@@ -1,0 +1,46 @@
+"""Test harness: 8 virtual CPU devices + TPU interpreter for all Pallas
+kernels (SURVEY.md §4 — this is where we exceed the reference, which can
+only test on real multi-GPU hardware).
+
+NOTE: on hosts with very few CPU cores, XLA:CPU's host thread pool can
+deadlock when many interpreted remote DMAs move large payloads concurrently
+(observed threshold ~16 KiB/chunk in 8-device ring kernels on a 1-core
+box). Keep per-DMA test payloads <= ~8 KiB; correctness coverage does not
+need more, and real-TPU runs are unaffected."""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _interpret_mode():
+    from triton_dist_tpu import config
+
+    config.update(interpret=True)
+    yield
+
+
+@pytest.fixture(scope="session")
+def mesh8() -> Mesh:
+    return Mesh(np.array(jax.devices()), ("tp",))
+
+
+@pytest.fixture(scope="session")
+def mesh2x4() -> Mesh:
+    return Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
+
+
+@pytest.fixture(scope="session")
+def mesh4() -> Mesh:
+    return Mesh(np.array(jax.devices()[:4]), ("tp",))

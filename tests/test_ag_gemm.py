@@ -1,0 +1,69 @@
+"""AG-GEMM vs golden (≙ reference test_ag_gemm.py: golden =
+all_gather_into_tensor + torch.matmul; here lax.all_gather + jnp.dot)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm, ag_gemm_op
+
+
+def _golden(a, b, mesh, axis="tp"):
+    def f(a, b):
+        a_full = jax.lax.all_gather(a, axis, tiled=True)
+        return jnp.dot(
+            a_full.astype(jnp.float32), b.astype(jnp.float32)
+        ).astype(a.dtype)
+
+    return jax.jit(
+        jax.shard_map(
+            f, mesh=mesh, in_specs=(P(axis, None), P(None, axis)),
+            out_specs=P(None, axis), check_vma=False,
+        )
+    )(a, b)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_ag_gemm(mesh4, dtype):
+    m_loc, k, n_total = 16, 128, 512
+    world = 4
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (world * m_loc, k)).astype(dtype)
+    b = jax.random.normal(jax.random.PRNGKey(1), (k, n_total)).astype(dtype)
+    cfg = AGGemmConfig(block_m=16, block_n=128, block_k=64)
+    got = ag_gemm_op(a, b, mesh4, config=cfg)
+    want = _golden(a, b, mesh4)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=3e-2, atol=3e-2
+    )
+
+
+def test_ag_gemm_gather_output(mesh4):
+    m_loc, k, n_total = 8, 128, 256
+    world = 4
+    a = jax.random.normal(jax.random.PRNGKey(2), (world * m_loc, k), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(3), (k, n_total), jnp.float32)
+
+    def f(a, b):
+        return ag_gemm(a, b, axis="tp", config=AGGemmConfig(8, 64, 64), gather_output=True)
+
+    c, ag = jax.jit(
+        jax.shard_map(
+            f, mesh=mesh4, in_specs=(P("tp", None), P(None, "tp")),
+            out_specs=(P(None, "tp"), P(None, None)), check_vma=False,
+        )
+    )(a, b)
+    np.testing.assert_array_equal(np.asarray(ag), np.asarray(a))
+    want = _golden(a, b, mesh4)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(want), rtol=2e-2, atol=2e-2)
+
+
+def test_ag_gemm_world1():
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("tp",))
+    a = jax.random.normal(jax.random.PRNGKey(4), (16, 128), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(5), (128, 128), jnp.float32)
+    got = ag_gemm_op(a, b, mesh, config=AGGemmConfig(16, 128, 128))
+    want = jnp.dot(a, b)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2)

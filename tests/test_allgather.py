@@ -1,0 +1,76 @@
+"""AllGather vs the XLA golden (≙ reference test_ag_gemm.py correctness
+pattern: golden = NCCL all_gather_into_tensor; here jax.lax.all_gather).
+Inputs are re-randomized across iterations (reference poisons workspaces,
+test_ag_gemm.py:120) to surface stale-data bugs."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.ops.allgather import all_gather, all_gather_op
+
+
+@pytest.mark.parametrize("method", ["ring_1d", "ring_bidir", "full_mesh_push"])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_all_gather_methods(mesh8, method, dtype):
+    # NOTE: keep per-PE chunks <= ~8 KiB — the TPU interpreter deadlocks on
+    # concurrent large DMAs when the host has few cores (see conftest).
+    m, d = 16, 128
+    fn = jax.jit(
+        jax.shard_map(
+            functools.partial(all_gather, axis="tp", method=method),
+            mesh=mesh8,
+            in_specs=P("tp"),
+            out_specs=P(None),
+            check_vma=False,
+        )
+    )
+    for it in range(3):
+        x = jax.random.normal(jax.random.PRNGKey(it), (8 * m, d)).astype(dtype)
+        out = fn(x)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+@pytest.mark.parametrize("method", ["ring_1d", "ring_bidir", "full_mesh_push"])
+def test_all_gather_smaller_world(mesh4, method):
+    m, d = 8, 128
+    x = jax.random.normal(jax.random.PRNGKey(0), (4 * m, d), jnp.float32)
+    out = all_gather_op(x, mesh4, axis="tp", method=method)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_all_gather_world1():
+    import jax
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("tp",))
+    x = jnp.ones((8, 128), jnp.float32)
+    out = all_gather_op(x, mesh, axis="tp")
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_all_gather_3d(mesh8):
+    """Gather of a rank-3 activation tensor (batch, seq, hidden)."""
+    x = jax.random.normal(jax.random.PRNGKey(1), (8 * 2, 8, 128), jnp.float32)
+    out = all_gather_op(x, mesh8, axis="tp", method="ring_1d")
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_all_gather_on_subaxis(mesh2x4):
+    """Gather along 'tp' of a 2-D (dp, tp) mesh — PE addressing must stay
+    within the row (team semantics)."""
+    m, d = 8, 128
+
+    def fn(x):
+        return all_gather(x, axis="tp", method="ring_1d")
+
+    x = jax.random.normal(jax.random.PRNGKey(2), (2 * 4 * m, d), jnp.float32)
+    out = jax.jit(
+        jax.shard_map(fn, mesh=mesh2x4, in_specs=P(("dp", "tp")), out_specs=P("dp"), check_vma=False)
+    )(x)
+    got = np.asarray(out).reshape(2, 4 * m, d)
+    want = np.asarray(x).reshape(2, 4 * m, d)
+    np.testing.assert_array_equal(got, want)

@@ -1,0 +1,19 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.ops.gemm import matmul
+
+
+@pytest.mark.parametrize("shape", [(256, 256, 256), (512, 128, 384)])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_matmul(shape, dtype):
+    m, k, n = shape
+    a = jax.random.normal(jax.random.PRNGKey(0), (m, k)).astype(dtype)
+    b = jax.random.normal(jax.random.PRNGKey(1), (k, n)).astype(dtype)
+    got = matmul(a, b, block_m=128, block_n=128, block_k=128)
+    want = jnp.dot(a.astype(jnp.float32), b.astype(jnp.float32)).astype(dtype)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=2e-2, atol=2e-2
+    )

@@ -1,0 +1,163 @@
+"""Primitive-level tests for the device SHMEM library (≙ reference
+test_notify.py / test_distributed_wait.py / test_nvshmem_api.py /
+test_ring_put.py)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.ops.common import dist_pallas_call
+from triton_dist_tpu.shmem import device as shmem
+
+
+def shard(fn, mesh, in_specs, out_specs):
+    return jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    )
+
+
+def test_notify_wait_ring(mesh8):
+    """tutorial-01 parity: each PE signals its right neighbor, waits for its
+    left, then writes its rank."""
+
+    def kernel(out_ref, sem):
+        me = shmem.my_pe("tp")
+        n = shmem.n_pes("tp")
+        right = jax.lax.rem(me + 1, n)
+        shmem.signal_op(sem, 1, pe=right, axis="tp")
+        shmem.wait(sem, 1)
+        out_ref[:] = jnp.full_like(out_ref, me)
+
+    def fn():
+        return dist_pallas_call(
+            kernel,
+            name="notify_wait",
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.SemaphoreType.REGULAR],
+        )()
+
+    out = shard(fn, mesh8, in_specs=(), out_specs=P("tp"))()
+    expect = np.repeat(np.arange(8), 8)[:, None] * np.ones((1, 128))
+    np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+def test_ring_put(mesh8):
+    """≙ test_ring_put.py: each PE puts its payload into its right
+    neighbor's output buffer."""
+
+    def kernel(x_ref, out_ref, send_sem, recv_sem):
+        me = shmem.my_pe("tp")
+        n = shmem.n_pes("tp")
+        right = jax.lax.rem(me + 1, n)
+        shmem.barrier_all("tp")
+        desc = shmem.putmem_nbi_block(out_ref, x_ref, right, "tp", send_sem, recv_sem)
+        desc.wait_recv()
+        shmem.quiet(desc)
+
+    def fn(x):
+        return dist_pallas_call(
+            kernel,
+            name="ring_put",
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(())],
+        )(x)
+
+    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(64, 128)
+    out = shard(fn, mesh8, in_specs=P("tp"), out_specs=P("tp"))(x)
+    out = np.asarray(out).reshape(8, 8, 128)
+    xs = np.asarray(x).reshape(8, 8, 128)
+    for peer in range(8):
+        np.testing.assert_array_equal(out[(peer + 1) % 8], xs[peer])
+
+
+@pytest.mark.parametrize("mesh_name", ["mesh8", "mesh4"])
+def test_barrier_all(mesh_name, request):
+    """Barrier correctness: PE r sleeps r loop-iterations before the
+    barrier; all must still observe every peer's pre-barrier write."""
+    mesh = request.getfixturevalue(mesh_name)
+    n = mesh.shape["tp"]
+
+    def kernel(flags_ref, out_ref, send_sem, recv_sem):
+        me = shmem.my_pe("tp")
+        shmem.barrier_all("tp")  # buffers live
+        # every PE broadcasts a flag to everyone (including itself)
+        descs = []
+        for d in range(n):
+            dst = jax.lax.rem(me + d, n)
+            descs.append(
+                shmem.putmem_nbi_block(
+                    flags_ref.at[pl.ds(me, 1)], flags_ref.at[pl.ds(me, 1)],
+                    dst, "tp", send_sem.at[d], recv_sem.at[d],
+                )
+            )
+        for desc in descs:
+            desc.wait_recv()
+        shmem.quiet(*descs)
+        shmem.barrier_all("tp")
+        out_ref[0, 0] = jnp.sum(flags_ref[:])
+
+    def fn(x):
+        flags = x  # (n, 128) one row per PE, row me pre-filled with me+1
+        return dist_pallas_call(
+            kernel,
+            name="barrier_test",
+            out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((n,)), pltpu.SemaphoreType.DMA((n,))],
+        )(flags)
+
+    # each PE's shard row me holds (me+1)/128 in every lane
+    rows = []
+    for r in range(n):
+        block = np.zeros((n, 128), np.float32)
+        block[r, :] = (r + 1) / 128.0
+        rows.append(block)
+    x = jnp.asarray(np.concatenate(rows, axis=0))
+    out = shard(fn, mesh, in_specs=P("tp"), out_specs=P("tp"))(x)
+    np.testing.assert_allclose(np.asarray(out).ravel(), np.full(n, n * (n + 1) / 2), rtol=1e-6)
+
+
+def test_putmem_signal(mesh8):
+    """≙ putmem_signal + signal_wait_until: receiver waits only on the
+    signal semaphore; data must be there."""
+
+    def kernel(x_ref, out_ref, sig_sem, send_sem):
+        me = shmem.my_pe("tp")
+        n = shmem.n_pes("tp")
+        right = jax.lax.rem(me + 1, n)
+        shmem.barrier_all("tp")
+        desc = shmem.putmem_signal_nbi_block(out_ref, x_ref, sig_sem, right, "tp", send_sem)
+        desc.wait_recv()  # waits OUR sig_sem: left neighbor's data arrived
+        shmem.quiet(desc)
+
+    def fn(x):
+        return dist_pallas_call(
+            kernel,
+            name="putmem_signal",
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(())],
+        )(x)
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32)
+    out = shard(fn, mesh8, in_specs=P("tp"), out_specs=P("tp"))(x)
+    out = np.asarray(out).reshape(8, 8, 128)
+    xs = np.asarray(x).reshape(8, 8, 128)
+    for peer in range(8):
+        np.testing.assert_array_equal(out[(peer + 1) % 8], xs[peer])
+
+
+def test_getmem_raises():
+    with pytest.raises(NotImplementedError):
+        shmem.getmem_nbi_block()

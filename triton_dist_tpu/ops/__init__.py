@@ -1,0 +1,9 @@
+"""Kernel zoo (≙ reference ``python/triton_dist/kernels/nvidia/``)."""
+
+from triton_dist_tpu.ops.gemm import matmul
+from triton_dist_tpu.ops.allgather import (
+    all_gather,
+    all_gather_op,
+    get_auto_all_gather_method,
+)
+from triton_dist_tpu.ops.common import barrier_all_op

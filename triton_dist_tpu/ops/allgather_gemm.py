@@ -1,0 +1,200 @@
+"""Fused AllGather-GEMM — the flagship overlapped op
+(≙ reference ``kernels/nvidia/allgather_gemm.py``, 748 LoC).
+
+The reference splits the op across CUDA streams: cp-engine producers push
+shards into a symmetric workspace while a persistent consumer GEMM kernel
+spins per-M-tile on readiness flags (``dl.wait`` + ``dl.consume_token``,
+allgather_gemm.py:226-227) with a rank-first tile swizzle (:206-219).
+
+TPU-native re-design: one fused Pallas kernel per PE. The ring transfer of
+the next shard rides the ICI DMA engines *while* the MXU multiplies the
+current shard through an inner ``emit_pipeline`` (HBM→VMEM double-buffered
+matmul). The reference's tile swizzle becomes the ring schedule itself:
+step s computes shard ``(me - s) % n``, which is exactly "start at own rank,
+walk in ring-arrival order" — compute order equals arrival order, so there
+is no wait bubble after the first hop.
+
+    step 0:  compute own shard       | send own shard to right neighbor
+    step s:  wait shard (me-s)       | forward it right | MXU on it
+
+Used for TP column-parallel layers: A is sharded on M (tokens), B on N
+(features); every PE gets the full gathered A and its N-shard of C.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.ops.common import dist_pallas_call
+from triton_dist_tpu.shmem import device as shmem
+from triton_dist_tpu.utils import cdiv
+
+
+@dataclasses.dataclass(frozen=True)
+class AGGemmConfig:
+    """Tunables (≙ ``AllGatherGEMMTensorParallelContext``,
+    reference allgather_gemm.py:407-489 — minus the stream/workspace
+    plumbing, which the fused kernel does not need)."""
+
+    block_m: int = 256
+    block_n: int = 256
+    block_k: int = 256
+
+
+def _pick_block(dim: int, block: int) -> int:
+    block = min(block, dim)
+    while dim % block != 0:
+        block //= 2
+    return max(block, 1)
+
+
+def _ag_gemm_kernel(
+    a_ref, b_ref, out_ref, ag_ref, acc_ref, copy_sem, send_sems, recv_sems,
+    *, axis: str, n: int, cfg: AGGemmConfig, out_dtype,
+):
+    me = shmem.my_pe(axis)
+    m_loc, k_dim = a_ref.shape
+    n_loc = b_ref.shape[1]
+    bm = _pick_block(m_loc, cfg.block_m)
+    bn = _pick_block(n_loc, cfg.block_n)
+    bk = _pick_block(k_dim, cfg.block_k)
+    n_k = k_dim // bk
+
+    local = pltpu.make_async_copy(a_ref, ag_ref.at[pl.ds(me * m_loc, m_loc)], copy_sem)
+    local.start()
+    local.wait()
+    shmem.barrier_all(axis)
+
+    right = jax.lax.rem(me + 1, n)
+
+    def mm_body(a_blk, b_blk, o_blk):
+        kk = pl.program_id(2)
+
+        @pl.when(kk == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        acc_ref[:] += jnp.dot(a_blk[:], b_blk[:], preferred_element_type=jnp.float32)
+
+        @pl.when(kk == n_k - 1)
+        def _():
+            o_blk[:] = acc_ref[:].astype(out_dtype)
+
+    pipeline = pltpu.emit_pipeline(
+        mm_body,
+        grid=(m_loc // bm, n_loc // bn, n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=[pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j))],
+    )
+
+    descs = []
+    for s in range(n):
+        c = jax.lax.rem(me - s + 2 * n, n)
+        if s > 0:
+            descs[s - 1].wait_recv()  # shard c landed during step s-1
+        sl = pl.ds(c * m_loc, m_loc)
+        if s < n - 1:
+            # Forward shard c around the ring *before* computing on it: the
+            # ICI transfer overlaps the MXU work below (≙ producer stream).
+            descs.append(
+                shmem.putmem_nbi_block(
+                    ag_ref.at[sl], ag_ref.at[sl], right, axis,
+                    send_sems.at[s], recv_sems.at[s],
+                )
+            )
+        pipeline(ag_ref.at[sl], b_ref, out_ref.at[sl])
+    shmem.quiet(*descs)
+
+
+def ag_gemm(
+    a: jax.Array,
+    b: jax.Array,
+    *,
+    axis: str = "tp",
+    config: AGGemmConfig | None = None,
+    gather_output: bool = False,
+    out_dtype: Any = None,
+    interpret: Any = None,
+):
+    """Overlapped ``all_gather(a) @ b`` (call inside ``jax.shard_map``).
+
+    a: ``[m_loc, K]`` — M-sharded activations on this PE.
+    b: ``[K, n_loc]`` — N-shard of the weight (column-parallel).
+    Returns ``[n*m_loc, n_loc]`` (plus the gathered ``[n*m_loc, K]`` A if
+    `gather_output`, ≙ the reference returning its AG workspace for reuse).
+    Golden: ``jax.lax.all_gather(a, axis, tiled=True) @ b``.
+    """
+    cfg = config or AGGemmConfig()
+    n = int(jax.lax.axis_size(axis))
+    m_loc, k_dim = a.shape
+    n_loc = b.shape[1]
+    out_dtype = out_dtype or a.dtype
+    bm = _pick_block(m_loc, cfg.block_m)
+    bn = _pick_block(n_loc, cfg.block_n)
+    out, ag = dist_pallas_call(
+        functools.partial(
+            _ag_gemm_kernel, axis=axis, n=n, cfg=cfg, out_dtype=out_dtype
+        ),
+        name="ag_gemm",
+        out_shape=(
+            jax.ShapeDtypeStruct((n * m_loc, n_loc), out_dtype),
+            jax.ShapeDtypeStruct((n * m_loc, k_dim), a.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bm, bn), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * m_loc * n_loc * k_dim,
+            bytes_accessed=(n * m_loc * k_dim + k_dim * n_loc + n * m_loc * n_loc) * a.dtype.itemsize,
+            transcendentals=0,
+        ),
+        uses_barrier=n > 1,
+        interpret=interpret,
+    )(a, b)
+    return (out, ag) if gather_output else out
+
+
+def ag_gemm_op(
+    a: jax.Array,
+    b: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "tp",
+    config: AGGemmConfig | None = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Host-level entry (≙ ``ag_gemm``, reference allgather_gemm.py:539):
+    `a` sharded on dim 0, `b` sharded on dim 1, result replicated on M and
+    sharded on N."""
+    fn = functools.partial(ag_gemm, axis=axis, config=config, interpret=interpret)
+    return jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(P(axis, None), P(None, axis)),
+            out_specs=P(None, axis),
+            check_vma=False,
+        )
+    )(a, b)

@@ -1,0 +1,107 @@
+"""Shared kernel-building helpers (≙ reference ``kernels/nvidia/common_ops.py``).
+
+The reference's common_ops holds device barrier kernels and host
+stream-signal wrappers (``wait_eq``/``set_signal`` over cuStreamWriteValue,
+:196-229). On TPU the host cannot poke device memory mid-program, so the
+surviving pieces are: a standalone barrier kernel, collective-id management,
+and the ``dist_pallas_call`` wrapper that all distributed kernels use.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu import config as tdt_config
+from triton_dist_tpu.shmem import device as shmem
+
+
+_collective_id_counter = itertools.count(1)
+_collective_ids: dict[str, int] = {}
+
+
+def collective_id_for(name: str) -> int:
+    """Stable collective_id per kernel family (barrier semaphores of
+    concurrently-running kernels must not collide)."""
+    if name not in _collective_ids:
+        _collective_ids[name] = next(_collective_id_counter) % 32
+    return _collective_ids[name]
+
+
+def dist_pallas_call(
+    kernel,
+    *,
+    name: str,
+    out_shape: Any,
+    in_specs: Sequence[pl.BlockSpec] | None = None,
+    out_specs: Any = None,
+    grid: tuple[int, ...] | None = None,
+    grid_spec: Any = None,
+    scratch_shapes: Sequence[Any] = (),
+    cost_estimate: pl.CostEstimate | None = None,
+    vmem_limit_bytes: int | None = None,
+    interpret: Any = None,
+    dimension_semantics: tuple[str, ...] | None = None,
+    input_output_aliases: dict[int, int] | None = None,
+    uses_barrier: bool = True,
+):
+    """pallas_call with the invariants every distributed kernel needs:
+    side effects on (remote DMAs must not be DCE'd), a collective_id for the
+    barrier semaphore, and config-resolved interpret mode.
+
+    `uses_barrier` must be False for degenerate single-PE calls: Mosaic
+    rejects a collective_id on kernels that never touch the barrier
+    semaphore."""
+    params: dict[str, Any] = dict(has_side_effects=True)
+    if uses_barrier:
+        params["collective_id"] = collective_id_for(name)
+    if vmem_limit_bytes is not None:
+        params["vmem_limit_bytes"] = vmem_limit_bytes
+    if dimension_semantics is not None:
+        params["dimension_semantics"] = dimension_semantics
+    kwargs: dict[str, Any] = {}
+    if grid_spec is not None:
+        kwargs["grid_spec"] = grid_spec
+    else:
+        if grid is not None:
+            kwargs["grid"] = grid
+        if in_specs is not None:
+            kwargs["in_specs"] = list(in_specs)
+        if out_specs is not None:
+            kwargs["out_specs"] = out_specs
+    if input_output_aliases:
+        kwargs["input_output_aliases"] = input_output_aliases
+    return pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        scratch_shapes=list(scratch_shapes),
+        compiler_params=pltpu.CompilerParams(**params),
+        cost_estimate=cost_estimate,
+        interpret=tdt_config.interpret_params() if interpret is None else interpret,
+        name=name,
+        **kwargs,
+    )
+
+
+def barrier_all_op(axis: str = "tp", interpret: Any = None) -> None:
+    """Standalone device barrier over a mesh axis — call inside shard_map
+    (≙ ``barrier_all_on_stream`` / ``barrier_all_intra_node_atomic_cas_block``,
+    common_ops.py:87-193)."""
+
+    def _kernel(out_ref):
+        shmem.barrier_all(axis)
+        out_ref[0] = jnp.int32(1)
+
+    return dist_pallas_call(
+        _kernel,
+        name="barrier_all",
+        out_shape=jax.ShapeDtypeStruct((1,), jnp.int32),
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        uses_barrier=int(jax.lax.axis_size(axis)) > 1,
+        interpret=interpret,
+    )()

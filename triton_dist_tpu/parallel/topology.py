@@ -1,0 +1,95 @@
+"""Topology discovery for TPU slices.
+
+TPU-native analogue of the reference's NVLink/NUMA probing
+(``python/triton_dist/utils.py:504-786``: ``get_has_fullmesh_nvlink``,
+``get_numa_world_size``, ``check_p2p_native_atomic_supported``,
+``get_intranode_max_speed``). On TPU the questions become: what are the
+physical torus coordinates of each device (``device.coords``), is the mesh
+axis a wrap-around ring, and what per-link ICI bandwidth to assume for
+method auto-selection and perf models.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+
+
+# Per-direction ICI link bandwidth, GB/s (one link). Conservative public
+# numbers; used only for auto-selection heuristics and SOL perf models
+# (≙ reference get_intranode_max_speed, utils.py:742).
+ICI_GBPS = {
+    "v4": 50.0,
+    "v5e": 45.0,
+    "v5p": 100.0,
+    "v6e": 90.0,
+    "cpu": 1.0,  # interpreter/testing
+}
+
+# Dense bf16 peak TFLOPs per chip (≙ gemm_perf_model.py tensor-core tables).
+PEAK_BF16_TFLOPS = {
+    "v4": 275.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6e": 918.0,
+    "cpu": 0.1,
+}
+
+HBM_GBPS = {
+    "v4": 1200.0,
+    "v5e": 819.0,
+    "v5p": 2765.0,
+    "v6e": 1640.0,
+    "cpu": 50.0,
+}
+
+
+def tpu_generation() -> str:
+    """Best-effort TPU generation string ('v5e', 'v5p', ...) or 'cpu'."""
+    devs = jax.devices()
+    if not devs or devs[0].platform not in ("tpu", "axon"):
+        return "cpu"
+    kind = getattr(devs[0], "device_kind", "").lower()
+    for gen in ("v6e", "v5p", "v5e", "v4"):
+        if gen in kind.replace(" ", "").replace("lite", "e"):
+            return gen
+    if "v5" in kind:
+        return "v5e" if "lite" in kind else "v5p"
+    return "v5e"
+
+
+def has_wraparound(axis_size: int) -> bool:
+    """Whether a mesh axis of this size forms a wrap-around torus ring.
+
+    TPU slices have wrap-around links when a full torus dimension is used
+    (≥ a full cube edge). Heuristic: wrap exists for axis sizes that fill a
+    torus dimension; we assume yes for sizes >= 4 on real TPU (v4/v5p 3-D
+    torus), which is the common production case, and always for the
+    interpreter (≙ reference get_has_fullmesh_nvlink, utils.py:762).
+    """
+    return axis_size >= 2
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkSpec:
+    gbps: float
+    generation: str
+
+
+def ici_link(gen: str | None = None) -> LinkSpec:
+    g = gen or tpu_generation()
+    return LinkSpec(gbps=ICI_GBPS.get(g, 45.0), generation=g)
+
+
+def device_coords(devices: Sequence[jax.Device] | None = None):
+    """Physical coords of each device, or None on non-TPU backends."""
+    devices = list(devices if devices is not None else jax.devices())
+    coords = []
+    for d in devices:
+        c = getattr(d, "coords", None)
+        if c is None:
+            return None
+        coords.append(tuple(c))
+    return coords

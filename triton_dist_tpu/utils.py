@@ -1,0 +1,118 @@
+"""Host-side utilities: timing, allclose, rank-aware printing, seeding.
+
+TPU-native re-design of the reference's ``python/triton_dist/utils.py``
+(dist_print :201, assert_allclose :789-818, perf_func :186-198,
+init_seed :75-88). CUDA-event timing becomes ``block_until_ready`` walltime;
+per-rank seeding becomes ``jax.random`` key folding.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import os
+import time
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(a: int, b: int) -> int:
+    return cdiv(a, b) * b
+
+
+def next_power_of_2(x: int) -> int:
+    return 1 if x <= 1 else 2 ** math.ceil(math.log2(x))
+
+
+def dist_print(*args: Any, rank: int = 0, prefix: bool = True, allowed_ranks: Sequence[int] | str = (0,), **kwargs: Any) -> None:
+    """Rank-filtered printing (≙ reference utils.py:201-230).
+
+    In JAX the host process is usually singular even for many devices, so
+    `rank` here is the process index (multi-host) rather than device rank.
+    """
+    pid = jax.process_index()
+    if allowed_ranks == "all":
+        allowed = range(jax.process_count())
+    else:
+        allowed = allowed_ranks
+    if pid in allowed:
+        if prefix:
+            print(f"[rank {pid}]", *args, **kwargs)
+        else:
+            print(*args, **kwargs)
+
+
+def init_seed(seed: int = 0, rank: int | None = None) -> jax.Array:
+    """Deterministic per-rank seeding (≙ reference utils.py:75-88)."""
+    rank = jax.process_index() if rank is None else rank
+    np.random.seed(seed + rank)
+    return jax.random.fold_in(jax.random.PRNGKey(seed), rank)
+
+
+def assert_allclose(x: jax.Array, y: jax.Array, atol: float = 1e-3, rtol: float = 1e-3, verbose: bool = True) -> None:
+    """Verbose allclose (≙ reference utils.py:789-818): reports worst
+    mismatch location/magnitude instead of a bare boolean."""
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    if x.shape != y.shape:
+        raise AssertionError(f"shape mismatch: {x.shape} vs {y.shape}")
+    err = np.abs(x - y) - (atol + rtol * np.abs(y))
+    bad = err > 0
+    if bad.any():
+        n_bad = int(bad.sum())
+        idx = np.unravel_index(np.argmax(err), err.shape)
+        msg = (
+            f"allclose failed: {n_bad}/{x.size} elements "
+            f"({100.0 * n_bad / x.size:.3f}%) exceed atol={atol} rtol={rtol}; "
+            f"worst at {idx}: {x[idx]} vs {y[idx]} (abs err {abs(x[idx]-y[idx]):.6g})"
+        )
+        if verbose:
+            print(msg)
+        raise AssertionError(msg)
+
+
+def perf_func(fn: Callable[[], Any], iters: int = 10, warmup_iters: int = 3) -> tuple[Any, float]:
+    """Time a jitted thunk, returning (last_output, mean_ms)
+    (≙ reference utils.py:186-198, CUDA events → walltime over
+    block_until_ready)."""
+    out = None
+    for _ in range(warmup_iters):
+        out = fn()
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    t1 = time.perf_counter()
+    return out, (t1 - t0) * 1e3 / iters
+
+
+@contextlib.contextmanager
+def group_profile(name: str | None = None, do_prof: bool = True, log_dir: str = "prof"):
+    """Profiling context (≙ reference utils.py:417-501 `group_profile`).
+
+    The reference collects per-rank torch chrome traces and merges them; the
+    XLA profiler already records every local device in one trace, so this is
+    a thin wrapper over ``jax.profiler`` writing a Perfetto/TensorBoard trace.
+    """
+    if not do_prof:
+        yield
+        return
+    path = os.path.join(log_dir, name or "trace")
+    os.makedirs(path, exist_ok=True)
+    jax.profiler.start_trace(path)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def bytes_of(x: jax.Array | jax.ShapeDtypeStruct) -> int:
+    return int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize
