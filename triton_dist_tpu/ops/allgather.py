@@ -26,7 +26,6 @@ import functools
 from typing import Any
 
 import jax
-import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
@@ -41,7 +40,9 @@ def get_auto_all_gather_method(chunk_bytes: int, n_pes: int) -> str:
     reference allgather.py:44-69, which keys on NVLink-fullmesh/NUMA)."""
     if n_pes <= 2:
         return "ring_1d"
-    if chunk_bytes <= 256 * 1024:
+    if chunk_bytes <= 256 * 1024 or not topology.has_wraparound(n_pes):
+        # Small latency-bound sizes, or a line topology where a ring's wrap
+        # hop would route the long way: direct hardware-routed puts win.
         return "full_mesh_push"
     return "ring_bidir"
 

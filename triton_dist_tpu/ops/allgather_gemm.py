@@ -44,9 +44,9 @@ class AGGemmConfig:
     reference allgather_gemm.py:407-489 — minus the stream/workspace
     plumbing, which the fused kernel does not need)."""
 
-    block_m: int = 256
-    block_n: int = 256
-    block_k: int = 256
+    block_m: int = 512
+    block_n: int = 2048
+    block_k: int = 512
 
 
 def _pick_block(dim: int, block: int) -> int:

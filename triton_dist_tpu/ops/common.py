@@ -27,9 +27,19 @@ _collective_ids: dict[str, int] = {}
 
 def collective_id_for(name: str) -> int:
     """Stable collective_id per kernel family (barrier semaphores of
-    concurrently-running kernels must not collide)."""
+    concurrently-running kernels must not collide). Mosaic supports a small
+    fixed pool of collective ids; running out is an error rather than a
+    silent wrap that would alias two families' barrier semaphores."""
     if name not in _collective_ids:
-        _collective_ids[name] = next(_collective_id_counter) % 32
+        next_id = next(_collective_id_counter)
+        if next_id >= 32:
+            raise RuntimeError(
+                f"out of collective_ids (32 kernel families in use) while "
+                f"registering {name!r}; reuse an existing family name in "
+                f"dist_pallas_call(name=...) for kernels that never run "
+                f"concurrently"
+            )
+        _collective_ids[name] = next_id
     return _collective_ids[name]
 
 

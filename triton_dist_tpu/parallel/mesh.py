@@ -81,8 +81,11 @@ def initialize_distributed(
     (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID) the way the reference
     honors RANK/WORLD_SIZE, then builds the global mesh over all devices.
     """
+    # NOTE: must run before anything touches the JAX backend (querying
+    # jax.devices()/process_count() first would initialize the local backend
+    # and make distributed init fail).
     coord = os.environ.get("COORDINATOR_ADDRESS") or os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if coord and jax.process_count() == 1 and not jax.distributed.is_initialized():
+    if coord and not jax.distributed.is_initialized():
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=int(os.environ.get("NUM_PROCESSES", os.environ.get("WORLD_SIZE", "1"))),

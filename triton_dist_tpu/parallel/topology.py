@@ -64,12 +64,14 @@ def has_wraparound(axis_size: int) -> bool:
     """Whether a mesh axis of this size forms a wrap-around torus ring.
 
     TPU slices have wrap-around links when a full torus dimension is used
-    (≥ a full cube edge). Heuristic: wrap exists for axis sizes that fill a
-    torus dimension; we assume yes for sizes >= 4 on real TPU (v4/v5p 3-D
-    torus), which is the common production case, and always for the
-    interpreter (≙ reference get_has_fullmesh_nvlink, utils.py:762).
+    (≥ a full cube edge). Heuristic: on real TPU, yes for sizes >= 4
+    (v4/v5p 3-D torus fills a ring at 4) and trivially for 2 (one link
+    serves both directions); a 3-chip line has no wrap. The interpreter
+    simulates any ring (≙ reference get_has_fullmesh_nvlink, utils.py:762).
     """
-    return axis_size >= 2
+    if tpu_generation() == "cpu":
+        return True
+    return axis_size == 2 or axis_size >= 4
 
 
 @dataclasses.dataclass(frozen=True)

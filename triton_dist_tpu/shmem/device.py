@@ -221,6 +221,15 @@ def barrier_all(axis: str | Sequence[str] = "tp"):
     ceil(log2(n)) rounds; in round r each PE signals (me + 2^r) % n and
     consumes one signal. Requires ``collective_id`` to be set in the
     kernel's ``pltpu.CompilerParams``.
+
+    Cross-invocation caveat: the barrier semaphore is shared between
+    launches with the same collective_id, so a PE racing far ahead into
+    launch k+1 could in principle satisfy a slow PE's launch-k wait early.
+    This framework relies on the Mosaic runtime serializing collective
+    kernels that share a collective_id (and on XLA's in-order per-device
+    queues), which is the same contract the official Pallas distributed
+    kernels assume. Do not give two kernels that may run concurrently the
+    same ``dist_pallas_call(name=...)``.
     """
     axes = [axis] if isinstance(axis, str) else list(axis)
     sizes = [n_pes(a) for a in axes]
