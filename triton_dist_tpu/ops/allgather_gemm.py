@@ -35,7 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_dist_tpu.ops.common import dist_pallas_call
 from triton_dist_tpu.shmem import device as shmem
-from triton_dist_tpu.utils import cdiv
+from triton_dist_tpu.utils import pick_block as _pick_block
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,13 +47,6 @@ class AGGemmConfig:
     block_m: int = 512
     block_n: int = 2048
     block_k: int = 512
-
-
-def _pick_block(dim: int, block: int) -> int:
-    block = min(block, dim)
-    while dim % block != 0:
-        block //= 2
-    return max(block, 1)
 
 
 def _ag_gemm_kernel(

@@ -34,7 +34,7 @@ def collective_id_for(name: str) -> int:
         next_id = next(_collective_id_counter)
         if next_id >= 32:
             raise RuntimeError(
-                f"out of collective_ids (32 kernel families in use) while "
+                f"out of collective_ids (31 kernel families in use) while "
                 f"registering {name!r}; reuse an existing family name in "
                 f"dist_pallas_call(name=...) for kernels that never run "
                 f"concurrently"

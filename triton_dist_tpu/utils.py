@@ -31,6 +31,15 @@ def next_power_of_2(x: int) -> int:
     return 1 if x <= 1 else 2 ** math.ceil(math.log2(x))
 
 
+def pick_block(dim: int, block: int) -> int:
+    """Largest divisor of `dim` that is <= `block` and power-of-2-shrinkable
+    from it (block-shape picker shared by the fused kernels)."""
+    block = min(block, dim)
+    while dim % block != 0:
+        block //= 2
+    return max(block, 1)
+
+
 def dist_print(*args: Any, rank: int | None = None, prefix: bool = True, allowed_ranks: Sequence[int] | str = (0,), **kwargs: Any) -> None:
     """Rank-filtered printing (≙ reference utils.py:201-230).
 
