@@ -1,0 +1,64 @@
+"""Fused GEMM-RS vs golden (≙ reference test_gemm_rs.py: golden =
+torch.matmul + reduce_scatter_tensor; here jnp.dot + lax.psum_scatter)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs, gemm_rs_op
+
+
+def _golden(a, b, mesh, axis="tp"):
+    def f(a, b):
+        c = jnp.dot(a.astype(jnp.float32), b.astype(jnp.float32))
+        return jax.lax.psum_scatter(c, axis, scatter_dimension=0, tiled=True).astype(
+            a.dtype
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            f, mesh=mesh, in_specs=(P(None, axis), P(axis, None)),
+            out_specs=P(axis, None), check_vma=False,
+        )
+    )(a, b)
+
+
+@pytest.mark.parametrize("method", ["scatter", "ring"])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_gemm_rs(mesh4, method, dtype):
+    m_tot, k_tot, n_dim = 64, 256, 256
+    a = jax.random.normal(jax.random.PRNGKey(0), (m_tot, k_tot)).astype(dtype)
+    b = jax.random.normal(jax.random.PRNGKey(1), (k_tot, n_dim)).astype(dtype)
+    cfg = GemmRSConfig(block_m=16, block_n=128, block_k=64)
+    got = gemm_rs_op(a, b, mesh4, method=method, config=cfg)
+    want = _golden(a, b, mesh4)
+    # bf16 partials are rounded once per transfer before the f32 reduce
+    # (same as the reference, whose tiles move in output dtype) — wider
+    # tolerance than the all-f32 golden.
+    tol = dict(rtol=1e-3, atol=1e-3) if dtype == jnp.float32 else dict(rtol=6e-2, atol=2e-1)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), **tol
+    )
+
+
+@pytest.mark.parametrize("method", ["scatter", "ring"])
+def test_gemm_rs_world8(mesh8, method):
+    m_tot, k_tot, n_dim = 64, 128, 256
+    a = jax.random.normal(jax.random.PRNGKey(2), (m_tot, k_tot), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(3), (k_tot, n_dim), jnp.float32)
+    cfg = GemmRSConfig(block_m=8, block_n=128, block_k=16)
+    got = gemm_rs_op(a, b, mesh8, method=method, config=cfg)
+    want = _golden(a, b, mesh8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-3, atol=1e-3)
+
+
+def test_gemm_rs_world1():
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    a = jax.random.normal(jax.random.PRNGKey(4), (16, 128), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(5), (128, 128), jnp.float32)
+    got = gemm_rs_op(a, b, mesh, config=GemmRSConfig(16, 128, 128))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(jnp.dot(a, b)), rtol=2e-2, atol=2e-2
+    )

@@ -1,10 +1,5 @@
 """ReduceScatter vs golden (≙ reference test_reduce_scatter.py:
-golden = torch.distributed reduce_scatter_tensor; here lax.psum_scatter).
-
-The ring method is pinned to <=4 simulated devices: its add-between-hops
-chain livelocks the CPU interpreter's cooperative DMA scheduler at larger
-world sizes (see module docstring); scatter_reduce covers world 8.
-"""
+golden = torch.distributed reduce_scatter_tensor; here lax.psum_scatter)."""
 
 import jax
 import jax.numpy as jnp
@@ -56,10 +51,11 @@ def test_reduce_scatter_methods(mesh4, method, dtype):
     )
 
 
-def test_scatter_reduce_world8(mesh8):
+@pytest.mark.parametrize("method", ["ring", "scatter_reduce"])
+def test_reduce_scatter_world8(mesh8, method):
     n, m_total, n_dim = 8, 64, 128
     x = jax.random.normal(jax.random.PRNGKey(1), (n, m_total, n_dim), jnp.float32)
-    got = _run(mesh8, x, method="scatter_reduce",
+    got = _run(mesh8, x, method=method,
                config=ReduceScatterConfig(block_m=8, block_n=128))
     want = _golden(mesh8, x)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)

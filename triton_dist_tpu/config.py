@@ -54,6 +54,98 @@ def on_tpu() -> bool:
         return False
 
 
+_interp_scheduler_patched = False
+
+
+def _patch_interpreter_scheduler() -> None:
+    """De-starve the TPU interpreter's semaphore scheduler on low-core hosts.
+
+    jax 0.9.0's interpreter executes pending DMAs lazily from within
+    ``Semaphore.wait`` (``dma_execution_mode='on_wait'``); when a core waits
+    on a semaphore whose producing DMA has not been *issued* yet (because the
+    producing core is still in compute), the wait busy-spins on the shared
+    lock. On a 1-core host the spinners starve the producing thread — a
+    livelock for any kernel whose cross-device dependency chain passes
+    through compute (exactly what fused GEMM+comm kernels do). This installs
+    a copy of ``Semaphore.wait`` whose empty-task-queue branch sleeps briefly
+    instead of hot-looping. Interpreter-only; never active on real TPU.
+    """
+    global _interp_scheduler_patched
+    if _interp_scheduler_patched:
+        return
+    _interp_scheduler_patched = True
+    try:
+        import time as _time
+
+        _debug_wait = bool(int(os.environ.get("TDT_DEBUG_WAIT", "0")))
+
+        from jax._src.pallas.mosaic.interpret import shared_memory as _sm
+        from jax._src.pallas.mosaic.interpret import vector_clock as _vc
+
+        def _wait(self, value, global_core_id, *, has_tasks=False):
+            global_core_id = int(global_core_id)
+            clock = None
+            if not has_tasks:
+                with self.cv:
+                    while self.count_by_core[global_core_id] < value:
+                        self.cv.wait()
+                    self.count_by_core[global_core_id] -= value
+                    if self.detect_races:
+                        clock = _vc.copy_vector_clock(self.clocks[global_core_id])
+                if self.detect_races:
+                    with self.shared_memory.lock:
+                        _vc.update_vector_clock(
+                            self.shared_memory.clocks[global_core_id], clock
+                        )
+                return
+            while True:
+                clock = None
+                with self.cv:
+                    if self.count_by_core[global_core_id] >= value:
+                        self.count_by_core[global_core_id] -= value
+                        if self.detect_races:
+                            clock = _vc.copy_vector_clock(self.clocks[global_core_id])
+                        else:
+                            return
+                if clock is not None:
+                    with self.shared_memory.lock:
+                        _vc.update_vector_clock(
+                            self.shared_memory.clocks[global_core_id], clock
+                        )
+                    return
+                with self.shared_memory.lock:
+                    task_queue = self.shared_memory.tasks_by_sem[
+                        (self.id, global_core_id)
+                    ]
+                    task = task_queue.pop() if len(task_queue) > 0 else None
+                if task is None:
+                    _time.sleep(5e-4)  # the one change vs upstream: no hot spin
+                    stalls = getattr(self, "_tdt_stalls", 0) + 1
+                    self._tdt_stalls = stalls
+                    if _debug_wait and stalls % 2000 == 0:
+                        print(
+                            f"[tdt-wait] sem={self.id} core={global_core_id} "
+                            f"want={value} have={self.count_by_core[global_core_id]} "
+                            f"stalls={stalls}",
+                            flush=True,
+                        )
+                    continue
+                self._tdt_stalls = 0
+                task()
+
+        _sm.Semaphore.wait = _wait
+    except Exception as e:  # pragma: no cover - jax version drift
+        import warnings
+
+        warnings.warn(
+            f"triton_dist_tpu: could not patch the Pallas interpreter "
+            f"semaphore scheduler ({e!r}); interpreted distributed kernels "
+            f"whose dependency chains pass through compute may livelock on "
+            f"low-core hosts",
+            RuntimeWarning,
+        )
+
+
 _cpu_tpu_info_registered = False
 
 
@@ -109,6 +201,7 @@ def interpret_params():
     if not use_interpret:
         return False
     _ensure_cpu_tpu_info()
+    _patch_interpreter_scheduler()
     return pltpu.InterpretParams(
         detect_races=cfg.detect_races,
         dma_execution_mode=cfg.dma_execution_mode,

@@ -7,3 +7,11 @@ from triton_dist_tpu.ops.allgather import (
     get_auto_all_gather_method,
 )
 from triton_dist_tpu.ops.common import barrier_all_op
+from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm, ag_gemm_op
+from triton_dist_tpu.ops.reduce_scatter import (
+    ReduceScatterConfig,
+    get_auto_reduce_scatter_method,
+    reduce_scatter,
+    reduce_scatter_op,
+)
+from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs, gemm_rs_op

@@ -18,12 +18,6 @@ has two stages we keep, re-designed TPU-native, plus the classic ring:
 Method choice mirrors ``get_auto_all_gather_method`` (allgather.py:44-69):
 latency-bound sizes and wraparound-less topologies take ``scatter_reduce``,
 large payloads on a ring topology take ``ring``.
-
-Interpreter caveat: the ring's add-between-hops makes its cross-device
-dependency chain pass through emit_pipeline compute; the CPU interpreter's
-cooperative DMA scheduler livelocks on that pattern for >4 simulated
-devices (tests pin the ring to <=4; ``scatter_reduce`` runs at any world
-size). Real-TPU Mosaic execution does not share the limitation.
 """
 
 from __future__ import annotations
@@ -249,12 +243,15 @@ def reduce_scatter_op(
     config: ReduceScatterConfig | None = None,
     interpret: Any = None,
 ) -> jax.Array:
-    """Host-level entry: `x` is ``[n, m_total, ...]`` — slice i is PE i's
-    full partial array (sharded on the stacking dim over `axis`). Returns
-    ``[m_total, ...]`` = the elementwise sum, sharded on dim 0 over `axis`
-    (PE i owns rows ``[i*m_loc, (i+1)*m_loc)``)."""
+    """Host-level entry: `x` is ``[n, m_total]`` or ``[n, m_total, n_dim]``
+    — slice i is PE i's full partial array (sharded on the stacking dim over
+    `axis`). Returns ``[m_total, ...]`` = the elementwise sum, sharded on
+    dim 0 over `axis` (PE i owns rows ``[i*m_loc, (i+1)*m_loc)``). Collapse
+    extra trailing dims before calling (the kernel is 1-D/2-D)."""
     n = mesh.shape[axis]
     assert x.shape[0] == n, (x.shape, n)
+    if x.ndim not in (2, 3):
+        raise ValueError(f"reduce_scatter_op wants [n, m] or [n, m, d]; got {x.shape}")
     fn = functools.partial(
         reduce_scatter, axis=axis, method=method, config=config, interpret=interpret
     )

@@ -93,6 +93,40 @@ def pe_dev_id(axis: str, pe):
 # One-sided puts (≙ putmem_* family)
 # ---------------------------------------------------------------------------
 
+class PutHandle:
+    """Handle for an in-flight one-sided put.
+
+    Wraps Pallas's ``AsyncCopyDescriptor`` and records — at trace time, which
+    is exact because distributed kernels unroll their comm loops in Python —
+    whether ``wait_send`` has already consumed the send semaphore. Semaphore
+    waits are *consuming* (sem -= value), so waiting the same put's send side
+    twice deadlocks on real hardware exactly as in the interpreter; the
+    record lets :func:`quiet` be safely called on every handle at kernel end
+    without double-waiting ones that were recycled mid-loop.
+    """
+
+    __slots__ = ("desc", "send_waited")
+
+    def __init__(self, desc):
+        self.desc = desc
+        self.send_waited = False
+
+    def wait_send(self):
+        """Wait local completion: the source buffer is reusable after this."""
+        self.desc.wait_send()
+        self.send_waited = True
+
+    def wait_recv(self):
+        """Wait one incoming symmetric transfer on this put's recv semaphore
+        (SPMD symmetry: peers use the same semaphore slot, so this observes
+        the arrival *into* this PE, not our outbound put's remote delivery)."""
+        self.desc.wait_recv()
+
+    def wait(self):
+        self.wait_send()
+        self.wait_recv()
+
+
 def putmem_nbi_block(dst_ref, src_ref, pe, axis: str, send_sem, recv_sem):
     """Non-blocking one-sided put: write local `src_ref` into PE `pe`'s
     `dst_ref` (≙ ``libshmem_device.putmem_nbi_block``,
@@ -113,7 +147,7 @@ def putmem_nbi_block(dst_ref, src_ref, pe, axis: str, send_sem, recv_sem):
         device_id_type=pltpu.DeviceIdType.MESH,
     )
     copy.start()
-    return copy
+    return PutHandle(copy)
 
 
 def putmem_block(dst_ref, src_ref, pe, axis: str, send_sem, recv_sem):
@@ -196,8 +230,12 @@ def quiet(*copies):
     """Wait local (send) completion of the given nbi puts
     (≙ ``libshmem_device.quiet``): after return, source buffers are
     reusable. Does NOT imply remote delivery — remote delivery is observed
-    through the receiver's semaphore, as in NVSHMEM."""
+    through the receiver's semaphore, as in NVSHMEM. Handles whose send was
+    already waited mid-kernel are skipped (consuming semantics — a second
+    wait would deadlock)."""
     for c in copies:
+        if isinstance(c, PutHandle) and c.send_waited:
+            continue
         c.wait_send()
 
 
