@@ -15,3 +15,10 @@ from triton_dist_tpu.ops.reduce_scatter import (
     reduce_scatter_op,
 )
 from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs, gemm_rs_op
+from triton_dist_tpu.ops.flash_decode import (
+    FlashDecodeConfig,
+    combine_partials,
+    flash_decode,
+    flash_decode_distributed,
+    flash_decode_op,
+)

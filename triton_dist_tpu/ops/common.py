@@ -98,6 +98,48 @@ def dist_pallas_call(
     )
 
 
+def gemm_add_pipeline(
+    bm: int, bn: int, bk: int, m_dim: int, n_dim: int, k_dim: int,
+    acc_ref, out_dtype, n_adds: int = 0,
+):
+    """Tiled ``O = A @ B (+ sum(adds))`` as an inner ``emit_pipeline``: f32
+    VMEM accumulation over the k grid dim with the optional adds fused into
+    the last-k epilogue. The shared MXU workhorse of the fused kernels
+    (≙ the consumer/producer GEMM bodies of reference allgather_gemm.py:133
+    and gemm_reduce_scatter.py:125). Add operands use a k-invariant index
+    map, so Pallas fetches each of their tiles once."""
+    n_k = k_dim // bk
+
+    def body(a_blk, b_blk, *rest):
+        o_blk = rest[-1]
+        adds = rest[:-1]
+        kk = pl.program_id(2)
+
+        @pl.when(kk == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        acc_ref[:] += jnp.dot(a_blk[:], b_blk[:], preferred_element_type=jnp.float32)
+
+        @pl.when(kk == n_k - 1)
+        def _():
+            acc = acc_ref[:]
+            for r in adds:
+                acc = acc + r[:].astype(jnp.float32)
+            o_blk[:] = acc.astype(out_dtype)
+
+    return pltpu.emit_pipeline(
+        body,
+        grid=(m_dim // bm, n_dim // bn, n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        ]
+        + [pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j))] * n_adds,
+        out_specs=[pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j))],
+    )
+
+
 def barrier_all_op(axis: str = "tp", interpret: Any = None) -> None:
     """Standalone device barrier over a mesh axis — call inside shard_map
     (≙ ``barrier_all_on_stream`` / ``barrier_all_intra_node_atomic_cas_block``,

@@ -43,7 +43,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
-from triton_dist_tpu.ops.common import dist_pallas_call
+from triton_dist_tpu.ops.common import dist_pallas_call, gemm_add_pipeline
 from triton_dist_tpu.ops.reduce_scatter import get_auto_reduce_scatter_method
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block
@@ -58,46 +58,6 @@ class GemmRSConfig:
     block_m: int = 256
     block_n: int = 1024
     block_k: int = 512
-
-
-def _gemm_add_pipeline(
-    bm: int, bn: int, bk: int, m_loc: int, n_dim: int, k_dim: int,
-    acc_ref, out_dtype, n_adds: int,
-):
-    """Tiled ``O = A @ B (+ sum(adds))`` with the adds fused into the last-k
-    epilogue (≙ the producer GEMM epilogue that writes into the RS input
-    layout, reference gemm_reduce_scatter.py:125-235). The add operands use
-    a k-invariant index map, so Pallas fetches each of their tiles once."""
-    n_k = k_dim // bk
-
-    def body(a_blk, b_blk, *rest):
-        o_blk = rest[-1]
-        adds = rest[:-1]
-        kk = pl.program_id(2)
-
-        @pl.when(kk == 0)
-        def _():
-            acc_ref[:] = jnp.zeros_like(acc_ref)
-
-        acc_ref[:] += jnp.dot(a_blk[:], b_blk[:], preferred_element_type=jnp.float32)
-
-        @pl.when(kk == n_k - 1)
-        def _():
-            acc = acc_ref[:]
-            for r in adds:
-                acc = acc + r[:].astype(jnp.float32)
-            o_blk[:] = acc.astype(out_dtype)
-
-    return pltpu.emit_pipeline(
-        body,
-        grid=(m_loc // bm, n_dim // bn, n_k),
-        in_specs=[
-            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
-            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
-        ]
-        + [pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j))] * n_adds,
-        out_specs=[pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j))],
-    )
 
 
 def _blocks(cfg: GemmRSConfig, m_loc: int, n_dim: int, k_loc: int):
@@ -117,8 +77,8 @@ def _gemm_rs_scatter_kernel(
     n_dim = b_ref.shape[1]
     m_loc = m_tot // n
     bm, bn, bk = _blocks(cfg, m_loc, n_dim, k_loc)
-    gemm = _gemm_add_pipeline(bm, bn, bk, m_loc, n_dim, k_loc, acc_ref, out_dtype, 0)
-    gemm_reduce = _gemm_add_pipeline(
+    gemm = gemm_add_pipeline(bm, bn, bk, m_loc, n_dim, k_loc, acc_ref, out_dtype, 0)
+    gemm_reduce = gemm_add_pipeline(
         bm, bn, bk, m_loc, n_dim, k_loc, acc_ref, out_dtype, n - 1
     )
 
@@ -163,8 +123,8 @@ def _gemm_rs_ring_kernel(
     n_dim = b_ref.shape[1]
     m_loc = m_tot // n
     bm, bn, bk = _blocks(cfg, m_loc, n_dim, k_loc)
-    gemm = _gemm_add_pipeline(bm, bn, bk, m_loc, n_dim, k_loc, acc_ref, out_dtype, 0)
-    gemm_add = _gemm_add_pipeline(bm, bn, bk, m_loc, n_dim, k_loc, acc_ref, out_dtype, 1)
+    gemm = gemm_add_pipeline(bm, bn, bk, m_loc, n_dim, k_loc, acc_ref, out_dtype, 0)
+    gemm_add = gemm_add_pipeline(bm, bn, bk, m_loc, n_dim, k_loc, acc_ref, out_dtype, 1)
 
     shmem.barrier_all(axis)
     right = jax.lax.rem(me + 1, n)
