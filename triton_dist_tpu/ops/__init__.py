@@ -15,6 +15,11 @@ from triton_dist_tpu.ops.reduce_scatter import (
     reduce_scatter_op,
 )
 from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs, gemm_rs_op
+from triton_dist_tpu.ops.all_to_all import (
+    all_to_all_post_process,
+    fast_all_to_all,
+    fast_all_to_all_op,
+)
 from triton_dist_tpu.ops.flash_decode import (
     FlashDecodeConfig,
     combine_partials,

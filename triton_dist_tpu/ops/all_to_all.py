@@ -1,0 +1,183 @@
+"""Low-latency All-to-All — EP MoE dispatch/combine transport
+(≙ reference ``kernels/nvidia/low_latency_all_to_all.py``, 270 LoC, and the
+inter-rank transport half of ``ep_a2a.py``).
+
+Reference design (SURVEY.md §3.4): one kernel, grid = WORLD_SIZE, each block
+owns a peer — put data + splits, put-signal scale, ``fence``, then
+``signal_op``/``signal_wait_until`` on the own slot, with double-buffered
+symmetric buffers versioned by ``call_count`` (low_latency_all_to_all.py:36-118).
+
+TPU-native re-design:
+
+- **Padded slabs, static shapes.** Token counts per peer are runtime values;
+  XLA needs static shapes, so each PE sends its full ``[max_m, hidden]``
+  segment per peer (the reference pads its symmetric buffers to ``max_m``
+  the same way, :139-147). The valid count travels as a tiny int32 put into
+  the receiver's split slab. A latency-bound MoE dispatch (the 137 µs
+  README headline is 128 tokens/rank) is padded-slab-shaped anyway.
+- **No signals, no fence, no call_count.** The data-coupled receive
+  semaphore of each put IS the signal (arrival implies data, which NVSHMEM
+  needs fence + signal_op for), and every call opens with ``barrier_all``
+  over fresh DMA semaphores, so the double-buffer/versioning machinery
+  drops out entirely.
+- **Slot symmetry**: sender ``s`` writes receiver ``r``'s slab ``s`` — every
+  (sender, receiver) pair owns a distinct slab, the same trick as the
+  reference's per-rank segments of its symmetric recv buffer.
+
+`fast_all_to_all` is its own inverse (with transposed splits), so EP
+*combine* is a second call with the dispatch output — the topk-weighted
+reduction after combine lives in the MoE layer, not here.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.ops.common import dist_pallas_call
+from triton_dist_tpu.shmem import device as shmem
+
+
+def _a2a_kernel(
+    send_ref, splits_ref, recv_ref, rsplits_ref, copy_sems,
+    data_send, data_recv, spl_send, spl_recv,
+    *, axis: str, n: int,
+):
+    me = shmem.my_pe(axis)
+    # Own slab moves locally; both copies ride the local DMA engines while
+    # the remote puts below are in flight.
+    c1 = pltpu.make_async_copy(send_ref.at[me], recv_ref.at[me], copy_sems.at[0])
+    c2 = pltpu.make_async_copy(splits_ref.at[me], rsplits_ref.at[me], copy_sems.at[1])
+    c1.start()
+    c2.start()
+    shmem.barrier_all(axis)
+    descs = []
+    for d in range(1, n):
+        dst = jax.lax.rem(me + d, n)
+        # splits first: a tiny put the receiver could use to early-out reads
+        descs.append(
+            shmem.putmem_nbi_block(
+                rsplits_ref.at[me], splits_ref.at[dst], dst, axis,
+                spl_send.at[d - 1], spl_recv.at[d - 1],
+            )
+        )
+        descs.append(
+            shmem.putmem_nbi_block(
+                recv_ref.at[me], send_ref.at[dst], dst, axis,
+                data_send.at[d - 1], data_recv.at[d - 1],
+            )
+        )
+    c1.wait()
+    c2.wait()
+    # Symmetric SPMD: each descriptor's recv side counts the equal-sized
+    # incoming slab from peer me-d, so this waits for all arrivals.
+    for desc in descs:
+        desc.wait_recv()
+    shmem.quiet(*descs)
+
+
+def fast_all_to_all(
+    tokens: jax.Array,
+    splits: jax.Array,
+    *,
+    axis: str = "tp",
+    interpret: Any = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Exchange padded token slabs between all PEs of `axis` (call inside
+    ``jax.shard_map``; ≙ ``fast_all_to_all``, low_latency_all_to_all.py:189).
+
+    tokens: ``[n, max_m, hidden]`` — slab ``p`` holds the ``splits[p]``
+    tokens this PE sends to PE ``p`` (rows beyond the count are padding).
+    splits: ``[n]`` int32 valid counts.
+
+    Returns ``(recv, recv_splits)``: slab ``j`` of ``recv`` holds the tokens
+    PE ``j`` sent here (``recv_splits[j]`` valid rows). Golden:
+    ``jax.lax.all_to_all`` over the slab dim.
+    """
+    n = int(jax.lax.axis_size(axis))
+    n_slabs, max_m, hidden = tokens.shape
+    assert n_slabs == n, (n_slabs, n)
+    splits = splits.reshape(n, 1).astype(jnp.int32)
+    if n == 1:
+        return tokens, splits.reshape(n)
+    n_steps = n - 1
+    recv, rsplits = dist_pallas_call(
+        functools.partial(_a2a_kernel, axis=axis, n=n),
+        name="fast_all_to_all",
+        out_shape=(
+            jax.ShapeDtypeStruct((n, max_m, hidden), tokens.dtype),
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((n_steps,)),
+            pltpu.SemaphoreType.DMA((n_steps,)),
+            pltpu.SemaphoreType.DMA((n_steps,)),
+            pltpu.SemaphoreType.DMA((n_steps,)),
+        ],
+        interpret=interpret,
+    )(tokens, splits)
+    return recv, rsplits.reshape(n)
+
+
+def all_to_all_post_process(
+    recv: jax.Array, recv_splits: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Compact the padded recv slabs to the front (≙ ``all_to_all_post_process``,
+    low_latency_all_to_all.py:251). Returns ``(packed, total)`` where
+    ``packed[:total]`` are the valid tokens in slab order (rows after that
+    are zero); shapes stay static as jit requires."""
+    n, max_m, hidden = recv.shape
+    flat = recv.reshape(n * max_m, hidden)
+    slab = jnp.arange(n * max_m) // max_m
+    pos = jnp.arange(n * max_m) % max_m
+    valid = pos < recv_splits[slab]
+    # Stable sort by target position (padding keys to the back): valid rows
+    # land densely at the front in slab order.
+    offsets = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(recv_splits)[:-1]])
+    keys = jnp.where(valid, offsets[slab] + pos, n * max_m)
+    order = jnp.argsort(keys, stable=True)
+    packed = jnp.where(valid[order][:, None], flat[order], 0)
+    return packed, jnp.sum(recv_splits)
+
+
+def fast_all_to_all_op(
+    tokens: jax.Array,
+    splits: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "tp",
+    interpret: Any = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Host-level entry: `tokens` ``[n, n, max_m, hidden]`` (dim 0 = owning
+    PE, dim 1 = destination slab) and `splits` ``[n, n]``, both sharded on
+    dim 0. Returns the exchanged slabs/splits in the same layout."""
+    fn = functools.partial(fast_all_to_all, axis=axis, interpret=interpret)
+
+    def wrapped(t, s):
+        r, rs = fn(t[0], s[0])
+        return r[None], rs[None]
+
+    return jax.jit(
+        jax.shard_map(
+            wrapped,
+            mesh=mesh,
+            in_specs=(P(axis, None, None, None), P(axis, None)),
+            out_specs=(P(axis, None, None, None), P(axis, None)),
+            check_vma=False,
+        )
+    )(tokens, splits.astype(jnp.int32))

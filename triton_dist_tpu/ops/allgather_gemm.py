@@ -33,7 +33,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
-from triton_dist_tpu.ops.common import dist_pallas_call
+from triton_dist_tpu.ops.common import dist_pallas_call, gemm_add_pipeline
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block as _pick_block
 
@@ -59,7 +59,6 @@ def _ag_gemm_kernel(
     bm = _pick_block(m_loc, cfg.block_m)
     bn = _pick_block(n_loc, cfg.block_n)
     bk = _pick_block(k_dim, cfg.block_k)
-    n_k = k_dim // bk
 
     local = pltpu.make_async_copy(a_ref, ag_ref.at[pl.ds(me * m_loc, m_loc)], copy_sem)
     local.start()
@@ -67,29 +66,7 @@ def _ag_gemm_kernel(
     shmem.barrier_all(axis)
 
     right = jax.lax.rem(me + 1, n)
-
-    def mm_body(a_blk, b_blk, o_blk):
-        kk = pl.program_id(2)
-
-        @pl.when(kk == 0)
-        def _():
-            acc_ref[:] = jnp.zeros_like(acc_ref)
-
-        acc_ref[:] += jnp.dot(a_blk[:], b_blk[:], preferred_element_type=jnp.float32)
-
-        @pl.when(kk == n_k - 1)
-        def _():
-            o_blk[:] = acc_ref[:].astype(out_dtype)
-
-    pipeline = pltpu.emit_pipeline(
-        mm_body,
-        grid=(m_loc // bm, n_loc // bn, n_k),
-        in_specs=[
-            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
-            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
-        ],
-        out_specs=[pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j))],
-    )
+    pipeline = gemm_add_pipeline(bm, bn, bk, m_loc, n_loc, k_dim, acc_ref, out_dtype)
 
     descs = []
     for s in range(n):
