@@ -1,0 +1,129 @@
+"""MoE ops vs goldens (≙ reference test_ag_group_gemm.py /
+test_moe_reduce_rs.py: golden = torch grouped matmul + NCCL collectives;
+here per-expert einsum + lax collectives)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.ops.allgather_group_gemm import ag_group_gemm_op
+from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
+from triton_dist_tpu.ops.moe_reduce_rs import moe_reduce_rs_op
+from triton_dist_tpu.ops.moe_utils import (
+    gather_sorted_rows,
+    moe_align_block_size,
+    scatter_add_unsorted,
+    select_experts,
+)
+
+
+def test_select_experts():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (16, 8))
+    w, ids = select_experts(logits, 2)
+    assert w.shape == (16, 2) and ids.shape == (16, 2)
+    np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, rtol=1e-6)
+    # ids are the argmax-2 experts
+    want_ids = np.argsort(-np.asarray(logits), axis=-1)[:, :2]
+    np.testing.assert_array_equal(np.sort(ids, -1), np.sort(want_ids, -1))
+
+
+def test_moe_align_block_size():
+    bm, n_exp = 4, 3
+    topk_ids = jnp.array([2, 0, 0, 1, 2, 2, 0, 0, 0], jnp.int32)
+    al = jax.jit(lambda i: moe_align_block_size(i, n_exp, bm))(topk_ids)
+    t = topk_ids.shape[0]
+    counts = np.bincount(np.asarray(topk_ids), minlength=n_exp)
+    padded = ((counts + bm - 1) // bm) * bm
+    assert int(al.num_tokens_post_pad) == padded.sum()
+    sti = np.asarray(al.sorted_token_ids)
+    eids = np.asarray(al.expert_ids)
+    # every valid row's assignment belongs to its block's expert; blocks
+    # are single-expert by construction
+    seg_starts = np.concatenate([[0], np.cumsum(padded)[:-1]])
+    for e in range(n_exp):
+        seg = sti[seg_starts[e] : seg_starts[e] + padded[e]]
+        valid = seg[seg < t]
+        assert len(valid) == counts[e]
+        np.testing.assert_array_equal(np.asarray(topk_ids)[valid], e)
+    for blk, e in enumerate(eids):
+        if blk * bm < padded.sum():
+            assert seg_starts[e] <= blk * bm < seg_starts[e] + padded[e]
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32])
+def test_group_gemm_vs_ragged_dot(dtype):
+    n_exp, bm, k_dim, n_dim = 3, 8, 64, 256
+    sizes = jnp.array([16, 8, 24], jnp.int32)  # already block-multiples
+    t_pad = int(sizes.sum())
+    a = jax.random.normal(jax.random.PRNGKey(1), (t_pad, k_dim)).astype(dtype)
+    b = jax.random.normal(jax.random.PRNGKey(2), (n_exp, k_dim, n_dim)).astype(dtype)
+    expert_ids = jnp.repeat(jnp.arange(n_exp, dtype=jnp.int32), sizes // bm)
+    got = jax.jit(
+        lambda a, b, e: group_gemm(a, b, e, config=GroupGemmConfig(bm, 128, 32))
+    )(a, b, expert_ids)
+    want = jax.lax.ragged_dot(a, b, group_sizes=sizes)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
+
+
+def test_gather_scatter_roundtrip():
+    bm, n_exp, topk, n_tokens, h = 4, 3, 2, 10, 16
+    key = jax.random.PRNGKey(3)
+    ids = jax.random.randint(key, (n_tokens, topk), 0, n_exp, jnp.int32)
+    x = jax.random.normal(jax.random.PRNGKey(4), (n_tokens, h), jnp.float32)
+    al = moe_align_block_size(ids.reshape(-1), n_exp, bm)
+    rows = gather_sorted_rows(x, al, topk)
+    w = jnp.full((n_tokens, topk), 0.5, jnp.float32)
+    back = scatter_add_unsorted(rows, al, w, n_tokens)
+    # each token appears topk times with weight 0.5 → back == x * topk * 0.5
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x), rtol=1e-5, atol=1e-5)
+
+
+def _moe_golden(a, b, topk_ids):
+    """Dense per-assignment golden: out[t*topk+k] = a[t] @ b[ids[t,k]]."""
+    m, topk = topk_ids.shape
+    flat = np.asarray(topk_ids).reshape(-1)
+    a_np = np.asarray(a, np.float32)
+    b_np = np.asarray(b, np.float32)
+    return np.stack([a_np[i // topk] @ b_np[flat[i]] for i in range(m * topk)])
+
+
+def test_ag_group_gemm(mesh4):
+    m_tot, k_dim, n_dim, n_exp, topk = 16, 64, 256, 4, 2
+    a = jax.random.normal(jax.random.PRNGKey(5), (m_tot, k_dim), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(6), (n_exp, k_dim, n_dim), jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(7), (m_tot, topk), 0, n_exp, jnp.int32)
+    got = ag_group_gemm_op(a, b, ids, mesh4, config=GroupGemmConfig(8, 64, 32))
+    want = _moe_golden(a, b, ids)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
+def test_moe_reduce_rs(mesh4):
+    n_tokens, f_dim, h_dim, n_exp, topk, bm = 16, 128, 64, 4, 2, 8
+    key = jax.random.PRNGKey(8)
+    ids = jax.random.randint(key, (n_tokens, topk), 0, n_exp, jnp.int32)
+    al = moe_align_block_size(ids.reshape(-1), n_exp, bm)
+    t_pad = al.sorted_token_ids.shape[0]
+    h_sorted = jax.random.normal(jax.random.PRNGKey(9), (t_pad, f_dim), jnp.float32)
+    w_down = jax.random.normal(jax.random.PRNGKey(10), (n_exp, f_dim, h_dim), jnp.float32)
+    tw = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(11), (n_tokens, topk)))
+    got = moe_reduce_rs_op(
+        h_sorted, w_down, al.sorted_token_ids, al.expert_ids, tw, mesh4,
+        config=GroupGemmConfig(bm, 64, 32),
+    )
+    # golden: full grouped GEMM + weighted unsort, no sharding
+    y = np.stack(
+        [
+            np.asarray(h_sorted, np.float32)[r]
+            @ np.asarray(w_down, np.float32)[int(al.expert_ids[r // bm])]
+            for r in range(t_pad)
+        ]
+    )
+    want = np.zeros((n_tokens, h_dim), np.float32)
+    sti = np.asarray(al.sorted_token_ids)
+    tw_np = np.asarray(tw, np.float32).reshape(-1)
+    for r in range(t_pad):
+        if sti[r] < n_tokens * topk:
+            want[sti[r] // topk] += tw_np[sti[r]] * y[r]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
