@@ -1,0 +1,166 @@
+"""Layer-level (L7) tests: TP MLP, MoE MLP, EP dispatch/combine, SP decode
+(≙ the reference's layer tests, e.g. test_sp_decode_attn.py / test_ep_a2a.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.layers import (
+    AllGatherLayer,
+    EPAll2AllLayer,
+    SpGQAFlashDecodeAttention,
+    TPMLP,
+    TPMoEMLP,
+)
+from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig
+from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig
+from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+from triton_dist_tpu.ops.moe_utils import select_experts
+
+
+def test_tp_mlp(mesh4):
+    m_tot, h_dim, f_dim = 32, 64, 256
+    x = jax.random.normal(jax.random.PRNGKey(0), (m_tot, h_dim), jnp.float32)
+    w_up = jax.random.normal(jax.random.PRNGKey(1), (h_dim, f_dim), jnp.float32) / 8
+    w_down = jax.random.normal(jax.random.PRNGKey(2), (f_dim, h_dim), jnp.float32) / 8
+    layer = TPMLP(
+        ag_config=AGGemmConfig(8, 64, 32), rs_config=GemmRSConfig(8, 64, 32)
+    )
+    got = jax.jit(
+        jax.shard_map(
+            layer, mesh=mesh4,
+            in_specs=(P("tp", None), P(None, "tp"), P("tp", None)),
+            out_specs=P("tp", None), check_vma=False,
+        )
+    )(x, w_up, w_down)
+    want = jnp.dot(jax.nn.gelu(jnp.dot(x, w_up)), w_down)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=5e-3, atol=5e-3)
+
+
+def test_tp_moe_mlp(mesh4):
+    m_tot, h_dim, f_dim, n_exp, topk = 16, 64, 128, 4, 2
+    key = jax.random.PRNGKey(3)
+    x = jax.random.normal(key, (m_tot, h_dim), jnp.float32)
+    w_up = jax.random.normal(jax.random.PRNGKey(4), (n_exp, h_dim, f_dim)) / 8
+    w_down = jax.random.normal(jax.random.PRNGKey(5), (n_exp, f_dim, h_dim)) / 8
+    logits = jax.random.normal(jax.random.PRNGKey(6), (m_tot, n_exp))
+    tw, ids = select_experts(logits, topk)
+    layer = TPMoEMLP(gg_config=GroupGemmConfig(8, 64, 32))
+    got = jax.jit(
+        jax.shard_map(
+            layer, mesh=mesh4,
+            in_specs=(
+                P("tp", None), P(None, None, "tp"), P(None, "tp", None),
+                P("tp", None), P("tp", None),
+            ),
+            out_specs=P("tp", None), check_vma=False,
+        )
+    )(x, w_up, w_down, ids, tw)
+    # golden: dense MoE forward
+    want = np.zeros((m_tot, h_dim), np.float32)
+    for t in range(m_tot):
+        for k in range(topk):
+            e = int(ids[t, k])
+            h = jax.nn.gelu(np.asarray(x)[t] @ np.asarray(w_up)[e])
+            want[t] += float(tw[t, k]) * (np.asarray(h) @ np.asarray(w_down)[e])
+    np.testing.assert_allclose(np.asarray(got), want, rtol=5e-3, atol=5e-3)
+
+
+def test_ep_a2a_layer_roundtrip(mesh4):
+    """Dispatch + identity expert + combine == topk-weighted identity."""
+    world, m_loc, hidden, n_exp, topk = 4, 8, 128, 8, 2
+    layer = EPAll2AllLayer(
+        n_experts=n_exp, topk=topk, max_m=m_loc * topk, axis="tp"
+    )
+    m_tot = world * m_loc
+    x = jax.random.normal(jax.random.PRNGKey(7), (m_tot, hidden), jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(8), (m_tot, topk), 0, n_exp, jnp.int32)
+    tw = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(9), (m_tot, topk)))
+
+    def fn(x, ids, tw):
+        recv, info = layer.dispatch(x, ids)
+        out = layer.combine(recv, info, tw, m_loc)  # identity "experts"
+        return out
+
+    got = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh4,
+            in_specs=(P("tp", None), P("tp", None), P("tp", None)),
+            out_specs=P("tp", None), check_vma=False,
+        )
+    )(x, ids, tw)
+    want = np.asarray(x) * np.asarray(tw.sum(-1))[:, None]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_ep_receiver_alignment(mesh4):
+    world, m_loc, hidden, n_exp, topk = 4, 8, 32, 8, 2
+    layer = EPAll2AllLayer(n_experts=n_exp, topk=topk, max_m=m_loc * topk, axis="tp")
+    m_tot = world * m_loc
+    x = jax.random.normal(jax.random.PRNGKey(10), (m_tot, hidden), jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(11), (m_tot, topk), 0, n_exp, jnp.int32)
+
+    def fn(x, ids):
+        recv, info = layer.dispatch(x, ids)
+        al = layer.receiver_alignment(info, block_m=4)
+        return al.sorted_token_ids, al.expert_ids, info.recv_expert, info.recv_splits
+
+    sti, eids, rexp, rsplits = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh4, in_specs=(P("tp", None), P("tp", None)),
+            out_specs=(P("tp"), P("tp"), P("tp", None), P("tp")), check_vma=False,
+        )
+    )(x, ids)
+    # per-PE: every valid sorted row's local expert matches its block expert
+    epr = n_exp // world
+    sti = np.asarray(sti).reshape(world, -1)
+    eids = np.asarray(eids).reshape(world, -1)
+    rexp = np.asarray(rexp).reshape(world, -1)
+    t = rexp.shape[1]
+    for pe in range(world):
+        for blk, e in enumerate(eids[pe]):
+            rows = sti[pe][blk * 4 : (blk + 1) * 4]
+            for r in rows:
+                if r < t and rexp[pe][r] >= 0:
+                    assert rexp[pe][r] == e or rexp[pe][r] == epr  # dummy
+
+
+def test_sp_layer_matches_op(mesh4):
+    from tests.test_flash_decode import _rand_case, _ref_decode
+
+    b, h_kv, g, s, d = 2, 1, 2, 128, 128
+    q, k, v, _ = _rand_case(jax.random.PRNGKey(12), b, h_kv * g, h_kv, s, d)
+    kv_lens = jnp.array([s, 57], jnp.int32)
+    s_loc = s // 4
+    layer = SpGQAFlashDecodeAttention(axis="tp")
+
+    def fn(q, k_s, v_s, lens):
+        local = layer.local_lens_from_global(lens, s_loc)
+        return layer(q, k_s, v_s, local)
+
+    got = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh4,
+            in_specs=(P(None, None, None), P(None, None, "tp", None),
+                      P(None, None, "tp", None), P(None)),
+            out_specs=P(None, None, None), check_vma=False,
+        )
+    )(q, k, v, kv_lens)
+    want = _ref_decode(q, k, v, kv_lens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_allgather_layer(mesh4):
+    x = jax.random.normal(jax.random.PRNGKey(13), (16, 128), jnp.float32)
+    for fwd in ["__call__", "forward_ring", "forward_push"]:
+        layer = AllGatherLayer(axis="tp")
+        fn = getattr(layer, fwd) if fwd != "__call__" else layer
+        got = jax.jit(
+            jax.shard_map(
+                fn, mesh=mesh4, in_specs=P("tp", None),
+                out_specs=P(None, None), check_vma=False,
+            )
+        )(x)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(x))

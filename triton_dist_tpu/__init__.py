@@ -34,6 +34,7 @@ from triton_dist_tpu.parallel.mesh import (
 from triton_dist_tpu import shmem as shmem
 from triton_dist_tpu import ops as ops
 from triton_dist_tpu import utils as utils
+from triton_dist_tpu import layers as layers
 from triton_dist_tpu import aot as aot
 from triton_dist_tpu import perf_model as perf_model
 from triton_dist_tpu.autotuner import contextual_autotune

@@ -86,8 +86,8 @@ def aot_compile_spaces(spaces: Mapping[str, Mapping[str, Any]]) -> Callable:
     of {name: {signature, grid, triton_algo_infos}} per kernel).
 
     Here a space is ``{name: {"example_args": tuple, "jit_kwargs": dict}}``.
-    The wrapped fn gains ``.aot`` — a dict lazily populated with compiled
-    executables per space — and ``.aot_compile_all()`` to force compilation.
+    The wrapped fn gains ``.aot(name)`` — returning the (lazily compiled,
+    cached) executable for that space — and ``.aot_compile_all()``.
     """
 
     def deco(fn: Callable) -> Callable:

@@ -111,8 +111,10 @@ def contextual_autotune(
                 return fn(*args, config=_memory_cache[mem_key], **kwargs)
             # disk entries store {"i": index, "cfg": repr} — the repr guards
             # against a reordered/edited candidate list silently applying
-            # the wrong config
-            entry = disk.get(key)
+            # the wrong config. Multi-host skips the disk fast path: an
+            # asymmetric cache hit would leave one host sweeping (and
+            # joining collectives) alone — all hosts sweep, rank 0 decides.
+            entry = disk.get(key) if jax.process_count() == 1 else None
             if (
                 isinstance(entry, dict)
                 and 0 <= entry.get("i", -1) < len(configs)

@@ -42,12 +42,16 @@ def _load() -> ctypes.CDLL | None:
                 not os.path.exists(_LIB_PATH)
                 or os.path.getmtime(_LIB_PATH) < src_mtime
             ):
+                # build to a per-process temp name + atomic rename so
+                # concurrent processes never dlopen a half-written .so
+                tmp = f"libtdt_native.so.tmp.{os.getpid()}"
                 subprocess.run(
-                    ["make", "-C", _CSRC_DIR, "-s", "-B"],
+                    ["make", "-C", _CSRC_DIR, "-s", "-B", f"LIB={tmp}"],
                     check=True,
                     capture_output=True,
                     timeout=120,
                 )
+                os.replace(os.path.join(_CSRC_DIR, tmp), _LIB_PATH)
             lib = ctypes.CDLL(_LIB_PATH)
             lib.tdt_abi_version.restype = ctypes.c_int
             if lib.tdt_abi_version() != 1:

@@ -1,0 +1,14 @@
+"""Module layer (L7) — reusable wrappers over the kernel zoo
+(≙ reference ``python/triton_dist/layers/nvidia/``: ``AllGatherLayer``,
+``EPAll2AllLayer``, ``SpGQAFlashDecodeAttention``).
+
+The reference layers are torch ``nn.Module``s that own symmetric-buffer
+contexts; under JAX the buffers are SPMD-symmetric by construction, so the
+layers here are light callable configs — everything stateful lives in the
+kernels' own workspaces. All ``__call__``s run inside ``jax.shard_map``.
+"""
+
+from triton_dist_tpu.layers.allgather_layer import AllGatherLayer
+from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer
+from triton_dist_tpu.layers.sp_flash_decode_layer import SpGQAFlashDecodeAttention
+from triton_dist_tpu.layers.tp_mlp import TPMLP, TPMoEMLP

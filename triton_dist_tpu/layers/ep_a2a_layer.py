@@ -1,0 +1,158 @@
+"""EP All-to-All layer — expert-parallel MoE dispatch/combine
+(≙ reference ``layers/nvidia/ep_a2a_layer.py:41`` ``EPAll2AllLayer`` over
+the DeepEP-style kernels of ``ep_a2a.py`` and
+``low_latency_all_to_all.py``).
+
+Reference flow: warp-granular put of contiguous token ranges to the
+same-local-rank peer, intra-node scatter by expert with atomic slot
+allocation, combine via remote ``symm_at`` loads (SURVEY.md §2.3). TPU has
+no remote loads, so combine is push-based (the dispatch in reverse) — the
+restructuring SURVEY.md §7 calls out. All data moves through the padded-slab
+``fast_all_to_all``; routing bookkeeping (sort by destination rank, slab
+packing, weighted un-permutation) is XLA gather/scatter.
+
+Expert placement: experts_per_rank = n_experts // world; expert ``e`` lives
+on rank ``e // experts_per_rank`` as local expert ``e % experts_per_rank``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.ops.all_to_all import fast_all_to_all
+from triton_dist_tpu.ops.moe_utils import MoEAlignment, moe_align_block_size
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class DispatchInfo:
+    """Bookkeeping to route combine results back to source tokens."""
+
+    order: jax.Array        # [m_loc*topk] assignment ids sorted by dest rank
+    send_splits: jax.Array  # [n] tokens sent per destination rank
+    recv_splits: jax.Array  # [n] tokens received per source rank
+    recv_expert: jax.Array  # [n, max_m] LOCAL expert id per received row
+
+
+@dataclasses.dataclass
+class EPAll2AllLayer:
+    """Dispatch tokens to expert-owning ranks and combine results back.
+
+    max_m is the per-(src,dst)-pair slab capacity; assignments beyond it are
+    dropped (≙ the reference's fixed ``max_m`` symmetric buffers,
+    low_latency_all_to_all.py:139-147 — size for the worst case).
+    """
+
+    n_experts: int
+    topk: int
+    max_m: int
+    axis: str = "ep"
+    interpret: Any = None
+
+    def _world(self) -> int:
+        return int(jax.lax.axis_size(self.axis))
+
+    def dispatch(
+        self, tokens: jax.Array, topk_ids: jax.Array
+    ) -> tuple[jax.Array, DispatchInfo]:
+        """Send each (token, k) assignment to the rank owning its expert
+        (call inside ``jax.shard_map``).
+
+        tokens: ``[m_loc, hidden]``; topk_ids: ``[m_loc, topk]`` global
+        expert ids. Returns ``(recv [n, max_m, hidden], info)`` — slab j
+        holds rank j's assignments for this rank (``info.recv_splits[j]``
+        valid, local expert per row in ``info.recv_expert``).
+        """
+        n = self._world()
+        epr = self.n_experts // n
+        m_loc, hidden = tokens.shape
+        t = m_loc * self.topk
+        flat_ids = topk_ids.reshape(-1)
+        dest = flat_ids // epr                                   # [t]
+        order = jnp.argsort(dest, stable=True).astype(jnp.int32)
+        dest_sorted = dest[order]
+        counts = jnp.bincount(dest, length=n).astype(jnp.int32)
+        offsets = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1]])
+        pos = (jnp.arange(t, dtype=jnp.int32) - offsets[dest_sorted])
+        # slab overflow drops the assignment (static max_m contract)
+        send = jnp.zeros((n, self.max_m, hidden), tokens.dtype)
+        send = send.at[dest_sorted, pos].set(
+            tokens[order // self.topk], mode="drop"
+        )
+        send_exp = jnp.full((n, self.max_m, 1), -1, jnp.int32)
+        send_exp = send_exp.at[dest_sorted, pos].set(
+            (flat_ids[order] % epr)[:, None], mode="drop"
+        )
+        recv, recv_splits = fast_all_to_all(
+            send, counts, axis=self.axis, interpret=self.interpret
+        )
+        recv_exp, _ = fast_all_to_all(
+            send_exp, counts, axis=self.axis, interpret=self.interpret
+        )
+        info = DispatchInfo(
+            order=order,
+            send_splits=counts,
+            recv_splits=recv_splits,
+            recv_expert=recv_exp[..., 0],
+        )
+        return recv, info
+
+    def receiver_alignment(
+        self, info: DispatchInfo, block_m: int
+    ) -> MoEAlignment:
+        """Block-align the received rows by LOCAL expert for group_gemm.
+        Invalid (padding) rows go to a virtual trailing expert whose blocks
+        compute garbage on clamped weights; combine drops them."""
+        n = self._world()
+        epr = self.n_experts // n
+        flat_exp = info.recv_expert.reshape(-1)
+        pos = jnp.arange(flat_exp.shape[0], dtype=jnp.int32) % self.max_m
+        slab = jnp.arange(flat_exp.shape[0], dtype=jnp.int32) // self.max_m
+        valid = pos < info.recv_splits[slab]
+        padded_exp = jnp.where(valid, flat_exp, epr)
+        al = moe_align_block_size(padded_exp, epr + 1, block_m)
+        return MoEAlignment(
+            sorted_token_ids=al.sorted_token_ids,
+            expert_ids=jnp.minimum(al.expert_ids, epr - 1),
+            num_tokens_post_pad=al.num_tokens_post_pad,
+        )
+
+    def combine(
+        self,
+        y: jax.Array,
+        info: DispatchInfo,
+        topk_weights: jax.Array,
+        m_loc: int,
+    ) -> jax.Array:
+        """Return expert outputs to their source ranks and reduce top-k
+        (push-based: the dispatch a2a in reverse — ≙ the remote-load
+        combine of ep_a2a.py:151-239 restructured as puts).
+
+        y: ``[n, max_m, h]`` expert outputs in the *received* slab layout.
+        topk_weights: ``[m_loc, topk]``. Returns ``[m_loc, h]``.
+        """
+        n = self._world()
+        back, _ = fast_all_to_all(
+            y, info.recv_splits, axis=self.axis, interpret=self.interpret
+        )
+        # slab p row i ↔ sorted assignment offsets[p]+i ↔ assignment order[...]
+        h = y.shape[-1]
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(info.send_splits)[:-1]]
+        )
+        flat = back.reshape(n * self.max_m, h)
+        pos = jnp.arange(n * self.max_m, dtype=jnp.int32) % self.max_m
+        slab = jnp.arange(n * self.max_m, dtype=jnp.int32) // self.max_m
+        valid = pos < info.send_splits[slab]
+        sorted_pos = jnp.clip(offsets[slab] + pos, 0, info.order.shape[0] - 1)
+        assignment = info.order[sorted_pos]
+        w = jnp.where(valid, topk_weights.reshape(-1)[assignment], 0.0)
+        token = assignment // self.topk
+        out = jnp.zeros((m_loc, h), jnp.float32)
+        return out.at[token].add(
+            jnp.where(valid[:, None], flat.astype(jnp.float32) * w[:, None], 0.0)
+        )

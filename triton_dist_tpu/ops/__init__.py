@@ -15,6 +15,7 @@ from triton_dist_tpu.ops.reduce_scatter import (
     reduce_scatter_op,
 )
 from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs, gemm_rs_op
+from triton_dist_tpu.ops.grads import ag_gemm_grad, gemm_rs_grad
 from triton_dist_tpu.ops.allgather_group_gemm import ag_group_gemm, ag_group_gemm_op
 from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
 from triton_dist_tpu.ops.moe_reduce_rs import moe_reduce_rs, moe_reduce_rs_op
