@@ -14,7 +14,7 @@ AG_CFG = AGGemmConfig(8, 64, 32)
 RS_CFG = GemmRSConfig(8, 64, 32)
 
 
-def _grads(fn, mesh, specs, out_spec, *args):
+def _grads(fn, mesh, specs, *args):
     def loss(*a):
         return jnp.sum(fn(*a) ** 2)
 
@@ -31,7 +31,7 @@ def test_ag_gemm_grad(mesh4):
     specs = (P("tp", None), P(None, "tp"))
     da, db = _grads(
         lambda a, b: ag_gemm_grad(a, b, "tp", AG_CFG, RS_CFG),
-        mesh4, specs, None, a, b,
+        mesh4, specs, a, b,
     )
 
     def golden(a, b):
@@ -54,7 +54,7 @@ def test_gemm_rs_grad(mesh4):
     specs = (P(None, "tp"), P("tp", None))
     da, db = _grads(
         lambda a, b: gemm_rs_grad(a, b, "tp", RS_CFG, AG_CFG),
-        mesh4, specs, None, a, b,
+        mesh4, specs, a, b,
     )
 
     def golden(a, b):

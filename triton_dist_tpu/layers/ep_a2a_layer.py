@@ -68,6 +68,11 @@ class EPAll2AllLayer:
         valid, local expert per row in ``info.recv_expert``).
         """
         n = self._world()
+        if self.n_experts % n != 0 or self.n_experts < n:
+            raise ValueError(
+                f"n_experts={self.n_experts} must be a positive multiple of "
+                f"the {self.axis!r} axis size {n}"
+            )
         epr = self.n_experts // n
         m_loc, hidden = tokens.shape
         t = m_loc * self.topk

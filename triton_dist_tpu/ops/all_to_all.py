@@ -40,7 +40,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
-from triton_dist_tpu.ops.common import dist_pallas_call
+from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
 from triton_dist_tpu.shmem import device as shmem
 
 
@@ -172,12 +172,9 @@ def fast_all_to_all_op(
         r, rs = fn(t[0], s[0])
         return r[None], rs[None]
 
-    return jax.jit(
-        jax.shard_map(
-            wrapped,
-            mesh=mesh,
-            in_specs=(P(axis, None, None, None), P(axis, None)),
-            out_specs=(P(axis, None, None, None), P(axis, None)),
-            check_vma=False,
-        )
+    return jit_shard_map(
+        wrapped, mesh,
+        (P(axis, None, None, None), P(axis, None)),
+        (P(axis, None, None, None), P(axis, None)),
+        key=("fast_all_to_all", axis, str(interpret)),
     )(tokens, splits.astype(jnp.int32))

@@ -30,7 +30,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
-from triton_dist_tpu.ops.common import dist_pallas_call
+from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
 from triton_dist_tpu.parallel import topology
 from triton_dist_tpu.shmem import device as shmem
 
@@ -192,6 +192,7 @@ def all_gather_op(
     fn = functools.partial(all_gather, axis=axis, method=method, interpret=interpret)
     in_spec = P(axis, *([None] * (x.ndim - 1)))
     out_spec = P(*([None] * x.ndim))
-    return jax.jit(
-        jax.shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec, check_vma=False)
+    return jit_shard_map(
+        fn, mesh, in_spec, out_spec,
+        key=("all_gather", axis, method, str(interpret)),
     )(x)

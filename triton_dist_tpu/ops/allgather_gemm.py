@@ -33,7 +33,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
-from triton_dist_tpu.ops.common import dist_pallas_call, gemm_add_pipeline
+from triton_dist_tpu.ops.common import dist_pallas_call, gemm_add_pipeline, jit_shard_map
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block as _pick_block
 
@@ -159,12 +159,7 @@ def ag_gemm_op(
     `a` sharded on dim 0, `b` sharded on dim 1, result replicated on M and
     sharded on N."""
     fn = functools.partial(ag_gemm, axis=axis, config=config, interpret=interpret)
-    return jax.jit(
-        jax.shard_map(
-            fn,
-            mesh=mesh,
-            in_specs=(P(axis, None), P(None, axis)),
-            out_specs=P(None, axis),
-            check_vma=False,
-        )
+    return jit_shard_map(
+        fn, mesh, (P(axis, None), P(None, axis)), P(None, axis),
+        key=("ag_gemm", axis, config, str(interpret)),
     )(a, b)

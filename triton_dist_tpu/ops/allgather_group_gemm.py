@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_dist_tpu.ops.allgather import all_gather
+from triton_dist_tpu.ops.common import jit_shard_map
 from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
 from triton_dist_tpu.ops.moe_utils import (
     MoEAlignment,
@@ -96,12 +97,9 @@ def ag_group_gemm_op(
         )
         return h_sorted[inv]
 
-    return jax.jit(
-        jax.shard_map(
-            fn,
-            mesh=mesh,
-            in_specs=(P(axis, None), P(None, None, axis), P(axis, None)),
-            out_specs=P(None, axis),
-            check_vma=False,
-        )
+    return jit_shard_map(
+        fn, mesh,
+        (P(axis, None), P(None, None, axis), P(axis, None)),
+        P(None, axis),
+        key=("ag_group_gemm", axis, cfg, m_tot, topk, str(interpret)),
     )(a, b, topk_ids.astype(jnp.int32))

@@ -140,6 +140,39 @@ def gemm_add_pipeline(
     )
 
 
+_jit_cache: dict[Any, Any] = {}
+
+
+def jit_shard_map(
+    fn,
+    mesh,
+    in_specs,
+    out_specs,
+    *,
+    key: Any,
+):
+    """``jax.jit(jax.shard_map(fn, ...))`` cached across calls.
+
+    ``jax.jit`` keys its cache on the callable's identity; building a fresh
+    ``shard_map`` wrapper per invocation (what every ``*_op`` convenience
+    entry naturally does) therefore retraces AND recompiles every call —
+    measured ~2 s per call on a tunneled TPU. `key` must capture everything
+    that changes the traced program besides the mesh/specs (op name, config,
+    method, static dims); argument shapes/dtypes are handled by jit itself.
+    """
+    cache_key = (mesh, str(in_specs), str(out_specs), key)
+    hit = _jit_cache.get(cache_key)
+    if hit is None:
+        hit = jax.jit(
+            jax.shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+        _jit_cache[cache_key] = hit
+    return hit
+
+
 def barrier_all_op(axis: str = "tp", interpret: Any = None) -> None:
     """Standalone device barrier over a mesh axis — call inside shard_map
     (≙ ``barrier_all_on_stream`` / ``barrier_all_intra_node_atomic_cas_block``,

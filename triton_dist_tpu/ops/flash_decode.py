@@ -42,7 +42,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_dist_tpu.ops.allgather import all_gather
-from triton_dist_tpu.ops.common import dist_pallas_call
+from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
 from triton_dist_tpu.utils import pick_block
 
 NEG_INF = float("-inf")
@@ -249,17 +249,14 @@ def flash_decode_op(
             q, k_s, v_s, local_lens, axis=axis, config=config, interpret=interpret
         )
 
-    return jax.jit(
-        jax.shard_map(
-            fn,
-            mesh=mesh,
-            in_specs=(
-                P(None, None, None),
-                P(None, None, axis, None),
-                P(None, None, axis, None),
-                P(None),
-            ),
-            out_specs=P(None, None, None),
-            check_vma=False,
-        )
+    return jit_shard_map(
+        fn, mesh,
+        (
+            P(None, None, None),
+            P(None, None, axis, None),
+            P(None, None, axis, None),
+            P(None),
+        ),
+        P(None, None, None),
+        key=("flash_decode", axis, config, s_shard, str(interpret)),
     )(q, k, v, kv_lens.astype(jnp.int32))

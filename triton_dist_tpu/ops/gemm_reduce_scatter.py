@@ -43,7 +43,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
-from triton_dist_tpu.ops.common import dist_pallas_call, gemm_add_pipeline
+from triton_dist_tpu.ops.common import dist_pallas_call, gemm_add_pipeline, jit_shard_map
 from triton_dist_tpu.ops.reduce_scatter import get_auto_reduce_scatter_method
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block
@@ -239,12 +239,7 @@ def gemm_rs_op(
     fn = functools.partial(
         gemm_rs, axis=axis, method=method, config=config, interpret=interpret
     )
-    return jax.jit(
-        jax.shard_map(
-            fn,
-            mesh=mesh,
-            in_specs=(P(None, axis), P(axis, None)),
-            out_specs=P(axis, None),
-            check_vma=False,
-        )
+    return jit_shard_map(
+        fn, mesh, (P(None, axis), P(axis, None)), P(axis, None),
+        key=("gemm_rs", axis, method, config, str(interpret)),
     )(a, b)

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from triton_dist_tpu.ops.common import jit_shard_map
 from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
 from triton_dist_tpu.ops.moe_utils import MoEAlignment, scatter_add_unsorted
 from triton_dist_tpu.ops.reduce_scatter import ReduceScatterConfig, reduce_scatter
@@ -98,18 +99,15 @@ def moe_reduce_rs_op(
             config=config, interpret=interpret,
         )
 
-    return jax.jit(
-        jax.shard_map(
-            fn,
-            mesh=mesh,
-            in_specs=(
-                P(None, axis),
-                P(None, axis, None),
-                P(None),
-                P(None),
-                P(None, None),
-            ),
-            out_specs=P(axis, None),
-            check_vma=False,
-        )
+    return jit_shard_map(
+        fn, mesh,
+        (
+            P(None, axis),
+            P(None, axis, None),
+            P(None),
+            P(None),
+            P(None, None),
+        ),
+        P(axis, None),
+        key=("moe_reduce_rs", axis, config, n_tokens, topk, str(interpret)),
     )(h_sorted, w_down, sorted_token_ids, expert_ids, topk_weights)

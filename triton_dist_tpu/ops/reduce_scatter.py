@@ -32,7 +32,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
-from triton_dist_tpu.ops.common import dist_pallas_call
+from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
 from triton_dist_tpu.parallel import topology
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block
@@ -261,6 +261,7 @@ def reduce_scatter_op(
 
     in_spec = P(axis, *([None] * (x.ndim - 1)))
     out_spec = P(axis, *([None] * (x.ndim - 2)))
-    return jax.jit(
-        jax.shard_map(wrapped, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec, check_vma=False)
+    return jit_shard_map(
+        wrapped, mesh, (in_spec,), out_spec,
+        key=("reduce_scatter", axis, method, config, str(interpret)),
     )(x)
