@@ -45,6 +45,11 @@ def _sig_key(args: Sequence[Any], kwargs: dict[str, Any]) -> str:
             parts.append(f"{a.dtype}{list(a.shape)}")
         elif isinstance(a, (int, float, str, bool)) or a is None:
             parts.append(repr(a))
+        else:
+            # non-array context (Mesh, method enums, …) must key the cache
+            # too: distinct contexts with identical array shapes are
+            # different tuning problems
+            parts.append(str(a)[:160])
     try:
         parts.append(f"dev={jax.devices()[0].device_kind}x{len(jax.devices())}")
     except Exception:
@@ -65,10 +70,18 @@ def _load_disk_cache(name: str) -> dict[str, Any]:
 
 
 def _store_disk_cache(name: str, table: dict[str, Any]) -> None:
+    """Atomic merge-write: re-read the table first (another process may have
+    tuned other signatures meanwhile), then temp-file + os.replace so a crash
+    mid-write can never leave a truncated/corrupt cache."""
     try:
         os.makedirs(_CACHE_DIR, exist_ok=True)
-        with open(_cache_path(name), "w") as f:
-            json.dump(table, f, indent=1, sort_keys=True)
+        merged = _load_disk_cache(name)
+        merged.update(table)
+        table.update(merged)
+        tmp = _cache_path(name) + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(merged, f, indent=1, sort_keys=True)
+        os.replace(tmp, _cache_path(name))
     except Exception:
         pass
 

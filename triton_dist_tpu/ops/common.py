@@ -140,6 +140,48 @@ def gemm_add_pipeline(
     )
 
 
+def gemm_only(a, b, *, cfg, out_dtype, name: str, interpret=None):
+    """Pure-MXU pipelined matmul — the world-1 degenerate path shared by the
+    fused ops (same inner ``gemm_add_pipeline``, minus workspace and ring).
+    `cfg` is any config with block_m/block_n/block_k (AGGemmConfig,
+    GemmRSConfig, …); `name` keeps traces/profiles attributed to the real op."""
+    from triton_dist_tpu.utils import pick_block
+
+    m_loc, k_dim = a.shape
+    n_loc = b.shape[1]
+    bm = pick_block(m_loc, cfg.block_m)
+    bn = pick_block(n_loc, cfg.block_n)
+    bk = pick_block(k_dim, cfg.block_k)
+
+    def _kernel(a_ref, b_ref, out_ref, acc_ref):
+        pipeline = gemm_add_pipeline(bm, bn, bk, m_loc, n_loc, k_dim, acc_ref, out_dtype)
+        pipeline(a_ref, b_ref, out_ref)
+
+    return dist_pallas_call(
+        _kernel,
+        name=name,
+        out_shape=jax.ShapeDtypeStruct((m_loc, n_loc), out_dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m_loc * n_loc * k_dim,
+            bytes_accessed=(m_loc * k_dim + k_dim * n_loc + m_loc * n_loc) * a.dtype.itemsize,
+            transcendentals=0,
+        ),
+        # the emit_pipeline double-buffers a/b/out tiles; the default 16 MiB
+        # budget rejects the large-tile configs the autotuner wants to try
+        vmem_limit_bytes=2 * 2 * (bm * bk + bk * bn + bm * bn) * a.dtype.itemsize
+        + 4 * bm * bn
+        + 2 * 2**20,
+        uses_barrier=False,
+        interpret=interpret,
+    )(a, b)
+
+
 _jit_cache: dict[Any, Any] = {}
 
 

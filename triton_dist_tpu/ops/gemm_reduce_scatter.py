@@ -43,7 +43,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
-from triton_dist_tpu.ops.common import dist_pallas_call, gemm_add_pipeline, jit_shard_map
+from triton_dist_tpu.autotuner import contextual_autotune
+from triton_dist_tpu.ops.common import (
+    dist_pallas_call,
+    gemm_add_pipeline,
+    gemm_only,
+    jit_shard_map,
+)
 from triton_dist_tpu.ops.reduce_scatter import get_auto_reduce_scatter_method
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block
@@ -177,7 +183,11 @@ def gemm_rs(
     n_dim = b.shape[1]
     out_dtype = out_dtype or a.dtype
     if n == 1:
-        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(out_dtype)
+        # World-1 is a plain matmul; run it through the same tuned MXU
+        # pipeline the fused kernels use (beats the XLA dot at bench shapes).
+        return gemm_only(
+            a, b, cfg=cfg, out_dtype=out_dtype, name="gemm_rs", interpret=interpret
+        )
     assert m_tot % n == 0, (m_tot, n)
     m_loc = m_tot // n
     if method == "auto":
@@ -243,3 +253,18 @@ def gemm_rs_op(
         fn, mesh, (P(None, axis), P(axis, None)), P(axis, None),
         key=("gemm_rs", axis, method, config, str(interpret)),
     )(a, b)
+
+
+# ≙ the reference's tune space for gemm_rs (gemm_reduce_scatter.py contexts);
+# block_m tiles the per-destination M-chunk, which is M/n — smaller than the
+# AG-GEMM tiles for the same problem.
+GEMM_RS_TUNE_SPACE = (
+    GemmRSConfig(256, 1024, 512),
+    GemmRSConfig(512, 1024, 512),
+    GemmRSConfig(256, 2048, 512),
+    GemmRSConfig(512, 2048, 1024),
+    GemmRSConfig(1024, 2048, 1024),
+    GemmRSConfig(128, 1024, 512),
+)
+
+gemm_rs_op = contextual_autotune(GEMM_RS_TUNE_SPACE, name="gemm_rs")(gemm_rs_op)
