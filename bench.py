@@ -29,7 +29,6 @@ run also populates .autotune_cache/ (the sweep the judge can inspect).
 
 from __future__ import annotations
 
-import functools
 import json
 import statistics
 

@@ -67,3 +67,34 @@ def test_ag_gemm_world1():
     got = ag_gemm_op(a, b, mesh, config=AGGemmConfig(16, 128, 128))
     want = jnp.dot(a, b)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2)
+
+
+def test_ag_gemm_2d(mesh2x4):
+    """Fused 2-D AG-GEMM over (dp, tp) vs all_gather+dot golden
+    (VERDICT r1 item 4: plumb multi-axis through ag_gemm)."""
+
+    from triton_dist_tpu.ops.allgather_gemm import ag_gemm, AGGemmConfig
+
+    m_loc, k, n_loc = 8, 128, 128
+    cfg = AGGemmConfig(8, 128, 64)
+
+    def fn(a, b):
+        return ag_gemm(a, b, axis=("dp", "tp"), config=cfg)
+
+    def golden(a, b):
+        ag = jax.lax.all_gather(a, ("dp", "tp"), tiled=True)
+        return jnp.dot(ag, b, preferred_element_type=jnp.float32).astype(a.dtype)
+
+    specs = dict(
+        mesh=mesh2x4,
+        in_specs=(P(("dp", "tp"), None), P(None, None)),
+        out_specs=P(None, None),
+        check_vma=False,
+    )
+    for it in range(2):
+        ka, kb = jax.random.split(jax.random.PRNGKey(40 + it))
+        a = jax.random.normal(ka, (8 * m_loc, k), jnp.float32)
+        b = jax.random.normal(kb, (k, n_loc), jnp.float32)
+        out = jax.jit(jax.shard_map(fn, **specs))(a, b)
+        ref = jax.jit(jax.shard_map(golden, **specs))(a, b)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-3, rtol=1e-3)

@@ -74,3 +74,49 @@ def test_all_gather_on_subaxis(mesh2x4):
     got = np.asarray(out).reshape(2, 4 * m, d)
     want = np.asarray(x).reshape(2, 4 * m, d)
     np.testing.assert_array_equal(got, want)
+
+
+def test_all_gather_2d(mesh2x4):
+    """Fused hierarchical 2-D ring over (dp, tp) vs the composite-axis XLA
+    golden (VERDICT r1 item 4: multi-axis collectives on mesh2x4)."""
+    from triton_dist_tpu.ops.allgather import all_gather_2d
+
+    m, d = 8, 128
+
+    def fn(x):
+        return all_gather_2d(x, axes=("dp", "tp"))
+
+    def golden(x):
+        return jax.lax.all_gather(x, ("dp", "tp"), tiled=True)
+
+    for it in range(3):
+        x = jax.random.normal(jax.random.PRNGKey(10 + it), (8 * m, d), jnp.float32)
+        out = jax.jit(
+            jax.shard_map(fn, mesh=mesh2x4, in_specs=P(("dp", "tp")), out_specs=P(None), check_vma=False)
+        )(x)
+        ref = jax.jit(
+            jax.shard_map(golden, mesh=mesh2x4, in_specs=P(("dp", "tp")), out_specs=P(None), check_vma=False)
+        )(x)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_all_gather_2d_outer_inner_swapped(mesh2x4):
+    """(tp, dp) ordering: outer=tp (4), inner=dp (2) — exercises n_i < n_o."""
+    from triton_dist_tpu.ops.allgather import all_gather_2d
+
+    m, d = 8, 128
+
+    def fn(x):
+        return all_gather_2d(x, axes=("tp", "dp"))
+
+    def golden(x):
+        return jax.lax.all_gather(x, ("tp", "dp"), tiled=True)
+
+    x = jax.random.normal(jax.random.PRNGKey(20), (8 * m, d), jnp.float32)
+    out = jax.jit(
+        jax.shard_map(fn, mesh=mesh2x4, in_specs=P(("tp", "dp")), out_specs=P(None), check_vma=False)
+    )(x)
+    ref = jax.jit(
+        jax.shard_map(golden, mesh=mesh2x4, in_specs=P(("tp", "dp")), out_specs=P(None), check_vma=False)
+    )(x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

@@ -62,3 +62,33 @@ def test_gemm_rs_world1():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(jnp.dot(a, b)), rtol=2e-2, atol=2e-2
     )
+
+
+def test_gemm_rs_2d(mesh2x4):
+    """Hierarchical 2-D GEMM-RS over (dp, tp) vs psum_scatter golden
+    (VERDICT r1 item 4: plumb multi-axis through gemm_rs)."""
+    from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs, GemmRSConfig
+
+    n, m_loc, k_loc, n_dim = 8, 8, 64, 128
+    cfg = GemmRSConfig(8, 128, 64)
+
+    def fn(a, b):
+        return gemm_rs(a, b, axis=("dp", "tp"), config=cfg)
+
+    def golden(a, b):
+        prod = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+        return jax.lax.psum_scatter(prod, ("dp", "tp"), tiled=True)
+
+    specs = dict(
+        mesh=mesh2x4,
+        in_specs=(P(None, ("dp", "tp")), P(("dp", "tp"), None)),
+        out_specs=P(("dp", "tp"), None),
+        check_vma=False,
+    )
+    for it in range(2):
+        ka, kb = jax.random.split(jax.random.PRNGKey(50 + it))
+        a = jax.random.normal(ka, (n * m_loc, 8 * k_loc), jnp.float32) / 8
+        b = jax.random.normal(kb, (8 * k_loc, n_dim), jnp.float32) / 8
+        out = jax.jit(jax.shard_map(fn, **specs))(a, b)
+        ref = jax.jit(jax.shard_map(golden, **specs))(a, b)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3)

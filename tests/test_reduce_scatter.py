@@ -74,3 +74,41 @@ def test_reduce_scatter_world1():
     x = jax.random.normal(jax.random.PRNGKey(3), (1, 16, 128), jnp.float32)
     got = reduce_scatter_op(x, mesh)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(x[0]))
+
+
+def test_reduce_scatter_2d(mesh2x4):
+    """Hierarchical 2-D reduce-scatter over (dp, tp) vs psum_scatter golden
+    (VERDICT r1 item 4)."""
+    from triton_dist_tpu.ops.reduce_scatter import reduce_scatter_2d
+
+
+    m, d = 8, 128
+    n = 8
+
+    def fn(x):
+        return reduce_scatter_2d(x, axes=("dp", "tp"))
+
+    def golden(x):
+        return jax.lax.psum_scatter(x, ("dp", "tp"), tiled=True)
+
+    for it in range(2):
+        x = jax.random.normal(jax.random.PRNGKey(30 + it), (n, n * m, d), jnp.float32)
+        out = jax.jit(
+            jax.shard_map(
+                lambda xs: fn(xs[0])[None],
+                mesh=mesh2x4,
+                in_specs=P(("dp", "tp"), None, None),
+                out_specs=P(("dp", "tp"), None, None),
+                check_vma=False,
+            )
+        )(x)
+        ref = jax.jit(
+            jax.shard_map(
+                lambda xs: golden(xs[0])[None],
+                mesh=mesh2x4,
+                in_specs=P(("dp", "tp"), None, None),
+                out_specs=P(("dp", "tp"), None, None),
+                check_vma=False,
+            )
+        )(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4)

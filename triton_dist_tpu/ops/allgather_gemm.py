@@ -93,6 +93,119 @@ def _ag_gemm_kernel(
     shmem.quiet(*descs)
 
 
+def _ag_gemm_2d_kernel(
+    a_ref, b_ref, out_ref, ag_ref, acc_ref, copy_sem, in_send, in_recv,
+    out_send, out_recv, *, outer: str, inner: str, n_o: int, n_i: int,
+    cfg: AGGemmConfig, out_dtype,
+):
+    """Fused hierarchical AG-GEMM over two mesh axes: the 2-D ring allgather
+    (see ops/allgather._ring_2d_kernel) with an MXU pipeline consuming every
+    chunk the moment it is locally available — compute order = 2-D arrival
+    order, the multi-axis generalization of the 1-D swizzle (≙ the
+    reference's node-shifted tile swizzle, allgather_gemm.py:206-219)."""
+    me_i = shmem.my_pe(inner)
+    me_o = shmem.my_pe(outer)
+    m_loc, k_dim = a_ref.shape
+    n_loc = b_ref.shape[1]
+    bm = _pick_block(m_loc, cfg.block_m)
+    bn = _pick_block(n_loc, cfg.block_n)
+    bk = _pick_block(k_dim, cfg.block_k)
+    pipeline = gemm_add_pipeline(bm, bn, bk, m_loc, n_loc, k_dim, acc_ref, out_dtype)
+
+    def slot(o, i):
+        return pl.ds((o * n_i + i) * m_loc, m_loc)
+
+    local = pltpu.make_async_copy(a_ref, ag_ref.at[slot(me_o, me_i)], copy_sem)
+    local.start()
+    local.wait()
+    shmem.barrier_all((outer, inner))
+
+    right_i = jax.lax.rem(me_i + 1, n_i)
+    down_o = jax.lax.rem(me_o + 1, n_o)
+    descs_i = []
+    descs_o = [[None] * n_i for _ in range(n_o - 1)]
+
+    for s in range(n_i):
+        c = jax.lax.rem(me_i - s + n_i, n_i)
+        if s > 0:
+            descs_i[s - 1].wait_recv()
+        sl = slot(me_o, c)
+        if s < n_i - 1:
+            descs_i.append(
+                shmem.putmem_nbi_block(
+                    ag_ref.at[sl], ag_ref.at[sl], right_i, inner,
+                    in_send.at[s], in_recv.at[s],
+                )
+            )
+        if n_o > 1:
+            descs_o[0][s] = shmem.putmem_nbi_block(
+                ag_ref.at[sl], ag_ref.at[sl], down_o, outer,
+                out_send.at[0, s], out_recv.at[0, s],
+            )
+        # both forwards are in flight: the MXU overlaps them
+        pipeline(ag_ref.at[sl], b_ref, out_ref.at[sl])
+
+    for t in range(1, n_o):
+        row = jax.lax.rem(me_o - t + n_o, n_o)
+        for s in range(n_i):
+            c = jax.lax.rem(me_i - s + n_i, n_i)
+            descs_o[t - 1][s].wait_recv()
+            sl = slot(row, c)
+            if t < n_o - 1:
+                descs_o[t][s] = shmem.putmem_nbi_block(
+                    ag_ref.at[sl], ag_ref.at[sl], down_o, outer,
+                    out_send.at[t, s], out_recv.at[t, s],
+                )
+            pipeline(ag_ref.at[sl], b_ref, out_ref.at[sl])
+    shmem.quiet(*descs_i, *(d for row_d in descs_o for d in row_d if d is not None))
+
+
+def _ag_gemm_2d(a, b, *, axes, cfg, gather_output, out_dtype, interpret):
+    outer, inner = axes
+    n_o = int(jax.lax.axis_size(outer))
+    n_i = int(jax.lax.axis_size(inner))
+    n = n_o * n_i
+    m_loc, k_dim = a.shape
+    n_loc = b.shape[1]
+    bm = _pick_block(m_loc, cfg.block_m)
+    bn = _pick_block(n_loc, cfg.block_n)
+    out, ag = dist_pallas_call(
+        functools.partial(
+            _ag_gemm_2d_kernel, outer=outer, inner=inner, n_o=n_o, n_i=n_i,
+            cfg=cfg, out_dtype=out_dtype,
+        ),
+        name="ag_gemm_2d",
+        out_shape=(
+            jax.ShapeDtypeStruct((n * m_loc, n_loc), out_dtype),
+            jax.ShapeDtypeStruct((n * m_loc, k_dim), a.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bm, bn), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((max(n_i - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n_i - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n_o - 1, 1), n_i)),
+            pltpu.SemaphoreType.DMA((max(n_o - 1, 1), n_i)),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * m_loc * n_loc * k_dim,
+            bytes_accessed=(n * m_loc * k_dim + k_dim * n_loc + n * m_loc * n_loc) * a.dtype.itemsize,
+            transcendentals=0,
+        ),
+        uses_barrier=True,
+        interpret=interpret,
+    )(a, b)
+    return (out, ag) if gather_output else out
+
+
 def ag_gemm(
     a: jax.Array,
     b: jax.Array,
@@ -112,10 +225,19 @@ def ag_gemm(
     Golden: ``jax.lax.all_gather(a, axis, tiled=True) @ b``.
     """
     cfg = config or AGGemmConfig()
+    out_dtype = out_dtype or a.dtype
+    if isinstance(axis, (tuple, list)):
+        if len(axis) == 1:
+            axis = axis[0]
+        else:
+            assert len(axis) == 2, f"at most 2 axes supported, got {axis}"
+            return _ag_gemm_2d(
+                a, b, axes=tuple(axis), cfg=cfg, gather_output=gather_output,
+                out_dtype=out_dtype, interpret=interpret,
+            )
     n = int(jax.lax.axis_size(axis))
     m_loc, k_dim = a.shape
     n_loc = b.shape[1]
-    out_dtype = out_dtype or a.dtype
     bm = _pick_block(m_loc, cfg.block_m)
     bn = _pick_block(n_loc, cfg.block_n)
     if n == 1:

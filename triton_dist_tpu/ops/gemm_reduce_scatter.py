@@ -159,6 +159,40 @@ def _gemm_rs_ring_kernel(
     shmem.quiet(*descs)
 
 
+def _gemm_rs_2d(a, b, *, axes, method, cfg, out_dtype, interpret):
+    """Hierarchical GEMM-RS over two mesh axes ``(outer, inner)``
+    (≙ the reference's producer GEMM + 2-D reduce-scatter pipeline,
+    reduce_scatter.py:525-637): the fused GEMM-RS runs over the fast `inner`
+    axis with A's chunk layout transposed so inner peer i ends up owning
+    slab ``S_i = concat_o'(chunk (o', i))`` of the product, already
+    inner-reduced; a standalone reduce-scatter then finishes over `outer`.
+    Every byte crosses the slow axis once, n_i-fold pre-reduced."""
+    from triton_dist_tpu.ops.reduce_scatter import reduce_scatter
+
+    outer, inner = axes
+    n_o = int(jax.lax.axis_size(outer))
+    n_i = int(jax.lax.axis_size(inner))
+    if n_o == 1:
+        return gemm_rs(a, b, axis=inner, method=method, config=cfg,
+                       out_dtype=out_dtype, interpret=interpret)
+    if n_i == 1:
+        return gemm_rs(a, b, axis=outer, method=method, config=cfg,
+                       out_dtype=out_dtype, interpret=interpret)
+    m_tot, k_loc = a.shape
+    n = n_o * n_i
+    assert m_tot % n == 0, (m_tot, n)
+    m_loc = m_tot // n
+    a_perm = a.reshape(n_o, n_i, m_loc, k_loc).swapaxes(0, 1).reshape(m_tot, k_loc)
+    part = gemm_rs(
+        a_perm, b, axis=inner, method=method, config=cfg,
+        out_dtype=out_dtype, interpret=interpret,
+    )  # [n_o*m_loc, N] = S_me_i's product, summed over the inner group
+    # gemm_rs and the standalone reduce_scatter use different method
+    # vocabularies ("scatter" vs "scatter_reduce")
+    rs_method = {"scatter": "scatter_reduce"}.get(method, method)
+    return reduce_scatter(part, axis=outer, method=rs_method, interpret=interpret)
+
+
 def gemm_rs(
     a: jax.Array,
     b: jax.Array,
@@ -178,10 +212,19 @@ def gemm_rs(
     (≙ ``gemm_rs_op``, reference gemm_reduce_scatter.py:498).
     """
     cfg = config or GemmRSConfig()
+    out_dtype = out_dtype or a.dtype
+    if isinstance(axis, (tuple, list)):
+        if len(axis) == 1:
+            axis = axis[0]
+        else:
+            assert len(axis) == 2, f"at most 2 axes supported, got {axis}"
+            return _gemm_rs_2d(
+                a, b, axes=tuple(axis), method=method, cfg=cfg,
+                out_dtype=out_dtype, interpret=interpret,
+            )
     n = int(jax.lax.axis_size(axis))
     m_tot, k_loc = a.shape
     n_dim = b.shape[1]
-    out_dtype = out_dtype or a.dtype
     if n == 1:
         # World-1 is a plain matmul; run it through the same tuned MXU
         # pipeline the fused kernels use (beats the XLA dot at bench shapes).

@@ -188,6 +188,14 @@ def reduce_scatter(
     Golden: ``jax.lax.psum_scatter(x, axis, tiled=True)``
     (≙ ``reduce_scatter_2d_op``, reference reduce_scatter.py:863).
     """
+    if isinstance(axis, (tuple, list)):
+        if len(axis) == 1:
+            axis = axis[0]
+        else:
+            assert len(axis) == 2, f"at most 2 axes supported, got {axis}"
+            return reduce_scatter_2d(
+                x, axes=tuple(axis), method=method, config=config, interpret=interpret
+            )
     cfg = config or ReduceScatterConfig()
     n = int(jax.lax.axis_size(axis))
     if n == 1:
@@ -229,6 +237,55 @@ def reduce_scatter(
         interpret=interpret,
     )(x)
     out = outs[0]
+    if orig_ndim == 1:
+        out = out.reshape(m_loc)
+    return out
+
+
+def reduce_scatter_2d(
+    x: jax.Array,
+    *,
+    axes: tuple[str, str],
+    method: str = "auto",
+    config: ReduceScatterConfig | None = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Hierarchical reduce-scatter over two mesh axes ``(outer, inner)``
+    (≙ the reference's 2-D pipeline: intra-node scatter → local reduce →
+    inter-node P2P → ring, reduce_scatter.py:47-142,525-637).
+
+    TPU-native staging: phase 1 reduce-scatters over the `inner` (fast ICI)
+    axis with the chunk layout transposed so each inner peer ends up owning
+    the slab ``S_i = concat_o'(chunk (o', i))``; phase 2 reduce-scatters that
+    slab over the `outer` axis. Every byte crosses the slow axis exactly once
+    and already (n_i-fold) reduced — the same traffic shape as the
+    reference's node-then-ring pipeline. Golden:
+    ``jax.lax.psum_scatter(x, axes, tiled=True)``.
+    """
+    outer, inner = axes
+    n_o = int(jax.lax.axis_size(outer))
+    n_i = int(jax.lax.axis_size(inner))
+    if n_o == 1:
+        return reduce_scatter(x, axis=inner, method=method, config=config, interpret=interpret)
+    if n_i == 1:
+        return reduce_scatter(x, axis=outer, method=method, config=config, interpret=interpret)
+    orig_ndim = x.ndim
+    if x.ndim == 1:
+        x = x.reshape(x.shape[0], 1)
+    m_total, n_dim = x.shape
+    n = n_o * n_i
+    assert m_total % n == 0, (m_total, n)
+    m_loc = m_total // n
+    # chunk (o, i) → slab order (i, o): phase 1's inner chunk j becomes
+    # S_j = concat_o'(chunk (o', j)). XLA lowers this to one HBM pass and
+    # fuses it with the surrounding program.
+    xt = x.reshape(n_o, n_i, m_loc, n_dim).swapaxes(0, 1).reshape(m_total, n_dim)
+    part = reduce_scatter(
+        xt, axis=inner, method=method, config=config, interpret=interpret
+    )  # [n_o*m_loc, n_dim]: S_me_i summed over the inner group
+    out = reduce_scatter(
+        part, axis=outer, method=method, config=config, interpret=interpret
+    )  # [m_loc, n_dim]: chunk (me_o, me_i) summed over everyone
     if orig_ndim == 1:
         out = out.reshape(m_loc)
     return out
