@@ -95,6 +95,55 @@ def test_ep_a2a_layer_roundtrip(mesh4):
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
 
 
+def test_ep_a2a_overflow_surfaced(mesh4):
+    """Undersized max_m: overflow is reported, bookkeeping matches what was
+    actually sent, and surviving assignments combine correctly (ADVICE r1:
+    splits must be clamped so combine never reads rows that never left)."""
+    world, m_loc, hidden, topk = 4, 8, 64, 2
+    n_exp = 4  # one expert per rank → each dest gets many rows, forcing drops
+    max_m = 3  # < worst case m_loc*topk
+    layer = EPAll2AllLayer(n_experts=n_exp, topk=topk, max_m=max_m, axis="tp")
+    m_tot = world * m_loc
+    x = jax.random.normal(jax.random.PRNGKey(20), (m_tot, hidden), jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(21), (m_tot, topk), 0, n_exp, jnp.int32)
+    tw = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(22), (m_tot, topk)))
+
+    def fn(x, ids, tw):
+        recv, info = layer.dispatch(x, ids)
+        out = layer.combine(recv, info, tw, m_loc)  # identity "experts"
+        return out, info.overflow[None], info.recv_splits
+
+    got, overflow, rsplits = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh4,
+            in_specs=(P("tp", None), P("tp", None), P("tp", None)),
+            out_specs=(P("tp", None), P("tp"), P("tp")), check_vma=False,
+        )
+    )(x, ids, tw)
+    assert np.all(np.asarray(rsplits) <= max_m)
+    overflow = np.asarray(overflow).reshape(world)
+    assert overflow.sum() > 0  # the undersized slab was actually exercised
+    # golden with drop semantics: per PE, assignments stable-sorted by dest
+    # rank keep only the first max_m per dest
+    want = np.zeros((m_tot, hidden), np.float32)
+    xs = np.asarray(x).reshape(world, m_loc, hidden)
+    ids_np = np.asarray(ids).reshape(world, m_loc, topk)
+    tw_np = np.asarray(tw).reshape(world, m_loc, topk)
+    for pe in range(world):
+        dest = (ids_np[pe] // (n_exp // world)).reshape(-1)
+        order = np.argsort(dest, kind="stable")
+        taken = {d: 0 for d in range(world)}
+        for a in order:
+            d = dest[a]
+            if taken[d] < max_m:
+                taken[d] += 1
+                t_loc, k = divmod(a, topk)
+                want[pe * m_loc + t_loc] += tw_np[pe][t_loc, k] * xs[pe][t_loc]
+    np.testing.assert_allclose(
+        np.asarray(got), want, rtol=1e-5, atol=1e-5
+    )
+
+
 def test_ep_receiver_alignment(mesh4):
     world, m_loc, hidden, n_exp, topk = 4, 8, 32, 8, 2
     layer = EPAll2AllLayer(n_experts=n_exp, topk=topk, max_m=m_loc * topk, axis="tp")

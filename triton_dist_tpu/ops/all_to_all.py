@@ -86,33 +86,49 @@ def fast_all_to_all(
     tokens: jax.Array,
     splits: jax.Array,
     *,
+    meta: jax.Array | None = None,
     axis: str = "tp",
     interpret: Any = None,
-) -> tuple[jax.Array, jax.Array]:
+) -> tuple[jax.Array, jax.Array] | tuple[jax.Array, jax.Array, jax.Array]:
     """Exchange padded token slabs between all PEs of `axis` (call inside
     ``jax.shard_map``; ≙ ``fast_all_to_all``, low_latency_all_to_all.py:189).
 
     tokens: ``[n, max_m, hidden]`` — slab ``p`` holds the ``splits[p]``
     tokens this PE sends to PE ``p`` (rows beyond the count are padding).
     splits: ``[n]`` int32 valid counts.
+    meta: optional ``[n, K]`` int32 per-slab metadata (e.g. per-row expert
+    ids, bitcast routing weights). It rides the *existing* splits put —
+    the reference folds routing metadata into the same transport for the
+    same reason (its scale tensor travels with the data,
+    low_latency_all_to_all.py:94-104) — so attaching metadata costs zero
+    extra DMAs, kernel launches, or barriers.
 
-    Returns ``(recv, recv_splits)``: slab ``j`` of ``recv`` holds the tokens
-    PE ``j`` sent here (``recv_splits[j]`` valid rows). Golden:
-    ``jax.lax.all_to_all`` over the slab dim.
+    Returns ``(recv, recv_splits[, recv_meta])``: slab ``j`` of ``recv``
+    holds the tokens PE ``j`` sent here (``recv_splits[j]`` valid rows).
+    Golden: ``jax.lax.all_to_all`` over the slab dim.
     """
     n = int(jax.lax.axis_size(axis))
     n_slabs, max_m, hidden = tokens.shape
     assert n_slabs == n, (n_slabs, n)
     splits = splits.reshape(n, 1).astype(jnp.int32)
+    payload = splits
+    if meta is not None:
+        assert meta.shape[0] == n, (meta.shape, n)
+        payload = jnp.concatenate(
+            [splits, meta.reshape(n, -1).astype(jnp.int32)], axis=1
+        )
+    w = payload.shape[1]
     if n == 1:
-        return tokens, splits.reshape(n)
+        if meta is None:
+            return tokens, splits.reshape(n)
+        return tokens, splits.reshape(n), meta
     n_steps = n - 1
-    recv, rsplits = dist_pallas_call(
+    recv, rpayload = dist_pallas_call(
         functools.partial(_a2a_kernel, axis=axis, n=n),
         name="fast_all_to_all",
         out_shape=(
             jax.ShapeDtypeStruct((n, max_m, hidden), tokens.dtype),
-            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n, w), jnp.int32),
         ),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
@@ -130,8 +146,11 @@ def fast_all_to_all(
             pltpu.SemaphoreType.DMA((n_steps,)),
         ],
         interpret=interpret,
-    )(tokens, splits)
-    return recv, rsplits.reshape(n)
+    )(tokens, payload)
+    rsplits = rpayload[:, 0]
+    if meta is None:
+        return recv, rsplits
+    return recv, rsplits, rpayload[:, 1:].reshape(meta.shape)
 
 
 def all_to_all_post_process(
