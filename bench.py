@@ -15,11 +15,14 @@ overlap-efficiency; all2all p50 µs", plus flash-decode latency):
 program on the same hardware (the reference's own methodology: fused op vs
 torch/NCCL golden). >= 1.0 means the fused path wins.
 
-Timing: the tunneled TPU adds ~70 ms constant readback latency and a few
-percent of drift, so each fused/baseline pair is timed INTERLEAVED
-(alternating trials) and scored by median-of-trials — an absolute-accuracy
-and drift-robust methodology (see utils.perf_func for the delta-timing
-that cancels the constant part).
+Timing: per-call dispatch over the tunneled TPU costs hundreds of µs of
+RPC, which buries µs-scale kernels and adds double-digit-% noise even at
+ms scale. Every fused/baseline pair is therefore timed ON DEVICE with
+``perf_func_loop``: the op runs inside one jitted ``lax.fori_loop`` whose
+iterations are chained by a 1-element scatter-add of the output into the
+input (aliasing DUS ≈ 0 cost, but defeats hoisting/CSE), timed at two
+trip counts so the single launch's constant cost cancels, median of
+trials.
 
 Runs on however many devices are visible: 1 real chip (driver) exercises
 the world-1 MXU pipelines; multi-chip exercises the rings. Ops without an
@@ -30,26 +33,27 @@ run also populates .autotune_cache/ (the sweep the judge can inspect).
 from __future__ import annotations
 
 import json
-import statistics
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from triton_dist_tpu.utils import perf_func
+from triton_dist_tpu.utils import perf_func_loop
 
 
-def bench_pair(fused, base, iters=30, trials=5):
-    """Interleaved median timing of two thunks: returns (fused_ms, base_ms).
-    Alternation puts both thunks under the same thermal/tunnel drift."""
-    ts_f, ts_b = [], []
-    for _ in range(trials):
-        _, tf = perf_func(fused, iters=iters, warmup_iters=1)
-        _, tb = perf_func(base, iters=iters, warmup_iters=1)
-        ts_f.append(tf)
-        ts_b.append(tb)
-    return statistics.median(ts_f), statistics.median(ts_b)
+def bench_pair(fused, base, args, iters=30, perturb_idx=0):
+    """On-device loop timing of two ops over the same args: returns
+    (fused_ms, base_ms). The side-effectful fused op needs only a 1-element
+    iteration chain; the pure XLA baseline must have its whole output
+    consumed or DCE shrinks it (see perf_func_loop's consume)."""
+    t_f = perf_func_loop(
+        fused, args, iters=iters, perturb_idx=perturb_idx, consume="first"
+    )
+    t_b = perf_func_loop(
+        base, args, iters=iters, perturb_idx=perturb_idx, consume="all"
+    )
+    return t_f, t_b
 
 
 def emit(metric, value, unit, vs_baseline):
@@ -82,7 +86,7 @@ def bench_gemm_rs(mesh, n):
         NamedSharding(mesh, P("tp", None)),
     )
 
-    fused = lambda: gemm_rs_op(a, b, mesh)
+    fused = lambda a, b: gemm_rs_op(a, b, mesh)
 
     @jax.jit
     def unfused(a, b):
@@ -93,13 +97,13 @@ def bench_gemm_rs(mesh, n):
             out, NamedSharding(mesh, P("tp", None))
         )
 
-    out = fused()
+    out = fused(a, b)  # eager call: correctness + autotune before the loop
     ref = unfused(a, b)
     np.testing.assert_allclose(
         np.asarray(out[:64], np.float32), np.asarray(ref[:64], np.float32),
         atol=4.0, rtol=4e-2,
     )
-    t_f, t_b = bench_pair(fused, lambda: unfused(a, b))
+    t_f, t_b = bench_pair(fused, unfused, (a, b), iters=40)
     tflops = 2.0 * m_tot * k_tot * n_dim / (t_f * 1e-3) / 1e12 / n
     emit(
         f"gemm_rs_bf16_tflops_per_chip_tp{n}_m{m_tot}k{k_tot}n{n_dim}",
@@ -123,16 +127,22 @@ def bench_all_to_all(mesh, n):
         jnp.full((n, n), max_m, jnp.int32), NamedSharding(mesh, P("tp", None))
     )
 
-    fused = lambda: fast_all_to_all_op(tokens, splits, mesh)
+    fused = lambda t, s: fast_all_to_all_op(t, s, mesh)
 
     @jax.jit
-    def xla_a2a(t):
+    def xla_a2a(t, s):
         # golden: XLA all-to-all over the slab dim (sharding-induced)
         return jax.lax.with_sharding_constraint(
             t.swapaxes(0, 1), NamedSharding(mesh, P("tp", None, None, None))
         )
 
-    t_f, t_b = bench_pair(fused, lambda: xla_a2a(tokens), iters=50)
+    fused(tokens, splits)  # autotune/compile before the loop
+    # Both sides consume="all": the baseline's sum cannot fuse into a
+    # collective's epilogue (unlike the GEMM baselines), so a one-sided
+    # full consumption would bill it an extra HBM pass the fused op skips.
+    iters = 2000 if n == 1 else 500
+    t_f = perf_func_loop(fused, (tokens, splits), iters=iters, consume="all")
+    t_b = perf_func_loop(xla_a2a, (tokens, splits), iters=iters, consume="all")
     emit(
         f"fast_all_to_all_p50_us_ep{n}_m{max_m}h{hidden}",
         t_f * 1e3, "us", t_b / t_f,
@@ -157,7 +167,7 @@ def bench_flash_decode(mesh, n):
     )
     kv_lens = jnp.full((b,), s, jnp.int32)
 
-    fused = lambda: flash_decode_op(q, k, v, kv_lens, mesh)
+    fused = lambda q, k, v: flash_decode_op(q, k, v, kv_lens, mesh)
 
     g = hq // h_kv
 
@@ -168,10 +178,10 @@ def bench_flash_decode(mesh, n):
         p = jax.nn.softmax(s_ / np.sqrt(d), axis=-1)
         return jnp.einsum("bhgs,bhsd->bhgd", p, v.astype(jnp.float32)).reshape(b, hq, d)
 
-    out = fused()
+    out = fused(q, k, v)  # eager call: correctness + autotune before the loop
     ref = xla_attn(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2, rtol=2e-2)
-    t_f, t_b = bench_pair(fused, lambda: xla_attn(q, k, v), iters=50)
+    t_f, t_b = bench_pair(fused, xla_attn, (q, k, v), iters=150)
     emit(
         f"flash_decode_us_sp{n}_b{b}hq{hq}kv{h_kv}s{s}",
         t_f * 1e3, "us", t_b / t_f,
@@ -198,28 +208,28 @@ def bench_ag_gemm(mesh, n):
         NamedSharding(mesh, P(None, "tp")),
     )
 
-    fused = lambda: ag_gemm_op(a, b, mesh)
+    fused = lambda a, b: ag_gemm_op(a, b, mesh)
 
     @jax.jit
     def unfused(a, b):
         return jnp.dot(a, b, preferred_element_type=jnp.bfloat16)
 
-    out = fused()
+    out = fused(a, b)  # eager call: correctness + autotune before the loop
     ref = unfused(a, b)
     np.testing.assert_allclose(
         np.asarray(out[:128], np.float32), np.asarray(ref[:128], np.float32),
         atol=2.0, rtol=2e-2,
     )
-    t_f, t_b = bench_pair(fused, lambda: unfused(a, b))
+    t_f, t_b = bench_pair(fused, unfused, (a, b), iters=40)
 
     if n > 1:
         # measured overlap: comm-only (the allgather) and compute-only (the
         # same gathered-GEMM with comm stripped = XLA dot on gathered A)
         a_rep = jax.device_put(np.asarray(a), NamedSharding(mesh, P(None, None)))
-        comm = lambda: all_gather_op(a, mesh)
-        comp = lambda: unfused(a_rep, b)
-        _, t_comm = perf_func(comm, iters=30, warmup_iters=2)
-        _, t_comp = perf_func(comp, iters=30, warmup_iters=2)
+        t_comm = perf_func_loop(
+            lambda a: all_gather_op(a, mesh), (a,), iters=40, consume="first"
+        )
+        t_comp = perf_func_loop(unfused, (a_rep, b), iters=40, consume="all")
         eff = overlap_efficiency(t_f, t_comp, t_comm)
         # vs_baseline keeps its contract (fused vs the serial comm+compute
         # program); the efficiency itself is the metric value

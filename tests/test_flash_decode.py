@@ -12,7 +12,30 @@ from triton_dist_tpu.ops.flash_decode import (
     combine_partials,
     flash_decode,
     flash_decode_op,
+    paged_flash_decode,
 )
+
+
+def _paginate(k, v, page_size, key=None, n_extra_pages=0):
+    """Split a contiguous cache into shuffled pages + block table."""
+    b, h_kv, s, d = k.shape
+    ppseq = s // page_size
+    n_pages = b * ppseq + n_extra_pages
+    perm = (
+        jax.random.permutation(key, n_pages)[: b * ppseq]
+        if key is not None
+        else jnp.arange(b * ppseq)
+    )
+    bt = perm.reshape(b, ppseq).astype(jnp.int32)
+    kp = jnp.zeros((n_pages, h_kv, page_size, d), k.dtype)
+    vp = jnp.zeros((n_pages, h_kv, page_size, d), v.dtype)
+    k_chunks = k.reshape(b, h_kv, ppseq, page_size, d)
+    v_chunks = v.reshape(b, h_kv, ppseq, page_size, d)
+    for bi in range(b):
+        for ci in range(ppseq):
+            kp = kp.at[bt[bi, ci]].set(k_chunks[bi, :, ci])
+            vp = vp.at[bt[bi, ci]].set(v_chunks[bi, :, ci])
+    return kp, vp, bt
 
 
 def _ref_decode(q, k, v, kv_lens):
@@ -85,6 +108,73 @@ def test_flash_decode_sp_op(mesh4):
     q, k, v, _ = _rand_case(jax.random.PRNGKey(3), b, h_kv * g, h_kv, s, d)
     kv_lens = jnp.array([s, 40], jnp.int32)  # rank >1 partially/fully empty
     got = flash_decode_op(q, k, v, kv_lens, mesh4, config=FlashDecodeConfig(block_s=32))
+    want = _ref_decode(q, k, v, kv_lens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("g", [1, 4])
+def test_paged_flash_decode_matches_contiguous(g):
+    """Paged (shuffled pages, block-table indirection) must exactly match
+    the contiguous kernel — the block table only changes page placement."""
+    b, h_kv, s, d, page = 2, 2, 256, 128, 64
+    q, k, v, kv_lens = _rand_case(jax.random.PRNGKey(5), b, h_kv * g, h_kv, s, d)
+    kp, vp, bt = _paginate(k, v, page, key=jax.random.PRNGKey(6), n_extra_pages=3)
+    got = paged_flash_decode(q, kp, vp, kv_lens, bt)
+    want = flash_decode(q, k, v, kv_lens, config=FlashDecodeConfig(block_s=page))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6)
+    ref = _ref_decode(q, k, v, kv_lens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_paged_flash_decode_ragged_lens():
+    """Partial last page + empty sequences mask correctly."""
+    b, h_kv, g, s, d, page = 3, 1, 2, 128, 128, 32
+    q, k, v, _ = _rand_case(jax.random.PRNGKey(7), b, h_kv * g, h_kv, s, d)
+    kv_lens = jnp.array([s, 41, 1], jnp.int32)  # mid-page boundaries
+    kp, vp, bt = _paginate(k, v, page, key=jax.random.PRNGKey(8))
+    got = paged_flash_decode(q, kp, vp, kv_lens, bt)
+    want = _ref_decode(q, k, v, kv_lens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_paged_flash_decode_sp(mesh4):
+    """Paged SP decode: each PE's page pool covers its sequence shard."""
+    from jax.sharding import PartitionSpec as P
+
+    from triton_dist_tpu.ops.flash_decode import paged_flash_decode_distributed
+
+    b, h_kv, g, s, d, page = 2, 1, 2, 256, 128, 32
+    world = 4
+    s_loc = s // world
+    q, k, v, _ = _rand_case(jax.random.PRNGKey(9), b, h_kv * g, h_kv, s, d)
+    kv_lens = jnp.array([s, 100], jnp.int32)
+    # build each PE's pool from its shard; stack pools on a leading axis
+    pools = []
+    for i in range(world):
+        sl = slice(i * s_loc, (i + 1) * s_loc)
+        kp, vp, bt = _paginate(
+            k[:, :, sl], v[:, :, sl], page, key=jax.random.PRNGKey(10 + i)
+        )
+        pools.append((kp, vp, bt))
+    kps = jnp.stack([p[0] for p in pools])
+    vps = jnp.stack([p[1] for p in pools])
+    bts = jnp.stack([p[2] for p in pools])
+
+    def fn(q, kps, vps, bts, lens):
+        me = jax.lax.axis_index("tp")
+        local_lens = jnp.clip(lens - me * s_loc, 0, s_loc)
+        return paged_flash_decode_distributed(
+            q, kps[0], vps[0], local_lens, bts[0], axis="tp"
+        )
+
+    got = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh4,
+            in_specs=(P(None, None, None), P("tp", None, None, None, None),
+                      P("tp", None, None, None, None), P("tp", None, None), P(None)),
+            out_specs=P(None, None, None), check_vma=False,
+        )
+    )(q, kps, vps, bts, kv_lens)
     want = _ref_decode(q, k, v, kv_lens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
 

@@ -1,0 +1,55 @@
+"""Topology discovery unit tests (≙ the reference's NVLink/NUMA probing,
+utils.py:504-786, tested here with faked physical coords)."""
+
+from triton_dist_tpu.parallel import topology
+
+
+class FakeDev:
+    def __init__(self, coords):
+        self.coords = coords
+
+
+def test_wraparound_cpu_backend():
+    # tests run on the CPU backend: the simulated ring always wraps
+    assert topology.tpu_generation() == "cpu"
+    assert topology.has_wraparound(3)
+    assert topology.has_wraparound(8)
+
+
+def test_wraparound_v5e(monkeypatch):
+    monkeypatch.setattr(topology, "tpu_generation", lambda: "v5e")
+    # a v5e 2x4 slice: the 4-long axis is a mesh line, NOT a wrap ring
+    devs = [FakeDev((x, 0, 0)) for x in range(4)]
+    assert not topology.has_wraparound(4, devs)
+    assert not topology.has_wraparound(4)          # size-only fallback
+    assert topology.has_wraparound(2)              # single link, both dirs
+    # full pod edge wraps
+    devs16 = [FakeDev((x, 0, 0)) for x in range(16)]
+    assert topology.has_wraparound(16, devs16)
+
+
+def test_wraparound_v5p(monkeypatch):
+    monkeypatch.setattr(topology, "tpu_generation", lambda: "v5p")
+    # full torus dimension (multiple of 4) wraps
+    devs = [FakeDev((0, y, 0)) for y in range(4)]
+    assert topology.has_wraparound(4, devs)
+    # 3-chip line: no wrap
+    devs3 = [FakeDev((0, y, 0)) for y in range(3)]
+    assert not topology.has_wraparound(3, devs3)
+    # axis snaking through two torus dims: no single ring
+    snake = [FakeDev((x, y, 0)) for x in range(2) for y in range(2)]
+    assert not topology.has_wraparound(4, snake)
+    # non-contiguous placement: no ring
+    nc = [FakeDev((0, y, 0)) for y in (0, 1, 2, 4)]
+    assert not topology.has_wraparound(4, nc)
+    # size-only fallbacks
+    assert topology.has_wraparound(8)
+    assert not topology.has_wraparound(6)
+
+
+def test_wraparound_coords_override_size(monkeypatch):
+    """Physical span beats the logical axis size: 4 mesh positions spread
+    over a longer line segment of the torus do not form a ring."""
+    monkeypatch.setattr(topology, "tpu_generation", lambda: "v5p")
+    spread = [FakeDev((0, y, 0)) for y in (0, 2, 4, 6)]
+    assert not topology.has_wraparound(4, spread)

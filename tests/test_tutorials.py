@@ -1,0 +1,30 @@
+"""Every tutorial must run green as a standalone program (≙ the reference's
+launch.sh-driven tutorial smoke runs; here they self-bootstrap a CPU mesh)."""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+TUTORIALS = sorted(
+    glob.glob(os.path.join(os.path.dirname(__file__), "..", "tutorials", "[0-9]*.py"))
+)
+
+
+def test_tutorials_exist():
+    assert len(TUTORIALS) >= 6
+
+
+@pytest.mark.parametrize("path", TUTORIALS, ids=[os.path.basename(p) for p in TUTORIALS])
+def test_tutorial_runs(path):
+    env = dict(os.environ, TDT_TUTORIAL_WORLD="4")
+    env.pop("XLA_FLAGS", None)  # tutorial sets its own device count
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(path)],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.abspath(path)),
+    )
+    assert proc.returncode == 0, f"{path}:\n{proc.stdout}\n{proc.stderr}"
+    assert "OK" in proc.stdout

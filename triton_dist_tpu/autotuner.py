@@ -30,7 +30,7 @@ from typing import Any, Callable, Iterable, Sequence
 import jax
 
 from triton_dist_tpu import config as tdt_config
-from triton_dist_tpu.utils import perf_func
+from triton_dist_tpu.utils import perf_func_loop
 
 
 _CACHE_DIR = os.environ.get("TDT_AUTOTUNE_CACHE", ".autotune_cache")
@@ -96,8 +96,10 @@ def contextual_autotune(
     configs: Iterable[Any],
     *,
     name: str | None = None,
-    iters: int = 5,
-    warmup: int = 2,
+    iters: int = 15,
+    trials: int = 3,
+    warmup: int = 1,  # kept for API compat; warmup happens inside the loop timer
+    dedupe: Callable[..., Any] | None = None,
 ) -> Callable:
     """Decorator: sweep `configs` for the wrapped op on first call per input
     signature, thereafter reuse the winner (≙ ``contextual_autotune``,
@@ -106,6 +108,14 @@ def contextual_autotune(
     The wrapped function must accept a ``config=`` keyword. Candidates that
     fail to compile/run are skipped (the reference likewise discards configs
     that raise, autotuner.py:150-170).
+
+    Each candidate is scored by the median of `trials` on-device loop
+    timings (``perf_func_loop`` — one compile per config; per-call walltime
+    over a tunneled chip was noisy enough to mis-pick by 40%).
+
+    `dedupe`, if given, maps ``(cfg, *args, **kwargs)`` to the config's
+    EFFECTIVE key for this problem (e.g. the clamped block shape); configs
+    that collapse to the same key are timed once and share the result.
     """
     configs = list(configs)
 
@@ -136,25 +146,30 @@ def contextual_autotune(
                 _memory_cache[mem_key] = configs[entry["i"]]
                 return fn(*args, config=_memory_cache[mem_key], **kwargs)
 
-            best_i, best_t, times = 0, float("inf"), []
+            times = [float("inf")] * len(configs)
+            seen: dict[Any, int] = {}
             for i, cfg in enumerate(configs):
-                # fn is called exactly as in the cached path (no extra jit
-                # wrapper: op entries are jitted inside, and non-array args
-                # like axis names must stay Python values)
+                if dedupe is not None:
+                    try:
+                        eff = dedupe(cfg, *args, **kwargs)
+                    except Exception:
+                        eff = i
+                    if eff in seen:
+                        times[i] = times[seen[eff]]  # same effective kernel
+                        continue
+                    seen[eff] = i
                 try:
-                    _, t = perf_func(
-                        functools.partial(fn, *args, config=cfg, **kwargs),
+                    times[i] = perf_func_loop(
+                        functools.partial(fn, config=cfg, **kwargs),
+                        args,
                         iters=iters,
-                        warmup_iters=warmup,
+                        trials=trials,
                     )
                 except Exception as e:  # config doesn't fit this problem
                     if tdt_config.get_config().verbose_autotune:
                         print(f"[autotune {op_name}] cfg {cfg} failed: {e!r}")
-                    times.append(float("inf"))
-                    continue
-                times.append(t)
-                if t < best_t:
-                    best_i, best_t = i, t
+            best_i = min(range(len(configs)), key=lambda i: times[i])
+            best_t = times[best_i]
             if not any(t != float("inf") for t in times):
                 raise RuntimeError(
                     f"autotune({op_name}): every candidate config failed"

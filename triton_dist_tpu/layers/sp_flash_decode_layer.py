@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from triton_dist_tpu.ops.flash_decode import (
     FlashDecodeConfig,
     flash_decode_distributed,
+    paged_flash_decode_distributed,
 )
 
 
@@ -44,6 +45,22 @@ class SpGQAFlashDecodeAttention:
             q, k_shard, v_shard, kv_lens_shard,
             axis=self.axis, config=self.config,
             ag_method=self.ag_method, interpret=self.interpret,
+        )
+
+    def forward_paged(
+        self,
+        q: jax.Array,            # [b, q_heads, d]
+        k_pages: jax.Array,      # [n_pages, kv_heads, page_size, d] local pool
+        v_pages: jax.Array,
+        kv_lens_shard: jax.Array,   # [b] valid positions in the LOCAL shard
+        block_table: jax.Array,  # [b, max_pages] local physical page ids
+    ) -> jax.Array:
+        """Paged-KV forward (≙ the reference layer's block_table path,
+        sp_flash_decode_layer.py:78: each rank's paged pool covers its
+        sequence shard)."""
+        return paged_flash_decode_distributed(
+            q, k_pages, v_pages, kv_lens_shard, block_table,
+            axis=self.axis, ag_method=self.ag_method, interpret=self.interpret,
         )
 
     def local_lens_from_global(

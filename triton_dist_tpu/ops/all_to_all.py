@@ -185,6 +185,9 @@ def fast_all_to_all_op(
     """Host-level entry: `tokens` ``[n, n, max_m, hidden]`` (dim 0 = owning
     PE, dim 1 = destination slab) and `splits` ``[n, n]``, both sharded on
     dim 0. Returns the exchanged slabs/splits in the same layout."""
+    if mesh.shape[axis] == 1:
+        # world-1 all-to-all IS the identity: no kernel, no copy
+        return tokens, splits.astype(jnp.int32)
     fn = functools.partial(fast_all_to_all, axis=axis, interpret=interpret)
 
     def wrapped(t, s):

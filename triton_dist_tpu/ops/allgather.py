@@ -35,12 +35,16 @@ from triton_dist_tpu.parallel import topology
 from triton_dist_tpu.shmem import device as shmem
 
 
-def get_auto_all_gather_method(chunk_bytes: int, n_pes: int) -> str:
+def get_auto_all_gather_method(
+    chunk_bytes: int, n_pes: int, devices: Any = None
+) -> str:
     """Topology/size-based method choice (≙ ``get_auto_all_gather_method``,
-    reference allgather.py:44-69, which keys on NVLink-fullmesh/NUMA)."""
+    reference allgather.py:44-69, which keys on NVLink-fullmesh/NUMA).
+    `devices` — the mesh-axis devices (``topology.axis_devices``) — enables
+    physical wrap detection from their torus coords."""
     if n_pes <= 2:
         return "ring_1d"
-    if chunk_bytes <= 256 * 1024 or not topology.has_wraparound(n_pes):
+    if chunk_bytes <= 256 * 1024 or not topology.has_wraparound(n_pes, devices):
         # Small latency-bound sizes, or a line topology where a ring's wrap
         # hop would route the long way: direct hardware-routed puts win.
         return "full_mesh_push"
@@ -266,7 +270,7 @@ def all_gather_2d(
     return out
 
 
-def all_gather(x: jax.Array, *, axis: str = "tp", method: str = "auto", interpret: Any = None) -> jax.Array:
+def all_gather(x: jax.Array, *, axis: str = "tp", method: str = "auto", interpret: Any = None, devices: Any = None) -> jax.Array:
     """Gather shards along mesh `axis` (call inside ``jax.shard_map``).
 
     `x` is this PE's shard ``(m, ...)``; returns ``(n*m, ...)`` with shard i
@@ -292,7 +296,7 @@ def all_gather(x: jax.Array, *, axis: str = "tp", method: str = "auto", interpre
         x = x.reshape(x.shape[0], 1)
     if method == "auto":
         method = get_auto_all_gather_method(
-            x.size * x.dtype.itemsize, n
+            x.size * x.dtype.itemsize, n, devices
         )
     kernel_fn, n_sem_pairs = _KERNELS[method]
     m = x.shape[0]
@@ -320,7 +324,10 @@ def all_gather_op(
 ) -> jax.Array:
     """Convenience wrapper applying shard_map over `mesh` for a global array
     sharded on dim 0 (≙ the host-level ``ag_gemm``-style entry points)."""
-    fn = functools.partial(all_gather, axis=axis, method=method, interpret=interpret)
+    fn = functools.partial(
+        all_gather, axis=axis, method=method, interpret=interpret,
+        devices=topology.axis_devices(mesh, axis),
+    )
     in_spec = P(axis, *([None] * (x.ndim - 1)))
     out_spec = P(*([None] * x.ndim))
     return jit_shard_map(

@@ -41,6 +41,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.allgather import all_gather
 from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
 from triton_dist_tpu.utils import pick_block
@@ -52,7 +53,7 @@ NEG_INF = float("-inf")
 class FlashDecodeConfig:
     """Tunables (≙ the reference's split-KV block knobs)."""
 
-    block_s: int = 512  # KV chunk per online-softmax step
+    block_s: int = 2048  # KV chunk per online-softmax step
 
 
 def _flash_decode_kernel(
@@ -72,12 +73,15 @@ def _flash_decode_kernel(
 
     @pl.when(c * block_s < kv_len)
     def _():
-        q = q_ref[0, 0].astype(jnp.float32) * scale        # [g, d]
-        k = k_ref[0, 0].astype(jnp.float32)                # [sc, d]
-        v = v_ref[0, 0].astype(jnp.float32)                # [sc, d]
-        s = jax.lax.dot_general(                           # [g, sc]
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
+        # Both matmuls run in the cache dtype (bf16 MXU fast path, f32
+        # accumulate); the f32-upcast variant costs a full VPU pass over
+        # every K/V tile and measured 25% slower than the HBM-bandwidth
+        # wall this kernel otherwise sits on.
+        q = q_ref[0, 0]                                     # [g, d]
+        s = jax.lax.dot_general(                            # [g, sc]
+            q, k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
         span = c * block_s + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(span < kv_len, s, NEG_INF)
         m_prev = m_scr[:]                                   # [g, 1]
@@ -86,7 +90,8 @@ def _flash_decode_kernel(
         p = jnp.exp(s - m_new)                              # [g, sc]
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32
+            p.astype(v_ref.dtype), v_ref[0, 0],
+            preferred_element_type=jnp.float32,
         )
         m_scr[:] = m_new
 
@@ -167,6 +172,124 @@ def flash_decode(
     return (out, lse) if return_lse else out
 
 
+def _paged_flash_decode_kernel(
+    kv_lens_ref, block_table_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
+    m_scr, l_scr, acc_scr,
+    *, n_chunks: int, page_size: int, scale: float,
+):
+    # Same online-softmax body as the contiguous kernel; the difference is
+    # entirely in the index_map (physical page via the prefetched block
+    # table ≙ the reference's block_table indirection, flash_decode.py:136,203)
+    _flash_decode_kernel(
+        kv_lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
+        m_scr, l_scr, acc_scr,
+        n_chunks=n_chunks, block_s=page_size, scale=scale,
+    )
+
+
+def paged_flash_decode(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    kv_lens: jax.Array,
+    block_table: jax.Array,
+    *,
+    return_lse: bool = False,
+    interpret: Any = None,
+):
+    """Single-device GQA batch decode over a PAGED KV cache
+    (≙ the reference's paged decode, flash_decode.py:130-280: the KV cache
+    is a pool of fixed-size pages; ``block_table[b, i]`` names the physical
+    page holding sequence ``b``'s ``i``-th chunk).
+
+    q: ``[b, q_heads, d]``; k_pages, v_pages: ``[n_pages, kv_heads,
+    page_size, d]``; kv_lens: ``[b]`` int32; block_table: ``[b, max_pages]``
+    int32 physical page ids (entries beyond the valid length may be
+    arbitrary in-range values). Returns like :func:`flash_decode`.
+
+    TPU-native form of the indirection: the block table rides scalar
+    prefetch (SMEM), and the K/V BlockSpec index_map reads it to steer each
+    grid step's page fetch — the double-buffered pipeline then streams
+    pages exactly as the contiguous kernel streams chunks.
+    """
+    b, hq, d = q.shape
+    n_pages, h_kv, page_size, _ = k_pages.shape
+    assert hq % h_kv == 0, (hq, h_kv)
+    g = hq // h_kv
+    max_pages = block_table.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    q4 = q.reshape(b, h_kv, g, d)
+
+    def kv_index_map(i, j, c, kv_lens_ref, bt_ref):
+        return (bt_ref[i, c], j, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h_kv, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda i, j, c, *_: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), kv_index_map),
+            pl.BlockSpec((1, 1, page_size, d), kv_index_map),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, g, d), lambda i, j, c, *_: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, g, 1), lambda i, j, c, *_: (i, j, 0, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, d), jnp.float32),
+        ],
+    )
+    # pages are viewed [n_pages, h_kv, page_size, d] → block (1,1,ps,d)
+    out, lse = dist_pallas_call(
+        functools.partial(
+            _paged_flash_decode_kernel,
+            n_chunks=max_pages, page_size=page_size, scale=scale,
+        ),
+        name="paged_flash_decode",
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h_kv, g, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h_kv, g, 1), jnp.float32),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * hq * max_pages * page_size * d,
+            bytes_accessed=(2 * b * h_kv * max_pages * page_size * d)
+            * k_pages.dtype.itemsize,
+            transcendentals=b * hq * max_pages * page_size,
+        ),
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+        uses_barrier=False,
+        interpret=interpret,
+    )(kv_lens.astype(jnp.int32), block_table.astype(jnp.int32), q4, k_pages, v_pages)
+    out = out.reshape(b, hq, d)
+    lse = lse.reshape(b, hq)
+    return (out, lse) if return_lse else out
+
+
+def paged_flash_decode_distributed(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    kv_lens_shard: jax.Array,
+    block_table: jax.Array,
+    *,
+    axis: str = "tp",
+    ag_method: str = "full_mesh_push",
+    interpret: Any = None,
+) -> jax.Array:
+    """SP/CP decode over a paged, sequence-sharded KV cache: each PE holds
+    its own page pool + block table covering its sequence shard (the paged
+    analogue of :func:`flash_decode_distributed`; ≙ the reference SP layer,
+    which is paged end-to-end: sp_flash_decode_layer.py:78)."""
+    out, lse = paged_flash_decode(
+        q, k_pages, v_pages, kv_lens_shard, block_table,
+        return_lse=True, interpret=interpret,
+    )
+    return _sp_allgather_combine(out, lse, axis, ag_method, interpret)
+
+
 def combine_partials(outs: jax.Array, lses: jax.Array) -> jax.Array:
     """Numerically-stable online-softmax merge of partial attention results
     (≙ ``kernel_inter_rank_gqa_fwd_batch_decode_combine_kv``, reference
@@ -203,17 +326,24 @@ def flash_decode_distributed(
     attention → low-latency allgather of the (out ‖ lse) payload → merge.
     Golden: single-device flash decode over the concatenated cache.
     """
-    n = int(jax.lax.axis_size(axis))
     out, lse = flash_decode(
         q, k_shard, v_shard, kv_lens_shard,
         config=config, return_lse=True, interpret=interpret,
     )
+    return _sp_allgather_combine(out, lse, axis, ag_method, interpret)
+
+
+def _sp_allgather_combine(out, lse, axis, ag_method, interpret) -> jax.Array:
+    """Shared SP tail: allgather each PE's (out ‖ lse) payload and merge.
+
+    One flat payload per PE (≙ the staged symm ag_buffer copy,
+    sp_flash_decode_layer.py:134-137): [b*hq, d] out rows, then the b*hq
+    lse scalars packed densely into ceil(b*hq/d) extra rows.
+    """
+    n = int(jax.lax.axis_size(axis))
     if n == 1:
         return out
     b, hq, d = out.shape
-    # One flat payload per PE (≙ the staged symm ag_buffer copy,
-    # sp_flash_decode_layer.py:134-137): [b*hq, d] out rows, then the b*hq
-    # lse scalars packed densely into ceil(b*hq/d) extra rows.
     rows = b * hq
     lse_rows = -(-rows // d)
     lse_packed = jnp.pad(lse.reshape(-1), (0, lse_rows * d - rows)).reshape(lse_rows, d)
@@ -260,3 +390,23 @@ def flash_decode_op(
         P(None, None, None),
         key=("flash_decode", axis, config, s_shard, str(interpret)),
     )(q, k, v, kv_lens.astype(jnp.int32))
+
+
+# KV-chunk tune space (≙ the reference's split-KV block sweep); larger
+# chunks amortize per-grid-step overhead, smaller ones win on short caches.
+FLASH_DECODE_TUNE_SPACE = (
+    FlashDecodeConfig(block_s=512),
+    FlashDecodeConfig(block_s=1024),
+    FlashDecodeConfig(block_s=2048),
+)
+
+
+def _fd_effective_block(cfg, q, k, v, kv_lens, mesh, *, axis="tp", **_):
+    """Configs whose block clamps to the same per-shard chunk are the same
+    kernel — time one (pick_block caps block_s at the local KV length)."""
+    return pick_block(k.shape[2] // mesh.shape[axis], cfg.block_s)
+
+
+flash_decode_op = contextual_autotune(
+    FLASH_DECODE_TUNE_SPACE, name="flash_decode", dedupe=_fd_effective_block
+)(flash_decode_op)

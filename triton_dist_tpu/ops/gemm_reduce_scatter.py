@@ -202,6 +202,7 @@ def gemm_rs(
     config: GemmRSConfig | None = None,
     out_dtype: Any = None,
     interpret: Any = None,
+    devices: Any = None,
 ) -> jax.Array:
     """Overlapped ``psum_scatter(a @ b)`` (call inside ``jax.shard_map``).
 
@@ -235,7 +236,7 @@ def gemm_rs(
     m_loc = m_tot // n
     if method == "auto":
         method = get_auto_reduce_scatter_method(
-            m_loc * n_dim * jnp.dtype(out_dtype).itemsize, n
+            m_loc * n_dim * jnp.dtype(out_dtype).itemsize, n, devices
         )
     # accept the standalone reduce-scatter's method name as an alias
     method = {"scatter_reduce": "scatter"}.get(method, method)
@@ -289,8 +290,11 @@ def gemm_rs_op(
     """Host-level entry (≙ ``gemm_rs_op``, reference
     gemm_reduce_scatter.py:498): `a` sharded on dim 1 (K), `b` sharded on
     dim 0 (K); the reduced result comes back sharded on dim 0 (M)."""
+    from triton_dist_tpu.parallel import topology
+
     fn = functools.partial(
-        gemm_rs, axis=axis, method=method, config=config, interpret=interpret
+        gemm_rs, axis=axis, method=method, config=config, interpret=interpret,
+        devices=topology.axis_devices(mesh, axis),
     )
     return jit_shard_map(
         fn, mesh, (P(None, axis), P(axis, None)), P(axis, None),
@@ -305,8 +309,10 @@ GEMM_RS_TUNE_SPACE = (
     GemmRSConfig(256, 1024, 512),
     GemmRSConfig(512, 1024, 512),
     GemmRSConfig(256, 2048, 512),
-    GemmRSConfig(512, 2048, 1024),
+    GemmRSConfig(512, 2048, 1024),   # swept winner at M=8192 K=14336 N=4096
+    GemmRSConfig(512, 2048, 512),
     GemmRSConfig(1024, 2048, 1024),
+    GemmRSConfig(512, 4096, 2048),
     GemmRSConfig(128, 1024, 512),
 )
 

@@ -47,8 +47,14 @@ class ReduceScatterConfig:
     block_n: int = 1024
 
 
-def get_auto_reduce_scatter_method(chunk_bytes: int, n_pes: int) -> str:
-    if n_pes <= 2 or chunk_bytes <= 256 * 1024 or not topology.has_wraparound(n_pes):
+def get_auto_reduce_scatter_method(
+    chunk_bytes: int, n_pes: int, devices: Any = None
+) -> str:
+    if (
+        n_pes <= 2
+        or chunk_bytes <= 256 * 1024
+        or not topology.has_wraparound(n_pes, devices)
+    ):
         return "scatter_reduce"
     return "ring"
 
@@ -180,6 +186,7 @@ def reduce_scatter(
     method: str = "auto",
     config: ReduceScatterConfig | None = None,
     interpret: Any = None,
+    devices: Any = None,
 ) -> jax.Array:
     """Reduce-scatter along mesh `axis` (call inside ``jax.shard_map``).
 
@@ -207,7 +214,9 @@ def reduce_scatter(
     assert m_total % n == 0, (m_total, n)
     m_loc = m_total // n
     if method == "auto":
-        method = get_auto_reduce_scatter_method(m_loc * n_dim * x.dtype.itemsize, n)
+        method = get_auto_reduce_scatter_method(
+            m_loc * n_dim * x.dtype.itemsize, n, devices
+        )
     n_steps = n - 1
     workspace = [
         jax.ShapeDtypeStruct((n_steps, m_loc, n_dim), x.dtype),  # landing slots
@@ -310,7 +319,8 @@ def reduce_scatter_op(
     if x.ndim not in (2, 3):
         raise ValueError(f"reduce_scatter_op wants [n, m] or [n, m, d]; got {x.shape}")
     fn = functools.partial(
-        reduce_scatter, axis=axis, method=method, config=config, interpret=interpret
+        reduce_scatter, axis=axis, method=method, config=config,
+        interpret=interpret, devices=topology.axis_devices(mesh, axis),
     )
 
     def wrapped(xs):  # xs block: [1, m_total, ...] → this PE's partial

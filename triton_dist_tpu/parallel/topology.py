@@ -60,18 +60,48 @@ def tpu_generation() -> str:
     return "v5e"
 
 
-def has_wraparound(axis_size: int) -> bool:
-    """Whether a mesh axis of this size forms a wrap-around torus ring.
+def has_wraparound(
+    axis_size: int, devices: Sequence[jax.Device] | None = None
+) -> bool:
+    """Whether a mesh axis of this size forms a wrap-around torus ring
+    (≙ reference ``get_has_fullmesh_nvlink``, utils.py:762 — the question
+    that steers collective-method auto-selection).
 
-    TPU slices have wrap-around links when a full torus dimension is used
-    (≥ a full cube edge). Heuristic: on real TPU, yes for sizes >= 4
-    (v4/v5p 3-D torus fills a ring at 4) and trivially for 2 (one link
-    serves both directions); a 3-chip line has no wrap. The interpreter
-    simulates any ring (≙ reference get_has_fullmesh_nvlink, utils.py:762).
+    Decision procedure:
+
+    1. Interpreter/CPU: True (the simulated ring is whatever we say it is).
+    2. ``axis_size`` ≤ 2: trivially True (one link serves both directions).
+    3. With `devices` (the devices along the axis): read their physical
+       ``coords``. A ring exists only if exactly one torus coordinate
+       varies, contiguously. Given that, wrap links exist per generation:
+       v4/v5p build 3-D tori with OCS wrap when a slice dimension is a
+       multiple of 4; v5e/v6e are 2-D meshes whose only wrap is a full
+       16-chip pod edge.
+    4. Without `devices` (or coords unavailable): same per-generation rule
+       applied to ``axis_size`` alone.
     """
-    if tpu_generation() == "cpu":
+    gen = tpu_generation()
+    if gen == "cpu":
         return True
-    return axis_size == 2 or axis_size >= 4
+    if axis_size <= 2:
+        return True
+    span = axis_size
+    if devices is not None:
+        coords = device_coords(devices)
+        if coords is not None:
+            ndim = len(coords[0])
+            varying = [
+                i for i in range(ndim) if len({c[i] for c in coords}) > 1
+            ]
+            if len(varying) != 1:
+                return False  # axis snakes through >1 torus dim: no ring wrap
+            vals = sorted({c[varying[0]] for c in coords})
+            if vals != list(range(vals[0], vals[0] + len(vals))):
+                return False  # non-contiguous placement
+            span = len(vals)
+    if gen in ("v4", "v5p"):
+        return span % 4 == 0
+    return span >= 16  # v5e/v6e: wrap only on a full 2-D pod edge
 
 
 @dataclasses.dataclass(frozen=True)
@@ -83,6 +113,15 @@ class LinkSpec:
 def ici_link(gen: str | None = None) -> LinkSpec:
     g = gen or tpu_generation()
     return LinkSpec(gbps=ICI_GBPS.get(g, 45.0), generation=g)
+
+
+def axis_devices(mesh, axis: str):
+    """The devices along one mesh axis (other axes fixed at index 0) — what
+    :func:`has_wraparound` wants for physical ring detection."""
+    ax = tuple(mesh.axis_names).index(axis)
+    idx: list = [0] * mesh.devices.ndim
+    idx[ax] = slice(None)
+    return list(mesh.devices[tuple(idx)])
 
 
 def device_coords(devices: Sequence[jax.Device] | None = None):

@@ -9,6 +9,7 @@ per-rank seeding becomes ``jax.random`` key folding.
 from __future__ import annotations
 
 import contextlib
+import functools
 import math
 import os
 import time
@@ -131,6 +132,95 @@ def perf_func(fn: Callable[[], Any], iters: int = 10, warmup_iters: int = 3) -> 
     t1 = timed(n1)
     t2 = timed(n2)
     return out, max(t2 - t1, 1e-9) * 1e3 / (n2 - n1)
+
+
+def perf_func_loop(
+    op: Callable[..., Any],
+    args: Sequence[Any],
+    iters: int = 100,
+    trials: int = 3,
+    perturb_idx: int = 0,
+    consume: str = "first",
+) -> float:
+    """On-device loop timing: run `op(*args)` `iters` times inside one jitted
+    ``lax.while_loop`` and return the median per-iteration ms.
+
+    Per-call timing over a tunneled TPU is dominated by per-dispatch RPC
+    cost (hundreds of µs), which buries µs-scale kernels; a device-side loop
+    measures only device time. Each iteration scatter-adds a vanishing
+    multiple of the output into one element of array arg ``perturb_idx`` —
+    a 1-element dynamic-update-slice that aliases the loop carry, chaining
+    iterations so neither XLA nor the scheduler can hoist, CSE, or overlap
+    them.
+
+    `consume` picks how much of the output feeds that chain:
+
+    - ``"first"`` (default) — one element. Correct for SIDE-EFFECTFUL ops
+      (our Pallas kernels): they execute in full regardless, and a bigger
+      dependency would bill them an extra HBM read pass that a pure op
+      gets fused away.
+    - ``"all"`` — a full ``sum`` over every output leaf. REQUIRED for pure
+      XLA ops: anything partial lets dead-code elimination shrink the op to
+      the observed slice (a matmul collapses to one dot-product row). The
+      sum itself is ~free for XLA — it fuses into the producer's epilogue.
+
+    The trip count is a runtime argument (one compile); the loop is timed
+    at two different counts and scored on the delta, so the single launch's
+    constant dispatch/readback cost cancels as well. Non-array args (Mesh,
+    axis names) are closed over; only arrays ride the carry, and
+    `perturb_idx` indexes the *array* args.
+    """
+    args = tuple(args)
+    is_arr = [hasattr(a, "shape") and hasattr(a, "dtype") for a in args]
+    arr_args = tuple(a for a, f in zip(args, is_arr) if f)
+
+    def rebuild(arrs: tuple) -> tuple:
+        it = iter(arrs)
+        return tuple(next(it) if f else a for a, f in zip(args, is_arr))
+
+    def body(state):
+        i, carry = state
+        out = op(*rebuild(carry))
+        leaves = jax.tree.leaves(out)
+        if consume == "all":
+            scalar = sum(jnp.sum(l, dtype=jnp.float32) for l in leaves) * 1e-30
+        else:
+            scalar = leaves[0].ravel()[0].astype(jnp.float32) * 1e-30
+        x = carry[perturb_idx]
+        x = x.at[(0,) * x.ndim].add(scalar.astype(x.dtype))
+        return i + 1, carry[:perturb_idx] + (x,) + carry[perturb_idx + 1 :]
+
+    @jax.jit
+    def run(n, arrs):
+        return jax.lax.while_loop(
+            lambda s: s[0] < n, body, (jnp.int32(0), arrs)
+        )[1]
+
+    n1 = max(1, iters // 4)
+    n2 = n1 + iters
+    _sync(run(jnp.int32(n1), arr_args))  # compile + warm
+    ts = []
+    last_t2 = 1e-9
+    for _ in range(2 * trials):  # re-measure on jitter, up to 2x attempts
+        t0 = time.perf_counter()
+        _sync(run(jnp.int32(n1), arr_args))
+        t1 = time.perf_counter()
+        _sync(run(jnp.int32(n2), arr_args))
+        t2 = time.perf_counter()
+        last_t2 = t2 - t1
+        delta = ((t2 - t1) - (t1 - t0)) * 1e3 / iters
+        # a negative delta is jitter in the constant part exceeding the
+        # measured work — a FAILED sample, never "infinitely fast"
+        if delta > 0:
+            ts.append(delta)
+        if len(ts) == trials:
+            break
+    if not ts:
+        # every delta drowned in jitter: conservative absolute upper bound
+        # (includes the constant launch cost) instead of a nonsense floor
+        return last_t2 * 1e3 / n2
+    ts.sort()
+    return ts[len(ts) // 2]
 
 
 @contextlib.contextmanager

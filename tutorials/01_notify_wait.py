@@ -1,0 +1,63 @@
+"""Tutorial 01 — device-side signal/wait primitives
+(≙ reference ``tutorials/01-distributed-notify-wait.py``: rank r sets a
+flag on rank r+1 and spins on its own; the smallest possible one-sided
+synchronization program).
+
+TPU-native shape of the same idea: a remote put's data-coupled receive
+semaphore IS the notify; ``semaphore_wait`` is the wait (SURVEY.md §7:
+``putmem_signal`` → ``make_async_remote_copy`` + semaphore). Run:
+
+    python tutorials/01_notify_wait.py
+"""
+
+import common  # noqa: F401  (must be first: backend bootstrap)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu.ops.common import dist_pallas_call
+from triton_dist_tpu.shmem import device as shmem
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def ring_notify_kernel(x_ref, out_ref, send_sem, recv_sem, *, axis, n):
+    """Every PE puts its value to its right neighbor, then waits for the
+    left neighbor's arrival — notify/wait over the full ring."""
+    me = shmem.my_pe(axis)
+    shmem.barrier_all(axis)
+    right = jax.lax.rem(me + 1, n)
+    desc = shmem.putmem_nbi_block(out_ref, x_ref, right, axis, send_sem, recv_sem)
+    desc.wait_recv()   # ≙ signal_wait_until: left neighbor's data landed
+    shmem.quiet(desc)  # ≙ quiet: our own put's source is reusable
+
+
+def main():
+    mesh, world = common.bootstrap()
+
+    def fn(x):
+        return dist_pallas_call(
+            lambda x_ref, out_ref, s, r: ring_notify_kernel(
+                x_ref, out_ref, s, r, axis="tp", n=world
+            ),
+            name="tut01_notify_wait",
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+        )(x)
+
+    x = jnp.arange(world * 8, dtype=jnp.float32).reshape(world, 8)
+    got = jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=P("tp", None),
+                      out_specs=P("tp", None), check_vma=False)
+    )(x)
+    want = np.roll(np.asarray(x), 1, axis=0)  # each PE holds left neighbor's row
+    ok = np.array_equal(np.asarray(got), want)
+    common.report("01_notify_wait", ok, f"world={world}")
+
+
+if __name__ == "__main__":
+    main()

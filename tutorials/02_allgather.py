@@ -1,0 +1,37 @@
+"""Tutorial 02 — AllGather kernel family
+(≙ reference ``tutorials/02-intra-node-allgather.py``: push/pull/ring
+producers into symmetric buffers, checked against the NCCL golden).
+
+Here: ring_1d / ring_bidir / full_mesh_push Pallas producers
+(triton_dist_tpu/ops/allgather.py) vs the ``jax.lax.all_gather`` golden,
+plus the auto method selection driven by topology. Run:
+
+    python tutorials/02_allgather.py
+"""
+
+import common  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.ops.allgather import all_gather_op
+
+
+def main():
+    mesh, world = common.bootstrap()
+    m_loc, h = 8, 128  # small: interpreter-friendly payloads
+    x = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(0), (world * m_loc, h), jnp.float32),
+        NamedSharding(mesh, P("tp", None)),
+    )
+    want = np.asarray(x)
+    for method in ("auto", "ring_1d", "ring_bidir", "full_mesh_push"):
+        got = all_gather_op(x, mesh, method=method)
+        ok = np.array_equal(np.asarray(got)[: world * m_loc], want)
+        common.report(f"02_allgather[{method}]", ok, f"world={world}")
+
+
+if __name__ == "__main__":
+    main()

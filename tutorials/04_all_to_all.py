@@ -1,0 +1,44 @@
+"""Tutorial 04 — low-latency All-to-All (EP MoE dispatch transport)
+(≙ reference ``tutorials/04-*all-to-all*``/``low_latency_all_to_all.py``:
+one kernel, each block puts a token slab + splits to its peer, the
+double-buffered symmetric recv versioned by call_count).
+
+TPU-native: padded slabs over remote DMA; the put's data-coupled receive
+semaphore replaces the fence/signal/call_count machinery entirely
+(triton_dist_tpu/ops/all_to_all.py). Run:
+
+    python tutorials/04_all_to_all.py
+"""
+
+import common  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.ops.all_to_all import fast_all_to_all_op
+
+
+def main():
+    mesh, world = common.bootstrap()
+    max_m, hidden = 4, 64
+    key = jax.random.PRNGKey(1)
+    tokens = jax.device_put(
+        jax.random.normal(key, (world, world, max_m, hidden), jnp.float32),
+        NamedSharding(mesh, P("tp", None, None, None)),
+    )
+    splits = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(2), (world, world), 0, max_m + 1, jnp.int32),
+        NamedSharding(mesh, P("tp", None)),
+    )
+    recv, rsplits = fast_all_to_all_op(tokens, splits, mesh)
+    # golden: slab transpose — recv[dst][src] == tokens[src][dst]
+    ok = np.array_equal(
+        np.asarray(recv), np.asarray(tokens).swapaxes(0, 1)
+    ) and np.array_equal(np.asarray(rsplits), np.asarray(splits).T)
+    common.report("04_all_to_all", ok, f"world={world}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Tutorial 05 — distributed GQA flash-decode (sequence parallelism)
+(≙ reference ``tutorials/`` flash-decode + ``sp_flash_decode_layer.py``:
+KV cache sharded over ranks, split-KV attention per rank, LL allgather of
+(out, lse), online-softmax merge).
+
+TPU-native: one online-softmax Pallas pass per shard + full-mesh push
+allgather + the (acc, lse) merge in XLA (triton_dist_tpu/ops/flash_decode.py).
+Run:
+
+    python tutorials/05_sp_flash_decode.py
+"""
+
+import common  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_dist_tpu.ops.flash_decode import FlashDecodeConfig, flash_decode_op
+
+
+def main():
+    mesh, world = common.bootstrap()
+    b, h_kv, g, d = 2, 1, 2, 128
+    s = world * 32
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(kq, (b, h_kv * g, d), jnp.float32)
+    k = jax.random.normal(kk, (b, h_kv, s, d), jnp.float32)
+    v = jax.random.normal(kv, (b, h_kv, s, d), jnp.float32)
+    kv_lens = jnp.array([s, s // 2 + 3], jnp.int32)
+
+    got = flash_decode_op(
+        q, k, v, kv_lens, mesh, config=FlashDecodeConfig(block_s=32)
+    )
+
+    q4 = q.reshape(b, h_kv, g, d).astype(jnp.float32)
+    scores = jnp.einsum("bhgd,bhsd->bhgs", q4, k.astype(jnp.float32))
+    scores /= jnp.sqrt(jnp.float32(d))
+    mask = jnp.arange(s)[None, :] < kv_lens[:, None]
+    scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
+    want = jnp.einsum(
+        "bhgs,bhsd->bhgd", jax.nn.softmax(scores, axis=-1), v.astype(jnp.float32)
+    ).reshape(b, h_kv * g, d)
+    ok = np.allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+    common.report("05_sp_flash_decode", ok, f"world={world} s={s}")
+
+
+if __name__ == "__main__":
+    main()

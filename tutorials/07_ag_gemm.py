@@ -1,0 +1,45 @@
+"""Tutorial 07 — fused AllGather-GEMM (TP column-parallel forward)
+(≙ reference ``tutorials/07-overlapping-allgather-gemm.py``: producer AG on
+comm streams, persistent consumer GEMM spinning on per-tile flags, rank-first
+tile swizzle).
+
+TPU-native: one fused Pallas kernel per PE — ring puts start immediately,
+the MXU pipeline consumes chunks in ARRIVAL order (own shard first, then
+left neighbors' as they land), so compute hides the ICI latency
+(triton_dist_tpu/ops/allgather_gemm.py). Run:
+
+    python tutorials/07_ag_gemm.py
+"""
+
+import common  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm_op
+
+
+def main():
+    mesh, world = common.bootstrap()
+    m_tot, k_dim, n_tot = world * 8, 64, 128
+    ka, kb = jax.random.split(jax.random.PRNGKey(4))
+    a = jax.device_put(
+        jax.random.normal(ka, (m_tot, k_dim), jnp.float32),
+        NamedSharding(mesh, P("tp", None)),
+    )
+    b = jax.device_put(
+        jax.random.normal(kb, (k_dim, n_tot), jnp.float32) / 8,
+        NamedSharding(mesh, P(None, "tp")),
+    )
+    got = ag_gemm_op(a, b, mesh, config=AGGemmConfig(8, 32, 32))
+    want = np.asarray(a, np.float32) @ np.asarray(
+        jax.device_put(b, NamedSharding(mesh, P(None, None))), np.float32
+    )
+    ok = np.allclose(np.asarray(got, np.float32), want, rtol=1e-4, atol=1e-4)
+    common.report("07_ag_gemm", ok, f"world={world} M={m_tot} K={k_dim} N={n_tot}")
+
+
+if __name__ == "__main__":
+    main()

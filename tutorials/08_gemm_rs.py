@@ -1,0 +1,45 @@
+"""Tutorial 08 — fused GEMM-ReduceScatter (TP row-parallel forward)
+(≙ reference ``tutorials/08-overlapping-gemm-reducescatter.py``: producer
+GEMM notifies per-rank tile counters with a rank+1-first swizzle; consumer
+reduce-scatter pipeline drains chunks on high-priority streams).
+
+TPU-native: the swizzle becomes the fused kernel's chunk emission order
+(remote chunks first, own chunk last with the n-way reduce fused into its
+epilogue) and the notify machinery becomes the puts' receive semaphores
+(triton_dist_tpu/ops/gemm_reduce_scatter.py). Run:
+
+    python tutorials/08_gemm_rs.py
+"""
+
+import common  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs_op
+
+
+def main():
+    mesh, world = common.bootstrap()
+    m_tot, k_tot, n_dim = world * 8, world * 16, 128
+    ka, kb = jax.random.split(jax.random.PRNGKey(5))
+    a = jax.device_put(
+        jax.random.normal(ka, (m_tot, k_tot), jnp.float32) / 4,
+        NamedSharding(mesh, P(None, "tp")),
+    )
+    b = jax.device_put(
+        jax.random.normal(kb, (k_tot, n_dim), jnp.float32) / 4,
+        NamedSharding(mesh, P("tp", None)),
+    )
+    got = gemm_rs_op(a, b, mesh, config=GemmRSConfig(8, 32, 16))
+    a_full = np.asarray(jax.device_put(a, NamedSharding(mesh, P(None, None))), np.float32)
+    b_full = np.asarray(jax.device_put(b, NamedSharding(mesh, P(None, None))), np.float32)
+    want = a_full @ b_full
+    ok = np.allclose(np.asarray(got, np.float32), want, rtol=1e-3, atol=1e-3)
+    common.report("08_gemm_rs", ok, f"world={world} M={m_tot} K={k_tot} N={n_dim}")
+
+
+if __name__ == "__main__":
+    main()

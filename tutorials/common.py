@@ -1,0 +1,64 @@
+"""Shared bootstrap for the tutorials (≙ the reference's ``launch.sh`` env
+setup, launch.sh:2-12: every tutorial there is launched under torchrun with
+NVSHMEM bootstrap vars; here the same role is a few lines that pick a
+runnable SPMD environment).
+
+Import this FIRST (before jax touches a backend) — it selects the platform:
+
+- default: an 8-virtual-device CPU mesh + Pallas interpreter mode, so every
+  tutorial runs anywhere (laptop CI included) with full SPMD semantics;
+- ``TDT_TUTORIAL_REAL=1``: use the real accelerator devices as-is (set this
+  on a multi-chip TPU host to watch the same programs ride real ICI).
+
+The platform choice must happen before backend initialization — JAX cannot
+switch platforms afterwards (the same constraint the multichip dryrun
+handles by re-exec'ing into a clean subprocess, __graft_entry__.py).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WORLD = int(os.environ.get("TDT_TUTORIAL_WORLD", "8"))
+REAL = os.environ.get("TDT_TUTORIAL_REAL", "0") == "1"
+
+if not REAL:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={WORLD}"
+    )
+
+import jax  # noqa: E402
+
+if not REAL:
+    jax.config.update("jax_platforms", "cpu")
+
+
+def bootstrap():
+    """Return (mesh, world) and enable interpreter mode on CPU.
+
+    ≙ reference ``initialize_distributed()`` (utils.py:91-117) — on TPU the
+    NCCL+NVSHMEM bootstrap collapses into mesh construction
+    (SURVEY.md §3.1); multi-host would add ``jax.distributed.initialize()``
+    (see triton_dist_tpu.parallel.mesh.initialize_distributed).
+    """
+    import numpy as np
+
+    devs = jax.devices()
+    if devs[0].platform == "cpu":
+        from triton_dist_tpu import config
+
+        config.update(interpret=True)
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devs), ("tp",)), len(devs)
+
+
+def report(name: str, ok: bool, detail: str = "") -> None:
+    status = "OK" if ok else "FAIL"
+    print(f"[tutorial {name}] {status} {detail}")
+    if not ok:
+        raise SystemExit(1)
