@@ -42,13 +42,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from triton_dist_tpu.utils import perf_func_loop
 
 
-def bench_pair(fused, base, args, iters=30, perturb_idx=0):
+def bench_pair(fused, base, args, iters=30, perturb_idx=0, fused_consume="first"):
     """On-device loop timing of two ops over the same args: returns
     (fused_ms, base_ms). The side-effectful fused op needs only a 1-element
     iteration chain; the pure XLA baseline must have its whole output
-    consumed or DCE shrinks it (see perf_func_loop's consume)."""
+    consumed or DCE shrinks it (see perf_func_loop's consume). When the
+    BASELINE's final op is a collective (its sum can't fuse into a GEMM
+    epilogue), pass fused_consume="all" so both sides pay the same read."""
     t_f = perf_func_loop(
-        fused, args, iters=iters, perturb_idx=perturb_idx, consume="first"
+        fused, args, iters=iters, perturb_idx=perturb_idx, consume=fused_consume
     )
     t_b = perf_func_loop(
         base, args, iters=iters, perturb_idx=perturb_idx, consume="all"
@@ -103,7 +105,12 @@ def bench_gemm_rs(mesh, n):
         np.asarray(out[:64], np.float32), np.asarray(ref[:64], np.float32),
         atol=4.0, rtol=4e-2,
     )
-    t_f, t_b = bench_pair(fused, unfused, (a, b), iters=40)
+    # n>1: the baseline ends in a reduce-scatter collective, so its
+    # consumption sum cannot fuse — match the fused side's consumption
+    t_f, t_b = bench_pair(
+        fused, unfused, (a, b), iters=40,
+        fused_consume="first" if n == 1 else "all",
+    )
     tflops = 2.0 * m_tot * k_tot * n_dim / (t_f * 1e-3) / 1e12 / n
     emit(
         f"gemm_rs_bf16_tflops_per_chip_tp{n}_m{m_tot}k{k_tot}n{n_dim}",

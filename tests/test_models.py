@@ -1,0 +1,169 @@
+"""Flagship TP transformer vs an unsharded jnp golden (forward parity,
+vocab-parallel loss parity, gradient flow through the fused kernels)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.models import (
+    TPTransformer,
+    TransformerConfig,
+    init_params,
+    param_specs,
+    train_step,
+)
+from triton_dist_tpu.models.tp_transformer import (
+    _causal_gqa_attention,
+    rmsnorm,
+    rope,
+)
+from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig
+from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab=64, hidden=32, ffn=64, n_layers=2, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=2, seq=16,
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _ref_forward(tokens, params, cfg):
+    """Unsharded pure-jnp forward with the same params/layout."""
+    x = params["embed"][tokens.reshape(-1)]
+    b, s = cfg.batch, cfg.seq
+    g = cfg.n_q_heads // cfg.n_kv_heads
+    d = cfg.head_dim
+    for p in params["layers"]:
+        h = rmsnorm(x, p["attn_norm"], cfg.norm_eps)
+        # kv-group-major qkv layout (see init_params)
+        qkv = (h @ p["wqkv"].reshape(cfg.hidden, -1)).reshape(
+            b, s, cfg.n_kv_heads, g + 2, d
+        )
+        q = qkv[..., :g, :].reshape(b, s, cfg.n_q_heads, d)
+        k = qkv[..., g, :]
+        v = qkv[..., g + 1, :]
+        pos = jnp.arange(s, dtype=jnp.int32)
+        q, k = rope(q, pos, cfg.rope_theta), rope(k, pos, cfg.rope_theta)
+        attn = _causal_gqa_attention(q, k, v, cfg)
+        x = x + attn.reshape(b * s, cfg.q_dim) @ p["wo"]
+        h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
+        gu = (h @ p["w_gate_up"].reshape(cfg.hidden, -1)).reshape(b * s, -1, 2)
+        gate, up = gu[..., 0], gu[..., 1]
+        x = x + (jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up) @ p["w_down"]
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    return x @ params["lm_head"]
+
+
+def _ref_loss(tokens, targets, params, cfg):
+    logits = _ref_forward(tokens, params, cfg).astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tl = jnp.take_along_axis(logits, targets[:, None], axis=1)[:, 0]
+    return jnp.mean(lse - tl)
+
+
+def _put_params(params, cfg, mesh):
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, param_specs(cfg),
+    )
+
+
+def test_tp_transformer_forward_parity(mesh4):
+    cfg = _cfg()
+    model = TPTransformer(cfg)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (cfg.batch * cfg.seq,), 0, cfg.vocab, jnp.int32
+    )
+    params_sh = _put_params(params, cfg, mesh4)
+    got = jax.jit(
+        jax.shard_map(
+            lambda t, p: model(t, p), mesh=mesh4,
+            in_specs=(P("tp"), param_specs(cfg)),
+            out_specs=P(None, "tp"), check_vma=False,
+        )
+    )(tokens, params_sh)
+    want = _ref_forward(tokens, params, cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3)
+
+
+def test_tp_transformer_loss_parity(mesh4):
+    cfg = _cfg()
+    model = TPTransformer(cfg)
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    m = cfg.batch * cfg.seq
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (m,), 0, cfg.vocab, jnp.int32)
+    targets = jax.random.randint(jax.random.PRNGKey(4), (m,), 0, cfg.vocab, jnp.int32)
+    params_sh = _put_params(params, cfg, mesh4)
+    got = jax.jit(
+        jax.shard_map(
+            lambda t, y, p: model.loss(t, y, p)[None], mesh=mesh4,
+            in_specs=(P("tp"), P(None), param_specs(cfg)),
+            out_specs=P("tp"), check_vma=False,
+        )
+    )(tokens, targets, params_sh)
+    want = float(_ref_loss(tokens, targets, params, cfg))
+    # every tp shard computes the identical full-batch loss
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+def test_tp_transformer_train_step_dp_tp(mesh2x4):
+    """Full dp(2) x tp(4) training step: loss decreases and sharded/
+    replicated grads are consistent with the unsharded reference step."""
+    cfg = _cfg()
+    model = TPTransformer(cfg)
+    params = init_params(jax.random.PRNGKey(5), cfg)
+    m = cfg.batch * cfg.seq
+    dp = 2
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (dp * m,), 0, cfg.vocab, jnp.int32)
+    targets = jax.random.randint(jax.random.PRNGKey(7), (dp * m,), 0, cfg.vocab, jnp.int32)
+
+    specs = param_specs(cfg)
+    params_sh = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh2x4, s)), params, specs
+    )
+
+    def step(t, y, p):
+        # t sharded over (dp, tp); y sharded over dp (replicated in tp)
+        return train_step(model, p, t, y.reshape(-1), lr=1e-1)
+
+    step_j = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh2x4,
+            in_specs=(P(("dp", "tp")), P("dp"), specs),
+            out_specs=(specs, P()), check_vma=False,
+        )
+    )
+    p1, loss1 = step_j(tokens, targets, params_sh)
+    p2, loss2 = step_j(tokens, targets, p1)
+    assert float(loss2) < float(loss1)
+
+    # reference step on the dp=0 half must match the dp-mean direction only
+    # loosely (different batch); instead check exact grad parity for one
+    # replicated param via the unsharded loss on the full batch
+    def full_loss(p):
+        l = 0.0
+        for i in range(dp):
+            l = l + _ref_loss(
+                tokens[i * m : (i + 1) * m], targets[i * m : (i + 1) * m], p, cfg
+            )
+        return l / dp
+
+    g_ref = jax.grad(full_loss)(params)
+    for name in ("final_norm", "embed"):  # replicated params: exact parity
+        got_after = np.asarray(p1[name])
+        want_after = np.asarray(params[name]) - 1e-1 * np.asarray(g_ref[name])
+        np.testing.assert_allclose(
+            got_after, want_after, rtol=2e-3, atol=2e-3, err_msg=name
+        )
+
+
+def test_models_package_imports():
+    import triton_dist_tpu.models as m
+
+    assert hasattr(m, "TPTransformer") and hasattr(m, "train_step")

@@ -26,6 +26,7 @@ specializations, each pre-lowered for its signature.
 from __future__ import annotations
 
 import functools
+import hashlib
 import os
 import pickle
 from typing import Any, Callable, Mapping, Sequence
@@ -65,16 +66,39 @@ def save_compiled(fn: Callable, example_args: Sequence[Any], path: str, **jit_kw
 
     compiled = aot_compile(fn, *example_args, **jit_kwargs)
     payload = serialize_executable.serialize(compiled)
+    blob = pickle.dumps(payload)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "wb") as f:
-        pickle.dump(payload, f)
+        f.write(_AOT_MAGIC)
+        f.write(hashlib.sha256(blob).digest())
+        f.write(blob)
+
+
+_AOT_MAGIC = b"TDTAOT1\x00"
 
 
 def load_compiled(path: str) -> Callable:
+    """Load a compiled-executable artifact written by :func:`save_compiled`.
+
+    The payload is a pickle (what jax's serialize_executable produces), so
+    loading one is code execution by construction — artifacts must come from
+    a TRUSTED cache. The sha256 in the header rejects truncated/corrupted
+    files and casual tampering before any byte reaches the unpickler; it is
+    an integrity check, not a signature — do not load artifacts from
+    untrusted sources."""
     from jax.experimental import serialize_executable
 
     with open(path, "rb") as f:
-        payload = pickle.load(f)
+        magic = f.read(len(_AOT_MAGIC))
+        if magic != _AOT_MAGIC:
+            raise ValueError(
+                f"{path}: not a triton_dist_tpu AOT artifact (bad magic)"
+            )
+        digest = f.read(32)
+        blob = f.read()
+    if hashlib.sha256(blob).digest() != digest:
+        raise ValueError(f"{path}: AOT artifact failed integrity check")
+    payload = pickle.loads(blob)
     return serialize_executable.deserialize_and_load(*payload)
 
 

@@ -75,6 +75,18 @@ def _patch_interpreter_scheduler() -> None:
         return
     _interp_scheduler_patched = True
     try:
+        import jax as _jax
+
+        # The body below is a copy of jax 0.9.x internals with one changed
+        # branch; on any other jax line, fall through to the warning (the
+        # copied scheduler could silently diverge from upstream semantics).
+        if not _jax.__version__.startswith("0.9."):
+            raise RuntimeError(
+                f"interpreter-scheduler patch was written against jax 0.9.x "
+                f"internals; running {_jax.__version__} — refusing to apply "
+                f"a stale copy (re-diff jax._src.pallas.mosaic.interpret."
+                f"shared_memory.Semaphore.wait and update config.py)"
+            )
         import time as _time
 
         _debug_wait = bool(int(os.environ.get("TDT_DEBUG_WAIT", "0")))

@@ -1,0 +1,283 @@
+"""Megatron-style TP transformer on the fused distributed kernels.
+
+Parallel layout (the classic column→row scheme the reference's AG-GEMM /
+GEMM-RS kernels exist to serve — its perf suite literally sweeps LLaMA/Qwen
+projection shapes, test_ag_gemm.py:149-156):
+
+- The residual stream is TOKEN-SHARDED over the ``tp`` axis
+  (sequence-parallel Megatron): each PE holds ``[m_loc, H]`` where
+  ``m_loc = B*S / tp``.
+- Column-parallel projections (QKV, gate/up, LM head) are fused AG-GEMMs:
+  the all-gather of the token shard overlaps the MXU ride through
+  ``ag_gemm_grad`` (differentiable, backward = fused GEMM-RS).
+- Row-parallel projections (attention out, MLP down) are fused GEMM-RS:
+  partial products reduce-scatter back to the token shard.
+- Attention runs on LOCAL heads over the full (gathered) sequence —
+  GQA + RoPE, causal. Long-context prefill can swap in
+  ``ops.ring_attention``; decode serves from ``ops.flash_decode``.
+- The loss is vocab-parallel cross-entropy: logits stay ``[m, V/tp]``
+  sharded, the log-sum-exp and target-logit reductions ride ``psum``/
+  ``pmax`` — no PE ever materializes the full logit matrix.
+
+Everything here is called INSIDE ``jax.shard_map`` (see
+:func:`train_step` / ``__graft_entry__.dryrun_multichip`` for the jit
+plumbing); data parallelism is an outer mesh axis that only the gradient
+``pmean`` sees.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig
+from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig
+from triton_dist_tpu.ops.grads import ag_gemm_grad, gemm_rs_grad
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """LLaMA-class decoder config (≙ the reference's model-shape tables)."""
+
+    vocab: int = 256
+    hidden: int = 128
+    ffn: int = 256
+    n_layers: int = 2
+    n_q_heads: int = 4
+    n_kv_heads: int = 2
+    head_dim: int = 32
+    batch: int = 2
+    seq: int = 32
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    axis: str = "tp"
+    dtype: Any = jnp.float32
+    ag_config: AGGemmConfig | None = None
+    rs_config: GemmRSConfig | None = None
+    interpret: Any = None
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_q_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+    @property
+    def qkv_dim(self) -> int:
+        return self.q_dim + 2 * self.kv_dim
+
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
+    """Unsharded parameter pytree; pair with :func:`param_specs` +
+    ``jax.device_put`` to lay it out over the mesh."""
+    n_mats = cfg.n_layers * 4 + 2
+    keys = iter(jax.random.split(key, n_mats))
+
+    def w(shape, scale):
+        return (jax.random.normal(next(keys), shape) * scale).astype(cfg.dtype)
+
+    h, f = cfg.hidden, cfg.ffn
+    g = cfg.n_q_heads // cfg.n_kv_heads
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append(
+            dict(
+                attn_norm=jnp.ones((h,), cfg.dtype),
+                # QKV stored KV-GROUP-MAJOR: [H, n_kv_heads, (g+2)*d] — each
+                # group's g query heads, its K head, its V head, contiguous.
+                # Column-sharding a flat [H, q|k|v] concat would hand one PE
+                # only K columns; group-major makes every tp shard a whole
+                # set of attention groups (Megatron's interleaved QKV).
+                wqkv=w((h, cfg.n_kv_heads, (g + 2) * cfg.head_dim), h**-0.5),
+                # wo rows in the same group-major q-head order
+                wo=w((cfg.q_dim, h), cfg.q_dim**-0.5),
+                mlp_norm=jnp.ones((h,), cfg.dtype),
+                # gate/up interleaved PER FFN UNIT: [H, F, 2] — sharding F
+                # gives every PE matched gate+up columns
+                w_gate_up=w((h, f, 2), h**-0.5),
+                w_down=w((f, h), f**-0.5),
+            )
+        )
+    return dict(
+        embed=w((cfg.vocab, h), 0.02),
+        layers=layers,
+        final_norm=jnp.ones((h,), cfg.dtype),
+        lm_head=w((h, cfg.vocab), h**-0.5),
+    )
+
+
+def param_specs(cfg: TransformerConfig) -> dict:
+    """PartitionSpecs matching :func:`init_params`: column-parallel weights
+    shard dim 1, row-parallel weights shard dim 0, norms/embed replicate."""
+    t = cfg.axis
+    layer = dict(
+        attn_norm=P(None),
+        wqkv=P(None, t, None),       # kv groups sharded
+        wo=P(t, None),               # row-parallel
+        mlp_norm=P(None),
+        w_gate_up=P(None, t, None),  # ffn units sharded
+        w_down=P(t, None),           # row-parallel
+    )
+    return dict(
+        embed=P(None, None),
+        layers=[dict(layer) for _ in range(cfg.n_layers)],
+        final_norm=P(None),
+        lm_head=P(None, t),    # vocab-parallel
+    )
+
+
+def rmsnorm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * r).astype(x.dtype) * scale
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding; x ``[..., s, n_heads, d]``, positions ``[s]``."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, jnp.float32) / d)
+    ang = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [s, d/2]
+    cos = jnp.cos(ang)[:, None, :]
+    sin = jnp.sin(ang)[:, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _causal_gqa_attention(q, k, v, cfg: TransformerConfig) -> jax.Array:
+    """Local-head causal GQA over the full sequence; q ``[b, s, hq_loc, d]``,
+    k/v ``[b, s, hkv_loc, d]``. Plain XLA — after the AG-GEMM gathered the
+    sequence, attention is embarrassingly head-parallel and XLA fuses the
+    softmax chain; swap in ops.ring_attention for seq-sharded long context."""
+    b, s, hq_loc, d = q.shape
+    hkv_loc = k.shape[2]
+    g = hq_loc // hkv_loc
+    qg = q.reshape(b, s, hkv_loc, g, d)
+    scores = jnp.einsum(
+        "bshgd,bthd->bhgst", qg.astype(jnp.float32), k.astype(jnp.float32)
+    ) / jnp.sqrt(jnp.float32(d))
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgst,bthd->bshgd", p, v.astype(jnp.float32))
+    return out.reshape(b, s, hq_loc * d).astype(q.dtype)
+
+
+@dataclasses.dataclass
+class TPTransformer:
+    """Decoder-only forward; call INSIDE shard_map with the token stream
+    sharded ``[m_loc]`` over ``cfg.axis`` (flattened ``B*S``)."""
+
+    cfg: TransformerConfig
+
+    def _col(self, x, w):
+        """Fused column-parallel projection: [m_loc, H] -> [m_tot, N/n]."""
+        c = self.cfg
+        return ag_gemm_grad(x, w, c.axis, c.ag_config, c.rs_config, c.interpret)
+
+    def _row(self, x, w):
+        """Fused row-parallel projection: [m_tot, N/n] -> [m_loc, H]."""
+        c = self.cfg
+        return gemm_rs_grad(x, w, c.axis, c.rs_config, c.ag_config, c.interpret)
+
+    def block(self, x: jax.Array, p: dict) -> jax.Array:
+        c = self.cfg
+        n = int(jax.lax.axis_size(c.axis))
+        b, s = c.batch, c.seq
+        hq_loc = c.n_q_heads // n
+        hkv_loc = c.n_kv_heads // n
+
+        g = c.n_q_heads // c.n_kv_heads
+        d = c.head_dim
+
+        # --- attention ---
+        h = rmsnorm(x, p["attn_norm"], c.norm_eps)
+        qkv = self._col(h, p["wqkv"].reshape(c.hidden, -1))
+        qkv = qkv.reshape(b, s, hkv_loc, g + 2, d)  # local kv groups
+        q = qkv[..., :g, :].reshape(b, s, hq_loc, d)
+        k = qkv[..., g, :]
+        v = qkv[..., g + 1, :]
+        pos = jnp.arange(s, dtype=jnp.int32)
+        q = rope(q, pos, c.rope_theta)
+        k = rope(k, pos, c.rope_theta)
+        attn = _causal_gqa_attention(q, k, v, c)   # [b, s, q_dim/n]
+        x = x + self._row(attn.reshape(b * s, hq_loc * d), p["wo"])
+
+        # --- MLP (SwiGLU) ---
+        h = rmsnorm(x, p["mlp_norm"], c.norm_eps)
+        gu = self._col(h, p["w_gate_up"].reshape(c.hidden, -1))
+        gu = gu.reshape(b * s, -1, 2)              # [m, F/n, 2]
+        gate, up = gu[..., 0], gu[..., 1]
+        act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+        return x + self._row(act, p["w_down"])
+
+    def __call__(self, tokens_loc: jax.Array, params: dict) -> jax.Array:
+        """tokens_loc ``[m_loc]`` int32 → vocab-sharded logits
+        ``[m_tot, V/n]``."""
+        c = self.cfg
+        x = params["embed"][tokens_loc]            # [m_loc, H]
+        for p in params["layers"]:
+            x = self.block(x, p)
+        x = rmsnorm(x, params["final_norm"], c.norm_eps)
+        return self._col(x, params["lm_head"])     # [m_tot, V/n]
+
+    def loss(self, tokens_loc, targets, params) -> jax.Array:
+        """Vocab-parallel cross-entropy (no PE sees the full logits):
+        ``lse`` and the target logit are assembled with psum/pmax over the
+        vocab shards. targets: ``[m_tot]`` int32 (full, replicated)."""
+        c = self.cfg
+        n = int(jax.lax.axis_size(c.axis))
+        me = jax.lax.axis_index(c.axis)
+        v_loc = c.vocab // n
+        logits = self(tokens_loc, params).astype(jnp.float32)  # [m, V/n]
+        # the max is a numerical-stability shift whose gradient cancels in
+        # the CE algebra; stop_gradient removes it from the backward pass
+        # (and pmax has no differentiation rule anyway — ride all_gather)
+        m_sh = jax.lax.stop_gradient(
+            jnp.max(jax.lax.all_gather(jnp.max(logits, -1), c.axis), 0)  # [m]
+        )
+        se = jax.lax.psum(jnp.sum(jnp.exp(logits - m_sh[:, None]), -1), c.axis)
+        lse = m_sh + jnp.log(se)
+        local = targets - me * v_loc
+        in_shard = (local >= 0) & (local < v_loc)
+        tl = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, v_loc - 1)[:, None], axis=1
+        )[:, 0]
+        target_logit = jax.lax.psum(jnp.where(in_shard, tl, 0.0), c.axis)
+        return jnp.mean(lse - target_logit)
+
+
+def train_step(model: TPTransformer, params, tokens_loc, targets, lr=1e-2):
+    """One SGD step (call inside shard_map over a ``(dp, tp)`` mesh).
+
+    Gradient accounting (verified against the unsharded reference in
+    tests/test_models.py): the per-PE loss is tp-replicated, so
+    differentiating inside shard_map effectively differentiates the SUM of
+    tp identical losses — every gradient comes back scaled by tp.
+    Tensor-parallel params receive that scaled-but-complete gradient
+    through the fused kernels' VJPs (each shard participates in every PE's
+    loss via the collectives); REPLICATED params (embed, norms) accumulate
+    only the paths through this PE's token shard and need a tp-psum.
+    Hence: psum replicated grads, divide everything by tp, pmean over dp."""
+    c = model.cfg
+    tp = int(jax.lax.axis_size(c.axis))
+    loss, grads = jax.value_and_grad(
+        lambda p: model.loss(tokens_loc, targets, p)
+    )(params)
+    loss = jax.lax.pmean(loss, "dp")
+    specs = param_specs(c)
+
+    def fix(g, spec):
+        if c.axis not in tuple(spec):
+            g = jax.lax.psum(g, c.axis)
+        return jax.lax.pmean(g, "dp") / tp
+
+    grads = jax.tree.map(fix, grads, specs)
+    params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+    return params, loss

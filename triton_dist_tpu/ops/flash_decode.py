@@ -129,7 +129,9 @@ def flash_decode(
     sc = pick_block(s_len, cfg.block_s)
     n_chunks = s_len // sc
     scale = 1.0 / math.sqrt(d)
-    q4 = q.reshape(b, h_kv, g, d)
+    # the kernel's matmuls run in the cache dtype (bf16 MXU fast path);
+    # mixed-precision callers get their q silently matched to the cache
+    q4 = q.reshape(b, h_kv, g, d).astype(k.dtype)
     grid = (b, h_kv, n_chunks)
     out, lse = dist_pallas_call(
         functools.partial(
@@ -218,7 +220,8 @@ def paged_flash_decode(
     g = hq // h_kv
     max_pages = block_table.shape[1]
     scale = 1.0 / math.sqrt(d)
-    q4 = q.reshape(b, h_kv, g, d)
+    # match q to the page-pool dtype (same contract as flash_decode)
+    q4 = q.reshape(b, h_kv, g, d).astype(k_pages.dtype)
 
     def kv_index_map(i, j, c, kv_lens_ref, bt_ref):
         return (bt_ref[i, c], j, 0, 0)
