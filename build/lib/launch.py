@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Multi-host launcher (≙ reference ``launch.sh``/``launch_amd.sh``: torchrun
+wrappers that export the bootstrap env before running a test/tutorial).
+
+On TPU pods the per-host bootstrap is ``jax.distributed.initialize``, driven
+by three env vars; this launcher sets them from flags and execs the target
+script identically on every host:
+
+    # host 0 (also the coordinator):
+    python launch.py --coordinator 10.0.0.1:8476 --num-hosts 4 --host-id 0 \\
+        tutorials/07_ag_gemm.py
+    # host k:
+    python launch.py --coordinator 10.0.0.1:8476 --num-hosts 4 --host-id K \\
+        tutorials/07_ag_gemm.py
+
+On Cloud TPU the three flags can be omitted entirely — jax.distributed
+auto-discovers the pod topology from the TPU metadata server — so
+``python launch.py script.py`` is also valid on every host of a pod slice.
+The launched script calls
+``triton_dist_tpu.parallel.initialize_distributed()`` (which reads these
+vars) before touching any device, exactly as every reference test calls
+``initialize_distributed()`` first.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--coordinator", help="host:port of process 0 (COORDINATOR_ADDRESS)")
+    ap.add_argument("--num-hosts", type=int, help="total number of host processes")
+    ap.add_argument("--host-id", type=int, help="this process's id (0-based)")
+    ap.add_argument("script", help="python script to run")
+    ap.add_argument("args", nargs=argparse.REMAINDER, help="script arguments")
+    ns = ap.parse_args()
+
+    if ns.coordinator:
+        os.environ["COORDINATOR_ADDRESS"] = ns.coordinator
+    if ns.num_hosts is not None:
+        os.environ["NUM_PROCESSES"] = str(ns.num_hosts)
+    if ns.host_id is not None:
+        os.environ["PROCESS_ID"] = str(ns.host_id)
+
+    sys.argv = [ns.script] + ns.args
+    sys.path.insert(0, os.path.dirname(os.path.abspath(ns.script)))
+    runpy.run_path(ns.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""triton_dist_tpu — a TPU-native compute/communication-overlap framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+Triton-distributed (ByteDance Seed's distributed-kernel compiler for GPUs):
+one-sided remote memory operations, signal/wait synchronization, and a zoo of
+fused compute+communication kernels (AG-GEMM, GEMM-RS, MoE all-to-all,
+distributed flash-decode) — all expressed TPU-first:
+
+- The NVSHMEM symmetric heap maps to SPMD-symmetric Pallas buffers under
+  ``jax.shard_map`` over a ``jax.sharding.Mesh``.
+- ``putmem_nbi_block`` / ``putmem_signal`` / ``signal_wait_until`` map to
+  ``pltpu.make_async_remote_copy`` over ICI and TPU hardware semaphores
+  (see ``triton_dist_tpu.shmem.device``).
+- Producer/consumer CUDA streams map to in-flight async DMAs inside a single
+  fused Pallas kernel that keeps the MXU busy while chunks arrive.
+
+Layer map (mirrors SURVEY.md §1 of the reference):
+  shmem/    — L3-L5: device-side SHMEM library + host symmetric buffers
+  ops/      — L6:   the kernel zoo (the product)
+  layers/   — L7:   module-level wrappers
+  models/   —       flagship TP/SP/EP transformer models (beyond reference)
+  parallel/ —       mesh/bootstrap/topology (≙ reference utils.py bootstrap)
+  autotuner —  L8, profiler/aot — aux subsystems
+"""
+
+__version__ = "0.1.0"
+
+from triton_dist_tpu import config as config
+from triton_dist_tpu.parallel.mesh import (
+    initialize_distributed,
+    get_default_context,
+    DistContext,
+)
+from triton_dist_tpu import shmem as shmem
+from triton_dist_tpu import ops as ops
+from triton_dist_tpu import utils as utils
+from triton_dist_tpu import layers as layers
+from triton_dist_tpu import aot as aot
+from triton_dist_tpu import perf_model as perf_model
+from triton_dist_tpu.autotuner import contextual_autotune

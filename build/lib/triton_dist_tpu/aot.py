@@ -1,0 +1,137 @@
+"""AOT compilation + serialized executables
+(≙ reference AOT toolchain: ``tools/compile_aot.py`` (865 LoC),
+``tools/compile/compile.py`` (259 LoC), ``tools/runtime/triton_aot_runtime.cc``
+(313 C++) and the ``@aot_compile_spaces`` decorator).
+
+The reference pre-compiles Triton kernels to cubins, generates C wrappers +
+an algo-dispatch table, and ships a CUDA-driver-API loader. Under XLA the
+whole toolchain collapses (SURVEY.md §7 design table): ``jax.jit(...)
+.lower().compile()`` is the AOT compile, the serialized artifact replaces
+the cubin+C-source bundle, and PJRT's loader replaces the C++ runtime —
+so this module is thin by design, not by omission.
+
+Two artifact flavors:
+
+- **Portable export** (`save_exported` / `load_exported`): StableHLO via
+  ``jax.export`` — survives jax/runtime upgrades, recompiles on load.
+- **Compiled executable** (`aot_compile` + `save_compiled`/`load_compiled`):
+  ``jax.jit(fn).lower(*args).compile()`` serialized with
+  ``jax.experimental.serialize_executable`` — zero-compile load on the
+  same topology+version (what the reference's cubin cache achieves).
+
+``aot_compile_spaces`` mirrors the reference decorator: a dict of named
+specializations, each pre-lowered for its signature.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import os
+import pickle
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+
+
+def aot_compile(fn: Callable, *example_args: Any, **jit_kwargs: Any):
+    """jit + lower + compile for the example signature. Returns the compiled
+    executable (callable with arrays matching the signature)."""
+    return jax.jit(fn, **jit_kwargs).lower(*example_args).compile()
+
+
+# -- portable StableHLO artifacts -------------------------------------------
+
+def save_exported(fn: Callable, example_args: Sequence[Any], path: str, **jit_kwargs: Any) -> None:
+    """Serialize `fn` as portable StableHLO (recompiles on load)."""
+    exported = jax.export.export(jax.jit(fn, **jit_kwargs))(*example_args)
+    data = exported.serialize()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def load_exported(path: str) -> Callable:
+    with open(path, "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    return jax.jit(exported.call)
+
+
+# -- same-topology compiled executables -------------------------------------
+
+def save_compiled(fn: Callable, example_args: Sequence[Any], path: str, **jit_kwargs: Any) -> None:
+    """Serialize a fully-compiled executable (zero-compile reload on the
+    same jax version + device topology; ≙ the reference's cubin bundle)."""
+    from jax.experimental import serialize_executable
+
+    compiled = aot_compile(fn, *example_args, **jit_kwargs)
+    payload = serialize_executable.serialize(compiled)
+    blob = pickle.dumps(payload)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_AOT_MAGIC)
+        f.write(hashlib.sha256(blob).digest())
+        f.write(blob)
+
+
+_AOT_MAGIC = b"TDTAOT1\x00"
+
+
+def load_compiled(path: str) -> Callable:
+    """Load a compiled-executable artifact written by :func:`save_compiled`.
+
+    The payload is a pickle (what jax's serialize_executable produces), so
+    loading one is code execution by construction — artifacts must come from
+    a TRUSTED cache. The sha256 in the header rejects truncated/corrupted
+    files and casual tampering before any byte reaches the unpickler; it is
+    an integrity check, not a signature — do not load artifacts from
+    untrusted sources."""
+    from jax.experimental import serialize_executable
+
+    with open(path, "rb") as f:
+        magic = f.read(len(_AOT_MAGIC))
+        if magic != _AOT_MAGIC:
+            raise ValueError(
+                f"{path}: not a triton_dist_tpu AOT artifact (bad magic)"
+            )
+        digest = f.read(32)
+        blob = f.read()
+    if hashlib.sha256(blob).digest() != digest:
+        raise ValueError(f"{path}: AOT artifact failed integrity check")
+    payload = pickle.loads(blob)
+    return serialize_executable.deserialize_and_load(*payload)
+
+
+# -- specialization spaces ---------------------------------------------------
+
+def aot_compile_spaces(spaces: Mapping[str, Mapping[str, Any]]) -> Callable:
+    """Decorator registering named AOT specializations
+    (≙ ``@aot_compile_spaces``, reference tools/compile_aot.py:61-77: a dict
+    of {name: {signature, grid, triton_algo_infos}} per kernel).
+
+    Here a space is ``{name: {"example_args": tuple, "jit_kwargs": dict}}``.
+    The wrapped fn gains ``.aot(name)`` — returning the (lazily compiled,
+    cached) executable for that space — and ``.aot_compile_all()``.
+    """
+
+    def deco(fn: Callable) -> Callable:
+        compiled: dict[str, Any] = {}
+
+        def get(name: str):
+            if name not in compiled:
+                spec = spaces[name]
+                compiled[name] = aot_compile(
+                    fn, *spec["example_args"], **spec.get("jit_kwargs", {})
+                )
+            return compiled[name]
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return fn(*args, **kwargs)
+
+        wrapped.aot = get
+        wrapped.aot_spaces = dict(spaces)
+        wrapped.aot_compile_all = lambda: [get(k) for k in spaces]
+        return wrapped
+
+    return deco

@@ -1,0 +1,67 @@
+// Host-side MoE token alignment — native C++ component
+// (≙ reference csrc/lib/moe_utils.cu:36-356 `moe_ag_scatter_align_block_size`:
+// token→expert sort/pad with histogram+cumsum; there a CUDA kernel because
+// the data lives on GPU, here a host routine because on TPU the device-side
+// path is the XLA sort in triton_dist_tpu/ops/moe_utils.py and the host
+// path serves CPU-side pre-processing, e.g. preparing the next batch's
+// alignment while the device computes).
+//
+// Exposed via ctypes (triton_dist_tpu/csrc_ops.py). Build: `make -C csrc`.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// Returns 0 on success, negative on error.
+//   topk_ids:        [t] expert id per flattened (token, k) assignment
+//   sorted_token_ids:[t_pad] out; assignment index per padded row, sentinel=t
+//   expert_ids:      [t_pad / block_m] out; owning expert per row-block
+//   num_tokens_post_pad: out; valid padded rows
+int tdt_moe_align_block_size(const int32_t* topk_ids, int64_t t,
+                             int32_t n_experts, int32_t block_m,
+                             int64_t t_pad, int32_t* sorted_token_ids,
+                             int32_t* expert_ids,
+                             int32_t* num_tokens_post_pad) {
+  if (t < 0 || n_experts <= 0 || block_m <= 0 || t_pad % block_m != 0)
+    return -1;
+  const int64_t n_blocks = t_pad / block_m;
+
+  std::vector<int64_t> counts(n_experts, 0);
+  for (int64_t i = 0; i < t; ++i) {
+    const int32_t e = topk_ids[i];
+    if (e < 0 || e >= n_experts) return -2;
+    counts[e]++;
+  }
+
+  std::vector<int64_t> padded(n_experts), seg_start(n_experts);
+  int64_t total = 0;
+  for (int32_t e = 0; e < n_experts; ++e) {
+    padded[e] = (counts[e] + block_m - 1) / block_m * block_m;
+    seg_start[e] = total;
+    total += padded[e];
+  }
+  if (total > t_pad) return -3;
+
+  for (int64_t r = 0; r < t_pad; ++r) sorted_token_ids[r] = (int32_t)t;
+  // stable counting sort: original order preserved within an expert
+  std::vector<int64_t> cursor(seg_start);
+  for (int64_t i = 0; i < t; ++i)
+    sorted_token_ids[cursor[topk_ids[i]]++] = (int32_t)i;
+
+  for (int64_t b = 0; b < n_blocks; ++b) {
+    const int64_t row = b * block_m;
+    int32_t e = n_experts - 1;
+    for (int32_t j = 0; j < n_experts; ++j)
+      if (row < seg_start[j] + padded[j]) { e = j; break; }
+    expert_ids[b] = e;
+  }
+  *num_tokens_post_pad = (int32_t)total;
+  return 0;
+}
+
+// Library version/ABI probe for the ctypes loader.
+int tdt_abi_version() { return 1; }
+
+}  // extern "C"

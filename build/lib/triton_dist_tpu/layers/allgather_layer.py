@@ -1,0 +1,47 @@
+"""AllGather layer (≙ reference ``layers/nvidia/low_latency_allgather_layer.py:31``
+``AllGatherLayer`` with its ``forward_pull`` / ``forward_push_2d(_ll)``
+method surface)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+
+from triton_dist_tpu.ops.allgather import all_gather
+
+
+@dataclasses.dataclass
+class AllGatherLayer:
+    """Method-pinned allgather over a mesh axis.
+
+    The reference exposes one ``forward_*`` per protocol (pull, push-2D,
+    LL, multicast); TPU keeps three (ring_1d / ring_bidir /
+    full_mesh_push — see ops.allgather for why the others collapse) behind
+    the same auto-selection the kernels use.
+    """
+
+    axis: str = "tp"
+    method: str = "auto"
+    interpret: Any = None
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return all_gather(
+            x, axis=self.axis, method=self.method, interpret=self.interpret
+        )
+
+    # explicit per-method entries, mirroring the reference's forward_* set
+    def forward_ring(self, x: jax.Array) -> jax.Array:
+        return all_gather(x, axis=self.axis, method="ring_1d", interpret=self.interpret)
+
+    def forward_ring_bidir(self, x: jax.Array) -> jax.Array:
+        return all_gather(x, axis=self.axis, method="ring_bidir", interpret=self.interpret)
+
+    def forward_push(self, x: jax.Array) -> jax.Array:
+        """Low-latency path (≙ ``forward_push_2d_ll``): direct puts to all
+        peers — the LL packed-flag protocol is unnecessary on TPU (see
+        ops.flash_decode module docstring)."""
+        return all_gather(
+            x, axis=self.axis, method="full_mesh_push", interpret=self.interpret
+        )

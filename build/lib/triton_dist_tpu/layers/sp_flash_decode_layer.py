@@ -1,0 +1,72 @@
+"""SP GQA flash-decode attention layer
+(≙ reference ``layers/nvidia/sp_flash_decode_layer.py:43``
+``SpGQAFlashDecodeAttention``: split-KV attention over the local KV shard,
+LL allgather of (out, lse), inter-rank online-softmax combine)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.ops.flash_decode import (
+    FlashDecodeConfig,
+    flash_decode_distributed,
+    paged_flash_decode_distributed,
+)
+
+
+@dataclasses.dataclass
+class SpGQAFlashDecodeAttention:
+    """Decode-time attention with the paged/contiguous KV cache sharded on
+    the sequence dim over `axis` (sequence/context parallelism).
+
+    The reference selects between JIT and AOT kernel variants via
+    ``USE_TRITON_DISTRIBUTED_AOT`` (sp_flash_decode_layer.py:32-40); here
+    the same effect is ``triton_dist_tpu.aot.aot_compile`` on the jitted
+    caller — no separate kernel source.
+    """
+
+    axis: str = "tp"
+    config: FlashDecodeConfig | None = None
+    ag_method: str = "full_mesh_push"
+    interpret: Any = None
+
+    def __call__(
+        self,
+        q: jax.Array,           # [b, q_heads, d]
+        k_shard: jax.Array,     # [b, kv_heads, s_loc, d]
+        v_shard: jax.Array,
+        kv_lens_shard: jax.Array,  # [b] valid positions in the LOCAL shard
+    ) -> jax.Array:
+        return flash_decode_distributed(
+            q, k_shard, v_shard, kv_lens_shard,
+            axis=self.axis, config=self.config,
+            ag_method=self.ag_method, interpret=self.interpret,
+        )
+
+    def forward_paged(
+        self,
+        q: jax.Array,            # [b, q_heads, d]
+        k_pages: jax.Array,      # [n_pages, kv_heads, page_size, d] local pool
+        v_pages: jax.Array,
+        kv_lens_shard: jax.Array,   # [b] valid positions in the LOCAL shard
+        block_table: jax.Array,  # [b, max_pages] local physical page ids
+    ) -> jax.Array:
+        """Paged-KV forward (≙ the reference layer's block_table path,
+        sp_flash_decode_layer.py:78: each rank's paged pool covers its
+        sequence shard)."""
+        return paged_flash_decode_distributed(
+            q, k_pages, v_pages, kv_lens_shard, block_table,
+            axis=self.axis, ag_method=self.ag_method, interpret=self.interpret,
+        )
+
+    def local_lens_from_global(
+        self, global_kv_lens: jax.Array, s_shard: int
+    ) -> jax.Array:
+        """Per-shard valid lengths from global sequence lengths (the layer's
+        callers track global lengths, ≙ reference forward(global_kv_lens))."""
+        me = jax.lax.axis_index(self.axis)
+        return jnp.clip(global_kv_lens - me * s_shard, 0, s_shard)

@@ -1,0 +1,81 @@
+"""TP transformer MLP blocks built on the fused kernels — the "one model
+layer running end-to-end" target of SURVEY.md §7 step 3 (the reference stops
+at kernels; these layers are the composition its tests perform inline, e.g.
+AG-GEMM feeding GEMM-RS = a megatron column→row parallel MLP forward)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm
+from triton_dist_tpu.ops.allgather_group_gemm import ag_group_gemm
+from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs
+from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+from triton_dist_tpu.ops.moe_reduce_rs import moe_reduce_rs
+
+
+@dataclasses.dataclass
+class TPMLP:
+    """Column→row parallel MLP forward, fully overlapped:
+    ``reduce_scatter(act(all_gather(x) @ W_up) @ W_down)`` with AG fused
+    into the up-GEMM and RS fused into the down-GEMM. Call inside
+    ``jax.shard_map``; x ``[m_loc, H]``, W_up ``[H, F/n]``,
+    W_down ``[F/n, H]`` → ``[m_loc, H]``."""
+
+    axis: str = "tp"
+    activation: Callable[[jax.Array], jax.Array] = jax.nn.gelu
+    ag_config: AGGemmConfig | None = None
+    rs_config: GemmRSConfig | None = None
+    interpret: Any = None
+
+    def __call__(self, x: jax.Array, w_up: jax.Array, w_down: jax.Array) -> jax.Array:
+        h = ag_gemm(
+            x, w_up, axis=self.axis, config=self.ag_config, interpret=self.interpret
+        )
+        h = self.activation(h)
+        return gemm_rs(
+            h, w_down, axis=self.axis, config=self.rs_config,
+            out_dtype=x.dtype, interpret=self.interpret,
+        )
+
+
+@dataclasses.dataclass
+class TPMoEMLP:
+    """MoE MLP with tensor-parallel experts: AG-GroupGEMM up-projection,
+    activation, MoE-Reduce-RS down-projection (≙ composing the reference's
+    ``ag_group_gemm`` + ``moe_reduce_rs`` as its MoE tests do).
+
+    Call inside ``jax.shard_map``; x ``[m_loc, H]``, w_up ``[E, H, F/n]``,
+    w_down ``[E, F/n, H]``, routing from local logits → ``[m_loc, H]``
+    (token-sharded both ends)."""
+
+    axis: str = "tp"
+    activation: Callable[[jax.Array], jax.Array] = jax.nn.gelu
+    gg_config: GroupGemmConfig | None = None
+    interpret: Any = None
+
+    def __call__(
+        self,
+        x: jax.Array,
+        w_up: jax.Array,
+        w_down: jax.Array,
+        topk_ids: jax.Array,       # [m_loc, topk]
+        topk_weights: jax.Array,   # [m_loc, topk]
+    ) -> jax.Array:
+        n = int(jax.lax.axis_size(self.axis))
+        m_loc = x.shape[0]
+        h_sorted, alignment = ag_group_gemm(
+            x, w_up, topk_ids, axis=self.axis, config=self.gg_config,
+            interpret=self.interpret,
+        )
+        h_sorted = self.activation(h_sorted)
+        tw_full = jax.lax.all_gather(topk_weights, self.axis, tiled=True)
+        return moe_reduce_rs(
+            h_sorted, w_down, alignment, tw_full,
+            axis=self.axis, n_tokens=n * m_loc, config=self.gg_config,
+            out_dtype=x.dtype, interpret=self.interpret,
+        ).astype(x.dtype)

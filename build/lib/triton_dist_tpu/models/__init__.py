@@ -1,0 +1,26 @@
+"""Flagship model family built on the fused distributed kernels.
+
+The reference is a kernel library — its "models" are the LLaMA/Qwen-shaped
+GEMM configs its perf tests sweep (test_ag_gemm.py:149-156) and the layer
+compositions its tests perform inline. This package IS that composition,
+shipped: a Megatron-style TP transformer (sequence-sharded residual stream,
+AG-GEMM column projections, GEMM-RS row projections, vocab-parallel loss)
+with dense and MoE blocks, differentiable end-to-end through the fused
+kernels' custom VJPs.
+"""
+
+from triton_dist_tpu.models.tp_transformer import (
+    TransformerConfig,
+    TPTransformer,
+    init_params,
+    param_specs,
+    train_step,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "TPTransformer",
+    "init_params",
+    "param_specs",
+    "train_step",
+]

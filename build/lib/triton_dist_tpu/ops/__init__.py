@@ -1,0 +1,38 @@
+"""Kernel zoo (≙ reference ``python/triton_dist/kernels/nvidia/``)."""
+
+from triton_dist_tpu.ops.gemm import matmul
+from triton_dist_tpu.ops.allgather import (
+    all_gather,
+    all_gather_op,
+    get_auto_all_gather_method,
+)
+from triton_dist_tpu.ops.common import barrier_all_op
+from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm, ag_gemm_op
+from triton_dist_tpu.ops.reduce_scatter import (
+    ReduceScatterConfig,
+    get_auto_reduce_scatter_method,
+    reduce_scatter,
+    reduce_scatter_op,
+)
+from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs, gemm_rs_op
+from triton_dist_tpu.ops.grads import ag_gemm_grad, gemm_rs_grad
+from triton_dist_tpu.ops.allgather_group_gemm import ag_group_gemm, ag_group_gemm_op
+from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
+from triton_dist_tpu.ops.moe_reduce_rs import moe_reduce_rs, moe_reduce_rs_op
+from triton_dist_tpu.ops.moe_utils import (
+    MoEAlignment,
+    moe_align_block_size,
+    select_experts,
+)
+from triton_dist_tpu.ops.all_to_all import (
+    all_to_all_post_process,
+    fast_all_to_all,
+    fast_all_to_all_op,
+)
+from triton_dist_tpu.ops.flash_decode import (
+    FlashDecodeConfig,
+    combine_partials,
+    flash_decode,
+    flash_decode_distributed,
+    flash_decode_op,
+)

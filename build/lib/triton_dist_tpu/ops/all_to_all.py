@@ -1,0 +1,202 @@
+"""Low-latency All-to-All — EP MoE dispatch/combine transport
+(≙ reference ``kernels/nvidia/low_latency_all_to_all.py``, 270 LoC, and the
+inter-rank transport half of ``ep_a2a.py``).
+
+Reference design (SURVEY.md §3.4): one kernel, grid = WORLD_SIZE, each block
+owns a peer — put data + splits, put-signal scale, ``fence``, then
+``signal_op``/``signal_wait_until`` on the own slot, with double-buffered
+symmetric buffers versioned by ``call_count`` (low_latency_all_to_all.py:36-118).
+
+TPU-native re-design:
+
+- **Padded slabs, static shapes.** Token counts per peer are runtime values;
+  XLA needs static shapes, so each PE sends its full ``[max_m, hidden]``
+  segment per peer (the reference pads its symmetric buffers to ``max_m``
+  the same way, :139-147). The valid count travels as a tiny int32 put into
+  the receiver's split slab. A latency-bound MoE dispatch (the 137 µs
+  README headline is 128 tokens/rank) is padded-slab-shaped anyway.
+- **No signals, no fence, no call_count.** The data-coupled receive
+  semaphore of each put IS the signal (arrival implies data, which NVSHMEM
+  needs fence + signal_op for), and every call opens with ``barrier_all``
+  over fresh DMA semaphores, so the double-buffer/versioning machinery
+  drops out entirely.
+- **Slot symmetry**: sender ``s`` writes receiver ``r``'s slab ``s`` — every
+  (sender, receiver) pair owns a distinct slab, the same trick as the
+  reference's per-rank segments of its symmetric recv buffer.
+
+`fast_all_to_all` is its own inverse (with transposed splits), so EP
+*combine* is a second call with the dispatch output — the topk-weighted
+reduction after combine lives in the MoE layer, not here.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
+from triton_dist_tpu.shmem import device as shmem
+
+
+def _a2a_kernel(
+    send_ref, splits_ref, recv_ref, rsplits_ref, copy_sems,
+    data_send, data_recv, spl_send, spl_recv,
+    *, axis: str, n: int,
+):
+    me = shmem.my_pe(axis)
+    # Own slab moves locally; both copies ride the local DMA engines while
+    # the remote puts below are in flight.
+    c1 = pltpu.make_async_copy(send_ref.at[me], recv_ref.at[me], copy_sems.at[0])
+    c2 = pltpu.make_async_copy(splits_ref.at[me], rsplits_ref.at[me], copy_sems.at[1])
+    c1.start()
+    c2.start()
+    shmem.barrier_all(axis)
+    descs = []
+    for d in range(1, n):
+        dst = jax.lax.rem(me + d, n)
+        # splits first: a tiny put the receiver could use to early-out reads
+        descs.append(
+            shmem.putmem_nbi_block(
+                rsplits_ref.at[me], splits_ref.at[dst], dst, axis,
+                spl_send.at[d - 1], spl_recv.at[d - 1],
+            )
+        )
+        descs.append(
+            shmem.putmem_nbi_block(
+                recv_ref.at[me], send_ref.at[dst], dst, axis,
+                data_send.at[d - 1], data_recv.at[d - 1],
+            )
+        )
+    c1.wait()
+    c2.wait()
+    # Symmetric SPMD: each descriptor's recv side counts the equal-sized
+    # incoming slab from peer me-d, so this waits for all arrivals.
+    for desc in descs:
+        desc.wait_recv()
+    shmem.quiet(*descs)
+
+
+def fast_all_to_all(
+    tokens: jax.Array,
+    splits: jax.Array,
+    *,
+    meta: jax.Array | None = None,
+    axis: str = "tp",
+    interpret: Any = None,
+) -> tuple[jax.Array, jax.Array] | tuple[jax.Array, jax.Array, jax.Array]:
+    """Exchange padded token slabs between all PEs of `axis` (call inside
+    ``jax.shard_map``; ≙ ``fast_all_to_all``, low_latency_all_to_all.py:189).
+
+    tokens: ``[n, max_m, hidden]`` — slab ``p`` holds the ``splits[p]``
+    tokens this PE sends to PE ``p`` (rows beyond the count are padding).
+    splits: ``[n]`` int32 valid counts.
+    meta: optional ``[n, K]`` int32 per-slab metadata (e.g. per-row expert
+    ids, bitcast routing weights). It rides the *existing* splits put —
+    the reference folds routing metadata into the same transport for the
+    same reason (its scale tensor travels with the data,
+    low_latency_all_to_all.py:94-104) — so attaching metadata costs zero
+    extra DMAs, kernel launches, or barriers.
+
+    Returns ``(recv, recv_splits[, recv_meta])``: slab ``j`` of ``recv``
+    holds the tokens PE ``j`` sent here (``recv_splits[j]`` valid rows).
+    Golden: ``jax.lax.all_to_all`` over the slab dim.
+    """
+    n = int(jax.lax.axis_size(axis))
+    n_slabs, max_m, hidden = tokens.shape
+    assert n_slabs == n, (n_slabs, n)
+    splits = splits.reshape(n, 1).astype(jnp.int32)
+    payload = splits
+    if meta is not None:
+        assert meta.shape[0] == n, (meta.shape, n)
+        payload = jnp.concatenate(
+            [splits, meta.reshape(n, -1).astype(jnp.int32)], axis=1
+        )
+    w = payload.shape[1]
+    if n == 1:
+        if meta is None:
+            return tokens, splits.reshape(n)
+        return tokens, splits.reshape(n), meta
+    n_steps = n - 1
+    recv, rpayload = dist_pallas_call(
+        functools.partial(_a2a_kernel, axis=axis, n=n),
+        name="fast_all_to_all",
+        out_shape=(
+            jax.ShapeDtypeStruct((n, max_m, hidden), tokens.dtype),
+            jax.ShapeDtypeStruct((n, w), jnp.int32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((n_steps,)),
+            pltpu.SemaphoreType.DMA((n_steps,)),
+            pltpu.SemaphoreType.DMA((n_steps,)),
+            pltpu.SemaphoreType.DMA((n_steps,)),
+        ],
+        interpret=interpret,
+    )(tokens, payload)
+    rsplits = rpayload[:, 0]
+    if meta is None:
+        return recv, rsplits
+    return recv, rsplits, rpayload[:, 1:].reshape(meta.shape)
+
+
+def all_to_all_post_process(
+    recv: jax.Array, recv_splits: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Compact the padded recv slabs to the front (≙ ``all_to_all_post_process``,
+    low_latency_all_to_all.py:251). Returns ``(packed, total)`` where
+    ``packed[:total]`` are the valid tokens in slab order (rows after that
+    are zero); shapes stay static as jit requires."""
+    n, max_m, hidden = recv.shape
+    flat = recv.reshape(n * max_m, hidden)
+    slab = jnp.arange(n * max_m) // max_m
+    pos = jnp.arange(n * max_m) % max_m
+    valid = pos < recv_splits[slab]
+    # Stable sort by target position (padding keys to the back): valid rows
+    # land densely at the front in slab order.
+    offsets = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(recv_splits)[:-1]])
+    keys = jnp.where(valid, offsets[slab] + pos, n * max_m)
+    order = jnp.argsort(keys, stable=True)
+    packed = jnp.where(valid[order][:, None], flat[order], 0)
+    return packed, jnp.sum(recv_splits)
+
+
+def fast_all_to_all_op(
+    tokens: jax.Array,
+    splits: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "tp",
+    interpret: Any = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Host-level entry: `tokens` ``[n, n, max_m, hidden]`` (dim 0 = owning
+    PE, dim 1 = destination slab) and `splits` ``[n, n]``, both sharded on
+    dim 0. Returns the exchanged slabs/splits in the same layout."""
+    if mesh.shape[axis] == 1:
+        # world-1 all-to-all IS the identity: no kernel, no copy
+        return tokens, splits.astype(jnp.int32)
+    fn = functools.partial(fast_all_to_all, axis=axis, interpret=interpret)
+
+    def wrapped(t, s):
+        r, rs = fn(t[0], s[0])
+        return r[None], rs[None]
+
+    return jit_shard_map(
+        wrapped, mesh,
+        (P(axis, None, None, None), P(axis, None)),
+        (P(axis, None, None, None), P(axis, None)),
+        key=("fast_all_to_all", axis, str(interpret)),
+    )(tokens, splits.astype(jnp.int32))

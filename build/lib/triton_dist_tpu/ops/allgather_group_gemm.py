@@ -1,0 +1,105 @@
+"""AG-GroupGEMM — MoE TP forward: allgather tokens + grouped expert GEMM
+(≙ reference ``kernels/nvidia/allgather_group_gemm.py``, 499 LoC).
+
+Reference pipeline: cp-engine allgather of tokens into symmetric workspace,
+C++ ``moe_ag_scatter_align_block_size`` sorts the gathered token→expert
+assignments so each tile is single-expert, and a consumer grouped GEMM
+waits per-tile on the source rank's flag (SURVEY.md §2.3).
+
+TPU-native composition: the fused ring allgather kernel moves tokens over
+ICI, routing ids are allgathered with an XLA collective (tiny payload), the
+jnp alignment (moe_utils) replaces the CUDA sort kernel, and the
+scalar-prefetch grouped GEMM (group_gemm) replaces the flag-waiting
+consumer — XLA chains the kernels back-to-back on the same core, which is
+the TPU analogue of the reference's stream-ordered producer/consumer.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.ops.allgather import all_gather
+from triton_dist_tpu.ops.common import jit_shard_map
+from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
+from triton_dist_tpu.ops.moe_utils import (
+    MoEAlignment,
+    gather_sorted_rows,
+    moe_align_block_size,
+)
+
+
+def ag_group_gemm(
+    a: jax.Array,
+    b: jax.Array,
+    topk_ids: jax.Array,
+    *,
+    axis: str = "tp",
+    config: GroupGemmConfig | None = None,
+    ag_method: str = "auto",
+    interpret: Any = None,
+) -> tuple[jax.Array, MoEAlignment]:
+    """Overlapped MoE up-projection (call inside ``jax.shard_map``;
+    ≙ ``ag_group_gemm``, reference allgather_group_gemm.py:272).
+
+    a: ``[m_loc, K]`` token shard; b: ``[E, K, n_loc]`` expert weights,
+    N-sharded (TP); topk_ids: ``[m_loc, topk]`` routing of the local tokens.
+    Returns ``(h_sorted [t_pad, n_loc], alignment)`` — the grouped-GEMM
+    output in block-aligned expert order over the *gathered* tokens, plus
+    the alignment to unsort it (the reference likewise returns scatter
+    order for the follow-up reduce).
+    """
+    cfg = config or GroupGemmConfig()
+    n_exp = b.shape[0]
+    topk = topk_ids.shape[1]
+    a_full = all_gather(a, axis=axis, method=ag_method, interpret=interpret)
+    ids_full = jax.lax.all_gather(topk_ids, axis, tiled=True)  # [m_tot, topk]
+    alignment = moe_align_block_size(
+        ids_full.reshape(-1), n_exp, cfg.block_m
+    )
+    a_sorted = gather_sorted_rows(a_full, alignment, topk)
+    h_sorted = group_gemm(
+        a_sorted, b, alignment.expert_ids, config=cfg, interpret=interpret
+    )
+    return h_sorted, alignment
+
+
+def ag_group_gemm_op(
+    a: jax.Array,
+    b: jax.Array,
+    topk_ids: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "tp",
+    config: GroupGemmConfig | None = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Host-level entry: returns the dense per-assignment output
+    ``[m_tot * topk, n_loc-sharded N]`` in original token order (sentinel
+    rows dropped), for golden comparison and simple use."""
+    cfg = config or GroupGemmConfig()
+    topk = topk_ids.shape[1]
+    m_tot = a.shape[0]
+
+    def fn(a, b, ids):
+        h_sorted, alignment = ag_group_gemm(
+            a, b, ids, axis=axis, config=cfg, interpret=interpret
+        )
+        # unsort to assignment order [m_tot*topk, n_loc]
+        t = m_tot * topk
+        # scatter row index by assignment id; sentinel rows (id == t) drop
+        inv = jnp.zeros((t,), jnp.int32).at[alignment.sorted_token_ids].set(
+            jnp.arange(alignment.sorted_token_ids.shape[0], dtype=jnp.int32),
+            mode="drop",
+        )
+        return h_sorted[inv]
+
+    return jit_shard_map(
+        fn, mesh,
+        (P(axis, None), P(None, None, axis), P(axis, None)),
+        P(None, axis),
+        key=("ag_group_gemm", axis, cfg, m_tot, topk, str(interpret)),
+    )(a, b, topk_ids.astype(jnp.int32))

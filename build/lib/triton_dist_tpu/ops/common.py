@@ -1,0 +1,234 @@
+"""Shared kernel-building helpers (≙ reference ``kernels/nvidia/common_ops.py``).
+
+The reference's common_ops holds device barrier kernels and host
+stream-signal wrappers (``wait_eq``/``set_signal`` over cuStreamWriteValue,
+:196-229). On TPU the host cannot poke device memory mid-program, so the
+surviving pieces are: a standalone barrier kernel, collective-id management,
+and the ``dist_pallas_call`` wrapper that all distributed kernels use.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu import config as tdt_config
+from triton_dist_tpu.shmem import device as shmem
+
+
+_collective_id_counter = itertools.count(1)
+_collective_ids: dict[str, int] = {}
+
+
+def collective_id_for(name: str) -> int:
+    """Stable collective_id per kernel family (barrier semaphores of
+    concurrently-running kernels must not collide). Mosaic supports a small
+    fixed pool of collective ids; running out is an error rather than a
+    silent wrap that would alias two families' barrier semaphores."""
+    if name not in _collective_ids:
+        next_id = next(_collective_id_counter)
+        if next_id >= 32:
+            raise RuntimeError(
+                f"out of collective_ids (31 kernel families in use) while "
+                f"registering {name!r}; reuse an existing family name in "
+                f"dist_pallas_call(name=...) for kernels that never run "
+                f"concurrently"
+            )
+        _collective_ids[name] = next_id
+    return _collective_ids[name]
+
+
+def dist_pallas_call(
+    kernel,
+    *,
+    name: str,
+    out_shape: Any,
+    in_specs: Sequence[pl.BlockSpec] | None = None,
+    out_specs: Any = None,
+    grid: tuple[int, ...] | None = None,
+    grid_spec: Any = None,
+    scratch_shapes: Sequence[Any] = (),
+    cost_estimate: pl.CostEstimate | None = None,
+    vmem_limit_bytes: int | None = None,
+    interpret: Any = None,
+    dimension_semantics: tuple[str, ...] | None = None,
+    input_output_aliases: dict[int, int] | None = None,
+    uses_barrier: bool = True,
+):
+    """pallas_call with the invariants every distributed kernel needs:
+    side effects on (remote DMAs must not be DCE'd), a collective_id for the
+    barrier semaphore, and config-resolved interpret mode.
+
+    `uses_barrier` must be False for degenerate single-PE calls: Mosaic
+    rejects a collective_id on kernels that never touch the barrier
+    semaphore."""
+    params: dict[str, Any] = dict(has_side_effects=True)
+    if uses_barrier:
+        params["collective_id"] = collective_id_for(name)
+    if vmem_limit_bytes is not None:
+        params["vmem_limit_bytes"] = vmem_limit_bytes
+    if dimension_semantics is not None:
+        params["dimension_semantics"] = dimension_semantics
+    kwargs: dict[str, Any] = {}
+    if grid_spec is not None:
+        kwargs["grid_spec"] = grid_spec
+    else:
+        if grid is not None:
+            kwargs["grid"] = grid
+        if in_specs is not None:
+            kwargs["in_specs"] = list(in_specs)
+        if out_specs is not None:
+            kwargs["out_specs"] = out_specs
+    if input_output_aliases:
+        kwargs["input_output_aliases"] = input_output_aliases
+    return pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        scratch_shapes=list(scratch_shapes),
+        compiler_params=pltpu.CompilerParams(**params),
+        cost_estimate=cost_estimate,
+        interpret=tdt_config.interpret_params() if interpret is None else interpret,
+        name=name,
+        **kwargs,
+    )
+
+
+def gemm_add_pipeline(
+    bm: int, bn: int, bk: int, m_dim: int, n_dim: int, k_dim: int,
+    acc_ref, out_dtype, n_adds: int = 0,
+):
+    """Tiled ``O = A @ B (+ sum(adds))`` as an inner ``emit_pipeline``: f32
+    VMEM accumulation over the k grid dim with the optional adds fused into
+    the last-k epilogue. The shared MXU workhorse of the fused kernels
+    (≙ the consumer/producer GEMM bodies of reference allgather_gemm.py:133
+    and gemm_reduce_scatter.py:125). Add operands use a k-invariant index
+    map, so Pallas fetches each of their tiles once."""
+    n_k = k_dim // bk
+
+    def body(a_blk, b_blk, *rest):
+        o_blk = rest[-1]
+        adds = rest[:-1]
+        kk = pl.program_id(2)
+
+        @pl.when(kk == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        acc_ref[:] += jnp.dot(a_blk[:], b_blk[:], preferred_element_type=jnp.float32)
+
+        @pl.when(kk == n_k - 1)
+        def _():
+            acc = acc_ref[:]
+            for r in adds:
+                acc = acc + r[:].astype(jnp.float32)
+            o_blk[:] = acc.astype(out_dtype)
+
+    return pltpu.emit_pipeline(
+        body,
+        grid=(m_dim // bm, n_dim // bn, n_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        ]
+        + [pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j))] * n_adds,
+        out_specs=[pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j))],
+    )
+
+
+def gemm_only(a, b, *, cfg, out_dtype, name: str, interpret=None):
+    """Pure-MXU pipelined matmul — the world-1 degenerate path shared by the
+    fused ops (same inner ``gemm_add_pipeline``, minus workspace and ring).
+    `cfg` is any config with block_m/block_n/block_k (AGGemmConfig,
+    GemmRSConfig, …); `name` keeps traces/profiles attributed to the real op."""
+    from triton_dist_tpu.utils import pick_block
+
+    m_loc, k_dim = a.shape
+    n_loc = b.shape[1]
+    bm = pick_block(m_loc, cfg.block_m)
+    bn = pick_block(n_loc, cfg.block_n)
+    bk = pick_block(k_dim, cfg.block_k)
+
+    def _kernel(a_ref, b_ref, out_ref, acc_ref):
+        pipeline = gemm_add_pipeline(bm, bn, bk, m_loc, n_loc, k_dim, acc_ref, out_dtype)
+        pipeline(a_ref, b_ref, out_ref)
+
+    return dist_pallas_call(
+        _kernel,
+        name=name,
+        out_shape=jax.ShapeDtypeStruct((m_loc, n_loc), out_dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m_loc * n_loc * k_dim,
+            bytes_accessed=(m_loc * k_dim + k_dim * n_loc + m_loc * n_loc) * a.dtype.itemsize,
+            transcendentals=0,
+        ),
+        # the emit_pipeline double-buffers a/b/out tiles; the default 16 MiB
+        # budget rejects the large-tile configs the autotuner wants to try
+        vmem_limit_bytes=2 * 2 * (bm * bk + bk * bn + bm * bn) * a.dtype.itemsize
+        + 4 * bm * bn
+        + 2 * 2**20,
+        uses_barrier=False,
+        interpret=interpret,
+    )(a, b)
+
+
+_jit_cache: dict[Any, Any] = {}
+
+
+def jit_shard_map(
+    fn,
+    mesh,
+    in_specs,
+    out_specs,
+    *,
+    key: Any,
+):
+    """``jax.jit(jax.shard_map(fn, ...))`` cached across calls.
+
+    ``jax.jit`` keys its cache on the callable's identity; building a fresh
+    ``shard_map`` wrapper per invocation (what every ``*_op`` convenience
+    entry naturally does) therefore retraces AND recompiles every call —
+    measured ~2 s per call on a tunneled TPU. `key` must capture everything
+    that changes the traced program besides the mesh/specs (op name, config,
+    method, static dims); argument shapes/dtypes are handled by jit itself.
+    """
+    cache_key = (mesh, str(in_specs), str(out_specs), key)
+    hit = _jit_cache.get(cache_key)
+    if hit is None:
+        hit = jax.jit(
+            jax.shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+        _jit_cache[cache_key] = hit
+    return hit
+
+
+def barrier_all_op(axis: str = "tp", interpret: Any = None) -> None:
+    """Standalone device barrier over a mesh axis — call inside shard_map
+    (≙ ``barrier_all_on_stream`` / ``barrier_all_intra_node_atomic_cas_block``,
+    common_ops.py:87-193)."""
+
+    def _kernel(out_ref):
+        shmem.barrier_all(axis)
+        out_ref[0] = jnp.int32(1)
+
+    return dist_pallas_call(
+        _kernel,
+        name="barrier_all",
+        out_shape=jax.ShapeDtypeStruct((1,), jnp.int32),
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        uses_barrier=int(jax.lax.axis_size(axis)) > 1,
+        interpret=interpret,
+    )()

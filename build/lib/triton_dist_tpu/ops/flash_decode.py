@@ -1,0 +1,415 @@
+"""Distributed GQA flash-decode — sequence/context parallelism for decode
+(≙ reference ``kernels/nvidia/flash_decode.py``, 1160 LoC, and the SP layer
+``layers/nvidia/sp_flash_decode_layer.py``).
+
+The reference pipeline (SURVEY.md §3.5): per-rank split-KV attention over the
+local KV shard (``kernel_gqa_fwd_batch_decode_split_kv`` :130) → intra-rank
+combine (:393) → LL-protocol allgather of (acc, lse) → inter-rank combine
+with the numerically-stable online-softmax merge (:482-530).
+
+TPU-native re-design:
+
+- **split-KV + intra-rank combine collapse into one kernel.** GPU split-KV
+  exists to fill idle SMs with independent KV spans; a TPU core executes the
+  Pallas grid sequentially with a pipelined memory stream, so the idiomatic
+  form is a single online-softmax pass over KV chunks (grid dim = chunk,
+  carry (m, l, acc) in VMEM scratch). Nothing to combine intra-rank.
+- **The LL protocol is unnecessary.** The reference packs payload+flag into
+  8-byte words so receivers spin on data (low_latency_allgather.py:532-571);
+  TPU remote DMAs carry data-coupled completion semaphores, so the plain
+  ``full_mesh_push`` allgather (allgather.py) IS the low-latency path.
+- **Inter-rank combine** keeps the reference's (acc‖lse) merge algebra —
+  it is exactly blockwise/ring-attention math — expressed as XLA elementwise
+  ops, which fuse into a single kernel without hand-writing one.
+
+Layouts: q ``[batch, q_heads, head_dim]`` (one decode token per sequence),
+KV cache ``[batch, kv_heads, seq, head_dim]`` with valid prefix ``kv_lens``
+per sequence (contiguous cache; a paged variant would add a block-table via
+scalar prefetch in the index_map, same kernel body).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.autotuner import contextual_autotune
+from triton_dist_tpu.ops.allgather import all_gather
+from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
+from triton_dist_tpu.utils import pick_block
+
+NEG_INF = float("-inf")
+
+
+@dataclasses.dataclass(frozen=True)
+class FlashDecodeConfig:
+    """Tunables (≙ the reference's split-KV block knobs)."""
+
+    block_s: int = 2048  # KV chunk per online-softmax step
+
+
+def _flash_decode_kernel(
+    kv_lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, n_chunks: int, block_s: int, scale: float,
+):
+    b_i = pl.program_id(0)
+    c = pl.program_id(2)
+
+    @pl.when(c == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    kv_len = kv_lens_ref[b_i]
+
+    @pl.when(c * block_s < kv_len)
+    def _():
+        # Both matmuls run in the cache dtype (bf16 MXU fast path, f32
+        # accumulate); the f32-upcast variant costs a full VPU pass over
+        # every K/V tile and measured 25% slower than the HBM-bandwidth
+        # wall this kernel otherwise sits on.
+        q = q_ref[0, 0]                                     # [g, d]
+        s = jax.lax.dot_general(                            # [g, sc]
+            q, k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        span = c * block_s + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(span < kv_len, s, NEG_INF)
+        m_prev = m_scr[:]                                   # [g, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                              # [g, sc]
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p.astype(v_ref.dtype), v_ref[0, 0],
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+
+    @pl.when(c == n_chunks - 1)
+    def _():
+        l = l_scr[:]
+        # kv_len == 0 → l == 0: emit out=0, lse=-inf (weight 0 in the merge).
+        out_ref[0, 0] = jnp.where(l > 0, acc_scr[:] / jnp.maximum(l, 1e-30), 0.0)
+        lse_ref[0, 0] = m_scr[:] + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def flash_decode(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_lens: jax.Array,
+    *,
+    config: FlashDecodeConfig | None = None,
+    return_lse: bool = False,
+    interpret: Any = None,
+):
+    """Single-device GQA batch decode (≙ ``gqa_fwd_batch_decode_intra_rank``,
+    reference flash_decode.py:763).
+
+    q: ``[b, q_heads, d]``; k, v: ``[b, kv_heads, s, d]``; kv_lens: ``[b]``
+    int32 valid prefix lengths. Returns f32 ``[b, q_heads, d]`` (and the
+    per-head log-sum-exp ``[b, q_heads]`` if `return_lse` — the partial pair
+    the SP merge consumes).
+    """
+    cfg = config or FlashDecodeConfig()
+    b, hq, d = q.shape
+    _, h_kv, s_len, _ = k.shape
+    assert hq % h_kv == 0, (hq, h_kv)
+    g = hq // h_kv
+    sc = pick_block(s_len, cfg.block_s)
+    n_chunks = s_len // sc
+    scale = 1.0 / math.sqrt(d)
+    # the kernel's matmuls run in the cache dtype (bf16 MXU fast path);
+    # mixed-precision callers get their q silently matched to the cache
+    q4 = q.reshape(b, h_kv, g, d).astype(k.dtype)
+    grid = (b, h_kv, n_chunks)
+    out, lse = dist_pallas_call(
+        functools.partial(
+            _flash_decode_kernel, n_chunks=n_chunks, block_s=sc, scale=scale
+        ),
+        name="flash_decode",
+        grid=grid,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h_kv, g, d), jnp.float32),
+            # 4-D with a unit minor dim: Mosaic wants the trailing block dims
+            # to equal the array dims (g < 8 sublanes is fine when full).
+            jax.ShapeDtypeStruct((b, h_kv, g, 1), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # kv_lens
+            pl.BlockSpec((1, 1, g, d), lambda i, j, c: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, sc, d), lambda i, j, c: (i, j, c, 0)),
+            pl.BlockSpec((1, 1, sc, d), lambda i, j, c: (i, j, c, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, g, d), lambda i, j, c: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, g, 1), lambda i, j, c: (i, j, 0, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, d), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * hq * s_len * d,
+            bytes_accessed=(2 * b * h_kv * s_len * d) * k.dtype.itemsize,
+            transcendentals=b * hq * s_len,
+        ),
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+        uses_barrier=False,
+        interpret=interpret,
+    )(kv_lens.astype(jnp.int32), q4, k, v)
+    out = out.reshape(b, hq, d)
+    lse = lse.reshape(b, hq)
+    return (out, lse) if return_lse else out
+
+
+def _paged_flash_decode_kernel(
+    kv_lens_ref, block_table_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
+    m_scr, l_scr, acc_scr,
+    *, n_chunks: int, page_size: int, scale: float,
+):
+    # Same online-softmax body as the contiguous kernel; the difference is
+    # entirely in the index_map (physical page via the prefetched block
+    # table ≙ the reference's block_table indirection, flash_decode.py:136,203)
+    _flash_decode_kernel(
+        kv_lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
+        m_scr, l_scr, acc_scr,
+        n_chunks=n_chunks, block_s=page_size, scale=scale,
+    )
+
+
+def paged_flash_decode(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    kv_lens: jax.Array,
+    block_table: jax.Array,
+    *,
+    return_lse: bool = False,
+    interpret: Any = None,
+):
+    """Single-device GQA batch decode over a PAGED KV cache
+    (≙ the reference's paged decode, flash_decode.py:130-280: the KV cache
+    is a pool of fixed-size pages; ``block_table[b, i]`` names the physical
+    page holding sequence ``b``'s ``i``-th chunk).
+
+    q: ``[b, q_heads, d]``; k_pages, v_pages: ``[n_pages, kv_heads,
+    page_size, d]``; kv_lens: ``[b]`` int32; block_table: ``[b, max_pages]``
+    int32 physical page ids (entries beyond the valid length may be
+    arbitrary in-range values). Returns like :func:`flash_decode`.
+
+    TPU-native form of the indirection: the block table rides scalar
+    prefetch (SMEM), and the K/V BlockSpec index_map reads it to steer each
+    grid step's page fetch — the double-buffered pipeline then streams
+    pages exactly as the contiguous kernel streams chunks.
+    """
+    b, hq, d = q.shape
+    n_pages, h_kv, page_size, _ = k_pages.shape
+    assert hq % h_kv == 0, (hq, h_kv)
+    g = hq // h_kv
+    max_pages = block_table.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    # match q to the page-pool dtype (same contract as flash_decode)
+    q4 = q.reshape(b, h_kv, g, d).astype(k_pages.dtype)
+
+    def kv_index_map(i, j, c, kv_lens_ref, bt_ref):
+        return (bt_ref[i, c], j, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h_kv, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda i, j, c, *_: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), kv_index_map),
+            pl.BlockSpec((1, 1, page_size, d), kv_index_map),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, g, d), lambda i, j, c, *_: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, g, 1), lambda i, j, c, *_: (i, j, 0, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, d), jnp.float32),
+        ],
+    )
+    # pages are viewed [n_pages, h_kv, page_size, d] → block (1,1,ps,d)
+    out, lse = dist_pallas_call(
+        functools.partial(
+            _paged_flash_decode_kernel,
+            n_chunks=max_pages, page_size=page_size, scale=scale,
+        ),
+        name="paged_flash_decode",
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h_kv, g, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h_kv, g, 1), jnp.float32),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * hq * max_pages * page_size * d,
+            bytes_accessed=(2 * b * h_kv * max_pages * page_size * d)
+            * k_pages.dtype.itemsize,
+            transcendentals=b * hq * max_pages * page_size,
+        ),
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+        uses_barrier=False,
+        interpret=interpret,
+    )(kv_lens.astype(jnp.int32), block_table.astype(jnp.int32), q4, k_pages, v_pages)
+    out = out.reshape(b, hq, d)
+    lse = lse.reshape(b, hq)
+    return (out, lse) if return_lse else out
+
+
+def paged_flash_decode_distributed(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    kv_lens_shard: jax.Array,
+    block_table: jax.Array,
+    *,
+    axis: str = "tp",
+    ag_method: str = "full_mesh_push",
+    interpret: Any = None,
+) -> jax.Array:
+    """SP/CP decode over a paged, sequence-sharded KV cache: each PE holds
+    its own page pool + block table covering its sequence shard (the paged
+    analogue of :func:`flash_decode_distributed`; ≙ the reference SP layer,
+    which is paged end-to-end: sp_flash_decode_layer.py:78)."""
+    out, lse = paged_flash_decode(
+        q, k_pages, v_pages, kv_lens_shard, block_table,
+        return_lse=True, interpret=interpret,
+    )
+    return _sp_allgather_combine(out, lse, axis, ag_method, interpret)
+
+
+def combine_partials(outs: jax.Array, lses: jax.Array) -> jax.Array:
+    """Numerically-stable online-softmax merge of partial attention results
+    (≙ ``kernel_inter_rank_gqa_fwd_batch_decode_combine_kv``, reference
+    flash_decode.py:482-530: ``acc *= exp(m - m_new) ...``).
+
+    outs: ``[n, b, hq, d]`` partial (normalized) outputs; lses: ``[n, b, hq]``
+    their log-sum-exps. Returns the exact full-attention result ``[b, hq, d]``.
+    """
+    m = jnp.max(lses, axis=0)                            # [b, hq]
+    # ranks with no KV carry lse=-inf → weight 0; all -inf → output 0
+    w = jnp.where(
+        jnp.isfinite(lses), jnp.exp(lses - jnp.maximum(m, -1e30)), 0.0
+    )                                                    # [n, b, hq]
+    denom = jnp.maximum(jnp.sum(w, axis=0), 1e-30)       # [b, hq]
+    return jnp.einsum("nbh,nbhd->bhd", w, outs) / denom[..., None]
+
+
+def flash_decode_distributed(
+    q: jax.Array,
+    k_shard: jax.Array,
+    v_shard: jax.Array,
+    kv_lens_shard: jax.Array,
+    *,
+    axis: str = "tp",
+    config: FlashDecodeConfig | None = None,
+    ag_method: str = "full_mesh_push",
+    interpret: Any = None,
+) -> jax.Array:
+    """SP/CP decode over a KV-sharded cache (call inside ``jax.shard_map``;
+    ≙ ``SpGQAFlashDecodeAttention.forward``, sp_flash_decode_layer.py:78).
+
+    Every PE holds the full q and a sequence-shard of the KV cache
+    (``kv_lens_shard`` = #valid positions in the LOCAL shard). Local partial
+    attention → low-latency allgather of the (out ‖ lse) payload → merge.
+    Golden: single-device flash decode over the concatenated cache.
+    """
+    out, lse = flash_decode(
+        q, k_shard, v_shard, kv_lens_shard,
+        config=config, return_lse=True, interpret=interpret,
+    )
+    return _sp_allgather_combine(out, lse, axis, ag_method, interpret)
+
+
+def _sp_allgather_combine(out, lse, axis, ag_method, interpret) -> jax.Array:
+    """Shared SP tail: allgather each PE's (out ‖ lse) payload and merge.
+
+    One flat payload per PE (≙ the staged symm ag_buffer copy,
+    sp_flash_decode_layer.py:134-137): [b*hq, d] out rows, then the b*hq
+    lse scalars packed densely into ceil(b*hq/d) extra rows.
+    """
+    n = int(jax.lax.axis_size(axis))
+    if n == 1:
+        return out
+    b, hq, d = out.shape
+    rows = b * hq
+    lse_rows = -(-rows // d)
+    lse_packed = jnp.pad(lse.reshape(-1), (0, lse_rows * d - rows)).reshape(lse_rows, d)
+    payload = jnp.concatenate([out.reshape(rows, d), lse_packed])
+    gathered = all_gather(payload, axis=axis, method=ag_method, interpret=interpret)
+    gathered = gathered.reshape(n, rows + lse_rows, d)
+    outs = gathered[:, :rows, :].reshape(n, b, hq, d)
+    lses = gathered[:, rows:, :].reshape(n, lse_rows * d)[:, :rows].reshape(n, b, hq)
+    return combine_partials(outs, lses)
+
+
+def flash_decode_op(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_lens: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "tp",
+    config: FlashDecodeConfig | None = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Host-level SP entry: `k`/`v` ``[b, h_kv, S, d]`` sharded on the
+    sequence dim over `axis`, `q`/`kv_lens` replicated (global lengths).
+    Each PE derives its local valid length from the global one."""
+    n = mesh.shape[axis]
+    s_shard = k.shape[2] // n
+
+    def fn(q, k_s, v_s, kv_lens):
+        me = jax.lax.axis_index(axis)
+        local_lens = jnp.clip(kv_lens - me * s_shard, 0, s_shard)
+        return flash_decode_distributed(
+            q, k_s, v_s, local_lens, axis=axis, config=config, interpret=interpret
+        )
+
+    return jit_shard_map(
+        fn, mesh,
+        (
+            P(None, None, None),
+            P(None, None, axis, None),
+            P(None, None, axis, None),
+            P(None),
+        ),
+        P(None, None, None),
+        key=("flash_decode", axis, config, s_shard, str(interpret)),
+    )(q, k, v, kv_lens.astype(jnp.int32))
+
+
+# KV-chunk tune space (≙ the reference's split-KV block sweep); larger
+# chunks amortize per-grid-step overhead, smaller ones win on short caches.
+FLASH_DECODE_TUNE_SPACE = (
+    FlashDecodeConfig(block_s=512),
+    FlashDecodeConfig(block_s=1024),
+    FlashDecodeConfig(block_s=2048),
+)
+
+
+def _fd_effective_block(cfg, q, k, v, kv_lens, mesh, *, axis="tp", **_):
+    """Configs whose block clamps to the same per-shard chunk are the same
+    kernel — time one (pick_block caps block_s at the local KV length)."""
+    return pick_block(k.shape[2] // mesh.shape[axis], cfg.block_s)
+
+
+flash_decode_op = contextual_autotune(
+    FLASH_DECODE_TUNE_SPACE, name="flash_decode", dedupe=_fd_effective_block
+)(flash_decode_op)

@@ -1,0 +1,319 @@
+"""Fused GEMM-ReduceScatter — TP row-parallel forward
+(≙ reference ``kernels/nvidia/gemm_reduce_scatter.py``, 561 LoC).
+
+The reference runs a producer GEMM whose tiles *notify* per-output-rank
+counters (``dl.notify`` on the last tile of a rank's rows,
+gemm_reduce_scatter.py:224-235) with a rank+1-first threadblock swizzle
+(:190-200) so communication for remote ranks starts as early as possible,
+while a consumer reduce-scatter pipeline drains finished chunks on separate
+high-priority streams (reduce_scatter.py:863).
+
+TPU-native re-design: one fused Pallas kernel per PE; the tile swizzle
+becomes the *chunk emission order* of the kernel's outer loop, and the
+notify/consumer machinery collapses into the data-coupled receive semaphore
+of each one-sided put. Two strategies (auto-selected like the standalone
+reduce-scatter):
+
+- ``scatter`` — produce the partial chunk destined for PE ``me+d`` in
+  increasing-``d`` order (own chunk LAST — exactly the reference's
+  rank+1-first swizzle) and push each chunk to its owner the moment its
+  GEMM finishes; the ICI DMA overlaps the next chunk's MXU work. The final
+  own-chunk GEMM fuses the n-way reduction of all landed chunks into its
+  epilogue, so the reduce costs no extra HBM round-trip.
+- ``ring`` — bandwidth-optimal fused ring reduce-scatter: step ``s``
+  produces partial chunk ``me-1-s``, fused-adds the partially-reduced chunk
+  that arrived from the left during step ``s-1``, and forwards it right;
+  the last step's add lands directly in ``out``. Per-hop carry is in
+  ``out_dtype`` (one round-off per hop, like any ring reduce).
+
+Used for TP row-parallel layers: A is ``[M, k_loc]`` (K-sharded
+activations, e.g. the output of a column-parallel layer), B is
+``[k_loc, N]``; every PE gets its M-chunk of the fully-reduced C.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.autotuner import contextual_autotune
+from triton_dist_tpu.ops.common import (
+    dist_pallas_call,
+    gemm_add_pipeline,
+    gemm_only,
+    jit_shard_map,
+)
+from triton_dist_tpu.ops.reduce_scatter import get_auto_reduce_scatter_method
+from triton_dist_tpu.shmem import device as shmem
+from triton_dist_tpu.utils import pick_block
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmRSConfig:
+    """Tunables (≙ the GEMM tile knobs of the reference contexts,
+    gemm_reduce_scatter.py:42-86; stream/buffer plumbing is subsumed by the
+    fused kernel)."""
+
+    block_m: int = 256
+    block_n: int = 1024
+    block_k: int = 512
+
+
+def _blocks(cfg: GemmRSConfig, m_loc: int, n_dim: int, k_loc: int):
+    return (
+        pick_block(m_loc, cfg.block_m),
+        pick_block(n_dim, cfg.block_n),
+        pick_block(k_loc, cfg.block_k),
+    )
+
+
+def _gemm_rs_scatter_kernel(
+    a_ref, b_ref, out_ref, send_buf, recv_buf, acc_ref, send_sems, recv_sems,
+    *, axis: str, n: int, cfg: GemmRSConfig, out_dtype,
+):
+    me = shmem.my_pe(axis)
+    m_tot, k_loc = a_ref.shape
+    n_dim = b_ref.shape[1]
+    m_loc = m_tot // n
+    bm, bn, bk = _blocks(cfg, m_loc, n_dim, k_loc)
+    gemm = gemm_add_pipeline(bm, bn, bk, m_loc, n_dim, k_loc, acc_ref, out_dtype, 0)
+    gemm_reduce = gemm_add_pipeline(
+        bm, bn, bk, m_loc, n_dim, k_loc, acc_ref, out_dtype, n - 1
+    )
+
+    # All PEs must be inside the kernel before any chunk may land in their
+    # slots (≙ the barrier before the scatter stage, reduce_scatter.py:604).
+    shmem.barrier_all(axis)
+
+    # Remote chunks first, own chunk last (≙ rank+1-first swizzle,
+    # gemm_reduce_scatter.py:190-200). Receiver slot d-1 holds the chunk
+    # from PE me-d — distinct per sender by symmetry.
+    descs = []
+    for d in range(1, n):
+        dst = jax.lax.rem(me + d, n)
+        slot = (d - 1) % 2
+        if d >= 3:
+            descs[d - 3].wait_send()  # send_buf slot free again
+        gemm(a_ref.at[pl.ds(dst * m_loc, m_loc)], b_ref, send_buf.at[slot])
+        descs.append(
+            shmem.putmem_nbi_block(
+                recv_buf.at[d - 1], send_buf.at[slot], dst, axis,
+                send_sems.at[d - 1], recv_sems.at[d - 1],
+            )
+        )
+    # Symmetric SPMD: our descriptors' recv side counts the equal-sized
+    # incoming chunks, so this waits for all n-1 arrivals.
+    for desc in descs:
+        desc.wait_recv()
+    # Own chunk's GEMM with the n-way reduction fused into its epilogue.
+    gemm_reduce(
+        a_ref.at[pl.ds(me * m_loc, m_loc)], b_ref,
+        *(recv_buf.at[d] for d in range(n - 1)), out_ref,
+    )
+    shmem.quiet(*descs)
+
+
+def _gemm_rs_ring_kernel(
+    a_ref, b_ref, out_ref, comp_buf, recv_buf, acc_ref, send_sems, recv_sems,
+    *, axis: str, n: int, cfg: GemmRSConfig, out_dtype,
+):
+    me = shmem.my_pe(axis)
+    m_tot, k_loc = a_ref.shape
+    n_dim = b_ref.shape[1]
+    m_loc = m_tot // n
+    bm, bn, bk = _blocks(cfg, m_loc, n_dim, k_loc)
+    gemm = gemm_add_pipeline(bm, bn, bk, m_loc, n_dim, k_loc, acc_ref, out_dtype, 0)
+    gemm_add = gemm_add_pipeline(bm, bn, bk, m_loc, n_dim, k_loc, acc_ref, out_dtype, 1)
+
+    shmem.barrier_all(axis)
+    right = jax.lax.rem(me + 1, n)
+
+    # Step s: produce partial chunk (me-1-s), fused-add the partial that
+    # landed from the left during step s-1, forward right. After n-1 hops
+    # the fully-reduced own chunk lands in out_ref.
+    descs = []
+    for s in range(n):
+        c = pl.ds(jax.lax.rem(me - 1 - s + 2 * n, n) * m_loc, m_loc)
+        target = out_ref if s == n - 1 else comp_buf.at[s % 2]
+        if 2 <= s < n - 1:
+            descs[s - 2].wait_send()  # comp_buf slot s%2 free again
+        if s == 0:
+            gemm(a_ref.at[c], b_ref, target)
+        else:
+            descs[s - 1].wait_recv()  # partial chunk landed in recv_buf[s-1]
+            gemm_add(a_ref.at[c], b_ref, recv_buf.at[s - 1], target)
+        if s < n - 1:
+            descs.append(
+                shmem.putmem_nbi_block(
+                    recv_buf.at[s], target, right, axis,
+                    send_sems.at[s], recv_sems.at[s],
+                )
+            )
+    shmem.quiet(*descs)
+
+
+def _gemm_rs_2d(a, b, *, axes, method, cfg, out_dtype, interpret):
+    """Hierarchical GEMM-RS over two mesh axes ``(outer, inner)``
+    (≙ the reference's producer GEMM + 2-D reduce-scatter pipeline,
+    reduce_scatter.py:525-637): the fused GEMM-RS runs over the fast `inner`
+    axis with A's chunk layout transposed so inner peer i ends up owning
+    slab ``S_i = concat_o'(chunk (o', i))`` of the product, already
+    inner-reduced; a standalone reduce-scatter then finishes over `outer`.
+    Every byte crosses the slow axis once, n_i-fold pre-reduced."""
+    from triton_dist_tpu.ops.reduce_scatter import reduce_scatter
+
+    outer, inner = axes
+    n_o = int(jax.lax.axis_size(outer))
+    n_i = int(jax.lax.axis_size(inner))
+    if n_o == 1:
+        return gemm_rs(a, b, axis=inner, method=method, config=cfg,
+                       out_dtype=out_dtype, interpret=interpret)
+    if n_i == 1:
+        return gemm_rs(a, b, axis=outer, method=method, config=cfg,
+                       out_dtype=out_dtype, interpret=interpret)
+    m_tot, k_loc = a.shape
+    n = n_o * n_i
+    assert m_tot % n == 0, (m_tot, n)
+    m_loc = m_tot // n
+    a_perm = a.reshape(n_o, n_i, m_loc, k_loc).swapaxes(0, 1).reshape(m_tot, k_loc)
+    part = gemm_rs(
+        a_perm, b, axis=inner, method=method, config=cfg,
+        out_dtype=out_dtype, interpret=interpret,
+    )  # [n_o*m_loc, N] = S_me_i's product, summed over the inner group
+    # gemm_rs and the standalone reduce_scatter use different method
+    # vocabularies ("scatter" vs "scatter_reduce")
+    rs_method = {"scatter": "scatter_reduce"}.get(method, method)
+    return reduce_scatter(part, axis=outer, method=rs_method, interpret=interpret)
+
+
+def gemm_rs(
+    a: jax.Array,
+    b: jax.Array,
+    *,
+    axis: str = "tp",
+    method: str = "auto",
+    config: GemmRSConfig | None = None,
+    out_dtype: Any = None,
+    interpret: Any = None,
+    devices: Any = None,
+) -> jax.Array:
+    """Overlapped ``psum_scatter(a @ b)`` (call inside ``jax.shard_map``).
+
+    a: ``[M, k_loc]`` — K-sharded activations on this PE (M = n * m_loc).
+    b: ``[k_loc, N]`` — K-shard of the weight (row-parallel).
+    Returns ``[m_loc, N]`` — this PE's M-chunk of the fully-reduced product.
+    Golden: ``jax.lax.psum_scatter(a @ b, axis, tiled=True)``
+    (≙ ``gemm_rs_op``, reference gemm_reduce_scatter.py:498).
+    """
+    cfg = config or GemmRSConfig()
+    out_dtype = out_dtype or a.dtype
+    if isinstance(axis, (tuple, list)):
+        if len(axis) == 1:
+            axis = axis[0]
+        else:
+            assert len(axis) == 2, f"at most 2 axes supported, got {axis}"
+            return _gemm_rs_2d(
+                a, b, axes=tuple(axis), method=method, cfg=cfg,
+                out_dtype=out_dtype, interpret=interpret,
+            )
+    n = int(jax.lax.axis_size(axis))
+    m_tot, k_loc = a.shape
+    n_dim = b.shape[1]
+    if n == 1:
+        # World-1 is a plain matmul; run it through the same tuned MXU
+        # pipeline the fused kernels use (beats the XLA dot at bench shapes).
+        return gemm_only(
+            a, b, cfg=cfg, out_dtype=out_dtype, name="gemm_rs", interpret=interpret
+        )
+    assert m_tot % n == 0, (m_tot, n)
+    m_loc = m_tot // n
+    if method == "auto":
+        method = get_auto_reduce_scatter_method(
+            m_loc * n_dim * jnp.dtype(out_dtype).itemsize, n, devices
+        )
+    # accept the standalone reduce-scatter's method name as an alias
+    method = {"scatter_reduce": "scatter"}.get(method, method)
+    bm, bn, _ = _blocks(cfg, m_loc, n_dim, k_loc)
+    kernels = {"scatter": _gemm_rs_scatter_kernel, "ring": _gemm_rs_ring_kernel}
+    if method not in kernels:
+        raise ValueError(f"unknown gemm_rs method: {method!r} (want scatter|ring)")
+    kernel = kernels[method]
+    n_steps = n - 1
+    outs = dist_pallas_call(
+        functools.partial(kernel, axis=axis, n=n, cfg=cfg, out_dtype=out_dtype),
+        name=f"gemm_rs_{method}",
+        out_shape=(
+            jax.ShapeDtypeStruct((m_loc, n_dim), out_dtype),
+            # Workspace as outputs: how a kernel gets private HBM, and the
+            # interpreter's emit_pipeline only takes kernel-arg HBM refs.
+            jax.ShapeDtypeStruct((2, m_loc, n_dim), out_dtype),        # send/comp
+            jax.ShapeDtypeStruct((n_steps, m_loc, n_dim), out_dtype),  # landing
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY) for _ in range(3)),
+        scratch_shapes=[
+            pltpu.VMEM((bm, bn), jnp.float32),
+            pltpu.SemaphoreType.DMA((n_steps,)),
+            pltpu.SemaphoreType.DMA((n_steps,)),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m_tot * n_dim * k_loc,
+            bytes_accessed=(m_tot * k_loc + k_loc * n_dim) * a.dtype.itemsize
+            + (m_tot + 3 * n_steps * m_loc) * n_dim * jnp.dtype(out_dtype).itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(a, b)
+    return outs[0]
+
+
+def gemm_rs_op(
+    a: jax.Array,
+    b: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "tp",
+    method: str = "auto",
+    config: GemmRSConfig | None = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Host-level entry (≙ ``gemm_rs_op``, reference
+    gemm_reduce_scatter.py:498): `a` sharded on dim 1 (K), `b` sharded on
+    dim 0 (K); the reduced result comes back sharded on dim 0 (M)."""
+    from triton_dist_tpu.parallel import topology
+
+    fn = functools.partial(
+        gemm_rs, axis=axis, method=method, config=config, interpret=interpret,
+        devices=topology.axis_devices(mesh, axis),
+    )
+    return jit_shard_map(
+        fn, mesh, (P(None, axis), P(axis, None)), P(axis, None),
+        key=("gemm_rs", axis, method, config, str(interpret)),
+    )(a, b)
+
+
+# ≙ the reference's tune space for gemm_rs (gemm_reduce_scatter.py contexts);
+# block_m tiles the per-destination M-chunk, which is M/n — smaller than the
+# AG-GEMM tiles for the same problem.
+GEMM_RS_TUNE_SPACE = (
+    GemmRSConfig(256, 1024, 512),
+    GemmRSConfig(512, 1024, 512),
+    GemmRSConfig(256, 2048, 512),
+    GemmRSConfig(512, 2048, 1024),   # swept winner at M=8192 K=14336 N=4096
+    GemmRSConfig(512, 2048, 512),
+    GemmRSConfig(1024, 2048, 1024),
+    GemmRSConfig(512, 4096, 2048),
+    GemmRSConfig(128, 1024, 512),
+)
+
+gemm_rs_op = contextual_autotune(GEMM_RS_TUNE_SPACE, name="gemm_rs")(gemm_rs_op)

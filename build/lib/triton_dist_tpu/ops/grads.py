@@ -1,0 +1,103 @@
+"""Custom VJPs for the fused distributed GEMMs — training support.
+
+The reference is an inference kernel library (SURVEY.md §2.3: no DP/PP, no
+training-side ops); a TPU framework must also train, and the algebra is a
+gift: **the backward of AG-GEMM is GEMM-RS and vice versa**, so the fused
+forward kernels are their own fused backward:
+
+  C = AG(A) @ B          (column-parallel fwd)
+    dA = psum_scatter(dC @ Bᵀ)  = gemm_rs(dC, Bᵀ)
+    dB = AG(A)ᵀ @ dC            (AG(A) is free — the fwd workspace)
+
+  C = psum_scatter(A @ B)  (row-parallel fwd)
+    dA = AG(dC) @ Bᵀ            = ag_gemm(dC, Bᵀ)
+    dB = Aᵀ @ AG(dC)            (AG(dC) is the ag_gemm workspace)
+
+Use ``ag_gemm_grad`` / ``gemm_rs_grad`` inside ``shard_map`` wherever the
+non-differentiable ``ops.ag_gemm`` / ``ops.gemm_rs`` would appear in a
+training step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm
+from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def ag_gemm_grad(
+    a: jax.Array,
+    b: jax.Array,
+    axis: str = "tp",
+    ag_config: AGGemmConfig | None = None,
+    rs_config: GemmRSConfig | None = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Differentiable fused ``all_gather(a) @ b`` (call inside shard_map)."""
+    return ag_gemm(a, b, axis=axis, config=ag_config, interpret=interpret)
+
+
+def _ag_gemm_fwd(a, b, axis, ag_config, rs_config, interpret):
+    out, a_full = ag_gemm(
+        a, b, axis=axis, config=ag_config, gather_output=True, interpret=interpret
+    )
+    return out, (a_full, b)
+
+
+def _ag_gemm_bwd(axis, ag_config, rs_config, interpret, res, dc):
+    a_full, b = res
+    da = gemm_rs(
+        dc, b.T, axis=axis, config=rs_config, out_dtype=dc.dtype,
+        interpret=interpret,
+    )
+    db = jnp.dot(
+        a_full.T, dc, preferred_element_type=jnp.float32
+    ).astype(b.dtype)
+    return da, db
+
+
+ag_gemm_grad.defvjp(_ag_gemm_fwd, _ag_gemm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def gemm_rs_grad(
+    a: jax.Array,
+    b: jax.Array,
+    axis: str = "tp",
+    rs_config: GemmRSConfig | None = None,
+    ag_config: AGGemmConfig | None = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Differentiable fused ``psum_scatter(a @ b)`` (call inside shard_map)."""
+    return gemm_rs(a, b, axis=axis, config=rs_config, interpret=interpret)
+
+
+def _gemm_rs_fwd(a, b, axis, rs_config, ag_config, interpret):
+    out = gemm_rs(a, b, axis=axis, config=rs_config, interpret=interpret)
+    return out, (a, b)
+
+
+def _gemm_rs_bwd(axis, rs_config, ag_config, interpret, res, dc):
+    a, b = res
+    n = int(jax.lax.axis_size(axis))
+    if n == 1:
+        dc_full = dc
+        da = jnp.dot(dc, b.T, preferred_element_type=jnp.float32).astype(a.dtype)
+    else:
+        da, dc_full = ag_gemm(
+            dc, b.T, axis=axis, config=ag_config, gather_output=True,
+            out_dtype=a.dtype, interpret=interpret,
+        )
+    db = jnp.dot(
+        a.T, dc_full, preferred_element_type=jnp.float32
+    ).astype(b.dtype)
+    return da, db
+
+
+gemm_rs_grad.defvjp(_gemm_rs_fwd, _gemm_rs_bwd)

@@ -1,0 +1,110 @@
+"""Block-aligned grouped GEMM for MoE expert compute
+(≙ the grouped-GEMM halves of reference ``allgather_group_gemm.py:420``
+``kernel_consumer_m_parallel_scatter_group_gemm`` and
+``moe_reduce_rs.py:362`` ``kernel_producer_group_gemm_tp_scatter_input``).
+
+Rows of `a` are pre-sorted by expert and padded so every ``block_m`` tile
+belongs to one expert (see ``moe_utils.moe_align_block_size``); the owning
+expert of each row-block arrives via scalar prefetch, steering the weight
+BlockSpec's index_map — the TPU analogue of the reference reading its
+device-side ``gather_index``/``expert_index`` tensors per tile. The MXU
+pipeline is then an ordinary tiled matmul whose B operand hops between
+experts' weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.ops.common import dist_pallas_call
+from triton_dist_tpu.utils import pick_block
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupGemmConfig:
+    block_m: int = 128  # must equal the alignment block size
+    block_n: int = 1024
+    block_k: int = 512
+
+
+def _group_gemm_kernel(e_ref, a_ref, b_ref, o_ref, acc_ref, *, n_k: int, out_dtype):
+    del e_ref  # consumed by the index maps
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(
+        a_ref[:], b_ref[0], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(kk == n_k - 1)
+    def _():
+        o_ref[:] = acc_ref[:].astype(out_dtype)
+
+
+def group_gemm(
+    a_sorted: jax.Array,
+    b: jax.Array,
+    expert_ids: jax.Array,
+    *,
+    config: GroupGemmConfig | None = None,
+    out_dtype: Any = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """``out[i*bm:(i+1)*bm] = a_sorted[i*bm:(i+1)*bm] @ b[expert_ids[i]]``.
+
+    a_sorted: ``[t_pad, K]`` block-aligned rows; b: ``[E, K, N]``;
+    expert_ids: ``[t_pad // block_m]`` int32 (runtime values — scalar
+    prefetch). Returns ``[t_pad, N]``. Golden: ``jax.lax.ragged_dot``.
+    """
+    cfg = config or GroupGemmConfig()
+    t_pad, k_dim = a_sorted.shape
+    n_exp, _, n_dim = b.shape
+    out_dtype = out_dtype or a_sorted.dtype
+    n_blocks = expert_ids.shape[0]
+    assert t_pad % n_blocks == 0, (t_pad, n_blocks)
+    bm = t_pad // n_blocks
+    assert bm == cfg.block_m, (
+        f"rows-per-block {bm} != config.block_m {cfg.block_m}: alignment and "
+        f"GEMM must use the same block size"
+    )
+    bn = pick_block(n_dim, cfg.block_n)
+    bk = pick_block(k_dim, cfg.block_k)
+    n_k = k_dim // bk
+    # parallel dims must form a grid prefix: n-tiles first (megablox order)
+    grid = (n_dim // bn, t_pad // bm, n_k)
+    return dist_pallas_call(
+        functools.partial(_group_gemm_kernel, n_k=n_k, out_dtype=out_dtype),
+        name="group_gemm",
+        out_shape=jax.ShapeDtypeStruct((t_pad, n_dim), out_dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((bm, bk), lambda j, i, kk, e_ref: (i, kk)),
+                pl.BlockSpec(
+                    (1, bk, bn), lambda j, i, kk, e_ref: (e_ref[i], kk, j)
+                ),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda j, i, kk, e_ref: (i, j)),
+            scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * t_pad * k_dim * n_dim,
+            bytes_accessed=(t_pad * k_dim + n_exp * k_dim * n_dim + t_pad * n_dim)
+            * a_sorted.dtype.itemsize,
+            transcendentals=0,
+        ),
+        dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        uses_barrier=False,
+        interpret=interpret,
+    )(expert_ids, a_sorted, b)

@@ -1,0 +1,113 @@
+"""MoE-Reduce-RS — MoE TP down-projection: grouped GEMM + top-k weighted
+reduce + reduce-scatter (≙ reference ``kernels/nvidia/moe_reduce_rs.py``,
+1020 LoC).
+
+Reference pipeline: grouped-GEMM producer with a scatter epilogue writing
+straight into the reduce-scatter input layout + per-rank notify counters
+(:362), consumer doing topk-reduce (:468) then the 2-D reduce-scatter on
+side streams (:817, orchestration :882-1020).
+
+TPU-native composition: the scalar-prefetch grouped GEMM produces the
+per-assignment rows, the topk-weighted unsort is an XLA fused
+scatter-add (moe_utils.scatter_add_unsorted — the notify/counter machinery
+has no role when kernels chain in-order on one core), and the result feeds
+the fused reduce-scatter kernel, whose one-sided pushes overlap the next
+layer's work in the XLA schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.ops.common import jit_shard_map
+from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
+from triton_dist_tpu.ops.moe_utils import MoEAlignment, scatter_add_unsorted
+from triton_dist_tpu.ops.reduce_scatter import ReduceScatterConfig, reduce_scatter
+
+
+def moe_reduce_rs(
+    h_sorted: jax.Array,
+    w_down: jax.Array,
+    alignment: MoEAlignment,
+    topk_weights: jax.Array,
+    *,
+    axis: str = "tp",
+    n_tokens: int,
+    config: GroupGemmConfig | None = None,
+    rs_config: ReduceScatterConfig | None = None,
+    rs_method: str = "auto",
+    out_dtype: Any = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """MoE second GEMM + weighted combine + reduce-scatter (call inside
+    ``jax.shard_map``; ≙ ``moe_reduce_rs``, reference moe_reduce_rs.py:882).
+
+    h_sorted: ``[t_pad, f_loc]`` block-aligned expert-major hidden rows
+    (e.g. the activated output of :func:`ag_group_gemm`) — `f_loc` is this
+    PE's TP shard of the expert FFN dim. w_down: ``[E, f_loc, H]``.
+    topk_weights: ``[n_tokens, topk]`` routing weights of the *gathered*
+    tokens. Returns ``[n_tokens / n, H]`` — this PE's token chunk of the
+    fully-reduced MoE output.
+    """
+    out_dtype = out_dtype or h_sorted.dtype
+    y_sorted = group_gemm(
+        h_sorted, w_down, alignment.expert_ids, config=config,
+        out_dtype=jnp.float32, interpret=interpret,
+    )
+    partial = scatter_add_unsorted(y_sorted, alignment, topk_weights, n_tokens)
+    return reduce_scatter(
+        partial.astype(out_dtype), axis=axis, method=rs_method,
+        config=rs_config, interpret=interpret,
+    )
+
+
+def moe_reduce_rs_op(
+    h_sorted: jax.Array,
+    w_down: jax.Array,
+    sorted_token_ids: jax.Array,
+    expert_ids: jax.Array,
+    topk_weights: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "tp",
+    config: GroupGemmConfig | None = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Host-level entry: `h_sorted` ``[t_pad, F]`` with F sharded over
+    `axis`, `w_down` ``[E, F, H]`` sharded on F; alignment arrays and
+    weights replicated. Result ``[n_tokens, H]`` sharded on tokens."""
+    n_tokens = topk_weights.shape[0]
+    topk = topk_weights.shape[1]
+
+    def fn(h, w, sti, eid, tw):
+        # every block inside an expert's padded segment has >=1 valid row,
+        # so valid-block count * block_m recovers num_tokens_post_pad
+        bm = sti.shape[0] // eid.shape[0]
+        block_valid = jnp.any(
+            sti.reshape(-1, bm) < n_tokens * topk, axis=1
+        )
+        alignment = MoEAlignment(
+            sorted_token_ids=sti, expert_ids=eid,
+            num_tokens_post_pad=(jnp.sum(block_valid) * bm).astype(jnp.int32),
+        )
+        return moe_reduce_rs(
+            h, w, alignment, tw, axis=axis, n_tokens=n_tokens,
+            config=config, interpret=interpret,
+        )
+
+    return jit_shard_map(
+        fn, mesh,
+        (
+            P(None, axis),
+            P(None, axis, None),
+            P(None),
+            P(None),
+            P(None, None),
+        ),
+        P(axis, None),
+        key=("moe_reduce_rs", axis, config, n_tokens, topk, str(interpret)),
+    )(h_sorted, w_down, sorted_token_ids, expert_ids, topk_weights)

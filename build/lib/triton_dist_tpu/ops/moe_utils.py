@@ -1,0 +1,134 @@
+"""MoE routing + token alignment utilities
+(≙ reference ``select_experts``/``full_moe_align_block_size``
+(moe_reduce_rs.py:87,180) and the C++ ``moe_ag_scatter_align_block_size``
+CUDA kernel (csrc/lib/moe_utils.cu:36-356)).
+
+The reference sorts token→expert assignments on device with a shared-memory
+histogram + cumsum so every GEMM tile processes rows of a single expert,
+padding each expert's segment to the tile size. The TPU-native form is a
+fortiori simpler: XLA's sort/scan primitives fuse into a handful of kernels,
+so the alignment is ~15 lines of jnp. (The reference's CUDA kernel is a
+device-side necessity, not a design feature; the C++ host-side equivalent
+for native tooling is part of the csrc/ build — see csrc/ when present.)
+
+All shapes are static: the padded row count is the worst case
+``T + E*(block_m-1)`` rounded up, with sentinel rows marked by token id
+``T`` (gathers clamp, epilogues mask).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.utils import round_up
+
+
+def select_experts(
+    logits: jax.Array, topk: int
+) -> tuple[jax.Array, jax.Array]:
+    """Softmax + top-k routing (≙ ``select_experts``, moe_reduce_rs.py:180).
+
+    logits: ``[tokens, E]``. Returns ``(weights [tokens, topk] — softmax
+    scores renormalized over the chosen experts, ids [tokens, topk] int32)``.
+    """
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    weights, ids = jax.lax.top_k(probs, topk)
+    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    return weights, ids.astype(jnp.int32)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class MoEAlignment:
+    """Block-aligned token ordering for grouped GEMM.
+
+    sorted_token_ids: ``[t_pad]`` int32 — flattened token-expert assignment
+      index (``token*topk + k`` slot) per padded row; sentinel ``T`` for
+      padding rows.
+    expert_ids: ``[t_pad // block_m]`` int32 — owning expert of each row
+      block (every block is single-expert by construction).
+    num_tokens_post_pad: scalar int32 — valid padded rows (static shapes
+      mean consumers still process all blocks; rows past this are padding).
+    """
+
+    sorted_token_ids: jax.Array
+    expert_ids: jax.Array
+    num_tokens_post_pad: jax.Array
+
+    @property
+    def block_m(self) -> int:
+        return self.sorted_token_ids.shape[0] // self.expert_ids.shape[0]
+
+
+def moe_align_block_size(
+    topk_ids: jax.Array, n_experts: int, block_m: int
+) -> MoEAlignment:
+    """Sort token-expert assignments by expert and pad each expert segment
+    to a multiple of `block_m` (≙ ``moe_ag_scatter_align_block_size``,
+    csrc/lib/moe_utils.cu:36-356).
+
+    topk_ids: ``[T]`` int32 flattened assignments (T = tokens * topk).
+    """
+    t = topk_ids.shape[0]
+    t_pad = round_up(t + n_experts * (block_m - 1), block_m)
+    counts = jnp.bincount(topk_ids, length=n_experts)
+    padded_counts = ((counts + block_m - 1) // block_m) * block_m
+    seg_starts = jnp.concatenate(
+        [jnp.zeros(1, padded_counts.dtype), jnp.cumsum(padded_counts)[:-1]]
+    )
+    # stable sort by expert keeps original token order within an expert
+    order = jnp.argsort(topk_ids, stable=True)  # [t] assignment indices
+    expert_sorted = topk_ids[order]
+    cum_counts = jnp.concatenate(
+        [jnp.zeros(1, counts.dtype), jnp.cumsum(counts)[:-1]]
+    )
+    pos_in_expert = jnp.arange(t) - cum_counts[expert_sorted]
+    target = seg_starts[expert_sorted] + pos_in_expert
+    sorted_token_ids = jnp.full((t_pad,), t, jnp.int32).at[target].set(
+        order.astype(jnp.int32)
+    )
+    block_starts = jnp.arange(t_pad // block_m) * block_m
+    expert_ids = jnp.searchsorted(
+        jnp.cumsum(padded_counts), block_starts, side="right"
+    ).astype(jnp.int32)
+    # blocks past all experts' segments keep a valid (clamped) expert id
+    expert_ids = jnp.minimum(expert_ids, n_experts - 1)
+    return MoEAlignment(
+        sorted_token_ids=sorted_token_ids,
+        expert_ids=expert_ids,
+        num_tokens_post_pad=jnp.sum(padded_counts).astype(jnp.int32),
+    )
+
+
+def gather_sorted_rows(
+    x: jax.Array, alignment: MoEAlignment, topk: int
+) -> jax.Array:
+    """Expand tokens into block-aligned grouped-GEMM rows: row ``r`` of the
+    result is token ``sorted_token_ids[r] // topk`` (sentinels clamp to the
+    last token; their outputs are masked on the way back)."""
+    token_of_row = jnp.minimum(alignment.sorted_token_ids // topk, x.shape[0] - 1)
+    return x[token_of_row]
+
+
+def scatter_add_unsorted(
+    y_sorted: jax.Array,
+    alignment: MoEAlignment,
+    weights: jax.Array,
+    n_tokens: int,
+) -> jax.Array:
+    """Inverse of :func:`gather_sorted_rows` with the top-k weighted
+    reduction fused in (≙ the consumer topk-reduce, moe_reduce_rs.py:468):
+    out[token] = Σ_k w[token,k] * y_sorted[row(token,k)]."""
+    topk = weights.shape[1]
+    ids = alignment.sorted_token_ids  # [t_pad], sentinel = n_tokens*topk
+    valid = ids < n_tokens * topk
+    flat_w = jnp.where(
+        valid, weights.reshape(-1)[jnp.clip(ids, 0, n_tokens * topk - 1)], 0.0
+    )
+    token_of_row = jnp.clip(ids // topk, 0, n_tokens - 1)
+    contrib = y_sorted.astype(jnp.float32) * flat_w[:, None]
+    out = jnp.zeros((n_tokens, y_sorted.shape[1]), jnp.float32)
+    return out.at[token_of_row].add(jnp.where(valid[:, None], contrib, 0.0))

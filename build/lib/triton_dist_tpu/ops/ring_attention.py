@@ -1,0 +1,267 @@
+"""Ring attention — sequence-parallel *prefill* for long context.
+
+The reference implements SP only for decode (KV-sharded flash-decode +
+lse merge, SURVEY.md §5: "Prefill-side ring attention / Ulysses ... are
+NOT implemented"); this framework treats long-context as first-class, so
+prefill SP is built on the same one-sided-put layer the other kernels use.
+
+Algorithm (blockwise ring attention, Liu et al. 2023): q stays put,
+(k, v) chunks rotate around the ring. At step ``s`` PE ``me`` holds the
+chunk of rank ``(me - s) mod n``; it starts forwarding that chunk right —
+the ICI transfer rides under the MXU work — then runs blockwise attention
+of its local q against the chunk, carrying the online-softmax state
+``(m, l, acc)`` in HBM across steps. The final step's epilogue normalizes
+``acc / l``. Causal masking is positional (global offsets), so any chunk
+arrival order would be correct; the ring order merely makes it efficient.
+
+The decode-side combine (flash_decode.combine_partials) is the same
+algebra — this kernel is its prefill-scale sibling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
+from triton_dist_tpu.shmem import device as shmem
+from triton_dist_tpu.utils import pick_block
+
+NEG_INF = float("-inf")
+
+
+@dataclasses.dataclass(frozen=True)
+class RingAttentionConfig:
+    block_q: int = 512
+    block_kv: int = 512
+
+
+def _attn_step_pipeline(
+    bh: int, s_loc: int, d: int, bq: int, bk: int,
+    m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
+    q_offset, kv_offset, first_step: bool,
+):
+    """One ring step: blockwise attention of local q vs the current kv
+    chunk. The (m, l, acc) state persists across ring steps in HBM; m/l use
+    a lane-broadcast minor dim of 128 (Mosaic cannot slice 1-wide minors).
+    State blocks are kv-invariant, so they move once per q tile — KV block
+    traffic dominates by a factor of n_q_tiles."""
+    nq, nkv = s_loc // bq, s_loc // bk
+
+    def body(q_blk, k_blk, v_blk, m_in, l_in, acc_in, m_out, l_out, acc_out):
+        qi, kj = pl.program_id(1), pl.program_id(2)
+
+        @pl.when(kj == 0)
+        def _():
+            if first_step:
+                m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+                l_scr[:] = jnp.zeros_like(l_scr)
+                acc_scr[:] = jnp.zeros_like(acc_scr)
+            else:
+                m_scr[:] = m_in[0, :, :1]
+                l_scr[:] = l_in[0, :, :1]
+                acc_scr[:] = acc_in[0]
+
+        q = q_blk[0].astype(jnp.float32) * scale          # [bq, d]
+        k = k_blk[0].astype(jnp.float32)                  # [bk, d]
+        v = v_blk[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )                                                  # [bq, bk]
+        if causal:
+            q_pos = q_offset + qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            kv_pos = kv_offset + kj * bk + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
+            )
+            s = jnp.where(kv_pos <= q_pos, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # fully-masked tile: m_new stays -inf; exp(-inf - -inf) would be
+        # NaN, so pin the shift to a finite value in that case
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        alpha = jnp.exp(m_prev - shift)
+        p = jnp.exp(s - shift)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.where(jnp.isfinite(m_new), m_new, m_prev)
+
+        @pl.when(kj == nkv - 1)
+        def _():
+            m_out[0] = jnp.broadcast_to(m_scr[:], (bq, 128))
+            l_out[0] = jnp.broadcast_to(l_scr[:], (bq, 128))
+            acc_out[0] = acc_scr[:]
+
+    state_spec = pl.BlockSpec((1, bq, 128), lambda i, qi, kj: (i, qi, 0))
+    acc_spec = pl.BlockSpec((1, bq, d), lambda i, qi, kj: (i, qi, 0))
+    return pltpu.emit_pipeline(
+        body,
+        grid=(bh, nq, nkv),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, qi, kj: (i, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, qi, kj: (i, kj, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, qi, kj: (i, kj, 0)),
+            state_spec, state_spec, acc_spec,
+        ],
+        out_specs=[state_spec, state_spec, acc_spec],
+    )
+
+
+def _ring_attention_kernel(
+    q_ref, k_ref, v_ref, out_ref, kv_land, acc_buf, m_buf, l_buf,
+    m_scr, l_scr, acc_scr, send_sems, recv_sems,
+    *, axis: str, n: int, cfg: RingAttentionConfig, scale: float,
+    causal: bool, out_dtype,
+):
+    me = shmem.my_pe(axis)
+    bh, s_loc, d = q_ref.shape
+    bq = pick_block(s_loc, cfg.block_q)
+    bk = pick_block(s_loc, cfg.block_kv)
+    q_offset = me * s_loc
+
+    shmem.barrier_all(axis)
+    right = jax.lax.rem(me + 1, n)
+    descs = []
+    for s in range(n):
+        chunk_rank = jax.lax.rem(me - s + 2 * n, n)
+        kv_offset = chunk_rank * s_loc
+        if s > 0:
+            # chunk landed in slot s-1 during step s-1 (two transfers: k, v)
+            descs[2 * (s - 1)].wait_recv()
+            descs[2 * (s - 1) + 1].wait_recv()
+        k_src = k_ref if s == 0 else kv_land.at[s - 1, 0]
+        v_src = v_ref if s == 0 else kv_land.at[s - 1, 1]
+        if s < n - 1:
+            # forward the chunk before computing on it: ICI rides under MXU
+            descs.append(
+                shmem.putmem_nbi_block(
+                    kv_land.at[s, 0], k_src, right, axis,
+                    send_sems.at[2 * s], recv_sems.at[2 * s],
+                )
+            )
+            descs.append(
+                shmem.putmem_nbi_block(
+                    kv_land.at[s, 1], v_src, right, axis,
+                    send_sems.at[2 * s + 1], recv_sems.at[2 * s + 1],
+                )
+            )
+        pipeline = _attn_step_pipeline(
+            bh, s_loc, d, bq, bk, m_scr, l_scr, acc_scr,
+            scale=scale, causal=causal, q_offset=q_offset,
+            kv_offset=kv_offset, first_step=(s == 0),
+        )
+        pipeline(
+            q_ref, k_src, v_src, m_buf, l_buf, acc_buf, m_buf, l_buf, acc_buf
+        )
+    shmem.quiet(*descs)
+
+    # epilogue: out = acc / l
+    nq = s_loc // bq
+
+    def norm_body(acc_in, l_in, o_blk):
+        l = l_in[0, :, :1]
+        o_blk[0] = (acc_in[0] / jnp.maximum(l, 1e-30)).astype(out_dtype)
+
+    pltpu.emit_pipeline(
+        norm_body,
+        grid=(bh, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, qi: (i, qi, 0)),
+            pl.BlockSpec((1, bq, 128), lambda i, qi: (i, qi, 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, bq, d), lambda i, qi: (i, qi, 0))],
+    )(acc_buf, l_buf, out_ref)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str = "tp",
+    causal: bool = True,
+    config: RingAttentionConfig | None = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Sequence-parallel attention over an s-sharded q/k/v (call inside
+    ``jax.shard_map``).
+
+    q, k, v: ``[b, h, s_loc, d]`` — the local sequence shard (MHA; GQA via
+    repeating kv heads host-side). Returns ``[b, h, s_loc, d]`` in q.dtype.
+    Golden: full (causal) attention over the gathered sequence.
+    """
+    cfg = config or RingAttentionConfig()
+    n = int(jax.lax.axis_size(axis))
+    b, h, s_loc, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    bh = b * h
+    q3 = q.reshape(bh, s_loc, d)
+    k3 = k.reshape(bh, s_loc, d)
+    v3 = v.reshape(bh, s_loc, d)
+    bq = pick_block(s_loc, cfg.block_q)
+    bk = pick_block(s_loc, cfg.block_kv)
+    n_steps = max(n - 1, 1)
+    outs = dist_pallas_call(
+        functools.partial(
+            _ring_attention_kernel, axis=axis, n=n, cfg=cfg, scale=scale,
+            causal=causal, out_dtype=q.dtype,
+        ),
+        name="ring_attention",
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, s_loc, d), q.dtype),
+            jax.ShapeDtypeStruct((n_steps, 2, bh, s_loc, d), k.dtype),  # kv ring
+            jax.ShapeDtypeStruct((bh, s_loc, d), jnp.float32),   # acc
+            jax.ShapeDtypeStruct((bh, s_loc, 128), jnp.float32),  # m (lanes)
+            jax.ShapeDtypeStruct((bh, s_loc, 128), jnp.float32),  # l (lanes)
+        ),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY) for _ in range(5)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.SemaphoreType.DMA((2 * n_steps,)),
+            pltpu.SemaphoreType.DMA((2 * n_steps,)),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * s_loc * (n * s_loc) * d,
+            bytes_accessed=(3 + 2 * n) * bh * s_loc * d * q.dtype.itemsize,
+            transcendentals=bh * s_loc * n * s_loc,
+        ),
+        uses_barrier=n > 1,
+        interpret=interpret,
+    )(q3, k3, v3)
+    return outs[0].reshape(b, h, s_loc, d)
+
+
+def ring_attention_op(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "tp",
+    causal: bool = True,
+    config: RingAttentionConfig | None = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Host-level entry: q/k/v ``[b, h, S, d]`` sharded on the sequence dim."""
+    fn = functools.partial(
+        ring_attention, axis=axis, causal=causal, config=config, interpret=interpret
+    )
+    spec = P(None, None, axis, None)
+    return jit_shard_map(
+        fn, mesh, (spec, spec, spec), spec,
+        key=("ring_attention", axis, causal, config, str(interpret)),
+    )(q, k, v)

@@ -1,0 +1,7 @@
+from triton_dist_tpu.parallel.mesh import (
+    DistContext,
+    initialize_distributed,
+    get_default_context,
+    make_mesh,
+)
+from triton_dist_tpu.parallel import topology as topology

@@ -1,0 +1,113 @@
+"""Bootstrap + device-mesh management.
+
+TPU-native re-design of the reference bootstrap
+(``python/triton_dist/utils.py:91-117`` ``initialize_distributed``): the
+NCCL process-group + NVSHMEM-uniqueid dance collapses into
+``jax.distributed.initialize()`` (multi-host) plus a ``jax.sharding.Mesh``.
+There is no symmetric-heap bootstrap — symmetric buffers exist by SPMD
+construction under ``jax.shard_map``.
+
+Axis conventions (richer than the reference, which only has a flat TP
+group): ``dp`` (data), ``tp`` (tensor), ``sp`` (sequence/context), ``ep``
+(expert), ``pp`` (pipeline). A 1-D communication "world" axis is named
+``tp`` by default to match the reference's TP_GROUP.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+_DEFAULT_CONTEXT: "DistContext | None" = None
+
+
+@dataclasses.dataclass(frozen=True)
+class DistContext:
+    """World handle: mesh + canonical axis names (≙ reference TP_GROUP)."""
+
+    mesh: Mesh
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    def axis_size(self, axis: str) -> int:
+        return int(self.mesh.shape[axis])
+
+    @property
+    def num_local_devices(self) -> int:
+        return jax.local_device_count()
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    def sharding(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+
+def make_mesh(shape: Mapping[str, int] | None = None, devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build a Mesh. ``shape`` maps axis name -> size; None gives a flat
+    1-D ``tp`` mesh over all devices (reference's single TP group over
+    WORLD_SIZE, utils.py:107)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = {"tp": len(devices)}
+    sizes = list(shape.values())
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError(f"mesh shape {dict(shape)} does not cover {len(devices)} devices")
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, tuple(shape.keys()))
+
+
+def initialize_distributed(
+    mesh_shape: Mapping[str, int] | None = None,
+    seed: int = 42,
+    set_default: bool = True,
+) -> DistContext:
+    """Bootstrap (≙ reference utils.py:91-117).
+
+    Multi-host: honors standard JAX coordination env vars
+    (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID) the way the reference
+    honors RANK/WORLD_SIZE, then builds the global mesh over all devices.
+    """
+    # NOTE: must run before anything touches the JAX backend (querying
+    # jax.devices()/process_count() first would initialize the local backend
+    # and make distributed init fail).
+    coord = os.environ.get("COORDINATOR_ADDRESS") or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coord and not jax.distributed.is_initialized():
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ.get("NUM_PROCESSES", os.environ.get("WORLD_SIZE", "1"))),
+            process_id=int(os.environ.get("PROCESS_ID", os.environ.get("RANK", "0"))),
+        )
+    from triton_dist_tpu.utils import init_seed
+
+    init_seed(seed)
+    ctx = DistContext(mesh=make_mesh(mesh_shape))
+    if set_default:
+        global _DEFAULT_CONTEXT
+        _DEFAULT_CONTEXT = ctx
+    return ctx
+
+
+def get_default_context() -> DistContext:
+    global _DEFAULT_CONTEXT
+    if _DEFAULT_CONTEXT is None:
+        _DEFAULT_CONTEXT = initialize_distributed()
+    return _DEFAULT_CONTEXT
+
+
+def set_default_context(ctx: DistContext) -> None:
+    global _DEFAULT_CONTEXT
+    _DEFAULT_CONTEXT = ctx

@@ -1,0 +1,113 @@
+"""Roofline perf models for TPU chips
+(≙ reference ``kernels/nvidia/gemm_perf_model.py`` (237 LoC) and
+``comm_perf_model.py`` (106 LoC)).
+
+The reference keeps tensor-core TFLOPS tables keyed by device name and NIC
+bandwidth discovered from sysfs, and uses ``estimate_gemm_sol_time_ms`` /
+``estimate_reduce_scatter_time`` to budget SMs between GEMM and comm. The
+TPU equivalents are per-generation MXU/HBM/ICI tables (public numbers) used
+to (a) pick kernel methods by predicted comm time and (b) sanity-check
+measured bench results against speed-of-light.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    name: str
+    bf16_tflops: float          # dense MXU peak
+    int8_tops: float
+    hbm_gbps: float             # HBM bandwidth, GB/s
+    ici_gbps_per_link: float    # one direction, per link, GB/s
+    ici_links: int              # torus links per chip
+    vmem_mib: int
+
+
+# Public spec-sheet numbers (cloud.google.com/tpu/docs/system-architecture).
+CHIP_SPECS = {
+    "v4": ChipSpec("v4", 275, 275, 1228, 50, 6, 128),
+    "v5e": ChipSpec("v5e", 197, 394, 819, 50, 4, 128),
+    "v5p": ChipSpec("v5p", 459, 918, 2765, 100, 6, 128),
+    "v6e": ChipSpec("v6e", 918, 1836, 1640, 100, 4, 128),
+}
+
+_KIND_ALIASES = {
+    "tpu v4": "v4",
+    "tpu v5 lite": "v5e",
+    "tpu v5e": "v5e",
+    "tpu v5": "v5p",
+    "tpu v5p": "v5p",
+    "tpu v6 lite": "v6e",
+    "tpu v6e": "v6e",
+}
+
+
+def detect_chip(default: str = "v5e") -> ChipSpec:
+    """Map ``jax.devices()[0].device_kind`` to a spec (≙ the reference's
+    pynvml device-name lookup, gemm_perf_model.py:14-60)."""
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return CHIP_SPECS[default]
+    for alias, name in sorted(_KIND_ALIASES.items(), key=lambda kv: -len(kv[0])):
+        if alias in kind:
+            return CHIP_SPECS[name]
+    return CHIP_SPECS[default]
+
+
+def estimate_gemm_sol_time_ms(
+    m: int, n: int, k: int, dtype_bytes: int = 2, spec: ChipSpec | None = None
+) -> float:
+    """Speed-of-light GEMM time: max(compute roofline, memory roofline)
+    (≙ ``estimate_gemm_sol_time_ms``, reference gemm_perf_model.py:233)."""
+    spec = spec or detect_chip()
+    flops = 2.0 * m * n * k
+    peak = (spec.int8_tops if dtype_bytes == 1 else spec.bf16_tflops) * 1e12
+    t_compute = flops / peak
+    bytes_moved = (m * k + k * n + m * n) * dtype_bytes
+    t_mem = bytes_moved / (spec.hbm_gbps * 1e9)
+    return max(t_compute, t_mem) * 1e3
+
+
+def estimate_ring_collective_time_ms(
+    payload_bytes: int,
+    n_pes: int,
+    spec: ChipSpec | None = None,
+    bidirectional: bool = True,
+) -> float:
+    """Ring allgather / reduce-scatter time over ICI: each PE moves
+    ``payload * (n-1)/n`` bytes through its link(s)
+    (≙ ``estimate_reduce_scatter_time``, comm_perf_model.py:91)."""
+    spec = spec or detect_chip()
+    if n_pes <= 1:
+        return 0.0
+    ici = spec.ici_gbps_per_link * 1e9 * (2 if bidirectional else 1)
+    return payload_bytes * (n_pes - 1) / n_pes / ici * 1e3
+
+
+def estimate_all_to_all_time_ms(
+    slab_bytes: int, n_pes: int, spec: ChipSpec | None = None
+) -> float:
+    """All-to-all: each PE injects ``(n-1) * slab`` bytes; on a 1-D torus
+    bisection limits throughput to ~2 links each way."""
+    spec = spec or detect_chip()
+    if n_pes <= 1:
+        return 0.0
+    inject = slab_bytes * (n_pes - 1)
+    return inject / (2 * spec.ici_gbps_per_link * 1e9) * 1e3
+
+
+def overlap_efficiency(t_fused_ms: float, t_compute_ms: float, t_comm_ms: float) -> float:
+    """How much of the comm time the fused kernel hid:
+    1.0 = perfect overlap (fused == max(comp, comm)), 0.0 = fully serial.
+    The headline metric of the reference's charts (README.md:181-195)."""
+    serial = t_compute_ms + t_comm_ms
+    ideal = max(t_compute_ms, t_comm_ms)
+    if serial <= ideal:
+        return 1.0
+    return max(0.0, min(1.0, (serial - t_fused_ms) / (serial - ideal)))

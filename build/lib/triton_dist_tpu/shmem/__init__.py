@@ -1,0 +1,11 @@
+"""SHMEM-like one-sided communication layer for TPU.
+
+``device`` — in-kernel ops (≙ reference ``libshmem_device`` L3 + ``dl.*`` L4)
+``host``   — symmetric buffers + host collectives (≙ pynvshmem L5)
+"""
+
+from triton_dist_tpu.shmem import device as device
+from triton_dist_tpu.shmem.host import (
+    create_symmetric_tensor,
+    symm_spec,
+)

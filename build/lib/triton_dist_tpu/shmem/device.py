@@ -1,0 +1,308 @@
+"""Device-side SHMEM library: one-sided remote ops inside Pallas kernels.
+
+This is the TPU-native re-design of the reference's device-side OpenSHMEM
+surface — ``patches/triton/python/triton/language/extra/libshmem_device.py``
+(337 LoC portable stub; full list in reference ``docs/primitives.md:19-56``)
+and the ``dl.*`` dialect ops (``python/triton_dist/language.py:57-112``).
+
+Mapping (see SURVEY.md §7 design table):
+
+====================================  =======================================
+reference (NVSHMEM / dialect)          here (Pallas TPU)
+====================================  =======================================
+``my_pe()`` / ``n_pes()``              ``my_pe(axis)`` / ``n_pes(axis)``
+                                       (mesh-axis scoped, like teams)
+``putmem_nbi_block(dst,src,sz,pe)``    ``putmem_nbi_block(...)`` →
+                                       ``pltpu.make_async_remote_copy``
+``putmem_signal_nbi_block(...)``       same op: the *receive semaphore* IS
+                                       the data-coupled signal — signal
+                                       arrival implies data arrival, which
+                                       NVSHMEM needs fence()+signal for
+``signal_op(sig, SET/ADD, pe)``        ``signal_op(sem, inc, pe, axis)`` —
+                                       TPU semaphores are ADD-native; SET is
+                                       replaced by monotonic versioned
+                                       counters (the reference itself does
+                                       this: ``call_count`` in
+                                       ``low_latency_all_to_all.py:163``)
+``signal_wait_until(sig, EQ, v)``      ``signal_wait_until(sem, v)`` —
+                                       consuming wait (sem -= v)
+``dl.wait(ptr, n, scope, sem)``        ``wait(sem, v)`` (same consuming wait)
+``dl.consume_token``                   intentionally dropped: Pallas ref
+                                       semantics already order loads after
+                                       semaphore waits (no compiler fence op
+                                       needed — SURVEY.md §7)
+``barrier_all[_block/_warp]``          ``barrier_all(*axes)`` dissemination
+                                       barrier on the hardware barrier
+                                       semaphore
+``fence()`` / ``quiet()``              ``quiet(*handles)`` waits local send
+                                       semaphores. There is no fence: TPU
+                                       remote-DMA ordering is expressed only
+                                       through data-coupled recv semaphores
+``getmem*`` / ``symm_at`` loads        **no remote loads on TPU** — pull
+                                       algorithms are restructured as push
+                                       (``getmem*`` raise, with guidance)
+``int_p / remote_ptr``                 not needed: symmetric buffers are
+                                       SPMD refs; addressing is (ref, pe)
+====================================  =======================================
+
+All functions must be called inside a ``pl.pallas_call`` kernel that is
+itself traced under ``jax.shard_map`` over a ``jax.sharding.Mesh`` (that is
+what makes every buffer symmetric across PEs by construction).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl  # noqa: F401  (re-exported idiom)
+from jax.experimental.pallas import tpu as pltpu
+
+
+# ---------------------------------------------------------------------------
+# PE queries (≙ nvshmem_my_pe / n_pes / team_my_pe; mesh axes play the role
+# of SHMEM teams)
+# ---------------------------------------------------------------------------
+
+def my_pe(axis: str | Sequence[str]):
+    """This device's index along `axis` (flattened if several axes)."""
+    if isinstance(axis, str):
+        return jax.lax.axis_index(axis)
+    idx = jnp.int32(0)
+    for name in axis:
+        idx = idx * n_pes(name) + jax.lax.axis_index(name)
+    return idx
+
+
+def n_pes(axis: str | Sequence[str]) -> int:
+    """Static size of `axis` (product if several axes)."""
+    if isinstance(axis, str):
+        return int(jax.lax.axis_size(axis))
+    return int(math.prod(int(jax.lax.axis_size(a)) for a in axis))
+
+
+def pe_dev_id(axis: str, pe):
+    """MESH device_id selecting index `pe` along `axis` (other axes stay at
+    this device's own coordinates)."""
+    return {axis: pe}
+
+
+# ---------------------------------------------------------------------------
+# One-sided puts (≙ putmem_* family)
+# ---------------------------------------------------------------------------
+
+class PutHandle:
+    """Handle for an in-flight one-sided put.
+
+    Wraps Pallas's ``AsyncCopyDescriptor`` and records — at trace time, which
+    is exact because distributed kernels unroll their comm loops in Python —
+    whether ``wait_send`` has already consumed the send semaphore. Semaphore
+    waits are *consuming* (sem -= value), so waiting the same put's send side
+    twice deadlocks on real hardware exactly as in the interpreter; the
+    record lets :func:`quiet` be safely called on every handle at kernel end
+    without double-waiting ones that were recycled mid-loop.
+    """
+
+    __slots__ = ("desc", "send_waited")
+
+    def __init__(self, desc):
+        self.desc = desc
+        self.send_waited = False
+
+    def wait_send(self):
+        """Wait local completion: the source buffer is reusable after this."""
+        self.desc.wait_send()
+        self.send_waited = True
+
+    def wait_recv(self):
+        """Wait one incoming symmetric transfer on this put's recv semaphore
+        (SPMD symmetry: peers use the same semaphore slot, so this observes
+        the arrival *into* this PE, not our outbound put's remote delivery)."""
+        self.desc.wait_recv()
+
+    def wait(self):
+        self.wait_send()
+        self.wait_recv()
+
+
+def putmem_nbi_block(dst_ref, src_ref, pe, axis: str, send_sem, recv_sem):
+    """Non-blocking one-sided put: write local `src_ref` into PE `pe`'s
+    `dst_ref` (≙ ``libshmem_device.putmem_nbi_block``,
+    reference docs/primitives.md:34).
+
+    Returns the started ``AsyncCopyDescriptor``. The *remote* device's
+    `recv_sem` is incremented when the data has fully landed — this is the
+    data-coupled signal that replaces NVSHMEM's separate
+    ``putmem_signal``/``fence`` pair. Call ``.wait_send()`` (or
+    :func:`quiet`) before reusing `src_ref`.
+    """
+    copy = pltpu.make_async_remote_copy(
+        src_ref=src_ref,
+        dst_ref=dst_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=pe_dev_id(axis, pe) if not isinstance(pe, dict) else pe,
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    copy.start()
+    return PutHandle(copy)
+
+
+def putmem_block(dst_ref, src_ref, pe, axis: str, send_sem, recv_sem):
+    """Blocking put: returns after the local source is safe to reuse
+    (≙ ``putmem_block``; NVSHMEM's blocking puts likewise only guarantee
+    local completion)."""
+    copy = putmem_nbi_block(dst_ref, src_ref, pe, axis, send_sem, recv_sem)
+    copy.wait_send()
+    return copy
+
+
+def putmem_signal_nbi_block(dst_ref, src_ref, sig_sem, pe, axis: str, send_sem):
+    """Put + signal in one op (≙ ``putmem_signal_nbi_block``,
+    docs/primitives.md:40): on TPU the signal is simply the remote receive
+    semaphore of the same DMA, so arrival of the signal *implies* arrival of
+    the data (stronger than NVSHMEM, which needs NVSHMEM_SIGNAL_ADD +
+    ordering)."""
+    return putmem_nbi_block(dst_ref, src_ref, pe, axis, send_sem, recv_sem=sig_sem)
+
+
+def getmem_nbi_block(*_args, **_kwargs):
+    raise NotImplementedError(
+        "TPU has no one-sided remote *loads* (no nvshmem_ptr/symm_at "
+        "dereference). Restructure the algorithm as a push from the data "
+        "owner — see SURVEY.md §7 'Hard parts' and e.g. the push-based "
+        "EP combine in triton_dist_tpu/ops/ep_a2a.py."
+    )
+
+
+getmem_block = getmem_nbi_block
+remote_ptr = getmem_nbi_block  # ≙ symm_at / nvshmem_ptr: intentionally absent
+
+
+# ---------------------------------------------------------------------------
+# Signals (≙ signal_op / signal_wait_until / dl.wait / dl.notify)
+# ---------------------------------------------------------------------------
+
+def signal_op(sem, inc=1, pe=None, axis: str | None = None):
+    """Increment a (possibly remote) semaphore (≙ ``signal_op`` with
+    NVSHMEM_SIGNAL_ADD, and ≙ ``dl.notify(sig="add")``,
+    language.py:98-112). SET semantics do not exist on TPU semaphores —
+    use monotonically increasing expected values instead."""
+    if pe is None:
+        pltpu.semaphore_signal(sem, inc)
+    else:
+        pltpu.semaphore_signal(
+            sem,
+            inc,
+            device_id=pe_dev_id(axis, pe) if not isinstance(pe, dict) else pe,
+            device_id_type=pltpu.DeviceIdType.MESH,
+        )
+
+
+def signal_wait_until(sem, value):
+    """Block until `sem` >= value, then consume (sem -= value)
+    (≙ ``signal_wait_until(CMP_EQ)`` given monotonic counters)."""
+    pltpu.semaphore_wait(sem, value)
+
+
+def wait(sem, value=1):
+    """≙ ``dl.wait(barrier_ptr, n, scope, semantic)`` (language.py:57-70):
+    spin until the flag semaphore reaches `value`. The acquire semantics and
+    the follow-up ``dl.consume_token`` are implicit — Pallas orders ref
+    reads after the wait."""
+    pltpu.semaphore_wait(sem, value)
+
+
+def consume_token(token=None):  # noqa: ARG001
+    """No-op, kept for API parity with ``dl.consume_token``
+    (language.py:72-80). On TPU the dependency is structural."""
+    return None
+
+
+def signal_read(sem):
+    """Non-destructive read of a semaphore's current value."""
+    return pltpu.semaphore_read(sem)
+
+
+def quiet(*copies):
+    """Wait local (send) completion of the given nbi puts
+    (≙ ``libshmem_device.quiet``): after return, source buffers are
+    reusable. Does NOT imply remote delivery — remote delivery is observed
+    through the receiver's semaphore, as in NVSHMEM. Handles whose send was
+    already waited mid-kernel are skipped (consuming semantics — a second
+    wait would deadlock)."""
+    for c in copies:
+        if isinstance(c, PutHandle) and c.send_waited:
+            continue
+        c.wait_send()
+
+
+def fence():
+    """≙ ``libshmem_device.fence``. Intentionally a no-op with a warning in
+    the docstring rather than a runtime op: TPU remote DMAs carry their own
+    completion semaphores and there is no inter-DMA ordering primitive.
+    Order-sensitive protocols must chain on semaphores."""
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Barriers (≙ barrier_all / barrier_all_block / sync_all)
+# ---------------------------------------------------------------------------
+
+def barrier_all(axis: str | Sequence[str] = "tp"):
+    """Dissemination barrier over all PEs of `axis` using the hardware
+    barrier semaphore (≙ ``libshmem_device.barrier_all`` and the device
+    barrier kernels in reference ``common_ops.py:45-160``).
+
+    ceil(log2(n)) rounds; in round r each PE signals (me + 2^r) % n and
+    consumes one signal. Requires ``collective_id`` to be set in the
+    kernel's ``pltpu.CompilerParams``.
+
+    Cross-invocation caveat: the barrier semaphore is shared between
+    launches with the same collective_id, so a PE racing far ahead into
+    launch k+1 could in principle satisfy a slow PE's launch-k wait early.
+    This framework relies on the Mosaic runtime serializing collective
+    kernels that share a collective_id (and on XLA's in-order per-device
+    queues), which is the same contract the official Pallas distributed
+    kernels assume. Do not give two kernels that may run concurrently the
+    same ``dist_pallas_call(name=...)``.
+    """
+    axes = [axis] if isinstance(axis, str) else list(axis)
+    sizes = [n_pes(a) for a in axes]
+    n = int(math.prod(sizes))
+    if n == 1:
+        return
+    sem = pltpu.get_barrier_semaphore()
+    me = my_pe(axes if len(axes) > 1 else axes[0])
+    rounds = max(1, math.ceil(math.log2(n)))
+    for r in range(rounds):
+        partner = jax.lax.rem(me + (1 << r), n)
+        # unflatten partner into per-axis coordinates (row-major)
+        dev_id = {}
+        rem_idx = partner
+        for a, s in zip(reversed(axes), reversed(sizes)):
+            dev_id[a] = jax.lax.rem(rem_idx, s)
+            rem_idx = jax.lax.div(rem_idx, s)
+        pltpu.semaphore_signal(sem, 1, device_id=dev_id, device_id_type=pltpu.DeviceIdType.MESH)
+        pltpu.semaphore_wait(sem, 1)
+
+
+sync_all = barrier_all  # ≙ sync_all (no quiet needed: see quiet() contract)
+
+
+def barrier_neighbors(axis: str = "tp"):
+    """Cheap ring-neighbor barrier: sync only with left/right neighbors
+    (sufficient before ring sends; ≙ the reference's intra-node
+    two-phase barrier on PCIe, common_ops.py:104-160)."""
+    n = n_pes(axis)
+    if n == 1:
+        return
+    sem = pltpu.get_barrier_semaphore()
+    me = my_pe(axis)
+    left = jax.lax.rem(me - 1 + n, n)
+    right = jax.lax.rem(me + 1, n)
+    pltpu.semaphore_signal(sem, 1, device_id={axis: left}, device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_signal(sem, 1, device_id={axis: right}, device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_wait(sem, 2)

@@ -1,0 +1,70 @@
+"""Host-side symmetric-buffer helpers (≙ pynvshmem L5).
+
+The reference's host runtime (``shmem/nvshmem_bind/pynvshmem``) exists to
+(1) bootstrap NVSHMEM, (2) allocate tensors on the symmetric heap
+(``nvshmem_create_tensor``, ``__init__.py:153-194``), and (3) expose
+stream-ordered host puts/barriers. On TPU:
+
+(1) collapses into mesh creation (``parallel.mesh``);
+(2) is ``create_symmetric_tensor`` below — a mesh-sharded array whose
+    per-device shard has identical shape on every device, which is exactly
+    the symmetric-heap invariant (Pallas remote copies require it);
+(3) host-initiated data plane has no TPU analogue mid-program — host code
+    composes *kernels* instead of issuing stream ops; the "golden" host
+    collectives are ``jax.lax.all_gather`` etc. (see tests).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def symm_spec(axis: str) -> P:
+    """PartitionSpec for a symmetric buffer with a leading PE dimension."""
+    return P(axis)
+
+
+def create_symmetric_tensor(
+    mesh: Mesh,
+    shape: Sequence[int],
+    dtype=jnp.float32,
+    axis: str = "tp",
+    fill: float | None = 0.0,
+) -> jax.Array:
+    """Allocate a symmetric tensor: every PE along `axis` owns one
+    `shape`-shaped shard (≙ ``pynvshmem.nvshmem_create_tensor``,
+    pynvshmem/__init__.py:153-168).
+
+    Returns a global array of shape ``(n_pes, *shape)`` sharded so that
+    shard i lives on PE i. Inside ``jax.shard_map`` with in_spec
+    ``P(axis)`` each PE sees its own ``(1, *shape)`` view. Persistent
+    double-buffered workspaces (EP all-to-all recv buffers etc.) are built
+    from these and threaded through calls functionally (donated via
+    ``jax.jit(donate_argnums=...)`` for in-place reuse).
+    """
+    n = int(mesh.shape[axis])
+    global_shape = (n, *shape)
+    sharding = NamedSharding(mesh, P(axis, *([None] * len(shape))))
+    if fill is None:
+        return jax.device_put(
+            jnp.empty(global_shape, dtype=dtype), sharding
+        )
+    return jax.device_put(jnp.full(global_shape, fill, dtype=dtype), sharding)
+
+
+def create_symmetric_tensor_list(
+    mesh: Mesh, shape: Sequence[int], dtype=jnp.float32, axis: str = "tp", n_bufs: int = 2
+) -> list[jax.Array]:
+    """List-of-buffers variant (≙ ``nvshmem_create_tensor_list_intra_node``)
+    used for double buffering."""
+    return [create_symmetric_tensor(mesh, shape, dtype, axis) for _ in range(n_bufs)]
+
+
+def replicate(mesh: Mesh, x: jax.Array) -> jax.Array:
+    """Place `x` fully-replicated over the mesh."""
+    return jax.device_put(x, NamedSharding(mesh, P(*([None] * x.ndim))))

@@ -1,0 +1,247 @@
+"""Host-side utilities: timing, allclose, rank-aware printing, seeding.
+
+TPU-native re-design of the reference's ``python/triton_dist/utils.py``
+(dist_print :201, assert_allclose :789-818, perf_func :186-198,
+init_seed :75-88). CUDA-event timing becomes ``block_until_ready`` walltime;
+per-rank seeding becomes ``jax.random`` key folding.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import math
+import os
+import time
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(a: int, b: int) -> int:
+    return cdiv(a, b) * b
+
+
+def next_power_of_2(x: int) -> int:
+    return 1 if x <= 1 else 2 ** math.ceil(math.log2(x))
+
+
+def pick_block(dim: int, block: int) -> int:
+    """Largest divisor of `dim` that is <= `block` and power-of-2-shrinkable
+    from it (block-shape picker shared by the fused kernels)."""
+    block = min(block, dim)
+    while dim % block != 0:
+        block //= 2
+    return max(block, 1)
+
+
+def dist_print(*args: Any, rank: int | None = None, prefix: bool = True, allowed_ranks: Sequence[int] | str = (0,), **kwargs: Any) -> None:
+    """Rank-filtered printing (≙ reference utils.py:201-230).
+
+    In JAX the host process is usually singular even for many devices, so
+    ranks here are process indices (multi-host) rather than device ranks.
+    `rank` is shorthand for ``allowed_ranks=(rank,)``.
+    """
+    pid = jax.process_index()
+    if rank is not None:
+        allowed = (rank,)
+    elif allowed_ranks == "all":
+        allowed = range(jax.process_count())
+    else:
+        allowed = allowed_ranks
+    if pid in allowed:
+        if prefix:
+            print(f"[rank {pid}]", *args, **kwargs)
+        else:
+            print(*args, **kwargs)
+
+
+def init_seed(seed: int = 0, rank: int | None = None) -> jax.Array:
+    """Deterministic per-rank seeding (≙ reference utils.py:75-88)."""
+    rank = jax.process_index() if rank is None else rank
+    np.random.seed(seed + rank)
+    return jax.random.fold_in(jax.random.PRNGKey(seed), rank)
+
+
+def assert_allclose(x: jax.Array, y: jax.Array, atol: float = 1e-3, rtol: float = 1e-3, verbose: bool = True) -> None:
+    """Verbose allclose (≙ reference utils.py:789-818): reports worst
+    mismatch location/magnitude instead of a bare boolean."""
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    if x.shape != y.shape:
+        raise AssertionError(f"shape mismatch: {x.shape} vs {y.shape}")
+    err = np.abs(x - y) - (atol + rtol * np.abs(y))
+    bad = err > 0
+    if bad.any():
+        n_bad = int(bad.sum())
+        idx = np.unravel_index(np.argmax(err), err.shape)
+        msg = (
+            f"allclose failed: {n_bad}/{x.size} elements "
+            f"({100.0 * n_bad / x.size:.3f}%) exceed atol={atol} rtol={rtol}; "
+            f"worst at {idx}: {x[idx]} vs {y[idx]} (abs err {abs(x[idx]-y[idx]):.6g})"
+        )
+        if verbose:
+            print(msg)
+        raise AssertionError(msg)
+
+
+def _sync(out: Any) -> None:
+    """Force device completion of everything enqueued so far.
+
+    ``jax.block_until_ready`` is not a real sync on remote/tunneled device
+    backends, so fetch one scalar per shard to host — each device queue is
+    in-order, so the readback implies all prior programs on it completed."""
+    jax.block_until_ready(out)
+    for leaf in jax.tree.leaves(out):
+        if not hasattr(leaf, "addressable_shards"):
+            continue
+        for shard in leaf.addressable_shards:
+            data = shard.data
+            if data.size:
+                jax.device_get(data.ravel()[0])
+
+
+def perf_func(fn: Callable[[], Any], iters: int = 10, warmup_iters: int = 3) -> tuple[Any, float]:
+    """Time a jitted thunk, returning (last_output, mean_ms)
+    (≙ reference utils.py:186-198, CUDA events → walltime).
+
+    Uses delta timing — two loop sizes, subtracting — so the constant
+    sync/readback overhead (70 ms over a tunneled TPU) cancels out.
+    """
+    out = None
+    for _ in range(max(warmup_iters, 1)):
+        out = fn()
+    _sync(out)
+
+    def timed(k: int) -> float:
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(k):
+            o = fn()
+        _sync(o)
+        return time.perf_counter() - t0
+
+    n1 = max(1, iters // 4)
+    n2 = n1 + iters
+    t1 = timed(n1)
+    t2 = timed(n2)
+    return out, max(t2 - t1, 1e-9) * 1e3 / (n2 - n1)
+
+
+def perf_func_loop(
+    op: Callable[..., Any],
+    args: Sequence[Any],
+    iters: int = 100,
+    trials: int = 3,
+    perturb_idx: int = 0,
+    consume: str = "first",
+) -> float:
+    """On-device loop timing: run `op(*args)` `iters` times inside one jitted
+    ``lax.while_loop`` and return the median per-iteration ms.
+
+    Per-call timing over a tunneled TPU is dominated by per-dispatch RPC
+    cost (hundreds of µs), which buries µs-scale kernels; a device-side loop
+    measures only device time. Each iteration scatter-adds a vanishing
+    multiple of the output into one element of array arg ``perturb_idx`` —
+    a 1-element dynamic-update-slice that aliases the loop carry, chaining
+    iterations so neither XLA nor the scheduler can hoist, CSE, or overlap
+    them.
+
+    `consume` picks how much of the output feeds that chain:
+
+    - ``"first"`` (default) — one element. Correct for SIDE-EFFECTFUL ops
+      (our Pallas kernels): they execute in full regardless, and a bigger
+      dependency would bill them an extra HBM read pass that a pure op
+      gets fused away.
+    - ``"all"`` — a full ``sum`` over every output leaf. REQUIRED for pure
+      XLA ops: anything partial lets dead-code elimination shrink the op to
+      the observed slice (a matmul collapses to one dot-product row). The
+      sum itself is ~free for XLA — it fuses into the producer's epilogue.
+
+    The trip count is a runtime argument (one compile); the loop is timed
+    at two different counts and scored on the delta, so the single launch's
+    constant dispatch/readback cost cancels as well. Non-array args (Mesh,
+    axis names) are closed over; only arrays ride the carry, and
+    `perturb_idx` indexes the *array* args.
+    """
+    args = tuple(args)
+    is_arr = [hasattr(a, "shape") and hasattr(a, "dtype") for a in args]
+    arr_args = tuple(a for a, f in zip(args, is_arr) if f)
+
+    def rebuild(arrs: tuple) -> tuple:
+        it = iter(arrs)
+        return tuple(next(it) if f else a for a, f in zip(args, is_arr))
+
+    def body(state):
+        i, carry = state
+        out = op(*rebuild(carry))
+        leaves = jax.tree.leaves(out)
+        if consume == "all":
+            scalar = sum(jnp.sum(l, dtype=jnp.float32) for l in leaves) * 1e-30
+        else:
+            scalar = leaves[0].ravel()[0].astype(jnp.float32) * 1e-30
+        x = carry[perturb_idx]
+        x = x.at[(0,) * x.ndim].add(scalar.astype(x.dtype))
+        return i + 1, carry[:perturb_idx] + (x,) + carry[perturb_idx + 1 :]
+
+    @jax.jit
+    def run(n, arrs):
+        return jax.lax.while_loop(
+            lambda s: s[0] < n, body, (jnp.int32(0), arrs)
+        )[1]
+
+    n1 = max(1, iters // 4)
+    n2 = n1 + iters
+    _sync(run(jnp.int32(n1), arr_args))  # compile + warm
+    ts = []
+    last_t2 = 1e-9
+    for _ in range(2 * trials):  # re-measure on jitter, up to 2x attempts
+        t0 = time.perf_counter()
+        _sync(run(jnp.int32(n1), arr_args))
+        t1 = time.perf_counter()
+        _sync(run(jnp.int32(n2), arr_args))
+        t2 = time.perf_counter()
+        last_t2 = t2 - t1
+        delta = ((t2 - t1) - (t1 - t0)) * 1e3 / iters
+        # a negative delta is jitter in the constant part exceeding the
+        # measured work — a FAILED sample, never "infinitely fast"
+        if delta > 0:
+            ts.append(delta)
+        if len(ts) == trials:
+            break
+    if not ts:
+        # every delta drowned in jitter: conservative absolute upper bound
+        # (includes the constant launch cost) instead of a nonsense floor
+        return last_t2 * 1e3 / n2
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+@contextlib.contextmanager
+def group_profile(name: str | None = None, do_prof: bool = True, log_dir: str = "prof"):
+    """Profiling context (≙ reference utils.py:417-501 `group_profile`).
+
+    The reference collects per-rank torch chrome traces and merges them; the
+    XLA profiler already records every local device in one trace, so this is
+    a thin wrapper over ``jax.profiler`` writing a Perfetto/TensorBoard trace.
+    """
+    if not do_prof:
+        yield
+        return
+    path = os.path.join(log_dir, name or "trace")
+    os.makedirs(path, exist_ok=True)
+    jax.profiler.start_trace(path)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def bytes_of(x: jax.Array | jax.ShapeDtypeStruct) -> int:
+    return int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize

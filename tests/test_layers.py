@@ -10,6 +10,7 @@ from jax.sharding import PartitionSpec as P
 from triton_dist_tpu.layers import (
     AllGatherLayer,
     EPAll2AllLayer,
+    HierEPAll2AllLayer,
     SpGQAFlashDecodeAttention,
     TPMLP,
     TPMoEMLP,
@@ -174,6 +175,116 @@ def test_ep_receiver_alignment(mesh4):
             for r in rows:
                 if r < t and rexp[pe][r] >= 0:
                     assert rexp[pe][r] == e or rexp[pe][r] == epr  # dummy
+
+
+def test_hier_ep_a2a_roundtrip(mesh2x4):
+    """Two-phase dispatch + identity experts + combine == topk-weighted
+    identity on a 2x4 mesh (the reference's node-then-local hierarchy)."""
+    n_o, n_i, m_loc, hidden, topk = 2, 4, 8, 64, 2
+    n_exp = 16
+    layer = HierEPAll2AllLayer(
+        n_experts=n_exp, topk=topk, max_m1=m_loc * topk,
+        max_m2=n_o * m_loc * topk, outer="dp", inner="tp",
+    )
+    world = n_o * n_i
+    m_tot = world * m_loc
+    x = jax.random.normal(jax.random.PRNGKey(30), (m_tot, hidden), jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(31), (m_tot, topk), 0, n_exp, jnp.int32)
+    tw = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(32), (m_tot, topk)))
+
+    def fn(x, ids, tw):
+        recv, info = layer.dispatch(x, ids, tw)
+        out = layer.combine(recv, info, m_loc)  # identity "experts"
+        return out, info.overflow[None]
+
+    got, ovf = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh2x4,
+            in_specs=(P(("dp", "tp"), None), P(("dp", "tp"), None), P(("dp", "tp"), None)),
+            out_specs=(P(("dp", "tp"), None), P(("dp", "tp"))), check_vma=False,
+        )
+    )(x, ids, tw)
+    assert int(np.asarray(ovf).sum()) == 0
+    want = np.asarray(x) * np.asarray(tw.sum(-1))[:, None]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_hier_ep_a2a_dedups_cross_node_traffic(mesh2x4):
+    """The hierarchy's bandwidth property: when a token's topk experts all
+    live on ONE node, exactly one copy crosses the outer axis (flat
+    dispatch would send topk copies)."""
+    n_o, n_i, m_loc, hidden, topk = 2, 4, 8, 32, 2
+    n_exp = 16
+    layer = HierEPAll2AllLayer(
+        n_experts=n_exp, topk=topk, max_m1=m_loc * topk,
+        max_m2=n_o * m_loc * topk, outer="dp", inner="tp",
+    )
+    m_tot = n_o * n_i * m_loc
+    x = jax.random.normal(jax.random.PRNGKey(36), (m_tot, hidden), jnp.float32)
+    # every token: two DIFFERENT experts of node 0 (global experts 0..7)
+    ids = jnp.stack(
+        [jnp.zeros(m_tot, jnp.int32), jnp.full(m_tot, 5, jnp.int32)], axis=1
+    )
+    tw = jnp.full((m_tot, topk), 0.5, jnp.float32)
+
+    def fn(x, ids, tw):
+        recv, info = layer.dispatch(x, ids, tw)
+        return info.send_splits1, layer.combine(recv, info, m_loc)
+
+    splits1, got = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh2x4,
+            in_specs=(P(("dp", "tp"), None),) * 3,
+            out_specs=(P(("dp", "tp")), P(("dp", "tp"), None)),
+            check_vma=False,
+        )
+    )(x, ids, tw)
+    splits1 = np.asarray(splits1).reshape(n_o * n_i, n_o)
+    # one phase-1 row per token (not topk) and only toward node 0
+    assert np.array_equal(splits1[:, 0], np.full(n_o * n_i, m_loc))
+    assert np.array_equal(splits1[:, 1], np.zeros(n_o * n_i))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(x), rtol=1e-5, atol=1e-5)
+
+
+def test_hier_ep_a2a_expert_compute(mesh2x4):
+    """Dispatch to per-expert scaling 'experts' and combine: checks the
+    phase-2 local-expert routing (not just the roundtrip)."""
+    n_o, n_i, m_loc, hidden, topk = 2, 4, 4, 32, 2
+    n_exp = 8
+    epr = n_exp // (n_o * n_i)
+    layer = HierEPAll2AllLayer(
+        n_experts=n_exp, topk=topk, max_m1=m_loc * topk,
+        max_m2=n_o * m_loc * topk, outer="dp", inner="tp",
+    )
+    world = n_o * n_i
+    m_tot = world * m_loc
+    x = jax.random.normal(jax.random.PRNGKey(33), (m_tot, hidden), jnp.float32)
+    ids = jax.random.randint(jax.random.PRNGKey(34), (m_tot, topk), 0, n_exp, jnp.int32)
+    tw = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(35), (m_tot, topk)))
+    # expert e multiplies by (e + 2)
+    def fn(x, ids, tw):
+        recv, info = layer.dispatch(x, ids, tw)
+        me_global = jax.lax.axis_index("dp") * n_i + jax.lax.axis_index("tp")
+        pos = jnp.arange(layer.max_m2, dtype=jnp.int32)[None, :]
+        valid = pos < info.recv_splits2[:, None]
+        gexp = me_global * epr + jnp.maximum(info.recv_expert, 0)
+        scale = jnp.where(valid, (gexp + 2).astype(jnp.float32), 0.0)
+        y = recv * scale[..., None]
+        return layer.combine(y, info, m_loc)
+
+    got = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh2x4,
+            in_specs=(P(("dp", "tp"), None), P(("dp", "tp"), None), P(("dp", "tp"), None)),
+            out_specs=P(("dp", "tp"), None), check_vma=False,
+        )
+    )(x, ids, tw)
+    want = np.zeros((m_tot, hidden), np.float32)
+    for t in range(m_tot):
+        for k in range(topk):
+            e = int(ids[t, k])
+            want[t] += float(tw[t, k]) * (e + 2) * np.asarray(x)[t]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
 
 
 def test_sp_layer_matches_op(mesh4):
