@@ -21,16 +21,16 @@ class BuildWithNative(build_py):
         # searches both locations.
         root = os.path.dirname(os.path.abspath(__file__))
         csrc = os.path.join(root, "csrc")
-        try:
+        try:  # the whole block: a failed/absent native build never blocks install
             subprocess.run(["make", "-C", csrc, "-s"], check=True, timeout=300)
             print(f"built native library in {csrc}")
+            dst = os.path.join(self.build_lib, "triton_dist_tpu", "csrc")
+            os.makedirs(dst, exist_ok=True)
+            for f in os.listdir(csrc):
+                if f.endswith((".cc", ".h", ".so")) or f == "Makefile":
+                    shutil.copy2(os.path.join(csrc, f), os.path.join(dst, f))
         except Exception as e:  # numpy fallback covers a missing toolchain
             print(f"WARNING: native csrc build skipped ({e}); numpy fallback active")
-        dst = os.path.join(self.build_lib, "triton_dist_tpu", "csrc")
-        os.makedirs(dst, exist_ok=True)
-        for f in os.listdir(csrc):
-            if f.endswith((".cc", ".h", ".so")) or f == "Makefile":
-                shutil.copy2(os.path.join(csrc, f), os.path.join(dst, f))
 
 
 setup(cmdclass={"build_py": BuildWithNative})

@@ -1,0 +1,53 @@
+"""SP decode/serving path vs the prefill forward (greedy tokens must
+match an autoregressive full-forward golden)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from triton_dist_tpu.models import TransformerConfig, init_params
+from triton_dist_tpu.models.decode import generate
+from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig
+from triton_dist_tpu.ops.flash_decode import FlashDecodeConfig
+from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig
+
+from tests.test_models import _ref_forward
+
+
+def test_generate_matches_full_forward(mesh4):
+    b, prompt_len, n_steps, s_max = 2, 4, 4, 16
+    cfg = TransformerConfig(
+        vocab=32, hidden=32, ffn=64, n_layers=2, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=b, seq=prompt_len + n_steps,
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (b, prompt_len), 0, cfg.vocab, jnp.int32
+    )
+
+    got = generate(
+        cfg, params, prompt, n_steps, mesh4, s_max=s_max,
+        fd_config=FlashDecodeConfig(block_s=4),
+    )
+    assert got.shape == (b, n_steps)
+
+    # golden: autoregressive greedy with a full causal forward each step
+    # (_ref_forward is fixed-shape over cfg.seq — restyle per step length)
+    toks = np.asarray(prompt)
+    for step in range(n_steps):
+        cur_len = prompt_len + step
+        cfg_step = TransformerConfig(
+            vocab=cfg.vocab, hidden=cfg.hidden, ffn=cfg.ffn,
+            n_layers=cfg.n_layers, n_q_heads=cfg.n_q_heads,
+            n_kv_heads=cfg.n_kv_heads, head_dim=cfg.head_dim,
+            batch=b, seq=cur_len,
+        )
+        logits = _ref_forward(
+            jnp.asarray(toks.reshape(-1)), params, cfg_step
+        ).reshape(b, cur_len, cfg.vocab)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1), np.int32)
+        toks = np.concatenate([toks, nxt[:, None]], axis=1)
+    want = toks[:, prompt_len:]
+    np.testing.assert_array_equal(np.asarray(got), want)

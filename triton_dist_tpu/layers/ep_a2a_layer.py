@@ -27,6 +27,30 @@ from triton_dist_tpu.ops.all_to_all import fast_all_to_all
 from triton_dist_tpu.ops.moe_utils import MoEAlignment, moe_align_block_size
 
 
+def _pack_slabs(dest: jax.Array, n_dest: int, max_m: int):
+    """Sort-and-slot slab packing shared by all dispatch paths: stable-sort
+    assignments by destination, compute each one's slot in its destination
+    slab, clamp to capacity. ``dest == n_dest`` is the drop sentinel (it
+    indexes out of range, so ``.at[...].set(mode="drop")`` discards it,
+    exactly like capacity overflow).
+
+    Returns ``(order, dest_sorted, pos, offsets, clamped, overflow)`` —
+    offsets are the UNCLAMPED group starts in the sorted layout (what the
+    combine reversal indexes); ``clamped`` is what actually ships.
+    """
+    t = dest.shape[0]
+    order = jnp.argsort(dest, stable=True).astype(jnp.int32)
+    dest_sorted = dest[order]
+    counts = jnp.bincount(dest, length=n_dest + 1)[:n_dest].astype(jnp.int32)
+    offsets = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1]])
+    pos = jnp.arange(t, dtype=jnp.int32) - offsets[
+        jnp.clip(dest_sorted, 0, n_dest - 1)
+    ]
+    clamped = jnp.minimum(counts, max_m)
+    overflow = jnp.sum(counts - clamped)
+    return order, dest_sorted, pos, offsets, clamped, overflow
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class DispatchInfo:
@@ -80,19 +104,15 @@ class EPAll2AllLayer:
         t = m_loc * self.topk
         flat_ids = topk_ids.reshape(-1)
         dest = flat_ids // epr                                   # [t]
-        order = jnp.argsort(dest, stable=True).astype(jnp.int32)
-        dest_sorted = dest[order]
-        counts = jnp.bincount(dest, length=n).astype(jnp.int32)
-        offsets = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1]])
-        pos = (jnp.arange(t, dtype=jnp.int32) - offsets[dest_sorted])
         # Slab overflow drops the assignment (static max_m contract), and the
         # splits are clamped to match what was actually transported — the
         # bookkeeping must never claim more rows than the slab holds (the
         # reference fails loudly instead: assert num_tokens <= ctx.max_m,
         # low_latency_all_to_all.py:212). `overflow` surfaces undersized
         # max_m to the caller; check it in tests / debug runs.
-        clamped = jnp.minimum(counts, self.max_m)
-        overflow = jnp.sum(counts - clamped)
+        order, dest_sorted, pos, offsets, clamped, overflow = _pack_slabs(
+            dest, n, self.max_m
+        )
         send = jnp.zeros((n, self.max_m, hidden), tokens.dtype)
         send = send.at[dest_sorted, pos].set(
             tokens[order // self.topk], mode="drop"
@@ -263,17 +283,9 @@ class HierEPAll2AllLayer:
         keep = ~dup.reshape(-1)                               # [t]
 
         dest1 = jnp.where(keep, dest_o, n_o)                  # drop sentinel
-        order1 = jnp.argsort(dest1, stable=True).astype(jnp.int32)
-        dest1_sorted = dest1[order1]
-        counts1 = jnp.bincount(dest1, length=n_o + 1)[:n_o].astype(jnp.int32)
-        offsets1 = jnp.concatenate(
-            [jnp.zeros(1, jnp.int32), jnp.cumsum(counts1)[:-1]]
+        order1, dest1_sorted, pos1, offsets1, clamped1, overflow1 = _pack_slabs(
+            dest1, n_o, self.max_m1
         )
-        pos1 = jnp.arange(t, dtype=jnp.int32) - offsets1[
-            jnp.clip(dest1_sorted, 0, n_o - 1)
-        ]
-        clamped1 = jnp.minimum(counts1, self.max_m1)
-        overflow1 = jnp.sum(counts1 - clamped1)
         send1 = jnp.zeros((n_o, self.max_m1, hidden), tokens.dtype)
         send1 = send1.at[dest1_sorted, pos1].set(
             tokens[order1 // self.topk], mode="drop"
@@ -317,17 +329,9 @@ class HierEPAll2AllLayer:
             & (g_outer == my_o)
         )
         dest2 = jnp.where(amask, g_inner, n_i)
-        order2 = jnp.argsort(dest2, stable=True).astype(jnp.int32)
-        dest2_sorted = dest2[order2]
-        counts2 = jnp.bincount(dest2, length=n_i + 1)[:n_i].astype(jnp.int32)
-        offsets2 = jnp.concatenate(
-            [jnp.zeros(1, jnp.int32), jnp.cumsum(counts2)[:-1]]
+        order2, dest2_sorted, pos2, offsets2, clamped2, overflow2 = _pack_slabs(
+            dest2, n_i, self.max_m2
         )
-        pos2 = jnp.arange(R * self.topk, dtype=jnp.int32) - offsets2[
-            jnp.clip(dest2_sorted, 0, n_i - 1)
-        ]
-        clamped2 = jnp.minimum(counts2, self.max_m2)
-        overflow2 = jnp.sum(counts2 - clamped2)
         send2 = jnp.zeros((n_i, self.max_m2, hidden), tokens.dtype)
         send2 = send2.at[dest2_sorted, pos2].set(
             rows[order2 // self.topk], mode="drop"

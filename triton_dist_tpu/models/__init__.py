@@ -9,18 +9,30 @@ with dense and MoE blocks, differentiable end-to-end through the fused
 kernels' custom VJPs.
 """
 
+from triton_dist_tpu.models.decode import KVCacheSpec, decode_step, generate
 from triton_dist_tpu.models.tp_transformer import (
+    MoETransformerConfig,
     TransformerConfig,
+    TPMoETransformer,
     TPTransformer,
+    init_moe_params,
     init_params,
+    moe_param_specs,
     param_specs,
     train_step,
 )
 
 __all__ = [
+    "KVCacheSpec",
+    "decode_step",
+    "generate",
+    "MoETransformerConfig",
     "TransformerConfig",
+    "TPMoETransformer",
     "TPTransformer",
+    "init_moe_params",
     "init_params",
+    "moe_param_specs",
     "param_specs",
     "train_step",
 ]

@@ -1,0 +1,203 @@
+"""Serving-side decode for the TP transformer: sequence-parallel KV cache
++ distributed flash decode (≙ the reference's serving story — its
+`SpGQAFlashDecodeAttention` layer over `flash_decode.py`, scaled 1→32 GPUs
+in README.md:193-195; here the same (partial, lse) merge rides the fused
+allgather of ops/flash_decode.py).
+
+Layout at decode time (one token per sequence per step):
+
+- Activations are tiny (``[b, H]``) and REPLICATED — the Megatron AG/RS
+  machinery is prefill-shaped; decode projections are plain TP
+  (local columns / psum rows).
+- The KV cache is SEQUENCE-SHARDED over the tp axis: PE ``i`` owns
+  positions ``[i*s_shard, (i+1)*s_shard)`` of every layer's cache — the
+  SP/CP decode scaling axis. Each step, the PE owning the current position
+  appends the (head-complete) k/v; attention runs as per-shard
+  flash-decode partials merged by log-sum-exp.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.models.tp_transformer import (
+    TransformerConfig,
+    param_specs,
+    rmsnorm,
+    rope,
+)
+from triton_dist_tpu.ops.flash_decode import (
+    FlashDecodeConfig,
+    flash_decode_distributed,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheSpec:
+    """Cache geometry: per layer ``[b, h_kv, s_max, d]`` sharded on dim 2."""
+
+    s_max: int
+
+    def init(self, cfg: TransformerConfig) -> dict:
+        shape = (
+            cfg.n_layers, cfg.batch, cfg.n_kv_heads, self.s_max, cfg.head_dim
+        )
+        return dict(k=jnp.zeros(shape, cfg.dtype), v=jnp.zeros(shape, cfg.dtype))
+
+    def specs(self, cfg: TransformerConfig) -> dict:
+        t = cfg.axis
+        return dict(k=P(None, None, None, t, None), v=P(None, None, None, t, None))
+
+
+def decode_step(
+    cfg: TransformerConfig,
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,   # [b] int32 — this step's input token per sequence
+    pos: jax.Array,      # [] int32 — current position (same for the batch)
+    *,
+    s_shard: int,
+    fd_config: FlashDecodeConfig | None = None,
+    interpret: Any = None,
+) -> tuple[jax.Array, dict]:
+    """One decode step (call inside ``jax.shard_map``): returns
+    ``(logits [b, vocab], new_cache)``. ``cache['k']/['v']`` hold this PE's
+    sequence shard ``[L, b, h_kv, s_shard, d]``."""
+    c = cfg
+    n = int(jax.lax.axis_size(c.axis))
+    me = jax.lax.axis_index(c.axis)
+    g = c.n_q_heads // c.n_kv_heads
+    d = c.head_dim
+    hkv_loc = c.n_kv_heads // n
+
+    x = params["embed"][tokens]  # [b, H] replicated
+    k_cache, v_cache = cache["k"], cache["v"]
+    owner = pos // s_shard
+    off = pos % s_shard
+    pos1 = pos[None].astype(jnp.int32)
+
+    for li, p in enumerate(params["layers"]):
+        # --- attention (SP flash decode over the seq-sharded cache) ---
+        h = rmsnorm(x, p["attn_norm"], c.norm_eps)
+        qkv_loc = h @ p["wqkv"].reshape(c.hidden, -1)      # [b, qkv/n] local
+        # head-complete qkv: PE-major concat == kv-group-major (the groups
+        # are sharded contiguously), so a tiled all_gather restores the
+        # global group order
+        qkv = jax.lax.all_gather(qkv_loc, c.axis, axis=1, tiled=True)
+        qkv = qkv.reshape(c.batch, c.n_kv_heads, g + 2, d)
+        q = qkv[:, :, :g, :].reshape(c.batch, 1, c.n_q_heads, d)
+        k_new = qkv[:, :, g, :].reshape(c.batch, 1, c.n_kv_heads, d)
+        v_new = qkv[:, :, g + 1, :]                         # [b, h_kv, d]
+        q = rope(q, pos1, c.rope_theta)[:, 0]               # [b, hq, d]
+        k_new = rope(k_new, pos1, c.rope_theta)[:, 0]       # [b, h_kv, d]
+
+        # the owning PE appends this position's k/v to its shard
+        upd_k = jax.lax.dynamic_update_slice(
+            k_cache[li], k_new.astype(k_cache.dtype)[:, :, None, :],
+            (0, 0, off, 0),
+        )
+        upd_v = jax.lax.dynamic_update_slice(
+            v_cache[li], v_new.astype(v_cache.dtype)[:, :, None, :],
+            (0, 0, off, 0),
+        )
+        k_sh = jnp.where(me == owner, upd_k, k_cache[li])
+        v_sh = jnp.where(me == owner, upd_v, v_cache[li])
+        k_cache = k_cache.at[li].set(k_sh)
+        v_cache = v_cache.at[li].set(v_sh)
+
+        local_lens = jnp.full(
+            (c.batch,), jnp.clip(pos + 1 - me * s_shard, 0, s_shard), jnp.int32
+        )
+        attn = flash_decode_distributed(
+            q.astype(k_sh.dtype), k_sh, v_sh, local_lens,
+            axis=c.axis, config=fd_config, interpret=interpret,
+        )                                                    # [b, hq, d] f32
+        # row-parallel out-proj on the LOCAL head slice + psum
+        attn_loc = jax.lax.dynamic_slice_in_dim(
+            attn, me * (c.n_q_heads // n), c.n_q_heads // n, axis=1
+        ).reshape(c.batch, -1).astype(x.dtype)
+        x = x + jax.lax.psum(attn_loc @ p["wo"], c.axis)
+
+        # --- MLP (plain TP: local columns, psum rows) ---
+        h = rmsnorm(x, p["mlp_norm"], c.norm_eps)
+        gu = (h @ p["w_gate_up"].reshape(c.hidden, -1)).reshape(c.batch, -1, 2)
+        act = jax.nn.silu(gu[..., 0].astype(jnp.float32)).astype(x.dtype) * gu[..., 1]
+        x = x + jax.lax.psum(act @ p["w_down"], c.axis)
+
+    x = rmsnorm(x, params["final_norm"], c.norm_eps)
+    logits_loc = x @ params["lm_head"]                       # [b, V/n]
+    logits = jax.lax.all_gather(logits_loc, c.axis, axis=1, tiled=True)
+    return logits, dict(k=k_cache, v=v_cache)
+
+
+def generate(
+    cfg: TransformerConfig,
+    params: dict,
+    prompt: jax.Array,   # [b, prompt_len] int32
+    n_steps: int,
+    mesh: Mesh,
+    *,
+    s_max: int,
+    fd_config: FlashDecodeConfig | None = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Greedy generation: feed the prompt token-by-token (cache warmup),
+    then decode ``n_steps`` new tokens. Returns ``[b, n_steps]``.
+
+    Host-level entry; jits ONE fused program that lax.scans decode_step
+    over all positions (prompt phase ignores the model's predictions)."""
+    b, prompt_len = prompt.shape
+    assert b == cfg.batch
+    if prompt_len + n_steps > s_max:
+        # past s_max no PE owns the position: the k/v append would silently
+        # drop and attention would read stale cache — fail loudly instead
+        raise ValueError(
+            f"prompt_len={prompt_len} + n_steps={n_steps} exceeds the KV "
+            f"cache capacity s_max={s_max}"
+        )
+    spec = KVCacheSpec(s_max)
+    cache = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        spec.init(cfg), spec.specs(cfg),
+    )
+    s_shard = s_max // mesh.shape[cfg.axis]
+    step = functools.partial(
+        decode_step, cfg, s_shard=s_shard, fd_config=fd_config,
+        interpret=interpret,
+    )
+
+    def run(params, cache, prompt):
+        def body(carry, i):
+            cache, tok = carry
+            logits, cache = step(params, cache, tok, i)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # within the prompt, the next input is the given token
+            tok = jnp.where(i + 1 < prompt_len, prompt[:, jnp.minimum(i + 1, prompt_len - 1)], nxt)
+            return (cache, tok), nxt
+
+        (_, _), outs = jax.lax.scan(
+            body, (cache, prompt[:, 0]), jnp.arange(prompt_len + n_steps - 1)
+        )
+        return outs  # [prompt_len + n_steps - 1, b]
+
+    cache_specs = spec.specs(cfg)
+    out = jax.jit(
+        jax.shard_map(
+            run, mesh=mesh,
+            in_specs=(param_specs(cfg), cache_specs, P(None, None)),
+            out_specs=P(None, None), check_vma=False,
+        )
+    )(
+        jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, param_specs(cfg),
+        ),
+        cache, prompt,
+    )
+    return out[prompt_len - 1 :].T  # [b, n_steps]

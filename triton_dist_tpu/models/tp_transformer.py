@@ -209,13 +209,18 @@ class TPTransformer:
         attn = _causal_gqa_attention(q, k, v, c)   # [b, s, q_dim/n]
         x = x + self._row(attn.reshape(b * s, hq_loc * d), p["wo"])
 
-        # --- MLP (SwiGLU) ---
+        return x + self._mlp(x, p)
+
+    def _mlp(self, x: jax.Array, p: dict) -> jax.Array:
+        """Dense SwiGLU MLP half of the block (overridden by the MoE model)."""
+        c = self.cfg
+        b, s = c.batch, c.seq
         h = rmsnorm(x, p["mlp_norm"], c.norm_eps)
         gu = self._col(h, p["w_gate_up"].reshape(c.hidden, -1))
         gu = gu.reshape(b * s, -1, 2)              # [m, F/n, 2]
         gate, up = gu[..., 0], gu[..., 1]
         act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
-        return x + self._row(act, p["w_down"])
+        return self._row(act, p["w_down"])
 
     def __call__(self, tokens_loc: jax.Array, params: dict) -> jax.Array:
         """tokens_loc ``[m_loc]`` int32 → vocab-sharded logits
@@ -251,6 +256,66 @@ class TPTransformer:
         )[:, 0]
         target_logit = jax.lax.psum(jnp.where(in_shard, tl, 0.0), c.axis)
         return jnp.mean(lse - target_logit)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoETransformerConfig(TransformerConfig):
+    """MoE decoder: dense attention + tensor-parallel expert MLPs
+    (≙ the reference's MoE shapes — its AG-GroupGEMM / MoE-Reduce-RS tests
+    compose exactly this block inline)."""
+
+    n_experts: int = 8
+    topk: int = 2
+    gg_config: Any = None  # GroupGemmConfig
+
+
+def init_moe_params(key: jax.Array, cfg: MoETransformerConfig) -> dict:
+    """Like :func:`init_params` but each layer's MLP is a router + expert
+    bank (single up-proj + gelu, matching layers.TPMoEMLP)."""
+    params = init_params(key, cfg)
+    h, f = cfg.hidden, cfg.ffn
+    keys = iter(jax.random.split(jax.random.fold_in(key, 1), cfg.n_layers * 3))
+
+    def w(shape, scale):
+        return (jax.random.normal(next(keys), shape) * scale).astype(cfg.dtype)
+
+    for p in params["layers"]:
+        del p["w_gate_up"], p["w_down"]
+        p["router"] = w((h, cfg.n_experts), h**-0.5)
+        p["w_up"] = w((cfg.n_experts, h, f), h**-0.5)
+        p["w_down"] = w((cfg.n_experts, f, h), f**-0.5)
+    return params
+
+
+def moe_param_specs(cfg: MoETransformerConfig) -> dict:
+    specs = param_specs(cfg)
+    t = cfg.axis
+    for p in specs["layers"]:
+        del p["w_gate_up"], p["w_down"]
+        p["router"] = P(None, None)
+        p["w_up"] = P(None, None, t)    # expert FFN columns sharded
+        p["w_down"] = P(None, t, None)  # expert FFN rows sharded
+    return specs
+
+
+@dataclasses.dataclass
+class TPMoETransformer(TPTransformer):
+    """MoE decoder forward: the dense MLP half is replaced by router →
+    ``layers.TPMoEMLP`` (fused AG-GroupGEMM up, MoE-Reduce-RS down).
+    Forward/serving path — the MoE kernels ship without custom VJPs, so
+    training this variant today means a dense-equivalent backward or
+    stop-gradient routing."""
+
+    def _mlp(self, x: jax.Array, p: dict) -> jax.Array:
+        from triton_dist_tpu.layers.tp_mlp import TPMoEMLP
+        from triton_dist_tpu.ops.moe_utils import select_experts
+
+        c = self.cfg
+        h = rmsnorm(x, p["mlp_norm"], c.norm_eps)
+        logits = h.astype(jnp.float32) @ p["router"].astype(jnp.float32)
+        tw, ids = select_experts(logits, c.topk)
+        moe = TPMoEMLP(axis=c.axis, gg_config=c.gg_config, interpret=c.interpret)
+        return moe(h, p["w_up"], p["w_down"], ids, tw.astype(jnp.float32))
 
 
 def train_step(
