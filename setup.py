@@ -21,16 +21,20 @@ class BuildWithNative(build_py):
         # searches both locations.
         root = os.path.dirname(os.path.abspath(__file__))
         csrc = os.path.join(root, "csrc")
-        try:  # the whole block: a failed/absent native build never blocks install
+        try:  # best-effort prebuild; a missing toolchain never blocks install
             subprocess.run(["make", "-C", csrc, "-s"], check=True, timeout=300)
             print(f"built native library in {csrc}")
+        except Exception as e:
+            print(f"WARNING: native csrc prebuild skipped ({e}); "
+                  f"csrc_ops will build on demand (numpy fallback otherwise)")
+        try:  # ALWAYS ship the sources — csrc_ops rebuilds at runtime
             dst = os.path.join(self.build_lib, "triton_dist_tpu", "csrc")
             os.makedirs(dst, exist_ok=True)
             for f in os.listdir(csrc):
                 if f.endswith((".cc", ".h", ".so")) or f == "Makefile":
                     shutil.copy2(os.path.join(csrc, f), os.path.join(dst, f))
-        except Exception as e:  # numpy fallback covers a missing toolchain
-            print(f"WARNING: native csrc build skipped ({e}); numpy fallback active")
+        except Exception as e:  # sdist without csrc/ — numpy fallback
+            print(f"WARNING: csrc sources not packaged ({e})")
 
 
 setup(cmdclass={"build_py": BuildWithNative})

@@ -10,6 +10,7 @@ kernels' custom VJPs.
 """
 
 from triton_dist_tpu.models.decode import KVCacheSpec, decode_step, generate
+from triton_dist_tpu.models.pipeline import pipeline_apply, stage_slice
 from triton_dist_tpu.models.tp_transformer import (
     MoETransformerConfig,
     TransformerConfig,
@@ -24,6 +25,8 @@ from triton_dist_tpu.models.tp_transformer import (
 
 __all__ = [
     "KVCacheSpec",
+    "pipeline_apply",
+    "stage_slice",
     "decode_step",
     "generate",
     "MoETransformerConfig",

@@ -74,7 +74,8 @@ def decode_step(
     me = jax.lax.axis_index(c.axis)
     g = c.n_q_heads // c.n_kv_heads
     d = c.head_dim
-    hkv_loc = c.n_kv_heads // n
+    # the tiled head all_gather below needs whole kv groups per PE
+    assert c.n_kv_heads % n == 0, (c.n_kv_heads, n)
 
     x = params["embed"][tokens]  # [b, H] replicated
     k_cache, v_cache = cache["k"], cache["v"]

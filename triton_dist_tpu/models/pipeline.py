@@ -1,0 +1,85 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a ``pp``
+mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.3: "no DP, no PP" —
+it is a kernel library); this module extends the framework beyond it so the
+flagship model covers every mesh-parallelism flavor (dp/tp/sp/ep/pp).
+
+TPU-native shape of the schedule: all stages run the SAME program under
+``shard_map`` (SPMD), each holding its own stage's layer parameters; the
+activation hand-off between consecutive stages is a ``jax.lax.ppermute``
+ring hop per tick, and the M-microbatch × (M+P-1)-tick schedule is one
+``lax.scan`` — compiler-friendly static control flow, no per-stage host
+code. Backward falls out of autodiff: the transpose of ``ppermute`` is the
+reverse permute, so differentiating the scan replays the pipeline in
+reverse (GPipe's backward schedule) for free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_apply(
+    block_fn: Callable[[jax.Array, Any], jax.Array],
+    stage_params: Any,
+    x_microbatches: jax.Array,   # [M, mb, ...] — full input, every stage
+    *,
+    axis: str = "pp",
+) -> jax.Array:
+    """Run ``block_fn`` through P pipeline stages over M microbatches
+    (call inside ``jax.shard_map``).
+
+    ``stage_params`` are THIS stage's parameters (layer shards);
+    ``block_fn(x, stage_params)`` is one stage's computation (shape
+    preserving). Stage 0 feeds microbatch ``t`` at tick ``t``; stage ``s``
+    processes microbatch ``t - s`` at tick ``t``; outputs surface on the
+    last stage and are returned (valid on every PE via a final broadcast
+    hop). Returns ``[M, mb, ...]``.
+    """
+    n = int(jax.lax.axis_size(axis))
+    me = jax.lax.axis_index(axis)
+    m_total = x_microbatches.shape[0]
+    ticks = m_total + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(buf, t):
+        # buf: activation handed to this stage by the previous one
+        mb_idx = t - me
+        x_in = jnp.where(me == 0, x_microbatches[jnp.clip(t, 0, m_total - 1)], buf)
+        active = (mb_idx >= 0) & (mb_idx < m_total)
+        y = block_fn(x_in, stage_params)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        out = jnp.where((me == n - 1) & active, y, jnp.zeros_like(y))
+        nxt = jax.lax.ppermute(y, axis, perm)
+        return nxt, out
+
+    _, outs = jax.lax.scan(
+        tick, jnp.zeros_like(x_microbatches[0]), jnp.arange(ticks)
+    )
+    # microbatch m exits the last stage at tick m + n - 1
+    outs = outs[n - 1 :]
+    # Broadcast the last stage's outputs to every PE (psum of one-hot).
+    # Gradient accounting for callers: a loss on this (replicated) output,
+    # differentiated inside shard_map, comes back scaled by the axis size
+    # (every PE seeds an identical loss — the same rule train_step handles
+    # for the tp axis); assemble stage grads with psum(g, axis) / n.
+    return jax.lax.psum(outs, axis)
+
+
+def stage_slice(params_layers: list, axis: str = "pp") -> list:
+    """This stage's contiguous slice of a layer list (host-side helper:
+    lists of per-layer pytrees can't be sharded by spec, so callers pass
+    the full list and each stage indexes its share under shard_map)."""
+    n = int(jax.lax.axis_size(axis))
+    me = jax.lax.axis_index(axis)
+    per = len(params_layers) // n
+    # static python slicing is impossible with a traced `me`; instead select
+    # each of this stage's layers by traced index over the stacked pytree
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *params_layers)
+    return [
+        jax.tree.map(lambda s: s[me * per + i], stacked) for i in range(per)
+    ]
