@@ -44,3 +44,8 @@ def mesh2x4() -> Mesh:
 @pytest.fixture(scope="session")
 def mesh4() -> Mesh:
     return Mesh(np.array(jax.devices()[:4]), ("tp",))
+
+
+@pytest.fixture(scope="session")
+def mesh2x2x2() -> Mesh:
+    return Mesh(np.array(jax.devices()).reshape(2, 2, 2), ("a", "b", "c"))

@@ -100,6 +100,31 @@ def test_all_gather_2d(mesh2x4):
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_all_gather_3d(mesh2x2x2):
+    """3-axis staged hierarchy (≙ the reference's 3-D node×numa×gpu push,
+    low_latency_allgather.py:401) vs the composite-axis XLA golden."""
+    from triton_dist_tpu.ops.allgather import all_gather
+
+    m, d = 4, 64
+
+    def fn(x):
+        return all_gather(x, axis=("a", "b", "c"))
+
+    def golden(x):
+        return jax.lax.all_gather(x, ("a", "b", "c"), tiled=True)
+
+    x = jax.random.normal(jax.random.PRNGKey(40), (8 * m, d), jnp.float32)
+    out = jax.jit(
+        jax.shard_map(fn, mesh=mesh2x2x2, in_specs=P(("a", "b", "c")),
+                      out_specs=P(None), check_vma=False)
+    )(x)
+    ref = jax.jit(
+        jax.shard_map(golden, mesh=mesh2x2x2, in_specs=P(("a", "b", "c")),
+                      out_specs=P(None), check_vma=False)
+    )(x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
 def test_all_gather_2d_outer_inner_swapped(mesh2x4):
     """(tp, dp) ordering: outer=tp (4), inner=dp (2) — exercises n_i < n_o."""
     from triton_dist_tpu.ops.allgather import all_gather_2d

@@ -70,7 +70,7 @@ def test_pipeline_backward_matches_sequential():
     mesh = _pp_mesh(pp)
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
 
-    def loss_pp(x, stacked):
+    def loss_pp(stacked, x):
         def block(xb, stage):
             return _mlp_block(xb, stage)
 
@@ -80,10 +80,8 @@ def test_pipeline_backward_matches_sequential():
         return jnp.mean(y * y)
 
     def grads_fn(x, stacked):
-        g = jax.grad(loss_pp, argnums=1)(x, stacked)
-        # each stage's grad lives on its PE; sum over the axis assembles the
-        # full stacked gradient (inactive stages contribute zeros)
-        return jax.tree.map(lambda t: t, g), loss_pp(x, stacked)[None]
+        loss, g = jax.value_and_grad(loss_pp)(stacked, x)
+        return g, loss[None]
 
     g_sh, loss_sh = jax.jit(
         jax.shard_map(

@@ -112,3 +112,28 @@ def test_reduce_scatter_2d(mesh2x4):
             )
         )(x)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4)
+
+
+def test_reduce_scatter_3d(mesh2x2x2):
+    """3-axis staged reduce-scatter (outermost peeled, inner pre-reduced)
+    vs psum_scatter golden."""
+    from triton_dist_tpu.ops.reduce_scatter import reduce_scatter
+
+    m, d, n = 4, 64, 8
+
+    def fn(xs):
+        return reduce_scatter(xs[0], axis=("a", "b", "c"))[None]
+
+    def golden(xs):
+        return jax.lax.psum_scatter(xs[0], ("a", "b", "c"), tiled=True)[None]
+
+    x = jax.random.normal(jax.random.PRNGKey(50), (n, n * m, d), jnp.float32)
+    out = jax.jit(
+        jax.shard_map(fn, mesh=mesh2x2x2, in_specs=P(("a", "b", "c"), None, None),
+                      out_specs=P(("a", "b", "c"), None, None), check_vma=False)
+    )(x)
+    ref = jax.jit(
+        jax.shard_map(golden, mesh=mesh2x2x2, in_specs=P(("a", "b", "c"), None, None),
+                      out_specs=P(("a", "b", "c"), None, None), check_vma=False)
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)

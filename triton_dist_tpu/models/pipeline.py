@@ -76,6 +76,9 @@ def stage_slice(params_layers: list, axis: str = "pp") -> list:
     the full list and each stage indexes its share under shard_map)."""
     n = int(jax.lax.axis_size(axis))
     me = jax.lax.axis_index(axis)
+    assert len(params_layers) % n == 0, (
+        f"{len(params_layers)} layers do not divide over {n} pipeline stages"
+    )
     per = len(params_layers) // n
     # static python slicing is impossible with a traced `me`; instead select
     # each of this stage's layers by traced index over the stacked pytree

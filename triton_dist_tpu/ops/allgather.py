@@ -280,14 +280,24 @@ def all_gather(x: jax.Array, *, axis: str = "tp", method: str = "auto", interpre
     if isinstance(axis, (tuple, list)):
         if len(axis) == 1:
             axis = axis[0]
-        else:
-            assert len(axis) == 2, f"at most 2 axes supported, got {axis}"
-            if method != "auto":
-                raise ValueError(
-                    f"multi-axis all_gather always uses the 2-D ring; got "
-                    f"method={method!r} (only 'auto' is valid with two axes)"
-                )
+        elif method != "auto":
+            raise ValueError(
+                f"multi-axis all_gather always uses the ring hierarchy; got "
+                f"method={method!r} (only 'auto' is valid with >1 axis)"
+            )
+        elif len(axis) == 2:
             return all_gather_2d(x, axes=tuple(axis), interpret=interpret)
+        else:
+            # N-D (≙ the reference's 3-D node×numa×gpu push hierarchy,
+            # low_latency_allgather.py:401): fused 2-D ring over the two
+            # INNERMOST axes, then staged gathers outward — each outer hop
+            # streams a block the inner hierarchy already assembled, and
+            # the outermost-major concat order matches
+            # jax.lax.all_gather(x, axes, tiled=True).
+            out = all_gather_2d(x, axes=tuple(axis[-2:]), interpret=interpret)
+            for a in reversed(axis[:-2]):
+                out = all_gather(out, axis=a, interpret=interpret)
+            return out
     n = int(jax.lax.axis_size(axis))
     if n == 1:
         return x

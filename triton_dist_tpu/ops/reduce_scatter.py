@@ -28,6 +28,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
@@ -198,11 +199,38 @@ def reduce_scatter(
     if isinstance(axis, (tuple, list)):
         if len(axis) == 1:
             axis = axis[0]
-        else:
-            assert len(axis) == 2, f"at most 2 axes supported, got {axis}"
+        elif len(axis) == 2:
             return reduce_scatter_2d(
                 x, axes=tuple(axis), method=method, config=config, interpret=interpret
             )
+        else:
+            # N-D: peel the outermost axis with the 2-D permuted staging
+            # (inner group pre-reduces before anything crosses the slower
+            # axis), recursing over the remaining axes. Ordering matches
+            # jax.lax.psum_scatter(x, axes, tiled=True).
+            a0, rest = axis[0], tuple(axis[1:])
+            n0 = int(jax.lax.axis_size(a0))
+            nr = int(np.prod([jax.lax.axis_size(a) for a in rest]))
+            orig_ndim0 = x.ndim
+            if x.ndim == 1:
+                x = x.reshape(x.shape[0], 1)
+            m_tot0, nd0 = x.shape
+            assert m_tot0 % (n0 * nr) == 0, (m_tot0, n0, nr)
+            m0 = m_tot0 // (n0 * nr)
+            xt = (
+                x.reshape(n0, nr, m0, nd0)
+                .swapaxes(0, 1)
+                .reshape(m_tot0, nd0)
+            )
+            part = reduce_scatter(
+                xt, axis=rest, method=method, config=config, interpret=interpret
+            )  # [n0*m0, nd0] pre-reduced over every inner axis
+            out = reduce_scatter(
+                part, axis=a0, method=method, config=config, interpret=interpret
+            )
+            if orig_ndim0 == 1:
+                out = out.reshape(m0)
+            return out
     cfg = config or ReduceScatterConfig()
     n = int(jax.lax.axis_size(axis))
     if n == 1:

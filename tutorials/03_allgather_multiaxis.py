@@ -1,0 +1,67 @@
+"""Tutorial 03 — hierarchical multi-axis AllGather
+(≙ reference ``tutorials/03`` inter-node allgather + the 2-D/3-D push
+hierarchies of ``low_latency_allgather.py:346-401``: NUMA/node-staged
+producers so each slow-axis link carries every byte exactly once).
+
+TPU-native: mesh axes replace the node/NUMA/GPU hierarchy — a fused 2-D
+ring over (outer, inner) forwards every chunk along the outer axis the
+moment it lands on the inner ring, and 3+ axes stage outward recursively.
+Run:
+
+    python tutorials/03_allgather_multiaxis.py
+"""
+
+import common  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.ops.allgather import all_gather
+
+
+def main():
+    _, world = common.bootstrap()
+    devs = np.array(jax.devices())
+    m, h = 4, 64
+    if world % 2:
+        common.report("03_allgather_2d", True, f"SKIP: world={world} not even")
+        return
+
+    # 2-D: (node, local)-style hierarchy
+    n_o, n_i = 2, world // 2
+    mesh2d = Mesh(devs.reshape(n_o, n_i), ("node", "local"))
+    x = jax.random.normal(jax.random.PRNGKey(0), (world * m, h), jnp.float32)
+    got = jax.jit(
+        jax.shard_map(
+            lambda x: all_gather(x, axis=("node", "local")),
+            mesh=mesh2d, in_specs=P(("node", "local")), out_specs=P(None),
+            check_vma=False,
+        )
+    )(x)
+    common.report(
+        "03_allgather_2d", bool(np.array_equal(np.asarray(got), np.asarray(x))),
+        f"mesh={n_o}x{n_i} (node, local)",
+    )
+
+    # 3-D: (node, numa, chip) ≙ the reference's 3-D push hierarchy
+    if world % 4:
+        common.report("03_allgather_3d", True, f"SKIP: world={world} not 4-divisible")
+        return
+    mesh3d = Mesh(devs.reshape(2, 2, world // 4), ("node", "numa", "chip"))
+    got3 = jax.jit(
+        jax.shard_map(
+            lambda x: all_gather(x, axis=("node", "numa", "chip")),
+            mesh=mesh3d, in_specs=P(("node", "numa", "chip")), out_specs=P(None),
+            check_vma=False,
+        )
+    )(x)
+    common.report(
+        "03_allgather_3d", bool(np.array_equal(np.asarray(got3), np.asarray(x))),
+        f"mesh=2x2x{world // 4} (node, numa, chip)",
+    )
+
+
+if __name__ == "__main__":
+    main()
