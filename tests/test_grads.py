@@ -108,3 +108,52 @@ def test_tp_mlp_training_step(mesh4):
     np.testing.assert_allclose(float(lv), float(wl), rtol=1e-4)
     np.testing.assert_allclose(np.asarray(gu), np.asarray(wu), rtol=1e-3, atol=1e-3)
     np.testing.assert_allclose(np.asarray(gd), np.asarray(wd), rtol=1e-3, atol=1e-3)
+
+
+def test_ring_attention_grad_matches_full(mesh4):
+    """SP ring attention VJP vs grads of full causal attention on the
+    gathered sequence."""
+    from triton_dist_tpu.ops.grads import ring_attention_grad
+    from triton_dist_tpu.ops.ring_attention import RingAttentionConfig
+
+    b, h, s, d = 1, 2, 64, 128
+    kq, kk, kv, kt = jax.random.split(jax.random.PRNGKey(40), 4)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.float32)
+    k = jax.random.normal(kk, (b, h, s, d), jnp.float32)
+    v = jax.random.normal(kv, (b, h, s, d), jnp.float32)
+    t = jax.random.normal(kt, (b, h, s, d), jnp.float32)  # cotangent seed
+
+    cfg = RingAttentionConfig(block_q=16, block_kv=16)
+
+    def loss_sp(q, k, v, t):
+        out = ring_attention_grad(q, k, v, "tp", True, cfg, None)
+        return jnp.sum(out * t)
+
+    def grads_sp(q, k, v, t):
+        # each output shard appears in exactly ONE PE's local loss, so the
+        # per-PE losses partition the global objective: local cotangents
+        # are already the global-loss cotangents (no psum needed — this
+        # does NOT hold for losses where shards overlap, e.g. a mean over
+        # a replicated dim)
+        g = jax.grad(loss_sp, argnums=(0, 1, 2))(q, k, v, t)
+        return g
+
+    gq, gk, gv = jax.jit(
+        jax.shard_map(
+            grads_sp, mesh=mesh4,
+            in_specs=(P(None, None, "tp", None),) * 4,
+            out_specs=(P(None, None, "tp", None),) * 3, check_vma=False,
+        )
+    )(q, k, v, t)
+
+    def loss_full(q, k, v):
+        scores = jnp.einsum("bhqd,bhsd->bhqs", q, k) / jnp.sqrt(jnp.float32(d))
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        out = jnp.einsum("bhqs,bhsd->bhqd", jax.nn.softmax(scores, -1), v)
+        return jnp.sum(out * t)
+
+    rq, rk, rv = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(rq), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(rk), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(rv), rtol=2e-3, atol=2e-3)

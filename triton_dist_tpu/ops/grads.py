@@ -101,3 +101,102 @@ def _gemm_rs_bwd(axis, rs_config, ag_config, interpret, res, dc):
 
 
 gemm_rs_grad.defvjp(_gemm_rs_fwd, _gemm_rs_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_attention_grad(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis: str = "tp",
+    causal: bool = True,
+    config: Any = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Differentiable sequence-parallel ring attention (call inside
+    shard_map) — the training-side SP the reference lacks entirely
+    (SURVEY.md §5: prefill ring attention is "not implemented" there).
+
+    Forward = the fused ring kernel (ops/ring_attention.py). Backward uses
+    the standard flash-attention gradient algebra on the gathered sequence:
+    one all_gather of (k ‖ v), local dq for the PE's query rows, and a
+    reduce-scatter returning each dk/dv chunk to its owner — two
+    collectives total, with the saved per-row log-sum-exp avoiding any
+    softmax recomputation instability.
+    """
+    from triton_dist_tpu.ops.ring_attention import ring_attention
+
+    return ring_attention(
+        q, k, v, axis=axis, causal=causal, config=config, interpret=interpret
+    )
+
+
+def _ring_attn_fwd(q, k, v, axis, causal, config, interpret):
+    from triton_dist_tpu.ops.ring_attention import ring_attention
+
+    out, lse = ring_attention(
+        q, k, v, axis=axis, causal=causal, config=config,
+        return_lse=True, interpret=interpret,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _ring_attn_bwd(axis, causal, config, interpret, res, dout):
+    import math
+
+    q, k, v, out, lse = res
+    b, h, s_loc, d = q.shape
+    bh = b * h
+    n = int(jax.lax.axis_size(axis))
+    me = jax.lax.axis_index(axis)
+    scale = 1.0 / math.sqrt(d)
+    f32 = jnp.float32
+
+    q3 = q.reshape(bh, s_loc, d).astype(f32)
+    dout3 = dout.reshape(bh, s_loc, d).astype(f32)
+    out3 = out.reshape(bh, s_loc, d).astype(f32)
+    lse3 = lse.reshape(bh, s_loc)
+    delta = jnp.sum(dout3 * out3, axis=-1)           # [bh, s_loc]
+    rows = me * s_loc + jnp.arange(s_loc)
+
+    # one gather: (k ‖ v) ride a single collective; kept in input dtype
+    kv = jnp.stack([k.reshape(bh, s_loc, d), v.reshape(bh, s_loc, d)])
+    kv_full = jax.lax.all_gather(kv, axis, axis=2, tiled=True)
+    kv_chunks = kv_full.reshape(2, bh, n, s_loc, d).swapaxes(0, 2)  # [n,bh,2,...]
+
+    # Blockwise over the n gathered KV chunks (flash-attention gradient
+    # algebra with the saved lse): peak memory is one [bh, s_loc, s_loc]
+    # block, matching the forward's blockwise scaling — never the full
+    # [s_loc, S] matrix.
+    def chunk_step(dq_acc, inp):
+        kv_c, c_idx = inp
+        k_c = kv_c[:, 0].astype(f32)                 # [bh, s_loc, d]
+        v_c = kv_c[:, 1].astype(f32)
+        s_c = jnp.einsum("bqd,bsd->bqs", q3, k_c) * scale
+        if causal:
+            cols = c_idx * s_loc + jnp.arange(s_loc)
+            s_c = jnp.where((cols[None, :] <= rows[:, None])[None], s_c, -jnp.inf)
+        p_c = jnp.exp(s_c - lse3[..., None])
+        dv_c = jnp.einsum("bqs,bqd->bsd", p_c, dout3)
+        ds_c = p_c * (
+            jnp.einsum("bqd,bsd->bqs", dout3, v_c) - delta[..., None]
+        ) * scale
+        dq_acc = dq_acc + jnp.einsum("bqs,bsd->bqd", ds_c, k_c)
+        dk_c = jnp.einsum("bqs,bqd->bsd", ds_c, q3)
+        return dq_acc, jnp.stack([dk_c, dv_c])
+
+    dq3, dkv_chunks = jax.lax.scan(
+        chunk_step, jnp.zeros_like(q3), (kv_chunks, jnp.arange(n))
+    )                                                # dkv_chunks [n, 2, bh, s_loc, d]
+    # one scatter: (dk ‖ dv) chunks return to their owner PEs pre-reduced
+    dkv = jax.lax.psum_scatter(
+        jnp.moveaxis(dkv_chunks, 0, 2).reshape(2, bh, n * s_loc, d),
+        axis, scatter_dimension=2, tiled=True,
+    )
+    dq = dq3.reshape(b, h, s_loc, d).astype(q.dtype)
+    dk = dkv[0].reshape(b, h, s_loc, d).astype(k.dtype)
+    dv = dkv[1].reshape(b, h, s_loc, d).astype(v.dtype)
+    return dq, dk, dv
+
+
+ring_attention_grad.defvjp(_ring_attn_fwd, _ring_attn_bwd)

@@ -24,11 +24,11 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 from typing import Any
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
@@ -199,18 +199,18 @@ def reduce_scatter(
     if isinstance(axis, (tuple, list)):
         if len(axis) == 1:
             axis = axis[0]
-        elif len(axis) == 2:
-            return reduce_scatter_2d(
-                x, axes=tuple(axis), method=method, config=config, interpret=interpret
-            )
         else:
-            # N-D: peel the outermost axis with the 2-D permuted staging
-            # (inner group pre-reduces before anything crosses the slower
-            # axis), recursing over the remaining axes. Ordering matches
-            # jax.lax.psum_scatter(x, axes, tiled=True).
+            # N-D (any >= 2 axes): peel the outermost axis with the permuted
+            # staging of the reference's 2-D pipeline (intra-scatter →
+            # local reduce → inter hop, reduce_scatter.py:47-142,525-637):
+            # chunk (o, r) is laid out slab-major (r, o) so the recursive
+            # reduce-scatter over the INNER axes pre-reduces every byte
+            # before it crosses the slower outer axis — exactly once, n_r-
+            # fold reduced. Ordering matches
+            # ``jax.lax.psum_scatter(x, axes, tiled=True)``.
             a0, rest = axis[0], tuple(axis[1:])
             n0 = int(jax.lax.axis_size(a0))
-            nr = int(np.prod([jax.lax.axis_size(a) for a in rest]))
+            nr = math.prod(int(jax.lax.axis_size(a)) for a in rest)
             orig_ndim0 = x.ndim
             if x.ndim == 1:
                 x = x.reshape(x.shape[0], 1)
@@ -223,7 +223,8 @@ def reduce_scatter(
                 .reshape(m_tot0, nd0)
             )
             part = reduce_scatter(
-                xt, axis=rest, method=method, config=config, interpret=interpret
+                xt, axis=rest if len(rest) > 1 else rest[0],
+                method=method, config=config, interpret=interpret,
             )  # [n0*m0, nd0] pre-reduced over every inner axis
             out = reduce_scatter(
                 part, axis=a0, method=method, config=config, interpret=interpret
@@ -299,33 +300,10 @@ def reduce_scatter_2d(
     reference's node-then-ring pipeline. Golden:
     ``jax.lax.psum_scatter(x, axes, tiled=True)``.
     """
-    outer, inner = axes
-    n_o = int(jax.lax.axis_size(outer))
-    n_i = int(jax.lax.axis_size(inner))
-    if n_o == 1:
-        return reduce_scatter(x, axis=inner, method=method, config=config, interpret=interpret)
-    if n_i == 1:
-        return reduce_scatter(x, axis=outer, method=method, config=config, interpret=interpret)
-    orig_ndim = x.ndim
-    if x.ndim == 1:
-        x = x.reshape(x.shape[0], 1)
-    m_total, n_dim = x.shape
-    n = n_o * n_i
-    assert m_total % n == 0, (m_total, n)
-    m_loc = m_total // n
-    # chunk (o, i) → slab order (i, o): phase 1's inner chunk j becomes
-    # S_j = concat_o'(chunk (o', j)). XLA lowers this to one HBM pass and
-    # fuses it with the surrounding program.
-    xt = x.reshape(n_o, n_i, m_loc, n_dim).swapaxes(0, 1).reshape(m_total, n_dim)
-    part = reduce_scatter(
-        xt, axis=inner, method=method, config=config, interpret=interpret
-    )  # [n_o*m_loc, n_dim]: S_me_i summed over the inner group
-    out = reduce_scatter(
-        part, axis=outer, method=method, config=config, interpret=interpret
-    )  # [m_loc, n_dim]: chunk (me_o, me_i) summed over everyone
-    if orig_ndim == 1:
-        out = out.reshape(m_loc)
-    return out
+    # single implementation: the generic N-D peel in reduce_scatter
+    return reduce_scatter(
+        x, axis=tuple(axes), method=method, config=config, interpret=interpret
+    )
 
 
 def reduce_scatter_op(

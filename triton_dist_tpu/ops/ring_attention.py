@@ -192,13 +192,16 @@ def ring_attention(
     axis: str = "tp",
     causal: bool = True,
     config: RingAttentionConfig | None = None,
+    return_lse: bool = False,
     interpret: Any = None,
-) -> jax.Array:
+):
     """Sequence-parallel attention over an s-sharded q/k/v (call inside
     ``jax.shard_map``).
 
     q, k, v: ``[b, h, s_loc, d]`` — the local sequence shard (MHA; GQA via
-    repeating kv heads host-side). Returns ``[b, h, s_loc, d]`` in q.dtype.
+    repeating kv heads host-side). Returns ``[b, h, s_loc, d]`` in q.dtype
+    (plus the per-row log-sum-exp ``[b, h, s_loc]`` f32 if `return_lse` —
+    the residual the custom backward consumes, ops/grads.py).
     Golden: full (causal) attention over the gathered sequence.
     """
     cfg = config or RingAttentionConfig()
@@ -242,7 +245,14 @@ def ring_attention(
         uses_barrier=n > 1,
         interpret=interpret,
     )(q3, k3, v3)
-    return outs[0].reshape(b, h, s_loc, d)
+    out = outs[0].reshape(b, h, s_loc, d)
+    if not return_lse:
+        return out
+    # m/l live lane-replicated in [bh, s_loc, 128] buffers (outs[3], outs[4])
+    lse = (
+        outs[3][..., 0] + jnp.log(jnp.maximum(outs[4][..., 0], 1e-30))
+    ).reshape(b, h, s_loc)
+    return out, lse
 
 
 def ring_attention_op(
