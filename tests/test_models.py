@@ -223,3 +223,47 @@ def test_models_package_imports():
     import triton_dist_tpu.models as m
 
     assert hasattr(m, "TPTransformer") and hasattr(m, "train_step")
+
+
+def test_sp_transformer_forward_and_train(mesh4):
+    """Context-parallel transformer: forward parity vs a full-sequence
+    reference with the same (replicated) params; train step reduces loss."""
+    from triton_dist_tpu.models.sp_transformer import (
+        SPTransformer, SPTransformerConfig, sp_train_step,
+    )
+    from triton_dist_tpu.ops.ring_attention import RingAttentionConfig
+
+    b, s = 1, 32
+    cfg = SPTransformerConfig(
+        vocab=32, hidden=32, ffn=64, n_layers=2, n_q_heads=2, n_kv_heads=1,
+        head_dim=128, batch=b, seq=s,
+        ring_config=RingAttentionConfig(block_q=8, block_kv=8),
+    )
+    model = SPTransformer(cfg)
+    params = init_params(jax.random.PRNGKey(10), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(11), (b, s), 0, cfg.vocab, jnp.int32)
+    targets = jax.random.randint(jax.random.PRNGKey(12), (b, s), 0, cfg.vocab, jnp.int32)
+
+    got = jax.jit(
+        jax.shard_map(
+            lambda t, p: model(t, p), mesh=mesh4,
+            in_specs=(P(None, "tp"), P(None)),
+            out_specs=P(None, "tp", None), check_vma=False,
+        )
+    )(tokens, params)
+    # reference: same weights through the dense _ref_forward (head-group
+    # layout matches; MHA here via repeat inside the model)
+    want = _ref_forward(tokens.reshape(-1), params, cfg).reshape(b, s, cfg.vocab)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=3e-3, atol=3e-3)
+
+    step = jax.jit(
+        jax.shard_map(
+            lambda t, y, p: sp_train_step(model, p, t, y, lr=5e-2),
+            mesh=mesh4,
+            in_specs=(P(None, "tp"), P(None, "tp"), P(None)),
+            out_specs=(P(None), P()), check_vma=False,
+        )
+    )
+    p1, l1 = step(tokens, targets, params)
+    p2, l2 = step(tokens, targets, p1)
+    assert float(l2) < float(l1)

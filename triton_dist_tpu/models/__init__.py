@@ -11,6 +11,11 @@ kernels' custom VJPs.
 
 from triton_dist_tpu.models.decode import KVCacheSpec, decode_step, generate
 from triton_dist_tpu.models.pipeline import pipeline_apply, stage_slice
+from triton_dist_tpu.models.sp_transformer import (
+    SPTransformer,
+    SPTransformerConfig,
+    sp_train_step,
+)
 from triton_dist_tpu.models.tp_transformer import (
     MoETransformerConfig,
     TransformerConfig,
@@ -27,6 +32,9 @@ __all__ = [
     "KVCacheSpec",
     "pipeline_apply",
     "stage_slice",
+    "SPTransformer",
+    "SPTransformerConfig",
+    "sp_train_step",
     "decode_step",
     "generate",
     "MoETransformerConfig",
