@@ -35,4 +35,13 @@ from triton_dist_tpu.ops.flash_decode import (
     flash_decode,
     flash_decode_distributed,
     flash_decode_op,
+    paged_flash_decode,
+    paged_flash_decode_distributed,
 )
+from triton_dist_tpu.ops.grads import ring_attention_grad
+from triton_dist_tpu.ops.ring_attention import (
+    RingAttentionConfig,
+    ring_attention,
+    ring_attention_op,
+)
+from triton_dist_tpu.ops.ulysses import ulysses_attention

@@ -1,0 +1,140 @@
+"""Ulysses-style sequence parallelism: all-to-all head exchange
+(DeepSpeed-Ulysses — the OTHER standard SP recipe; SURVEY.md §5 notes the
+reference implements neither Ulysses nor ring prefill. Ring attention
+(ops/ring_attention.py) keeps q resident and circulates KV; Ulysses instead
+re-shards [seq → heads] with one all-to-all, runs dense LOCAL attention on
+each PE's head slice over the full sequence, and re-shards back. Fewer,
+bigger collectives — the better trade when heads ≥ world and per-hop
+latency dominates.)
+
+Transport is the framework's own ``fast_all_to_all`` slab exchange
+(ops/all_to_all.py): head-group slabs are equal-sized, so the padded-slab
+contract is exact (no padding waste), and the exchange is a single fused
+Pallas kernel per direction. Differentiable end-to-end via a custom VJP:
+the transpose of the head exchange is the reverse exchange, so the backward
+is the same two collectives around the local attention's VJP.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.ops.all_to_all import fast_all_to_all
+
+
+def _exchange(x: jax.Array, axis: str, n: int, interpret: Any):
+    """[n, rows, d] slab exchange (slab j → PE j); returns same shape with
+    slab i = what PE i sent here. Shapes are static and equal, so splits
+    are full."""
+    rows = x.shape[1]
+    splits = jnp.full((n,), rows, jnp.int32)
+    recv, _ = fast_all_to_all(x, splits, axis=axis, interpret=interpret)
+    return recv
+
+
+def _seq_to_heads(q, axis, n, interpret):
+    """[b, h, s_loc, d] seq-sharded → [b, h/n, S, d] head-sharded."""
+    b, h, s_loc, d = q.shape
+    h_loc = h // n
+    # slab j = head group j (all local seq rows)
+    slabs = q.reshape(b, n, h_loc, s_loc, d).transpose(1, 0, 2, 3, 4)
+    recv = _exchange(slabs.reshape(n, b * h_loc * s_loc, d), axis, n, interpret)
+    # slab i holds seq chunk i of my head group
+    return (
+        recv.reshape(n, b, h_loc, s_loc, d)
+        .transpose(1, 2, 0, 3, 4)
+        .reshape(b, h_loc, n * s_loc, d)
+    )
+
+
+def _heads_to_seq(o, axis, n, interpret):
+    """[b, h/n, S, d] head-sharded → [b, h, s_loc, d] seq-sharded
+    (the exact transpose of :func:`_seq_to_heads`)."""
+    b, h_loc, s_tot, d = o.shape
+    s_loc = s_tot // n
+    slabs = (
+        o.reshape(b, h_loc, n, s_loc, d)
+        .transpose(2, 0, 1, 3, 4)          # slab i = seq chunk i → PE i
+        .reshape(n, b * h_loc * s_loc, d)
+    )
+    recv = _exchange(slabs, axis, n, interpret)
+    # slab j = head group j computed by PE j, for MY seq chunk
+    return (
+        recv.reshape(n, b, h_loc, s_loc, d)
+        .transpose(1, 0, 2, 3, 4)
+        .reshape(b, n * h_loc, s_loc, d)
+    )
+
+
+def _local_attention(q, k, v, causal: bool):
+    """Dense attention on the local head slice over the FULL sequence."""
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bhqd,bhsd->bhqs", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / math.sqrt(d)
+    if causal:
+        s_tot = q.shape[2]
+        mask = jnp.tril(jnp.ones((s_tot, s_tot), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqs,bhsd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis: str = "tp",
+    causal: bool = True,
+    interpret: Any = None,
+) -> jax.Array:
+    """Sequence-parallel attention via head exchange (call inside
+    ``jax.shard_map``). q, k, v: ``[b, h, s_loc, d]`` sequence shards with
+    ``h % axis_size == 0``; returns the same layout. Golden: full (causal)
+    attention over the gathered sequence."""
+    n = int(jax.lax.axis_size(axis))
+    if n == 1:
+        return _local_attention(q, k, v, causal)
+    qh = _seq_to_heads(q, axis, n, interpret)
+    kh = _seq_to_heads(k, axis, n, interpret)
+    vh = _seq_to_heads(v, axis, n, interpret)
+    oh = _local_attention(qh, kh, vh, causal)
+    return _heads_to_seq(oh, axis, n, interpret)
+
+
+def _ulysses_fwd(q, k, v, axis, causal, interpret):
+    n = int(jax.lax.axis_size(axis))
+    if n == 1:
+        return _local_attention(q, k, v, causal), (q, k, v)
+    qh = _seq_to_heads(q, axis, n, interpret)
+    kh = _seq_to_heads(k, axis, n, interpret)
+    vh = _seq_to_heads(v, axis, n, interpret)
+    oh = _local_attention(qh, kh, vh, causal)
+    # residuals are the head-sharded inputs in BOTH cases (at n==1 the two
+    # layouts coincide); the local attention is recomputed in the backward
+    # (flash-style remat) rather than storing its linearization
+    return _heads_to_seq(oh, axis, n, interpret), (qh, kh, vh)
+
+
+def _ulysses_bwd(axis, causal, interpret, res, dout):
+    qh, kh, vh = res
+    n = int(jax.lax.axis_size(axis))
+    _, vjp = jax.vjp(lambda *a: _local_attention(*a, causal), qh, kh, vh)
+    if n == 1:
+        return vjp(dout)
+    # transpose of heads→seq is seq→heads (a permutation both ways)
+    dqh, dkh, dvh = vjp(_seq_to_heads(dout, axis, n, interpret))
+    return (
+        _heads_to_seq(dqh, axis, n, interpret),
+        _heads_to_seq(dkh, axis, n, interpret),
+        _heads_to_seq(dvh, axis, n, interpret),
+    )
+
+
+ulysses_attention.defvjp(_ulysses_fwd, _ulysses_bwd)
