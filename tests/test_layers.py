@@ -59,13 +59,80 @@ def test_tp_moe_mlp(mesh4):
             out_specs=P("tp", None), check_vma=False,
         )
     )(x, w_up, w_down, ids, tw)
-    # golden: dense MoE forward
+    want = _dense_moe_golden(x, w_up, w_down, ids, tw)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=5e-3, atol=5e-3)
+
+
+def _dense_moe_golden(x, w_up, w_down, ids, tw):
+    m_tot, h_dim = x.shape
     want = np.zeros((m_tot, h_dim), np.float32)
     for t in range(m_tot):
-        for k in range(topk):
+        for k in range(tw.shape[1]):
             e = int(ids[t, k])
             h = jax.nn.gelu(np.asarray(x)[t] @ np.asarray(w_up)[e])
             want[t] += float(tw[t, k]) * (np.asarray(h) @ np.asarray(w_down)[e])
+    return want
+
+
+def test_ep_moe_mlp_flat(mesh4):
+    """Expert-parallel MoE MLP (whole experts per PE, a2a transport) vs the
+    dense golden — same answer as the TP MoE layer, different parallelism."""
+    from triton_dist_tpu.layers import EPMoEMLP
+
+    world, m_loc, h_dim, f_dim, n_exp, topk = 4, 4, 64, 128, 4, 2
+    m_tot = world * m_loc
+    x = jax.random.normal(jax.random.PRNGKey(40), (m_tot, h_dim), jnp.float32)
+    w_up = jax.random.normal(jax.random.PRNGKey(41), (n_exp, h_dim, f_dim)) / 8
+    w_down = jax.random.normal(jax.random.PRNGKey(42), (n_exp, f_dim, h_dim)) / 8
+    logits = jax.random.normal(jax.random.PRNGKey(43), (m_tot, n_exp))
+    tw, ids = select_experts(logits, topk)
+    layer = EPMoEMLP(
+        n_experts=n_exp, topk=topk, max_m=m_loc * topk, axis="tp",
+        gg_config=GroupGemmConfig(8, 64, 32),
+    )
+    got = jax.jit(
+        jax.shard_map(
+            layer, mesh=mesh4,
+            in_specs=(
+                P("tp", None), P("tp", None, None), P("tp", None, None),
+                P("tp", None), P("tp", None),
+            ),
+            out_specs=P("tp", None), check_vma=False,
+        )
+    )(x, w_up, w_down, ids, tw)
+    want = _dense_moe_golden(x, w_up, w_down, ids, tw)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=5e-3, atol=5e-3)
+
+
+def test_ep_moe_mlp_hier(mesh2x4):
+    """Same layer over the two-phase (node, local) hierarchical transport."""
+    from triton_dist_tpu.layers import EPMoEMLP
+
+    n_o, n_i, m_loc, h_dim, f_dim, topk = 2, 4, 4, 32, 64, 2
+    world = n_o * n_i
+    n_exp = world  # one whole expert per PE
+    m_tot = world * m_loc
+    x = jax.random.normal(jax.random.PRNGKey(44), (m_tot, h_dim), jnp.float32)
+    w_up = jax.random.normal(jax.random.PRNGKey(45), (n_exp, h_dim, f_dim)) / 8
+    w_down = jax.random.normal(jax.random.PRNGKey(46), (n_exp, f_dim, h_dim)) / 8
+    logits = jax.random.normal(jax.random.PRNGKey(47), (m_tot, n_exp))
+    tw, ids = select_experts(logits, topk)
+    layer = EPMoEMLP(
+        n_experts=n_exp, topk=topk, max_m=m_loc * topk,
+        outer="dp", inner="tp", gg_config=GroupGemmConfig(8, 32, 32),
+    )
+    got = jax.jit(
+        jax.shard_map(
+            layer, mesh=mesh2x4,
+            in_specs=(
+                P(("dp", "tp"), None), P(("dp", "tp"), None, None),
+                P(("dp", "tp"), None, None), P(("dp", "tp"), None),
+                P(("dp", "tp"), None),
+            ),
+            out_specs=P(("dp", "tp"), None), check_vma=False,
+        )
+    )(x, w_up, w_down, ids, tw)
+    want = _dense_moe_golden(x, w_up, w_down, ids, tw)
     np.testing.assert_allclose(np.asarray(got), want, rtol=5e-3, atol=5e-3)
 
 

@@ -4,6 +4,7 @@ vocab-parallel loss parity, gradient flow through the fused kernels)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.models import (
@@ -163,36 +164,11 @@ def test_tp_transformer_train_step_dp_tp(mesh2x4):
         )
 
 
-def test_tp_moe_transformer_forward_parity(mesh4):
-    """MoE decoder forward vs a dense per-token expert golden."""
-    from triton_dist_tpu.models import (
-        MoETransformerConfig, TPMoETransformer, init_moe_params, moe_param_specs,
-    )
-    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+def _moe_ref_forward(tokens, params, cfg):
+    """Dense per-token-expert golden forward (one MoE layer)."""
     from triton_dist_tpu.ops.moe_utils import select_experts
 
-    cfg = MoETransformerConfig(
-        vocab=64, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
-        head_dim=8, batch=2, seq=16, n_experts=4, topk=2,
-        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
-        gg_config=GroupGemmConfig(8, 16, 16),
-    )
-    model = TPMoETransformer(cfg)
-    params = init_moe_params(jax.random.PRNGKey(8), cfg)
-    m = cfg.batch * cfg.seq
-    tokens = jax.random.randint(jax.random.PRNGKey(9), (m,), 0, cfg.vocab, jnp.int32)
-    specs = moe_param_specs(cfg)
-    params_sh = jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh4, s)), params, specs
-    )
-    got = jax.jit(
-        jax.shard_map(
-            lambda t, p: model(t, p), mesh=mesh4,
-            in_specs=(P("tp"), specs), out_specs=P(None, "tp"), check_vma=False,
-        )
-    )(tokens, params_sh)
-
-    # golden: same forward with a dense per-token expert loop
+    m = tokens.shape[0]
     x = params["embed"][tokens]
     p = params["layers"][0]
     b, s, g, d = cfg.batch, cfg.seq, cfg.n_q_heads // cfg.n_kv_heads, cfg.head_dim
@@ -215,8 +191,96 @@ def test_tp_moe_transformer_forward_parity(mesh4):
             moe_out[t] += float(tw[t, kk]) * (np.asarray(he) @ np.asarray(p["w_down"])[e])
     x = x + moe_out
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    want = x @ params["lm_head"]
+    return x @ params["lm_head"]
+
+
+@pytest.mark.parametrize("kind", ["tp", "ep"])
+def test_moe_transformer_forward_parity(mesh4, kind):
+    """MoE decoder forward vs a dense per-token expert golden — the same
+    answer whether experts are tensor-parallel (sliced over the FFN dim,
+    AG-GroupGEMM/MoE-Reduce-RS) or expert-parallel (whole experts per PE,
+    a2a dispatch/combine)."""
+    from triton_dist_tpu.models import (
+        EPMoETransformer, EPMoETransformerConfig, MoETransformerConfig,
+        TPMoETransformer, ep_moe_param_specs, init_moe_params, moe_param_specs,
+    )
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+    from triton_dist_tpu.ops.moe_utils import select_experts
+
+    shapes = dict(
+        vocab=64, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=2, seq=16, n_experts=4, topk=2,
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+        gg_config=GroupGemmConfig(8, 16, 16),
+    )
+    if kind == "tp":
+        cfg = MoETransformerConfig(**shapes)
+        model, specs = TPMoETransformer(cfg), moe_param_specs(cfg)
+    else:
+        cfg = EPMoETransformerConfig(**shapes)
+        model, specs = EPMoETransformer(cfg), ep_moe_param_specs(cfg)
+    params = init_moe_params(jax.random.PRNGKey(8), cfg)
+    m = cfg.batch * cfg.seq
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (m,), 0, cfg.vocab, jnp.int32)
+    params_sh = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh4, s)), params, specs
+    )
+    got = jax.jit(
+        jax.shard_map(
+            lambda t, p: model(t, p), mesh=mesh4,
+            in_specs=(P("tp"), specs), out_specs=P(None, "tp"), check_vma=False,
+        )
+    )(tokens, params_sh)
+
+    want = _moe_ref_forward(tokens, params, cfg)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=5e-3, atol=5e-3)
+
+
+def test_ep_moe_transformer_hier_forward(mesh2x4):
+    """Hierarchical EP model wiring on a (dp, tp) mesh: attention TP over
+    ``tp``, whole experts spread over all 8 PEs, two-phase dispatch over
+    (dp, tp); each dp group runs its own token slice, so the golden is the
+    dense forward per group."""
+    from triton_dist_tpu.models import (
+        EPMoETransformer, EPMoETransformerConfig, ep_moe_param_specs,
+        init_moe_params,
+    )
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+
+    dp = 2
+    cfg = EPMoETransformerConfig(
+        vocab=64, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=2, seq=16, n_experts=8, topk=2, ep_outer="dp",
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+        gg_config=GroupGemmConfig(8, 16, 16),
+    )
+    model, specs = EPMoETransformer(cfg), ep_moe_param_specs(cfg)
+    params = init_moe_params(jax.random.PRNGKey(10), cfg)
+    m = cfg.batch * cfg.seq
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(11), (dp * m,), 0, cfg.vocab, jnp.int32
+    )
+    params_sh = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh2x4, s)), params, specs
+    )
+    got = jax.jit(
+        jax.shard_map(
+            lambda t, p: model(t, p), mesh=mesh2x4,
+            in_specs=(P(("dp", "tp")), specs),
+            out_specs=P("dp", "tp"), check_vma=False,
+        )
+    )(tokens, params_sh)
+    # drain the interpreted program before dispatching the eager golden:
+    # concurrent io_callbacks + eager ops can starve XLA:CPU's thread pool
+    # (the conftest deadlock note) on small-core hosts
+    jax.block_until_ready(got)
+    want = np.concatenate(
+        [
+            np.asarray(_moe_ref_forward(tokens[g * m : (g + 1) * m], params, cfg))
+            for g in range(dp)
+        ]
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=5e-3, atol=5e-3)
 
 
 def test_models_package_imports():

@@ -36,5 +36,6 @@ from triton_dist_tpu import ops as ops
 from triton_dist_tpu import utils as utils
 from triton_dist_tpu import layers as layers
 from triton_dist_tpu import aot as aot
+from triton_dist_tpu import checkpoint as checkpoint
 from triton_dist_tpu import perf_model as perf_model
 from triton_dist_tpu.autotuner import contextual_autotune

@@ -1,0 +1,88 @@
+"""Distributed checkpoint / resume for the model family.
+
+The reference has NO checkpointing (SURVEY.md §5: "absent (no trainer)" —
+its only resume-like state is the autotune log dir). This framework ships a
+trainer-shaped model family, so it ships the matching aux subsystem: sharded
+save/restore built on orbax (the TPU ecosystem's checkpointer), with
+restore-onto-any-mesh resharding — the property that makes checkpoints
+useful across slice sizes (train on v5p-32, resume debug on an 8-device CPU
+mesh).
+
+Design notes (TPU-native, not a port):
+- Saves are SPMD-coordinated: every process calls :func:`save` with its
+  addressable shards; orbax writes a single logical checkpoint (OCDBT).
+- Restore takes the TARGET sharding tree — params land already placed for
+  the mesh you resume on, no host-side gather/scatter round-trip.
+- Async by default (``wait=False`` returns immediately and overlaps the
+  serialization with the next train steps; call :func:`wait_until_saved`
+  before exiting) — the standard bandwidth trick for large meshes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+__all__ = ["save", "restore", "latest_step", "wait_until_saved"]
+
+_manager_cache: dict[str, Any] = {}
+
+
+def _manager(directory: str):
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    if directory not in _manager_cache:
+        _manager_cache[directory] = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=3, create=True, enable_async_checkpointing=True
+            ),
+        )
+    return _manager_cache[directory]
+
+
+def save(directory: str, step: int, tree: Any, *, wait: bool = False) -> None:
+    """Save a (sharded) pytree as checkpoint `step`. All processes must
+    call this collectively. ``wait=True`` blocks until durable."""
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(directory)
+    mgr.save(step, args=ocp.args.StandardSave(tree))
+    if wait:
+        mgr.wait_until_finished()
+
+
+def wait_until_saved(directory: str) -> None:
+    """Block until every async save to `directory` is durable."""
+    _manager(directory).wait_until_finished()
+
+
+def latest_step(directory: str) -> int | None:
+    """Newest checkpoint step in `directory`, or None if empty. Read
+    failures (corrupt metadata, permissions) propagate — a resume script
+    must not mistake a broken checkpoint dir for a fresh run."""
+    return _manager(directory).latest_step()
+
+
+def restore(directory: str, step: int | None = None, *, like: Any) -> Any:
+    """Restore checkpoint `step` (default: latest) resharded to match
+    `like` — a pytree of arrays (shapes/dtypes/shardings to restore onto,
+    e.g. ``jax.eval_shape`` output placed with ``NamedSharding``s of the
+    CURRENT mesh, or simply the freshly-initialized params)."""
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(directory)
+    if step is None:
+        step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {directory}")
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+        ),
+        like,
+    )
+    return mgr.restore(step, args=ocp.args.StandardRestore(abstract))

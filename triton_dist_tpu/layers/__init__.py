@@ -10,5 +10,6 @@ kernels' own workspaces. All ``__call__``s run inside ``jax.shard_map``.
 
 from triton_dist_tpu.layers.allgather_layer import AllGatherLayer
 from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer, HierEPAll2AllLayer
+from triton_dist_tpu.layers.ep_moe_mlp import EPMoEMLP
 from triton_dist_tpu.layers.sp_flash_decode_layer import SpGQAFlashDecodeAttention
 from triton_dist_tpu.layers.tp_mlp import TPMLP, TPMoEMLP

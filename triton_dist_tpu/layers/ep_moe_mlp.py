@@ -1,0 +1,123 @@
+"""Expert-parallel MoE MLP — the full EP block the reference's tests
+compose inline (≙ reference ``ep_a2a.py`` dispatch → local grouped expert
+compute → combine; its ``EPAll2AllLayer`` only ships the transport, the
+expert GEMMs live in the test bodies).
+
+Contrast with :class:`~triton_dist_tpu.layers.tp_mlp.TPMoEMLP`: there every
+PE holds a *slice of every* expert and tokens ride AG/RS; here each PE holds
+``n_experts / world`` *whole* experts and tokens travel to their experts
+over the all-to-all (DeepSeek-style EP). One layer covers both transports:
+
+- flat (``axis=``): single all-to-all over one mesh axis;
+- hierarchical (``outer=``/``inner=``): the two-phase node-then-local
+  dispatch with cross-node dedup (≙ ``ep_a2a.py:36-147``).
+
+The expert compute between dispatch and combine is the scalar-prefetch
+grouped GEMM pair on block-aligned received rows — the same kernel the TP
+MoE path uses, with whole-expert weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer, HierEPAll2AllLayer
+from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
+
+
+@dataclasses.dataclass
+class EPMoEMLP:
+    """Call inside ``jax.shard_map``; x ``[m_loc, H]`` (token-sharded over
+    the EP world), w_up ``[E/world, H, F]``, w_down ``[E/world, F, H]``
+    (each PE's WHOLE experts), routing ``[m_loc, topk]`` → ``[m_loc, H]``.
+
+    ``max_m`` is the per-(src, dest) slab capacity of the (phase-1)
+    dispatch; ``max_m2`` the phase-2 capacity when hierarchical (defaults
+    to a worst-case bound from the phase-1 slabs).
+    """
+
+    n_experts: int
+    topk: int
+    max_m: int
+    axis: str = "ep"            # flat transport axis …
+    outer: str | None = None    # … or set BOTH outer+inner for two-phase
+    inner: str | None = None
+    max_m2: int | None = None
+    activation: Callable[[jax.Array], jax.Array] = jax.nn.gelu
+    gg_config: GroupGemmConfig | None = None
+    interpret: Any = None
+
+    def _transport(self):
+        if (self.outer is None) != (self.inner is None):
+            raise ValueError("set both outer= and inner=, or neither")
+        if self.outer is not None:
+            n_o = int(jax.lax.axis_size(self.outer))
+            return HierEPAll2AllLayer(
+                n_experts=self.n_experts, topk=self.topk,
+                max_m1=self.max_m,
+                max_m2=self.max_m2 or n_o * self.max_m * self.topk,
+                outer=self.outer, inner=self.inner, interpret=self.interpret,
+            )
+        return EPAll2AllLayer(
+            n_experts=self.n_experts, topk=self.topk, max_m=self.max_m,
+            axis=self.axis, interpret=self.interpret,
+        )
+
+    def __call__(
+        self,
+        x: jax.Array,
+        w_up: jax.Array,
+        w_down: jax.Array,
+        topk_ids: jax.Array,
+        topk_weights: jax.Array,
+        *,
+        with_overflow: bool = False,
+    ):
+        """``with_overflow=True`` additionally returns the scalar count of
+        assignments dropped by slab overflow — an undersized ``max_m``
+        silently zeroes those tokens' expert contributions otherwise (the
+        transport layers surface the same counter; don't swallow it in
+        anything user-facing)."""
+        cfg = self.gg_config or GroupGemmConfig()
+        layer = self._transport()
+        hier = self.outer is not None
+        m_loc = x.shape[0]
+
+        if hier:
+            recv, info = layer.dispatch(x, topk_ids, topk_weights)
+        else:
+            recv, info = layer.dispatch(x, topk_ids)
+
+        # local expert compute on block-aligned received rows (sentinel
+        # rows land on the clamped last expert and are dropped on scatter)
+        al = layer.receiver_alignment(info, block_m=cfg.block_m)
+        rows = recv.reshape(-1, x.shape[-1])            # [R, H]
+        r_cap = rows.shape[0]
+        a_sorted = rows[jnp.minimum(al.sorted_token_ids, r_cap - 1)]
+        h1 = group_gemm(
+            a_sorted, w_up, al.expert_ids, config=cfg, interpret=self.interpret
+        )
+        h1 = self.activation(h1.astype(jnp.float32)).astype(x.dtype)
+        y_sorted = group_gemm(
+            h1, w_down, al.expert_ids, config=cfg, interpret=self.interpret
+        )
+        # back to the received slab layout: each valid row appears exactly
+        # once in the sorted order; the sentinel id R is out of range → drop
+        y = (
+            jnp.zeros((r_cap, y_sorted.shape[-1]), y_sorted.dtype)
+            .at[al.sorted_token_ids]
+            .set(y_sorted, mode="drop")
+            .reshape(recv.shape[0], recv.shape[1], -1)
+            .astype(x.dtype)
+        )
+
+        if hier:
+            out = layer.combine(y, info, m_loc)
+        else:
+            out = layer.combine(y, info, topk_weights, m_loc)
+        out = out.astype(x.dtype)
+        return (out, info.overflow) if with_overflow else out

@@ -17,10 +17,13 @@ from triton_dist_tpu.models.sp_transformer import (
     sp_train_step,
 )
 from triton_dist_tpu.models.tp_transformer import (
+    EPMoETransformer,
+    EPMoETransformerConfig,
     MoETransformerConfig,
     TransformerConfig,
     TPMoETransformer,
     TPTransformer,
+    ep_moe_param_specs,
     init_moe_params,
     init_params,
     moe_param_specs,
@@ -37,10 +40,13 @@ __all__ = [
     "sp_train_step",
     "decode_step",
     "generate",
+    "EPMoETransformer",
+    "EPMoETransformerConfig",
     "MoETransformerConfig",
     "TransformerConfig",
     "TPMoETransformer",
     "TPTransformer",
+    "ep_moe_param_specs",
     "init_moe_params",
     "init_params",
     "moe_param_specs",

@@ -318,6 +318,63 @@ class TPMoETransformer(TPTransformer):
         return moe(h, p["w_up"], p["w_down"], ids, tw.astype(jnp.float32))
 
 
+@dataclasses.dataclass(frozen=True)
+class EPMoETransformerConfig(MoETransformerConfig):
+    """Expert-parallel MoE decoder: attention stays TP over ``axis``; the
+    FFN experts are WHOLE and spread over the EP world (DeepSeek-style),
+    tokens traveling to them over the all-to-all. ``ep_outer=None`` → flat
+    EP over ``axis``; set it (e.g. ``"dp"``) for the two-phase hierarchical
+    dispatch over ``(ep_outer, axis)``."""
+
+    ep_outer: str | None = None
+    ep_max_m: int | None = None  # per-(src, dest) slab cap; None = worst case
+
+
+def ep_moe_param_specs(cfg: EPMoETransformerConfig) -> dict:
+    """Like :func:`moe_param_specs` but experts are sharded on the EXPERT
+    dim (each PE holds whole experts) instead of the FFN dim."""
+    specs = moe_param_specs(cfg)
+    exp_axes = (
+        (cfg.ep_outer, cfg.axis) if cfg.ep_outer is not None else cfg.axis
+    )
+    for p in specs["layers"]:
+        p["w_up"] = P(exp_axes, None, None)
+        p["w_down"] = P(exp_axes, None, None)
+    return specs
+
+
+@dataclasses.dataclass
+class EPMoETransformer(TPMoETransformer):
+    """MoE decoder forward with expert-parallel FFNs: router →
+    ``layers.EPMoEMLP`` (EP dispatch a2a, local grouped expert GEMMs,
+    push-based weighted combine). Params from :func:`init_moe_params` with
+    :func:`ep_moe_param_specs` sharding — inside shard_map each PE sees
+    ``[E/world, H, F]`` whole experts. Forward/serving path, like the TP
+    MoE variant (the a2a transport ships without a custom VJP)."""
+
+    def _mlp(self, x: jax.Array, p: dict) -> jax.Array:
+        from triton_dist_tpu.layers.ep_moe_mlp import EPMoEMLP
+        from triton_dist_tpu.ops.moe_utils import select_experts
+
+        c = self.cfg
+        h = rmsnorm(x, p["mlp_norm"], c.norm_eps)
+        logits = h.astype(jnp.float32) @ p["router"].astype(jnp.float32)
+        tw, ids = select_experts(logits, c.topk)
+        # worst-case slab bound: hierarchical phase 1 dedups to at most ONE
+        # copy per (token, dest node), so m_loc suffices; flat dispatch can
+        # send all topk assignments to one rank
+        max_m = c.ep_max_m or (
+            x.shape[0] if c.ep_outer is not None else x.shape[0] * c.topk
+        )
+        moe = EPMoEMLP(
+            n_experts=c.n_experts, topk=c.topk, max_m=max_m,
+            axis=c.axis, outer=c.ep_outer,
+            inner=c.axis if c.ep_outer is not None else None,
+            gg_config=c.gg_config, interpret=c.interpret,
+        )
+        return moe(h, p["w_up"], p["w_down"], ids, tw.astype(jnp.float32))
+
+
 def train_step(
     model: TPTransformer, params, tokens_loc, targets, lr=1e-2,
     dp_axis: str | None = "dp",

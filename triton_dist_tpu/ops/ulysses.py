@@ -9,8 +9,9 @@ latency dominates.)
 
 Transport is the framework's own ``fast_all_to_all`` slab exchange
 (ops/all_to_all.py): head-group slabs are equal-sized, so the padded-slab
-contract is exact (no padding waste), and the exchange is a single fused
-Pallas kernel per direction. Differentiable end-to-end via a custom VJP:
+contract is exact (no padding waste), and q/k/v ride ONE fused exchange
+(their rows concatenated per slab) — two collectives per forward, two per
+backward. Differentiable end-to-end via a custom VJP:
 the transpose of the head exchange is the reverse exchange, so the backward
 is the same two collectives around the local attention's VJP.
 """
@@ -37,37 +38,59 @@ def _exchange(x: jax.Array, axis: str, n: int, interpret: Any):
     return recv
 
 
-def _seq_to_heads(q, axis, n, interpret):
-    """[b, h, s_loc, d] seq-sharded → [b, h/n, S, d] head-sharded."""
-    b, h, s_loc, d = q.shape
+def _seq_to_heads(arrs, axis, n, interpret):
+    """[b, h, s_loc, d] seq-sharded → [b, h/n, S, d] head-sharded, for a
+    tuple of same-shape arrays IN ONE EXCHANGE: slab j carries the arrays'
+    head-group-j rows back to back, so q/k/v cost one collective (and one
+    barrier), not three."""
+    b, h, s_loc, d = arrs[0].shape
     h_loc = h // n
-    # slab j = head group j (all local seq rows)
-    slabs = q.reshape(b, n, h_loc, s_loc, d).transpose(1, 0, 2, 3, 4)
-    recv = _exchange(slabs.reshape(n, b * h_loc * s_loc, d), axis, n, interpret)
+    rows = b * h_loc * s_loc
+    # slab j = head group j (all local seq rows), arrays concatenated
+    slabs = jnp.concatenate(
+        [
+            a.reshape(b, n, h_loc, s_loc, d)
+            .transpose(1, 0, 2, 3, 4)
+            .reshape(n, rows, d)
+            for a in arrs
+        ],
+        axis=1,
+    )
+    recv = _exchange(slabs, axis, n, interpret)
     # slab i holds seq chunk i of my head group
-    return (
-        recv.reshape(n, b, h_loc, s_loc, d)
+    return tuple(
+        recv[:, i * rows : (i + 1) * rows]
+        .reshape(n, b, h_loc, s_loc, d)
         .transpose(1, 2, 0, 3, 4)
         .reshape(b, h_loc, n * s_loc, d)
+        for i in range(len(arrs))
     )
 
 
-def _heads_to_seq(o, axis, n, interpret):
-    """[b, h/n, S, d] head-sharded → [b, h, s_loc, d] seq-sharded
-    (the exact transpose of :func:`_seq_to_heads`)."""
-    b, h_loc, s_tot, d = o.shape
+def _heads_to_seq(arrs, axis, n, interpret):
+    """[b, h/n, S, d] head-sharded → [b, h, s_loc, d] seq-sharded for a
+    tuple of same-shape arrays in one exchange (the exact transpose of
+    :func:`_seq_to_heads`)."""
+    b, h_loc, s_tot, d = arrs[0].shape
     s_loc = s_tot // n
-    slabs = (
-        o.reshape(b, h_loc, n, s_loc, d)
-        .transpose(2, 0, 1, 3, 4)          # slab i = seq chunk i → PE i
-        .reshape(n, b * h_loc * s_loc, d)
+    rows = b * h_loc * s_loc
+    slabs = jnp.concatenate(
+        [
+            a.reshape(b, h_loc, n, s_loc, d)
+            .transpose(2, 0, 1, 3, 4)      # slab i = seq chunk i → PE i
+            .reshape(n, rows, d)
+            for a in arrs
+        ],
+        axis=1,
     )
     recv = _exchange(slabs, axis, n, interpret)
     # slab j = head group j computed by PE j, for MY seq chunk
-    return (
-        recv.reshape(n, b, h_loc, s_loc, d)
+    return tuple(
+        recv[:, i * rows : (i + 1) * rows]
+        .reshape(n, b, h_loc, s_loc, d)
         .transpose(1, 0, 2, 3, 4)
         .reshape(b, n * h_loc, s_loc, d)
+        for i in range(len(arrs))
     )
 
 
@@ -101,25 +124,21 @@ def ulysses_attention(
     n = int(jax.lax.axis_size(axis))
     if n == 1:
         return _local_attention(q, k, v, causal)
-    qh = _seq_to_heads(q, axis, n, interpret)
-    kh = _seq_to_heads(k, axis, n, interpret)
-    vh = _seq_to_heads(v, axis, n, interpret)
+    qh, kh, vh = _seq_to_heads((q, k, v), axis, n, interpret)
     oh = _local_attention(qh, kh, vh, causal)
-    return _heads_to_seq(oh, axis, n, interpret)
+    return _heads_to_seq((oh,), axis, n, interpret)[0]
 
 
 def _ulysses_fwd(q, k, v, axis, causal, interpret):
     n = int(jax.lax.axis_size(axis))
     if n == 1:
         return _local_attention(q, k, v, causal), (q, k, v)
-    qh = _seq_to_heads(q, axis, n, interpret)
-    kh = _seq_to_heads(k, axis, n, interpret)
-    vh = _seq_to_heads(v, axis, n, interpret)
+    qh, kh, vh = _seq_to_heads((q, k, v), axis, n, interpret)
     oh = _local_attention(qh, kh, vh, causal)
     # residuals are the head-sharded inputs in BOTH cases (at n==1 the two
     # layouts coincide); the local attention is recomputed in the backward
     # (flash-style remat) rather than storing its linearization
-    return _heads_to_seq(oh, axis, n, interpret), (qh, kh, vh)
+    return _heads_to_seq((oh,), axis, n, interpret)[0], (qh, kh, vh)
 
 
 def _ulysses_bwd(axis, causal, interpret, res, dout):
@@ -129,12 +148,9 @@ def _ulysses_bwd(axis, causal, interpret, res, dout):
     if n == 1:
         return vjp(dout)
     # transpose of heads→seq is seq→heads (a permutation both ways)
-    dqh, dkh, dvh = vjp(_seq_to_heads(dout, axis, n, interpret))
-    return (
-        _heads_to_seq(dqh, axis, n, interpret),
-        _heads_to_seq(dkh, axis, n, interpret),
-        _heads_to_seq(dvh, axis, n, interpret),
-    )
+    (dout_h,) = _seq_to_heads((dout,), axis, n, interpret)
+    dqh, dkh, dvh = vjp(dout_h)
+    return _heads_to_seq((dqh, dkh, dvh), axis, n, interpret)
 
 
 ulysses_attention.defvjp(_ulysses_fwd, _ulysses_bwd)

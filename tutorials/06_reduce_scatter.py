@@ -1,0 +1,69 @@
+"""Tutorial 06 — ReduceScatter: 1-D kernels + the hierarchical pipeline
+(≙ reference ``tutorials/05-intra-node-reduce-scatter.py`` and
+``06-inter-node-reduce-scatter.py``: the intra-node scatter → local reduce
+→ inter-node P2P → ring pipeline of ``reduce_scatter.py:47-142,525-637``).
+
+TPU-native: the 1-D family is ``ring`` (bandwidth-optimal neighbor ring,
+one add per hop) and ``scatter_reduce`` (push all chunks up front, one
+local f32 reduction — the latency-bound choice); the inter-node story is
+the same kernels peeled over two mesh axes, inner (fast ICI) first so
+every slow-axis byte crosses exactly once and already reduced. Run:
+
+    python tutorials/06_reduce_scatter.py
+"""
+
+import common  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.ops.reduce_scatter import reduce_scatter, reduce_scatter_op
+
+
+def main():
+    mesh, world = common.bootstrap()
+    m_loc, h = 8, 128
+    # each PE holds a full [world*m_loc, h] partial; sum lands sharded on dim 0
+    x = jax.device_put(
+        jax.random.normal(
+            jax.random.PRNGKey(0), (world, world * m_loc, h), jnp.float32
+        ),
+        NamedSharding(mesh, P("tp", None, None)),
+    )
+    want = np.asarray(x).sum(axis=0)
+
+    # 1-D (≙ tutorial 05, intra-node): both methods against the same golden
+    for method in ("auto", "ring", "scatter_reduce"):
+        got = reduce_scatter_op(x, mesh, method=method)
+        ok = np.allclose(np.asarray(got), want, atol=1e-4, rtol=1e-5)
+        common.report(f"06_reduce_scatter[{method}]", ok, f"world={world}")
+
+    # 2-D hierarchical (≙ tutorial 06, inter-node): (node, local) staging
+    if world % 2:
+        common.report("06_reduce_scatter_2d", True, f"SKIP: world={world} not even")
+        return
+    n_o, n_i = 2, world // 2
+    devs = np.array(jax.devices())
+    mesh2d = Mesh(devs.reshape(n_o, n_i), ("node", "local"))
+    xs = jax.random.normal(
+        jax.random.PRNGKey(1), (world, world * m_loc, h), jnp.float32
+    )
+    got2 = jax.jit(
+        jax.shard_map(
+            lambda p: reduce_scatter(p[0], axis=("node", "local")),
+            mesh=mesh2d,
+            in_specs=P(("node", "local")),
+            out_specs=P(("node", "local")),
+            check_vma=False,
+        )
+    )(xs)
+    ok2 = np.allclose(
+        np.asarray(got2), np.asarray(xs).sum(axis=0), atol=1e-4, rtol=1e-5
+    )
+    common.report("06_reduce_scatter_2d", ok2, f"mesh={n_o}x{n_i} (node, local)")
+
+
+if __name__ == "__main__":
+    main()
