@@ -63,6 +63,33 @@ def test_tp_moe_mlp(mesh4):
     np.testing.assert_allclose(np.asarray(got), want, rtol=5e-3, atol=5e-3)
 
 
+def test_tp_moe_mlp_2d_axes(mesh2x4):
+    """MoE TP over a composite (node, local) axis pair: the AG-GroupGEMM's
+    gather and the MoE-Reduce-RS's scatter both ride the hierarchical
+    multi-axis collectives (the reference's multi-node MoE pipeline,
+    moe_reduce_rs.py:817 consumer_reduce_scatter_reduce_2d)."""
+    m_tot, h_dim, f_dim, n_exp, topk = 16, 64, 128, 4, 2
+    x = jax.random.normal(jax.random.PRNGKey(50), (m_tot, h_dim), jnp.float32)
+    w_up = jax.random.normal(jax.random.PRNGKey(51), (n_exp, h_dim, f_dim)) / 8
+    w_down = jax.random.normal(jax.random.PRNGKey(52), (n_exp, f_dim, h_dim)) / 8
+    tw, ids = select_experts(
+        jax.random.normal(jax.random.PRNGKey(53), (m_tot, n_exp)), topk
+    )
+    layer = TPMoEMLP(axis=("dp", "tp"), gg_config=GroupGemmConfig(8, 64, 32))
+    got = jax.jit(
+        jax.shard_map(
+            layer, mesh=mesh2x4,
+            in_specs=(
+                P(("dp", "tp")), P(None, None, ("dp", "tp")),
+                P(None, ("dp", "tp")), P(("dp", "tp")), P(("dp", "tp")),
+            ),
+            out_specs=P(("dp", "tp")), check_vma=False,
+        )
+    )(x, w_up, w_down, ids, tw)
+    want = _dense_moe_golden(x, w_up, w_down, ids, tw)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=5e-3, atol=5e-3)
+
+
 def _dense_moe_golden(x, w_up, w_down, ids, tw):
     m_tot, h_dim = x.shape
     want = np.zeros((m_tot, h_dim), np.float32)
