@@ -51,3 +51,26 @@ def test_generate_matches_full_forward(mesh4):
         toks = np.concatenate([toks, nxt[:, None]], axis=1)
     want = toks[:, prompt_len:]
     np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_generate_paged_matches_contiguous(mesh4):
+    """Paged serving cache (page pool + block table + runtime allocation)
+    decodes exactly the tokens the contiguous cache decodes."""
+    b, prompt_len, n_steps, s_max = 2, 4, 4, 16
+    cfg = TransformerConfig(
+        vocab=32, hidden=32, ffn=64, n_layers=2, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=b, seq=prompt_len + n_steps,
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+    )
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(3), (b, prompt_len), 0, cfg.vocab, jnp.int32
+    )
+    contiguous = generate(
+        cfg, params, prompt, n_steps, mesh4, s_max=s_max,
+        fd_config=FlashDecodeConfig(block_s=4),
+    )
+    paged = generate(
+        cfg, params, prompt, n_steps, mesh4, s_max=s_max, page_size=2,
+    )
+    np.testing.assert_array_equal(np.asarray(paged), np.asarray(contiguous))

@@ -9,7 +9,12 @@ with dense and MoE blocks, differentiable end-to-end through the fused
 kernels' custom VJPs.
 """
 
-from triton_dist_tpu.models.decode import KVCacheSpec, decode_step, generate
+from triton_dist_tpu.models.decode import (
+    KVCacheSpec,
+    PagedKVCacheSpec,
+    decode_step,
+    generate,
+)
 from triton_dist_tpu.models.pipeline import pipeline_apply, stage_slice
 from triton_dist_tpu.models.sp_transformer import (
     SPTransformer,
@@ -33,6 +38,7 @@ from triton_dist_tpu.models.tp_transformer import (
 
 __all__ = [
     "KVCacheSpec",
+    "PagedKVCacheSpec",
     "pipeline_apply",
     "stage_slice",
     "SPTransformer",

@@ -35,16 +35,44 @@ from triton_dist_tpu.models.tp_transformer import (
 from triton_dist_tpu.ops.flash_decode import (
     FlashDecodeConfig,
     flash_decode_distributed,
+    paged_flash_decode_distributed,
 )
+
+
+def _shard_of(s_max: int, n: int) -> int:
+    """Per-PE sequence shard; positions >= (s_max//n)*n would be owned by
+    no PE (their k/v would silently never land), so require even division."""
+    if s_max % n != 0:
+        raise ValueError(f"s_max={s_max} must divide evenly over {n} PEs")
+    return s_max // n
+
+
+def _mask_store_and_lens(cfg, cache, li, upd_k, upd_v, pos, me, s_shard):
+    """Owner-gated cache write + per-PE valid lengths, shared by both cache
+    strategies (a fix here must hold for contiguous AND paged)."""
+    owner = pos // s_shard
+    k_sh = jnp.where(me == owner, upd_k, cache["k"][li])
+    v_sh = jnp.where(me == owner, upd_v, cache["v"][li])
+    cache = dict(
+        cache, k=cache["k"].at[li].set(k_sh), v=cache["v"].at[li].set(v_sh)
+    )
+    local_lens = jnp.full(
+        (cfg.batch,), jnp.clip(pos + 1 - me * s_shard, 0, s_shard), jnp.int32
+    )
+    return k_sh, v_sh, cache, local_lens
 
 
 @dataclasses.dataclass(frozen=True)
 class KVCacheSpec:
-    """Cache geometry: per layer ``[b, h_kv, s_max, d]`` sharded on dim 2."""
+    """Contiguous cache geometry: per layer ``[b, h_kv, s_max, d]`` sharded
+    on dim 2. The spec object is also the cache STRATEGY: ``pre_step`` and
+    ``update_and_attend`` are the only places decode touches the cache, so
+    the paged variant below slots in without touching the decode loop."""
 
     s_max: int
 
-    def init(self, cfg: TransformerConfig) -> dict:
+    def init(self, cfg: TransformerConfig, n: int) -> dict:
+        _shard_of(self.s_max, n)
         shape = (
             cfg.n_layers, cfg.batch, cfg.n_kv_heads, self.s_max, cfg.head_dim
         )
@@ -54,6 +82,114 @@ class KVCacheSpec:
         t = cfg.axis
         return dict(k=P(None, None, None, t, None), v=P(None, None, None, t, None))
 
+    def pre_step(self, cfg, cache: dict, pos, me, n: int) -> dict:
+        return cache
+
+    def update_and_attend(
+        self, cfg, cache, li, k_new, v_new, q, pos, me, n,
+        fd_config, interpret,
+    ):
+        """Owning PE appends this position's k/v into its sequence shard,
+        then SP flash-decode partials merge by log-sum-exp."""
+        s_shard = _shard_of(self.s_max, n)
+        off = pos % s_shard
+        upd_k = jax.lax.dynamic_update_slice(
+            cache["k"][li], k_new.astype(cache["k"].dtype)[:, :, None, :],
+            (0, 0, off, 0),
+        )
+        upd_v = jax.lax.dynamic_update_slice(
+            cache["v"][li], v_new.astype(cache["v"].dtype)[:, :, None, :],
+            (0, 0, off, 0),
+        )
+        k_sh, v_sh, cache, local_lens = _mask_store_and_lens(
+            cfg, cache, li, upd_k, upd_v, pos, me, s_shard
+        )
+        attn = flash_decode_distributed(
+            q.astype(k_sh.dtype), k_sh, v_sh, local_lens,
+            axis=cfg.axis, config=fd_config, interpret=interpret,
+        )
+        return attn, cache
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedKVCacheSpec:
+    """Paged cache: each PE owns a page POOL covering its sequence shard
+    plus a per-sequence block table (≙ the reference's paged serving cache,
+    flash_decode.py:136,203 — vLLM-style). Pages are allocated at RUNTIME
+    from a per-PE counter the first time a position lands in a new logical
+    page, and the block-table indirection steers the kernel's page fetches
+    via scalar prefetch (ops/flash_decode.paged_flash_decode)."""
+
+    s_max: int
+    page_size: int
+
+    def _geometry(self, cfg, n: int) -> tuple[int, int]:
+        s_shard = _shard_of(self.s_max, n)
+        assert s_shard % self.page_size == 0, (s_shard, self.page_size)
+        pages_per_seq = s_shard // self.page_size
+        return pages_per_seq, cfg.batch * pages_per_seq  # local pool size
+
+    def init(self, cfg: TransformerConfig, n: int) -> dict:
+        pages_per_seq, n_pages = self._geometry(cfg, n)
+        shape = (
+            cfg.n_layers, n * n_pages, cfg.n_kv_heads, self.page_size,
+            cfg.head_dim,
+        )
+        return dict(
+            k=jnp.zeros(shape, cfg.dtype),
+            v=jnp.zeros(shape, cfg.dtype),
+            block_table=jnp.zeros((n, cfg.batch, pages_per_seq), jnp.int32),
+            n_alloc=jnp.zeros((n,), jnp.int32),
+        )
+
+    def specs(self, cfg: TransformerConfig) -> dict:
+        t = cfg.axis
+        return dict(
+            k=P(None, t, None, None, None), v=P(None, t, None, None, None),
+            block_table=P(t, None, None), n_alloc=P(t),
+        )
+
+    def pre_step(self, cfg, cache: dict, pos, me, n: int) -> dict:
+        """Allocate a physical page per sequence when this step's position
+        opens a new logical page on the owning PE (runs once per step —
+        the table is shared by all layers, whose pools allocate in
+        lockstep)."""
+        s_shard = self.s_max // n
+        off = pos % s_shard
+        page_idx = off // self.page_size
+        need = (me == pos // s_shard) & (off % self.page_size == 0)
+        new_ids = cache["n_alloc"][0] + jnp.arange(cfg.batch, dtype=jnp.int32)
+        bt = jnp.where(
+            need,
+            cache["block_table"].at[0, :, page_idx].set(new_ids),
+            cache["block_table"],
+        )
+        n_alloc = cache["n_alloc"] + jnp.where(need, cfg.batch, 0)
+        return dict(cache, block_table=bt, n_alloc=n_alloc)
+
+    def update_and_attend(
+        self, cfg, cache, li, k_new, v_new, q, pos, me, n,
+        fd_config, interpret,
+    ):
+        s_shard = _shard_of(self.s_max, n)
+        off = pos % s_shard
+        slot = off % self.page_size
+        page_ids = cache["block_table"][0, :, off // self.page_size]  # [b]
+        upd_k = cache["k"][li].at[page_ids, :, slot].set(
+            k_new.astype(cache["k"].dtype)
+        )
+        upd_v = cache["v"][li].at[page_ids, :, slot].set(
+            v_new.astype(cache["v"].dtype)
+        )
+        k_sh, v_sh, cache, local_lens = _mask_store_and_lens(
+            cfg, cache, li, upd_k, upd_v, pos, me, s_shard
+        )
+        attn = paged_flash_decode_distributed(
+            q.astype(k_sh.dtype), k_sh, v_sh, local_lens,
+            cache["block_table"][0], axis=cfg.axis, interpret=interpret,
+        )
+        return attn, cache
+
 
 def decode_step(
     cfg: TransformerConfig,
@@ -62,13 +198,13 @@ def decode_step(
     tokens: jax.Array,   # [b] int32 — this step's input token per sequence
     pos: jax.Array,      # [] int32 — current position (same for the batch)
     *,
-    s_shard: int,
+    spec: KVCacheSpec | PagedKVCacheSpec,
     fd_config: FlashDecodeConfig | None = None,
     interpret: Any = None,
 ) -> tuple[jax.Array, dict]:
     """One decode step (call inside ``jax.shard_map``): returns
-    ``(logits [b, vocab], new_cache)``. ``cache['k']/['v']`` hold this PE's
-    sequence shard ``[L, b, h_kv, s_shard, d]``."""
+    ``(logits [b, vocab], new_cache)``. The cache layout and attention
+    kernel come from `spec` (contiguous or paged)."""
     c = cfg
     n = int(jax.lax.axis_size(c.axis))
     me = jax.lax.axis_index(c.axis)
@@ -78,13 +214,11 @@ def decode_step(
     assert c.n_kv_heads % n == 0, (c.n_kv_heads, n)
 
     x = params["embed"][tokens]  # [b, H] replicated
-    k_cache, v_cache = cache["k"], cache["v"]
-    owner = pos // s_shard
-    off = pos % s_shard
     pos1 = pos[None].astype(jnp.int32)
+    cache = spec.pre_step(c, cache, pos, me, n)
 
     for li, p in enumerate(params["layers"]):
-        # --- attention (SP flash decode over the seq-sharded cache) ---
+        # --- attention (SP flash decode over the sharded cache) ---
         h = rmsnorm(x, p["attn_norm"], c.norm_eps)
         qkv_loc = h @ p["wqkv"].reshape(c.hidden, -1)      # [b, qkv/n] local
         # head-complete qkv: PE-major concat == kv-group-major (the groups
@@ -98,26 +232,8 @@ def decode_step(
         q = rope(q, pos1, c.rope_theta)[:, 0]               # [b, hq, d]
         k_new = rope(k_new, pos1, c.rope_theta)[:, 0]       # [b, h_kv, d]
 
-        # the owning PE appends this position's k/v to its shard
-        upd_k = jax.lax.dynamic_update_slice(
-            k_cache[li], k_new.astype(k_cache.dtype)[:, :, None, :],
-            (0, 0, off, 0),
-        )
-        upd_v = jax.lax.dynamic_update_slice(
-            v_cache[li], v_new.astype(v_cache.dtype)[:, :, None, :],
-            (0, 0, off, 0),
-        )
-        k_sh = jnp.where(me == owner, upd_k, k_cache[li])
-        v_sh = jnp.where(me == owner, upd_v, v_cache[li])
-        k_cache = k_cache.at[li].set(k_sh)
-        v_cache = v_cache.at[li].set(v_sh)
-
-        local_lens = jnp.full(
-            (c.batch,), jnp.clip(pos + 1 - me * s_shard, 0, s_shard), jnp.int32
-        )
-        attn = flash_decode_distributed(
-            q.astype(k_sh.dtype), k_sh, v_sh, local_lens,
-            axis=c.axis, config=fd_config, interpret=interpret,
+        attn, cache = spec.update_and_attend(
+            c, cache, li, k_new, v_new, q, pos, me, n, fd_config, interpret
         )                                                    # [b, hq, d] f32
         # row-parallel out-proj on the LOCAL head slice + psum
         attn_loc = jax.lax.dynamic_slice_in_dim(
@@ -134,7 +250,7 @@ def decode_step(
     x = rmsnorm(x, params["final_norm"], c.norm_eps)
     logits_loc = x @ params["lm_head"]                       # [b, V/n]
     logits = jax.lax.all_gather(logits_loc, c.axis, axis=1, tiled=True)
-    return logits, dict(k=k_cache, v=v_cache)
+    return logits, cache
 
 
 def generate(
@@ -145,11 +261,19 @@ def generate(
     mesh: Mesh,
     *,
     s_max: int,
+    page_size: int | None = None,
     fd_config: FlashDecodeConfig | None = None,
     interpret: Any = None,
 ) -> jax.Array:
     """Greedy generation: feed the prompt token-by-token (cache warmup),
     then decode ``n_steps`` new tokens. Returns ``[b, n_steps]``.
+
+    ``page_size`` switches the KV cache to the paged layout (page pool +
+    block table, runtime page allocation) — the serving-shaped
+    configuration; default is the contiguous sequence-sharded cache. On
+    the paged path the page IS the attention block, so ``fd_config``
+    (whose ``block_s`` tiles the contiguous kernel) is not accepted
+    alongside ``page_size``.
 
     Host-level entry; jits ONE fused program that lax.scans decode_step
     over all positions (prompt phase ignores the model's predictions)."""
@@ -162,15 +286,21 @@ def generate(
             f"prompt_len={prompt_len} + n_steps={n_steps} exceeds the KV "
             f"cache capacity s_max={s_max}"
         )
-    spec = KVCacheSpec(s_max)
+    if page_size and fd_config is not None:
+        raise ValueError(
+            "fd_config tiles the contiguous kernel; with page_size the page "
+            "is the block — pass one or the other"
+        )
+    spec = (
+        PagedKVCacheSpec(s_max, page_size) if page_size else KVCacheSpec(s_max)
+    )
+    n = mesh.shape[cfg.axis]
     cache = jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        spec.init(cfg), spec.specs(cfg),
+        spec.init(cfg, n), spec.specs(cfg),
     )
-    s_shard = s_max // mesh.shape[cfg.axis]
     step = functools.partial(
-        decode_step, cfg, s_shard=s_shard, fd_config=fd_config,
-        interpret=interpret,
+        decode_step, cfg, spec=spec, fd_config=fd_config, interpret=interpret,
     )
 
     def run(params, cache, prompt):
