@@ -125,7 +125,13 @@ class PagedKVCacheSpec:
 
     def _geometry(self, cfg, n: int) -> tuple[int, int]:
         s_shard = _shard_of(self.s_max, n)
-        assert s_shard % self.page_size == 0, (s_shard, self.page_size)
+        if s_shard % self.page_size != 0:
+            # a non-dividing page size would let block_table gathers clamp
+            # and silently overwrite page 0 — fail loudly like _shard_of
+            raise ValueError(
+                f"page_size={self.page_size} must divide the per-PE "
+                f"sequence shard {s_shard}"
+            )
         pages_per_seq = s_shard // self.page_size
         return pages_per_seq, cfg.batch * pages_per_seq  # local pool size
 

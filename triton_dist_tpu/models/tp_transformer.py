@@ -300,22 +300,24 @@ def moe_param_specs(cfg: MoETransformerConfig) -> dict:
 
 @dataclasses.dataclass
 class TPMoETransformer(TPTransformer):
-    """MoE decoder forward: the dense MLP half is replaced by router →
-    ``layers.TPMoEMLP`` (fused AG-GroupGEMM up, MoE-Reduce-RS down).
-    Forward/serving path — the MoE kernels ship without custom VJPs, so
-    training this variant today means a dense-equivalent backward or
-    stop-gradient routing."""
+    """MoE decoder: the dense MLP half is replaced by router →
+    fused AG-GroupGEMM up, MoE-Reduce-RS down — differentiable end-to-end
+    via ``ops.grads.tp_moe_mlp_grad`` (the router trains through the
+    routing-weight gradient), so :func:`train_step` works on this variant
+    exactly as on the dense model."""
 
     def _mlp(self, x: jax.Array, p: dict) -> jax.Array:
-        from triton_dist_tpu.layers.tp_mlp import TPMoEMLP
+        from triton_dist_tpu.ops.grads import tp_moe_mlp_grad
         from triton_dist_tpu.ops.moe_utils import select_experts
 
         c = self.cfg
         h = rmsnorm(x, p["mlp_norm"], c.norm_eps)
         logits = h.astype(jnp.float32) @ p["router"].astype(jnp.float32)
         tw, ids = select_experts(logits, c.topk)
-        moe = TPMoEMLP(axis=c.axis, gg_config=c.gg_config, interpret=c.interpret)
-        return moe(h, p["w_up"], p["w_down"], ids, tw.astype(jnp.float32))
+        return tp_moe_mlp_grad(
+            h, p["w_up"], p["w_down"], ids, tw.astype(jnp.float32),
+            c.axis, jax.nn.gelu, c.gg_config, c.interpret,
+        ).astype(x.dtype)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -375,6 +377,15 @@ class EPMoETransformer(TPMoETransformer):
         return moe(h, p["w_up"], p["w_down"], ids, tw.astype(jnp.float32))
 
 
+def specs_for(cfg: TransformerConfig) -> dict:
+    """Partition specs matching the model variant's param tree."""
+    if isinstance(cfg, EPMoETransformerConfig):
+        return ep_moe_param_specs(cfg)
+    if isinstance(cfg, MoETransformerConfig):
+        return moe_param_specs(cfg)
+    return param_specs(cfg)
+
+
 def train_step(
     model: TPTransformer, params, tokens_loc, targets, lr=1e-2,
     dp_axis: str | None = "dp",
@@ -398,10 +409,15 @@ def train_step(
     )(params)
     if dp_axis is not None:
         loss = jax.lax.pmean(loss, dp_axis)
-    specs = param_specs(c)
+    specs = specs_for(c)
 
     def fix(g, spec):
-        if c.axis not in tuple(spec):
+        # flatten composite spec entries like ("dp", "tp") before asking
+        # whether this param is sharded over the tensor axis
+        axes: set = set()
+        for e in tuple(spec):
+            axes.update(e if isinstance(e, (tuple, list)) else (e,))
+        if c.axis not in axes:
             g = jax.lax.psum(g, c.axis)
         if dp_axis is not None:
             g = jax.lax.pmean(g, dp_axis)

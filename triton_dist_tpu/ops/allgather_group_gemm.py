@@ -40,8 +40,9 @@ def ag_group_gemm(
     axis: str = "tp",
     config: GroupGemmConfig | None = None,
     ag_method: str = "auto",
+    gather_output: bool = False,
     interpret: Any = None,
-) -> tuple[jax.Array, MoEAlignment]:
+):
     """Overlapped MoE up-projection (call inside ``jax.shard_map``;
     ≙ ``ag_group_gemm``, reference allgather_group_gemm.py:272).
 
@@ -50,7 +51,9 @@ def ag_group_gemm(
     Returns ``(h_sorted [t_pad, n_loc], alignment)`` — the grouped-GEMM
     output in block-aligned expert order over the *gathered* tokens, plus
     the alignment to unsort it (the reference likewise returns scatter
-    order for the follow-up reduce).
+    order for the follow-up reduce). ``gather_output=True`` additionally
+    returns the gathered tokens ``a_full`` (free — the fwd workspace; the
+    training backward wants it, same contract as ``ag_gemm``).
     """
     cfg = config or GroupGemmConfig()
     n_exp = b.shape[0]
@@ -64,6 +67,8 @@ def ag_group_gemm(
     h_sorted = group_gemm(
         a_sorted, b, alignment.expert_ids, config=cfg, interpret=interpret
     )
+    if gather_output:
+        return h_sorted, alignment, a_full
     return h_sorted, alignment
 
 

@@ -25,6 +25,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm
 from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs
@@ -200,3 +201,179 @@ def _ring_attn_bwd(axis, causal, config, interpret, res, dout):
 
 
 ring_attention_grad.defvjp(_ring_attn_fwd, _ring_attn_bwd)
+
+
+def _block_outer_accumulate(a_sorted, g_sorted, expert_ids, n_exp, block_m):
+    """``dW[e] = Σ_{blocks of e} A_blkᵀ @ G_blk`` — the transpose grouped
+    GEMM. A scan over row blocks keeps peak memory at ``[E, K, N] + [K, N]``
+    (an einsum+segment-sum would materialize ``[n_blocks, K, N]``); each
+    step is one MXU ``[bm,K]ᵀ@[bm,N]`` matmul."""
+    k_dim = a_sorted.shape[1]
+    n_dim = g_sorted.shape[1]
+    a_blocks = a_sorted.reshape(-1, block_m, k_dim)
+    g_blocks = g_sorted.reshape(-1, block_m, n_dim)
+
+    def step(acc, inp):
+        a_b, g_b, e = inp
+        upd = jnp.dot(
+            a_b.T.astype(jnp.float32), g_b.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        return acc.at[e].add(upd), None
+
+    acc0 = jnp.zeros((n_exp, k_dim, n_dim), jnp.float32)
+    acc, _ = jax.lax.scan(step, acc0, (a_blocks, g_blocks, expert_ids))
+    return acc
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def tp_moe_mlp_grad(
+    x: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    topk_ids: jax.Array,
+    topk_weights: jax.Array,
+    axis: str = "tp",
+    activation=jax.nn.gelu,
+    gg_config: Any = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Differentiable fused MoE TP MLP (call inside shard_map) — the
+    training path the reference lacks for its MoE ops.
+
+    Forward = the fused AG-GroupGEMM → activation → MoE-Reduce-RS exactly
+    as :class:`~triton_dist_tpu.layers.tp_mlp.TPMoEMLP`. Backward reuses
+    the same algebra as the dense pair (grads above): the reduce-scatter's
+    transpose is an all-gather of dout, the two grouped GEMMs backprop
+    through ``group_gemm`` with per-expert transposed weights (the fused
+    kernel is its own backward), expert-weight grads come from the
+    block-transpose scan, and dx / d(topk_weights) return to their shards
+    via one fused reduce-scatter each. y_sorted is recomputed (flash-style
+    remat) rather than stored.
+
+    x: ``[m_loc, H]``; w_up: ``[E, H, F/n]``; w_down: ``[E, F/n, H]``;
+    topk_ids/topk_weights: ``[m_loc, topk]`` (ids carry a zero cotangent).
+    Returns ``[m_loc, H]``.
+    """
+    from triton_dist_tpu.ops.allgather_group_gemm import ag_group_gemm
+    from triton_dist_tpu.ops.moe_reduce_rs import moe_reduce_rs
+
+    n = int(jax.lax.axis_size(axis))
+    h_sorted, alignment = ag_group_gemm(
+        x, w_up, topk_ids, axis=axis, config=gg_config, interpret=interpret
+    )
+    act = activation(h_sorted.astype(jnp.float32)).astype(x.dtype)
+    tw_full = jax.lax.all_gather(topk_weights, axis, tiled=True)
+    return moe_reduce_rs(
+        act, w_down, alignment, tw_full, axis=axis,
+        n_tokens=n * x.shape[0], config=gg_config, out_dtype=x.dtype,
+        interpret=interpret,
+    ).astype(x.dtype)
+
+
+def _tp_moe_fwd(x, w_up, w_down, topk_ids, topk_weights, axis, activation,
+                gg_config, interpret):
+    from triton_dist_tpu.ops.allgather_group_gemm import ag_group_gemm
+    from triton_dist_tpu.ops.moe_reduce_rs import moe_reduce_rs
+
+    n = int(jax.lax.axis_size(axis))
+    h_sorted, alignment, a_full = ag_group_gemm(
+        x, w_up, topk_ids, axis=axis, config=gg_config,
+        gather_output=True, interpret=interpret,
+    )
+    act = activation(h_sorted.astype(jnp.float32)).astype(x.dtype)
+    tw_full = jax.lax.all_gather(topk_weights, axis, tiled=True)
+    out = moe_reduce_rs(
+        act, w_down, alignment, tw_full, axis=axis,
+        n_tokens=n * x.shape[0], config=gg_config, out_dtype=x.dtype,
+        interpret=interpret,
+    ).astype(x.dtype)
+    res = (a_full, h_sorted, tw_full, alignment, w_up, w_down, x.shape[0])
+    return out, res
+
+
+def _tp_moe_bwd(axis, activation, gg_config, interpret, res, dout):
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
+    from triton_dist_tpu.ops.moe_utils import gather_sorted_rows
+    from triton_dist_tpu.ops.reduce_scatter import reduce_scatter
+
+    a_full, h_sorted, tw_full, al, w_up, w_down, m_loc = res
+    cfg = gg_config or GroupGemmConfig()
+    n_exp = w_up.shape[0]
+    f32 = jnp.float32
+    m_tot, h_dim = a_full.shape
+    topk = tw_full.shape[1]
+    t = m_tot * topk
+
+    # transpose of the final reduce-scatter: every PE sees the full dout
+    dpartial = jax.lax.all_gather(dout, axis, tiled=True).astype(f32)  # [m_tot, H]
+
+    ids = al.sorted_token_ids                       # [t_pad], sentinel == t
+    valid = ids < t
+    token_of_row = jnp.clip(ids // topk, 0, m_tot - 1)
+    w_row = jnp.where(
+        valid, tw_full.reshape(-1)[jnp.clip(ids, 0, t - 1)], 0.0
+    ).astype(f32)                                   # [t_pad]
+
+    # recompute act / y_sorted (remat) and the activation's local VJP
+    act_f, act_vjp = jax.vjp(
+        lambda h: activation(h.astype(f32)), h_sorted
+    )
+    act = act_f.astype(a_full.dtype)
+    y_sorted = group_gemm(
+        act, w_down, al.expert_ids, config=cfg, out_dtype=f32,
+        interpret=interpret,
+    )                                               # [t_pad, H]
+
+    dpart_rows = dpartial[token_of_row]             # [t_pad, H]
+    # d topk_weights: dot(dout_row, y_row) per valid assignment, summed
+    # over PEs (each PE holds only its F-shard's contribution)
+    dtw_rows = jnp.where(valid, jnp.sum(dpart_rows * y_sorted, -1), 0.0)
+    dtw_full = (
+        jnp.zeros((t,), f32).at[jnp.clip(ids, 0, t - 1)]
+        .add(dtw_rows)  # already zeroed at invalid rows
+        .reshape(m_tot, topk)
+    )
+    # tiny, latency-bound payload: the XLA collective, not the ring kernel
+    dtw = jax.lax.psum_scatter(
+        dtw_full, axis, scatter_dimension=0, tiled=True
+    ).astype(tw_full.dtype)                         # [m_loc, topk]
+
+    # back through the weighted scatter: dy_sorted = w * dout_row
+    dy_sorted = (dpart_rows * w_row[:, None]).astype(act.dtype)
+    # back through the down grouped GEMM (fused kernel, transposed weights)
+    dact = group_gemm(
+        dy_sorted, w_down.transpose(0, 2, 1), al.expert_ids, config=cfg,
+        out_dtype=f32, interpret=interpret,
+    )
+    dw_down = _block_outer_accumulate(
+        act, dy_sorted, al.expert_ids, n_exp, cfg.block_m
+    ).astype(w_down.dtype)
+    # through the activation
+    (dh_sorted,) = act_vjp(dact)
+    dh_sorted = dh_sorted.astype(a_full.dtype)
+    # back through the up grouped GEMM
+    a_sorted = gather_sorted_rows(a_full, al, topk)
+    a_sorted = jnp.where(valid[:, None], a_sorted, 0)  # mask sentinel rows
+    da_sorted = group_gemm(
+        dh_sorted, w_up.transpose(0, 2, 1), al.expert_ids, config=cfg,
+        out_dtype=f32, interpret=interpret,
+    )
+    dw_up = _block_outer_accumulate(
+        a_sorted, dh_sorted, al.expert_ids, n_exp, cfg.block_m
+    ).astype(w_up.dtype)
+    # unsorted scatter-add back to tokens, then the all-gather's transpose
+    da_full = (
+        jnp.zeros((m_tot, h_dim), f32)
+        .at[token_of_row]
+        .add(jnp.where(valid[:, None], da_sorted, 0.0))
+    )
+    dx = reduce_scatter(
+        da_full, axis=axis, interpret=interpret
+    ).astype(a_full.dtype)                          # [m_loc, H]
+
+    dids = np.zeros((m_loc, topk), jax.dtypes.float0)
+    return dx, dw_up, dw_down, dids, dtw
+
+
+tp_moe_mlp_grad.defvjp(_tp_moe_fwd, _tp_moe_bwd)
