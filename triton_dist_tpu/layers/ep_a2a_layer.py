@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from triton_dist_tpu.ops.all_to_all import fast_all_to_all
+from triton_dist_tpu.ops.grads import fast_all_to_all_grad
 from triton_dist_tpu.ops.moe_utils import MoEAlignment, moe_align_block_size
 
 
@@ -123,9 +124,8 @@ class EPAll2AllLayer:
         )
         # expert ids ride the splits payload of the SAME a2a — dispatch
         # costs exactly one collective call (VERDICT r1 weak #7)
-        recv, recv_splits, recv_exp = fast_all_to_all(
-            send, clamped, meta=send_exp, axis=self.axis,
-            interpret=self.interpret,
+        recv, recv_splits, recv_exp = fast_all_to_all_grad(
+            send, clamped, send_exp, self.axis, self.interpret
         )
         info = DispatchInfo(
             order=order,
@@ -164,8 +164,8 @@ class EPAll2AllLayer:
         topk_weights: ``[m_loc, topk]``. Returns ``[m_loc, h]``.
         """
         n = self._world()
-        back, _ = fast_all_to_all(
-            y, info.recv_splits, axis=self.axis, interpret=self.interpret
+        back, _, _ = fast_all_to_all_grad(
+            y, info.recv_splits, None, self.axis, self.interpret
         )
         # slab p row i ↔ sorted assignment offsets[p]+i ↔ assignment order[...]
         # (offsets from the UNCLAMPED counts — they index the sorted
@@ -234,6 +234,12 @@ class HierEPAll2AllLayer:
     Expert placement matches the flat layer over the flattened
     (outer-major) rank order: expert ``e`` on rank ``e // epr`` =
     (outer ``rank // n_i``, inner ``rank % n_i``).
+
+    FORWARD-ONLY: routing weights travel bitcast through the integer
+    metadata channel, so differentiating this layer would silently zero
+    the router gradient — it therefore stays on the non-differentiable
+    transport (autodiff fails loudly). Train with the flat
+    :class:`EPAll2AllLayer` (differentiable end-to-end) or the TP MoE path.
     """
 
     n_experts: int

@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 
 from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer, HierEPAll2AllLayer
-from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
+from triton_dist_tpu.ops.grads import group_gemm_grad
+from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
 
 
 @dataclasses.dataclass
@@ -98,12 +99,12 @@ class EPMoEMLP:
         rows = recv.reshape(-1, x.shape[-1])            # [R, H]
         r_cap = rows.shape[0]
         a_sorted = rows[jnp.minimum(al.sorted_token_ids, r_cap - 1)]
-        h1 = group_gemm(
-            a_sorted, w_up, al.expert_ids, config=cfg, interpret=self.interpret
+        h1 = group_gemm_grad(
+            a_sorted, w_up, al.expert_ids, cfg, None, self.interpret
         )
         h1 = self.activation(h1.astype(jnp.float32)).astype(x.dtype)
-        y_sorted = group_gemm(
-            h1, w_down, al.expert_ids, config=cfg, interpret=self.interpret
+        y_sorted = group_gemm_grad(
+            h1, w_down, al.expert_ids, cfg, None, self.interpret
         )
         # back to the received slab layout: each valid row appears exactly
         # once in the sorted order; the sentinel id R is out of range → drop

@@ -12,10 +12,8 @@ import jax
 import jax.numpy as jnp
 
 from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm
-from triton_dist_tpu.ops.allgather_group_gemm import ag_group_gemm
 from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs
 from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
-from triton_dist_tpu.ops.moe_reduce_rs import moe_reduce_rs
 
 
 @dataclasses.dataclass
@@ -49,6 +47,10 @@ class TPMoEMLP:
     activation, MoE-Reduce-RS down-projection (≙ composing the reference's
     ``ag_group_gemm`` + ``moe_reduce_rs`` as its MoE tests do).
 
+    Delegates to :func:`~triton_dist_tpu.ops.grads.tp_moe_mlp_grad` — ONE
+    source of truth for the fused MoE forward, and the layer is trainable
+    for free (custom VJP, router gradient included).
+
     Call inside ``jax.shard_map``; x ``[m_loc, H]``, w_up ``[E, H, F/n]``,
     w_down ``[E, F/n, H]``, routing from local logits → ``[m_loc, H]``
     (token-sharded both ends)."""
@@ -66,16 +68,9 @@ class TPMoEMLP:
         topk_ids: jax.Array,       # [m_loc, topk]
         topk_weights: jax.Array,   # [m_loc, topk]
     ) -> jax.Array:
-        n = int(jax.lax.axis_size(self.axis))
-        m_loc = x.shape[0]
-        h_sorted, alignment = ag_group_gemm(
-            x, w_up, topk_ids, axis=self.axis, config=self.gg_config,
-            interpret=self.interpret,
-        )
-        h_sorted = self.activation(h_sorted)
-        tw_full = jax.lax.all_gather(topk_weights, self.axis, tiled=True)
-        return moe_reduce_rs(
-            h_sorted, w_down, alignment, tw_full,
-            axis=self.axis, n_tokens=n * m_loc, config=self.gg_config,
-            out_dtype=x.dtype, interpret=self.interpret,
+        from triton_dist_tpu.ops.grads import tp_moe_mlp_grad
+
+        return tp_moe_mlp_grad(
+            x, w_up, w_down, topk_ids, topk_weights.astype(jnp.float32),
+            self.axis, self.activation, self.gg_config, self.interpret,
         ).astype(x.dtype)

@@ -377,3 +377,96 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, res, dout):
 
 
 tp_moe_mlp_grad.defvjp(_tp_moe_fwd, _tp_moe_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fast_all_to_all_grad(
+    tokens: jax.Array,
+    splits: jax.Array,
+    meta: jax.Array | None = None,
+    axis: str = "tp",
+    interpret: Any = None,
+):
+    """Differentiable padded-slab all-to-all (call inside shard_map).
+
+    The slab exchange is a self-inverse permutation of the data (slab j of
+    PE i ↔ slab i of PE j; full slabs always ship, splits are metadata), so
+    its VJP is the SAME exchange applied to the output cotangent — one
+    fused collective each way. splits/meta are integer bookkeeping and
+    carry zero cotangents. Always returns ``(recv, recv_splits,
+    recv_meta-or-None)``.
+    """
+    from triton_dist_tpu.ops.all_to_all import fast_all_to_all
+
+    out = fast_all_to_all(
+        tokens, splits, meta=meta, axis=axis, interpret=interpret
+    )
+    if meta is None:
+        recv, rs = out
+        return recv, rs, None
+    return out
+
+
+def _a2a_fwd(tokens, splits, meta, axis, interpret):
+    out = fast_all_to_all_grad(tokens, splits, meta, axis, interpret)
+    return out, (out[1], splits, meta)
+
+
+def _a2a_bwd(axis, interpret, res, cots):
+    from triton_dist_tpu.ops.all_to_all import fast_all_to_all
+
+    recv_splits, splits, meta = res
+    d_recv = cots[0]  # cotangent dtype matches the primal tokens dtype
+    dx, _ = fast_all_to_all(
+        d_recv, recv_splits, axis=axis, interpret=interpret
+    )
+    d_splits = np.zeros(splits.shape, jax.dtypes.float0)
+    d_meta = None if meta is None else np.zeros(meta.shape, jax.dtypes.float0)
+    return dx, d_splits, d_meta
+
+
+fast_all_to_all_grad.defvjp(_a2a_fwd, _a2a_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def group_gemm_grad(
+    a_sorted: jax.Array,
+    b: jax.Array,
+    expert_ids: jax.Array,
+    config: Any = None,
+    out_dtype: Any = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Differentiable block-aligned grouped GEMM (the scalar-prefetch MXU
+    kernel is its own backward with per-expert transposed weights; the
+    expert-weight grad is the block-transpose scan)."""
+    from triton_dist_tpu.ops.group_gemm import group_gemm
+
+    return group_gemm(
+        a_sorted, b, expert_ids, config=config, out_dtype=out_dtype,
+        interpret=interpret,
+    )
+
+
+def _gg_fwd(a_sorted, b, expert_ids, config, out_dtype, interpret):
+    out = group_gemm_grad(a_sorted, b, expert_ids, config, out_dtype, interpret)
+    return out, (a_sorted, b, expert_ids)
+
+
+def _gg_bwd(config, out_dtype, interpret, res, dout):
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
+
+    a_sorted, b, expert_ids = res
+    cfg = config or GroupGemmConfig()
+    da = group_gemm(
+        dout.astype(a_sorted.dtype), b.transpose(0, 2, 1), expert_ids,
+        config=cfg, out_dtype=jnp.float32, interpret=interpret,
+    ).astype(a_sorted.dtype)
+    db = _block_outer_accumulate(
+        a_sorted, dout, expert_ids, b.shape[0], cfg.block_m
+    ).astype(b.dtype)
+    d_ids = np.zeros(expert_ids.shape, jax.dtypes.float0)
+    return da, db, d_ids
+
+
+group_gemm_grad.defvjp(_gg_fwd, _gg_bwd)
