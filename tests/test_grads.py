@@ -159,6 +159,19 @@ def test_ring_attention_grad_matches_full(mesh4):
     np.testing.assert_allclose(np.asarray(gv), np.asarray(rv), rtol=2e-3, atol=2e-3)
 
 
+
+def _dense_moe_loss(ids):
+    """Dense differentiable MoE golden, shared by the three grad tests."""
+
+    def dense_loss(x, wu, wd, tw):
+        he = jax.nn.gelu(jnp.einsum("th,tkhf->tkf", x, wu[ids]))
+        y = jnp.einsum("tkf,tkfh->tkh", he, wd[ids])
+        out = jnp.sum(tw[:, :, None] * y, axis=1)
+        return jnp.sum(out ** 2)
+
+    return dense_loss
+
+
 def test_tp_moe_mlp_grad(mesh4):
     """Fused MoE TP MLP custom VJP vs the dense differentiable MoE: grads
     for tokens, both expert weight banks, and the routing weights."""
@@ -191,15 +204,9 @@ def test_tp_moe_mlp_grad(mesh4):
             check_vma=False,
         )
     )(x, w_up, w_down, ids, tw)
+    jax.block_until_ready((dx, dwu, dwd, dtw))
 
-    # dense differentiable golden on the full (unsharded) domain
-    def dense_loss(x, wu, wd, tw):
-        he = jax.nn.gelu(jnp.einsum("th,tkhf->tkf", x, wu[ids]))
-        y = jnp.einsum("tkf,tkfh->tkh", he, wd[ids])
-        out = jnp.sum(tw[:, :, None] * y, axis=1)
-        return jnp.sum(out ** 2)
-
-    wx, wwu, wwd, wtw = jax.grad(dense_loss, argnums=(0, 1, 2, 3))(
+    wx, wwu, wwd, wtw = jax.grad(_dense_moe_loss(ids), argnums=(0, 1, 2, 3))(
         x, w_up, w_down, tw
     )
     np.testing.assert_allclose(np.asarray(dx), np.asarray(wx), rtol=2e-3, atol=2e-3)
@@ -246,14 +253,64 @@ def test_ep_moe_mlp_grad(mesh4):
             check_vma=False,
         )
     )(x, w_up, w_down, ids, tw)
+    # drain the interpreted program before the eager golden (1-core
+    # thread-pool starvation otherwise; see conftest note)
+    jax.block_until_ready((dx, dwu, dwd, dtw))
 
-    def dense_loss(x, wu, wd, tw):
-        he = jax.nn.gelu(jnp.einsum("th,tkhf->tkf", x, wu[ids]))
-        y = jnp.einsum("tkf,tkfh->tkh", he, wd[ids])
-        out = jnp.sum(tw[:, :, None] * y, axis=1)
-        return jnp.sum(out ** 2)
+    wx, wwu, wwd, wtw = jax.grad(_dense_moe_loss(ids), argnums=(0, 1, 2, 3))(
+        x, w_up, w_down, tw
+    )
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(wx), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(dwu), np.asarray(wwu), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(dwd), np.asarray(wwd), rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(dtw), np.asarray(wtw), rtol=2e-3, atol=2e-3)
 
-    wx, wwu, wwd, wtw = jax.grad(dense_loss, argnums=(0, 1, 2, 3))(
+
+def test_hier_ep_moe_mlp_grad(mesh2x4):
+    """Hierarchical two-phase EP MoE differentiates too — routing weights
+    ride the data slab (a differentiable channel), so the router gradient
+    survives both a2a hops."""
+    from triton_dist_tpu.layers import EPMoEMLP
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+    from triton_dist_tpu.ops.moe_utils import select_experts
+
+    n_o, n_i, m_loc, h_dim, f_dim, topk = 2, 4, 4, 32, 64, 2
+    world = n_o * n_i
+    n_exp = world
+    m_tot = world * m_loc
+    x = jax.random.normal(jax.random.PRNGKey(80), (m_tot, h_dim), jnp.float32)
+    w_up = jax.random.normal(jax.random.PRNGKey(81), (n_exp, h_dim, f_dim)) / 8
+    w_down = jax.random.normal(jax.random.PRNGKey(82), (n_exp, f_dim, h_dim)) / 8
+    tw, ids = select_experts(
+        jax.random.normal(jax.random.PRNGKey(83), (m_tot, n_exp)), topk
+    )
+    tw = tw.astype(jnp.float32)
+    layer = EPMoEMLP(
+        n_experts=n_exp, topk=topk, max_m=m_loc * topk,
+        outer="dp", inner="tp", gg_config=GroupGemmConfig(8, 32, 32),
+    )
+    specs = (
+        P(("dp", "tp"), None), P(("dp", "tp"), None, None),
+        P(("dp", "tp"), None, None), P(("dp", "tp"), None),
+        P(("dp", "tp"), None),
+    )
+
+    def loss(x, wu, wd, ids, tw):
+        return jnp.sum(layer(x, wu, wd, ids, tw) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2, 4))
+    dx, dwu, dwd, dtw = jax.jit(
+        jax.shard_map(
+            g, mesh=mesh2x4, in_specs=specs,
+            out_specs=(specs[0], specs[1], specs[2], specs[4]),
+            check_vma=False,
+        )
+    )(x, w_up, w_down, ids, tw)
+    # drain the interpreted program before the eager golden (1-core
+    # thread-pool starvation otherwise; see conftest note)
+    jax.block_until_ready((dx, dwu, dwd, dtw))
+
+    wx, wwu, wwd, wtw = jax.grad(_dense_moe_loss(ids), argnums=(0, 1, 2, 3))(
         x, w_up, w_down, tw
     )
     np.testing.assert_allclose(np.asarray(dx), np.asarray(wx), rtol=2e-3, atol=2e-3)

@@ -235,11 +235,11 @@ class HierEPAll2AllLayer:
     (outer-major) rank order: expert ``e`` on rank ``e // epr`` =
     (outer ``rank // n_i``, inner ``rank % n_i``).
 
-    FORWARD-ONLY: routing weights travel bitcast through the integer
-    metadata channel, so differentiating this layer would silently zero
-    the router gradient — it therefore stays on the non-differentiable
-    transport (autodiff fails loudly). Train with the flat
-    :class:`EPAll2AllLayer` (differentiable end-to-end) or the TP MoE path.
+    Differentiable end-to-end: routing weights ride the DATA slab as topk
+    extra columns (expert ids stay on the integer metadata put), so the
+    router gradient flows through the a2a VJPs like every other cotangent.
+    With bf16 tokens the in-flight weights round to bf16 — train in f32 or
+    accept the routing-weight rounding.
     """
 
     n_experts: int
@@ -292,36 +292,33 @@ class HierEPAll2AllLayer:
         order1, dest1_sorted, pos1, offsets1, clamped1, overflow1 = _pack_slabs(
             dest1, n_o, self.max_m1
         )
-        send1 = jnp.zeros((n_o, self.max_m1, hidden), tokens.dtype)
+        # routing WEIGHTS ride the data slab as topk extra columns — the
+        # differentiable channel (an int-metadata bitcast would cut the
+        # router gradient); expert IDS stay on the int metadata put.
+        # Weights are carried in the slab dtype (bf16 tokens round them).
+        row_payload = jnp.concatenate(
+            [tokens, topk_weights.astype(tokens.dtype)], axis=1
+        )                                                     # [m_loc, H+topk]
+        send1 = jnp.zeros((n_o, self.max_m1, hidden + self.topk), tokens.dtype)
         send1 = send1.at[dest1_sorted, pos1].set(
-            tokens[order1 // self.topk], mode="drop"
+            row_payload[order1 // self.topk], mode="drop"
         )
-        # metadata per row: the token's full topk ids + bitcast weights
+        # metadata per row: the token's full topk ids
         # (the relay filters to its own node's experts)
         meta_ids = jnp.full((n_o, self.max_m1, self.topk), -1, jnp.int32)
-        meta_w = jnp.zeros((n_o, self.max_m1, self.topk), jnp.int32)
         row_ids = topk_ids.astype(jnp.int32)[order1 // self.topk]
-        row_w = jax.lax.bitcast_convert_type(
-            topk_weights.astype(jnp.float32), jnp.int32
-        )[order1 // self.topk]
         meta_ids = meta_ids.at[dest1_sorted, pos1].set(row_ids, mode="drop")
-        meta_w = meta_w.at[dest1_sorted, pos1].set(row_w, mode="drop")
-        meta1 = jnp.concatenate(
-            [meta_ids.reshape(n_o, -1), meta_w.reshape(n_o, -1)], axis=1
+        recv1, recv_splits1, rmeta1 = fast_all_to_all_grad(
+            send1, clamped1, meta_ids.reshape(n_o, -1), self.outer,
+            self.interpret,
         )
-        recv1, recv_splits1, rmeta1 = fast_all_to_all(
-            send1, clamped1, meta=meta1, axis=self.outer,
-            interpret=self.interpret,
-        )
-        rmeta1 = rmeta1.reshape(n_o, 2, self.max_m1, self.topk)
-        rel_ids = rmeta1[:, 0].reshape(-1, self.topk)          # [R, topk]
-        rel_w = jax.lax.bitcast_convert_type(
-            rmeta1[:, 1].reshape(-1, self.topk), jnp.float32
-        )
+        rel_ids = rmeta1.reshape(-1, self.topk)                # [R, topk]
 
         # ---- phase 2: relay scatters rows to expert-owning inner PEs ----
         R = n_o * self.max_m1
-        rows = recv1.reshape(R, hidden)
+        rows_full = recv1.reshape(R, hidden + self.topk)
+        rows = rows_full[:, :hidden]
+        rel_w = rows_full[:, hidden:].astype(jnp.float32)      # [R, topk]
         pos_r = jnp.arange(R, dtype=jnp.int32) % self.max_m1
         slab_r = jnp.arange(R, dtype=jnp.int32) // self.max_m1
         row_valid = pos_r < recv_splits1[slab_r]               # [R]
@@ -346,9 +343,8 @@ class HierEPAll2AllLayer:
         send_exp2 = send_exp2.at[dest2_sorted, pos2].set(
             jnp.where(g >= 0, g % epr, -1)[order2], mode="drop"
         )
-        recv2, recv_splits2, recv_exp2 = fast_all_to_all(
-            send2, clamped2, meta=send_exp2, axis=self.inner,
-            interpret=self.interpret,
+        recv2, recv_splits2, recv_exp2 = fast_all_to_all_grad(
+            send2, clamped2, send_exp2, self.inner, self.interpret
         )
         info = HierDispatchInfo(
             order1=order1, send_splits1=clamped1, send_offsets1=offsets1,
@@ -376,8 +372,8 @@ class HierEPAll2AllLayer:
         R = n_o * self.max_m1
 
         # reverse phase 2 (inner axis): expert outputs back to the relay
-        back2, _ = fast_all_to_all(
-            y, info.recv_splits2, axis=self.inner, interpret=self.interpret
+        back2, _, _ = fast_all_to_all_grad(
+            y, info.recv_splits2, None, self.inner, self.interpret
         )
         flat2 = back2.reshape(n_i * self.max_m2, h)
         pos2 = jnp.arange(n_i * self.max_m2, dtype=jnp.int32) % self.max_m2
@@ -396,9 +392,9 @@ class HierEPAll2AllLayer:
         )
 
         # reverse phase 1 (outer axis): node-partials back to the source
-        back1, _ = fast_all_to_all(
+        back1, _, _ = fast_all_to_all_grad(
             partial.reshape(n_o, self.max_m1, h).astype(y.dtype),
-            info.recv_splits1, axis=self.outer, interpret=self.interpret,
+            info.recv_splits1, None, self.outer, self.interpret,
         )
         flat1 = back1.reshape(R, h)
         pos1 = jnp.arange(R, dtype=jnp.int32) % self.max_m1

@@ -351,11 +351,10 @@ class EPMoETransformer(TPMoETransformer):
     ``layers.EPMoEMLP`` (EP dispatch a2a, local grouped expert GEMMs,
     push-based weighted combine). Params from :func:`init_moe_params` with
     :func:`ep_moe_param_specs` sharding — inside shard_map each PE sees
-    ``[E/world, H, F]`` whole experts. The FLAT layout (``ep_outer=None``)
-    trains end-to-end (the a2a and grouped-GEMM VJPs compose, router
-    included); the hierarchical layout is forward/serving-only — its
-    routing weights ride the integer metadata channel, so autodiff through
-    it fails loudly by design (see ``HierEPAll2AllLayer``)."""
+    ``[E/world, H, F]`` whole experts. Both layouts train end-to-end: the
+    a2a and grouped-GEMM VJPs compose, and the hierarchical dispatch
+    carries routing weights in the data slab (a differentiable channel),
+    so the router gradient survives both hops."""
 
     def _mlp(self, x: jax.Array, p: dict) -> jax.Array:
         from triton_dist_tpu.layers.ep_moe_mlp import EPMoEMLP

@@ -409,19 +409,21 @@ def fast_all_to_all_grad(
 
 def _a2a_fwd(tokens, splits, meta, axis, interpret):
     out = fast_all_to_all_grad(tokens, splits, meta, axis, interpret)
-    return out, (out[1], splits, meta)
+    # only static shapes are needed for the float0 zeros — don't keep the
+    # integer arrays alive across the forward/backward gap
+    return out, (out[1], splits.shape, None if meta is None else meta.shape)
 
 
 def _a2a_bwd(axis, interpret, res, cots):
     from triton_dist_tpu.ops.all_to_all import fast_all_to_all
 
-    recv_splits, splits, meta = res
+    recv_splits, splits_shape, meta_shape = res
     d_recv = cots[0]  # cotangent dtype matches the primal tokens dtype
     dx, _ = fast_all_to_all(
         d_recv, recv_splits, axis=axis, interpret=interpret
     )
-    d_splits = np.zeros(splits.shape, jax.dtypes.float0)
-    d_meta = None if meta is None else np.zeros(meta.shape, jax.dtypes.float0)
+    d_splits = np.zeros(splits_shape, jax.dtypes.float0)
+    d_meta = None if meta_shape is None else np.zeros(meta_shape, jax.dtypes.float0)
     return dx, d_splits, d_meta
 
 
