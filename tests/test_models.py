@@ -414,3 +414,98 @@ def test_ep_moe_transformer_train_step(mesh4):
     r0 = np.asarray(params["layers"][0]["router"])
     r1 = np.asarray(p1["layers"][0]["router"])
     assert np.abs(r1 - r0).max() > 0
+
+
+def _moe_dense_forward(tokens, params, cfg):
+    """Differentiable dense golden forward for the (1-layer) MoE decoder
+    (einsum MoE instead of _moe_ref_forward's numpy loop)."""
+    from triton_dist_tpu.ops.moe_utils import select_experts
+
+    m = tokens.shape[0]
+    x = params["embed"][tokens]
+    p = params["layers"][0]
+    b, s, g, d = cfg.batch, cfg.seq, cfg.n_q_heads // cfg.n_kv_heads, cfg.head_dim
+    h = rmsnorm(x, p["attn_norm"], cfg.norm_eps)
+    qkv = (h @ p["wqkv"].reshape(cfg.hidden, -1)).reshape(b, s, cfg.n_kv_heads, g + 2, d)
+    q = qkv[..., :g, :].reshape(b, s, cfg.n_q_heads, d)
+    k, v = qkv[..., g, :], qkv[..., g + 1, :]
+    pos = jnp.arange(s, dtype=jnp.int32)
+    q, k = rope(q, pos, cfg.rope_theta), rope(k, pos, cfg.rope_theta)
+    attn = _causal_gqa_attention(q, k, v, cfg)
+    x = x + attn.reshape(m, cfg.q_dim) @ p["wo"]
+    h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps)
+    logits = h.astype(jnp.float32) @ p["router"].astype(jnp.float32)
+    tw, ids = select_experts(logits, cfg.topk)
+    he = jax.nn.gelu(jnp.einsum("th,tkhf->tkf", h, p["w_up"][ids]))
+    y = jnp.einsum("tkf,tkfh->tkh", he, p["w_down"][ids])
+    x = x + jnp.sum(tw.astype(jnp.float32)[:, :, None] * y, axis=1)
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    return x @ params["lm_head"]
+
+
+def test_ep_moe_transformer_hier_train_grad_parity(mesh2x4):
+    """The dp x tp hierarchical EP training step applies the EXACT gradient
+    of the dp-mean loss — in particular the dp-sharded expert banks must
+    NOT be pmean'd across dp ranks holding different experts."""
+    from triton_dist_tpu.models import (
+        EPMoETransformer, EPMoETransformerConfig, ep_moe_param_specs,
+        init_moe_params,
+    )
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+
+    dp, lr = 2, 1e-1
+    cfg = EPMoETransformerConfig(
+        vocab=64, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=2, seq=16, n_experts=8, topk=2, ep_outer="dp",
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+        gg_config=GroupGemmConfig(8, 16, 16),
+    )
+    model, specs = EPMoETransformer(cfg), ep_moe_param_specs(cfg)
+    params = init_moe_params(jax.random.PRNGKey(40), cfg)
+    m = cfg.batch * cfg.seq
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(41), (dp * m,), 0, cfg.vocab, jnp.int32
+    )
+    targets = jax.random.randint(
+        jax.random.PRNGKey(42), (dp * m,), 0, cfg.vocab, jnp.int32
+    )
+    params_sh = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh2x4, s)), params, specs
+    )
+    p1, _ = jax.jit(
+        jax.shard_map(
+            lambda t, y, p: train_step(model, p, t, y.reshape(-1), lr=lr),
+            mesh=mesh2x4, in_specs=(P(("dp", "tp")), P("dp"), specs),
+            out_specs=(specs, P()), check_vma=False,
+        )
+    )(tokens, targets, params_sh)
+    jax.block_until_ready(p1)
+
+    def dense_ce(toks, tgts, p):
+        logits = _moe_dense_forward(toks, p, cfg).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tl = jnp.take_along_axis(logits, tgts[:, None], axis=1)[:, 0]
+        return jnp.mean(lse - tl)
+
+    def full_loss(p):
+        l = 0.0
+        for i in range(dp):
+            l = l + dense_ce(
+                tokens[i * m : (i + 1) * m], targets[i * m : (i + 1) * m], p
+            )
+        return l / dp
+
+    g_ref = jax.grad(full_loss)(params)
+    for name, got, want_p, want_g in (
+        ("w_up", p1["layers"][0]["w_up"], params["layers"][0]["w_up"],
+         g_ref["layers"][0]["w_up"]),
+        ("w_down", p1["layers"][0]["w_down"], params["layers"][0]["w_down"],
+         g_ref["layers"][0]["w_down"]),
+        ("router", p1["layers"][0]["router"], params["layers"][0]["router"],
+         g_ref["layers"][0]["router"]),
+        ("embed", p1["embed"], params["embed"], g_ref["embed"]),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want_p) - lr * np.asarray(want_g),
+            rtol=2e-3, atol=2e-3, err_msg=name,
+        )

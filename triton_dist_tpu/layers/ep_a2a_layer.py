@@ -23,7 +23,6 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from triton_dist_tpu.ops.all_to_all import fast_all_to_all
 from triton_dist_tpu.ops.grads import fast_all_to_all_grad
 from triton_dist_tpu.ops.moe_utils import MoEAlignment, moe_align_block_size
 
@@ -235,11 +234,11 @@ class HierEPAll2AllLayer:
     (outer-major) rank order: expert ``e`` on rank ``e // epr`` =
     (outer ``rank // n_i``, inner ``rank % n_i``).
 
-    Differentiable end-to-end: routing weights ride the DATA slab as topk
-    extra columns (expert ids stay on the integer metadata put), so the
-    router gradient flows through the a2a VJPs like every other cotangent.
-    With bf16 tokens the in-flight weights round to bf16 — train in f32 or
-    accept the routing-weight rounding.
+    Differentiable end-to-end with EXACT forward numerics: routing weights
+    travel bitcast-f32 on the integer metadata put (the value used in
+    combine — no rounding for bf16/fp8 slabs) AND as topk data-slab columns
+    (the differentiable channel); a straight-through sum gives combine the
+    exact value with the slab channel's gradient.
     """
 
     n_experts: int
@@ -292,10 +291,12 @@ class HierEPAll2AllLayer:
         order1, dest1_sorted, pos1, offsets1, clamped1, overflow1 = _pack_slabs(
             dest1, n_o, self.max_m1
         )
-        # routing WEIGHTS ride the data slab as topk extra columns — the
-        # differentiable channel (an int-metadata bitcast would cut the
-        # router gradient); expert IDS stay on the int metadata put.
-        # Weights are carried in the slab dtype (bf16 tokens round them).
+        # routing WEIGHTS travel on BOTH channels: bitcast-exact f32 on the
+        # int metadata put (the forward VALUE — no rounding, whatever the
+        # slab dtype) and as topk extra data-slab columns (the
+        # DIFFERENTIABLE channel — int metadata would cut the router
+        # gradient). A straight-through combine below uses the exact value
+        # with the slab channel's gradient.
         row_payload = jnp.concatenate(
             [tokens, topk_weights.astype(tokens.dtype)], axis=1
         )                                                     # [m_loc, H+topk]
@@ -303,22 +304,36 @@ class HierEPAll2AllLayer:
         send1 = send1.at[dest1_sorted, pos1].set(
             row_payload[order1 // self.topk], mode="drop"
         )
-        # metadata per row: the token's full topk ids
+        # metadata per row: the token's full topk ids + bitcast f32 weights
         # (the relay filters to its own node's experts)
         meta_ids = jnp.full((n_o, self.max_m1, self.topk), -1, jnp.int32)
+        meta_w = jnp.zeros((n_o, self.max_m1, self.topk), jnp.int32)
         row_ids = topk_ids.astype(jnp.int32)[order1 // self.topk]
+        row_w = jax.lax.bitcast_convert_type(
+            topk_weights.astype(jnp.float32), jnp.int32
+        )[order1 // self.topk]
         meta_ids = meta_ids.at[dest1_sorted, pos1].set(row_ids, mode="drop")
-        recv1, recv_splits1, rmeta1 = fast_all_to_all_grad(
-            send1, clamped1, meta_ids.reshape(n_o, -1), self.outer,
-            self.interpret,
+        meta_w = meta_w.at[dest1_sorted, pos1].set(row_w, mode="drop")
+        meta1 = jnp.concatenate(
+            [meta_ids.reshape(n_o, -1), meta_w.reshape(n_o, -1)], axis=1
         )
-        rel_ids = rmeta1.reshape(-1, self.topk)                # [R, topk]
+        recv1, recv_splits1, rmeta1 = fast_all_to_all_grad(
+            send1, clamped1, meta1, self.outer, self.interpret,
+        )
+        rmeta1 = rmeta1.reshape(n_o, 2, self.max_m1, self.topk)
+        rel_ids = rmeta1[:, 0].reshape(-1, self.topk)          # [R, topk]
+        exact_w = jax.lax.bitcast_convert_type(
+            rmeta1[:, 1].reshape(-1, self.topk), jnp.float32
+        )
 
         # ---- phase 2: relay scatters rows to expert-owning inner PEs ----
         R = n_o * self.max_m1
         rows_full = recv1.reshape(R, hidden + self.topk)
         rows = rows_full[:, :hidden]
-        rel_w = rows_full[:, hidden:].astype(jnp.float32)      # [R, topk]
+        slab_w = rows_full[:, hidden:].astype(jnp.float32)     # [R, topk]
+        # straight-through: VALUE = the bitcast-exact weights, GRADIENT =
+        # the differentiable slab channel's (identity cotangent)
+        rel_w = exact_w + (slab_w - jax.lax.stop_gradient(slab_w))
         pos_r = jnp.arange(R, dtype=jnp.int32) % self.max_m1
         slab_r = jnp.arange(R, dtype=jnp.int32) // self.max_m1
         row_valid = pos_r < recv_splits1[slab_r]               # [R]

@@ -415,14 +415,21 @@ def train_step(
 
     def fix(g, spec):
         # flatten composite spec entries like ("dp", "tp") before asking
-        # whether this param is sharded over the tensor axis
+        # which axes this param is sharded over
         axes: set = set()
         for e in tuple(spec):
             axes.update(e if isinstance(e, (tuple, list)) else (e,))
         if c.axis not in axes:
             g = jax.lax.psum(g, c.axis)
         if dp_axis is not None:
-            g = jax.lax.pmean(g, dp_axis)
+            if dp_axis in axes:
+                # dp-SHARDED param (EP expert banks over (dp, tp)): its
+                # gradient already sums every dp group's contribution via
+                # the a2a transports — a pmean would average in a DIFFERENT
+                # expert's gradient from the peer dp rank. Just normalize.
+                g = g / jax.lax.axis_size(dp_axis)
+            else:
+                g = jax.lax.pmean(g, dp_axis)
         return g / tp
 
     grads = jax.tree.map(fix, grads, specs)
