@@ -25,14 +25,24 @@ from triton_dist_tpu.models.tp_transformer import (
     rope,
 )
 from triton_dist_tpu.ops.grads import ring_attention_grad
-from triton_dist_tpu.ops.ring_attention import RingAttentionConfig
+from triton_dist_tpu.ops.ring_attention import (
+    RingAttentionConfig,
+    zigzag_positions,
+)
 
 
 @dataclasses.dataclass(frozen=True)
 class SPTransformerConfig(TransformerConfig):
-    """`axis` names the SEQUENCE axis here; weights replicate over it."""
+    """`axis` names the SEQUENCE axis here; weights replicate over it.
+
+    ``zigzag=True`` uses the causal-load-balanced stripe-pair layout:
+    feed tokens/targets PRE-PERMUTED with
+    ``ring_attention.zigzag_permutation`` (logits come back in the same
+    permuted order) — RoPE positions and the ring's causal mask follow
+    automatically."""
 
     ring_config: RingAttentionConfig | None = None
+    zigzag: bool = False
 
 
 @dataclasses.dataclass
@@ -54,7 +64,11 @@ class SPTransformer:
             b, s_loc, c.n_kv_heads, g + 2, d
         )
         # GLOBAL positions for this shard's rows
-        pos = me * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
+        if c.zigzag:
+            n = int(jax.lax.axis_size(c.axis))
+            pos = zigzag_positions(me, n, s_loc)
+        else:
+            pos = me * s_loc + jnp.arange(s_loc, dtype=jnp.int32)
         q = rope(qkv[..., :g, :].reshape(b, s_loc, c.n_q_heads, d), pos, c.rope_theta)
         k = rope(qkv[..., g, :], pos, c.rope_theta)
         v = qkv[..., g + 1, :]
@@ -63,7 +77,8 @@ class SPTransformer:
         k_t = jnp.repeat(k.transpose(0, 2, 1, 3), g, axis=1)
         v_t = jnp.repeat(v.transpose(0, 2, 1, 3), g, axis=1)
         attn = ring_attention_grad(
-            q_t, k_t, v_t, c.axis, True, c.ring_config, c.interpret
+            q_t, k_t, v_t, c.axis, True, c.ring_config, c.interpret,
+            "zigzag" if c.zigzag else "contig",
         ).transpose(0, 2, 1, 3)                       # [b, s_loc, hq, d]
         x = x + attn.reshape(b, s_loc, c.q_dim) @ p["wo"]
 

@@ -43,5 +43,7 @@ from triton_dist_tpu.ops.ring_attention import (
     RingAttentionConfig,
     ring_attention,
     ring_attention_op,
+    zigzag_permutation,
+    zigzag_positions,
 )
 from triton_dist_tpu.ops.ulysses import ulysses_attention

@@ -104,7 +104,7 @@ def _gemm_rs_bwd(axis, rs_config, ag_config, interpret, res, dc):
 gemm_rs_grad.defvjp(_gemm_rs_fwd, _gemm_rs_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def ring_attention_grad(
     q: jax.Array,
     k: jax.Array,
@@ -113,6 +113,7 @@ def ring_attention_grad(
     causal: bool = True,
     config: Any = None,
     interpret: Any = None,
+    layout: str = "contig",
 ) -> jax.Array:
     """Differentiable sequence-parallel ring attention (call inside
     shard_map) — the training-side SP the reference lacks entirely
@@ -128,22 +129,25 @@ def ring_attention_grad(
     from triton_dist_tpu.ops.ring_attention import ring_attention
 
     return ring_attention(
-        q, k, v, axis=axis, causal=causal, config=config, interpret=interpret
+        q, k, v, axis=axis, causal=causal, config=config, layout=layout,
+        interpret=interpret,
     )
 
 
-def _ring_attn_fwd(q, k, v, axis, causal, config, interpret):
+def _ring_attn_fwd(q, k, v, axis, causal, config, interpret, layout="contig"):
     from triton_dist_tpu.ops.ring_attention import ring_attention
 
     out, lse = ring_attention(
-        q, k, v, axis=axis, causal=causal, config=config,
+        q, k, v, axis=axis, causal=causal, config=config, layout=layout,
         return_lse=True, interpret=interpret,
     )
     return out, (q, k, v, out, lse)
 
 
-def _ring_attn_bwd(axis, causal, config, interpret, res, dout):
+def _ring_attn_bwd(axis, causal, config, interpret, layout, res, dout):
     import math
+
+    from triton_dist_tpu.ops.ring_attention import zigzag_positions
 
     q, k, v, out, lse = res
     b, h, s_loc, d = q.shape
@@ -158,7 +162,10 @@ def _ring_attn_bwd(axis, causal, config, interpret, res, dout):
     out3 = out.reshape(bh, s_loc, d).astype(f32)
     lse3 = lse.reshape(bh, s_loc)
     delta = jnp.sum(dout3 * out3, axis=-1)           # [bh, s_loc]
-    rows = me * s_loc + jnp.arange(s_loc)
+    if layout == "zigzag":
+        rows = zigzag_positions(me, n, s_loc)
+    else:
+        rows = me * s_loc + jnp.arange(s_loc)
 
     # one gather: (k ‖ v) ride a single collective; kept in input dtype
     kv = jnp.stack([k.reshape(bh, s_loc, d), v.reshape(bh, s_loc, d)])
@@ -175,7 +182,10 @@ def _ring_attn_bwd(axis, causal, config, interpret, res, dout):
         v_c = kv_c[:, 1].astype(f32)
         s_c = jnp.einsum("bqd,bsd->bqs", q3, k_c) * scale
         if causal:
-            cols = c_idx * s_loc + jnp.arange(s_loc)
+            if layout == "zigzag":
+                cols = zigzag_positions(c_idx, n, s_loc)
+            else:
+                cols = c_idx * s_loc + jnp.arange(s_loc)
             s_c = jnp.where((cols[None, :] <= rows[:, None])[None], s_c, -jnp.inf)
         p_c = jnp.exp(s_c - lse3[..., None])
         dv_c = jnp.einsum("bqs,bqd->bsd", p_c, dout3)

@@ -44,10 +44,31 @@ class RingAttentionConfig:
     block_kv: int = 512
 
 
+def _tile_offset(rank, tile_start, *, s_loc: int, n: int, layout: str):
+    """Global-position offset of a tile starting at local row `tile_start`
+    on (q or kv chunk) owner `rank`: global = offset + local_row.
+
+    - "contig": PE r owns rows [r*s_loc, (r+1)*s_loc).
+    - "zigzag": PE r owns stripes r and 2n-1-r of length s_loc/2 — the
+      causal-load-balanced layout (every PE sees the same mix of early and
+      late positions, so masked-out work is even across the ring instead
+      of concentrated on the last PE). Tiles never straddle the stripe
+      boundary (block sizes divide s_loc/2).
+    """
+    if layout == "contig":
+        return rank * s_loc
+    s_half = s_loc // 2
+    return jnp.where(
+        tile_start < s_half,
+        rank * s_half,                        # stripe r
+        (2 * n - 1 - rank) * s_half - s_half,  # stripe 2n-1-r
+    )
+
+
 def _attn_step_pipeline(
     bh: int, s_loc: int, d: int, bq: int, bk: int,
     m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
-    q_offset, kv_offset, first_step: bool,
+    q_rank, kv_rank, n: int, layout: str, first_step: bool,
 ):
     """One ring step: blockwise attention of local q vs the current kv
     chunk. The (m, l, acc) state persists across ring steps in HBM; m/l use
@@ -77,10 +98,12 @@ def _attn_step_pipeline(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )                                                  # [bq, bk]
         if causal:
-            q_pos = q_offset + qi * bq + jax.lax.broadcasted_iota(
+            q_off = _tile_offset(q_rank, qi * bq, s_loc=s_loc, n=n, layout=layout)
+            kv_off = _tile_offset(kv_rank, kj * bk, s_loc=s_loc, n=n, layout=layout)
+            q_pos = q_off + qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0
             )
-            kv_pos = kv_offset + kj * bk + jax.lax.broadcasted_iota(
+            kv_pos = kv_off + kj * bk + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1
             )
             s = jnp.where(kv_pos <= q_pos, s, NEG_INF)
@@ -122,20 +145,20 @@ def _ring_attention_kernel(
     q_ref, k_ref, v_ref, out_ref, kv_land, acc_buf, m_buf, l_buf,
     m_scr, l_scr, acc_scr, send_sems, recv_sems,
     *, axis: str, n: int, cfg: RingAttentionConfig, scale: float,
-    causal: bool, out_dtype,
+    causal: bool, layout: str, out_dtype,
 ):
     me = shmem.my_pe(axis)
     bh, s_loc, d = q_ref.shape
-    bq = pick_block(s_loc, cfg.block_q)
-    bk = pick_block(s_loc, cfg.block_kv)
-    q_offset = me * s_loc
+    # zigzag: tiles must not straddle the stripe boundary at s_loc/2
+    block_span = s_loc // 2 if layout == "zigzag" else s_loc
+    bq = pick_block(block_span, cfg.block_q)
+    bk = pick_block(block_span, cfg.block_kv)
 
     shmem.barrier_all(axis)
     right = jax.lax.rem(me + 1, n)
     descs = []
     for s in range(n):
         chunk_rank = jax.lax.rem(me - s + 2 * n, n)
-        kv_offset = chunk_rank * s_loc
         if s > 0:
             # chunk landed in slot s-1 during step s-1 (two transfers: k, v)
             descs[2 * (s - 1)].wait_recv()
@@ -158,8 +181,8 @@ def _ring_attention_kernel(
             )
         pipeline = _attn_step_pipeline(
             bh, s_loc, d, bq, bk, m_scr, l_scr, acc_scr,
-            scale=scale, causal=causal, q_offset=q_offset,
-            kv_offset=kv_offset, first_step=(s == 0),
+            scale=scale, causal=causal, q_rank=me,
+            kv_rank=chunk_rank, n=n, layout=layout, first_step=(s == 0),
         )
         pipeline(
             q_ref, k_src, v_src, m_buf, l_buf, acc_buf, m_buf, l_buf, acc_buf
@@ -184,6 +207,40 @@ def _ring_attention_kernel(
     )(acc_buf, l_buf, out_ref)
 
 
+def zigzag_permutation(n: int, s_tot: int):
+    """Row permutation taking the NATURAL sequence order to the zigzag
+    sharding order: after ``x[perm]``, contiguous shard ``r`` (of ``n``)
+    holds stripes ``r`` and ``2n-1-r`` (each ``s_tot / 2n`` rows) — the
+    causal-load-balanced assignment. Returns (perm, inverse)."""
+    import numpy as _np
+
+    if s_tot % (2 * n) != 0:
+        raise ValueError(
+            f"zigzag needs s_tot divisible by 2*n: {s_tot} % {2 * n} != 0"
+        )
+    s_half = s_tot // (2 * n)
+    order = []
+    for r in range(n):
+        order.extend(range(r * s_half, (r + 1) * s_half))
+        order.extend(range((2 * n - 1 - r) * s_half, (2 * n - r) * s_half))
+    perm = _np.asarray(order, _np.int32)
+    inv = _np.empty_like(perm)
+    inv[perm] = _np.arange(perm.shape[0], dtype=_np.int32)
+    return perm, inv
+
+
+def zigzag_positions(me, n: int, s_loc: int):
+    """Global positions of PE `me`'s local rows under the zigzag layout
+    (feed to RoPE / loss instead of ``me*s_loc + arange``)."""
+    s_half = s_loc // 2
+    r = jnp.arange(s_loc, dtype=jnp.int32)
+    return jnp.where(
+        r < s_half,
+        me * s_half + r,
+        (2 * n - 1 - me) * s_half + (r - s_half),
+    )
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -192,6 +249,7 @@ def ring_attention(
     axis: str = "tp",
     causal: bool = True,
     config: RingAttentionConfig | None = None,
+    layout: str = "contig",
     return_lse: bool = False,
     interpret: Any = None,
 ):
@@ -203,22 +261,34 @@ def ring_attention(
     (plus the per-row log-sum-exp ``[b, h, s_loc]`` f32 if `return_lse` —
     the residual the custom backward consumes, ops/grads.py).
     Golden: full (causal) attention over the gathered sequence.
+
+    ``layout="zigzag"``: the shards are stripe PAIRS (shard r = stripes r
+    and 2n-1-r of the global sequence; see :func:`zigzag_permutation`) —
+    causal masking then discards the same fraction of work on every PE,
+    instead of PE 0 sitting ~idle while PE n-1 computes the full lower
+    triangle. Same collective traffic; up to ~2x less wall-clock tail at
+    large n for causal prefill.
     """
     cfg = config or RingAttentionConfig()
     n = int(jax.lax.axis_size(axis))
     b, h, s_loc, d = q.shape
+    if layout not in ("contig", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "zigzag" and s_loc % 2 != 0:
+        raise ValueError(f"zigzag needs an even s_loc, got {s_loc}")
     scale = 1.0 / math.sqrt(d)
     bh = b * h
     q3 = q.reshape(bh, s_loc, d)
     k3 = k.reshape(bh, s_loc, d)
     v3 = v.reshape(bh, s_loc, d)
-    bq = pick_block(s_loc, cfg.block_q)
-    bk = pick_block(s_loc, cfg.block_kv)
+    block_span = s_loc // 2 if layout == "zigzag" else s_loc
+    bq = pick_block(block_span, cfg.block_q)
+    bk = pick_block(block_span, cfg.block_kv)
     n_steps = max(n - 1, 1)
     outs = dist_pallas_call(
         functools.partial(
             _ring_attention_kernel, axis=axis, n=n, cfg=cfg, scale=scale,
-            causal=causal, out_dtype=q.dtype,
+            causal=causal, layout=layout, out_dtype=q.dtype,
         ),
         name="ring_attention",
         out_shape=(
@@ -264,14 +334,17 @@ def ring_attention_op(
     axis: str = "tp",
     causal: bool = True,
     config: RingAttentionConfig | None = None,
+    layout: str = "contig",
     interpret: Any = None,
 ) -> jax.Array:
-    """Host-level entry: q/k/v ``[b, h, S, d]`` sharded on the sequence dim."""
+    """Host-level entry: q/k/v ``[b, h, S, d]`` sharded on the sequence dim
+    (pre-permuted with :func:`zigzag_permutation` when ``layout="zigzag"``)."""
     fn = functools.partial(
-        ring_attention, axis=axis, causal=causal, config=config, interpret=interpret
+        ring_attention, axis=axis, causal=causal, config=config,
+        layout=layout, interpret=interpret,
     )
     spec = P(None, None, axis, None)
     return jit_shard_map(
         fn, mesh, (spec, spec, spec), spec,
-        key=("ring_attention", axis, causal, config, str(interpret)),
+        key=("ring_attention", axis, causal, config, layout, str(interpret)),
     )(q, k, v)
