@@ -127,3 +127,27 @@ def test_moe_reduce_rs(mesh4):
         if sti[r] < n_tokens * topk:
             want[sti[r] // topk] += tw_np[sti[r]] * y[r]
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
+def test_group_gemm_dw_matches_segment_sum():
+    """Transpose grouped GEMM (expert-steered output accumulation) vs the
+    per-block outer-product segment-sum golden; expert 2 has no rows and
+    must come back exactly zero."""
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm_dw
+
+    bm, n_blocks, k_dim, n_dim, n_exp = 8, 6, 32, 64, 4
+    t_pad = bm * n_blocks
+    a = jax.random.normal(jax.random.PRNGKey(90), (t_pad, k_dim), jnp.float32)
+    g = jax.random.normal(jax.random.PRNGKey(91), (t_pad, n_dim), jnp.float32)
+    expert_ids = jnp.asarray([0, 0, 1, 3, 3, 3], jnp.int32)  # expert 2 empty
+    got = group_gemm_dw(
+        a, g, expert_ids, n_exp, config=GroupGemmConfig(bm, 32, 16)
+    )
+    want = np.zeros((n_exp, k_dim, n_dim), np.float32)
+    for i in range(n_blocks):
+        e = int(expert_ids[i])
+        want[e] += np.asarray(a[i * bm : (i + 1) * bm]).T @ np.asarray(
+            g[i * bm : (i + 1) * bm]
+        )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+    assert np.all(np.asarray(got)[2] == 0)

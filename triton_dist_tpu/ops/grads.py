@@ -213,27 +213,19 @@ def _ring_attn_bwd(axis, causal, config, interpret, layout, res, dout):
 ring_attention_grad.defvjp(_ring_attn_fwd, _ring_attn_bwd)
 
 
-def _block_outer_accumulate(a_sorted, g_sorted, expert_ids, n_exp, block_m):
+def _block_outer_accumulate(
+    a_sorted, g_sorted, expert_ids, n_exp, config, interpret=None
+):
     """``dW[e] = Σ_{blocks of e} A_blkᵀ @ G_blk`` — the transpose grouped
-    GEMM. A scan over row blocks keeps peak memory at ``[E, K, N] + [K, N]``
-    (an einsum+segment-sum would materialize ``[n_blocks, K, N]``); each
-    step is one MXU ``[bm,K]ᵀ@[bm,N]`` matmul."""
-    k_dim = a_sorted.shape[1]
-    n_dim = g_sorted.shape[1]
-    a_blocks = a_sorted.reshape(-1, block_m, k_dim)
-    g_blocks = g_sorted.reshape(-1, block_m, n_dim)
+    GEMM, as a fused MXU kernel (``ops.group_gemm.group_gemm_dw``: expert
+    ids steer the output BlockSpec, consecutive same-expert visits
+    accumulate in VMEM)."""
+    from triton_dist_tpu.ops.group_gemm import group_gemm_dw
 
-    def step(acc, inp):
-        a_b, g_b, e = inp
-        upd = jnp.dot(
-            a_b.T.astype(jnp.float32), g_b.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
-        return acc.at[e].add(upd), None
-
-    acc0 = jnp.zeros((n_exp, k_dim, n_dim), jnp.float32)
-    acc, _ = jax.lax.scan(step, acc0, (a_blocks, g_blocks, expert_ids))
-    return acc
+    return group_gemm_dw(
+        a_sorted, g_sorted, expert_ids, n_exp, config=config,
+        interpret=interpret,
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
@@ -357,7 +349,7 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, res, dout):
         out_dtype=f32, interpret=interpret,
     )
     dw_down = _block_outer_accumulate(
-        act, dy_sorted, al.expert_ids, n_exp, cfg.block_m
+        act, dy_sorted, al.expert_ids, n_exp, cfg, interpret
     ).astype(w_down.dtype)
     # through the activation
     (dh_sorted,) = act_vjp(dact)
@@ -370,7 +362,7 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, res, dout):
         out_dtype=f32, interpret=interpret,
     )
     dw_up = _block_outer_accumulate(
-        a_sorted, dh_sorted, al.expert_ids, n_exp, cfg.block_m
+        a_sorted, dh_sorted, al.expert_ids, n_exp, cfg, interpret
     ).astype(w_up.dtype)
     # unsorted scatter-add back to tokens, then the all-gather's transpose
     da_full = (
@@ -475,7 +467,7 @@ def _gg_bwd(config, out_dtype, interpret, res, dout):
         config=cfg, out_dtype=jnp.float32, interpret=interpret,
     ).astype(a_sorted.dtype)
     db = _block_outer_accumulate(
-        a_sorted, dout, expert_ids, b.shape[0], cfg.block_m
+        a_sorted, dout, expert_ids, b.shape[0], cfg, interpret
     ).astype(b.dtype)
     d_ids = np.zeros(expert_ids.shape, jax.dtypes.float0)
     return da, db, d_ids

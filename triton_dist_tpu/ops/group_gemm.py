@@ -108,3 +108,94 @@ def group_gemm(
         uses_barrier=False,
         interpret=interpret,
     )(expert_ids, a_sorted, b)
+
+
+def _group_gemm_dw_kernel(e_ref, a_ref, g_ref, o_ref, acc_ref):
+    """acc[e] += A_iᵀ @ G_i for the run of row-blocks owned by expert e.
+    Expert ids are sorted (block alignment), so all visits to one output
+    block are CONSECUTIVE in the innermost grid dim — the only pattern
+    under which Pallas output revisits accumulate correctly."""
+    i = pl.program_id(2)
+    first_of_run = jnp.logical_or(
+        i == 0, e_ref[jnp.maximum(i - 1, 0)] != e_ref[i]
+    )
+
+    @pl.when(first_of_run)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jax.lax.dot_general(
+        a_ref[:].astype(jnp.float32), g_ref[:].astype(jnp.float32),
+        (((0,), (0,)), ((), ())),           # contract the bm rows: AᵀG
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0] = acc_ref[:]
+
+
+def group_gemm_dw(
+    a_sorted: jax.Array,
+    g_sorted: jax.Array,
+    expert_ids: jax.Array,
+    n_exp: int,
+    *,
+    config: GroupGemmConfig | None = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Transpose grouped GEMM: ``dW[e] = Σ_{blocks i of e} A_iᵀ @ G_i``
+    (the expert-weight gradient of :func:`group_gemm`; ≙ the dW half the
+    reference leaves to torch autograd — here a first-class MXU kernel
+    instead of a scan of dots).
+
+    a_sorted ``[t_pad, K]``, g_sorted ``[t_pad, N]`` block-aligned rows in
+    the SAME sorted-by-expert order; expert_ids ``[t_pad // block_m]``
+    (non-decreasing). Returns ``[n_exp, K, N]`` f32; experts with no rows
+    come back exactly zero.
+    """
+    cfg = config or GroupGemmConfig()
+    t_pad, k_dim = a_sorted.shape
+    n_dim = g_sorted.shape[1]
+    n_blocks = expert_ids.shape[0]
+    assert t_pad % n_blocks == 0 and t_pad // n_blocks == cfg.block_m, (
+        t_pad, n_blocks, cfg.block_m,
+    )
+    bm = cfg.block_m
+    bk = pick_block(k_dim, cfg.block_k)
+    bn = pick_block(n_dim, cfg.block_n)
+    # i innermost: output-block visits for one (kk, nn) tile are grouped by
+    # expert run; kk/nn never revisit a previously-left block
+    grid = (k_dim // bk, n_dim // bn, n_blocks)
+    out = dist_pallas_call(
+        _group_gemm_dw_kernel,
+        name="group_gemm_dw",
+        out_shape=jax.ShapeDtypeStruct((n_exp, k_dim, n_dim), jnp.float32),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((bm, bk), lambda kk, nn, i, e_ref: (i, kk)),
+                pl.BlockSpec((bm, bn), lambda kk, nn, i, e_ref: (i, nn)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, bk, bn), lambda kk, nn, i, e_ref: (e_ref[i], kk, nn)
+            ),
+            scratch_shapes=[pltpu.VMEM((bk, bn), jnp.float32)],
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * t_pad * k_dim * n_dim,
+            bytes_accessed=(
+                t_pad * (k_dim + n_dim) * a_sorted.dtype.itemsize
+                + n_exp * k_dim * n_dim * 4
+            ),
+            transcendentals=0,
+        ),
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+        uses_barrier=False,
+        interpret=interpret,
+    )(expert_ids, a_sorted, g_sorted)
+    # an expert with zero rows never has its output block visited — that
+    # memory is undefined, not zero; mask it (where, not multiply: the
+    # garbage may be NaN)
+    counts = jnp.bincount(
+        jnp.clip(expert_ids, 0, n_exp - 1), length=n_exp
+    )
+    return jnp.where(counts[:, None, None] > 0, out, 0.0)
