@@ -139,7 +139,7 @@ def test_group_gemm_dw_matches_segment_sum():
     t_pad = bm * n_blocks
     a = jax.random.normal(jax.random.PRNGKey(90), (t_pad, k_dim), jnp.float32)
     g = jax.random.normal(jax.random.PRNGKey(91), (t_pad, n_dim), jnp.float32)
-    expert_ids = jnp.asarray([0, 0, 1, 3, 3, 3], jnp.int32)  # expert 2 empty
+    expert_ids = jnp.asarray([0, 3, 1, 0, 3, 3], jnp.int32)  # UNSORTED; 2 empty
     got = group_gemm_dw(
         a, g, expert_ids, n_exp, config=GroupGemmConfig(bm, 32, 16)
     )

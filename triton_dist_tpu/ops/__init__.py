@@ -35,8 +35,11 @@ from triton_dist_tpu.ops.flash_decode import (
     flash_decode,
     flash_decode_distributed,
     flash_decode_op,
+    flash_decode_quant,
+    flash_decode_quant_distributed,
     paged_flash_decode,
     paged_flash_decode_distributed,
+    quantize_kv,
 )
 from triton_dist_tpu.ops.grads import ring_attention_grad
 from triton_dist_tpu.ops.ring_attention import (

@@ -147,9 +147,14 @@ def group_gemm_dw(
     instead of a scan of dots).
 
     a_sorted ``[t_pad, K]``, g_sorted ``[t_pad, N]`` block-aligned rows in
-    the SAME sorted-by-expert order; expert_ids ``[t_pad // block_m]``
-    (non-decreasing). Returns ``[n_exp, K, N]`` f32; experts with no rows
-    come back exactly zero.
+    the SAME order; expert_ids ``[t_pad // block_m]``. Returns
+    ``[n_exp, K, N]`` f32; experts with no rows come back exactly zero.
+
+    The kernel's output-revisit accumulation needs each expert's blocks
+    CONSECUTIVE in grid order, so blocks are grouped by expert up front —
+    a no-op permutation for the usual already-sorted alignment layouts,
+    and correctness insurance for any other caller (the forward
+    ``group_gemm`` is order-independent, so its VJP must be too).
     """
     cfg = config or GroupGemmConfig()
     t_pad, k_dim = a_sorted.shape
@@ -159,6 +164,10 @@ def group_gemm_dw(
         t_pad, n_blocks, cfg.block_m,
     )
     bm = cfg.block_m
+    order = jnp.argsort(expert_ids, stable=True)
+    expert_ids = expert_ids[order]
+    a_sorted = a_sorted.reshape(n_blocks, bm, k_dim)[order].reshape(t_pad, k_dim)
+    g_sorted = g_sorted.reshape(n_blocks, bm, n_dim)[order].reshape(t_pad, n_dim)
     bk = pick_block(k_dim, cfg.block_k)
     bn = pick_block(n_dim, cfg.block_n)
     # i innermost: output-block visits for one (kk, nn) tile are grouped by
