@@ -49,4 +49,4 @@ from triton_dist_tpu.ops.ring_attention import (
     zigzag_permutation,
     zigzag_positions,
 )
-from triton_dist_tpu.ops.ulysses import ulysses_attention
+from triton_dist_tpu.ops.ulysses import ulysses_attention, usp_attention

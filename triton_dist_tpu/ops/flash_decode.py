@@ -142,6 +142,15 @@ def flash_decode(
     per-head log-sum-exp ``[b, q_heads]`` if `return_lse` — the partial pair
     the SP merge consumes).
     """
+    return _decode_call(
+        q, k, v, None, kv_lens, config=config, return_lse=return_lse,
+        interpret=interpret,
+    )
+
+
+def _decode_call(q, k, v, scales, kv_lens, *, config, return_lse, interpret):
+    """Shared host-side builder for the plain and int8 decode paths; the
+    only deltas are the two optional scale operands and the q dtype."""
     cfg = config or FlashDecodeConfig()
     b, hq, d = q.shape
     _, h_kv, s_len, _ = k.shape
@@ -151,14 +160,31 @@ def flash_decode(
     n_chunks = s_len // sc
     scale = 1.0 / math.sqrt(d)
     # the kernel's matmuls run in the cache dtype (bf16 MXU fast path);
-    # mixed-precision callers get their q silently matched to the cache
-    q4 = q.reshape(b, h_kv, g, d).astype(k.dtype)
+    # mixed-precision callers get their q silently matched to the cache —
+    # int8 caches upcast in-kernel, so their q rides bf16
+    q4 = q.reshape(b, h_kv, g, d).astype(
+        jnp.bfloat16 if scales is not None else k.dtype
+    )
     grid = (b, h_kv, n_chunks)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # kv_lens
+        pl.BlockSpec((1, 1, g, d), lambda i, j, c: (i, j, 0, 0)),
+        pl.BlockSpec((1, 1, sc, d), lambda i, j, c: (i, j, c, 0)),
+        pl.BlockSpec((1, 1, sc, d), lambda i, j, c: (i, j, c, 0)),
+    ]
+    args = [kv_lens.astype(jnp.int32), q4, k, v]
+    if scales is None:
+        name, kernel = "flash_decode", _flash_decode_kernel
+        kv_bytes = 2 * b * h_kv * s_len * d * k.dtype.itemsize
+    else:
+        name, kernel = "flash_decode_quant", _flash_decode_quant_kernel
+        scale_spec = pl.BlockSpec((1, 1, 1, sc), lambda i, j, c: (i, j, 0, c))
+        in_specs += [scale_spec, scale_spec]
+        args += [scales[0].astype(jnp.float32), scales[1].astype(jnp.float32)]
+        kv_bytes = 2 * b * h_kv * s_len * (d + 4)  # int8 payload + f32 scale
     out, lse = dist_pallas_call(
-        functools.partial(
-            _flash_decode_kernel, n_chunks=n_chunks, block_s=sc, scale=scale
-        ),
-        name="flash_decode",
+        functools.partial(kernel, n_chunks=n_chunks, block_s=sc, scale=scale),
+        name=name,
         grid=grid,
         out_shape=(
             jax.ShapeDtypeStruct((b, h_kv, g, d), jnp.float32),
@@ -166,12 +192,7 @@ def flash_decode(
             # to equal the array dims (g < 8 sublanes is fine when full).
             jax.ShapeDtypeStruct((b, h_kv, g, 1), jnp.float32),
         ),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # kv_lens
-            pl.BlockSpec((1, 1, g, d), lambda i, j, c: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, sc, d), lambda i, j, c: (i, j, c, 0)),
-            pl.BlockSpec((1, 1, sc, d), lambda i, j, c: (i, j, c, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, 1, g, d), lambda i, j, c: (i, j, 0, 0)),
             pl.BlockSpec((1, 1, g, 1), lambda i, j, c: (i, j, 0, 0)),
@@ -183,13 +204,13 @@ def flash_decode(
         ],
         cost_estimate=pl.CostEstimate(
             flops=4 * b * hq * s_len * d,
-            bytes_accessed=(2 * b * h_kv * s_len * d) * k.dtype.itemsize,
+            bytes_accessed=kv_bytes,
             transcendentals=b * hq * s_len,
         ),
         dimension_semantics=("parallel", "parallel", "arbitrary"),
         uses_barrier=False,
         interpret=interpret,
-    )(kv_lens.astype(jnp.int32), q4, k, v)
+    )(*args)
     out = out.reshape(b, hq, d)
     lse = lse.reshape(b, hq)
     return (out, lse) if return_lse else out
@@ -235,60 +256,10 @@ def flash_decode_quant(
     """GQA batch decode over an int8-quantized KV cache (from
     :func:`quantize_kv`) — same contract as :func:`flash_decode`, half the
     HBM traffic. Composes with the SP merge via ``return_lse``."""
-    cfg = config or FlashDecodeConfig()
-    b, hq, d = q.shape
-    _, h_kv, s_len, _ = k_q.shape
-    assert hq % h_kv == 0, (hq, h_kv)
-    g = hq // h_kv
-    sc = pick_block(s_len, cfg.block_s)
-    n_chunks = s_len // sc
-    scale = 1.0 / math.sqrt(d)
-    q4 = q.reshape(b, h_kv, g, d).astype(jnp.bfloat16)
-    grid = (b, h_kv, n_chunks)
-    scale_spec = pl.BlockSpec((1, 1, 1, sc), lambda i, j, c: (i, j, 0, c))
-    out, lse = dist_pallas_call(
-        functools.partial(
-            _flash_decode_quant_kernel, n_chunks=n_chunks, block_s=sc,
-            scale=scale,
-        ),
-        name="flash_decode_quant",
-        grid=grid,
-        out_shape=(
-            jax.ShapeDtypeStruct((b, h_kv, g, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, h_kv, g, 1), jnp.float32),
-        ),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # kv_lens
-            pl.BlockSpec((1, 1, g, d), lambda i, j, c: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, sc, d), lambda i, j, c: (i, j, c, 0)),
-            pl.BlockSpec((1, 1, sc, d), lambda i, j, c: (i, j, c, 0)),
-            scale_spec,
-            scale_spec,
-        ],
-        out_specs=(
-            pl.BlockSpec((1, 1, g, d), lambda i, j, c: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, g, 1), lambda i, j, c: (i, j, 0, 0)),
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, d), jnp.float32),
-        ],
-        cost_estimate=pl.CostEstimate(
-            flops=4 * b * hq * s_len * d,
-            bytes_accessed=2 * b * h_kv * s_len * (d + 4),
-            transcendentals=b * hq * s_len,
-        ),
-        dimension_semantics=("parallel", "parallel", "arbitrary"),
-        uses_barrier=False,
-        interpret=interpret,
-    )(
-        kv_lens.astype(jnp.int32), q4, k_q, v_q,
-        k_scale.astype(jnp.float32), v_scale.astype(jnp.float32),
+    return _decode_call(
+        q, k_q, v_q, (k_scale, v_scale), kv_lens, config=config,
+        return_lse=return_lse, interpret=interpret,
     )
-    out = out.reshape(b, hq, d)
-    lse = lse.reshape(b, hq)
-    return (out, lse) if return_lse else out
 
 
 def flash_decode_quant_distributed(

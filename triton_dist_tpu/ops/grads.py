@@ -214,7 +214,8 @@ ring_attention_grad.defvjp(_ring_attn_fwd, _ring_attn_bwd)
 
 
 def _block_outer_accumulate(
-    a_sorted, g_sorted, expert_ids, n_exp, config, interpret=None
+    a_sorted, g_sorted, expert_ids, n_exp, config, interpret=None,
+    assume_sorted=False,
 ):
     """``dW[e] = Σ_{blocks of e} A_blkᵀ @ G_blk`` — the transpose grouped
     GEMM, as a fused MXU kernel (``ops.group_gemm.group_gemm_dw``: expert
@@ -224,7 +225,7 @@ def _block_outer_accumulate(
 
     return group_gemm_dw(
         a_sorted, g_sorted, expert_ids, n_exp, config=config,
-        interpret=interpret,
+        assume_sorted=assume_sorted, interpret=interpret,
     )
 
 
@@ -349,7 +350,8 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, res, dout):
         out_dtype=f32, interpret=interpret,
     )
     dw_down = _block_outer_accumulate(
-        act, dy_sorted, al.expert_ids, n_exp, cfg, interpret
+        act, dy_sorted, al.expert_ids, n_exp, cfg, interpret,
+        assume_sorted=True,  # moe_align ids are sorted by construction
     ).astype(w_down.dtype)
     # through the activation
     (dh_sorted,) = act_vjp(dact)
@@ -362,7 +364,8 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, res, dout):
         out_dtype=f32, interpret=interpret,
     )
     dw_up = _block_outer_accumulate(
-        a_sorted, dh_sorted, al.expert_ids, n_exp, cfg, interpret
+        a_sorted, dh_sorted, al.expert_ids, n_exp, cfg, interpret,
+        assume_sorted=True,
     ).astype(w_up.dtype)
     # unsorted scatter-add back to tokens, then the all-gather's transpose
     da_full = (

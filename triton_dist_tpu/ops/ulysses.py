@@ -25,16 +25,16 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from triton_dist_tpu.ops.all_to_all import fast_all_to_all
-
-
 def _exchange(x: jax.Array, axis: str, n: int, interpret: Any):
     """[n, rows, d] slab exchange (slab j → PE j); returns same shape with
     slab i = what PE i sent here. Shapes are static and equal, so splits
-    are full."""
+    are full. Differentiable (the a2a VJP is the reverse exchange), so
+    compositions like :func:`usp_attention` autodiff through it."""
+    from triton_dist_tpu.ops.grads import fast_all_to_all_grad
+
     rows = x.shape[1]
     splits = jnp.full((n,), rows, jnp.int32)
-    recv, _ = fast_all_to_all(x, splits, axis=axis, interpret=interpret)
+    recv, _, _ = fast_all_to_all_grad(x, splits, None, axis, interpret)
     return recv
 
 
@@ -154,3 +154,42 @@ def _ulysses_bwd(axis, causal, interpret, res, dout):
 
 
 ulysses_attention.defvjp(_ulysses_fwd, _ulysses_bwd)
+
+
+def usp_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    outer: str = "sp",
+    inner: str = "tp",
+    causal: bool = True,
+    ring_config: Any = None,
+    layout: str = "contig",
+    interpret: Any = None,
+) -> jax.Array:
+    """Unified sequence parallelism (USP): Ulysses head exchange over the
+    `inner` (fast) axis composed with ring attention over the `outer` axis
+    — long-context attention over MORE chips than there are heads, the
+    regime neither recipe covers alone (Ulysses needs h >= world; a flat
+    ring pays n-1 hops of latency).
+
+    q, k, v: ``[b, h, s_loc, d]`` with the sequence sharded over BOTH axes
+    outer-major (s_loc = S / (n_o * n_i)) and ``h % n_i == 0``. After the
+    inner head exchange each PE holds h/n_i heads of its outer group's
+    contiguous sequence block, which is exactly the ring kernel's contig
+    layout over `outer` (``layout="zigzag"`` composes as usual: permute
+    the GLOBAL sequence with ``zigzag_permutation(n_o, S)``).
+    Differentiable end-to-end (ring VJP + self-inverse exchanges).
+    """
+    from triton_dist_tpu.ops.grads import ring_attention_grad
+
+    n_i = int(jax.lax.axis_size(inner))
+    if n_i == 1:
+        return ring_attention_grad(
+            q, k, v, outer, causal, ring_config, interpret, layout
+        )
+    qh, kh, vh = _seq_to_heads((q, k, v), inner, n_i, interpret)
+    oh = ring_attention_grad(
+        qh, kh, vh, outer, causal, ring_config, interpret, layout
+    )
+    return _heads_to_seq((oh,), inner, n_i, interpret)[0]
