@@ -100,11 +100,12 @@ class EPMoEMLP:
         r_cap = rows.shape[0]
         a_sorted = rows[jnp.minimum(al.sorted_token_ids, r_cap - 1)]
         h1 = group_gemm_grad(
-            a_sorted, w_up, al.expert_ids, cfg, None, self.interpret
+            a_sorted, w_up, al.expert_ids, cfg, None, self.interpret,
+            True,  # alignment ids are sorted by construction
         )
         h1 = self.activation(h1.astype(jnp.float32)).astype(x.dtype)
         y_sorted = group_gemm_grad(
-            h1, w_down, al.expert_ids, cfg, None, self.interpret
+            h1, w_down, al.expert_ids, cfg, None, self.interpret, True
         )
         # back to the received slab layout: each valid row appears exactly
         # once in the sorted order; the sentinel id R is out of range → drop

@@ -435,7 +435,7 @@ def _a2a_bwd(axis, interpret, res, cots):
 fast_all_to_all_grad.defvjp(_a2a_fwd, _a2a_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def group_gemm_grad(
     a_sorted: jax.Array,
     b: jax.Array,
@@ -443,6 +443,7 @@ def group_gemm_grad(
     config: Any = None,
     out_dtype: Any = None,
     interpret: Any = None,
+    assume_sorted: bool = False,
 ) -> jax.Array:
     """Differentiable block-aligned grouped GEMM (the scalar-prefetch MXU
     kernel is its own backward with per-expert transposed weights; the
@@ -455,12 +456,15 @@ def group_gemm_grad(
     )
 
 
-def _gg_fwd(a_sorted, b, expert_ids, config, out_dtype, interpret):
-    out = group_gemm_grad(a_sorted, b, expert_ids, config, out_dtype, interpret)
+def _gg_fwd(a_sorted, b, expert_ids, config, out_dtype, interpret,
+            assume_sorted=False):
+    out = group_gemm_grad(
+        a_sorted, b, expert_ids, config, out_dtype, interpret, assume_sorted
+    )
     return out, (a_sorted, b, expert_ids)
 
 
-def _gg_bwd(config, out_dtype, interpret, res, dout):
+def _gg_bwd(config, out_dtype, interpret, assume_sorted, res, dout):
     from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
 
     a_sorted, b, expert_ids = res
@@ -470,7 +474,8 @@ def _gg_bwd(config, out_dtype, interpret, res, dout):
         config=cfg, out_dtype=jnp.float32, interpret=interpret,
     ).astype(a_sorted.dtype)
     db = _block_outer_accumulate(
-        a_sorted, dout, expert_ids, b.shape[0], cfg, interpret
+        a_sorted, dout, expert_ids, b.shape[0], cfg, interpret,
+        assume_sorted=assume_sorted,
     ).astype(b.dtype)
     d_ids = np.zeros(expert_ids.shape, jax.dtypes.float0)
     return da, db, d_ids

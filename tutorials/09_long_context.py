@@ -1,0 +1,106 @@
+"""Tutorial 09 — long-context sequence parallelism (beyond the reference:
+SURVEY.md §5 notes it implements neither prefill ring attention nor
+Ulysses; this framework treats long context as first-class).
+
+Four recipes over the same causal-attention problem, all matching the
+dense golden:
+
+1. ring          — q stays put, KV circulates; bandwidth-optimal
+2. ring+zigzag   — stripe-pair shards balance the causal load per PE
+3. ulysses       — one head exchange, dense local attention (h >= world)
+4. usp           — Ulysses-inner x ring-outer on a 2-D mesh: long context
+                   over MORE chips than heads
+
+Shapes are kept tiny per recipe (the interpreter host is small); on real
+ICI the same calls scale to the long-context regime. Run:
+
+    python tutorials/09_long_context.py
+"""
+
+import common  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu.ops import (
+    RingAttentionConfig,
+    ring_attention_op,
+    ulysses_attention,
+    usp_attention,
+    zigzag_permutation,
+)
+
+
+def dense_causal(q, k, v):
+    d = q.shape[-1]
+    s = q.shape[2]
+    sc = jnp.einsum(
+        "bhqd,bhsd->bhqs", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / jnp.sqrt(jnp.float32(d))
+    sc = jnp.where(jnp.tril(jnp.ones((s, s), bool))[None, None], sc, -jnp.inf)
+    return jnp.einsum("bhqs,bhsd->bhqd", jax.nn.softmax(sc, -1), v)
+
+
+def _case(key, b, h, s, d=128):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(kk, (b, h, s, d), jnp.float32) for kk in ks)
+
+
+def main():
+    mesh, world = common.bootstrap()
+    cfg = RingAttentionConfig(4, 4)
+
+    def check(name, got, want, detail=""):
+        ok = np.allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+        common.report(f"09_long_context[{name}]", ok, detail)
+
+    # 1 + 2: ring and zigzag-ring (one head — the ring DMAs stay under the
+    # interpreter host's concurrent-transfer threshold at world=8)
+    q, k, v = _case(jax.random.PRNGKey(0), 1, 1, 8 * world)
+    want = dense_causal(q, k, v)
+    check("ring", ring_attention_op(q, k, v, mesh, config=cfg), want,
+          f"world={world}")
+    perm, inv = zigzag_permutation(world, 8 * world)
+    got_z = ring_attention_op(
+        q[:, :, perm], k[:, :, perm], v[:, :, perm], mesh,
+        config=cfg, layout="zigzag",
+    )
+    check("ring_zigzag", np.asarray(got_z)[:, :, inv], want,
+          "balanced causal load")
+
+    # 3: Ulysses head exchange (h == world here)
+    qu, ku, vu = _case(jax.random.PRNGKey(1), 1, world, 4 * world)
+    got_u = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ulysses_attention(q, k, v, "tp", True),
+            mesh=mesh, in_specs=(P(None, None, "tp", None),) * 3,
+            out_specs=P(None, None, "tp", None), check_vma=False,
+        )
+    )(qu, ku, vu)
+    check("ulysses", got_u, dense_causal(qu, ku, vu),
+          "one exchange, dense local attention")
+
+    # 4: USP over a 2-D (outer, inner) mesh — sequence over BOTH axes
+    if world % 2:
+        common.report("09_long_context[usp]", True, f"SKIP: world={world} odd")
+        return
+    n_i, n_o = 2, world // 2
+    mesh2d = Mesh(np.array(jax.devices()).reshape(n_o, n_i), ("sp", "tp"))
+    qs, ks_, vs = _case(jax.random.PRNGKey(2), 1, n_i, 4 * world)
+    got_usp = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: usp_attention(
+                q, k, v, outer="sp", inner="tp", ring_config=cfg
+            ),
+            mesh=mesh2d, in_specs=(P(None, None, ("sp", "tp"), None),) * 3,
+            out_specs=P(None, None, ("sp", "tp"), None), check_vma=False,
+        )
+    )(qs, ks_, vs)
+    check("usp", got_usp, dense_causal(qs, ks_, vs),
+          f"mesh={n_o}x{n_i} (ring x heads)")
+
+
+if __name__ == "__main__":
+    main()
