@@ -140,3 +140,24 @@ def test_checkpoint_save_restore_reshard(tmp_path, mesh2x4, mesh8):
     np.testing.assert_array_equal(np.asarray(got["w"]), np.asarray(tree["w"]))
     assert float(got["step_scale"]) == 3.0
     assert got["w"].sharding == like["w"].sharding
+
+
+def test_hang_watchdog_fires_and_clears(capsys):
+    """Watchdog dumps stacks + calls the hook when the region overruns,
+    and stays silent when it completes in time."""
+    import time
+
+    from triton_dist_tpu.utils import hang_watchdog
+
+    fired = []
+    with hang_watchdog(0.2, dump=False, on_timeout=lambda: fired.append(1)):
+        time.sleep(0.6)
+    assert fired == [1]
+    err = capsys.readouterr().err
+    assert "hang_watchdog" in err
+
+    fired.clear()
+    with hang_watchdog(5.0, dump=False, on_timeout=lambda: fired.append(1)):
+        pass
+    time.sleep(0.3)
+    assert fired == []

@@ -551,3 +551,43 @@ def test_sp_transformer_zigzag_matches_contig(mesh4):
     np.testing.assert_allclose(
         np.asarray(got_z)[:, inv], np.asarray(want), rtol=2e-4, atol=2e-4
     )
+
+
+def test_train_step_with_optax_adam(mesh4):
+    """train_step takes any optax transform: adam state shards via
+    opt_state_specs (param-mirroring subtrees get the param specs, counts
+    replicate) and the loss decreases."""
+    import optax
+
+    from triton_dist_tpu.models import opt_state_specs
+
+    cfg = _cfg()
+    model = TPTransformer(cfg)
+    params = init_params(jax.random.PRNGKey(60), cfg)
+    m = cfg.batch * cfg.seq
+    tokens = jax.random.randint(jax.random.PRNGKey(61), (m,), 0, cfg.vocab, jnp.int32)
+    targets = jax.random.randint(jax.random.PRNGKey(62), (m,), 0, cfg.vocab, jnp.int32)
+    opt = optax.adam(1e-2)
+    specs = param_specs(cfg)
+    o_specs = opt_state_specs(opt, params, specs)
+    params_sh = _put_params(params, cfg, mesh4)
+    opt_state = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh4, s)),
+        opt.init(params), o_specs,
+    )
+    step = jax.jit(
+        jax.shard_map(
+            lambda t, y, p, o: train_step(
+                model, p, t, y, dp_axis=None, opt=opt, opt_state=o
+            ),
+            mesh=mesh4, in_specs=(P("tp"), P(None), specs, o_specs),
+            out_specs=(specs, o_specs, P()), check_vma=False,
+        )
+    )
+    p1, o1, loss1 = step(tokens, targets, params_sh, opt_state)
+    jax.block_until_ready(loss1)
+    p2, o2, loss2 = step(tokens, targets, p1, o1)
+    jax.block_until_ready(loss2)
+    p3, _, loss3 = step(tokens, targets, p2, o2)
+    assert float(loss2) < float(loss1)
+    assert float(loss3) < float(loss2)

@@ -32,6 +32,7 @@ from triton_dist_tpu.models.tp_transformer import (
     init_moe_params,
     init_params,
     moe_param_specs,
+    opt_state_specs,
     param_specs,
     train_step,
 )
@@ -56,6 +57,7 @@ __all__ = [
     "init_moe_params",
     "init_params",
     "moe_param_specs",
+    "opt_state_specs",
     "param_specs",
     "train_step",
 ]

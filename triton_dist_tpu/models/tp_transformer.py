@@ -388,11 +388,42 @@ def specs_for(cfg: TransformerConfig) -> dict:
     return param_specs(cfg)
 
 
+def opt_state_specs(opt, params, specs):
+    """Partition specs for an optax optimizer state: subtrees that mirror
+    the param tree (adam's mu/nu, momentum buffers, …) take the param
+    specs; everything else (step counts, scalars) replicates. Use to
+    device_put / shard_map the state alongside the params."""
+    target = jax.tree.structure(params)
+
+    def mirrors(x):
+        # structure AND leaf shapes must match — structure alone would
+        # mis-classify scalar state (adam's count) when params is itself
+        # a single leaf
+        if jax.tree.structure(x) != target:
+            return False
+        return all(
+            getattr(xe, "shape", None) == getattr(pe, "shape", None)
+            for xe, pe in zip(jax.tree.leaves(x), jax.tree.leaves(params))
+        )
+
+    def expand(x):
+        if mirrors(x):
+            return specs
+        return jax.tree.map(lambda _: P(), x)
+
+    state = jax.eval_shape(opt.init, params)
+    return jax.tree.map(expand, state, is_leaf=mirrors)
+
+
 def train_step(
     model: TPTransformer, params, tokens_loc, targets, lr=1e-2,
-    dp_axis: str | None = "dp",
+    dp_axis: str | None = "dp", opt=None, opt_state=None,
 ):
-    """One SGD step (call inside shard_map over a ``(dp, tp)`` mesh; pass
+    """One optimizer step (call inside shard_map over a ``(dp, tp)`` mesh).
+    Default is SGD at `lr`; pass ``opt=`` (any optax transform) and
+    ``opt_state=`` for a stateful optimizer — `lr` is then UNUSED (the
+    transform carries its own schedule) and the return becomes
+    ``(params, opt_state, loss)``. Pass
     ``dp_axis=None`` on a pure-TP mesh, or the data axis's actual name).
 
     Gradient accounting (verified against the unsharded reference in
@@ -433,5 +464,13 @@ def train_step(
         return g / tp
 
     grads = jax.tree.map(fix, grads, specs)
+    if opt is not None:
+        # any optax transform; state sharding via opt_state_specs. Returns
+        # (params, opt_state, loss) in this mode.
+        import optax
+
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
     params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
     return params, loss

@@ -245,3 +245,51 @@ def group_profile(name: str | None = None, do_prof: bool = True, log_dir: str = 
 
 def bytes_of(x: jax.Array | jax.ShapeDtypeStruct) -> int:
     return int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize
+
+
+@contextlib.contextmanager
+def hang_watchdog(timeout_s: float = 300.0, *, dump: bool = True,
+                  on_timeout: Callable[[], None] | None = None):
+    """Failure detection for distributed programs (the reference has none —
+    SURVEY.md §5: errors are fail-fast only, hangs just hang).
+
+    A collective with a mismatched participant, a deadlocked semaphore, or
+    a dead peer host leaves ``block_until_ready`` waiting forever with no
+    diagnostics. Wrap the blocking region::
+
+        with hang_watchdog(120):
+            jax.block_until_ready(train_step(...))
+
+    If the region is still running after `timeout_s`, the watchdog dumps
+    every Python thread's stack to stderr (``dump=True``) and calls
+    `on_timeout` if given — a hook for e.g. aborting the coordinator so
+    the job fails loudly instead of burning a reservation. The watchdog is
+    passive until the deadline and adds one daemon thread of overhead.
+    """
+    import faulthandler
+    import sys
+    import threading
+
+    done = threading.Event()
+
+    def watch():
+        if done.wait(timeout_s):
+            return
+        suffix = " — dumping thread stacks" if dump else ""
+        print(
+            f"[hang_watchdog] region still blocked after {timeout_s:.0f}s"
+            f"{suffix}",
+            file=sys.stderr, flush=True,
+        )
+        if dump:
+            faulthandler.dump_traceback(file=sys.stderr)
+        if on_timeout is not None:
+            on_timeout()
+
+    t = threading.Thread(target=watch, daemon=True, name="tdt-hang-watchdog")
+    t.start()
+    try:
+        yield
+    finally:
+        done.set()
+        t.join(timeout=1.0)
