@@ -1,0 +1,25 @@
+"""The real-TPU smoke script must stay runnable: exercise its exact op
+sequence through the interpreter so the script can't rot between chip
+sessions (on a real accelerator it runs compiled via `python
+scripts/tpu_smoke.py`)."""
+
+import os
+import subprocess
+import sys
+
+SCRIPT = os.path.join(
+    os.path.dirname(__file__), "..", "scripts", "tpu_smoke.py"
+)
+
+
+def test_tpu_smoke_script_interpreted():
+    env = dict(os.environ, TDT_SMOKE_INTERPRET="1", JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=1"
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(SCRIPT)],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    assert "ops OK" in proc.stdout, proc.stdout
