@@ -251,7 +251,35 @@ def bench_ag_gemm(mesh, n):
 
 
 def main() -> None:
-    devs = jax.devices()
+    # the tunneled accelerator backend can die such that first init BLOCKS
+    # forever (observed: axon tunnel outage) — probe it on a side thread
+    # and fail fast with a diagnostic instead of hanging the driver
+    import sys
+    import threading
+
+    box: list = []
+
+    def _probe():
+        try:
+            box.append(("ok", jax.devices()))
+        except Exception as e:  # surfaced below, not via threading hook
+            box.append(("err", e))
+
+    probe = threading.Thread(target=_probe, daemon=True)
+    probe.start()
+    probe.join(300)
+    if not box:
+        print(
+            "bench: accelerator backend failed to initialize within 300s "
+            "(tunnel down?) — aborting instead of hanging",
+            file=sys.stderr, flush=True,
+        )
+        raise SystemExit(2)
+    status, payload = box[0]
+    if status == "err":
+        print(f"bench: backend init failed: {payload!r}", file=sys.stderr, flush=True)
+        raise SystemExit(2)
+    devs = payload
     n = len(devs)
     mesh = Mesh(np.array(devs), ("tp",))
     bench_gemm_rs(mesh, n)
