@@ -59,6 +59,9 @@ class TPMoEMLP:
     activation: Callable[[jax.Array], jax.Array] = jax.nn.gelu
     gg_config: GroupGemmConfig | None = None
     interpret: Any = None
+    # True: single-kernel overlapped AG-GroupGEMM / MoE-Reduce-RS pair;
+    # False: sequential composition (A/B baseline)
+    overlap: bool = True
 
     def __call__(
         self,
@@ -73,4 +76,5 @@ class TPMoEMLP:
         return tp_moe_mlp_grad(
             x, w_up, w_down, topk_ids, topk_weights.astype(jnp.float32),
             self.axis, self.activation, self.gg_config, self.interpret,
+            self.overlap,
         ).astype(x.dtype)

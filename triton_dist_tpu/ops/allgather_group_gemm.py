@@ -16,20 +16,28 @@ the TPU analogue of the reference's stream-ordered producer/consumer.
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.allgather import all_gather
-from triton_dist_tpu.ops.common import jit_shard_map
+from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
 from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
 from triton_dist_tpu.ops.moe_utils import (
     MoEAlignment,
+    RankedAlignment,
     gather_sorted_rows,
     moe_align_block_size,
+    moe_align_ranked,
 )
+from triton_dist_tpu.shmem import device as shmem
+from triton_dist_tpu.utils import pick_block
 
 
 def ag_group_gemm(
@@ -72,6 +80,290 @@ def ag_group_gemm(
     return h_sorted, alignment
 
 
+def _ag_group_gemm_overlap_kernel(
+    eid_ref, a_ref, b_ref, src_rows_ref,
+    out_ref, ag_ref,
+    a_all, b_buf, out_stage, ids_sm,
+    copy_sem, send_sems, recv_sems, gsems, idsem, bsem, outsem,
+    *, axis: str, n: int, nb: int, n_jn: int, bn: int, bpg: int, out_dtype,
+):
+    """Fused ring-AG + grouped GEMM: each chunk's rows are row-DMA-gathered
+    into VMEM in double-buffered groups the moment the ring delivers the
+    chunk, and consumed by a jn-outer / block-inner MXU loop that
+    re-fetches an expert's weight slab only when the expert changes (the
+    consecutive-block reuse the grid-based ``group_gemm`` gets from
+    Pallas's index-map equality). Compute order = ring arrival order — the
+    reference's per-source-segment tile swizzle with flag waits
+    (allgather_group_gemm.py:420-470) becomes the schedule itself, as in
+    ``_ag_gemm_kernel``."""
+    me = shmem.my_pe(axis)
+    m_loc, k_dim = a_ref.shape
+    bm = ids_sm.shape[0] // nb
+    t_pad_loc = nb * bm
+    it_counter = [0]  # trace-time global (block, jn) iteration count
+
+    local = pltpu.make_async_copy(
+        a_ref, ag_ref.at[pl.ds(me * m_loc, m_loc)], copy_sem
+    )
+    local.start()
+    local.wait()
+    if n > 1:
+        shmem.barrier_all(axis)
+    right = jax.lax.rem(me + 1, n)
+
+    descs = []
+    for s in range(n):
+        c = jax.lax.rem(me - s + 2 * n, n)
+        if s > 0:
+            descs[s - 1].wait_recv()  # chunk c landed during step s-1
+        sl = pl.ds(c * m_loc, m_loc)
+        if s < n - 1:
+            # forward chunk c before computing on it: ICI overlaps MXU
+            descs.append(
+                shmem.putmem_nbi_block(
+                    ag_ref.at[sl], ag_ref.at[sl], right, axis,
+                    send_sems.at[s], recv_sems.at[s],
+                )
+            )
+
+        # chunk c's gather plan (global src rows) → SMEM; rows are then
+        # gathered in double-buffered GROUPS of `bpg` blocks so VMEM stays
+        # bounded for any t_pad_loc (group g+1's row DMAs fly while group
+        # g's blocks run through the MXU)
+        ids_cp = pltpu.make_async_copy(
+            src_rows_ref.at[c], ids_sm, idsem
+        )
+        ids_cp.start()
+        ids_cp.wait()
+
+        n_groups = (nb + bpg - 1) // bpg
+
+        def _issue_group(g, slot):
+            base = g * bpg * bm
+            cnt = min(bpg * bm, t_pad_loc - base)
+
+            def _row(r, _):
+                src = ids_sm[base + r]
+                pltpu.make_async_copy(
+                    ag_ref.at[pl.ds(src, 1), :],
+                    a_all.at[slot, pl.ds(r, 1), :],
+                    gsems.at[slot],
+                ).start()
+                return 0
+
+            jax.lax.fori_loop(0, cnt, _row, 0)
+            return cnt
+
+        cnt0 = _issue_group(0, 0)
+        group_rows = [cnt0]
+        for g in range(n_groups):          # python: group sizes are static
+            gslot = g % 2
+            if g + 1 < n_groups:
+                group_rows.append(_issue_group(g + 1, 1 - gslot))
+            # wait the whole group's row copies (byte-counted: cnt rows of K)
+            pltpu.make_async_copy(
+                ag_ref.at[pl.ds(0, group_rows[g]), :],
+                a_all.at[gslot, pl.ds(0, group_rows[g]), :],
+                gsems.at[gslot],
+            ).wait()
+            nb_g = group_rows[g] // bm     # blocks in this group
+
+            # first weight slab of this group
+            e0 = eid_ref[c, g * bpg]
+            pltpu.make_async_copy(
+                b_ref.at[e0, :, pl.ds(0, bn)], b_buf.at[0], bsem.at[0]
+            ).start()
+            it_base = it_counter[0]
+
+            def _iter(i, slot, g=g, gslot=gslot, nb_g=nb_g, it_base=it_base):
+                jn = i // nb_g
+                b_rel = jax.lax.rem(i, nb_g)
+                b = g * bpg + b_rel
+                e = eid_ref[c, b]
+                prev_rel = jax.lax.rem(jax.lax.max(i - 1, 0), nb_g)
+                fresh = jnp.logical_or(
+                    i == 0,
+                    jnp.logical_or(
+                        jn != jax.lax.max(i - 1, 0) // nb_g,
+                        e != eid_ref[c, g * bpg + prev_rel],
+                    ),
+                )
+                slot = jnp.where(fresh, 1 - slot, slot)
+
+                # DMA semaphores are waited through a descriptor of matching
+                # byte count (both Mosaic and the interpreter count bytes)
+                @pl.when(fresh)
+                def _():
+                    pltpu.make_async_copy(
+                        b_ref.at[e, :, pl.ds(jn * bn, bn)],
+                        b_buf.at[slot],
+                        bsem.at[slot],
+                    ).wait()
+
+                # prefetch the NEXT distinct weight slab while this dot runs
+                nxt = i + 1
+                jn2 = nxt // nb_g
+                b2 = jax.lax.rem(nxt, nb_g)
+                e2 = eid_ref[c, g * bpg + jax.lax.min(b2, nb_g - 1)]
+                fresh2 = jnp.logical_and(
+                    nxt < nb_g * n_jn,
+                    jnp.logical_or(jn2 != jn, e2 != e),
+                )
+
+                @pl.when(fresh2)
+                def _():
+                    pltpu.make_async_copy(
+                        b_ref.at[e2, :, pl.ds(jn2 * bn, bn)],
+                        b_buf.at[1 - slot],
+                        bsem.at[1 - slot],
+                    ).start()
+
+                y = jnp.dot(
+                    a_all[gslot, pl.ds(b_rel * bm, bm), :],
+                    b_buf[slot],
+                    preferred_element_type=jnp.float32,
+                )
+                # out_stage slots alternate on the GLOBAL iteration count
+                # (group iteration counts may be odd); a slot's first-ever
+                # use has no pending store to wait for
+                gi = it_base + i
+                oslot = jax.lax.rem(gi, 2)
+
+                @pl.when(gi >= 2)
+                def _():
+                    pltpu.make_async_copy(
+                        out_stage.at[pl.ds(oslot * bm, bm), :],
+                        out_ref.at[
+                            pl.ds(c * t_pad_loc + b * bm, bm), pl.ds(jn * bn, bn)
+                        ],
+                        outsem.at[oslot],
+                    ).wait()
+
+                out_stage[pl.ds(oslot * bm, bm), :] = y.astype(out_dtype)
+                pltpu.make_async_copy(
+                    out_stage.at[pl.ds(oslot * bm, bm), :],
+                    out_ref.at[
+                        pl.ds(c * t_pad_loc + b * bm, bm), pl.ds(jn * bn, bn)
+                    ],
+                    outsem.at[oslot],
+                ).start()
+                return slot
+
+            jax.lax.fori_loop(0, nb_g * n_jn, _iter, jnp.int32(1))
+            it_counter[0] += nb_g * n_jn
+    # Drain the final pending output store per used slot, then wait local
+    # send completion of the ring puts.
+    total_iters = n * nb * n_jn
+
+    def _drain(oslot):
+        pltpu.make_async_copy(
+            out_stage.at[pl.ds(oslot * bm, bm), :],
+            out_ref.at[pl.ds(0, bm), pl.ds(0, bn)],
+            outsem.at[oslot],
+        ).wait()
+
+    if total_iters >= 1:
+        _drain((total_iters - 1) % 2)
+    if total_iters >= 2:
+        _drain(total_iters % 2)
+    shmem.quiet(*descs)
+
+
+def ag_group_gemm_overlap(
+    a: jax.Array,
+    b: jax.Array,
+    ral: RankedAlignment,
+    *,
+    axis: str = "tp",
+    config: GroupGemmConfig | None = None,
+    gather_output: bool = False,
+    out_dtype: Any = None,
+    gather_group_blocks: int | None = None,
+    interpret: Any = None,
+):
+    """Single-kernel overlapped MoE up-projection (call inside shard_map;
+    ≙ the reference's fused producer/consumer ``ag_group_gemm``,
+    allgather_group_gemm.py:272,420-470 — there: cp-engine AG + consumer
+    GEMM spinning on per-source flags; here: ring DMA + arrival-order
+    grouped GEMM in one Pallas kernel).
+
+    a: ``[m_loc, K]`` token shard; b: ``[E, K, n_loc]``; `ral` from
+    :func:`~triton_dist_tpu.ops.moe_utils.moe_align_ranked` over the
+    allgathered routing ids. Returns ``[n*t_pad_loc, n_loc]`` rows in
+    rank-major aligned order (+ the gathered ``[n*m_loc, K]`` tokens when
+    `gather_output`)."""
+    cfg = config or GroupGemmConfig()
+    out_dtype = out_dtype or a.dtype
+    n = int(jax.lax.axis_size(axis))
+    m_loc, k_dim = a.shape
+    n_loc = b.shape[2]
+    nb = ral.blocks_per_rank
+    bm = ral.block_m
+    t_pad_loc = ral.t_pad_loc
+    assert bm == cfg.block_m, (bm, cfg.block_m)
+    bn = pick_block(n_loc, cfg.block_n)
+    n_jn = n_loc // bn
+    itemsize = jnp.dtype(a.dtype).itemsize
+    # gather-group size: the double-buffered resident rows must stay inside
+    # a ~16 MiB budget regardless of t_pad_loc (VMEM-bounded for any shape);
+    # `gather_group_blocks` overrides for tests of the multi-group path
+    bpg = gather_group_blocks or max(
+        1, min(nb, (16 * 2**20) // (2 * bm * k_dim * itemsize))
+    )
+    vmem_bytes = (
+        2 * bpg * bm * k_dim * itemsize       # double-buffered gather groups
+        + 2 * k_dim * bn * itemsize           # double-buffered weight slabs
+        + 2 * 2 * bm * bn * jnp.dtype(out_dtype).itemsize
+        + 4 * 2**20
+    )
+    out, ag = dist_pallas_call(
+        functools.partial(
+            _ag_group_gemm_overlap_kernel, axis=axis, n=n, nb=nb,
+            n_jn=n_jn, bn=bn, bpg=bpg, out_dtype=out_dtype,
+        ),
+        name="ag_group_gemm_overlap",
+        out_shape=(
+            jax.ShapeDtypeStruct((n * t_pad_loc, n_loc), out_dtype),
+            jax.ShapeDtypeStruct((n * m_loc, k_dim), a.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # expert ids [n, nb]
+            pl.BlockSpec(memory_space=pl.ANY),       # a
+            pl.BlockSpec(memory_space=pl.ANY),       # b
+            pl.BlockSpec(memory_space=pl.ANY),       # src rows [n, t_pad_loc]
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, bpg * bm, k_dim), a.dtype),
+            pltpu.VMEM((2, k_dim, bn), b.dtype),
+            pltpu.VMEM((2 * bm, bn), out_dtype),
+            pltpu.SMEM((t_pad_loc,), jnp.int32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * t_pad_loc * k_dim * n_loc,
+            bytes_accessed=(
+                n * m_loc * k_dim + b.shape[0] * k_dim * n_loc
+                + n * t_pad_loc * n_loc
+            ) * itemsize,
+            transcendentals=0,
+        ),
+        vmem_limit_bytes=min(vmem_bytes, 100 * 2**20),
+        uses_barrier=n > 1,
+        interpret=interpret,
+    )(ral.expert_ids, a, b, ral.src_rows)
+    return (out, ag) if gather_output else out
+
+
 def ag_group_gemm_op(
     a: jax.Array,
     b: jax.Array,
@@ -108,3 +400,19 @@ def ag_group_gemm_op(
         P(None, axis),
         key=("ag_group_gemm", axis, cfg, m_tot, topk, str(interpret)),
     )(a, b, topk_ids.astype(jnp.int32))
+
+
+# Grouped-GEMM tile sweep (≙ the reference autotuning its MoE kernels,
+# allgather_group_gemm.py:130-180 config lists). block_m is also the
+# alignment block, so the sweep may change padding, not just tiling.
+AG_GROUP_GEMM_TUNE_SPACE = (
+    GroupGemmConfig(128, 1024, 512),
+    GroupGemmConfig(128, 2048, 512),
+    GroupGemmConfig(128, 1024, 1024),
+    GroupGemmConfig(128, 512, 512),
+    GroupGemmConfig(256, 1024, 512),
+)
+
+ag_group_gemm_op = contextual_autotune(
+    AG_GROUP_GEMM_TUNE_SPACE, name="ag_group_gemm"
+)(ag_group_gemm_op)

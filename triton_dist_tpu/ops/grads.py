@@ -229,7 +229,67 @@ def _block_outer_accumulate(
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _tp_moe_forward_impl(x, w_up, w_down, topk_ids, topk_weights, axis,
+                         activation, gg_config, interpret, overlap):
+    """Shared forward of the MoE TP MLP. ``overlap=True`` runs the two
+    single-kernel overlapped ops over the rank-major alignment (comm rides
+    under the grouped GEMMs); ``overlap=False`` is the sequential
+    composition (the A/B baseline and the fallback). Both return
+    ``(out, res)`` with the SAME residual structure — the backward is
+    layout-agnostic through the global-view alignment."""
+    from triton_dist_tpu.ops.allgather_group_gemm import (
+        ag_group_gemm,
+        ag_group_gemm_overlap,
+    )
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+    from triton_dist_tpu.ops.moe_reduce_rs import (
+        moe_reduce_rs,
+        moe_reduce_rs_overlap,
+    )
+    from triton_dist_tpu.ops.moe_utils import (
+        moe_align_ranked,
+        ranked_global_view,
+        ranked_scatter_meta,
+    )
+
+    n = int(jax.lax.axis_size(axis))
+    m_loc = x.shape[0]
+    n_exp = w_up.shape[0]
+    topk = topk_ids.shape[1]
+    tw_full = jax.lax.all_gather(topk_weights, axis, tiled=True)
+    if overlap:
+        cfg = gg_config or GroupGemmConfig()
+        ids_full = jax.lax.all_gather(topk_ids, axis, tiled=True)
+        ral = moe_align_ranked(
+            ids_full.reshape(n, m_loc * topk), n_exp, cfg.block_m, m_loc
+        )
+        h_sorted, a_full = ag_group_gemm_overlap(
+            x, w_up, ral, axis=axis, config=cfg, gather_output=True,
+            interpret=interpret,
+        )
+        act = activation(h_sorted.astype(jnp.float32)).astype(x.dtype)
+        dst_ids, w_rows = ranked_scatter_meta(ral, tw_full)
+        out = moe_reduce_rs_overlap(
+            act, w_down, ral.expert_ids, dst_ids, w_rows, axis=axis,
+            m_out=m_loc, config=cfg, out_dtype=x.dtype, interpret=interpret,
+        ).astype(x.dtype)
+        alignment = ranked_global_view(ral, m_loc, topk)
+    else:
+        h_sorted, alignment, a_full = ag_group_gemm(
+            x, w_up, topk_ids, axis=axis, config=gg_config,
+            gather_output=True, interpret=interpret,
+        )
+        act = activation(h_sorted.astype(jnp.float32)).astype(x.dtype)
+        out = moe_reduce_rs(
+            act, w_down, alignment, tw_full, axis=axis,
+            n_tokens=n * m_loc, config=gg_config, out_dtype=x.dtype,
+            interpret=interpret,
+        ).astype(x.dtype)
+    res = (a_full, h_sorted, tw_full, alignment, w_up, w_down, m_loc)
+    return out, res
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
 def tp_moe_mlp_grad(
     x: jax.Array,
     w_up: jax.Array,
@@ -240,12 +300,17 @@ def tp_moe_mlp_grad(
     activation=jax.nn.gelu,
     gg_config: Any = None,
     interpret: Any = None,
+    overlap: bool = True,
 ) -> jax.Array:
     """Differentiable fused MoE TP MLP (call inside shard_map) — the
     training path the reference lacks for its MoE ops.
 
-    Forward = the fused AG-GroupGEMM → activation → MoE-Reduce-RS exactly
-    as :class:`~triton_dist_tpu.layers.tp_mlp.TPMoEMLP`. Backward reuses
+    Forward (default ``overlap=True``) = the single-kernel overlapped
+    AG-GroupGEMM → activation → single-kernel MoE-Reduce-RS over the
+    rank-major alignment (≙ the reference's fused
+    ``ag_group_gemm``/``moe_reduce_rs`` pipelines,
+    allgather_group_gemm.py:420-470, moe_reduce_rs.py:882-1020);
+    ``overlap=False`` keeps the sequential composition. Backward reuses
     the same algebra as the dense pair (grads above): the reduce-scatter's
     transpose is an all-gather of dout, the two grouped GEMMs backprop
     through ``group_gemm`` with per-expert transposed weights (the fused
@@ -258,44 +323,22 @@ def tp_moe_mlp_grad(
     topk_ids/topk_weights: ``[m_loc, topk]`` (ids carry a zero cotangent).
     Returns ``[m_loc, H]``.
     """
-    from triton_dist_tpu.ops.allgather_group_gemm import ag_group_gemm
-    from triton_dist_tpu.ops.moe_reduce_rs import moe_reduce_rs
-
-    n = int(jax.lax.axis_size(axis))
-    h_sorted, alignment = ag_group_gemm(
-        x, w_up, topk_ids, axis=axis, config=gg_config, interpret=interpret
+    out, _ = _tp_moe_forward_impl(
+        x, w_up, w_down, topk_ids, topk_weights, axis, activation,
+        gg_config, interpret, overlap,
     )
-    act = activation(h_sorted.astype(jnp.float32)).astype(x.dtype)
-    tw_full = jax.lax.all_gather(topk_weights, axis, tiled=True)
-    return moe_reduce_rs(
-        act, w_down, alignment, tw_full, axis=axis,
-        n_tokens=n * x.shape[0], config=gg_config, out_dtype=x.dtype,
-        interpret=interpret,
-    ).astype(x.dtype)
+    return out
 
 
 def _tp_moe_fwd(x, w_up, w_down, topk_ids, topk_weights, axis, activation,
-                gg_config, interpret):
-    from triton_dist_tpu.ops.allgather_group_gemm import ag_group_gemm
-    from triton_dist_tpu.ops.moe_reduce_rs import moe_reduce_rs
-
-    n = int(jax.lax.axis_size(axis))
-    h_sorted, alignment, a_full = ag_group_gemm(
-        x, w_up, topk_ids, axis=axis, config=gg_config,
-        gather_output=True, interpret=interpret,
+                gg_config, interpret, overlap):
+    return _tp_moe_forward_impl(
+        x, w_up, w_down, topk_ids, topk_weights, axis, activation,
+        gg_config, interpret, overlap,
     )
-    act = activation(h_sorted.astype(jnp.float32)).astype(x.dtype)
-    tw_full = jax.lax.all_gather(topk_weights, axis, tiled=True)
-    out = moe_reduce_rs(
-        act, w_down, alignment, tw_full, axis=axis,
-        n_tokens=n * x.shape[0], config=gg_config, out_dtype=x.dtype,
-        interpret=interpret,
-    ).astype(x.dtype)
-    res = (a_full, h_sorted, tw_full, alignment, w_up, w_down, x.shape[0])
-    return out, res
 
 
-def _tp_moe_bwd(axis, activation, gg_config, interpret, res, dout):
+def _tp_moe_bwd(axis, activation, gg_config, interpret, overlap, res, dout):
     from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
     from triton_dist_tpu.ops.moe_utils import gather_sorted_rows
     from triton_dist_tpu.ops.reduce_scatter import reduce_scatter
@@ -349,9 +392,11 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, res, dout):
         dy_sorted, w_down.transpose(0, 2, 1), al.expert_ids, config=cfg,
         out_dtype=f32, interpret=interpret,
     )
+    # global alignment is expert-sorted by construction; the rank-major
+    # (overlap) layout sorts only within each rank segment
     dw_down = _block_outer_accumulate(
         act, dy_sorted, al.expert_ids, n_exp, cfg, interpret,
-        assume_sorted=True,  # moe_align ids are sorted by construction
+        assume_sorted=not overlap,
     ).astype(w_down.dtype)
     # through the activation
     (dh_sorted,) = act_vjp(dact)
@@ -365,7 +410,7 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, res, dout):
     )
     dw_up = _block_outer_accumulate(
         a_sorted, dh_sorted, al.expert_ids, n_exp, cfg, interpret,
-        assume_sorted=True,
+        assume_sorted=not overlap,
     ).astype(w_up.dtype)
     # unsorted scatter-add back to tokens, then the all-gather's transpose
     da_full = (

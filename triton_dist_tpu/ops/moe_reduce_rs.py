@@ -17,16 +17,22 @@ layer's work in the XLA schedule.
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
-from triton_dist_tpu.ops.common import jit_shard_map
+from triton_dist_tpu.autotuner import contextual_autotune
+from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
 from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
 from triton_dist_tpu.ops.moe_utils import MoEAlignment, scatter_add_unsorted
 from triton_dist_tpu.ops.reduce_scatter import ReduceScatterConfig, reduce_scatter
+from triton_dist_tpu.shmem import device as shmem
+from triton_dist_tpu.utils import pick_block
 
 
 def moe_reduce_rs(
@@ -63,6 +69,283 @@ def moe_reduce_rs(
         partial.astype(out_dtype), axis=axis, method=rs_method,
         config=rs_config, interpret=interpret,
     )
+
+
+def _moe_reduce_rs_overlap_kernel(
+    eid_ref, h_ref, w_ref, dst_ref, wrow_ref,
+    out_ref, own_buf, landing,
+    h_buf, w_buf, push_stage, ids_v, w_v, partial_ref,
+    hsem, wsem, metasem, stage_sem, recv_sems,
+    *, axis: str, n: int, nb: int, n_jn: int, bn: int, m_out: int, out_dtype,
+):
+    """Fused grouped-GEMM → weighted combine → reduce-scatter: destination
+    rank c's chunk is computed from ITS aligned rows (rank-major layout:
+    chunk c's blocks are contiguous), combined in VMEM, and pushed to c the
+    moment its slab is done — while the next chunk's expert GEMMs already
+    run (≙ the reference's producer GEMM on side streams feeding the RS
+    consumer through per-rank notify counters, moe_reduce_rs.py:362,
+    817,882-1020). The top-k weighted scatter is a one-hot-weights matmul
+    riding the MXU in the shadow of the weight-slab DMAs instead of a
+    per-row scatter pass over HBM."""
+    me = shmem.my_pe(axis)
+    t_pad_tot, f_loc = h_ref.shape
+    t_pad_loc = t_pad_tot // n
+    bm = t_pad_loc // nb
+    cdt = h_ref.dtype
+    if n > 1:
+        shmem.barrier_all(axis)
+
+    def _issue_h(c, b, slot):
+        pltpu.make_async_copy(
+            h_ref.at[pl.ds(c * t_pad_loc + b * bm, bm), :],
+            h_buf.at[slot],
+            hsem.at[slot],
+        ).start()
+
+    for s in range(n):
+        # own chunk LAST: remote pushes get the whole kernel to land
+        c = jax.lax.rem(me + 1 + s, n) if n > 1 else jnp.int32(0)
+        ids_cp = pltpu.make_async_copy(dst_ref.at[c], ids_v, metasem)
+        ids_cp.start()
+        w_cp = pltpu.make_async_copy(wrow_ref.at[c], w_v, metasem)
+        w_cp.start()
+        ids_cp.wait()
+        w_cp.wait()
+
+        for jn in range(n_jn):
+            partial_ref[:] = jnp.zeros_like(partial_ref)
+            e0 = eid_ref[c, 0]
+            pltpu.make_async_copy(
+                w_ref.at[e0, :, pl.ds(jn * bn, bn)], w_buf.at[0], wsem.at[0]
+            ).start()
+            _issue_h(c, 0, 0)   # h rows stream per block, double-buffered
+
+            def _blk(b, slot):
+                e = eid_ref[c, b]
+                e_prev = eid_ref[c, jax.lax.max(b - 1, 0)]
+                fresh = jnp.logical_or(b == 0, e != e_prev)
+                slot = jnp.where(fresh, 1 - slot, slot)
+
+                @pl.when(fresh)
+                def _():
+                    pltpu.make_async_copy(
+                        w_ref.at[e, :, pl.ds(jn * bn, bn)],
+                        w_buf.at[slot],
+                        wsem.at[slot],
+                    ).wait()
+
+                e2 = eid_ref[c, jax.lax.min(b + 1, nb - 1)]
+
+                @pl.when(jnp.logical_and(b + 1 < nb, e2 != e))
+                def _():
+                    pltpu.make_async_copy(
+                        w_ref.at[e2, :, pl.ds(jn * bn, bn)],
+                        w_buf.at[1 - slot],
+                        wsem.at[1 - slot],
+                    ).start()
+
+                hslot = jax.lax.rem(b, 2)
+                pltpu.make_async_copy(
+                    h_ref.at[pl.ds(0, bm), :], h_buf.at[hslot], hsem.at[hslot]
+                ).wait()
+
+                @pl.when(b + 1 < nb)
+                def _():
+                    pltpu.make_async_copy(
+                        h_ref.at[
+                            pl.ds(c * t_pad_loc + (b + 1) * bm, bm), :
+                        ],
+                        h_buf.at[1 - hslot],
+                        hsem.at[1 - hslot],
+                    ).start()
+
+                y = jnp.dot(
+                    h_buf[hslot],
+                    w_buf[slot],
+                    preferred_element_type=jnp.float32,
+                )
+                d = ids_v[b]                       # [bm] destination tokens
+                w_r = w_v[b]                       # [bm] routing weights
+                sel = jax.lax.broadcasted_iota(
+                    jnp.int32, (m_out, bm), 0
+                ) == d[None, :]
+                scat = jnp.where(sel, w_r[None, :], 0.0).astype(cdt)
+                partial_ref[:] += jnp.dot(
+                    scat, y.astype(cdt), preferred_element_type=jnp.float32
+                )
+                return slot
+
+            jax.lax.fori_loop(0, nb, _blk, jnp.int32(1))
+
+            pc = s * n_jn + jn
+            pslot = pc % 2
+
+            def _stage_wait(sl):
+                pltpu.make_async_copy(
+                    push_stage.at[sl], own_buf.at[:, pl.ds(0, bn)],
+                    stage_sem.at[sl],
+                ).wait()
+
+            if pc >= 2:
+                _stage_wait(pslot)
+            push_stage[pslot] = partial_ref[:].astype(out_dtype)
+            if s < n - 1:
+                # landing slot index s is the sender-distance convention of
+                # _scatter_reduce_kernel: distinct per sender by symmetry.
+                # Send completion is accounted on stage_sem by the slot-reuse
+                # waits (and the end-of-kernel drain), so the handle is not
+                # kept.
+                shmem.putmem_nbi_block(
+                    landing.at[s, :, pl.ds(jn * bn, bn)],
+                    push_stage.at[pslot],
+                    c, axis, stage_sem.at[pslot], recv_sems.at[s, jn],
+                )
+            else:
+                pltpu.make_async_copy(
+                    push_stage.at[pslot],
+                    (out_ref if n == 1 else own_buf).at[:, pl.ds(jn * bn, bn)],
+                    stage_sem.at[pslot],
+                ).start()
+
+    # drain the last two staged pushes
+    total_push = n * n_jn
+    if total_push >= 1:
+        pltpu.make_async_copy(
+            push_stage.at[(total_push - 1) % 2], own_buf.at[:, pl.ds(0, bn)],
+            stage_sem.at[(total_push - 1) % 2],
+        ).wait()
+    if total_push >= 2:
+        pltpu.make_async_copy(
+            push_stage.at[total_push % 2], own_buf.at[:, pl.ds(0, bn)],
+            stage_sem.at[total_push % 2],
+        ).wait()
+    if n == 1:
+        return
+
+    # wait every incoming slab, then one n-way f32 reduction pass
+    for d in range(n - 1):
+        for jn in range(n_jn):
+            pltpu.make_async_copy(
+                landing.at[d, :, pl.ds(jn * bn, bn)],
+                own_buf.at[:, pl.ds(jn * bn, bn)],
+                recv_sems.at[d, jn],
+            ).wait()
+
+    h_dim = out_ref.shape[1]
+    bmo = pick_block(m_out, 256)
+    bno = pick_block(h_dim, 1024)
+
+    def reduce_body(*blks):
+        o_blk = blks[-1]
+        acc = blks[0][:].astype(jnp.float32)
+        for r in blks[1:-1]:
+            acc = acc + r[:].astype(jnp.float32)
+        o_blk[:] = acc.astype(out_dtype)
+
+    blk = lambda i, j: (i, j)  # noqa: E731
+    pltpu.emit_pipeline(
+        reduce_body,
+        grid=(m_out // bmo, h_dim // bno),
+        in_specs=[pl.BlockSpec((bmo, bno), blk)] * n,
+        out_specs=[pl.BlockSpec((bmo, bno), blk)],
+    )(
+        own_buf,
+        *(landing.at[d] for d in range(n - 1)),
+        out_ref,
+    )
+
+
+def moe_reduce_rs_overlap(
+    h_sorted: jax.Array,
+    w_down: jax.Array,
+    expert_ids: jax.Array,
+    dst_ids: jax.Array,
+    w_rows: jax.Array,
+    *,
+    axis: str = "tp",
+    m_out: int,
+    config: GroupGemmConfig | None = None,
+    out_dtype: Any = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Single-kernel overlapped MoE down-projection + combine + RS (call
+    inside shard_map). h_sorted: ``[n*t_pad_loc, f_loc]`` rank-major aligned
+    rows (the fused up-projection's output); w_down: ``[E, f_loc, H]``;
+    expert_ids ``[n, nb]``, and ``(dst_ids, w_rows)`` ``[n, nb, bm]`` from
+    :func:`~triton_dist_tpu.ops.moe_utils.ranked_scatter_meta`. Returns
+    ``[m_out, H]`` — this PE's fully-reduced token chunk."""
+    cfg = config or GroupGemmConfig()
+    out_dtype = out_dtype or h_sorted.dtype
+    n = int(jax.lax.axis_size(axis))
+    t_pad_tot, f_loc = h_sorted.shape
+    t_pad_loc = t_pad_tot // n
+    nb = expert_ids.shape[1]
+    bm = t_pad_loc // nb
+    assert bm == cfg.block_m, (bm, cfg.block_m)
+    h_dim = w_down.shape[2]
+    itemsize = jnp.dtype(h_sorted.dtype).itemsize
+    out_item = jnp.dtype(out_dtype or h_sorted.dtype).itemsize
+    # bn must keep the f32 partial accumulator, the staged pushes and the
+    # streamed weight slabs inside a ~48 MiB budget for ANY m_out/f_loc
+    per_bn = m_out * 4 + 2 * m_out * out_item + 2 * f_loc * jnp.dtype(w_down.dtype).itemsize
+    bn_budget = max(128, (48 * 2**20) // per_bn)
+    bn = pick_block(h_dim, min(cfg.block_n, bn_budget))
+    n_jn = h_dim // bn
+    workspace = [
+        jax.ShapeDtypeStruct((m_out, h_dim), out_dtype),            # own_buf
+        jax.ShapeDtypeStruct((max(n - 1, 1), m_out, h_dim), out_dtype),
+    ]
+    outs = dist_pallas_call(
+        functools.partial(
+            _moe_reduce_rs_overlap_kernel, axis=axis, n=n, nb=nb,
+            n_jn=n_jn, bn=bn, m_out=m_out, out_dtype=out_dtype,
+        ),
+        name="moe_reduce_rs_overlap",
+        out_shape=(
+            jax.ShapeDtypeStruct((m_out, h_dim), out_dtype),
+            *workspace,
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # expert ids [n, nb]
+            pl.BlockSpec(memory_space=pl.ANY),       # h_sorted
+            pl.BlockSpec(memory_space=pl.ANY),       # w_down
+            pl.BlockSpec(memory_space=pl.ANY),       # dst_ids
+            pl.BlockSpec(memory_space=pl.ANY),       # w_rows
+        ],
+        out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY) for _ in range(3)),
+        scratch_shapes=[
+            pltpu.VMEM((2, bm, f_loc), h_sorted.dtype),
+            pltpu.VMEM((2, f_loc, bn), w_down.dtype),
+            pltpu.VMEM((2, m_out, bn), out_dtype),
+            pltpu.VMEM((nb, bm), jnp.int32),
+            pltpu.VMEM((nb, bm), jnp.float32),
+            pltpu.VMEM((m_out, bn), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1), n_jn)),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * t_pad_tot * f_loc * h_dim
+            + 2 * n * n_jn * nb * m_out * bm * bn,
+            bytes_accessed=(
+                t_pad_tot * f_loc + w_down.shape[0] * f_loc * h_dim
+                + (2 * n) * m_out * h_dim
+            ) * itemsize,
+            transcendentals=0,
+        ),
+        vmem_limit_bytes=min(
+            2 * bm * f_loc * itemsize
+            + 2 * f_loc * bn * jnp.dtype(w_down.dtype).itemsize
+            + (2 * jnp.dtype(out_dtype).itemsize + 4) * m_out * bn
+            + 8 * 2**20,
+            100 * 2**20,
+        ),
+        uses_barrier=n > 1,
+        interpret=interpret,
+    )(expert_ids, h_sorted, w_down, dst_ids, w_rows)
+    return outs[0]
 
 
 def moe_reduce_rs_op(
@@ -111,3 +394,17 @@ def moe_reduce_rs_op(
         P(axis, None),
         key=("moe_reduce_rs", axis, config, n_tokens, topk, str(interpret)),
     )(h_sorted, w_down, sorted_token_ids, expert_ids, topk_weights)
+
+
+# block_m is pinned by the caller-provided alignment (128 = moe_align
+# default); the sweep covers the N/K tiling of the grouped GEMM.
+MOE_RS_TUNE_SPACE = (
+    GroupGemmConfig(128, 1024, 512),
+    GroupGemmConfig(128, 2048, 512),
+    GroupGemmConfig(128, 1024, 1024),
+    GroupGemmConfig(128, 512, 512),
+)
+
+moe_reduce_rs_op = contextual_autotune(MOE_RS_TUNE_SPACE, name="moe_reduce_rs")(
+    moe_reduce_rs_op
+)

@@ -103,6 +103,131 @@ def moe_align_block_size(
     )
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class RankedAlignment:
+    """Per-source-rank block alignment: rank-major, expert-minor.
+
+    Each rank's ``m_loc * topk`` assignments are aligned *independently*
+    (same construction as :func:`moe_align_block_size`, applied per rank),
+    so every row block draws its tokens from exactly ONE rank's chunk. That
+    locality is what lets the fused AG-GroupGEMM consume each chunk the
+    moment its ring transfer lands, and the fused MoE-Reduce-RS push each
+    destination rank's output as soon as its blocks finish — the TPU form
+    of the reference's per-source-segment tile swizzle + per-rank notify
+    counters (reference allgather_group_gemm.py:420-470,
+    moe_reduce_rs.py:362). The price is per-rank instead of global padding
+    (≤ ``E*(block_m-1)`` extra rows *per rank*); the overlap and the
+    elimination of the materialized gather buy it back.
+
+    local_ids: ``[n, t_pad_loc]`` int32 — rank-local flattened assignment
+      index (``token*topk + k``), sentinel ``t_loc`` for padding rows.
+    src_rows: ``[n, t_pad_loc]`` int32 — GLOBAL gathered-A row feeding each
+      aligned row (``c*m_loc + token``); sentinel rows clamp to row 0 of
+      their own chunk, which is always resident when that chunk is
+      processed.
+    expert_ids: ``[n, nb]`` int32 — owning expert of each row block.
+    """
+
+    local_ids: jax.Array
+    src_rows: jax.Array
+    expert_ids: jax.Array
+
+    @property
+    def n_ranks(self) -> int:
+        return self.local_ids.shape[0]
+
+    @property
+    def t_pad_loc(self) -> int:
+        return self.local_ids.shape[1]
+
+    @property
+    def blocks_per_rank(self) -> int:
+        return self.expert_ids.shape[1]
+
+    @property
+    def block_m(self) -> int:
+        return self.t_pad_loc // self.blocks_per_rank
+
+def ranked_global_view(al: RankedAlignment, m_loc: int, topk: int) -> MoEAlignment:
+    """Express a rank-major :class:`RankedAlignment` as an ordinary global
+    :class:`MoEAlignment` over the gathered token set, so every downstream
+    consumer (``scatter_add_unsorted``, ``group_gemm`` backward, goldens)
+    works unchanged: row ``(c, r)`` maps to global assignment
+    ``c*m_loc*topk + local_ids[c, r]`` with the global sentinel
+    ``n*m_loc*topk`` for padding rows.
+
+    Two contract deltas vs :func:`moe_align_block_size` output: expert ids
+    are sorted only *within* each rank segment (pass ``assume_sorted=False``
+    to ``group_gemm_dw``), and because padding blocks are interleaved per
+    rank segment there is no valid-prefix — ``num_tokens_post_pad`` is
+    therefore the FULL padded length, so a consumer that truncates work at
+    it conservatively processes everything (sentinel ids mask the padding
+    rows, which every consumer must honor anyway)."""
+    n, t_pad_loc = al.local_ids.shape
+    t_loc = m_loc * topk
+    c = jnp.arange(n, dtype=jnp.int32)[:, None]
+    valid = al.local_ids < t_loc
+    sorted_token_ids = jnp.where(
+        valid, c * t_loc + al.local_ids, n * t_loc
+    ).reshape(-1).astype(jnp.int32)
+    return MoEAlignment(
+        sorted_token_ids=sorted_token_ids,
+        expert_ids=al.expert_ids.reshape(-1),
+        num_tokens_post_pad=jnp.int32(n * t_pad_loc),
+    )
+
+
+def moe_align_ranked(
+    ids_full: jax.Array, n_experts: int, block_m: int, m_loc: int
+) -> RankedAlignment:
+    """Align each rank's routing independently (see
+    :class:`RankedAlignment`). ids_full: ``[n, m_loc*topk]`` int32 — the
+    allgathered flattened top-k ids (tiny payload; ≙ the reference
+    allgathering routing metadata ahead of the token data,
+    allgather_group_gemm.py:272-330)."""
+    n, t_loc = ids_full.shape
+    topk = t_loc // m_loc
+    al = jax.vmap(
+        lambda ids: moe_align_block_size(ids, n_experts, block_m)
+    )(ids_full)
+    token_of = jnp.clip(al.sorted_token_ids // topk, 0, m_loc - 1)
+    valid = al.sorted_token_ids < t_loc
+    c = jnp.arange(n, dtype=jnp.int32)[:, None]
+    src_rows = c * m_loc + jnp.where(valid, token_of, 0)
+    return RankedAlignment(
+        local_ids=al.sorted_token_ids.astype(jnp.int32),
+        src_rows=src_rows.astype(jnp.int32),
+        expert_ids=al.expert_ids.astype(jnp.int32),
+    )
+
+
+def ranked_scatter_meta(
+    al: RankedAlignment, topk_weights_full: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Per-row combine metadata for the fused MoE-Reduce-RS: destination
+    token WITHIN the row's own chunk and the routing weight (0 for sentinel
+    rows). topk_weights_full: ``[n*m_loc, topk]`` gathered weights.
+    Returns ``(dst_ids [n, nb, bm] int32, w_rows [n, nb, bm] f32)`` shaped
+    for per-block VMEM slicing."""
+    n, t_pad_loc = al.local_ids.shape
+    topk = topk_weights_full.shape[1]
+    m_loc = topk_weights_full.shape[0] // n
+    t_loc = m_loc * topk
+    valid = al.local_ids < t_loc
+    local_tok = jnp.clip(al.local_ids // topk, 0, m_loc - 1)
+    c = jnp.arange(n, dtype=jnp.int32)[:, None]
+    glob_assign = jnp.clip(c * t_loc + al.local_ids, 0, n * t_loc - 1)
+    w = jnp.where(
+        valid, topk_weights_full.reshape(-1)[glob_assign], 0.0
+    ).astype(jnp.float32)
+    bm = al.block_m
+    return (
+        local_tok.astype(jnp.int32).reshape(n, -1, bm),
+        w.reshape(n, -1, bm),
+    )
+
+
 def gather_sorted_rows(
     x: jax.Array, alignment: MoEAlignment, topk: int
 ) -> jax.Array:
