@@ -23,6 +23,27 @@ import pytest  # noqa: E402
 from jax.sharding import Mesh  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: second-tier tests (models, tutorials, large shapes, "
+        "multi-process) — excluded from the <5-min `-m quick` CI tier",
+    )
+    config.addinivalue_line(
+        "markers",
+        "quick: first-tier kernel-family coverage; `pytest -m quick` must "
+        "stay under ~5 min on a 1-core box",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    # quick == everything not explicitly marked slow, so the quick tier
+    # can't silently lose new tests
+    for item in items:
+        if "slow" not in item.keywords:
+            item.add_marker(pytest.mark.quick)
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _interpret_mode():
     from triton_dist_tpu import config

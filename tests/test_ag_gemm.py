@@ -35,8 +35,11 @@ def test_ag_gemm(mesh4, dtype):
     cfg = AGGemmConfig(block_m=16, block_n=128, block_k=64)
     got = ag_gemm_op(a, b, mesh4, config=cfg)
     want = _golden(a, b, mesh4)
+    # f32 against an f32 golden must be tight (VERDICT r2 #6); bf16 pays
+    # MXU rounding
+    tol = 1e-4 if dtype == jnp.float32 else 3e-2
     np.testing.assert_allclose(
-        np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=3e-2, atol=3e-2
+        np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=tol, atol=tol
     )
 
 
@@ -57,7 +60,7 @@ def test_ag_gemm_gather_output(mesh4):
     )(a, b)
     np.testing.assert_array_equal(np.asarray(ag), np.asarray(a))
     want = _golden(a, b, mesh4)
-    np.testing.assert_allclose(np.asarray(c), np.asarray(want), rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(want), rtol=1e-4, atol=1e-4)
 
 
 def test_ag_gemm_world1():
@@ -66,7 +69,7 @@ def test_ag_gemm_world1():
     b = jax.random.normal(jax.random.PRNGKey(5), (128, 128), jnp.float32)
     got = ag_gemm_op(a, b, mesh, config=AGGemmConfig(16, 128, 128))
     want = jnp.dot(a, b)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
 
 
 def test_ag_gemm_2d(mesh2x4):
@@ -97,4 +100,4 @@ def test_ag_gemm_2d(mesh2x4):
         b = jax.random.normal(kb, (k, n_loc), jnp.float32)
         out = jax.jit(jax.shard_map(fn, **specs))(a, b)
         ref = jax.jit(jax.shard_map(golden, **specs))(a, b)
-        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-3, rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4)

@@ -94,3 +94,19 @@ def test_fast_all_to_all_dtypes(dtype):
     assert recv.dtype == dtype
     want = np.asarray(tokens).transpose(1, 0, 2, 3)
     np.testing.assert_array_equal(np.asarray(recv), want)
+
+
+@pytest.mark.parametrize("chunks", [2, 4])
+def test_fast_all_to_all_chunked_puts(chunks):
+    """A2AConfig.puts_per_slab splits each slab into row-chunk puts — the
+    autotuner's scheduling knob; any granularity must exchange identically."""
+    from triton_dist_tpu.ops.all_to_all import A2AConfig
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))
+    tokens, splits = _case(jax.random.PRNGKey(9), 4, 8, 128)
+    recv, rsplits = fast_all_to_all_op(
+        tokens, splits, mesh, config=A2AConfig(puts_per_slab=chunks)
+    )
+    want = np.asarray(tokens).transpose(1, 0, 2, 3)
+    np.testing.assert_array_equal(np.asarray(recv), want)
+    np.testing.assert_array_equal(np.asarray(rsplits), np.asarray(splits).T)

@@ -37,7 +37,7 @@ def test_gemm_rs(mesh4, method, dtype):
     # bf16 partials are rounded once per transfer before the f32 reduce
     # (same as the reference, whose tiles move in output dtype) — wider
     # tolerance than the all-f32 golden.
-    tol = dict(rtol=1e-3, atol=1e-3) if dtype == jnp.float32 else dict(rtol=6e-2, atol=2e-1)
+    tol = dict(rtol=1e-4, atol=1e-4) if dtype == jnp.float32 else dict(rtol=6e-2, atol=2e-1)
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(want, np.float32), **tol
     )
@@ -51,7 +51,7 @@ def test_gemm_rs_world8(mesh8, method):
     cfg = GemmRSConfig(block_m=8, block_n=128, block_k=16)
     got = gemm_rs_op(a, b, mesh8, method=method, config=cfg)
     want = _golden(a, b, mesh8)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4)
 
 
 def test_gemm_rs_world1():
@@ -60,7 +60,7 @@ def test_gemm_rs_world1():
     b = jax.random.normal(jax.random.PRNGKey(5), (128, 128), jnp.float32)
     got = gemm_rs_op(a, b, mesh, config=GemmRSConfig(16, 128, 128))
     np.testing.assert_allclose(
-        np.asarray(got), np.asarray(jnp.dot(a, b)), rtol=2e-2, atol=2e-2
+        np.asarray(got), np.asarray(jnp.dot(a, b)), rtol=1e-4, atol=1e-4
     )
 
 
@@ -91,4 +91,4 @@ def test_gemm_rs_2d(mesh2x4):
         b = jax.random.normal(kb, (8 * k_loc, n_dim), jnp.float32) / 8
         out = jax.jit(jax.shard_map(fn, **specs))(a, b)
         ref = jax.jit(jax.shard_map(golden, **specs))(a, b)
-        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4, rtol=1e-4)

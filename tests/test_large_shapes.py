@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # second tier: excluded from the quick CI tier
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.ops.allgather import all_gather_op

@@ -418,3 +418,61 @@ def test_allgather_layer(mesh4):
             )
         )(x)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(x))
+
+
+def test_ep_overflow_debug_flag_trips(mesh4):
+    """debug_ep_overflow=True must fail loudly on an undersized max_m
+    (≙ the reference's assert, low_latency_all_to_all.py:212): the host
+    callback raises and the output is NaN-poisoned; with the flag off the
+    same run keeps the documented silent-drop + counter contract."""
+    from triton_dist_tpu import config as tdt_config
+    from triton_dist_tpu.layers import EPMoEMLP
+
+    world, m_loc, h_dim, f_dim, n_exp, topk = 4, 4, 16, 32, 4, 2
+    m_tot = world * m_loc
+    x = jax.random.normal(jax.random.PRNGKey(50), (m_tot, h_dim), jnp.float32)
+    w_up = jax.random.normal(jax.random.PRNGKey(51), (n_exp, h_dim, f_dim)) / 8
+    w_down = jax.random.normal(jax.random.PRNGKey(52), (n_exp, f_dim, h_dim)) / 8
+    # route EVERY assignment to expert 0 → rank 0's slabs overflow at
+    # max_m=2 (each rank sends m_loc*topk=8 assignments there)
+    ids = jnp.zeros((m_tot, topk), jnp.int32)
+    tw = jnp.full((m_tot, topk), 0.5, jnp.float32)
+    layer = EPMoEMLP(
+        n_experts=n_exp, topk=topk, max_m=2, axis="tp",
+        gg_config=GroupGemmConfig(4, 16, 16),
+    )
+
+    def fn(*a):
+        out, ov = layer(*a, with_overflow=True)
+        return out, ov.reshape(1)
+
+    def run():
+        return jax.jit(
+            jax.shard_map(
+                fn, mesh=mesh4,
+                in_specs=(
+                    P("tp", None), P("tp", None, None), P("tp", None, None),
+                    P("tp", None), P("tp", None),
+                ),
+                out_specs=(P("tp", None), P(None)), check_vma=False,
+            )
+        )(x, w_up, w_down, ids, tw)
+
+    # flag off: silent drop, counter reports it, output finite
+    out, ov = run()
+    assert int(np.asarray(ov)[0]) > 0
+    assert np.isfinite(np.asarray(out)).all()
+
+    tdt_config.update(debug_ep_overflow=True)
+    try:
+        out2, ov2 = run()
+        jax.block_until_ready(out2)
+        # poison path: every element NaN — impossible to train through
+        assert np.isnan(np.asarray(out2)).all()
+        # host-side hard stop on the fetched counter
+        from triton_dist_tpu.layers.ep_moe_mlp import assert_no_overflow
+
+        with pytest.raises(RuntimeError, match="slab overflow"):
+            assert_no_overflow(np.asarray(ov2)[0])
+    finally:
+        tdt_config.update(debug_ep_overflow=False)

@@ -8,6 +8,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # second tier: excluded from the quick CI tier
+
 TUTORIALS = sorted(
     glob.glob(os.path.join(os.path.dirname(__file__), "..", "tutorials", "[0-9]*.py"))
 )

@@ -98,8 +98,8 @@ def contextual_autotune(
     name: str | None = None,
     iters: int = 15,
     trials: int = 3,
-    warmup: int = 1,  # kept for API compat; warmup happens inside the loop timer
     dedupe: Callable[..., Any] | None = None,
+    sweep_in_interpret: bool = False,
 ) -> Callable:
     """Decorator: sweep `configs` for the wrapped op on first call per input
     signature, thereafter reuse the winner (≙ ``contextual_autotune``,
@@ -112,6 +112,11 @@ def contextual_autotune(
     Each candidate is scored by the median of `trials` on-device loop
     timings (``perf_func_loop`` — one compile per config; per-call walltime
     over a tunneled chip was noisy enough to mis-pick by 40%).
+
+    Under the TPU *interpreter* (CPU tests) timings are meaningless and a
+    sweep costs minutes per signature, so the first viable candidate is
+    used directly unless ``sweep_in_interpret=True`` (set by the
+    autotuner's own unit tests).
 
     `dedupe`, if given, maps ``(cfg, *args, **kwargs)`` to the config's
     EFFECTIVE key for this problem (e.g. the clamped block shape); configs
@@ -145,6 +150,27 @@ def contextual_autotune(
             ):
                 _memory_cache[mem_key] = configs[entry["i"]]
                 return fn(*args, config=_memory_cache[mem_key], **kwargs)
+
+            interp = tdt_config.get_config().interpret
+            if interp is None:
+                interp = not tdt_config.on_tpu()
+            if interp and not sweep_in_interpret:
+                # interpreter timings are noise; pick the first candidate
+                # that runs (memory-cache only — never poison the disk
+                # cache real hardware will consult)
+                last_err: Exception | None = None
+                for cfg in configs:
+                    try:
+                        out = fn(*args, config=cfg, **kwargs)
+                    except Exception as e:
+                        last_err = e
+                        continue
+                    _memory_cache[mem_key] = cfg
+                    return out
+                raise RuntimeError(
+                    f"autotune({op_name}): every candidate config failed "
+                    f"under the interpreter"
+                ) from last_err
 
             times = [float("inf")] * len(configs)
             seen: dict[Any, int] = {}

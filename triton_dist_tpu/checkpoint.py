@@ -25,31 +25,66 @@ from typing import Any
 
 import jax
 
-__all__ = ["save", "restore", "latest_step", "wait_until_saved"]
+__all__ = [
+    "save", "restore", "latest_step", "wait_until_saved", "close",
+    "clear_cache",
+]
 
 _manager_cache: dict[str, Any] = {}
 
 
-def _manager(directory: str):
+def _manager(directory: str, *, max_to_keep: int | None = None):
+    """Cached orbax CheckpointManager per directory. The first call to a
+    directory fixes its retention (`max_to_keep`, default 3); a later call
+    with a DIFFERENT explicit value recreates the manager (closing the old
+    one) so retention changes take effect."""
     import orbax.checkpoint as ocp
 
     directory = os.path.abspath(directory)
-    if directory not in _manager_cache:
-        _manager_cache[directory] = ocp.CheckpointManager(
-            directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=3, create=True, enable_async_checkpointing=True
-            ),
-        )
-    return _manager_cache[directory]
+    hit = _manager_cache.get(directory)
+    if hit is not None:
+        mgr, kept = hit
+        if max_to_keep is None or kept == max_to_keep:
+            return mgr
+        mgr.close()
+        del _manager_cache[directory]
+    keep = 3 if max_to_keep is None else max_to_keep
+    mgr = ocp.CheckpointManager(
+        directory,
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=keep, create=True, enable_async_checkpointing=True
+        ),
+    )
+    _manager_cache[directory] = (mgr, keep)
+    return mgr
 
 
-def save(directory: str, step: int, tree: Any, *, wait: bool = False) -> None:
+def close(directory: str) -> None:
+    """Flush pending async saves and release `directory`'s manager (orbax
+    managers hold background threads; long-lived processes checkpointing to
+    many directories should close ones they are done with)."""
+    directory = os.path.abspath(directory)
+    hit = _manager_cache.pop(directory, None)
+    if hit is not None:
+        hit[0].close()
+
+
+def clear_cache() -> None:
+    """Close every cached manager (see :func:`close`)."""
+    for directory in list(_manager_cache):
+        close(directory)
+
+
+def save(
+    directory: str, step: int, tree: Any, *, wait: bool = False,
+    max_to_keep: int | None = None,
+) -> None:
     """Save a (sharded) pytree as checkpoint `step`. All processes must
-    call this collectively. ``wait=True`` blocks until durable."""
+    call this collectively. ``wait=True`` blocks until durable;
+    `max_to_keep` sets the directory's retention (default 3)."""
     import orbax.checkpoint as ocp
 
-    mgr = _manager(directory)
+    mgr = _manager(directory, max_to_keep=max_to_keep)
     mgr.save(step, args=ocp.args.StandardSave(tree))
     if wait:
         mgr.wait_until_finished()

@@ -29,6 +29,13 @@ class Config:
     detect_races: bool = False
     # 'on_wait' mimics real DMA async semantics; 'eager' is faster.
     dma_execution_mode: str = "on_wait"
+    # Fail loudly when EP dispatch drops assignments to slab overflow
+    # (≙ the reference's assert, low_latency_all_to_all.py:212): prints a
+    # host-side diagnostic AND NaN-poisons the layer output so an
+    # undersized max_m can never silently zero expert contributions in a
+    # training run (see also layers.ep_moe_mlp.assert_no_overflow for a
+    # host-side hard stop on the fetched counter).
+    debug_ep_overflow: bool = False
     # Print autotuner decisions.
     verbose_autotune: bool = bool(int(os.environ.get("TDT_VERBOSE_AUTOTUNE", "0")))
 

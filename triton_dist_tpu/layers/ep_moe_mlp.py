@@ -25,9 +25,41 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
+from triton_dist_tpu import config as tdt_config
 from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer, HierEPAll2AllLayer
 from triton_dist_tpu.ops.grads import group_gemm_grad
 from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+
+
+def _overflow_message(ov: int) -> str:
+    return (
+        f"EP dispatch dropped {ov} assignments to slab overflow — "
+        f"max_m/max_m2 too small (≙ the reference's assert, "
+        f"low_latency_all_to_all.py:212). Raise the capacity or route "
+        f"fewer tokens per rank."
+    )
+
+
+def _overflow_guard(overflow) -> None:
+    # Diagnose only — raising from inside a debug callback while the
+    # shard_map collective is in flight can wedge the runtime instead of
+    # failing it (observed intermittent XLA:CPU hangs). The guaranteed-loud
+    # failure is the NaN poison applied by the caller; use
+    # :func:`assert_no_overflow` for a host-side hard stop after the step.
+    ov = int(overflow)
+    if ov > 0:
+        import sys
+
+        print(f"ERROR: {_overflow_message(ov)}", file=sys.stderr, flush=True)
+
+
+def assert_no_overflow(overflow) -> None:
+    """Host-side hard stop on a fetched overflow counter (call OUTSIDE jit,
+    e.g. on the aux output of ``EPMoEMLP(..., with_overflow=True)`` after
+    the step completes)."""
+    ov = int(overflow)
+    if ov > 0:
+        raise RuntimeError(_overflow_message(ov))
 
 
 @dataclasses.dataclass
@@ -122,4 +154,12 @@ class EPMoEMLP:
         else:
             out = layer.combine(y, info, topk_weights, m_loc)
         out = out.astype(x.dtype)
+        if tdt_config.get_config().debug_ep_overflow:
+            # loud failure on dropped assignments: a stderr diagnostic plus
+            # NaN poison — any loss downstream goes NaN instead of silently
+            # wrong (the callback only prints; see _overflow_guard)
+            jax.debug.callback(_overflow_guard, info.overflow)
+            out = jnp.where(
+                info.overflow > 0, jnp.full_like(out, jnp.nan), out
+            )
         return (out, info.overflow) if with_overflow else out

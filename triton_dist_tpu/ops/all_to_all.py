@@ -31,6 +31,7 @@ reduction after combine lives in the MoE layer, not here.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any
 
@@ -40,16 +41,30 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
 from triton_dist_tpu.shmem import device as shmem
+
+
+@dataclasses.dataclass(frozen=True)
+class A2AConfig:
+    """``puts_per_slab`` splits each peer's data slab into that many
+    row-chunk puts: more descriptors, but chunks can ride different ICI
+    routes/engines concurrently and the receiver's first rows land sooner.
+    1 (one put per peer) is the latency-optimal default for the small slabs
+    of the MoE dispatch headline shape; the autotuner sweeps it."""
+
+    puts_per_slab: int = 1
 
 
 def _a2a_kernel(
     send_ref, splits_ref, recv_ref, rsplits_ref, copy_sems,
     data_send, data_recv, spl_send, spl_recv,
-    *, axis: str, n: int,
+    *, axis: str, n: int, chunks: int,
 ):
     me = shmem.my_pe(axis)
+    max_m = send_ref.shape[1]
+    rows = max_m // chunks
     # Own slab moves locally; both copies ride the local DMA engines while
     # the remote puts below are in flight.
     c1 = pltpu.make_async_copy(send_ref.at[me], recv_ref.at[me], copy_sems.at[0])
@@ -67,12 +82,14 @@ def _a2a_kernel(
                 spl_send.at[d - 1], spl_recv.at[d - 1],
             )
         )
-        descs.append(
-            shmem.putmem_nbi_block(
-                recv_ref.at[me], send_ref.at[dst], dst, axis,
-                data_send.at[d - 1], data_recv.at[d - 1],
+        for k in range(chunks):
+            sl = pl.ds(k * rows, rows if k < chunks - 1 else max_m - k * rows)
+            descs.append(
+                shmem.putmem_nbi_block(
+                    recv_ref.at[me, sl], send_ref.at[dst, sl], dst, axis,
+                    data_send.at[d - 1, k], data_recv.at[d - 1, k],
+                )
             )
-        )
     c1.wait()
     c2.wait()
     # Symmetric SPMD: each descriptor's recv side counts the equal-sized
@@ -88,6 +105,7 @@ def fast_all_to_all(
     *,
     meta: jax.Array | None = None,
     axis: str = "tp",
+    config: A2AConfig | None = None,
     interpret: Any = None,
 ) -> tuple[jax.Array, jax.Array] | tuple[jax.Array, jax.Array, jax.Array]:
     """Exchange padded token slabs between all PEs of `axis` (call inside
@@ -107,9 +125,11 @@ def fast_all_to_all(
     holds the tokens PE ``j`` sent here (``recv_splits[j]`` valid rows).
     Golden: ``jax.lax.all_to_all`` over the slab dim.
     """
+    cfg = config or A2AConfig()
     n = int(jax.lax.axis_size(axis))
     n_slabs, max_m, hidden = tokens.shape
     assert n_slabs == n, (n_slabs, n)
+    chunks = max(1, min(cfg.puts_per_slab, max_m))
     splits = splits.reshape(n, 1).astype(jnp.int32)
     payload = splits
     if meta is not None:
@@ -124,7 +144,7 @@ def fast_all_to_all(
         return tokens, splits.reshape(n), meta
     n_steps = n - 1
     recv, rpayload = dist_pallas_call(
-        functools.partial(_a2a_kernel, axis=axis, n=n),
+        functools.partial(_a2a_kernel, axis=axis, n=n, chunks=chunks),
         name="fast_all_to_all",
         out_shape=(
             jax.ShapeDtypeStruct((n, max_m, hidden), tokens.dtype),
@@ -140,8 +160,8 @@ def fast_all_to_all(
         ),
         scratch_shapes=[
             pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((n_steps,)),
-            pltpu.SemaphoreType.DMA((n_steps,)),
+            pltpu.SemaphoreType.DMA((n_steps, chunks)),
+            pltpu.SemaphoreType.DMA((n_steps, chunks)),
             pltpu.SemaphoreType.DMA((n_steps,)),
             pltpu.SemaphoreType.DMA((n_steps,)),
         ],
@@ -180,6 +200,7 @@ def fast_all_to_all_op(
     mesh: Mesh,
     *,
     axis: str = "tp",
+    config: A2AConfig | None = None,
     interpret: Any = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Host-level entry: `tokens` ``[n, n, max_m, hidden]`` (dim 0 = owning
@@ -188,7 +209,9 @@ def fast_all_to_all_op(
     if mesh.shape[axis] == 1:
         # world-1 all-to-all IS the identity: no kernel, no copy
         return tokens, splits.astype(jnp.int32)
-    fn = functools.partial(fast_all_to_all, axis=axis, interpret=interpret)
+    fn = functools.partial(
+        fast_all_to_all, axis=axis, config=config, interpret=interpret
+    )
 
     def wrapped(t, s):
         r, rs = fn(t[0], s[0])
@@ -198,5 +221,12 @@ def fast_all_to_all_op(
         wrapped, mesh,
         (P(axis, None, None, None), P(axis, None)),
         (P(axis, None, None, None), P(axis, None)),
-        key=("fast_all_to_all", axis, str(interpret)),
+        key=("fast_all_to_all", axis, config, str(interpret)),
     )(tokens, splits.astype(jnp.int32))
+
+
+A2A_TUNE_SPACE = (A2AConfig(1), A2AConfig(2), A2AConfig(4))
+
+fast_all_to_all_op = contextual_autotune(A2A_TUNE_SPACE, name="fast_all_to_all")(
+    fast_all_to_all_op
+)

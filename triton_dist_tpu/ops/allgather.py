@@ -42,11 +42,15 @@ def get_auto_all_gather_method(
     reference allgather.py:44-69, which keys on NVLink-fullmesh/NUMA).
     `devices` — the mesh-axis devices (``topology.axis_devices``) — enables
     physical wrap detection from their torus coords."""
+    from triton_dist_tpu.perf_model import direct_vs_ring_crossover_bytes
+
     if n_pes <= 2:
         return "ring_1d"
-    if chunk_bytes <= 256 * 1024 or not topology.has_wraparound(n_pes, devices):
-        # Small latency-bound sizes, or a line topology where a ring's wrap
-        # hop would route the long way: direct hardware-routed puts win.
+    if not topology.has_wraparound(n_pes, devices):
+        # a line topology: a ring's wrap hop would route the long way
+        return "full_mesh_push"
+    # model-driven crossover (ring SOL vs routed-put SOL; tracks ICI BW)
+    if chunk_bytes <= direct_vs_ring_crossover_bytes(n_pes):
         return "full_mesh_push"
     return "ring_bidir"
 

@@ -255,7 +255,10 @@ def flash_decode_quant(
 ):
     """GQA batch decode over an int8-quantized KV cache (from
     :func:`quantize_kv`) — same contract as :func:`flash_decode`, half the
-    HBM traffic. Composes with the SP merge via ``return_lse``."""
+    HBM traffic, with one precision delta: `q` is cast to bfloat16 for the
+    MXU fast path (the int8 cache upcasts to bf16 in-kernel), so f32
+    queries lose precision here that the plain path would keep. Composes
+    with the SP merge via ``return_lse``."""
     return _decode_call(
         q, k_q, v_q, (k_scale, v_scale), kv_lens, config=config,
         return_lse=return_lse, interpret=interpret,

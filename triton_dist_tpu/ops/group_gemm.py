@@ -162,6 +162,11 @@ def group_gemm_dw(
     cfg = config or GroupGemmConfig()
     t_pad, k_dim = a_sorted.shape
     n_dim = g_sorted.shape[1]
+    # enforce the id-range invariant here rather than by caller convention:
+    # an out-of-range id would land its block's AᵀG in expert n_exp-1's dW
+    # (the output index_map clamps) while the zero-row mask below counted it
+    # as occupying a DIFFERENT bucket — clamping first keeps both consistent
+    expert_ids = jnp.clip(expert_ids, 0, n_exp - 1)
     n_blocks = expert_ids.shape[0]
     assert t_pad % n_blocks == 0 and t_pad // n_blocks == cfg.block_m, (
         t_pad, n_blocks, cfg.block_m,

@@ -33,6 +33,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
 from triton_dist_tpu.parallel import topology
 from triton_dist_tpu.shmem import device as shmem
@@ -42,20 +43,25 @@ from triton_dist_tpu.utils import pick_block
 @dataclasses.dataclass(frozen=True)
 class ReduceScatterConfig:
     """Tunables (≙ the tile knobs of ``ReduceScatter2DContext``; stream and
-    buffer plumbing is subsumed by the fused kernels)."""
+    buffer plumbing is subsumed by the fused kernels). ``method`` pins the
+    kernel family (None = honor the call's ``method=`` argument) so the
+    autotuner can sweep method × tiles in one space."""
 
     block_m: int = 256
     block_n: int = 1024
+    method: str | None = None
 
 
 def get_auto_reduce_scatter_method(
     chunk_bytes: int, n_pes: int, devices: Any = None
 ) -> str:
-    if (
-        n_pes <= 2
-        or chunk_bytes <= 256 * 1024
-        or not topology.has_wraparound(n_pes, devices)
-    ):
+    from triton_dist_tpu.perf_model import direct_vs_ring_crossover_bytes
+
+    if n_pes <= 2 or not topology.has_wraparound(n_pes, devices):
+        return "scatter_reduce"
+    # model-driven crossover (same wire shape as the allgather choice:
+    # direct routed puts vs neighbor ring; tracks ICI BW)
+    if chunk_bytes <= direct_vs_ring_crossover_bytes(n_pes):
         return "scatter_reduce"
     return "ring"
 
@@ -242,6 +248,8 @@ def reduce_scatter(
     m_total, n_dim = x.shape
     assert m_total % n == 0, (m_total, n)
     m_loc = m_total // n
+    if cfg.method is not None and method == "auto":
+        method = cfg.method
     if method == "auto":
         method = get_auto_reduce_scatter_method(
             m_loc * n_dim * x.dtype.itemsize, n, devices
@@ -338,3 +346,19 @@ def reduce_scatter_op(
         wrapped, mesh, (in_spec,), out_spec,
         key=("reduce_scatter", axis, method, config, str(interpret)),
     )(x)
+
+
+# method × tile sweep (≙ the reference autotuning its RS contexts); configs
+# whose method is invalid for the problem (e.g. "ring" on a 2-PE axis still
+# runs; no invalid combos here) simply lose the timing race.
+RS_TUNE_SPACE = (
+    ReduceScatterConfig(256, 1024, "scatter_reduce"),
+    ReduceScatterConfig(512, 2048, "scatter_reduce"),
+    ReduceScatterConfig(256, 1024, "ring"),
+    ReduceScatterConfig(512, 2048, "ring"),
+    ReduceScatterConfig(128, 512, "scatter_reduce"),
+)
+
+reduce_scatter_op = contextual_autotune(RS_TUNE_SPACE, name="reduce_scatter")(
+    reduce_scatter_op
+)

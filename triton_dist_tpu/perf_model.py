@@ -102,6 +102,68 @@ def estimate_all_to_all_time_ms(
     return inject / (2 * spec.ici_gbps_per_link * 1e9) * 1e3
 
 
+# Per-hop ICI latency ballpark (public "How to Scale Your Model" order of
+# magnitude; exact value only shifts the crossover linearly).
+ICI_HOP_LATENCY_MS = 1e-3
+
+
+def estimate_ag_ring_time_ms(
+    chunk_bytes: int, n_pes: int, spec: ChipSpec | None = None
+) -> float:
+    """Store-and-forward neighbor ring: (n-1) dependent hops, each paying
+    per-hop latency plus the chunk's wire time over the bidirectional link
+    pair."""
+    spec = spec or detect_chip()
+    if n_pes <= 1:
+        return 0.0
+    per_hop = ICI_HOP_LATENCY_MS + chunk_bytes / (2 * spec.ici_gbps_per_link * 1e9) * 1e3
+    return (n_pes - 1) * per_hop
+
+
+def _mean_ring_distance(n_pes: int) -> float:
+    """Exact mean shortest-path hops to the n-1 peers on a wrapped 1-D
+    axis: mean over d in 1..n-1 of min(d, n-d)."""
+    return sum(min(d, n_pes - d) for d in range(1, n_pes)) / (n_pes - 1)
+
+
+def estimate_ag_push_time_ms(
+    chunk_bytes: int, n_pes: int, spec: ChipSpec | None = None
+) -> float:
+    """Direct hardware-routed puts to every peer: one latency stage, but
+    multi-hop packets share links — per-PE injected bytes are inflated by
+    the mean route length across the 2 engaged links."""
+    spec = spec or detect_chip()
+    if n_pes <= 1:
+        return 0.0
+    avg_dist = _mean_ring_distance(n_pes)
+    wire = chunk_bytes * (n_pes - 1) * avg_dist / (2 * spec.ici_gbps_per_link * 1e9) * 1e3
+    return ICI_HOP_LATENCY_MS + wire
+
+
+def direct_vs_ring_crossover_bytes(
+    n_pes: int, spec: ChipSpec | None = None
+) -> float:
+    """Chunk size below which direct full-mesh puts beat the neighbor ring
+    (allgather and reduce-scatter share this shape: same wire pattern,
+    reversed direction). Solves ``estimate_ag_ring_time_ms ==
+    estimate_ag_push_time_ms`` for the chunk size — the model-driven
+    replacement for a fixed byte threshold (≙ the reference steering
+    resources from its SOL models, gemm_perf_model.py:233,
+    comm_perf_model.py:91). Scales linearly with ICI bandwidth: faster
+    links amortize the ring's latency chain at larger payloads."""
+    spec = spec or detect_chip()
+    if n_pes <= 2:
+        return float("inf")
+    # (n-2)*lat == chunk*(n-1)/(2*ici) * (avg_dist - 1)  [wire-time delta]
+    congestion = _mean_ring_distance(n_pes) - 1.0
+    if congestion <= 0:
+        # all peers one hop away (n == 3 wrapped): routed puts never
+        # congest past a ring
+        return float("inf")
+    ici = 2 * spec.ici_gbps_per_link * 1e9
+    return (n_pes - 2) * ICI_HOP_LATENCY_MS * 1e-3 * ici / ((n_pes - 1) * congestion)
+
+
 def overlap_efficiency(t_fused_ms: float, t_compute_ms: float, t_comm_ms: float) -> float:
     """How much of the comm time the fused kernel hid:
     1.0 = perfect overlap (fused == max(comp, comm)), 0.0 = fully serial.
