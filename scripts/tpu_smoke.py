@@ -21,6 +21,17 @@ import numpy as np
 from jax.sharding import Mesh
 
 
+_JIT_CACHE: dict = {}
+
+
+def _cached_jit(key, build):
+    """jax.jit caches by callable identity; inline lambdas rebuilt every
+    pass would recompile 20x on a real chip. Build once, reuse."""
+    if key not in _JIT_CACHE:
+        _JIT_CACHE[key] = build()
+    return _JIT_CACHE[key]
+
+
 def _poison_arena(interp: bool) -> None:
     """Dirty the allocator arena between passes: allocate, NaN-fill and drop
     a large buffer so freed workspace memory a kernel might wrongly re-read
@@ -101,11 +112,23 @@ def run_pass(key, interp, it, worst, fails):
     oks.append(check("matmul", matmul(a, b), ref))
     oks.append(check("ag_gemm", ag_gemm_op(a, b, mesh, config=AGGemmConfig(256, 256, 256)), ref))
     oks.append(check("gemm_rs", gemm_rs_op(a, b, mesh, config=GemmRSConfig(256, 256, 256)), ref))
+    from triton_dist_tpu.ops.all_to_all import A2AConfig
+    from triton_dist_tpu.ops.reduce_scatter import ReduceScatterConfig
+
     oks.append(check("all_gather", all_gather_op(a, mesh), a.astype(jnp.float32)))
-    oks.append(check("reduce_scatter", reduce_scatter_op(a[None], mesh), a.astype(jnp.float32)))
+    # explicit configs keep the smoke deterministic and sweep-free (the op
+    # entries are autotuned; an unpinned call would run a timing sweep and
+    # write .autotune_cache from whatever cwd the smoke runs in)
+    oks.append(check(
+        "reduce_scatter",
+        reduce_scatter_op(a[None], mesh, config=ReduceScatterConfig(256, 1024)),
+        a.astype(jnp.float32),
+    ))
 
     t = jax.random.normal(key, (1, 1, 64, 256), jnp.bfloat16)
-    recv, _ = fast_all_to_all_op(t, jnp.full((1, 1), 64, jnp.int32), mesh)
+    recv, _ = fast_all_to_all_op(
+        t, jnp.full((1, 1), 64, jnp.int32), mesh, config=A2AConfig(1)
+    )
     oks.append(check("fast_all_to_all", recv, t.astype(jnp.float32)))
 
     bq, h_kv, g, d = 2, 2, 4, 128
@@ -175,30 +198,26 @@ def run_pass(key, interp, it, worst, fails):
         moe_topk,
     )
 
-    moe_fused = jax.jit(
-        jax.shard_map(
-            lambda x, u, d, i, t: tp_moe_mlp_grad(
-                x, u, d, i, t, "tp", jax.nn.gelu,
-                GroupGemmConfig(bm, 128, 128), None, True,
-            ),
-            mesh=mesh,
-            in_specs=(_P(None, None), _P(None, None, None),
-                      _P(None, None, None), _P(None, None), _P(None, None)),
-            out_specs=_P(None, None), check_vma=False,
-        )
-    )(xm, wu, wd, mids, mtw)
-    moe_seq = jax.jit(
-        jax.shard_map(
-            lambda x, u, d, i, t: tp_moe_mlp_grad(
-                x, u, d, i, t, "tp", jax.nn.gelu,
-                GroupGemmConfig(bm, 128, 128), None, False,
-            ),
-            mesh=mesh,
-            in_specs=(_P(None, None), _P(None, None, None),
-                      _P(None, None, None), _P(None, None), _P(None, None)),
-            out_specs=_P(None, None), check_vma=False,
-        )
-    )(xm, wu, wd, mids, mtw)
+    def _build_moe(overlap):
+        def build():
+            return jax.jit(
+                jax.shard_map(
+                    lambda x, u, d, i, t: tp_moe_mlp_grad(
+                        x, u, d, i, t, "tp", jax.nn.gelu,
+                        GroupGemmConfig(bm, 128, 128), None, overlap,
+                    ),
+                    mesh=mesh,
+                    in_specs=(_P(None, None), _P(None, None, None),
+                              _P(None, None, None), _P(None, None),
+                              _P(None, None)),
+                    out_specs=_P(None, None), check_vma=False,
+                )
+            )
+
+        return _cached_jit(("moe", overlap), build)
+
+    moe_fused = _build_moe(True)(xm, wu, wd, mids, mtw)
+    moe_seq = _build_moe(False)(xm, wu, wd, mids, mtw)
     oks.append(check(
         "moe_overlap_pair", moe_fused, jnp.asarray(moe_seq, jnp.float32), tol=0.5
     ))
@@ -243,23 +262,29 @@ def run_pass(key, interp, it, worst, fails):
 
     from triton_dist_tpu.ops.ulysses import ulysses_attention, usp_attention
 
-    uly = jax.jit(
-        jax.shard_map(
-            lambda q, k, v: ulysses_attention(q, k, v, "tp", True),
-            mesh=mesh, in_specs=(P(None, None, "tp", None),) * 3,
-            out_specs=P(None, None, "tp", None), check_vma=False,
-        )
+    uly = _cached_jit(
+        "ulysses",
+        lambda: jax.jit(
+            jax.shard_map(
+                lambda q, k, v: ulysses_attention(q, k, v, "tp", True),
+                mesh=mesh, in_specs=(P(None, None, "tp", None),) * 3,
+                out_specs=P(None, None, "tp", None), check_vma=False,
+            )
+        ),
     )(qr, kr, vr)
     oks.append(check("ulysses_attention", uly, ring_ref, tol=2e-2))
     mesh2 = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("sp", "tp2"))
-    usp = jax.jit(
-        jax.shard_map(
-            lambda q, k, v: usp_attention(
-                q, k, v, outer="sp", inner="tp2", ring_config=rcfg
-            ),
-            mesh=mesh2, in_specs=(P(None, None, ("sp", "tp2"), None),) * 3,
-            out_specs=P(None, None, ("sp", "tp2"), None), check_vma=False,
-        )
+    usp = _cached_jit(
+        "usp",
+        lambda: jax.jit(
+            jax.shard_map(
+                lambda q, k, v: usp_attention(
+                    q, k, v, outer="sp", inner="tp2", ring_config=rcfg
+                ),
+                mesh=mesh2, in_specs=(P(None, None, ("sp", "tp2"), None),) * 3,
+                out_specs=P(None, None, ("sp", "tp2"), None), check_vma=False,
+            )
+        ),
     )(qr, kr, vr)
     oks.append(check("usp_attention", usp, ring_ref, tol=2e-2))
 

@@ -9,15 +9,24 @@ barrier only protects workspace liveness before remote WRITES land.
 
 This test launches the same kernel family back-to-back with heavy per-PE
 timing skew that FLIPS between the launches (PE 0 slowest in launch 1,
-fastest in launch 2), the worst case for cross-launch signal bleed, under
-the interpreter's happens-before race detector. Both launches must produce
-exact results and the detector must stay quiet.
+fastest in launch 2) under the happens-before race detector, and checks
+exact results for every launch.
 
-Result (documented per VERDICT): the contract HOLDS — consuming waits keep
-the per-round accounting balanced across launches (a bled signal from
-launch k+1 round r is repaid by the matching launch-k signal arriving
-later; total credits per (PE, partner) pair are conserved), and no data
-read is ordered on the barrier alone."""
+Scope of the evidence (documented per VERDICT r2 #10): the interpreter
+initializes FRESH shared memory and semaphores per pallas call and joins
+all simulated devices at a cleanup barrier when each call ends
+(interpret_pallas_call.py _initialize_shared_memory / clean_up_barrier),
+so the cross-launch signal-bleed scenario is structurally unreproducible
+here — what this harness proves is per-launch correctness under worst-case
+skew plus detector silence WITHIN each launch. On real hardware the
+contract rests on (a) XLA's per-device program-order execution of
+side-effecting kernels and (b) Mosaic serializing collective kernels that
+share a collective_id — the same contract the official Pallas distributed
+kernels assume — and on the analytical argument that consuming waits keep
+per-(PE, partner) signal credits conserved across launches. The residual
+risk is documented in ``shmem/device.py`` ``barrier_all``; real-multi-chip
+stress (scripts/tpu_smoke.py discipline on a pod) is the remaining
+validation step when hardware is available."""
 
 import functools
 
@@ -56,7 +65,7 @@ def _skewed_ring_kernel(x_ref, o_ref, acc_ref, send_sem, recv_sem, *, n, flip):
 
 
 @pytest.mark.parametrize("rounds", [3])
-def test_barrier_aliasing_back_to_back_skewed(mesh4, rounds):
+def test_barrier_aliasing_back_to_back_skewed(mesh4, rounds, capfd):
     """`rounds` back-to-back launches of the same collective-id family with
     flipping skew; every launch's output must be the left neighbor's data."""
     tdt_config.update(detect_races=True)
@@ -106,9 +115,13 @@ def test_barrier_aliasing_back_to_back_skewed(mesh4, rounds):
             ).reshape(n * m, 32)
             np.testing.assert_array_equal(np.asarray(out), want, err_msg=f"launch {i}")
 
+        # print-capture covers every launch (the interpreter re-creates its
+        # race state per pallas call; see tests/test_races.py)
         from jax._src.pallas.mosaic.interpret import interpret_pallas_call as ipc
 
         state = getattr(ipc, "races", None)
         assert state is None or not state.races_found
+        out_s, err_s = capfd.readouterr()
+        assert "RACE DETECTED" not in out_s + err_s
     finally:
         tdt_config.update(detect_races=False)

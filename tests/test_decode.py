@@ -14,6 +14,10 @@ from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig
 
 from tests.test_models import _ref_forward
 
+import pytest
+
+pytestmark = pytest.mark.slow  # second tier: excluded from the quick CI tier
+
 
 def test_generate_matches_full_forward(mesh4):
     b, prompt_len, n_steps, s_max = 2, 4, 4, 16

@@ -10,6 +10,10 @@ from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig
 from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig
 from triton_dist_tpu.ops.grads import ag_gemm_grad, gemm_rs_grad
 
+import pytest
+
+pytestmark = pytest.mark.slow  # second tier: excluded from the quick CI tier
+
 AG_CFG = AGGemmConfig(8, 64, 32)
 RS_CFG = GemmRSConfig(8, 64, 32)
 

@@ -5,6 +5,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # second tier: excluded from the quick CI tier
 from jax.sharding import PartitionSpec as P
 
 from triton_dist_tpu.layers import (

@@ -125,7 +125,15 @@ def test_two_process_bootstrap_op_tune_checkpoint(tmp_path):
                 text=True,
             )
         )
-    outs = [p.communicate(timeout=600) for p in procs]
+    try:
+        outs = [p.communicate(timeout=600) for p in procs]
+    finally:
+        # a worker wedged in a distributed barrier must not outlive the
+        # test (orphans would hold the coordinator port and spin forever)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, f"rc={p.returncode}\n{out}\n{err[-4000:]}"
         for marker in ("MP_OP_OK", "MP_TUNE_OK", "MP_CKPT_OK"):

@@ -26,25 +26,31 @@ def _races_on():
     tdt_config.update(detect_races=False)
 
 
-def _assert_no_races():
+def _assert_no_races(capfd):
+    """The interpreter re-creates its RaceDetectionState per pallas call,
+    so the module-global `races` only reflects the LAST kernel — but every
+    detection also prints 'RACE DETECTED'. Checking the captured streams
+    covers ALL kernels a test ran."""
     from jax._src.pallas.mosaic.interpret import interpret_pallas_call as ipc
 
     state = getattr(ipc, "races", None)
     assert state is None or not state.races_found, "race detector fired"
+    out, err = capfd.readouterr()
+    assert "RACE DETECTED" not in out + err, (out + err)[-2000:]
 
 
 @pytest.mark.parametrize("method", ["ring_1d", "ring_bidir", "full_mesh_push"])
-def test_races_allgather(mesh4, method):
+def test_races_allgather(mesh4, method, capfd):
     from triton_dist_tpu.ops.allgather import all_gather_op
 
     x = jax.random.normal(jax.random.PRNGKey(0), (8, 64), jnp.float32)
     out = all_gather_op(x, mesh4, method=method)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
-    _assert_no_races()
+    _assert_no_races(capfd)
 
 
 @pytest.mark.parametrize("method", ["ring", "scatter_reduce"])
-def test_races_reduce_scatter(mesh4, method):
+def test_races_reduce_scatter(mesh4, method, capfd):
     from triton_dist_tpu.ops.reduce_scatter import (
         ReduceScatterConfig, reduce_scatter_op,
     )
@@ -56,10 +62,10 @@ def test_races_reduce_scatter(mesh4, method):
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(x).sum(0), rtol=1e-5, atol=1e-5
     )
-    _assert_no_races()
+    _assert_no_races(capfd)
 
 
-def test_races_ag_gemm(mesh4):
+def test_races_ag_gemm(mesh4, capfd):
     from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm_op
 
     a = jax.random.normal(jax.random.PRNGKey(2), (16, 32), jnp.float32)
@@ -70,10 +76,10 @@ def test_races_ag_gemm(mesh4):
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(a) @ np.asarray(b), rtol=1e-4, atol=1e-4
     )
-    _assert_no_races()
+    _assert_no_races(capfd)
 
 
-def test_races_gemm_rs(mesh4):
+def test_races_gemm_rs(mesh4, capfd):
     from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs_op
 
     a = jax.random.normal(jax.random.PRNGKey(4), (16, 32), jnp.float32)
@@ -84,10 +90,10 @@ def test_races_gemm_rs(mesh4):
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(a) @ np.asarray(b), rtol=1e-4, atol=2e-4
     )
-    _assert_no_races()
+    _assert_no_races(capfd)
 
 
-def test_races_all_to_all(mesh4):
+def test_races_all_to_all(mesh4, capfd):
     from triton_dist_tpu.ops.all_to_all import A2AConfig, fast_all_to_all_op
 
     tokens = jax.random.normal(jax.random.PRNGKey(6), (4, 4, 4, 32), jnp.float32)
@@ -98,10 +104,10 @@ def test_races_all_to_all(mesh4):
     np.testing.assert_array_equal(
         np.asarray(recv), np.asarray(tokens).transpose(1, 0, 2, 3)
     )
-    _assert_no_races()
+    _assert_no_races(capfd)
 
 
-def test_races_moe_overlap_pair(mesh4):
+def test_races_moe_overlap_pair(mesh4, capfd):
     """The two new single-kernel overlapped MoE ops — ring DMA + row-gather
     + MXU in one kernel, and grouped GEMM + combine + RS pushes in one
     kernel — under the race detector."""
@@ -132,10 +138,10 @@ def test_races_moe_overlap_pair(mesh4):
         )
     )(x, w_up, w_down, ids, tw.astype(jnp.float32))
     assert np.isfinite(np.asarray(out)).all()
-    _assert_no_races()
+    _assert_no_races(capfd)
 
 
-def test_races_ring_attention(mesh4):
+def test_races_ring_attention(mesh4, capfd):
     from triton_dist_tpu.ops.ring_attention import (
         RingAttentionConfig, ring_attention_op,
     )
@@ -148,4 +154,4 @@ def test_races_ring_attention(mesh4):
         q, k, v, mesh4, causal=True, config=RingAttentionConfig(4, 4)
     )
     assert np.isfinite(np.asarray(out)).all()
-    _assert_no_races()
+    _assert_no_races(capfd)

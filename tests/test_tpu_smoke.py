@@ -7,6 +7,10 @@ import os
 import subprocess
 import sys
 
+import pytest
+
+pytestmark = pytest.mark.slow  # second tier: excluded from the quick CI tier
+
 SCRIPT = os.path.join(
     os.path.dirname(__file__), "..", "scripts", "tpu_smoke.py"
 )

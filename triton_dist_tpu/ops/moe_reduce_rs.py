@@ -288,7 +288,9 @@ def moe_reduce_rs_overlap(
     # bn must keep the f32 partial accumulator, the staged pushes and the
     # streamed weight slabs inside a ~48 MiB budget for ANY m_out/f_loc
     per_bn = m_out * 4 + 2 * m_out * out_item + 2 * f_loc * jnp.dtype(w_down.dtype).itemsize
-    bn_budget = max(128, (48 * 2**20) // per_bn)
+    # floor to a power of two: pick_block shrinks by halving, so a
+    # non-power-of-two cap would walk past every divisor down to 1
+    bn_budget = 2 ** max(7, ((48 * 2**20) // per_bn).bit_length() - 1)
     bn = pick_block(h_dim, min(cfg.block_n, bn_budget))
     n_jn = h_dim // bn
     workspace = [
