@@ -21,17 +21,6 @@ import numpy as np
 from jax.sharding import Mesh
 
 
-_JIT_CACHE: dict = {}
-
-
-def _cached_jit(key, build):
-    """jax.jit caches by callable identity; inline lambdas rebuilt every
-    pass would recompile 20x on a real chip. Build once, reuse."""
-    if key not in _JIT_CACHE:
-        _JIT_CACHE[key] = build()
-    return _JIT_CACHE[key]
-
-
 def _poison_arena(interp: bool) -> None:
     """Dirty the allocator arena between passes: allocate, NaN-fill and drop
     a large buffer so freed workspace memory a kernel might wrongly re-read
@@ -198,26 +187,28 @@ def run_pass(key, interp, it, worst, fails):
         moe_topk,
     )
 
-    def _build_moe(overlap):
-        def build():
-            return jax.jit(
-                jax.shard_map(
-                    lambda x, u, d, i, t: tp_moe_mlp_grad(
-                        x, u, d, i, t, "tp", jax.nn.gelu,
-                        GroupGemmConfig(bm, 128, 128), None, overlap,
-                    ),
-                    mesh=mesh,
-                    in_specs=(_P(None, None), _P(None, None, None),
-                              _P(None, None, None), _P(None, None),
-                              _P(None, None)),
-                    out_specs=_P(None, None), check_vma=False,
-                )
+    from triton_dist_tpu.ops.common import jit_shard_map
+
+    def _moe_fn(overlap):
+        # jit_shard_map's keyed cache keeps one compile per variant across
+        # the >= 20 stress passes (jax.jit keys on callable identity, so a
+        # fresh lambda per pass would recompile every time)
+        def fn(x, u, d, i, t):
+            return tp_moe_mlp_grad(
+                x, u, d, i, t, "tp", jax.nn.gelu,
+                GroupGemmConfig(bm, 128, 128), None, overlap,
             )
 
-        return _cached_jit(("moe", overlap), build)
+        return jit_shard_map(
+            fn, mesh,
+            (_P(None, None), _P(None, None, None), _P(None, None, None),
+             _P(None, None), _P(None, None)),
+            _P(None, None),
+            key=("smoke_moe", overlap, bm),
+        )
 
-    moe_fused = _build_moe(True)(xm, wu, wd, mids, mtw)
-    moe_seq = _build_moe(False)(xm, wu, wd, mids, mtw)
+    moe_fused = _moe_fn(True)(xm, wu, wd, mids, mtw)
+    moe_seq = _moe_fn(False)(xm, wu, wd, mids, mtw)
     oks.append(check(
         "moe_overlap_pair", moe_fused, jnp.asarray(moe_seq, jnp.float32), tol=0.5
     ))
@@ -262,29 +253,20 @@ def run_pass(key, interp, it, worst, fails):
 
     from triton_dist_tpu.ops.ulysses import ulysses_attention, usp_attention
 
-    uly = _cached_jit(
-        "ulysses",
-        lambda: jax.jit(
-            jax.shard_map(
-                lambda q, k, v: ulysses_attention(q, k, v, "tp", True),
-                mesh=mesh, in_specs=(P(None, None, "tp", None),) * 3,
-                out_specs=P(None, None, "tp", None), check_vma=False,
-            )
-        ),
+    uly = jit_shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, "tp", True),
+        mesh, (P(None, None, "tp", None),) * 3, P(None, None, "tp", None),
+        key=("smoke_ulysses",),
     )(qr, kr, vr)
     oks.append(check("ulysses_attention", uly, ring_ref, tol=2e-2))
     mesh2 = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("sp", "tp2"))
-    usp = _cached_jit(
-        "usp",
-        lambda: jax.jit(
-            jax.shard_map(
-                lambda q, k, v: usp_attention(
-                    q, k, v, outer="sp", inner="tp2", ring_config=rcfg
-                ),
-                mesh=mesh2, in_specs=(P(None, None, ("sp", "tp2"), None),) * 3,
-                out_specs=P(None, None, ("sp", "tp2"), None), check_vma=False,
-            )
+    usp = jit_shard_map(
+        lambda q, k, v: usp_attention(
+            q, k, v, outer="sp", inner="tp2", ring_config=rcfg
         ),
+        mesh2, (P(None, None, ("sp", "tp2"), None),) * 3,
+        P(None, None, ("sp", "tp2"), None),
+        key=("smoke_usp", rcfg),
     )(qr, kr, vr)
     oks.append(check("usp_attention", usp, ring_ref, tol=2e-2))
 

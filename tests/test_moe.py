@@ -315,3 +315,30 @@ def test_ag_group_gemm_overlap_multigroup(mesh4):
             np.testing.assert_allclose(
                 out[c * t_pad_loc + r], want, rtol=1e-4, atol=1e-4
             )
+
+
+def test_overlap_vmem_budgets_at_bench_scale():
+    """Host-side shape derivations of the two overlapped kernels stay
+    inside VMEM at the driver's REAL bench shapes (n=1 and n=8; the bugs
+    this guards against — 142 MiB resident rows, a non-power-of-two cap
+    walking pick_block down to bn=1 — only trigger at those scales, which
+    interpreter tests can't reach)."""
+    from triton_dist_tpu.ops.allgather_group_gemm import gather_group_blocks_for
+    from triton_dist_tpu.ops.moe_reduce_rs import rs_block_n_for
+
+    bm = 128
+    for n in (1, 8):
+        m_loc, topk, n_exp, h_dim, f_dim = 8192 // n, 2, 8, 4096, 14336
+        t_pad_loc = ((m_loc * topk + n_exp * (bm - 1) + bm - 1) // bm) * bm
+        nb = t_pad_loc // bm
+        bpg = gather_group_blocks_for(nb, bm, h_dim, 2)
+        assert 1 <= bpg <= nb
+        assert 2 * bpg * bm * h_dim * 2 <= 16 * 2**20       # resident rows
+        bn = rs_block_n_for(h_dim, 1024, m_loc, f_dim // n, 2, 2)
+        assert bn >= 128 and h_dim % bn == 0
+        assert (
+            m_loc * bn * 4 + 2 * m_loc * bn * 2 + 2 * (f_dim // n) * bn * 2
+            <= 48 * 2**20
+        )
+    # a pathological budget/shape mix must never collapse below 128 lanes
+    assert rs_block_n_for(4096, 1024, 65536, 28672, 4, 4) >= 128

@@ -80,6 +80,16 @@ def ag_group_gemm(
     return h_sorted, alignment
 
 
+def gather_group_blocks_for(
+    nb: int, bm: int, k_dim: int, itemsize: int, budget: int = 16 * 2**20
+) -> int:
+    """Gather-group size for the overlapped kernel: the double-buffered
+    resident rows (2 × bpg × bm × K) must stay inside `budget` regardless
+    of t_pad_loc, so the kernel is VMEM-bounded for ANY shape (the n=1
+    bench shape would otherwise need ~142 MiB resident)."""
+    return max(1, min(nb, budget // (2 * bm * k_dim * itemsize)))
+
+
 def _ag_group_gemm_overlap_kernel(
     eid_ref, a_ref, b_ref, src_rows_ref,
     out_ref, ag_ref,
@@ -106,9 +116,13 @@ def _ag_group_gemm_overlap_kernel(
         a_ref, ag_ref.at[pl.ds(me * m_loc, m_loc)], copy_sem
     )
     local.start()
-    local.wait()
     if n > 1:
+        local.wait()
         shmem.barrier_all(axis)
+    # world-1: row gathers read the input directly, so the ag workspace
+    # copy (kept for the gather_output contract) runs concurrently with
+    # compute instead of gating it
+    gather_src = ag_ref if n > 1 else a_ref
     right = jax.lax.rem(me + 1, n)
 
     descs = []
@@ -145,7 +159,7 @@ def _ag_group_gemm_overlap_kernel(
             def _row(r, _):
                 src = ids_sm[base + r]
                 pltpu.make_async_copy(
-                    ag_ref.at[pl.ds(src, 1), :],
+                    gather_src.at[pl.ds(src, 1), :],
                     a_all.at[slot, pl.ds(r, 1), :],
                     gsems.at[slot],
                 ).start()
@@ -266,6 +280,8 @@ def _ag_group_gemm_overlap_kernel(
         _drain((total_iters - 1) % 2)
     if total_iters >= 2:
         _drain(total_iters % 2)
+    if n == 1:
+        local.wait()  # ag workspace copy ran concurrently with compute
     shmem.quiet(*descs)
 
 
@@ -304,12 +320,7 @@ def ag_group_gemm_overlap(
     bn = pick_block(n_loc, cfg.block_n)
     n_jn = n_loc // bn
     itemsize = jnp.dtype(a.dtype).itemsize
-    # gather-group size: the double-buffered resident rows must stay inside
-    # a ~16 MiB budget regardless of t_pad_loc (VMEM-bounded for any shape);
-    # `gather_group_blocks` overrides for tests of the multi-group path
-    bpg = gather_group_blocks or max(
-        1, min(nb, (16 * 2**20) // (2 * bm * k_dim * itemsize))
-    )
+    bpg = gather_group_blocks or gather_group_blocks_for(nb, bm, k_dim, itemsize)
     vmem_bytes = (
         2 * bpg * bm * k_dim * itemsize       # double-buffered gather groups
         + 2 * k_dim * bn * itemsize           # double-buffered weight slabs

@@ -268,12 +268,25 @@ def _tp_moe_forward_impl(x, w_up, w_down, topk_ids, topk_weights, axis,
             interpret=interpret,
         )
         act = activation(h_sorted.astype(jnp.float32)).astype(x.dtype)
-        dst_ids, w_rows = ranked_scatter_meta(ral, tw_full)
-        out = moe_reduce_rs_overlap(
-            act, w_down, ral.expert_ids, dst_ids, w_rows, axis=axis,
-            m_out=m_loc, config=cfg, out_dtype=x.dtype, interpret=interpret,
-        ).astype(x.dtype)
         alignment = ranked_global_view(ral, m_loc, topk)
+        if n == 1:
+            # world-1: there is no reduce-scatter to hide, so the
+            # one-hot-matmul combine would be pure MXU overhead — use the
+            # XLA scatter-add path (≙ ag_gemm's world-1 degeneration to a
+            # plain matmul). The fused up-proj still wins: it skips the
+            # materialized a_sorted.
+            out = moe_reduce_rs(
+                act, w_down, alignment, tw_full, axis=axis,
+                n_tokens=m_loc, config=cfg, out_dtype=x.dtype,
+                interpret=interpret,
+            ).astype(x.dtype)
+        else:
+            dst_ids, w_rows = ranked_scatter_meta(ral, tw_full)
+            out = moe_reduce_rs_overlap(
+                act, w_down, ral.expert_ids, dst_ids, w_rows, axis=axis,
+                m_out=m_loc, config=cfg, out_dtype=x.dtype,
+                interpret=interpret,
+            ).astype(x.dtype)
     else:
         h_sorted, alignment, a_full = ag_group_gemm(
             x, w_up, topk_ids, axis=axis, config=gg_config,

@@ -71,6 +71,21 @@ def moe_reduce_rs(
     )
 
 
+def rs_block_n_for(
+    h_dim: int, want_bn: int, m_out: int, f_loc: int,
+    out_itemsize: int, w_itemsize: int, budget: int = 48 * 2**20,
+) -> int:
+    """H-slab width for the overlapped kernel: the f32 partial accumulator
+    (m_out × bn), the staged pushes (2 × m_out × bn) and the streamed
+    weight slabs (2 × f_loc × bn) must fit `budget` for ANY m_out/f_loc.
+    The cap is floored to a power of two — ``pick_block`` shrinks by
+    halving, so a non-power-of-two cap would walk past every divisor of
+    h_dim down to bn=1."""
+    per_bn = m_out * 4 + 2 * m_out * out_itemsize + 2 * f_loc * w_itemsize
+    cap = 2 ** max(7, (budget // per_bn).bit_length() - 1)
+    return pick_block(h_dim, min(want_bn, cap))
+
+
 def _moe_reduce_rs_overlap_kernel(
     eid_ref, h_ref, w_ref, dst_ref, wrow_ref,
     out_ref, own_buf, landing,
@@ -284,14 +299,10 @@ def moe_reduce_rs_overlap(
     assert bm == cfg.block_m, (bm, cfg.block_m)
     h_dim = w_down.shape[2]
     itemsize = jnp.dtype(h_sorted.dtype).itemsize
-    out_item = jnp.dtype(out_dtype or h_sorted.dtype).itemsize
-    # bn must keep the f32 partial accumulator, the staged pushes and the
-    # streamed weight slabs inside a ~48 MiB budget for ANY m_out/f_loc
-    per_bn = m_out * 4 + 2 * m_out * out_item + 2 * f_loc * jnp.dtype(w_down.dtype).itemsize
-    # floor to a power of two: pick_block shrinks by halving, so a
-    # non-power-of-two cap would walk past every divisor down to 1
-    bn_budget = 2 ** max(7, ((48 * 2**20) // per_bn).bit_length() - 1)
-    bn = pick_block(h_dim, min(cfg.block_n, bn_budget))
+    bn = rs_block_n_for(
+        h_dim, cfg.block_n, m_out, f_loc,
+        jnp.dtype(out_dtype).itemsize, jnp.dtype(w_down.dtype).itemsize,
+    )
     n_jn = h_dim // bn
     workspace = [
         jax.ShapeDtypeStruct((m_out, h_dim), out_dtype),            # own_buf

@@ -83,10 +83,20 @@ def n_pes(axis: str | Sequence[str]) -> int:
     return int(math.prod(int(jax.lax.axis_size(a)) for a in axis))
 
 
-def pe_dev_id(axis: str, pe):
+def pe_dev_id(axis: str | Sequence[str], pe):
     """MESH device_id selecting index `pe` along `axis` (other axes stay at
-    this device's own coordinates)."""
-    return {axis: pe}
+    this device's own coordinates). A composite axis (tuple — ``my_pe``'s
+    flattened row-major numbering) is decomposed into per-axis
+    coordinates, the form Mosaic's device_id lowering is specified for."""
+    if isinstance(axis, str):
+        return {axis: pe}
+    out = {}
+    rem = pe
+    for a in reversed(list(axis)):
+        s = n_pes(a)
+        out[a] = jax.lax.rem(rem, s)
+        rem = jax.lax.div(rem, s)
+    return out
 
 
 # ---------------------------------------------------------------------------
