@@ -202,7 +202,6 @@ def bench_moe(mesh, n):
     scatter-add → reduce-scatter). vs_baseline > 1 means the fused pipeline
     (reference's defining MoE capability, allgather_group_gemm.py:420,
     moe_reduce_rs.py:882) beats the composition."""
-    from triton_dist_tpu.ops.grads import tp_moe_mlp_grad
     from triton_dist_tpu.ops.moe_utils import select_experts
 
     m_tot, h_dim, f_dim, n_exp, topk = 8192, 4096, 14336, 8, 2
@@ -226,20 +225,14 @@ def bench_moe(mesh, n):
     tw = jax.device_put(tw.astype(jnp.float32), NamedSharding(mesh, P("tp", None)))
     ids = jax.device_put(ids, NamedSharding(mesh, P("tp", None)))
 
-    from triton_dist_tpu.ops.common import jit_shard_map
+    from triton_dist_tpu.ops.grads import tp_moe_mlp_op
 
     def make(overlap):
-        def fn(x, wu, wd, ids, tw):
-            return tp_moe_mlp_grad(
-                x, wu, wd, ids, tw, "tp", jax.nn.gelu, None, None, overlap
-            )
-
-        return jit_shard_map(
-            fn, mesh,
-            (P("tp", None), P(None, None, "tp"), P(None, "tp", None),
-             P("tp", None), P("tp", None)),
-            P("tp", None),
-            key=("bench_moe", overlap),
+        # autotuned whole-pipeline entry: the first call sweeps the
+        # grouped-GEMM tiling per variant (fused and sequential each get
+        # their best config — the honest A/B)
+        return lambda x, wu, wd, ids, tw: tp_moe_mlp_op(
+            x, wu, wd, ids, tw, mesh, overlap=overlap
         )
 
     fused, seq = make(True), make(False)

@@ -342,3 +342,29 @@ def test_overlap_vmem_budgets_at_bench_scale():
         )
     # a pathological budget/shape mix must never collapse below 128 lanes
     assert rs_block_n_for(4096, 1024, 65536, 28672, 4, 4) >= 128
+
+
+def test_tp_moe_mlp_op_entry(mesh4):
+    """The autotuned host-level MoE MLP entry (what bench.py A/Bs): fused
+    and sequential variants agree through the public sharded interface."""
+    from triton_dist_tpu.ops.grads import tp_moe_mlp_op
+    from triton_dist_tpu.ops.moe_utils import select_experts
+
+    m_tot, h_dim, f_dim, n_exp, topk = 16, 32, 64, 3, 2
+    kx, ku, kd, kl = jax.random.split(jax.random.PRNGKey(23), 4)
+    x = jax.random.normal(kx, (m_tot, h_dim), jnp.float32)
+    w_up = jax.random.normal(ku, (n_exp, h_dim, f_dim)) / 8
+    w_down = jax.random.normal(kd, (n_exp, f_dim, h_dim)) / 8
+    tw, ids = select_experts(
+        jax.random.normal(kl, (m_tot, n_exp), jnp.float32), topk
+    )
+    cfg = GroupGemmConfig(4, 32, 32)
+    fused = tp_moe_mlp_op(
+        x, w_up, w_down, ids, tw, mesh4, config=cfg, overlap=True
+    )
+    seq = tp_moe_mlp_op(
+        x, w_up, w_down, ids, tw, mesh4, config=cfg, overlap=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(seq), rtol=1e-5, atol=1e-5
+    )

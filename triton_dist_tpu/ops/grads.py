@@ -27,8 +27,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm
 from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs
+from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
@@ -540,3 +542,59 @@ def _gg_bwd(config, out_dtype, interpret, assume_sorted, res, dout):
 
 
 group_gemm_grad.defvjp(_gg_fwd, _gg_bwd)
+
+
+def tp_moe_mlp_op(
+    x: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    topk_ids: jax.Array,
+    topk_weights: jax.Array,
+    mesh,
+    *,
+    axis: str = "tp",
+    config: Any = None,
+    overlap: bool = True,
+    activation=jax.nn.gelu,
+    interpret: Any = None,
+) -> jax.Array:
+    """Host-level entry for the full MoE TP MLP (≙ the reference's
+    ``ag_group_gemm`` + ``moe_reduce_rs`` test drivers composing both fused
+    pipelines): x ``[m_tot, H]`` token-sharded, w_up ``[E, H, F]``
+    N-sharded, w_down ``[E, F, H]`` F-sharded, routing token-sharded →
+    ``[m_tot, H]`` token-sharded. Autotuned over the grouped-GEMM tiling
+    (block_m is also the alignment block, so the sweep trades padding
+    against tile shape — the whole two-kernel pipeline is timed per
+    config, the reference's contextual-autotune discipline)."""
+    from jax.sharding import PartitionSpec as P
+
+    from triton_dist_tpu.ops.common import jit_shard_map
+
+    def fn(x, wu, wd, ids, tw):
+        return tp_moe_mlp_grad(
+            x, wu, wd, ids, tw.astype(jnp.float32), axis, activation,
+            config, interpret, overlap,
+        )
+
+    return jit_shard_map(
+        fn, mesh,
+        (P(axis, None), P(None, None, axis), P(None, axis, None),
+         P(axis, None), P(axis, None)),
+        P(axis, None),
+        key=("tp_moe_mlp", axis, config, overlap, activation, str(interpret)),
+    )(x, w_up, w_down, topk_ids.astype(jnp.int32), topk_weights)
+
+
+# Whole-pipeline sweep: both fused kernels (or both halves of the
+# sequential composition) are timed together per candidate.
+TP_MOE_TUNE_SPACE = (
+    GroupGemmConfig(128, 1024, 512),
+    GroupGemmConfig(128, 2048, 512),
+    GroupGemmConfig(128, 512, 512),
+    GroupGemmConfig(128, 1024, 1024),
+    GroupGemmConfig(256, 1024, 512),
+)
+
+tp_moe_mlp_op = contextual_autotune(TP_MOE_TUNE_SPACE, name="tp_moe_mlp")(
+    tp_moe_mlp_op
+)
