@@ -241,3 +241,41 @@ def test_autotuner_interpret_fast_path(tmp_path, monkeypatch):
     assert not (tmp_path / "toy3.json").exists()        # memory-cache only
     op(jnp.ones((2,)))
     assert calls == ["bad", 7, 7]                       # cached thereafter
+
+
+def test_autotuner_cached_or_first_policy(tmp_path, monkeypatch):
+    """TDT_AUTOTUNE_POLICY=cached_or_first (the bench driver's bounded-time
+    mode): a warm signature-level disk entry resolves the tuned winner;
+    anything else applies the FIRST candidate with no sweep."""
+    import json as _json
+
+    import triton_dist_tpu.autotuner as at
+
+    monkeypatch.setattr(at, "_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("TDT_AUTOTUNE_POLICY", "cached_or_first")
+    calls = []
+
+    @contextual_autotune(configs=[11, 22], name="toy4")
+    def op(x, *, config=None):
+        calls.append(config)
+        return x * config
+
+    x = jnp.ones((2,))
+    np.testing.assert_allclose(np.asarray(op(x)), 11.0)  # first candidate
+    assert calls == [11]                                  # no sweep ran
+
+    # a warm signature-keyed entry takes precedence over the policy
+    y = jnp.ones((3,))
+    sig = at._sig_key((y,), {})
+    (tmp_path / "toy5.json").write_text(
+        _json.dumps({sig: {"i": 1, "cfg": repr(22)}})
+    )
+    calls2 = []
+
+    @contextual_autotune(configs=[11, 22], name="toy5")
+    def op2(x, *, config=None):
+        calls2.append(config)
+        return x * config
+
+    np.testing.assert_allclose(np.asarray(op2(y)), 22.0)  # tuned winner
+    assert calls2 == [22]

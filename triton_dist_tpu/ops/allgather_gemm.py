@@ -305,12 +305,14 @@ def ag_gemm_op(
 # triton.Config spaces, allgather_gemm.py:386-404). Swept per input
 # signature the first time `ag_gemm_op` is called without an explicit
 # config; `pick_block` shrinks oversized tiles, so large-tile candidates
-# degrade gracefully on small shards. Winner measured on a real v5e at the
-# M=8192 LLaMA-8B bench shape: (1024, 2048, 1024) ≈ 199 TFLOPS vs XLA 188.
+# degrade gracefully on small shards. FIRST entry is the best-known config
+# (what TDT_AUTOTUNE_POLICY=cached_or_first applies without a sweep):
+# (1024, 2048, 1024), measured on a real v5e at the M=8192 LLaMA-8B bench
+# shape ≈ 199 TFLOPS vs XLA 188.
 AG_GEMM_TUNE_SPACE = (
+    AGGemmConfig(1024, 2048, 1024),
     AGGemmConfig(512, 2048, 512),
     AGGemmConfig(512, 2048, 1024),
-    AGGemmConfig(1024, 2048, 1024),
     AGGemmConfig(512, 2048, 2048),
     AGGemmConfig(512, 1024, 512),
     AGGemmConfig(256, 1024, 512),
