@@ -27,10 +27,12 @@ trials.
 Runs on however many devices are visible: 1 real chip (driver) exercises
 the world-1 MXU pipelines; multi-chip exercises the rings. Config policy:
 by default the autotuner runs under TDT_AUTOTUNE_POLICY=cached_or_first —
-a warm signature-level cache entry resolves the tuned winner, anything
-else takes each tune space's FIRST candidate (its best-known config) with
-no sweep, so a driver-window run can never spend its budget compiling
-candidates (the failure mode that zeroed round 2's perf evidence).
+a warm signature-level cache entry resolves the tuned winner (single-host;
+multi-host always walks the candidate order — per-host caches can
+diverge), anything else takes each tune space's first VIABLE candidate
+(spaces lead with their best-known config) with no sweep, so a
+driver-window run can never spend its budget compiling candidates (the
+failure mode that zeroed round 2's perf evidence).
 ``TDT_BENCH_TUNE=1 python bench.py`` runs the full sweeps instead and
 persists the winners to .autotune_cache/ for later driver runs (and the
 judge) to use.
@@ -39,6 +41,7 @@ judge) to use.
 from __future__ import annotations
 
 import json
+import os
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +49,21 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.utils import perf_func_loop
+
+# TDT_BENCH_SCALE=k divides every large dimension by k and shrinks the
+# timing loops — a PLUMBING dry-run mode (CPU/interpreter: validates every
+# metric's code path, emissions and exit codes before a driver window).
+# Timing output is meaningless at scale != 1.
+_SCALE = max(1, int(os.environ.get("TDT_BENCH_SCALE", "1")))
+
+
+def _sc(dim: int, quantum: int = 128) -> int:
+    """Scale a large dimension down, keeping it a multiple of `quantum`."""
+    return max(quantum, (dim // _SCALE) // quantum * quantum)
+
+
+def _it(iters: int) -> int:
+    return max(2, iters // _SCALE)
 
 
 def bench_pair(fused, base, args, iters=30, perturb_idx=0, fused_consume="first"):
@@ -82,7 +100,7 @@ def bench_gemm_rs(mesh, n):
     """Row-parallel down-proj shape: A [M, K_ffn/n], B [K_ffn/n, N=hidden]."""
     from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs_op
 
-    m_tot, k_tot, n_dim = 8192, 14336, 4096
+    m_tot, k_tot, n_dim = _sc(8192), _sc(14336), _sc(4096)
     k_tot = (k_tot // n) * n
     ka, kb = jax.random.split(jax.random.PRNGKey(1))
     a = jax.device_put(
@@ -114,7 +132,7 @@ def bench_gemm_rs(mesh, n):
     # n>1: the baseline ends in a reduce-scatter collective, so its
     # consumption sum cannot fuse — match the fused side's consumption
     t_f, t_b = bench_pair(
-        fused, unfused, (a, b), iters=40,
+        fused, unfused, (a, b), iters=_it(40),
         fused_consume="first" if n == 1 else "all",
     )
     tflops = 2.0 * m_tot * k_tot * n_dim / (t_f * 1e-3) / 1e12 / n
@@ -129,7 +147,9 @@ def bench_all_to_all(mesh, n):
     topk=8, hidden=7168): each rank exchanges topk*128/n ≈ per-peer slabs."""
     from triton_dist_tpu.ops.all_to_all import fast_all_to_all_op
 
-    hidden = 7168
+    # only hidden scales (scaling max_m too would shrink the payload by
+    # _SCALE^2 and lose the slab's row alignment)
+    hidden = _sc(7168)
     max_m = max(128 * 8 // n, 16)
     key = jax.random.PRNGKey(2)
     tokens = jax.device_put(
@@ -153,7 +173,7 @@ def bench_all_to_all(mesh, n):
     # Both sides consume="all": the baseline's sum cannot fuse into a
     # collective's epilogue (unlike the GEMM baselines), so a one-sided
     # full consumption would bill it an extra HBM pass the fused op skips.
-    iters = 2000 if n == 1 else 500
+    iters = _it(2000) if n == 1 else _it(500)
     t_f = perf_func_loop(fused, (tokens, splits), iters=iters, consume="all")
     t_b = perf_func_loop(xla_a2a, (tokens, splits), iters=iters, consume="all")
     emit(
@@ -167,7 +187,7 @@ def bench_flash_decode(mesh, n):
     KV sharded over the axis (SP decode ≙ reference flash-decode scaling)."""
     from triton_dist_tpu.ops.flash_decode import flash_decode_op
 
-    b, hq, h_kv, d, s = 8, 64, 8, 128, 8192
+    b, hq, h_kv, d, s = 8, 64, 8, 128, _sc(8192)
     kq, kk, kv = jax.random.split(jax.random.PRNGKey(3), 3)
     q = jax.random.normal(kq, (b, hq, d), jnp.bfloat16)
     k = jax.device_put(
@@ -194,7 +214,7 @@ def bench_flash_decode(mesh, n):
     out = fused(q, k, v)  # eager call: correctness + autotune before the loop
     ref = xla_attn(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2, rtol=2e-2)
-    t_f, t_b = bench_pair(fused, xla_attn, (q, k, v), iters=150)
+    t_f, t_b = bench_pair(fused, xla_attn, (q, k, v), iters=_it(150))
     emit(
         f"flash_decode_us_sp{n}_b{b}hq{hq}kv{h_kv}s{s}",
         t_f * 1e3, "us", t_b / t_f,
@@ -210,7 +230,7 @@ def bench_moe(mesh, n):
     moe_reduce_rs.py:882) beats the composition."""
     from triton_dist_tpu.ops.moe_utils import select_experts
 
-    m_tot, h_dim, f_dim, n_exp, topk = 8192, 4096, 14336, 8, 2
+    m_tot, h_dim, f_dim, n_exp, topk = _sc(8192), _sc(4096), _sc(14336), 8, 2
     f_dim = (f_dim // n) * n
     kx, ku, kd, kl = jax.random.split(jax.random.PRNGKey(5), 4)
     x = jax.device_put(
@@ -253,8 +273,8 @@ def bench_moe(mesh, n):
         np.asarray(out_f[:64], np.float32), np.asarray(out_s[:64], np.float32),
         atol=0.5, rtol=6e-2,
     )
-    t_f = perf_func_loop(fused, args, iters=20, consume="first")
-    t_s = perf_func_loop(seq, args, iters=20, consume="first")
+    t_f = perf_func_loop(fused, args, iters=_it(20), consume="first")
+    t_s = perf_func_loop(seq, args, iters=_it(20), consume="first")
     flops = 2 * 2 * m_tot * topk * h_dim * f_dim  # up + down, no padding
     tflops = flops / (t_f * 1e-3) / 1e12 / n
     emit(
@@ -271,7 +291,7 @@ def bench_ag_gemm(mesh, n):
     from triton_dist_tpu.ops.allgather_gemm import ag_gemm_op
     from triton_dist_tpu.perf_model import overlap_efficiency
 
-    m_tot, k_dim, n_tot = 8192, 4096, 14336
+    m_tot, k_dim, n_tot = _sc(8192), _sc(4096), _sc(14336)
     n_tot = (n_tot // n) * n
     ka, kb = jax.random.split(jax.random.PRNGKey(0))
     a = jax.device_put(
@@ -295,16 +315,16 @@ def bench_ag_gemm(mesh, n):
         np.asarray(out[:128], np.float32), np.asarray(ref[:128], np.float32),
         atol=2.0, rtol=2e-2,
     )
-    t_f, t_b = bench_pair(fused, unfused, (a, b), iters=40)
+    t_f, t_b = bench_pair(fused, unfused, (a, b), iters=_it(40))
 
     if n > 1:
         # measured overlap: comm-only (the allgather) and compute-only (the
         # same gathered-GEMM with comm stripped = XLA dot on gathered A)
         a_rep = jax.device_put(np.asarray(a), NamedSharding(mesh, P(None, None)))
         t_comm = perf_func_loop(
-            lambda a: all_gather_op(a, mesh), (a,), iters=40, consume="first"
+            lambda a: all_gather_op(a, mesh), (a,), iters=_it(40), consume="first"
         )
-        t_comp = perf_func_loop(unfused, (a_rep, b), iters=40, consume="all")
+        t_comp = perf_func_loop(unfused, (a_rep, b), iters=_it(40), consume="all")
         eff = overlap_efficiency(t_f, t_comp, t_comm)
         # vs_baseline keeps its contract (fused vs the serial comm+compute
         # program); the efficiency itself is the metric value
@@ -359,7 +379,6 @@ def _wait_for_backend(attempts=3, timeouts=(120, 180, 240), sleep_between=20):
 
 
 def main() -> None:
-    import os
     import sys
 
     # bounded-time config policy unless the operator asks for full sweeps

@@ -246,7 +246,7 @@ def test_autotuner_interpret_fast_path(tmp_path, monkeypatch):
 def test_autotuner_cached_or_first_policy(tmp_path, monkeypatch):
     """TDT_AUTOTUNE_POLICY=cached_or_first (the bench driver's bounded-time
     mode): a warm signature-level disk entry resolves the tuned winner;
-    anything else applies the FIRST candidate with no sweep."""
+    anything else applies the first VIABLE candidate with no sweep."""
     import json as _json
 
     import triton_dist_tpu.autotuner as at
@@ -255,14 +255,16 @@ def test_autotuner_cached_or_first_policy(tmp_path, monkeypatch):
     monkeypatch.setenv("TDT_AUTOTUNE_POLICY", "cached_or_first")
     calls = []
 
-    @contextual_autotune(configs=[11, 22], name="toy4")
+    @contextual_autotune(configs=["bad", 11, 22], name="toy4")
     def op(x, *, config=None):
         calls.append(config)
+        if config == "bad":
+            raise ValueError("nope")
         return x * config
 
     x = jnp.ones((2,))
-    np.testing.assert_allclose(np.asarray(op(x)), 11.0)  # first candidate
-    assert calls == [11]                                  # no sweep ran
+    np.testing.assert_allclose(np.asarray(op(x)), 11.0)  # first VIABLE
+    assert calls == ["bad", 11]                           # no timing sweep
 
     # a warm signature-keyed entry takes precedence over the policy
     y = jnp.ones((3,))

@@ -152,15 +152,29 @@ def contextual_autotune(
                 return fn(*args, config=_memory_cache[mem_key], **kwargs)
 
             # TDT_AUTOTUNE_POLICY=cached_or_first: signature cache hit
-            # (handled above) or the first candidate, deterministically —
-            # NEVER a sweep. This is the bounded-time mode for runs inside
-            # a budgeted window (the driver bench): a sweep costs a compile
-            # + timed loop per candidate. Works on multi-host too: every
-            # process picks configs[0] without coordination. Tune spaces
-            # therefore lead with their best-known config.
+            # (handled above) or the first VIABLE candidate — NEVER a
+            # sweep. This is the bounded-time mode for runs inside a
+            # budgeted window (the driver bench): a sweep costs a compile
+            # + timed loop per candidate. Tune spaces therefore lead with
+            # their best-known config. Multi-host intentionally ignores
+            # even a warm disk cache here (per-host cache files can
+            # diverge and a mismatched config choice deadlocks
+            # collectives): every process deterministically walks the same
+            # candidate order without coordination.
             if os.environ.get("TDT_AUTOTUNE_POLICY") == "cached_or_first":
-                _memory_cache[mem_key] = configs[0]
-                return fn(*args, config=configs[0], **kwargs)
+                last_err: Exception | None = None
+                for cfg in configs:
+                    try:
+                        out = fn(*args, config=cfg, **kwargs)
+                    except Exception as e:  # candidate doesn't fit — skip
+                        last_err = e
+                        continue
+                    _memory_cache[mem_key] = cfg
+                    return out
+                raise RuntimeError(
+                    f"autotune({op_name}): every candidate config failed "
+                    f"under cached_or_first"
+                ) from last_err
 
             interp = tdt_config.get_config().interpret
             if interp is None:

@@ -305,11 +305,14 @@ def gemm_rs_op(
 # ≙ the reference's tune space for gemm_rs (gemm_reduce_scatter.py contexts);
 # block_m tiles the per-destination M-chunk, which is M/n — smaller than the
 # AG-GEMM tiles for the same problem.
+# FIRST entry = best-known config (applied sweep-free under
+# TDT_AUTOTUNE_POLICY=cached_or_first): the swept winner at the bench
+# shape M=8192 K=14336 N=4096.
 GEMM_RS_TUNE_SPACE = (
+    GemmRSConfig(512, 2048, 1024),
     GemmRSConfig(256, 1024, 512),
     GemmRSConfig(512, 1024, 512),
     GemmRSConfig(256, 2048, 512),
-    GemmRSConfig(512, 2048, 1024),   # swept winner at M=8192 K=14336 N=4096
     GemmRSConfig(512, 2048, 512),
     GemmRSConfig(1024, 2048, 1024),
     GemmRSConfig(512, 4096, 2048),
