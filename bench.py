@@ -328,7 +328,10 @@ def bench_ag_gemm(mesh, n):
         eff = overlap_efficiency(t_f, t_comp, t_comm)
         # vs_baseline keeps its contract (fused vs the serial comm+compute
         # program); the efficiency itself is the metric value
-        emit(f"ag_gemm_overlap_efficiency_tp{n}", eff, "ratio", (t_comp + t_comm) / t_f)
+        emit(
+            f"ag_gemm_overlap_efficiency_tp{n}_m{m_tot}k{k_dim}n{n_tot}",
+            eff, "ratio", (t_comp + t_comm) / t_f,
+        )
 
     flops = 2.0 * m_tot * k_dim * n_tot
     tflops = flops / (t_f * 1e-3) / 1e12 / n

@@ -8,6 +8,11 @@
 #      resolve tuned configs without sweeping
 #   3. a bounded-time bench pass exactly as the driver runs it
 # Logs land in docs/chip_logs/ (commit them).
+#
+# NOTE: .autotune_cache/ is gitignored, so the step-2 warm-up only helps
+# driver runs FROM THIS SAME WORKING TREE (which is how the round driver
+# invokes bench.py). A fresh clone starts cold and uses each tune space's
+# first (best-known) candidate instead.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 mkdir -p docs/chip_logs

@@ -368,3 +368,45 @@ def test_tp_moe_mlp_op_entry(mesh4):
     np.testing.assert_allclose(
         np.asarray(fused), np.asarray(seq), rtol=1e-5, atol=1e-5
     )
+
+
+@pytest.mark.parametrize("routing", ["topk1", "skewed"])
+def test_tp_moe_overlap_edge_routing(mesh4, routing):
+    """Edge routings for the fused pair: topk=1 (minimal expansion) and
+    every-token-to-expert-0 (maximal per-rank padding: all but one
+    expert's segments are sentinel blocks)."""
+    from triton_dist_tpu.ops.grads import tp_moe_mlp_grad
+
+    n, m_loc, n_exp, h_dim, f_dim = 4, 8, 3, 32, 64
+    m_tot = n * m_loc
+    topk = 1 if routing == "topk1" else 2
+    cfg = GroupGemmConfig(block_m=4, block_n=32, block_k=32)
+    kx, ku, kd = jax.random.split(jax.random.PRNGKey(29), 3)
+    x = jax.random.normal(kx, (m_tot, h_dim), jnp.float32)
+    w_up = jax.random.normal(ku, (n_exp, h_dim, f_dim)) / 8
+    w_down = jax.random.normal(kd, (n_exp, f_dim, h_dim)) / 8
+    if routing == "topk1":
+        ids = jax.random.randint(
+            jax.random.PRNGKey(30), (m_tot, 1), 0, n_exp, jnp.int32
+        )
+        tw = jnp.ones((m_tot, 1), jnp.float32)
+    else:
+        ids = jnp.zeros((m_tot, topk), jnp.int32)   # everything to expert 0
+        tw = jnp.full((m_tot, topk), 0.5, jnp.float32)
+    specs = (
+        P("tp", None), P(None, None, "tp"), P(None, "tp", None),
+        P("tp", None), P("tp", None),
+    )
+
+    def run(overlap):
+        return np.asarray(jax.jit(
+            jax.shard_map(
+                lambda x, wu, wd, i, t: tp_moe_mlp_grad(
+                    x, wu, wd, i, t, "tp", jax.nn.gelu, cfg, None, overlap
+                ),
+                mesh=mesh4, in_specs=specs, out_specs=P("tp", None),
+                check_vma=False,
+            )
+        )(x, w_up, w_down, ids, tw))
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-5)

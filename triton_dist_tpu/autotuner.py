@@ -151,51 +151,52 @@ def contextual_autotune(
                 _memory_cache[mem_key] = configs[entry["i"]]
                 return fn(*args, config=_memory_cache[mem_key], **kwargs)
 
-            # TDT_AUTOTUNE_POLICY=cached_or_first: signature cache hit
-            # (handled above) or the first VIABLE candidate — NEVER a
-            # sweep. This is the bounded-time mode for runs inside a
-            # budgeted window (the driver bench): a sweep costs a compile
-            # + timed loop per candidate. Tune spaces therefore lead with
-            # their best-known config. Multi-host intentionally ignores
-            # even a warm disk cache here (per-host cache files can
-            # diverge and a mismatched config choice deadlocks
-            # collectives): every process deterministically walks the same
-            # candidate order without coordination.
-            if os.environ.get("TDT_AUTOTUNE_POLICY") == "cached_or_first":
-                last_err: Exception | None = None
-                for cfg in configs:
-                    try:
-                        out = fn(*args, config=cfg, **kwargs)
-                    except Exception as e:  # candidate doesn't fit — skip
-                        last_err = e
-                        continue
-                    _memory_cache[mem_key] = cfg
-                    return out
-                raise RuntimeError(
-                    f"autotune({op_name}): every candidate config failed "
-                    f"under cached_or_first"
-                ) from last_err
+            def _first_viable(reason: str):
+                """Apply the first candidate that runs — NEVER a sweep.
+                Skips are always logged to stderr: demoting the best-known
+                config on a transient error must not look like a genuine
+                perf regression. Memory-cache only: the disk cache real
+                tuned runs consult is never written by these paths."""
+                import sys
 
-            interp = tdt_config.get_config().interpret
-            if interp is None:
-                interp = not tdt_config.on_tpu()
-            if interp and not sweep_in_interpret:
-                # interpreter timings are noise; pick the first candidate
-                # that runs (memory-cache only — never poison the disk
-                # cache real hardware will consult)
                 last_err: Exception | None = None
                 for cfg in configs:
                     try:
                         out = fn(*args, config=cfg, **kwargs)
                     except Exception as e:
                         last_err = e
+                        print(
+                            f"[autotune {op_name}] {reason}: candidate "
+                            f"{cfg!r} failed ({e!r:.200}); trying next",
+                            file=sys.stderr, flush=True,
+                        )
                         continue
                     _memory_cache[mem_key] = cfg
                     return out
                 raise RuntimeError(
                     f"autotune({op_name}): every candidate config failed "
-                    f"under the interpreter"
+                    f"({reason})"
                 ) from last_err
+
+            # TDT_AUTOTUNE_POLICY=cached_or_first: signature cache hit
+            # (handled above) or the first VIABLE candidate. This is the
+            # bounded-time mode for runs inside a budgeted window (the
+            # driver bench): a sweep costs a compile + timed loop per
+            # candidate. Tune spaces therefore lead with their best-known
+            # config. Multi-host intentionally ignores even a warm disk
+            # cache here (per-host cache files can diverge and a
+            # mismatched config choice deadlocks collectives): every
+            # process deterministically walks the same candidate order
+            # without coordination.
+            if os.environ.get("TDT_AUTOTUNE_POLICY") == "cached_or_first":
+                return _first_viable("cached_or_first")
+
+            interp = tdt_config.get_config().interpret
+            if interp is None:
+                interp = not tdt_config.on_tpu()
+            if interp and not sweep_in_interpret:
+                # interpreter timings are noise
+                return _first_viable("interpreter")
 
             times = [float("inf")] * len(configs)
             seen: dict[Any, int] = {}

@@ -225,6 +225,8 @@ def fast_all_to_all_op(
     )(tokens, splits.astype(jnp.int32))
 
 
+# FIRST entry = best-known default (one put per peer is latency-optimal
+# for the dispatch headline shape; applied sweep-free under cached_or_first)
 A2A_TUNE_SPACE = (A2AConfig(1), A2AConfig(2), A2AConfig(4))
 
 fast_all_to_all_op = contextual_autotune(A2A_TUNE_SPACE, name="fast_all_to_all")(

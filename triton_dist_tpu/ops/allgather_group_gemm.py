@@ -416,6 +416,8 @@ def ag_group_gemm_op(
 # Grouped-GEMM tile sweep (≙ the reference autotuning its MoE kernels,
 # allgather_group_gemm.py:130-180 config lists). block_m is also the
 # alignment block, so the sweep may change padding, not just tiling.
+# FIRST entry = best-known default (applied sweep-free under
+# cached_or_first).
 AG_GROUP_GEMM_TUNE_SPACE = (
     GroupGemmConfig(128, 1024, 512),
     GroupGemmConfig(128, 2048, 512),

@@ -586,7 +586,8 @@ def tp_moe_mlp_op(
 
 
 # Whole-pipeline sweep: both fused kernels (or both halves of the
-# sequential composition) are timed together per candidate.
+# sequential composition) are timed together per candidate. FIRST entry =
+# best-known default (applied sweep-free under cached_or_first).
 TP_MOE_TUNE_SPACE = (
     GroupGemmConfig(128, 1024, 512),
     GroupGemmConfig(128, 2048, 512),

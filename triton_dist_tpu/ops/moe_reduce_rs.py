@@ -410,7 +410,8 @@ def moe_reduce_rs_op(
 
 
 # block_m is pinned by the caller-provided alignment (128 = moe_align
-# default); the sweep covers the N/K tiling of the grouped GEMM.
+# default); the sweep covers the N/K tiling of the grouped GEMM. FIRST
+# entry = best-known default (applied sweep-free under cached_or_first).
 MOE_RS_TUNE_SPACE = (
     GroupGemmConfig(128, 1024, 512),
     GroupGemmConfig(128, 2048, 512),

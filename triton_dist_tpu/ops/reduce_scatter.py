@@ -350,7 +350,8 @@ def reduce_scatter_op(
 
 # method × tile sweep (≙ the reference autotuning its RS contexts); configs
 # whose method is invalid for the problem (e.g. "ring" on a 2-PE axis still
-# runs; no invalid combos here) simply lose the timing race.
+# runs; no invalid combos here) simply lose the timing race. FIRST entry =
+# best-known default (applied sweep-free under cached_or_first).
 RS_TUNE_SPACE = (
     ReduceScatterConfig(256, 1024, "scatter_reduce"),
     ReduceScatterConfig(512, 2048, "scatter_reduce"),
