@@ -15,16 +15,34 @@ from triton_dist_tpu.ops.reduce_scatter import (
     reduce_scatter_op,
 )
 from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs, gemm_rs_op
-from triton_dist_tpu.ops.grads import ag_gemm_grad, gemm_rs_grad
-from triton_dist_tpu.ops.allgather_group_gemm import ag_group_gemm, ag_group_gemm_op
+from triton_dist_tpu.ops.grads import (
+    ag_gemm_grad,
+    gemm_rs_grad,
+    tp_moe_mlp_grad,
+    tp_moe_mlp_op,
+)
+from triton_dist_tpu.ops.allgather_group_gemm import (
+    ag_group_gemm,
+    ag_group_gemm_op,
+    ag_group_gemm_overlap,
+)
 from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
-from triton_dist_tpu.ops.moe_reduce_rs import moe_reduce_rs, moe_reduce_rs_op
+from triton_dist_tpu.ops.moe_reduce_rs import (
+    moe_reduce_rs,
+    moe_reduce_rs_op,
+    moe_reduce_rs_overlap,
+)
 from triton_dist_tpu.ops.moe_utils import (
     MoEAlignment,
+    RankedAlignment,
     moe_align_block_size,
+    moe_align_ranked,
+    ranked_global_view,
+    ranked_scatter_meta,
     select_experts,
 )
 from triton_dist_tpu.ops.all_to_all import (
+    A2AConfig,
     all_to_all_post_process,
     fast_all_to_all,
     fast_all_to_all_op,
