@@ -381,7 +381,33 @@ def _wait_for_backend(attempts=3, timeouts=(120, 180, 240), sleep_between=20):
     return False
 
 
+# Canonical emission order (flagship LAST — the driver parses the final
+# line). EXECUTION order differs: the flagship runs FIRST, while the chip
+# session is healthiest, and every metric runs in its own subprocess with
+# a hard deadline — observed failure mode (round 2 + a round-3 chip
+# session): one remote compile or a wedged device call blocks in-process
+# forever with no way to interrupt it, and everything queued behind it is
+# lost. Isolation caps the damage at one metric.
+_METRICS = {
+    "gemm_rs": bench_gemm_rs,
+    "all_to_all": bench_all_to_all,
+    "flash_decode": bench_flash_decode,
+    "moe": bench_moe,
+    "ag_gemm": bench_ag_gemm,
+}
+_EXEC_ORDER = ("ag_gemm", "gemm_rs", "all_to_all", "flash_decode", "moe")
+_METRIC_TIMEOUT_S = int(os.environ.get("TDT_BENCH_METRIC_TIMEOUT", "1500"))
+
+
+def _run_one(name: str) -> None:
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("tp",))
+    _METRICS[name](mesh, n)
+
+
 def main() -> None:
+    import subprocess
     import sys
 
     # bounded-time config policy unless the operator asks for full sweeps
@@ -391,6 +417,10 @@ def main() -> None:
     else:
         os.environ.setdefault("TDT_AUTOTUNE_POLICY", "cached_or_first")
 
+    if len(sys.argv) > 2 and sys.argv[1] == "--metric":
+        _run_one(sys.argv[2])
+        return
+
     if not _wait_for_backend():
         print(
             "bench: accelerator backend unreachable after all retries — "
@@ -398,24 +428,51 @@ def main() -> None:
             file=sys.stderr, flush=True,
         )
         raise SystemExit(2)
-    devs = jax.devices()
-    n = len(devs)
-    mesh = Mesh(np.array(devs), ("tp",))
-    # each metric runs independently so one failure can't zero the file;
-    # ag_gemm (headline) stays last so the driver's parsed line is the
-    # flagship. Surviving metrics are still emitted on partial failure, but
-    # the exit code goes nonzero so a missing flagship can't masquerade as
-    # a clean run.
+
+    lines: dict[str, list[str]] = {}
     failed = []
-    for fn in (
-        bench_gemm_rs, bench_all_to_all, bench_flash_decode, bench_moe,
-        bench_ag_gemm,
-    ):
+    for name in _EXEC_ORDER:
+        # Popen + its own session: on deadline the WHOLE process group is
+        # killed (a wedged helper grandchild holding the pipes would make
+        # subprocess.run's post-kill drain block forever) and the partial
+        # capture is still reported — it names the op/shape that wedged.
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--metric", name],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            start_new_session=True,
+        )
         try:
-            fn(mesh, n)
-        except Exception as e:
-            failed.append(fn.__name__)
-            print(f"bench: {fn.__name__} failed: {e!r}", file=sys.stderr, flush=True)
+            stdout, stderr = proc.communicate(timeout=_METRIC_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            import signal
+
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            stdout, stderr = proc.communicate()
+            failed.append(name)
+            sys.stderr.write(stderr or "")
+            print(
+                f"bench: {name} exceeded {_METRIC_TIMEOUT_S}s — process "
+                "group killed (wedged remote compile/device call?)",
+                file=sys.stderr, flush=True,
+            )
+            continue
+        sys.stderr.write(stderr or "")
+        got = [ln for ln in (stdout or "").splitlines() if ln.startswith("{")]
+        if proc.returncode == 0 and got:
+            lines[name] = got
+        else:
+            failed.append(name)
+            print(
+                f"bench: {name} failed rc={proc.returncode}",
+                file=sys.stderr, flush=True,
+            )
+    for name in _METRICS:  # canonical emission order, flagship last
+        for ln in lines.get(name, ()):
+            print(ln, flush=True)
     if failed:
         print(f"bench: FAILED metrics: {failed}", file=sys.stderr, flush=True)
         raise SystemExit(2)

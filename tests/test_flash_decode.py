@@ -188,6 +188,30 @@ def test_flash_decode_sp_world1():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
 
 
+def test_flash_decode_xla_candidate(mesh4):
+    """block_s=0 (XLA-native formulation): same (out, lse) contract as the
+    Pallas kernel at world-1 AND through the SP combine (partial shards,
+    one fully-empty shard)."""
+    cfg = FlashDecodeConfig(block_s=0)
+    b, h_kv, g, s, d = 2, 1, 2, 128, 128
+    q, k, v, _ = _rand_case(jax.random.PRNGKey(6), b, h_kv * g, h_kv, s, d)
+    kv_lens = jnp.array([s, 40], jnp.int32)  # rank >1 partially/fully empty
+    want = _ref_decode(q, k, v, kv_lens)
+    got = flash_decode_op(q, k, v, kv_lens, mesh4, config=cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    got1 = flash_decode_op(q, k, v, kv_lens, mesh1, config=cfg)
+    np.testing.assert_allclose(np.asarray(got1), np.asarray(want), rtol=2e-4, atol=2e-4)
+    # standalone (out, lse) parity vs the kernel
+    out_x, lse_x = flash_decode(q, k, v, kv_lens, config=cfg, return_lse=True)
+    out_p, lse_p = flash_decode(
+        q, k, v, kv_lens, config=FlashDecodeConfig(block_s=32),
+        return_lse=True, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out_x), np.asarray(out_p), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(lse_x), np.asarray(lse_p), rtol=2e-4, atol=2e-4)
+
+
 def test_flash_decode_quant_parity():
     """int8 KV cache (absmax row scales): output within quantization
     tolerance of the f32 path; zero-length rows handled."""

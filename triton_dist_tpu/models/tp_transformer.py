@@ -329,7 +329,13 @@ class EPMoETransformerConfig(MoETransformerConfig):
     dispatch over ``(ep_outer, axis)``."""
 
     ep_outer: str | None = None
-    ep_max_m: int | None = None  # per-(src, dest) slab cap; None = worst case
+    # Per-(src, dest) slab cap; None = worst case (never drops). An
+    # undersized override silently drops assignments UNLESS
+    # ``config.update(debug_ep_overflow=True)`` is set, which NaN-poisons
+    # the layer output and reports the dropped count (see
+    # ``layers.ep_moe_mlp`` — the flag applies to every EPMoEMLP call,
+    # including this model's).
+    ep_max_m: int | None = None
 
 
 def ep_moe_param_specs(cfg: EPMoETransformerConfig) -> dict:

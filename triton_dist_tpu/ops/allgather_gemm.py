@@ -53,6 +53,10 @@ class AGGemmConfig:
     block_m: int = 512
     block_n: int = 2048
     block_k: int = 512
+    # block_m=0: world-1 XLA-native sentinel — dispatch the degenerate
+    # no-comm case to jnp.dot (XLA's matmul), a first-class autotune
+    # candidate. Non-viable (raises) at n>1, where the fused ring kernel
+    # is the whole point.
 
 
 def _ag_gemm_kernel(
@@ -238,6 +242,11 @@ def ag_gemm(
     n = int(jax.lax.axis_size(axis))
     m_loc, k_dim = a.shape
     n_loc = b.shape[1]
+    if cfg.block_m == 0:
+        if n != 1:
+            raise ValueError("AGGemmConfig(block_m=0) (XLA dot) is world-1 only")
+        out = jnp.dot(a, b, preferred_element_type=out_dtype)
+        return (out, a) if gather_output else out
     bm = _pick_block(m_loc, cfg.block_m)
     bn = _pick_block(n_loc, cfg.block_n)
     if n == 1:
@@ -311,6 +320,7 @@ def ag_gemm_op(
 # shape ≈ 199 TFLOPS vs XLA 188.
 AG_GEMM_TUNE_SPACE = (
     AGGemmConfig(1024, 2048, 1024),
+    AGGemmConfig(0, 0, 0),  # world-1 XLA dot (raises → skipped at n>1)
     AGGemmConfig(512, 2048, 512),
     AGGemmConfig(512, 2048, 1024),
     AGGemmConfig(512, 2048, 2048),

@@ -95,7 +95,8 @@ def _ag_group_gemm_overlap_kernel(
     out_ref, ag_ref,
     a_all, b_buf, out_stage, ids_sm,
     copy_sem, send_sems, recv_sems, gsems, idsem, bsem, outsem,
-    *, axis: str, n: int, nb: int, n_jn: int, bn: int, bpg: int, out_dtype,
+    *, axis: str, n: int, nb: int, n_jn: int, bn: int, bpg: int, bm: int,
+    out_dtype,
 ):
     """Fused ring-AG + grouped GEMM: each chunk's rows are row-DMA-gathered
     into VMEM in double-buffered groups the moment the ring delivers the
@@ -108,7 +109,6 @@ def _ag_group_gemm_overlap_kernel(
     ``_ag_gemm_kernel``."""
     me = shmem.my_pe(axis)
     m_loc, k_dim = a_ref.shape
-    bm = ids_sm.shape[0] // nb
     t_pad_loc = nb * bm
     it_counter = [0]  # trace-time global (block, jn) iteration count
 
@@ -143,7 +143,9 @@ def _ag_group_gemm_overlap_kernel(
         # chunk c's gather plan (global src rows) → SMEM; rows are then
         # gathered in double-buffered GROUPS of `bpg` blocks so VMEM stays
         # bounded for any t_pad_loc (group g+1's row DMAs fly while group
-        # g's blocks run through the MXU)
+        # g's blocks run through the MXU). The whole (lane-padded) row is
+        # copied: Mosaic requires lane-dim slices be 128-aligned, which
+        # t_pad_loc alone need not be.
         ids_cp = pltpu.make_async_copy(
             src_rows_ref.at[c], ids_sm, idsem
         )
@@ -327,10 +329,16 @@ def ag_group_gemm_overlap(
         + 2 * 2 * bm * bn * jnp.dtype(out_dtype).itemsize
         + 4 * 2**20
     )
+    # lane-pad the gather plan: the kernel copies whole [t_pad] rows to
+    # SMEM and Mosaic rejects lane-dim slices not aligned to 128
+    sr_pad = -(-t_pad_loc // 128) * 128
+    src_rows = ral.src_rows
+    if sr_pad != t_pad_loc:
+        src_rows = jnp.pad(src_rows, ((0, 0), (0, sr_pad - t_pad_loc)))
     out, ag = dist_pallas_call(
         functools.partial(
             _ag_group_gemm_overlap_kernel, axis=axis, n=n, nb=nb,
-            n_jn=n_jn, bn=bn, bpg=bpg, out_dtype=out_dtype,
+            n_jn=n_jn, bn=bn, bpg=bpg, bm=bm, out_dtype=out_dtype,
         ),
         name="ag_group_gemm_overlap",
         out_shape=(
@@ -351,7 +359,7 @@ def ag_group_gemm_overlap(
             pltpu.VMEM((2, bpg * bm, k_dim), a.dtype),
             pltpu.VMEM((2, k_dim, bn), b.dtype),
             pltpu.VMEM((2 * bm, bn), out_dtype),
-            pltpu.SMEM((t_pad_loc,), jnp.int32),
+            pltpu.SMEM((sr_pad,), jnp.int32),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
@@ -371,7 +379,7 @@ def ag_group_gemm_overlap(
         vmem_limit_bytes=min(vmem_bytes, 100 * 2**20),
         uses_barrier=n > 1,
         interpret=interpret,
-    )(ral.expert_ids, a, b, ral.src_rows)
+    )(ral.expert_ids, a, b, src_rows)
     return (out, ag) if gather_output else out
 
 

@@ -64,6 +64,8 @@ class GemmRSConfig:
     block_m: int = 256
     block_n: int = 1024
     block_k: int = 512
+    # block_m=0: world-1 XLA-native sentinel (see AGGemmConfig) — the
+    # no-comm degenerate case goes to jnp.dot; raises at n>1.
 
 
 def _blocks(cfg: GemmRSConfig, m_loc: int, n_dim: int, k_loc: int):
@@ -226,6 +228,10 @@ def gemm_rs(
     n = int(jax.lax.axis_size(axis))
     m_tot, k_loc = a.shape
     n_dim = b.shape[1]
+    if cfg.block_m == 0:
+        if n != 1:
+            raise ValueError("GemmRSConfig(block_m=0) (XLA dot) is world-1 only")
+        return jnp.dot(a, b, preferred_element_type=out_dtype)
     if n == 1:
         # World-1 is a plain matmul; run it through the same tuned MXU
         # pipeline the fused kernels use (beats the XLA dot at bench shapes).
@@ -309,6 +315,9 @@ def gemm_rs_op(
 # TDT_AUTOTUNE_POLICY=cached_or_first): the swept winner at the bench
 # shape M=8192 K=14336 N=4096.
 GEMM_RS_TUNE_SPACE = (
+    GemmRSConfig(0, 0, 0),  # world-1 XLA dot (raises → skipped at n>1);
+    # measured v5e world-1: XLA 199 TFLOPS vs best Pallas chunking 176 at
+    # M=8192 K=14336 N=4096 — this shape's B-panel restreaming favors XLA
     GemmRSConfig(512, 2048, 1024),
     GemmRSConfig(256, 1024, 512),
     GemmRSConfig(512, 1024, 512),
