@@ -429,7 +429,11 @@ def main() -> None:
         )
         raise SystemExit(2)
 
-    lines: dict[str, list[str]] = {}
+    # Only the flagship's lines are buffered (it EXECUTES first, while the
+    # chip session is healthiest, but must be EMITTED last — the driver
+    # parses the final line). Every other metric streams the moment its
+    # subprocess exits, so a parent killed mid-run keeps what finished.
+    flagship: list[str] = []
     failed = []
     for name in _EXEC_ORDER:
         # Popen + its own session: on deadline the WHOLE process group is
@@ -463,16 +467,19 @@ def main() -> None:
         sys.stderr.write(stderr or "")
         got = [ln for ln in (stdout or "").splitlines() if ln.startswith("{")]
         if proc.returncode == 0 and got:
-            lines[name] = got
+            if name == "ag_gemm":
+                flagship = got
+            else:
+                for ln in got:
+                    print(ln, flush=True)
         else:
             failed.append(name)
             print(
                 f"bench: {name} failed rc={proc.returncode}",
                 file=sys.stderr, flush=True,
             )
-    for name in _METRICS:  # canonical emission order, flagship last
-        for ln in lines.get(name, ()):
-            print(ln, flush=True)
+    for ln in flagship:
+        print(ln, flush=True)
     if failed:
         print(f"bench: FAILED metrics: {failed}", file=sys.stderr, flush=True)
         raise SystemExit(2)

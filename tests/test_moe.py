@@ -216,8 +216,12 @@ def test_ag_group_gemm_overlap_vs_sequential(mesh4):
     )(
         jax.device_put(a, jax.NamedSharding(mesh4, P("tp", None))), b, ids
     )
-    np.testing.assert_allclose(np.asarray(ag), np.asarray(a), atol=0, rtol=0)
     out, lids, srows, eids = map(np.asarray, (out, lids, srows, eids))
+    # gather_output contract: the SORTED gathered slab — row (c, r) is the
+    # source token row srows[c, r] (sentinels clamp to a row of own chunk)
+    np.testing.assert_allclose(
+        np.asarray(ag), np.asarray(a)[srows.reshape(-1)], atol=0, rtol=0
+    )
     t_pad_loc = lids.shape[1]
     a_np, b_np = np.asarray(a), np.asarray(b)
     for c in range(n):

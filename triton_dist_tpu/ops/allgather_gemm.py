@@ -230,6 +230,16 @@ def ag_gemm(
     """
     cfg = config or AGGemmConfig()
     out_dtype = out_dtype or a.dtype
+    if cfg.block_m == 0:
+        # before the 2-D dispatch: _pick_block(m, 0) would ZeroDivide there
+        names = axis if isinstance(axis, (tuple, list)) else (axis,)
+        n_tot = 1
+        for ax in names:
+            n_tot *= int(jax.lax.axis_size(ax))
+        if n_tot != 1:
+            raise ValueError("AGGemmConfig(block_m=0) (XLA dot) is world-1 only")
+        out = jnp.dot(a, b, preferred_element_type=out_dtype)
+        return (out, a) if gather_output else out
     if isinstance(axis, (tuple, list)):
         if len(axis) == 1:
             axis = axis[0]
@@ -242,11 +252,6 @@ def ag_gemm(
     n = int(jax.lax.axis_size(axis))
     m_loc, k_dim = a.shape
     n_loc = b.shape[1]
-    if cfg.block_m == 0:
-        if n != 1:
-            raise ValueError("AGGemmConfig(block_m=0) (XLA dot) is world-1 only")
-        out = jnp.dot(a, b, preferred_element_type=out_dtype)
-        return (out, a) if gather_output else out
     bm = _pick_block(m_loc, cfg.block_m)
     bn = _pick_block(n_loc, cfg.block_n)
     if n == 1:

@@ -6,12 +6,27 @@ C++ ``moe_ag_scatter_align_block_size`` sorts the gathered token→expert
 assignments so each tile is single-expert, and a consumer grouped GEMM
 waits per-tile on the source rank's flag (SURVEY.md §2.3).
 
-TPU-native composition: the fused ring allgather kernel moves tokens over
-ICI, routing ids are allgathered with an XLA collective (tiny payload), the
-jnp alignment (moe_utils) replaces the CUDA sort kernel, and the
-scalar-prefetch grouped GEMM (group_gemm) replaces the flag-waiting
-consumer — XLA chains the kernels back-to-back on the same core, which is
-the TPU analogue of the reference's stream-ordered producer/consumer.
+TPU-native composition (two entries):
+
+- :func:`ag_group_gemm` — sequential: ring-AG kernel, XLA alignment gather,
+  scalar-prefetch grouped GEMM. The A/B baseline.
+- :func:`ag_group_gemm_overlap` — SORT-BEFORE-RING single kernel: each rank
+  pre-sorts its OWN tokens into block-aligned expert order with one fused
+  XLA gather (routing ids are allgathered first — tiny payload, same move
+  the reference makes at allgather_group_gemm.py:272-330), then the ring
+  ships already-aligned slabs which the grouped GEMM consumes with one
+  bulk DMA per double-buffered group the moment each chunk lands. Compute
+  order = ring arrival order — the reference's per-source-segment tile
+  swizzle with flag waits (allgather_group_gemm.py:420-470) becomes the
+  schedule itself, as in ``_ag_gemm_kernel``.
+
+  Why sort-before-ring (a real-chip finding): Mosaic has no legal
+  row-granular dynamic gather — 1-row DMA slices violate sublane tiling,
+  and ``tpu.dynamic_gather`` cannot cross vregs — and a per-row DMA loop
+  is descriptor-bound on the scalar core anyway. Shipping pre-sorted slabs
+  costs ~topk× ICI payload (token rows duplicate per assignment, exactly
+  as EP dispatch duplicates them over the network) but the ring rides
+  under the grouped GEMM, whose arithmetic intensity dwarfs it.
 """
 
 from __future__ import annotations
@@ -51,7 +66,7 @@ def ag_group_gemm(
     gather_output: bool = False,
     interpret: Any = None,
 ):
-    """Overlapped MoE up-projection (call inside ``jax.shard_map``;
+    """Sequential MoE up-projection (call inside ``jax.shard_map``;
     ≙ ``ag_group_gemm``, reference allgather_group_gemm.py:272).
 
     a: ``[m_loc, K]`` token shard; b: ``[E, K, n_loc]`` expert weights,
@@ -60,8 +75,9 @@ def ag_group_gemm(
     output in block-aligned expert order over the *gathered* tokens, plus
     the alignment to unsort it (the reference likewise returns scatter
     order for the follow-up reduce). ``gather_output=True`` additionally
-    returns the gathered tokens ``a_full`` (free — the fwd workspace; the
-    training backward wants it, same contract as ``ag_gemm``).
+    returns the SORTED gathered rows ``a_sorted [t_pad, K]`` (free — the
+    GEMM's own input; the training backward consumes exactly this, same
+    contract as :func:`ag_group_gemm_overlap`).
     """
     cfg = config or GroupGemmConfig()
     n_exp = b.shape[0]
@@ -76,7 +92,7 @@ def ag_group_gemm(
         a_sorted, b, alignment.expert_ids, config=cfg, interpret=interpret
     )
     if gather_output:
-        return h_sorted, alignment, a_full
+        return h_sorted, alignment, a_sorted
     return h_sorted, alignment
 
 
@@ -91,38 +107,32 @@ def gather_group_blocks_for(
 
 
 def _ag_group_gemm_overlap_kernel(
-    eid_ref, a_ref, b_ref, src_rows_ref,
+    eid_ref, a_ref, b_ref,
     out_ref, ag_ref,
-    a_all, b_buf, out_stage, ids_sm,
-    copy_sem, send_sems, recv_sems, gsems, idsem, bsem, outsem,
+    a_all, b_buf, out_stage,
+    copy_sem, send_sems, recv_sems, gsems, bsem, outsem,
     *, axis: str, n: int, nb: int, n_jn: int, bn: int, bpg: int, bm: int,
     out_dtype,
 ):
-    """Fused ring-AG + grouped GEMM: each chunk's rows are row-DMA-gathered
-    into VMEM in double-buffered groups the moment the ring delivers the
-    chunk, and consumed by a jn-outer / block-inner MXU loop that
-    re-fetches an expert's weight slab only when the expert changes (the
-    consecutive-block reuse the grid-based ``group_gemm`` gets from
-    Pallas's index-map equality). Compute order = ring arrival order — the
-    reference's per-source-segment tile swizzle with flag waits
-    (allgather_group_gemm.py:420-470) becomes the schedule itself, as in
-    ``_ag_gemm_kernel``."""
+    """Fused ring-AG + grouped GEMM over PRE-SORTED slabs: the ring
+    delivers each rank's block-aligned [t_pad_loc, K] slab; arriving chunks
+    are streamed into VMEM in double-buffered groups of ``bpg`` blocks (one
+    bulk aligned DMA per group — no per-row traffic) and consumed by a
+    jn-outer / block-inner MXU loop that re-fetches an expert's weight slab
+    only when the expert changes (the consecutive-block reuse the grid-based
+    ``group_gemm`` gets from Pallas's index-map equality)."""
     me = shmem.my_pe(axis)
-    m_loc, k_dim = a_ref.shape
     t_pad_loc = nb * bm
     it_counter = [0]  # trace-time global (block, jn) iteration count
 
+    # n >= 2 always: the host entry dispatches world-1 to the grid
+    # group_gemm before building this kernel
     local = pltpu.make_async_copy(
-        a_ref, ag_ref.at[pl.ds(me * m_loc, m_loc)], copy_sem
+        a_ref, ag_ref.at[pl.ds(me * t_pad_loc, t_pad_loc)], copy_sem
     )
     local.start()
-    if n > 1:
-        local.wait()
-        shmem.barrier_all(axis)
-    # world-1: row gathers read the input directly, so the ag workspace
-    # copy (kept for the gather_output contract) runs concurrently with
-    # compute instead of gating it
-    gather_src = ag_ref if n > 1 else a_ref
+    local.wait()
+    shmem.barrier_all(axis)
     right = jax.lax.rem(me + 1, n)
 
     descs = []
@@ -130,7 +140,7 @@ def _ag_group_gemm_overlap_kernel(
         c = jax.lax.rem(me - s + 2 * n, n)
         if s > 0:
             descs[s - 1].wait_recv()  # chunk c landed during step s-1
-        sl = pl.ds(c * m_loc, m_loc)
+        sl = pl.ds(c * t_pad_loc, t_pad_loc)
         if s < n - 1:
             # forward chunk c before computing on it: ICI overlaps MXU
             descs.append(
@@ -140,49 +150,24 @@ def _ag_group_gemm_overlap_kernel(
                 )
             )
 
-        # chunk c's gather plan (global src rows) → SMEM; rows are then
-        # gathered in double-buffered GROUPS of `bpg` blocks so VMEM stays
-        # bounded for any t_pad_loc (group g+1's row DMAs fly while group
-        # g's blocks run through the MXU). The whole (lane-padded) row is
-        # copied: Mosaic requires lane-dim slices be 128-aligned, which
-        # t_pad_loc alone need not be.
-        ids_cp = pltpu.make_async_copy(
-            src_rows_ref.at[c], ids_sm, idsem
-        )
-        ids_cp.start()
-        ids_cp.wait()
-
         n_groups = (nb + bpg - 1) // bpg
 
-        def _issue_group(g, slot):
+        def _group_desc(g, slot, c=c):
             base = g * bpg * bm
             cnt = min(bpg * bm, t_pad_loc - base)
+            return pltpu.make_async_copy(
+                ag_ref.at[pl.ds(c * t_pad_loc + base, cnt), :],
+                a_all.at[slot, pl.ds(0, cnt), :],
+                gsems.at[slot],
+            )
 
-            def _row(r, _):
-                src = ids_sm[base + r]
-                pltpu.make_async_copy(
-                    gather_src.at[pl.ds(src, 1), :],
-                    a_all.at[slot, pl.ds(r, 1), :],
-                    gsems.at[slot],
-                ).start()
-                return 0
-
-            jax.lax.fori_loop(0, cnt, _row, 0)
-            return cnt
-
-        cnt0 = _issue_group(0, 0)
-        group_rows = [cnt0]
+        _group_desc(0, 0).start()
         for g in range(n_groups):          # python: group sizes are static
             gslot = g % 2
             if g + 1 < n_groups:
-                group_rows.append(_issue_group(g + 1, 1 - gslot))
-            # wait the whole group's row copies (byte-counted: cnt rows of K)
-            pltpu.make_async_copy(
-                ag_ref.at[pl.ds(0, group_rows[g]), :],
-                a_all.at[gslot, pl.ds(0, group_rows[g]), :],
-                gsems.at[gslot],
-            ).wait()
-            nb_g = group_rows[g] // bm     # blocks in this group
+                _group_desc(g + 1, 1 - gslot).start()
+            _group_desc(g, gslot).wait()
+            nb_g = min(bpg, nb - g * bpg)  # blocks in this group
 
             # first weight slab of this group
             e0 = eid_ref[c, g * bpg]
@@ -282,9 +267,19 @@ def _ag_group_gemm_overlap_kernel(
         _drain((total_iters - 1) % 2)
     if total_iters >= 2:
         _drain(total_iters % 2)
-    if n == 1:
-        local.wait()  # ag workspace copy ran concurrently with compute
     shmem.quiet(*descs)
+
+
+def presort_local_rows(a: jax.Array, ral: RankedAlignment, axis: str) -> jax.Array:
+    """This rank's block-aligned slab ``[t_pad_loc, K]``: one fused XLA
+    gather (HBM-bandwidth pass). Sentinel rows clamp to row 0 of the own
+    chunk — junk values, masked by zero combine weights downstream."""
+    me = jax.lax.axis_index(axis)
+    m_loc = a.shape[0]
+    rows_loc = jax.lax.dynamic_index_in_dim(
+        ral.src_rows, me, axis=0, keepdims=False
+    ) - me * m_loc
+    return jnp.take(a, rows_loc, axis=0)
 
 
 def ag_group_gemm_overlap(
@@ -302,14 +297,19 @@ def ag_group_gemm_overlap(
     """Single-kernel overlapped MoE up-projection (call inside shard_map;
     ≙ the reference's fused producer/consumer ``ag_group_gemm``,
     allgather_group_gemm.py:272,420-470 — there: cp-engine AG + consumer
-    GEMM spinning on per-source flags; here: ring DMA + arrival-order
-    grouped GEMM in one Pallas kernel).
+    GEMM spinning on per-source flags; here: sort-before-ring, see module
+    docstring).
 
     a: ``[m_loc, K]`` token shard; b: ``[E, K, n_loc]``; `ral` from
     :func:`~triton_dist_tpu.ops.moe_utils.moe_align_ranked` over the
     allgathered routing ids. Returns ``[n*t_pad_loc, n_loc]`` rows in
-    rank-major aligned order (+ the gathered ``[n*m_loc, K]`` tokens when
-    `gather_output`)."""
+    rank-major aligned order (+ the SORTED gathered rows
+    ``[n*t_pad_loc, K]`` when `gather_output` — the backward's input).
+
+    World-1 degenerates to the scalar-prefetch grid ``group_gemm`` over the
+    pre-sorted slab: with no ring to hide, Mosaic's automatic grid
+    pipelining is the best schedule (≙ the world-1 XLA-dot sentinels of
+    ``ag_gemm``/``gemm_rs``)."""
     cfg = config or GroupGemmConfig()
     out_dtype = out_dtype or a.dtype
     n = int(jax.lax.axis_size(axis))
@@ -319,6 +319,16 @@ def ag_group_gemm_overlap(
     bm = ral.block_m
     t_pad_loc = ral.t_pad_loc
     assert bm == cfg.block_m, (bm, cfg.block_m)
+
+    a_srt = presort_local_rows(a, ral, axis)
+
+    if n == 1:
+        h = group_gemm(
+            a_srt, b, ral.expert_ids[0], config=cfg, out_dtype=out_dtype,
+            interpret=interpret,
+        )
+        return (h, a_srt) if gather_output else h
+
     bn = pick_block(n_loc, cfg.block_n)
     n_jn = n_loc // bn
     itemsize = jnp.dtype(a.dtype).itemsize
@@ -329,12 +339,6 @@ def ag_group_gemm_overlap(
         + 2 * 2 * bm * bn * jnp.dtype(out_dtype).itemsize
         + 4 * 2**20
     )
-    # lane-pad the gather plan: the kernel copies whole [t_pad] rows to
-    # SMEM and Mosaic rejects lane-dim slices not aligned to 128
-    sr_pad = -(-t_pad_loc // 128) * 128
-    src_rows = ral.src_rows
-    if sr_pad != t_pad_loc:
-        src_rows = jnp.pad(src_rows, ((0, 0), (0, sr_pad - t_pad_loc)))
     out, ag = dist_pallas_call(
         functools.partial(
             _ag_group_gemm_overlap_kernel, axis=axis, n=n, nb=nb,
@@ -343,43 +347,43 @@ def ag_group_gemm_overlap(
         name="ag_group_gemm_overlap",
         out_shape=(
             jax.ShapeDtypeStruct((n * t_pad_loc, n_loc), out_dtype),
-            jax.ShapeDtypeStruct((n * m_loc, k_dim), a.dtype),
+            jax.ShapeDtypeStruct((n * t_pad_loc, k_dim), a.dtype),
         ),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),   # expert ids [n, nb]
-            pl.BlockSpec(memory_space=pl.ANY),       # a
-            pl.BlockSpec(memory_space=pl.ANY),       # b
-            pl.BlockSpec(memory_space=pl.ANY),       # src rows [n, t_pad_loc]
+            # HBM pinned (not ANY): chunk slices at traced-but-aligned
+            # offsets must DMA from untiled HBM, not from VMEM the
+            # compiler might pick for small inputs
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # a_srt
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # b
         ],
         out_specs=(
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
         ),
         scratch_shapes=[
             pltpu.VMEM((2, bpg * bm, k_dim), a.dtype),
             pltpu.VMEM((2, k_dim, bn), b.dtype),
             pltpu.VMEM((2 * bm, bn), out_dtype),
-            pltpu.SMEM((sr_pad,), jnp.int32),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
         cost_estimate=pl.CostEstimate(
             flops=2 * n * t_pad_loc * k_dim * n_loc,
             bytes_accessed=(
-                n * m_loc * k_dim + b.shape[0] * k_dim * n_loc
+                n * t_pad_loc * k_dim + b.shape[0] * k_dim * n_loc
                 + n * t_pad_loc * n_loc
             ) * itemsize,
             transcendentals=0,
         ),
         vmem_limit_bytes=min(vmem_bytes, 100 * 2**20),
-        uses_barrier=n > 1,
+        uses_barrier=True,
         interpret=interpret,
-    )(ral.expert_ids, a, b, src_rows)
+    )(ral.expert_ids, a_srt, b)
     return (out, ag) if gather_output else out
 
 

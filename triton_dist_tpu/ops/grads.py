@@ -265,7 +265,7 @@ def _tp_moe_forward_impl(x, w_up, w_down, topk_ids, topk_weights, axis,
         ral = moe_align_ranked(
             ids_full.reshape(n, m_loc * topk), n_exp, cfg.block_m, m_loc
         )
-        h_sorted, a_full = ag_group_gemm_overlap(
+        h_sorted, a_sorted = ag_group_gemm_overlap(
             x, w_up, ral, axis=axis, config=cfg, gather_output=True,
             interpret=interpret,
         )
@@ -275,8 +275,8 @@ def _tp_moe_forward_impl(x, w_up, w_down, topk_ids, topk_weights, axis,
             # world-1: there is no reduce-scatter to hide, so the
             # one-hot-matmul combine would be pure MXU overhead — use the
             # XLA scatter-add path (≙ ag_gemm's world-1 degeneration to a
-            # plain matmul). The fused up-proj still wins: it skips the
-            # materialized a_sorted.
+            # plain matmul). The up path differs from sequential only in
+            # per-rank vs global alignment (both pre-sort via XLA gather).
             out = moe_reduce_rs(
                 act, w_down, alignment, tw_full, axis=axis,
                 n_tokens=m_loc, config=cfg, out_dtype=x.dtype,
@@ -290,7 +290,7 @@ def _tp_moe_forward_impl(x, w_up, w_down, topk_ids, topk_weights, axis,
                 interpret=interpret,
             ).astype(x.dtype)
     else:
-        h_sorted, alignment, a_full = ag_group_gemm(
+        h_sorted, alignment, a_sorted = ag_group_gemm(
             x, w_up, topk_ids, axis=axis, config=gg_config,
             gather_output=True, interpret=interpret,
         )
@@ -300,7 +300,10 @@ def _tp_moe_forward_impl(x, w_up, w_down, topk_ids, topk_weights, axis,
             n_tokens=n * m_loc, config=gg_config, out_dtype=x.dtype,
             interpret=interpret,
         ).astype(x.dtype)
-    res = (a_full, h_sorted, tw_full, alignment, w_up, w_down, m_loc)
+    # a_sorted: block-aligned gathered rows [t_pad, H] — BOTH paths return
+    # the sorted slab (the backward's direct input; raw gathered tokens are
+    # never needed again)
+    res = (a_sorted, h_sorted, tw_full, alignment, w_up, w_down, m_loc)
     return out, res
 
 
@@ -355,14 +358,13 @@ def _tp_moe_fwd(x, w_up, w_down, topk_ids, topk_weights, axis, activation,
 
 def _tp_moe_bwd(axis, activation, gg_config, interpret, overlap, res, dout):
     from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
-    from triton_dist_tpu.ops.moe_utils import gather_sorted_rows
     from triton_dist_tpu.ops.reduce_scatter import reduce_scatter
 
-    a_full, h_sorted, tw_full, al, w_up, w_down, m_loc = res
+    a_sorted, h_sorted, tw_full, al, w_up, w_down, m_loc = res
     cfg = gg_config or GroupGemmConfig()
     n_exp = w_up.shape[0]
     f32 = jnp.float32
-    m_tot, h_dim = a_full.shape
+    m_tot, h_dim = tw_full.shape[0], a_sorted.shape[1]
     topk = tw_full.shape[1]
     t = m_tot * topk
 
@@ -380,7 +382,7 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, overlap, res, dout):
     act_f, act_vjp = jax.vjp(
         lambda h: activation(h.astype(f32)), h_sorted
     )
-    act = act_f.astype(a_full.dtype)
+    act = act_f.astype(a_sorted.dtype)
     y_sorted = group_gemm(
         act, w_down, al.expert_ids, config=cfg, out_dtype=f32,
         interpret=interpret,
@@ -415,10 +417,10 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, overlap, res, dout):
     ).astype(w_down.dtype)
     # through the activation
     (dh_sorted,) = act_vjp(dact)
-    dh_sorted = dh_sorted.astype(a_full.dtype)
-    # back through the up grouped GEMM
-    a_sorted = gather_sorted_rows(a_full, al, topk)
-    a_sorted = jnp.where(valid[:, None], a_sorted, 0)  # mask sentinel rows
+    dh_sorted = dh_sorted.astype(a_sorted.dtype)
+    # back through the up grouped GEMM (the residual IS the sorted slab;
+    # sentinel rows hold clamped junk — mask them)
+    a_sorted = jnp.where(valid[:, None], a_sorted, 0)
     da_sorted = group_gemm(
         dh_sorted, w_up.transpose(0, 2, 1), al.expert_ids, config=cfg,
         out_dtype=f32, interpret=interpret,
@@ -435,7 +437,7 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, overlap, res, dout):
     )
     dx = reduce_scatter(
         da_full, axis=axis, interpret=interpret
-    ).astype(a_full.dtype)                          # [m_loc, H]
+    ).astype(a_sorted.dtype)                        # [m_loc, H]
 
     dids = np.zeros((m_loc, topk), jax.dtypes.float0)
     return dx, dw_up, dw_down, dids, dtw

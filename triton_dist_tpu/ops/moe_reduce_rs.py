@@ -320,12 +320,17 @@ def moe_reduce_rs_overlap(
         ),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),   # expert ids [n, nb]
-            pl.BlockSpec(memory_space=pl.ANY),       # h_sorted
-            pl.BlockSpec(memory_space=pl.ANY),       # w_down
-            pl.BlockSpec(memory_space=pl.ANY),       # dst_ids
-            pl.BlockSpec(memory_space=pl.ANY),       # w_rows
+            # HBM pinned: block/meta slices at dynamic offsets must DMA
+            # from untiled HBM, not from VMEM the compiler might choose
+            # for small inputs (see ag_group_gemm_overlap)
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # h_sorted
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # w_down
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # dst_ids
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # w_rows
         ],
-        out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY) for _ in range(3)),
+        out_specs=tuple(
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM) for _ in range(3)
+        ),
         scratch_shapes=[
             pltpu.VMEM((2, bm, f_loc), h_sorted.dtype),
             pltpu.VMEM((2, f_loc, bn), w_down.dtype),

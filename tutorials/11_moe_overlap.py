@@ -7,11 +7,14 @@ moe_reduce_rs.py:882-1020).
 
 TPU-native: TWO single Pallas kernels over a rank-major block alignment.
 
-Up-projection (``ag_group_gemm_overlap``): a ring allgather of raw token
-chunks where each chunk's rows are row-DMA-gathered straight into VMEM and
-fed to the grouped GEMM the moment the ring delivers them — compute order
-IS arrival order, so the reference's tile swizzle + flag waits become the
-schedule itself, and the materialized ``a_sorted`` buffer disappears.
+Up-projection (``ag_group_gemm_overlap``): SORT-BEFORE-RING — each rank
+pre-sorts its own tokens into block-aligned expert order with one fused
+XLA gather (the routing ids were allgathered first; tiny payload), then a
+ring allgather ships the aligned slabs and the grouped GEMM consumes each
+chunk the moment the ring delivers it — compute order IS arrival order,
+so the reference's tile swizzle + flag waits become the schedule itself.
+(Mosaic has no legal row-granular dynamic gather, so sorting must precede
+the ring; the ~topk× slab inflation rides under the GEMM.)
 
 Down-projection (``moe_reduce_rs_overlap``): destination rank c's output
 chunk is computed from its own contiguous blocks, the top-k weighted
