@@ -308,6 +308,10 @@ def ag_gemm_op(
     """Host-level entry (≙ ``ag_gemm``, reference allgather_gemm.py:539):
     `a` sharded on dim 0, `b` sharded on dim 1, result replicated on M and
     sharded on N."""
+    if mesh.size == 1 and config is not None and config.block_m == 0:
+        # world-1 XLA-dot sentinel: no SPMD machinery at all — the fused
+        # entry IS the best XLA program, with zero wrapper overhead
+        return jnp.dot(a, b, preferred_element_type=a.dtype)
     fn = functools.partial(ag_gemm, axis=axis, config=config, interpret=interpret)
     return jit_shard_map(
         fn, mesh, (P(axis, None), P(None, axis)), P(None, axis),
@@ -319,13 +323,18 @@ def ag_gemm_op(
 # triton.Config spaces, allgather_gemm.py:386-404). Swept per input
 # signature the first time `ag_gemm_op` is called without an explicit
 # config; `pick_block` shrinks oversized tiles, so large-tile candidates
-# degrade gracefully on small shards. FIRST entry is the best-known config
-# (what TDT_AUTOTUNE_POLICY=cached_or_first applies without a sweep):
-# (1024, 2048, 1024), measured on a real v5e at the M=8192 LLaMA-8B bench
-# shape ≈ 199 TFLOPS vs XLA 188.
+# degrade gracefully on small shards. Candidate ORDER is preference order
+# (the sweep's order-margin walk and the first-viable policy both honor
+# it): the world-1 XLA-dot sentinel leads — honest paired timing on v5e
+# showed XLA's matmul at parity-or-better with the best Pallas chunking
+# at the M=8192 bench shape (~188-190 TFLOPS; an earlier 199-vs-188
+# reading predated full-output consumption and was DCE-inflated) — and
+# (1024, 2048, 1024) is the best-known ring-kernel config at n>1.
 AG_GEMM_TUNE_SPACE = (
+    # world-1 XLA-dot sentinel LEADS (raises → skipped at n>1, where the
+    # cached_or_first policy falls through to the ring kernel below)
+    AGGemmConfig(0, 0, 0),
     AGGemmConfig(1024, 2048, 1024),
-    AGGemmConfig(0, 0, 0),  # world-1 XLA dot (raises → skipped at n>1)
     AGGemmConfig(512, 2048, 512),
     AGGemmConfig(512, 2048, 1024),
     AGGemmConfig(512, 2048, 2048),

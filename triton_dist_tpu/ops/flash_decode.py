@@ -532,6 +532,11 @@ def flash_decode_op(
     Each PE derives its local valid length from the global one."""
     n = mesh.shape[axis]
     s_shard = k.shape[2] // n
+    if n == 1 and config is not None and config.block_s == 0:
+        # world-1 XLA-native sentinel: no SPMD machinery (see ag_gemm_op)
+        return _xla_decode(
+            q, k, v, kv_lens.astype(jnp.int32), return_lse=False
+        )
 
     def fn(q, k_s, v_s, kv_lens):
         me = jax.lax.axis_index(axis)

@@ -298,6 +298,10 @@ def gemm_rs_op(
     dim 0 (K); the reduced result comes back sharded on dim 0 (M)."""
     from triton_dist_tpu.parallel import topology
 
+    if mesh.size == 1 and config is not None and config.block_m == 0:
+        # world-1 XLA-dot sentinel: bypass shard_map entirely (see
+        # ag_gemm_op)
+        return jnp.dot(a, b, preferred_element_type=a.dtype)
     fn = functools.partial(
         gemm_rs, axis=axis, method=method, config=config, interpret=interpret,
         devices=topology.axis_devices(mesh, axis),

@@ -134,6 +134,39 @@ def perf_func(fn: Callable[[], Any], iters: int = 10, warmup_iters: int = 3) -> 
     return out, max(t2 - t1, 1e-9) * 1e3 / (n2 - n1)
 
 
+def _loop_runner(op, args, perturb_idx, consume):
+    """Build the jitted chained-iteration while_loop for `op` (see
+    :func:`perf_func_loop`): returns ``(run, arr_args)`` where
+    ``run(n, arr_args)`` executes n chained iterations on device."""
+    args = tuple(args)
+    is_arr = [hasattr(a, "shape") and hasattr(a, "dtype") for a in args]
+    arr_args = tuple(a for a, f in zip(args, is_arr) if f)
+
+    def rebuild(arrs: tuple) -> tuple:
+        it = iter(arrs)
+        return tuple(next(it) if f else a for a, f in zip(args, is_arr))
+
+    def body(state):
+        i, carry = state
+        out = op(*rebuild(carry))
+        leaves = jax.tree.leaves(out)
+        if consume == "all":
+            scalar = sum(jnp.sum(l, dtype=jnp.float32) for l in leaves) * 1e-30
+        else:
+            scalar = leaves[0].ravel()[0].astype(jnp.float32) * 1e-30
+        x = carry[perturb_idx]
+        x = x.at[(0,) * x.ndim].add(scalar.astype(x.dtype))
+        return i + 1, carry[:perturb_idx] + (x,) + carry[perturb_idx + 1 :]
+
+    @jax.jit
+    def run(n, arrs):
+        return jax.lax.while_loop(
+            lambda s: s[0] < n, body, (jnp.int32(0), arrs)
+        )[1]
+
+    return run, arr_args
+
+
 def perf_func_loop(
     op: Callable[..., Any],
     args: Sequence[Any],
@@ -170,32 +203,7 @@ def perf_func_loop(
     axis names) are closed over; only arrays ride the carry, and
     `perturb_idx` indexes the *array* args.
     """
-    args = tuple(args)
-    is_arr = [hasattr(a, "shape") and hasattr(a, "dtype") for a in args]
-    arr_args = tuple(a for a, f in zip(args, is_arr) if f)
-
-    def rebuild(arrs: tuple) -> tuple:
-        it = iter(arrs)
-        return tuple(next(it) if f else a for a, f in zip(args, is_arr))
-
-    def body(state):
-        i, carry = state
-        out = op(*rebuild(carry))
-        leaves = jax.tree.leaves(out)
-        if consume == "all":
-            scalar = sum(jnp.sum(l, dtype=jnp.float32) for l in leaves) * 1e-30
-        else:
-            scalar = leaves[0].ravel()[0].astype(jnp.float32) * 1e-30
-        x = carry[perturb_idx]
-        x = x.at[(0,) * x.ndim].add(scalar.astype(x.dtype))
-        return i + 1, carry[:perturb_idx] + (x,) + carry[perturb_idx + 1 :]
-
-    @jax.jit
-    def run(n, arrs):
-        return jax.lax.while_loop(
-            lambda s: s[0] < n, body, (jnp.int32(0), arrs)
-        )[1]
-
+    run, arr_args = _loop_runner(op, args, perturb_idx, consume)
     n1 = max(1, iters // 4)
     n2 = n1 + iters
     _sync(run(jnp.int32(n1), arr_args))  # compile + warm
@@ -221,6 +229,62 @@ def perf_func_loop(
         return last_t2 * 1e3 / n2
     ts.sort()
     return ts[len(ts) // 2]
+
+
+def perf_pair_loop(
+    op_a: Callable[..., Any],
+    op_b: Callable[..., Any],
+    args: Sequence[Any],
+    iters: int = 100,
+    rounds: int = 3,
+    perturb_idx: int = 0,
+) -> tuple[float, float, float]:
+    """A/B timing of two ops over the same args with INTERLEAVED sampling:
+    returns ``(t_a_ms, t_b_ms, ratio)`` where ``ratio = median of
+    per-round t_b/t_a``.
+
+    Two separately-measured :func:`perf_func_loop` calls put minutes of
+    wall clock between the A and B measurements, so slow drift (tunnel RPC
+    weather, chip clocking) lands squarely in the ratio — observed as ±30%
+    swings of `vs_baseline` between back-to-back runs. Here both loops are
+    compiled once, then rounds alternate A,B,A,B… and each round's ratio
+    is taken from ADJACENT samples, cancelling any drift slower than one
+    round. Both sides consume their full output (the A side can resolve to
+    a pure XLA program — see the bench's world-1 sentinels — and partial
+    consumption would let DCE shrink it)."""
+    run_a, arrs_a = _loop_runner(op_a, args, perturb_idx, "all")
+    run_b, arrs_b = _loop_runner(op_b, args, perturb_idx, "all")
+    n1 = max(1, iters // 4)
+    n2 = n1 + iters
+
+    def sample(run, arrs):
+        t0 = time.perf_counter()
+        _sync(run(jnp.int32(n1), arrs))
+        t1 = time.perf_counter()
+        _sync(run(jnp.int32(n2), arrs))
+        t2 = time.perf_counter()
+        return ((t2 - t1) - (t1 - t0)) * 1e3 / iters, (t2 - t1) * 1e3 / n2
+
+    _sync(run_a(jnp.int32(n1), arrs_a))  # compile + warm
+    _sync(run_b(jnp.int32(n1), arrs_b))
+    ta, tb, ratios = [], [], []
+    bound_a = bound_b = float("inf")
+    for _ in range(2 * rounds):  # extra attempts when jitter eats a sample
+        da, ba = sample(run_a, arrs_a)
+        db, bb = sample(run_b, arrs_b)
+        bound_a, bound_b = min(bound_a, ba), min(bound_b, bb)
+        if da > 0 and db > 0:
+            ta.append(da)
+            tb.append(db)
+            ratios.append(db / da)
+        if len(ratios) == rounds:
+            break
+    if not ratios:
+        # every delta drowned in jitter: conservative absolute upper bounds
+        return bound_a, bound_b, bound_b / bound_a
+    for xs in (ta, tb, ratios):
+        xs.sort()
+    return ta[len(ta) // 2], tb[len(tb) // 2], ratios[len(ratios) // 2]
 
 
 @contextlib.contextmanager
