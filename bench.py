@@ -132,8 +132,6 @@ def bench_gemm_rs(mesh, n):
         np.asarray(out[:64], np.float32), np.asarray(ref[:64], np.float32),
         atol=4.0, rtol=4e-2,
     )
-    # n>1: the baseline ends in a reduce-scatter collective, so its
-    # consumption sum cannot fuse — match the fused side's consumption
     t_f, t_b, ratio = bench_pair(fused, unfused, (a, b), iters=_it(100))
     tflops = 2.0 * m_tot * k_tot * n_dim / (t_f * 1e-3) / 1e12 / n
     emit(
@@ -200,7 +198,6 @@ def bench_flash_decode(mesh, n):
 
     fused = lambda q, k, v: flash_decode_op(q, k, v, kv_lens, mesh)
 
-    g = hq // h_kv
 
     from triton_dist_tpu.ops.flash_decode import _xla_decode
 

@@ -16,6 +16,7 @@ from triton_dist_tpu.models.decode import (
     generate,
 )
 from triton_dist_tpu.models.pipeline import pipeline_apply, stage_slice
+from triton_dist_tpu.models import presets
 from triton_dist_tpu.models.sp_transformer import (
     SPTransformer,
     SPTransformerConfig,
@@ -40,6 +41,7 @@ from triton_dist_tpu.models.tp_transformer import (
 __all__ = [
     "KVCacheSpec",
     "PagedKVCacheSpec",
+    "presets",
     "pipeline_apply",
     "stage_slice",
     "SPTransformer",

@@ -1,0 +1,102 @@
+"""Named model-shape presets — the reference's benchmark shape table as
+ready-to-run configs (≙ the perf-test suite's shape list,
+reference ``python/triton_dist/test/nvidia/test_ag_gemm.py:149-156``:
+M=8192 with N/K drawn from LLaMA-7B / 3.1-8B / 3.1-70B / 3.1-405B,
+Mistral-7B, Qwen2-72B; the MoE tests use Mixtral-8x7B shapes).
+
+All numbers are the public architecture shapes of the open-weight models.
+Presets carry GLOBAL dimensions; sharding is derived by ``param_specs`` /
+``moe_param_specs`` from the mesh, so the same preset runs at any TP
+degree that divides its head/ffn counts (``validate_tp`` checks).
+
+    cfg = presets.preset("llama-3.1-8b", batch=1, seq=8192)
+    cfg = presets.preset("mixtral-8x7b", tp_check=8)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+
+from triton_dist_tpu.models.tp_transformer import (
+    MoETransformerConfig,
+    TransformerConfig,
+)
+
+# name → (hidden, ffn, n_q_heads, n_kv_heads, head_dim, vocab[, E, topk])
+_DENSE = {
+    "llama-7b": (4096, 11008, 32, 32, 128, 32000),
+    "llama-3.1-8b": (4096, 14336, 32, 8, 128, 128256),
+    "llama-3.1-70b": (8192, 28672, 64, 8, 128, 128256),
+    "llama-3.1-405b": (16384, 53248, 128, 8, 128, 128256),
+    "mistral-7b": (4096, 14336, 32, 8, 128, 32768),
+    "qwen2-72b": (8192, 29568, 64, 8, 128, 152064),
+}
+_MOE = {
+    "mixtral-8x7b": (4096, 14336, 32, 8, 128, 32000, 8, 2),
+}
+
+PRESETS = tuple(sorted((*_DENSE, *_MOE)))
+
+
+def validate_tp(cfg: TransformerConfig, tp: int) -> None:
+    """Raise if the preset's global shapes don't divide across `tp` PEs
+    (kv heads bound attention TP; ffn bounds the MLP TP)."""
+    if cfg.n_kv_heads % tp:
+        raise ValueError(
+            f"tp={tp} does not divide n_kv_heads={cfg.n_kv_heads}"
+        )
+    # dense and expert MLPs share `ffn` (MoETransformerConfig adds expert
+    # COUNT, not a distinct width), so one check covers both
+    if cfg.ffn % tp:
+        raise ValueError(f"tp={tp} does not divide ffn={cfg.ffn}")
+
+
+def preset(
+    name: str,
+    *,
+    batch: int = 1,
+    seq: int = 8192,
+    n_layers: int | None = None,
+    dtype: Any = jnp.bfloat16,
+    tp_check: int | None = None,
+    **overrides: Any,
+) -> TransformerConfig:
+    """Build the named model's config. `n_layers` defaults to 1 (a single
+    decoder block — the unit the reference's per-op benchmarks compose);
+    pass the real depth for full-model runs. Extra keyword arguments
+    override any config field (e.g. ``ag_config=...``)."""
+    if name in _MOE:
+        h, f, q, kv, d, vocab, n_exp, topk = _MOE[name]
+        cfg: TransformerConfig = MoETransformerConfig(
+            vocab=vocab, hidden=h, ffn=f, n_layers=n_layers or 1,
+            n_q_heads=q, n_kv_heads=kv, head_dim=d, batch=batch, seq=seq,
+            dtype=dtype, n_experts=n_exp, topk=topk, **overrides,
+        )
+    elif name in _DENSE:
+        h, f, q, kv, d, vocab = _DENSE[name]
+        cfg = TransformerConfig(
+            vocab=vocab, hidden=h, ffn=f, n_layers=n_layers or 1,
+            n_q_heads=q, n_kv_heads=kv, head_dim=d, batch=batch, seq=seq,
+            dtype=dtype, **overrides,
+        )
+    else:
+        raise KeyError(f"unknown preset {name!r}; have {PRESETS}")
+    if tp_check is not None:
+        validate_tp(cfg, tp_check)
+    return cfg
+
+
+def bench_gemm_shapes(name: str, m: int = 8192) -> dict[str, tuple[int, int, int]]:
+    """The reference benchmark's (M, K, N) problem list for one model:
+    column-parallel up-proj (AG-GEMM side) and row-parallel down-proj
+    (GEMM-RS side) — the two fused-GEMM shapes its perf suite sweeps."""
+    cfg = preset(name)
+    return {
+        "ag_gemm_up": (m, cfg.hidden, cfg.ffn),
+        "gemm_rs_down": (m, cfg.ffn, cfg.hidden),
+        "ag_gemm_qkv": (m, cfg.hidden, (cfg.q_dim + 2 * cfg.kv_dim)),
+        "gemm_rs_o": (m, cfg.q_dim, cfg.hidden),
+    }

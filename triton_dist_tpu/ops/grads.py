@@ -259,6 +259,13 @@ def _tp_moe_forward_impl(x, w_up, w_down, topk_ids, topk_weights, axis,
     n_exp = w_up.shape[0]
     topk = topk_ids.shape[1]
     tw_full = jax.lax.all_gather(topk_weights, axis, tiled=True)
+    if overlap and n == 1:
+        # world-1: there is nothing to overlap — the up-projection already
+        # degenerates to the grid group_gemm and the down-projection to
+        # the XLA scatter path, so the "overlap" pipeline IS the
+        # sequential composition. Route it there outright (one code path,
+        # identical graphs; ≙ ag_gemm's world-1 collapse).
+        overlap = False
     if overlap:
         cfg = gg_config or GroupGemmConfig()
         ids_full = jax.lax.all_gather(topk_ids, axis, tiled=True)
@@ -271,24 +278,13 @@ def _tp_moe_forward_impl(x, w_up, w_down, topk_ids, topk_weights, axis,
         )
         act = activation(h_sorted.astype(jnp.float32)).astype(x.dtype)
         alignment = ranked_global_view(ral, m_loc, topk)
-        if n == 1:
-            # world-1: there is no reduce-scatter to hide, so the
-            # one-hot-matmul combine would be pure MXU overhead — use the
-            # XLA scatter-add path (≙ ag_gemm's world-1 degeneration to a
-            # plain matmul). The up path differs from sequential only in
-            # per-rank vs global alignment (both pre-sort via XLA gather).
-            out = moe_reduce_rs(
-                act, w_down, alignment, tw_full, axis=axis,
-                n_tokens=m_loc, config=cfg, out_dtype=x.dtype,
-                interpret=interpret,
-            ).astype(x.dtype)
-        else:
-            dst_ids, w_rows = ranked_scatter_meta(ral, tw_full)
-            out = moe_reduce_rs_overlap(
-                act, w_down, ral.expert_ids, dst_ids, w_rows, axis=axis,
-                m_out=m_loc, config=cfg, out_dtype=x.dtype,
-                interpret=interpret,
-            ).astype(x.dtype)
+        # n >= 2 here: world-1 routed to the sequential branch above
+        dst_ids, w_rows = ranked_scatter_meta(ral, tw_full)
+        out = moe_reduce_rs_overlap(
+            act, w_down, ral.expert_ids, dst_ids, w_rows, axis=axis,
+            m_out=m_loc, config=cfg, out_dtype=x.dtype,
+            interpret=interpret,
+        ).astype(x.dtype)
     else:
         h_sorted, alignment, a_sorted = ag_group_gemm(
             x, w_up, topk_ids, axis=axis, config=gg_config,
