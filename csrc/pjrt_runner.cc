@@ -1,0 +1,354 @@
+// Native AOT executable runner over the PJRT C API
+// (≙ reference tools/runtime/triton_aot_runtime.cc + tools/compile/compile.c:
+// their AOT flow emits C launchers linked against a C++ CUDA runtime; the
+// TPU-native equivalent loads an XLA executable serialized by
+// `triton_dist_tpu.aot.export_pjrt` and drives it through the PJRT C API
+// exported by the accelerator plugin — no Python in the serving loop).
+//
+//   pjrt_runner <plugin.so> <executable.bin> [--input DTYPE:DIMxDIMx...]...
+//               [--option KEY=i:INT | KEY=s:STR]... [--iters N]
+//
+// The plugin is any PJRT C-API .so (libtpu.so for TPU). `--option` pairs
+// become PJRT_NamedValue client-create options (plugins like proxied
+// backends require e.g. topology/session settings). Inputs are filled
+// with a deterministic pattern; outputs are copied back and byte-summed so
+// runs are comparable across hosts. Exit 0 = executed and produced every
+// output.
+//
+// ABI note: the PJRT C API is designed for cross-version use — every call
+// carries struct_size, and the loader checks the plugin's major version at
+// startup (PJRT_Api_Version) instead of assuming header == plugin.
+
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <chrono>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+const PJRT_Api* g_api = nullptr;
+
+[[noreturn]] void Die(const std::string& what) {
+  fprintf(stderr, "pjrt_runner: %s\n", what.c_str());
+  exit(1);
+}
+
+void CheckErr(PJRT_Error* err, const char* what) {
+  if (err == nullptr) return;
+  PJRT_Error_Message_Args m;
+  memset(&m, 0, sizeof(m));
+  m.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  m.error = err;
+  g_api->PJRT_Error_Message(&m);
+  std::string msg(m.message, m.message_size);
+  PJRT_Error_Destroy_Args d;
+  memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  d.error = err;
+  g_api->PJRT_Error_Destroy(&d);
+  Die(std::string(what) + ": " + msg);
+}
+
+void AwaitAndDestroy(PJRT_Event* event, const char* what) {
+  PJRT_Event_Await_Args a;
+  memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  a.event = event;
+  CheckErr(g_api->PJRT_Event_Await(&a), what);
+  PJRT_Event_Destroy_Args d;
+  memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  d.event = event;
+  CheckErr(g_api->PJRT_Event_Destroy(&d), "event destroy");
+}
+
+struct InputSpec {
+  PJRT_Buffer_Type type;
+  size_t elem_bytes;
+  std::vector<int64_t> dims;
+  size_t nbytes() const {
+    size_t n = elem_bytes;
+    for (int64_t d : dims) n *= static_cast<size_t>(d);
+    return n;
+  }
+};
+
+InputSpec ParseInput(const std::string& s) {
+  // DTYPE:DIMxDIMx... (scalar: "f32:" with no dims)
+  auto colon = s.find(':');
+  if (colon == std::string::npos) Die("bad --input (want DTYPE:DIMS): " + s);
+  std::string dt = s.substr(0, colon);
+  InputSpec spec;
+  if (dt == "f32") {
+    spec.type = PJRT_Buffer_Type_F32;
+    spec.elem_bytes = 4;
+  } else if (dt == "bf16") {
+    spec.type = PJRT_Buffer_Type_BF16;
+    spec.elem_bytes = 2;
+  } else if (dt == "f16") {
+    spec.type = PJRT_Buffer_Type_F16;
+    spec.elem_bytes = 2;
+  } else if (dt == "i32" || dt == "s32") {
+    spec.type = PJRT_Buffer_Type_S32;
+    spec.elem_bytes = 4;
+  } else if (dt == "i8" || dt == "s8") {
+    spec.type = PJRT_Buffer_Type_S8;
+    spec.elem_bytes = 1;
+  } else if (dt == "u8") {
+    spec.type = PJRT_Buffer_Type_U8;
+    spec.elem_bytes = 1;
+  } else {
+    Die("unsupported dtype: " + dt);
+  }
+  std::string dims = s.substr(colon + 1);
+  size_t pos = 0;
+  while (pos < dims.size()) {
+    auto x = dims.find('x', pos);
+    std::string tok = dims.substr(pos, x == std::string::npos ? x : x - pos);
+    if (!tok.empty()) spec.dims.push_back(strtoll(tok.c_str(), nullptr, 10));
+    if (x == std::string::npos) break;
+    pos = x + 1;
+  }
+  return spec;
+}
+
+std::vector<char> ReadFile(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) Die(std::string("cannot open ") + path);
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::vector<char> buf(static_cast<size_t>(n));
+  if (fread(buf.data(), 1, buf.size(), f) != buf.size()) Die("short read");
+  fclose(f);
+  return buf;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr,
+            "usage: %s <plugin.so> <executable.bin> "
+            "[--input DTYPE:DIMxDIM...]... [--iters N]\n",
+            argv[0]);
+    return 2;
+  }
+  std::vector<InputSpec> inputs;
+  int iters = 1;
+  // --option storage: strings must outlive PJRT_Client_Create
+  std::vector<std::string> opt_keys, opt_strs;
+  std::vector<int64_t> opt_ints;
+  std::vector<int> opt_kind;  // 0 = int, 1 = string
+  for (int i = 3; i < argc; i++) {
+    if (!strcmp(argv[i], "--input") && i + 1 < argc) {
+      inputs.push_back(ParseInput(argv[++i]));
+    } else if (!strcmp(argv[i], "--iters") && i + 1 < argc) {
+      iters = atoi(argv[++i]);
+      if (iters < 1) Die(std::string("--iters must be >= 1, got ") + argv[i]);
+    } else if (!strcmp(argv[i], "--option") && i + 1 < argc) {
+      std::string kv = argv[++i];
+      auto eq = kv.find('=');
+      if (eq == std::string::npos || eq + 2 >= kv.size() || kv[eq + 2] != ':') {
+        Die("bad --option (want KEY=i:INT or KEY=s:STR): " + kv);
+      }
+      char kind = kv[eq + 1];
+      opt_keys.push_back(kv.substr(0, eq));
+      std::string val = kv.substr(eq + 3);
+      if (kind == 'i') {
+        opt_kind.push_back(0);
+        opt_ints.push_back(strtoll(val.c_str(), nullptr, 10));
+        opt_strs.emplace_back();
+      } else if (kind == 's') {
+        opt_kind.push_back(1);
+        opt_strs.push_back(val);
+        opt_ints.push_back(0);
+      } else {
+        Die("bad --option type (i or s): " + kv);
+      }
+    } else {
+      Die(std::string("unknown arg ") + argv[i]);
+    }
+  }
+
+  void* lib = dlopen(argv[1], RTLD_NOW | RTLD_LOCAL);
+  if (!lib) Die(std::string("dlopen: ") + dlerror());
+  auto get_api = reinterpret_cast<const PJRT_Api* (*)()>(
+      dlsym(lib, "GetPjrtApi"));
+  if (!get_api) Die("plugin exports no GetPjrtApi");
+  g_api = get_api();
+  if (!g_api) Die("GetPjrtApi returned null");
+  fprintf(stderr, "pjrt_runner: plugin api v%d.%d\n",
+          g_api->pjrt_api_version.major_version,
+          g_api->pjrt_api_version.minor_version);
+  if (g_api->pjrt_api_version.major_version != PJRT_API_MAJOR) {
+    Die("plugin PJRT major version mismatch vs header");
+  }
+
+  {
+    PJRT_Plugin_Initialize_Args a;
+    memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+    CheckErr(g_api->PJRT_Plugin_Initialize(&a), "plugin init");
+  }
+
+  PJRT_Client* client = nullptr;
+  {
+    std::vector<PJRT_NamedValue> nvs(opt_keys.size());
+    for (size_t i = 0; i < opt_keys.size(); i++) {
+      memset(&nvs[i], 0, sizeof(nvs[i]));
+      nvs[i].struct_size = PJRT_NamedValue_STRUCT_SIZE;
+      nvs[i].name = opt_keys[i].c_str();
+      nvs[i].name_size = opt_keys[i].size();
+      if (opt_kind[i] == 0) {
+        nvs[i].type = PJRT_NamedValue_kInt64;
+        nvs[i].int64_value = opt_ints[i];
+        nvs[i].value_size = 1;
+      } else {
+        nvs[i].type = PJRT_NamedValue_kString;
+        nvs[i].string_value = opt_strs[i].c_str();
+        nvs[i].value_size = opt_strs[i].size();
+      }
+    }
+    PJRT_Client_Create_Args a;
+    memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+    a.create_options = nvs.data();
+    a.num_options = nvs.size();
+    CheckErr(g_api->PJRT_Client_Create(&a), "client create");
+    client = a.client;
+  }
+
+  PJRT_Device* device = nullptr;
+  {
+    PJRT_Client_AddressableDevices_Args a;
+    memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+    a.client = client;
+    CheckErr(g_api->PJRT_Client_AddressableDevices(&a), "devices");
+    if (a.num_addressable_devices == 0) Die("no addressable devices");
+    device = a.addressable_devices[0];
+  }
+
+  std::vector<char> exe_bytes = ReadFile(argv[2]);
+  PJRT_LoadedExecutable* loaded = nullptr;
+  {
+    PJRT_Executable_DeserializeAndLoad_Args a;
+    memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Executable_DeserializeAndLoad_Args_STRUCT_SIZE;
+    a.client = client;
+    a.serialized_executable = exe_bytes.data();
+    a.serialized_executable_size = exe_bytes.size();
+    CheckErr(g_api->PJRT_Executable_DeserializeAndLoad(&a), "deserialize");
+    loaded = a.loaded_executable;
+  }
+
+  size_t num_outputs = 0;
+  {
+    PJRT_LoadedExecutable_GetExecutable_Args g;
+    memset(&g, 0, sizeof(g));
+    g.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+    g.loaded_executable = loaded;
+    CheckErr(g_api->PJRT_LoadedExecutable_GetExecutable(&g), "get exe");
+    PJRT_Executable_NumOutputs_Args n;
+    memset(&n, 0, sizeof(n));
+    n.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+    n.executable = g.executable;
+    CheckErr(g_api->PJRT_Executable_NumOutputs(&n), "num outputs");
+    num_outputs = n.num_outputs;
+  }
+
+  // Stage inputs: deterministic byte pattern (comparable across hosts).
+  std::vector<PJRT_Buffer*> arg_bufs;
+  std::vector<std::vector<char>> host_inputs;
+  for (const InputSpec& spec : inputs) {
+    host_inputs.emplace_back(spec.nbytes());
+    std::vector<char>& h = host_inputs.back();
+    for (size_t i = 0; i < h.size(); i++) {
+      h[i] = static_cast<char>((i * 131) % 241 % 63);  // small positive ints
+    }
+    PJRT_Client_BufferFromHostBuffer_Args a;
+    memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    a.client = client;
+    a.data = h.data();
+    a.type = spec.type;
+    a.dims = spec.dims.data();
+    a.num_dims = spec.dims.size();
+    a.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    a.device = device;
+    CheckErr(g_api->PJRT_Client_BufferFromHostBuffer(&a), "h2d");
+    AwaitAndDestroy(a.done_with_host_buffer, "h2d await");
+    arg_bufs.push_back(a.buffer);
+  }
+
+  // Execute `iters` times (buffers are not donated: executables whose
+  // inputs alias outputs should be exported with donation disabled).
+  std::vector<PJRT_Buffer*> outputs(num_outputs, nullptr);
+  double total_ms = 0.0;
+  for (int it = 0; it < iters; it++) {
+    for (PJRT_Buffer* b : outputs) {
+      if (b != nullptr) {
+        PJRT_Buffer_Destroy_Args d;
+        memset(&d, 0, sizeof(d));
+        d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+        d.buffer = b;
+        CheckErr(g_api->PJRT_Buffer_Destroy(&d), "out destroy");
+      }
+    }
+    PJRT_ExecuteOptions opts;
+    memset(&opts, 0, sizeof(opts));
+    opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+    std::vector<int64_t> non_donatable(arg_bufs.size());
+    for (size_t i = 0; i < non_donatable.size(); i++) non_donatable[i] = i;
+    opts.non_donatable_input_indices = non_donatable.data();
+    opts.num_non_donatable_input_indices = non_donatable.size();
+
+    PJRT_Buffer* const* arg_list = arg_bufs.data();
+    PJRT_Buffer** out_list = outputs.data();
+    PJRT_Event* done = nullptr;
+
+    PJRT_LoadedExecutable_Execute_Args a;
+    memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    a.executable = loaded;
+    a.options = &opts;
+    a.argument_lists = &arg_list;
+    a.num_devices = 1;
+    a.num_args = arg_bufs.size();
+    a.output_lists = &out_list;
+    a.device_complete_events = &done;
+    a.execute_device = device;
+    auto t0 = std::chrono::steady_clock::now();
+    CheckErr(g_api->PJRT_LoadedExecutable_Execute(&a), "execute");
+    AwaitAndDestroy(done, "execute await");
+    auto t1 = std::chrono::steady_clock::now();
+    total_ms += std::chrono::duration<double, std::milli>(t1 - t0).count();
+  }
+
+  // Copy outputs back; byte-sum for a host-independent fingerprint.
+  for (size_t i = 0; i < num_outputs; i++) {
+    PJRT_Buffer_ToHostBuffer_Args a;
+    memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    a.src = outputs[i];
+    CheckErr(g_api->PJRT_Buffer_ToHostBuffer(&a), "d2h size query");
+    std::vector<char> host(a.dst_size);
+    a.dst = host.data();
+    CheckErr(g_api->PJRT_Buffer_ToHostBuffer(&a), "d2h");
+    AwaitAndDestroy(a.event, "d2h await");
+    uint64_t sum = 0;
+    for (char c : host) sum += static_cast<unsigned char>(c);
+    printf("output[%zu]: %zu bytes, bytesum=%llu\n", i, host.size(),
+           static_cast<unsigned long long>(sum));
+  }
+  printf("executed %d iter(s), avg %.3f ms\n", iters, total_ms / iters);
+  return 0;
+}

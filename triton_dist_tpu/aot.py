@@ -5,12 +5,15 @@
 
 The reference pre-compiles Triton kernels to cubins, generates C wrappers +
 an algo-dispatch table, and ships a CUDA-driver-API loader. Under XLA the
-whole toolchain collapses (SURVEY.md §7 design table): ``jax.jit(...)
-.lower().compile()`` is the AOT compile, the serialized artifact replaces
-the cubin+C-source bundle, and PJRT's loader replaces the C++ runtime —
-so this module is thin by design, not by omission.
+compile-side toolchain collapses (SURVEY.md §7 design table): ``jax.jit(...)
+.lower().compile()`` is the AOT compile and the serialized artifact
+replaces the cubin+C-source bundle. The native load side is shipped:
+``csrc/pjrt_runner.cc`` executes :func:`export_pjrt` artifacts through
+the PJRT C API of any accelerator plugin — no Python in the serving loop
+(verified bit-exact against the jitted Python run on a real chip;
+``scripts/pjrt_runner_check.sh``).
 
-Two artifact flavors:
+Three artifact flavors:
 
 - **Portable export** (`save_exported` / `load_exported`): StableHLO via
   ``jax.export`` — survives jax/runtime upgrades, recompiles on load.
@@ -18,6 +21,9 @@ Two artifact flavors:
   ``jax.jit(fn).lower(*args).compile()`` serialized with
   ``jax.experimental.serialize_executable`` — zero-compile load on the
   same topology+version (what the reference's cubin cache achieves).
+- **Native serving artifact** (`export_pjrt`): the raw PJRT executable
+  bytes for the C++ runner — the reference's cubin + C launcher as one
+  file + one binary.
 
 ``aot_compile_spaces`` mirrors the reference decorator: a dict of named
 specializations, each pre-lowered for its signature.
@@ -100,6 +106,40 @@ def load_compiled(path: str) -> Callable:
         raise ValueError(f"{path}: AOT artifact failed integrity check")
     payload = pickle.loads(blob)
     return serialize_executable.deserialize_and_load(*payload)
+
+
+# -- native (no-Python) serving artifacts ------------------------------------
+
+def export_pjrt(
+    fn: Callable, example_args: Sequence[Any], path: str, **jit_kwargs: Any
+) -> str:
+    """Serialize the RAW PJRT executable for the native C++ runner
+    (`csrc/pjrt_runner.cc` ≙ reference ``tools/runtime/triton_aot_runtime.cc``
+    — their cubin + C launcher becomes one PJRT artifact + one binary).
+
+    Unlike :func:`save_compiled` (a pickle for Python reload), this writes
+    exactly the bytes ``PJRT_Executable_DeserializeAndLoad`` consumes — no
+    Python on the load side. Same-platform, same-libtpu-version only (the
+    PJRT contract for serialized executables). Returns a ready-to-run
+    ``pjrt_runner`` command line for the example signature."""
+    # dtype check FIRST: failing after the (potentially minutes-long)
+    # compile would also leave a stray artifact at `path`
+    dt_map = {"float32": "f32", "bfloat16": "bf16", "float16": "f16",
+              "int32": "i32", "int8": "i8", "uint8": "u8"}
+    specs = []
+    for a in jax.tree.leaves(tuple(example_args)):
+        dt = dt_map.get(str(a.dtype))
+        if dt is None:
+            raise ValueError(f"pjrt_runner has no input support for {a.dtype}")
+        specs.append(f"--input {dt}:" + "x".join(str(d) for d in a.shape))
+    compiled = aot_compile(fn, *example_args, **jit_kwargs)
+    blob = compiled.runtime_executable().serialize()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(blob)
+    return (
+        "csrc/pjrt_runner <plugin.so> " + path + " " + " ".join(specs)
+    )
 
 
 # -- specialization spaces ---------------------------------------------------
