@@ -81,7 +81,7 @@ def bench_pair(fused, base, args, iters=100, perturb_idx=0):
     `iters` should size the measured window ≳300 ms (RPC jitter is tens
     of ms per sample). Returns (fused_ms, base_ms, ratio)."""
     return perf_pair_loop(
-        fused, base, args, iters=iters, rounds=5, perturb_idx=perturb_idx
+        fused, base, args, iters=iters, rounds=7, perturb_idx=perturb_idx
     )
 
 

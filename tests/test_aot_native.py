@@ -78,9 +78,18 @@ def test_runner_cli_contract(tmp_path):
 
 
 def test_runner_input_pattern_matches_python():
-    """The runner's deterministic fill pattern (pjrt_runner.cc) pinned
-    byte-for-byte — the on-chip check's bit-exact comparison depends on
-    both sides generating identical inputs."""
+    """The runner's deterministic fill pattern pinned byte-for-byte
+    AGAINST THE C++ SOURCE — the on-chip check's bit-exact comparison
+    depends on pjrt_runner.cc, scripts/pjrt_runner_check.sh and the
+    Python golden all generating identical inputs, so an edit to the .cc
+    expression must fail here, not as a confusing on-chip MISMATCH."""
+    src = open(os.path.join(REPO, "csrc", "pjrt_runner.cc")).read()
+    assert "(i * 131) % 241 % 63" in src, (
+        "fill pattern in pjrt_runner.cc changed — update the Python "
+        "golden in scripts/pjrt_runner_check.sh and this test TOGETHER"
+    )
+    sh = open(os.path.join(REPO, "scripts", "pjrt_runner_check.sh")).read()
+    assert "(i * 131) % 241 % 63" in sh
     i = np.arange(64, dtype=np.uint64)
     expect = ((i * 131) % 241 % 63).astype(np.uint8)
     assert expect.max() < 63  # bf16-safe: high bytes stay finite/positive

@@ -178,3 +178,38 @@ def test_stage_slice():
     )(jnp.zeros(2))
     np.testing.assert_allclose(np.asarray(got)[0], np.asarray(layers[0]["w"]))
     np.testing.assert_allclose(np.asarray(got)[1], np.asarray(layers[2]["w"]))
+
+
+def test_pipeline_remat_backward_matches():
+    """remat=True (stage checkpointing — the 1F1B memory bound) must not
+    change gradients, only the recompute schedule."""
+    pp, n_layers, m_batches, h, mb = 4, 4, 3, 8, 4
+    layers = _make(n_layers, h, jax.random.PRNGKey(5))
+    x = jax.random.normal(jax.random.PRNGKey(6), (m_batches, mb, h))
+    mesh = _pp_mesh(pp)
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+    def grads_fn(remat):
+        def loss_pp(stacked, x):
+            me = jax.lax.axis_index("pp")
+            stage = jax.tree.map(lambda s: s[me], stacked)
+            y = pipeline_apply(
+                lambda xb, st: _mlp_block(xb, st), stage, x,
+                axis="pp", remat=remat,
+            )
+            return jnp.mean(y * y)
+
+        return jax.jit(
+            jax.shard_map(
+                lambda x, st: jax.grad(loss_pp)(st, x), mesh=mesh,
+                in_specs=(P(None, None, None), P(None)), out_specs=P(None),
+                check_vma=False,
+            )
+        )(x, stacked)
+
+    g_plain = grads_fn(False)
+    g_remat = grads_fn(True)
+    np.testing.assert_allclose(
+        np.asarray(g_remat["w"]), np.asarray(g_plain["w"]),
+        rtol=1e-5, atol=1e-6,
+    )

@@ -22,6 +22,8 @@ import dataclasses
 import functools
 from typing import Any
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -47,19 +49,31 @@ def _shard_of(s_max: int, n: int) -> int:
     return s_max // n
 
 
-def _mask_store_and_lens(cfg, cache, li, upd_k, upd_v, pos, me, s_shard):
-    """Owner-gated cache write + per-PE valid lengths, shared by both cache
-    strategies (a fix here must hold for contiguous AND paged)."""
-    owner = pos // s_shard
-    k_sh = jnp.where(me == owner, upd_k, cache["k"][li])
-    v_sh = jnp.where(me == owner, upd_v, cache["v"][li])
+def _local_lens(pos_b, me, s_shard):
+    """Per-PE valid prefix per sequence: positions are global; this PE's
+    shard covers ``[me*s_shard, (me+1)*s_shard)``."""
+    return jnp.clip(pos_b + 1 - me * s_shard, 0, s_shard).astype(jnp.int32)
+
+
+def _mask_store_and_lens(
+    cfg, cache, li, upd_k, upd_v, pos_b, me, s_shard, gate_batch=True
+):
+    """Owner-gated cache write + per-PE valid lengths. ``pos_b`` is
+    per-sequence ``[b]`` (ragged decode; the lockstep path broadcasts a
+    scalar). ``gate_batch=True`` gates ownership per sequence along the
+    leading batch dim (the CONTIGUOUS layout); the paged pool is
+    page-leading, gates its scatter INDICES instead (non-owner rows go
+    out of range and drop), and passes ``gate_batch=False`` with
+    fully-gated updates."""
+    if gate_batch:
+        owner_b = pos_b // s_shard                   # [b]
+        sel = (me == owner_b).reshape((-1,) + (1,) * (upd_k.ndim - 1))
+        upd_k = jnp.where(sel, upd_k, cache["k"][li])
+        upd_v = jnp.where(sel, upd_v, cache["v"][li])
     cache = dict(
-        cache, k=cache["k"].at[li].set(k_sh), v=cache["v"].at[li].set(v_sh)
+        cache, k=cache["k"].at[li].set(upd_k), v=cache["v"].at[li].set(upd_v)
     )
-    local_lens = jnp.full(
-        (cfg.batch,), jnp.clip(pos + 1 - me * s_shard, 0, s_shard), jnp.int32
-    )
-    return k_sh, v_sh, cache, local_lens
+    return upd_k, upd_v, cache, _local_lens(pos_b, me, s_shard)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,23 +100,23 @@ class KVCacheSpec:
         return cache
 
     def update_and_attend(
-        self, cfg, cache, li, k_new, v_new, q, pos, me, n,
+        self, cfg, cache, li, k_new, v_new, q, pos_b, me, n,
         fd_config, interpret,
     ):
-        """Owning PE appends this position's k/v into its sequence shard,
-        then SP flash-decode partials merge by log-sum-exp."""
+        """Owning PE appends each sequence's k/v at ITS position into the
+        sequence shard, then SP flash-decode partials merge by
+        log-sum-exp. ``pos_b [b]`` may be ragged (continuous batching)."""
         s_shard = _shard_of(self.s_max, n)
-        off = pos % s_shard
-        upd_k = jax.lax.dynamic_update_slice(
-            cache["k"][li], k_new.astype(cache["k"].dtype)[:, :, None, :],
-            (0, 0, off, 0),
+        off_b = pos_b % s_shard                          # [b]
+        bidx = jnp.arange(cfg.batch)
+        upd_k = cache["k"][li].at[bidx, :, off_b, :].set(
+            k_new.astype(cache["k"].dtype)
         )
-        upd_v = jax.lax.dynamic_update_slice(
-            cache["v"][li], v_new.astype(cache["v"].dtype)[:, :, None, :],
-            (0, 0, off, 0),
+        upd_v = cache["v"][li].at[bidx, :, off_b, :].set(
+            v_new.astype(cache["v"].dtype)
         )
         k_sh, v_sh, cache, local_lens = _mask_store_and_lens(
-            cfg, cache, li, upd_k, upd_v, pos, me, s_shard
+            cfg, cache, li, upd_k, upd_v, pos_b, me, s_shard
         )
         attn = flash_decode_distributed(
             q.astype(k_sh.dtype), k_sh, v_sh, local_lens,
@@ -122,6 +136,14 @@ class PagedKVCacheSpec:
 
     s_max: int
     page_size: int
+    # static_table=True pre-assigns each sequence slot its own page range
+    # at init and disables the runtime bump allocator — required for
+    # CONTINUOUS batching, where slots reset mid-run (the bump counter
+    # never reclaims, so re-admissions would run the pool out and the
+    # out-of-range scatters would silently drop; ≙ vLLM restarting a
+    # sequence with a fresh block list). The block-table indirection and
+    # paged kernel path are identical either way.
+    static_table: bool = False
 
     def _geometry(self, cfg, n: int) -> tuple[int, int]:
         s_shard = _shard_of(self.s_max, n)
@@ -141,10 +163,21 @@ class PagedKVCacheSpec:
             cfg.n_layers, n * n_pages, cfg.n_kv_heads, self.page_size,
             cfg.head_dim,
         )
+        if self.static_table:
+            bt = jnp.broadcast_to(
+                (
+                    jnp.arange(cfg.batch, dtype=jnp.int32)[:, None]
+                    * pages_per_seq
+                    + jnp.arange(pages_per_seq, dtype=jnp.int32)[None, :]
+                ),
+                (n, cfg.batch, pages_per_seq),
+            )
+        else:
+            bt = jnp.zeros((n, cfg.batch, pages_per_seq), jnp.int32)
         return dict(
             k=jnp.zeros(shape, cfg.dtype),
             v=jnp.zeros(shape, cfg.dtype),
-            block_table=jnp.zeros((n, cfg.batch, pages_per_seq), jnp.int32),
+            block_table=bt,
             n_alloc=jnp.zeros((n,), jnp.int32),
         )
 
@@ -155,40 +188,56 @@ class PagedKVCacheSpec:
             block_table=P(t, None, None), n_alloc=P(t),
         )
 
-    def pre_step(self, cfg, cache: dict, pos, me, n: int) -> dict:
-        """Allocate a physical page per sequence when this step's position
-        opens a new logical page on the owning PE (runs once per step —
-        the table is shared by all layers, whose pools allocate in
-        lockstep)."""
+    def pre_step(self, cfg, cache: dict, pos_b, me, n: int) -> dict:
+        """Allocate a physical page per sequence when ITS position opens a
+        new logical page on the owning PE (runs once per step — the table
+        is shared by all layers, whose pools allocate in lockstep).
+        Ragged ``pos_b``: needing sequences claim consecutive ids off the
+        bump counter via an exclusive prefix sum."""
+        if self.static_table:
+            return cache
         s_shard = self.s_max // n
-        off = pos % s_shard
-        page_idx = off // self.page_size
-        need = (me == pos // s_shard) & (off % self.page_size == 0)
-        new_ids = cache["n_alloc"][0] + jnp.arange(cfg.batch, dtype=jnp.int32)
-        bt = jnp.where(
-            need,
-            cache["block_table"].at[0, :, page_idx].set(new_ids),
-            cache["block_table"],
+        off_b = pos_b % s_shard                          # [b]
+        page_idx_b = off_b // self.page_size
+        need_b = (me == pos_b // s_shard) & (off_b % self.page_size == 0)
+        order = jnp.cumsum(need_b.astype(jnp.int32)) - need_b
+        new_ids = cache["n_alloc"][0] + order.astype(jnp.int32)
+        bidx = jnp.arange(cfg.batch)
+        cur = cache["block_table"][0, bidx, page_idx_b]
+        bt = cache["block_table"].at[0, bidx, page_idx_b].set(
+            jnp.where(need_b, new_ids, cur)
         )
-        n_alloc = cache["n_alloc"] + jnp.where(need, cfg.batch, 0)
+        n_alloc = cache["n_alloc"] + jnp.sum(need_b).astype(jnp.int32)
         return dict(cache, block_table=bt, n_alloc=n_alloc)
 
     def update_and_attend(
-        self, cfg, cache, li, k_new, v_new, q, pos, me, n,
+        self, cfg, cache, li, k_new, v_new, q, pos_b, me, n,
         fd_config, interpret,
     ):
         s_shard = _shard_of(self.s_max, n)
-        off = pos % s_shard
-        slot = off % self.page_size
-        page_ids = cache["block_table"][0, :, off // self.page_size]  # [b]
-        upd_k = cache["k"][li].at[page_ids, :, slot].set(
-            k_new.astype(cache["k"].dtype)
+        off_b = pos_b % s_shard                          # [b]
+        slot_b = off_b % self.page_size
+        bidx = jnp.arange(cfg.batch)
+        page_ids = cache["block_table"][0, bidx, off_b // self.page_size]
+        # page-leading pool: ownership gates the scatter INDICES —
+        # non-owner rows are sent out of range and dropped. (Gating the
+        # VALUES instead would keep non-owner rows in the scatter, and a
+        # non-owner whose table entry still holds the 0 default would
+        # alias a real page: duplicate-index scatter order is
+        # unspecified, so its stale write-back could clobber the owner's
+        # k_new.)
+        own_b = me == pos_b // s_shard                   # [b]
+        n_pool = cache["k"].shape[1]
+        safe_ids = jnp.where(own_b, page_ids, n_pool)    # OOB → dropped
+        upd_k = cache["k"][li].at[safe_ids, :, slot_b].set(
+            k_new.astype(cache["k"].dtype), mode="drop"
         )
-        upd_v = cache["v"][li].at[page_ids, :, slot].set(
-            v_new.astype(cache["v"].dtype)
+        upd_v = cache["v"][li].at[safe_ids, :, slot_b].set(
+            v_new.astype(cache["v"].dtype), mode="drop"
         )
         k_sh, v_sh, cache, local_lens = _mask_store_and_lens(
-            cfg, cache, li, upd_k, upd_v, pos, me, s_shard
+            cfg, cache, li, upd_k, upd_v, pos_b, me, s_shard,
+            gate_batch=False,
         )
         attn = paged_flash_decode_distributed(
             q.astype(k_sh.dtype), k_sh, v_sh, local_lens,
@@ -202,7 +251,8 @@ def decode_step(
     params: dict,
     cache: dict,
     tokens: jax.Array,   # [b] int32 — this step's input token per sequence
-    pos: jax.Array,      # [] int32 — current position (same for the batch)
+    pos: jax.Array,      # [] or [b] int32 — position (scalar = lockstep
+                         # batch; vector = ragged/continuous batching)
     *,
     spec: KVCacheSpec | PagedKVCacheSpec,
     fd_config: FlashDecodeConfig | None = None,
@@ -220,8 +270,8 @@ def decode_step(
     assert c.n_kv_heads % n == 0, (c.n_kv_heads, n)
 
     x = params["embed"][tokens]  # [b, H] replicated
-    pos1 = pos[None].astype(jnp.int32)
-    cache = spec.pre_step(c, cache, pos, me, n)
+    pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (c.batch,))
+    cache = spec.pre_step(c, cache, pos_b, me, n)
 
     for li, p in enumerate(params["layers"]):
         # --- attention (SP flash decode over the sharded cache) ---
@@ -235,11 +285,13 @@ def decode_step(
         q = qkv[:, :, :g, :].reshape(c.batch, 1, c.n_q_heads, d)
         k_new = qkv[:, :, g, :].reshape(c.batch, 1, c.n_kv_heads, d)
         v_new = qkv[:, :, g + 1, :]                         # [b, h_kv, d]
-        q = rope(q, pos1, c.rope_theta)[:, 0]               # [b, hq, d]
-        k_new = rope(k_new, pos1, c.rope_theta)[:, 0]       # [b, h_kv, d]
+        # per-sequence rotary position (ragged decode): vmap over batch
+        rope_b = jax.vmap(lambda xi, pi: rope(xi, pi, c.rope_theta))
+        q = rope_b(q, pos_b[:, None])[:, 0]                 # [b, hq, d]
+        k_new = rope_b(k_new, pos_b[:, None])[:, 0]         # [b, h_kv, d]
 
         attn, cache = spec.update_and_attend(
-            c, cache, li, k_new, v_new, q, pos, me, n, fd_config, interpret
+            c, cache, li, k_new, v_new, q, pos_b, me, n, fd_config, interpret
         )                                                    # [b, hq, d] f32
         # row-parallel out-proj on the LOCAL head slice + psum
         attn_loc = jax.lax.dynamic_slice_in_dim(
@@ -338,3 +390,161 @@ def generate(
         cache, prompt,
     )
     return out[prompt_len - 1 :].T  # [b, n_steps]
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request for :class:`ContinuousBatcher`."""
+
+    prompt: list            # token ids, len >= 1
+    max_new_tokens: int
+    eos_id: int | None = None
+    uid: Any = None
+
+
+class ContinuousBatcher:
+    """Continuous batching over the ragged decode step (beyond the
+    reference — its serving surface stops at the decode kernel; this is
+    the vLLM-shaped scheduler the kernel exists for).
+
+    TPU-idiomatic split: ONE jitted SPMD step (static shapes, per-slot
+    position vector) does all device work; the host only picks each
+    slot's next token (prompt feed vs argmax), admits queued requests
+    into free slots, and collects finished sequences between steps. Slots
+    run RAGGED — a new request starts at position 0 while its neighbors
+    are mid-generation; eviction is just the slot going idle (its stale
+    cache is masked by the per-sequence ``kv_lens = pos+1`` and fully
+    overwritten on re-admission).
+
+        batcher = ContinuousBatcher(cfg, params, mesh, s_max=256)
+        batcher.submit(Request([1, 2, 3], max_new_tokens=8))
+        done = batcher.run()     # or step() in a serving loop
+    """
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        params: dict,
+        mesh: Mesh,
+        *,
+        s_max: int,
+        page_size: int | None = None,
+        fd_config: FlashDecodeConfig | None = None,
+        interpret: Any = None,
+    ):
+        self.cfg, self.mesh, self.s_max = cfg, mesh, s_max
+        n = mesh.shape[cfg.axis]
+        if page_size and fd_config is not None:
+            raise ValueError(
+                "fd_config tiles the contiguous kernel; with page_size the "
+                "page is the block — pass one or the other"
+            )
+        self.spec = (
+            PagedKVCacheSpec(s_max, page_size, static_table=True)
+            if page_size else KVCacheSpec(s_max)
+        )
+        self.cache = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            self.spec.init(cfg, n), self.spec.specs(cfg),
+        )
+        self.params = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, param_specs(cfg),
+        )
+        step = functools.partial(
+            decode_step, cfg, spec=self.spec, fd_config=fd_config,
+            interpret=interpret,
+        )
+        self._step = jax.jit(
+            jax.shard_map(
+                step, mesh=mesh,
+                in_specs=(
+                    param_specs(cfg), self.spec.specs(cfg), P(None), P(None),
+                ),
+                out_specs=(P(None, None), self.spec.specs(cfg)),
+                check_vma=False,
+            )
+        )
+        b = cfg.batch
+        self.pos = np.zeros(b, np.int32)        # next write position per slot
+        self.tok = np.zeros(b, np.int32)        # next input token per slot
+        self.slot_req: list[Request | None] = [None] * b
+        self.slot_fed: list[int] = [0] * b      # prompt tokens already fed
+        self.slot_out: list[list] = [[] for _ in range(b)]
+        self.queue: list[Request] = []
+        self.finished: list[tuple[Any, list]] = []
+
+    def submit(self, req: Request) -> None:
+        if not req.prompt:
+            raise ValueError("empty prompt (need at least one token)")
+        if len(req.prompt) + req.max_new_tokens > self.s_max:
+            raise ValueError(
+                f"prompt {len(req.prompt)} + max_new {req.max_new_tokens} "
+                f"exceeds s_max={self.s_max}"
+            )
+        self.queue.append(req)
+
+    def _admit(self) -> None:
+        for i, r in enumerate(self.slot_req):
+            if r is None and self.queue:
+                req = self.queue.pop(0)
+                self.slot_req[i] = req
+                self.pos[i] = 0
+                self.tok[i] = req.prompt[0]
+                self.slot_fed[i] = 1
+                self.slot_out[i] = []
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and all(r is None for r in self.slot_req)
+
+    def step(self) -> None:
+        """One ragged decode step for every slot + host scheduling."""
+        self._admit()
+        if self.idle:
+            return
+        logits, self.cache = self._step(
+            self.params, self.cache,
+            jnp.asarray(self.tok), jnp.asarray(self.pos),
+        )
+        nxt = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        for i, req in enumerate(self.slot_req):
+            if req is None:
+                continue  # idle slot decoded a dummy token; ignore
+            if self.slot_fed[i] < len(req.prompt):
+                # still feeding the prompt: the model's prediction is
+                # ignored, the next input is the given token
+                self.tok[i] = req.prompt[self.slot_fed[i]]
+                self.slot_fed[i] += 1
+            else:
+                t = int(nxt[i])
+                self.slot_out[i].append(t)
+                self.tok[i] = t
+                done = len(self.slot_out[i]) >= req.max_new_tokens or (
+                    req.eos_id is not None and t == req.eos_id
+                )
+                if done:
+                    self.finished.append((req.uid, self.slot_out[i]))
+                    self.slot_req[i] = None
+                    continue
+            self.pos[i] += 1
+
+    def run(self, max_steps: int = 100000) -> list[tuple[Any, list]]:
+        """Drive until every queued request finishes; returns
+        ``[(uid, generated_tokens), ...]`` in completion order. Raises if
+        `max_steps` elapse with work still in flight — a partial return
+        would be indistinguishable from completion."""
+        for _ in range(max_steps):
+            if self.idle:
+                break
+            self.step()
+        if not self.idle:
+            pending = [r.uid for r in self.slot_req if r is not None] + [
+                r.uid for r in self.queue
+            ]
+            raise RuntimeError(
+                f"run(max_steps={max_steps}) exhausted with requests still "
+                f"in flight: {pending}"
+            )
+        out, self.finished = self.finished, []
+        return out

@@ -29,6 +29,7 @@ def pipeline_apply(
     x_microbatches: jax.Array,   # [M, mb, ...] — full input, every stage
     *,
     axis: str = "pp",
+    remat: bool = False,
 ) -> jax.Array:
     """Run ``block_fn`` through P pipeline stages over M microbatches
     (call inside ``jax.shard_map``).
@@ -39,7 +40,17 @@ def pipeline_apply(
     processes microbatch ``t - s`` at tick ``t``; outputs surface on the
     last stage and are returned (valid on every PE via a final broadcast
     hop). Returns ``[M, mb, ...]``.
+
+    ``remat=True`` checkpoints each stage application: under autodiff the
+    scan otherwise keeps every tick's activations live until the backward
+    replay — the GPipe memory profile. Remat recomputes them per backward
+    tick instead, bounding live activations to O(1) microbatches per
+    stage — the memory bound 1F1B scheduling buys, paid in recompute
+    FLOPs rather than schedule complexity (the TPU-idiomatic trade: XLA
+    control flow stays a single static scan).
     """
+    if remat:
+        block_fn = jax.checkpoint(block_fn)
     n = int(jax.lax.axis_size(axis))
     me = jax.lax.axis_index(axis)
     m_total = x_microbatches.shape[0]

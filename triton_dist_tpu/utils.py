@@ -269,9 +269,16 @@ def perf_pair_loop(
     _sync(run_b(jnp.int32(n1), arrs_b))
     ta, tb, ratios = [], [], []
     bound_a = bound_b = float("inf")
-    for _ in range(2 * rounds):  # extra attempts when jitter eats a sample
-        da, ba = sample(run_a, arrs_a)
-        db, bb = sample(run_b, arrs_b)
+    for r in range(2 * rounds):  # extra attempts when jitter eats a sample
+        # alternate the within-round order (A,B / B,A): any drift linear
+        # over a round biases the two orders oppositely, so it cancels in
+        # the median instead of pushing every ratio the same way
+        if r % 2 == 0:
+            da, ba = sample(run_a, arrs_a)
+            db, bb = sample(run_b, arrs_b)
+        else:
+            db, bb = sample(run_b, arrs_b)
+            da, ba = sample(run_a, arrs_a)
         bound_a, bound_b = min(bound_a, ba), min(bound_b, bb)
         if da > 0 and db > 0:
             ta.append(da)
