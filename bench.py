@@ -117,11 +117,16 @@ def bench_gemm_rs(mesh, n):
 
     fused = lambda a, b: gemm_rs_op(a, b, mesh)
 
-    @jax.jit
+    # not pre-jitted, and no world-1 no-op constraint: the timing loop
+    # jits both sides, and keeping the baseline's HLO literally identical
+    # to the world-1 sentinel's lets perf_pair_loop recognize them as the
+    # same program (ratio ≡ 1) instead of timing buffer-placement luck
     def unfused(a, b):
         # constrain the output to the fused op's M-sharded layout so XLA
         # emits the semantically equivalent reduce-scatter, not an all-reduce
         out = jnp.dot(a, b, preferred_element_type=jnp.bfloat16)
+        if n == 1:
+            return out
         return jax.lax.with_sharding_constraint(
             out, NamedSharding(mesh, P("tp", None))
         )
@@ -160,11 +165,17 @@ def bench_all_to_all(mesh, n):
 
     fused = lambda t, s: fast_all_to_all_op(t, s, mesh)
 
-    @jax.jit
     def xla_a2a(t, s):
-        # golden: XLA all-to-all over the slab dim (sharding-induced)
-        return jax.lax.with_sharding_constraint(
-            t.swapaxes(0, 1), NamedSharding(mesh, P("tp", None, None, None))
+        # golden: XLA all-to-all over the slab dim (sharding-induced);
+        # splits exchange alongside (their transpose at n>1, identity at
+        # world-1 — where this program equals the fused identity exactly)
+        if n == 1:
+            return t, s
+        return (
+            jax.lax.with_sharding_constraint(
+                t.swapaxes(0, 1), NamedSharding(mesh, P("tp", None, None, None))
+            ),
+            s.swapaxes(0, 1),
         )
 
     fused(tokens, splits)  # autotune/compile before the loop
@@ -301,8 +312,7 @@ def bench_ag_gemm(mesh, n):
 
     fused = lambda a, b: ag_gemm_op(a, b, mesh)
 
-    @jax.jit
-    def unfused(a, b):
+    def unfused(a, b):  # not pre-jitted: see bench_gemm_rs
         return jnp.dot(a, b, preferred_element_type=jnp.bfloat16)
 
     out = fused(a, b)  # eager call: correctness + autotune before the loop

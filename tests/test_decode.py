@@ -154,3 +154,69 @@ def test_continuous_batcher_eos_and_reuse(mesh4):
     done = dict(batcher.run())
     assert done["a"] == [eos]        # stopped at eos immediately
     assert len(done["b"]) == 2       # queued request ran after re-admission
+
+
+def test_generate_prefill_matches_token_by_token(mesh4):
+    """prefill=True (one full-forward prompt pass writing every KV
+    position at once) must reproduce the token-by-token warmup exactly —
+    same cache contents, same greedy tokens."""
+    b, prompt_len, n_steps, s_max = 2, 4, 5, 16
+    cfg = TransformerConfig(
+        vocab=32, hidden=32, ffn=64, n_layers=2, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=b, seq=prompt_len,
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+    )
+    params = init_params(jax.random.PRNGKey(4), cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(5), (b, prompt_len), 0, cfg.vocab, jnp.int32
+    )
+    fd = FlashDecodeConfig(block_s=4)
+    want = generate(
+        cfg, params, prompt, n_steps, mesh4, s_max=s_max, fd_config=fd,
+    )
+    got = generate(
+        cfg, params, prompt, n_steps, mesh4, s_max=s_max, fd_config=fd,
+        prefill=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_continuous_batcher_prefill_admission(mesh4):
+    """prefill=True admission (one masked full-forward pass per admitted
+    request, ragged pick of each slot's last-prompt-position logits) must
+    generate exactly the same tokens as token-by-token admission —
+    including re-admission over a dirty cache and EOS mid-prefill."""
+    from triton_dist_tpu.models.decode import ContinuousBatcher, Request
+
+    s_max = 16
+    cfg = TransformerConfig(
+        vocab=32, hidden=32, ffn=64, n_layers=2, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=2, seq=8,
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    key = jax.random.PRNGKey(9)
+    reqs = [
+        (list(np.asarray(jax.random.randint(
+            jax.random.fold_in(key, i), (pl,), 0, cfg.vocab, jnp.int32
+        ))), mn)
+        for i, (pl, mn) in enumerate([(3, 4), (6, 3), (2, 5), (4, 2)])
+    ]
+
+    def serve(prefill):
+        b = ContinuousBatcher(
+            cfg, params, mesh4, s_max=s_max,
+            fd_config=FlashDecodeConfig(block_s=4), prefill=prefill,
+        )
+        for i, (p, mn) in enumerate(reqs):
+            b.submit(Request(p, max_new_tokens=mn, uid=i))
+        return dict(b.run(max_steps=300))
+
+    want = serve(False)
+    got = serve(True)
+    assert set(got) == set(want) == {0, 1, 2, 3}
+    for uid in want:
+        np.testing.assert_array_equal(
+            np.asarray(got[uid], np.int32), np.asarray(want[uid], np.int32),
+            err_msg=f"request {uid}",
+        )

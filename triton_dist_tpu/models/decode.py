@@ -321,10 +321,16 @@ def generate(
     s_max: int,
     page_size: int | None = None,
     fd_config: FlashDecodeConfig | None = None,
+    prefill: bool = False,
     interpret: Any = None,
 ) -> jax.Array:
-    """Greedy generation: feed the prompt token-by-token (cache warmup),
-    then decode ``n_steps`` new tokens. Returns ``[b, n_steps]``.
+    """Greedy generation: process the prompt (cache warmup), then decode
+    ``n_steps`` new tokens. Returns ``[b, n_steps]``.
+
+    ``prefill=True`` runs the prompt through ONE full transformer forward
+    (``prefill_cache`` — MXU-rate prompt processing, the serving-system
+    prefill/decode split) instead of token-by-token; contiguous cache
+    only, and ``b*prompt_len`` must divide over the axis.
 
     ``page_size`` switches the KV cache to the paged layout (page pool +
     block table, runtime page allocation) — the serving-shaped
@@ -353,6 +359,17 @@ def generate(
         PagedKVCacheSpec(s_max, page_size) if page_size else KVCacheSpec(s_max)
     )
     n = mesh.shape[cfg.axis]
+    if prefill:
+        if page_size:
+            raise ValueError(
+                "prefill=True writes the contiguous layout; the paged "
+                "cache warms token-by-token"
+            )
+        if (b * prompt_len) % n:
+            raise ValueError(
+                f"prefill needs b*prompt_len={b * prompt_len} divisible "
+                f"over {n} PEs (the prompt shard is the model's token shard)"
+            )
     cache = jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         spec.init(cfg, n), spec.specs(cfg),
@@ -375,10 +392,29 @@ def generate(
         )
         return outs  # [prompt_len + n_steps - 1, b]
 
+    def run_prefill(params, cache, prompt):
+        pcfg = dataclasses.replace(cfg, seq=prompt_len)
+        prompt_loc = _prompt_shard(prompt, b, prompt_len, cfg.axis)
+        cache, last = prefill_cache(
+            pcfg, params, cache, prompt_loc, spec, s_max
+        )
+        tok0 = jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+        def body(carry, i):
+            cache, tok = carry
+            logits, cache = step(params, cache, tok, prompt_len + i)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (cache, nxt), nxt
+
+        (_, _), outs = jax.lax.scan(
+            body, (cache, tok0), jnp.arange(n_steps - 1)
+        )
+        return jnp.concatenate([tok0[None], outs], axis=0)  # [n_steps, b]
+
     cache_specs = spec.specs(cfg)
     out = jax.jit(
         jax.shard_map(
-            run, mesh=mesh,
+            run_prefill if prefill else run, mesh=mesh,
             in_specs=(param_specs(cfg), cache_specs, P(None, None)),
             out_specs=P(None, None), check_vma=False,
         )
@@ -389,7 +425,9 @@ def generate(
         ),
         cache, prompt,
     )
-    return out[prompt_len - 1 :].T  # [b, n_steps]
+    if prefill:
+        return out.T                    # [b, n_steps]
+    return out[prompt_len - 1 :].T      # [b, n_steps]
 
 
 @dataclasses.dataclass
@@ -430,6 +468,7 @@ class ContinuousBatcher:
         s_max: int,
         page_size: int | None = None,
         fd_config: FlashDecodeConfig | None = None,
+        prefill: bool = False,
         interpret: Any = None,
     ):
         self.cfg, self.mesh, self.s_max = cfg, mesh, s_max
@@ -439,6 +478,13 @@ class ContinuousBatcher:
                 "fd_config tiles the contiguous kernel; with page_size the "
                 "page is the block — pass one or the other"
             )
+        if prefill and page_size:
+            raise ValueError(
+                "prefill admission writes the contiguous layout; the paged "
+                "cache warms token-by-token"
+            )
+        self.prefill = prefill
+        self._prefill_progs: dict[int, Any] = {}
         self.spec = (
             PagedKVCacheSpec(s_max, page_size, static_table=True)
             if page_size else KVCacheSpec(s_max)
@@ -455,6 +501,9 @@ class ContinuousBatcher:
             decode_step, cfg, spec=self.spec, fd_config=fd_config,
             interpret=interpret,
         )
+        # cache donated: a serving-sized cache is gigabytes and the old
+        # buffer is dead the moment the step returns — without donation
+        # every token pays a second full cache allocation + copy
         self._step = jax.jit(
             jax.shard_map(
                 step, mesh=mesh,
@@ -463,7 +512,8 @@ class ContinuousBatcher:
                 ),
                 out_specs=(P(None, None), self.spec.specs(cfg)),
                 check_vma=False,
-            )
+            ),
+            donate_argnums=(1,),
         )
         b = cfg.batch
         self.pos = np.zeros(b, np.int32)        # next write position per slot
@@ -477,6 +527,8 @@ class ContinuousBatcher:
     def submit(self, req: Request) -> None:
         if not req.prompt:
             raise ValueError("empty prompt (need at least one token)")
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
         if len(req.prompt) + req.max_new_tokens > self.s_max:
             raise ValueError(
                 f"prompt {len(req.prompt)} + max_new {req.max_new_tokens} "
@@ -484,15 +536,95 @@ class ContinuousBatcher:
             )
         self.queue.append(req)
 
+    def _prefill_prog(self, bucket: int):
+        """Jitted masked-prefill program for one padded prompt length
+        (compiled once per bucket; buckets are powers of two so a serving
+        mix of lengths stays at a handful of compilations)."""
+        if bucket in self._prefill_progs:
+            return self._prefill_progs[bucket]
+        cfg, mesh, spec, s_max = self.cfg, self.mesh, self.spec, self.s_max
+        b = cfg.batch
+        pcfg = dataclasses.replace(cfg, seq=bucket)
+
+        def fn(params, cache, prompt, mask, pick):
+            prompt_loc = _prompt_shard(prompt, b, bucket, cfg.axis)
+            return prefill_cache(
+                pcfg, params, cache, prompt_loc, spec, s_max,
+                slot_mask=mask, pick=pick,
+            )
+
+        prog = jax.jit(
+            jax.shard_map(
+                fn, mesh=mesh,
+                in_specs=(
+                    param_specs(cfg), spec.specs(cfg), P(None, None),
+                    P(None), P(None),
+                ),
+                out_specs=(spec.specs(cfg), P(None, None)),
+                check_vma=False,
+            ),
+            donate_argnums=(1,),  # see self._step: the old cache is dead
+        )
+        self._prefill_progs[bucket] = prog
+        return prog
+
+    def _bucket(self, length: int) -> int:
+        n = self.mesh.shape[self.cfg.axis]
+        bucket = 1
+        while bucket < self.s_max and (
+            bucket < length or (self.cfg.batch * bucket) % n
+        ):
+            bucket *= 2
+        bucket = min(bucket, self.s_max)
+        if bucket < length or (self.cfg.batch * bucket) % n:
+            # e.g. an axis size with an odd prime factor that divides
+            # neither batch nor any power-of-two bucket — no valid shard
+            raise ValueError(
+                f"no prefill bucket <= s_max={self.s_max} fits prompt "
+                f"length {length} with b*bucket divisible over {n} PEs"
+            )
+        return bucket
+
+    def _admit_prefill(self, i: int, req: Request) -> None:
+        """MXU-rate admission: one masked full-forward pass writes the
+        whole prompt's KV and yields the first generated token."""
+        L = len(req.prompt)
+        bucket = self._bucket(L)
+        prompt = np.zeros((self.cfg.batch, bucket), np.int32)
+        prompt[i, :L] = req.prompt
+        # pad positions write junk KV beyond L-1, but decode overwrites
+        # each position before kv_lens ever exposes it; the first
+        # generated token comes from position L-1's logits (pick)
+        pick = np.zeros(self.cfg.batch, np.int32)
+        pick[i] = L - 1
+        self.cache, last = self._prefill_prog(bucket)(
+            self.params, self.cache, jnp.asarray(prompt),
+            jnp.asarray(np.arange(self.cfg.batch) == i),
+            jnp.asarray(pick),
+        )
+        t0 = int(np.asarray(jnp.argmax(last[i])))
+        self.slot_fed[i] = L
+        self.slot_out[i] = [t0]
+        self.tok[i] = t0
+        self.pos[i] = L
+        if len(self.slot_out[i]) >= req.max_new_tokens or (
+            req.eos_id is not None and t0 == req.eos_id
+        ):
+            self.finished.append((req.uid, self.slot_out[i]))
+            self.slot_req[i] = None
+
     def _admit(self) -> None:
         for i, r in enumerate(self.slot_req):
             if r is None and self.queue:
                 req = self.queue.pop(0)
                 self.slot_req[i] = req
-                self.pos[i] = 0
-                self.tok[i] = req.prompt[0]
-                self.slot_fed[i] = 1
                 self.slot_out[i] = []
+                if self.prefill and len(req.prompt) > 1:
+                    self._admit_prefill(i, req)
+                else:
+                    self.pos[i] = 0
+                    self.tok[i] = req.prompt[0]
+                    self.slot_fed[i] = 1
 
     @property
     def idle(self) -> bool:
@@ -548,3 +680,85 @@ class ContinuousBatcher:
             )
         out, self.finished = self.finished, []
         return out
+
+
+def _prompt_shard(prompt, b, length, axis):
+    """This PE's contiguous slice of the b-major flattened prompt — the
+    model's token sharding (shared by generate's prefill and the
+    batcher's admission program)."""
+    n = int(jax.lax.axis_size(axis))
+    me = jax.lax.axis_index(axis)
+    m_loc = b * length // n
+    return jax.lax.dynamic_slice_in_dim(
+        prompt.reshape(-1), me * m_loc, m_loc, 0
+    )
+
+
+def prefill_cache(
+    cfg, params, cache, prompt_loc, spec, s_max, slot_mask=None, pick=None
+):
+    """Chunked prefill (call inside shard_map): run the full TP transformer
+    forward over the flattened prompt shard and write every position's
+    post-RoPE k/v into the decode cache in ONE pass — prompt processing at
+    MXU rates instead of token-by-token (the serving-side gap between a
+    decode kernel and a serving system; the reference stops at the
+    kernel). Contiguous cache only: the per-layer head→sequence reshard
+    lands directly in the sequence-sharded layout.
+
+    prompt_loc: ``[b*L/n]`` int32 flattened prompt shard (b-major).
+    ``slot_mask [b] bool`` restricts the cache write to chosen sequences
+    (continuous-batching admission: one slot prefills while its
+    neighbors' cache rows must stay untouched); padded prompt positions
+    beyond a slot's true length are harmless — causal attention keeps
+    them out of earlier positions and the decode-side ``kv_lens`` mask
+    never reads them. Returns ``(cache, last_logits [b, vocab])`` — the
+    cache holds positions ``[0, L)`` and `last_logits` are per-sequence
+    position ``pick``'s (default ``L-1`` — ragged admission passes each
+    slot's true ``len-1``; the row is selected BEFORE the vocab-shard
+    gather, so only ``[b, V]`` ever materializes).
+    """
+    from triton_dist_tpu.models.tp_transformer import TPTransformer
+
+    if not isinstance(spec, KVCacheSpec):
+        raise ValueError(
+            "prefill_cache writes the contiguous layout; paged caches "
+            "warm token-by-token"
+        )
+    c = cfg
+    n = int(jax.lax.axis_size(c.axis))
+    me = jax.lax.axis_index(c.axis)
+    b, L = c.batch, c.seq
+    s_shard = _shard_of(s_max, n)
+
+    model = TPTransformer(c)
+    model.kv_sink = []
+    logits_loc = model(prompt_loc, params)            # [b*L, V/n]
+    for li, (k_loc, v_loc) in enumerate(model.kv_sink):
+        # heads are sharded contiguously, so a tiled gather on the head
+        # dim restores global head order: [b, L, h_kv, d]
+        k_full = jax.lax.all_gather(k_loc, c.axis, axis=2, tiled=True)
+        v_full = jax.lax.all_gather(v_loc, c.axis, axis=2, tiled=True)
+        k_full = jnp.swapaxes(k_full, 1, 2)           # [b, h_kv, L, d]
+        v_full = jnp.swapaxes(v_full, 1, 2)
+        kd = cache["k"].dtype
+        k_pad = jnp.zeros((b, c.n_kv_heads, s_max, c.head_dim), kd)
+        v_pad = jnp.zeros((b, c.n_kv_heads, s_max, c.head_dim), kd)
+        k_pad = k_pad.at[:, :, :L].set(k_full.astype(kd))
+        v_pad = v_pad.at[:, :, :L].set(v_full.astype(kd))
+        k_new = jax.lax.dynamic_slice_in_dim(k_pad, me * s_shard, s_shard, 2)
+        v_new = jax.lax.dynamic_slice_in_dim(v_pad, me * s_shard, s_shard, 2)
+        if slot_mask is not None:
+            sel = slot_mask.reshape(b, 1, 1, 1)
+            k_new = jnp.where(sel, k_new, cache["k"][li])
+            v_new = jnp.where(sel, v_new, cache["v"][li])
+        cache = dict(
+            cache,
+            k=cache["k"].at[li].set(k_new),
+            v=cache["v"].at[li].set(v_new),
+        )
+    if pick is None:
+        pick = jnp.full((b,), L - 1, jnp.int32)
+    rows = jnp.arange(b, dtype=jnp.int32) * L + jnp.clip(pick, 0, L - 1)
+    sel = logits_loc[rows]                            # [b, V/n]
+    last = jax.lax.all_gather(sel, c.axis, axis=1, tiled=True)  # [b, V]
+    return cache, last

@@ -206,6 +206,10 @@ class TPTransformer:
         pos = jnp.arange(s, dtype=jnp.int32)
         q = rope(q, pos, c.rope_theta)
         k = rope(k, pos, c.rope_theta)
+        if getattr(self, "kv_sink", None) is not None:
+            # prefill capture (models/decode.prefill_cache): the post-RoPE
+            # per-layer k/v in this PE's head shard, [b, s, hkv_loc, d]
+            self.kv_sink.append((k, v))
         attn = _causal_gqa_attention(q, k, v, c)   # [b, s, q_dim/n]
         x = x + self._row(attn.reshape(b * s, hq_loc * d), p["wo"])
 
