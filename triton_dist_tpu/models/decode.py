@@ -29,16 +29,31 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.models.tp_transformer import (
+    EPMoETransformerConfig,
+    MoETransformerConfig,
     TransformerConfig,
-    param_specs,
     rmsnorm,
     rope,
+    specs_for,
 )
 from triton_dist_tpu.ops.flash_decode import (
     FlashDecodeConfig,
     flash_decode_distributed,
     paged_flash_decode_distributed,
 )
+
+
+def _specs_for(cfg: TransformerConfig):
+    """Param specs for the serving path: dense or TP-MoE. EP configs are
+    rejected — their expert placement (ep_outer/ep_max_m, tokens traveling
+    to whole experts over the all-to-all) has no decode path here, and
+    silently serving them as plain TP-MoE would ignore those semantics."""
+    if isinstance(cfg, EPMoETransformerConfig):
+        raise NotImplementedError(
+            "EP-MoE configs have no serving decode path (attention-TP + "
+            "expert-parallel FFN); use a TP MoETransformerConfig"
+        )
+    return specs_for(cfg)
 
 
 def _shard_of(s_max: int, n: int) -> int:
@@ -299,11 +314,33 @@ def decode_step(
         ).reshape(c.batch, -1).astype(x.dtype)
         x = x + jax.lax.psum(attn_loc @ p["wo"], c.axis)
 
-        # --- MLP (plain TP: local columns, psum rows) ---
+        # --- MLP ---
         h = rmsnorm(x, p["mlp_norm"], c.norm_eps)
-        gu = (h @ p["w_gate_up"].reshape(c.hidden, -1)).reshape(c.batch, -1, 2)
-        act = jax.nn.silu(gu[..., 0].astype(jnp.float32)).astype(x.dtype) * gu[..., 1]
-        x = x + jax.lax.psum(act @ p["w_down"], c.axis)
+        if isinstance(c, MoETransformerConfig):
+            # decode-shaped MoE: at serving batch sizes every expert's
+            # F-shard weights stream from HBM regardless (weight-bound),
+            # so computing ALL experts with dense einsums + a one-hot
+            # topk combine is the TPU-shaped move — no gather/sort on a
+            # [b, H] activation. (Prefill-sized token counts go through
+            # the fused AG-GroupGEMM pipeline instead.)
+            from triton_dist_tpu.ops.moe_utils import select_experts
+
+            logits = h.astype(jnp.float32) @ p["router"].astype(jnp.float32)
+            tw, ids = select_experts(logits, c.topk)       # [b, topk]
+            hE = jnp.einsum("bh,ehf->ebf", h, p["w_up"])   # [E, b, F/n]
+            act = jax.nn.gelu(hE.astype(jnp.float32)).astype(x.dtype)
+            yE = jnp.einsum("ebf,efh->ebh", act, p["w_down"])
+            wE = (
+                jnp.zeros((c.batch, c.n_experts), jnp.float32)
+                .at[jnp.arange(c.batch)[:, None], ids]
+                .add(tw)
+            )
+            y = jnp.einsum("be,ebh->bh", wE, yE.astype(jnp.float32))
+            x = x + jax.lax.psum(y.astype(x.dtype), c.axis)
+        else:
+            gu = (h @ p["w_gate_up"].reshape(c.hidden, -1)).reshape(c.batch, -1, 2)
+            act = jax.nn.silu(gu[..., 0].astype(jnp.float32)).astype(x.dtype) * gu[..., 1]
+            x = x + jax.lax.psum(act @ p["w_down"], c.axis)
 
     x = rmsnorm(x, params["final_norm"], c.norm_eps)
     logits_loc = x @ params["lm_head"]                       # [b, V/n]
@@ -412,21 +449,25 @@ def generate(
         return jnp.concatenate([tok0[None], outs], axis=0)  # [n_steps, b]
 
     cache_specs = spec.specs(cfg)
+    pspecs = _specs_for(cfg)
     out = jax.jit(
         jax.shard_map(
             run_prefill if prefill else run, mesh=mesh,
-            in_specs=(param_specs(cfg), cache_specs, P(None, None)),
+            in_specs=(pspecs, cache_specs, P(None, None)),
             out_specs=P(None, None), check_vma=False,
         )
     )(
         jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-            params, param_specs(cfg),
+            params, pspecs,
         ),
         cache, prompt,
     )
     if prefill:
-        return out.T                    # [b, n_steps]
+        # n_steps=0: the scan is empty but tok0 was still concatenated —
+        # slice keeps the [b, n_steps] contract identical to the
+        # token-by-token path
+        return out.T[:, :n_steps]       # [b, n_steps]
     return out[prompt_len - 1 :].T      # [b, n_steps]
 
 
@@ -495,7 +536,7 @@ class ContinuousBatcher:
         )
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-            params, param_specs(cfg),
+            params, _specs_for(cfg),
         )
         step = functools.partial(
             decode_step, cfg, spec=self.spec, fd_config=fd_config,
@@ -508,7 +549,7 @@ class ContinuousBatcher:
             jax.shard_map(
                 step, mesh=mesh,
                 in_specs=(
-                    param_specs(cfg), self.spec.specs(cfg), P(None), P(None),
+                    _specs_for(cfg), self.spec.specs(cfg), P(None), P(None),
                 ),
                 out_specs=(P(None, None), self.spec.specs(cfg)),
                 check_vma=False,
@@ -557,7 +598,7 @@ class ContinuousBatcher:
             jax.shard_map(
                 fn, mesh=mesh,
                 in_specs=(
-                    param_specs(cfg), spec.specs(cfg), P(None, None),
+                    _specs_for(cfg), spec.specs(cfg), P(None, None),
                     P(None), P(None),
                 ),
                 out_specs=(spec.specs(cfg), P(None, None)),
@@ -717,7 +758,9 @@ def prefill_cache(
     slot's true ``len-1``; the row is selected BEFORE the vocab-shard
     gather, so only ``[b, V]`` ever materializes).
     """
-    from triton_dist_tpu.models.tp_transformer import TPTransformer
+    from triton_dist_tpu.models.tp_transformer import (
+        TPMoETransformer, TPTransformer,
+    )
 
     if not isinstance(spec, KVCacheSpec):
         raise ValueError(
@@ -730,7 +773,10 @@ def prefill_cache(
     b, L = c.batch, c.seq
     s_shard = _shard_of(s_max, n)
 
-    model = TPTransformer(c)
+    model_cls = (
+        TPMoETransformer if isinstance(c, MoETransformerConfig) else TPTransformer
+    )
+    model = model_cls(c)
     model.kv_sink = []
     logits_loc = model(prompt_loc, params)            # [b*L, V/n]
     for li, (k_loc, v_loc) in enumerate(model.kv_sink):
@@ -741,12 +787,17 @@ def prefill_cache(
         k_full = jnp.swapaxes(k_full, 1, 2)           # [b, h_kv, L, d]
         v_full = jnp.swapaxes(v_full, 1, 2)
         kd = cache["k"].dtype
-        k_pad = jnp.zeros((b, c.n_kv_heads, s_max, c.head_dim), kd)
-        v_pad = jnp.zeros((b, c.n_kv_heads, s_max, c.head_dim), kd)
-        k_pad = k_pad.at[:, :, :L].set(k_full.astype(kd))
-        v_pad = v_pad.at[:, :, :L].set(v_full.astype(kd))
-        k_new = jax.lax.dynamic_slice_in_dim(k_pad, me * s_shard, s_shard, 2)
-        v_new = jax.lax.dynamic_slice_in_dim(v_pad, me * s_shard, s_shard, 2)
+        # this PE's window [me*s_shard, me*s_shard + s_shard) of the
+        # prompt: pad by ONE shard (not to s_max — a long-context cache
+        # would otherwise allocate n x the PE's shard per layer as a
+        # temp) and slice; a window past L is all-zero either way, so
+        # clamping the start into the padded region stays correct
+        zpad = jnp.zeros((b, c.n_kv_heads, s_shard, c.head_dim), kd)
+        k_buf = jnp.concatenate([k_full.astype(kd), zpad], axis=2)
+        v_buf = jnp.concatenate([v_full.astype(kd), zpad], axis=2)
+        start = jnp.minimum(me * s_shard, L)
+        k_new = jax.lax.dynamic_slice_in_dim(k_buf, start, s_shard, 2)
+        v_new = jax.lax.dynamic_slice_in_dim(v_buf, start, s_shard, 2)
         if slot_mask is not None:
             sel = slot_mask.reshape(b, 1, 1, 1)
             k_new = jnp.where(sel, k_new, cache["k"][li])
