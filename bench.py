@@ -411,6 +411,19 @@ _METRIC_TIMEOUT_S = int(os.environ.get("TDT_BENCH_METRIC_TIMEOUT", "1500"))
 
 
 def _run_one(name: str) -> None:
+    # persistent compilation cache: every metric runs in its own
+    # subprocess, and without this each pays minutes of (remote)
+    # compiles for loops already compiled by a previous run — the
+    # dominant cost of a driver-window bench pass
+    try:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax or read-only tree: compile-per-run still works
     devs = jax.devices()
     n = len(devs)
     mesh = Mesh(np.array(devs), ("tp",))

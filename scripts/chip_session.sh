@@ -2,33 +2,49 @@
 # One real-chip session, end to end (run whenever the accelerator tunnel
 # is up):
 #   1. correctness stress: >= 20 re-randomized, arena-poisoned passes of
-#      every op, log kept for the record (VERDICT r2 #4)
+#      every op (exits nonzero on any golden mismatch)
 #   2. full autotune sweeps (TDT_BENCH_TUNE=1) — winners persist to
 #      .autotune_cache/ so later bounded-time bench runs (the driver's)
 #      resolve tuned configs without sweeping
-#   3. a bounded-time bench pass exactly as the driver runs it
+#   3. a bounded-time bench pass exactly as the driver runs it (the
+#      persistent .jax_cache/ written by step 2 makes this mostly
+#      compile-free)
+#   4. the native-serving round trip: AOT export -> C++ PJRT runner ->
+#      bit-exact byte-sum vs the jitted Python run
 # Logs land in docs/chip_logs/ (commit them).
 #
-# NOTE: .autotune_cache/ is gitignored, so the step-2 warm-up only helps
-# driver runs FROM THIS SAME WORKING TREE (which is how the round driver
-# invokes bench.py). A fresh clone starts cold and uses each tune space's
-# first (best-known) candidate instead.
+# NOTE: .autotune_cache/ and .jax_cache/ are gitignored, so the warm-up
+# only helps runs FROM THIS SAME WORKING TREE (which is how the round
+# driver invokes bench.py). A fresh clone starts cold and uses each tune
+# space's first (best-known) candidate instead.
+#
+# Run each step SOLO on a small host: a concurrent CPU-heavy job (e.g.
+# the test suite) starves the host side of the bench loops and inflates
+# every wall-time past its timeout.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 mkdir -p docs/chip_logs
 stamp=$(date -u +%Y%m%d_%H%M)
 
-echo "=== [1/3] smoke stress" | tee "docs/chip_logs/${stamp}_smoke.log"
-timeout 3600 python scripts/tpu_smoke.py 2>&1 | tee -a "docs/chip_logs/${stamp}_smoke.log"
-smoke_rc=${PIPESTATUS[0]}
+echo "=== [1/4] smoke stress"
+timeout 3600 python scripts/tpu_smoke.py > "docs/chip_logs/${stamp}_smoke.log" 2>&1
+smoke_rc=$?
+echo "smoke rc=$smoke_rc" >> "docs/chip_logs/${stamp}_smoke.log"
 
-echo "=== [2/3] bench with full sweeps (warms .autotune_cache/)"
-TDT_BENCH_TUNE=1 timeout 3600 python bench.py 2>&1 | tee "docs/chip_logs/${stamp}_bench_tuned.log"
-tuned_rc=${PIPESTATUS[0]}
+echo "=== [2/4] bench with full sweeps (warms .autotune_cache/ + .jax_cache/)"
+TDT_BENCH_TUNE=1 timeout 3600 python bench.py > "docs/chip_logs/${stamp}_bench_tuned.log" 2>&1
+tuned_rc=$?
+echo "tuned rc=$tuned_rc" >> "docs/chip_logs/${stamp}_bench_tuned.log"
 
-echo "=== [3/3] bounded-time bench (driver mode, warm cache)"
-timeout 1800 python bench.py 2>&1 | tee "docs/chip_logs/${stamp}_bench_driver_mode.log"
-driver_rc=${PIPESTATUS[0]}
+echo "=== [3/4] bounded-time bench (driver mode, warm caches)"
+timeout 1800 python bench.py > "docs/chip_logs/${stamp}_bench_driver_mode.log" 2>&1
+driver_rc=$?
+echo "driver rc=$driver_rc" >> "docs/chip_logs/${stamp}_bench_driver_mode.log"
 
-echo "rc: smoke=$smoke_rc tuned=$tuned_rc driver_mode=$driver_rc"
-exit $(( smoke_rc || tuned_rc || driver_rc ))
+echo "=== [4/4] native PJRT runner round trip"
+timeout 900 bash scripts/pjrt_runner_check.sh > "docs/chip_logs/${stamp}_pjrt_runner.log" 2>&1
+pjrt_rc=$?
+echo "pjrt rc=$pjrt_rc" >> "docs/chip_logs/${stamp}_pjrt_runner.log"
+
+echo "rc: smoke=$smoke_rc tuned=$tuned_rc driver=$driver_rc pjrt=$pjrt_rc"
+exit $(( smoke_rc || tuned_rc || driver_rc || pjrt_rc ))
