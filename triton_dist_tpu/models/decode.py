@@ -473,12 +473,35 @@ def generate(
 
 @dataclasses.dataclass
 class Request:
-    """One generation request for :class:`ContinuousBatcher`."""
+    """One generation request for :class:`ContinuousBatcher`.
+
+    Sampling: greedy by default; ``temperature > 0`` samples from the
+    softmax (optionally truncated to the ``top_k`` most likely tokens),
+    reproducibly per request via ``seed`` — each slot owns an
+    independent RNG, so a request's tokens do not depend on what shares
+    the batch with it."""
 
     prompt: list            # token ids, len >= 1
     max_new_tokens: int
     eos_id: int | None = None
+    temperature: float = 0.0
+    top_k: int | None = None
+    seed: int | None = None
     uid: Any = None
+
+    def sample(self, logits, rng) -> int:
+        """Pick the next token from a [vocab] f32 logit row."""
+        if self.temperature <= 0.0:
+            return int(logits.argmax())
+        z = logits.astype(np.float64) / self.temperature
+        if self.top_k is not None:
+            k = min(self.top_k, len(z))   # validated >= 1 at submit()
+            kth = np.partition(z, -k)[-k]
+            z = np.where(z >= kth, z, -np.inf)
+        z -= z.max()
+        probs = np.exp(z)
+        probs /= probs.sum()
+        return int(rng.choice(len(probs), p=probs))
 
 
 class ContinuousBatcher:
@@ -560,6 +583,7 @@ class ContinuousBatcher:
         self.pos = np.zeros(b, np.int32)        # next write position per slot
         self.tok = np.zeros(b, np.int32)        # next input token per slot
         self.slot_req: list[Request | None] = [None] * b
+        self.slot_rng: list[Any] = [None] * b
         self.slot_fed: list[int] = [0] * b      # prompt tokens already fed
         self.slot_out: list[list] = [[] for _ in range(b)]
         self.queue: list[Request] = []
@@ -570,6 +594,8 @@ class ContinuousBatcher:
             raise ValueError("empty prompt (need at least one token)")
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if req.top_k is not None and req.top_k < 1:
+            raise ValueError("top_k must be >= 1 (or None)")
         if len(req.prompt) + req.max_new_tokens > self.s_max:
             raise ValueError(
                 f"prompt {len(req.prompt)} + max_new {req.max_new_tokens} "
@@ -643,7 +669,7 @@ class ContinuousBatcher:
             jnp.asarray(np.arange(self.cfg.batch) == i),
             jnp.asarray(pick),
         )
-        t0 = int(np.asarray(jnp.argmax(last[i])))
+        t0 = req.sample(np.asarray(last[i], np.float32), self.slot_rng[i])
         self.slot_fed[i] = L
         self.slot_out[i] = [t0]
         self.tok[i] = t0
@@ -660,6 +686,7 @@ class ContinuousBatcher:
                 req = self.queue.pop(0)
                 self.slot_req[i] = req
                 self.slot_out[i] = []
+                self.slot_rng[i] = np.random.default_rng(req.seed)
                 if self.prefill and len(req.prompt) > 1:
                     self._admit_prefill(i, req)
                 else:
@@ -680,7 +707,17 @@ class ContinuousBatcher:
             self.params, self.cache,
             jnp.asarray(self.tok), jnp.asarray(self.pos),
         )
+        # greedy slots need only the [b]-int argmax; the full [b, vocab]
+        # row transfer (~vocab x 4 bytes/slot over a possibly-remote link)
+        # is paid only when some active request actually samples
         nxt = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        logits_h = (
+            np.asarray(logits, np.float32)
+            if any(
+                r is not None and r.temperature > 0.0 for r in self.slot_req
+            )
+            else None
+        )
         for i, req in enumerate(self.slot_req):
             if req is None:
                 continue  # idle slot decoded a dummy token; ignore
@@ -690,7 +727,10 @@ class ContinuousBatcher:
                 self.tok[i] = req.prompt[self.slot_fed[i]]
                 self.slot_fed[i] += 1
             else:
-                t = int(nxt[i])
+                t = (
+                    int(nxt[i]) if req.temperature <= 0.0
+                    else req.sample(logits_h[i], self.slot_rng[i])
+                )
                 self.slot_out[i].append(t)
                 self.tok[i] = t
                 done = len(self.slot_out[i]) >= req.max_new_tokens or (
