@@ -296,9 +296,10 @@ def test_continuous_batcher_sampling(mesh4):
     )
     params = init_params(jax.random.PRNGKey(3), cfg)
 
-    def run(reqs):
+    def run(reqs, prefill=False):
         b = ContinuousBatcher(
-            cfg, params, mesh4, s_max=16, fd_config=FlashDecodeConfig(block_s=4)
+            cfg, params, mesh4, s_max=16,
+            fd_config=FlashDecodeConfig(block_s=4), prefill=prefill,
         )
         for r in reqs:
             b.submit(r)
@@ -320,3 +321,7 @@ def test_continuous_batcher_sampling(mesh4):
         Request([4, 5], max_new_tokens=8, temperature=1.0, seed=42, uid="n"),
     ])
     assert pair["a"] == a, "sampling must not depend on batch neighbors"
+    # prefill admission samples the FIRST token from the picked logits —
+    # the same seed must reproduce through that path too
+    a_pf = run([mk(temperature=1.5, seed=7, uid="a")], prefill=True)["a"]
+    assert a_pf == a, "prefill admission must sample identically"

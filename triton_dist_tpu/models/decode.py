@@ -496,8 +496,10 @@ class Request:
         z = logits.astype(np.float64) / self.temperature
         if self.top_k is not None:
             k = min(self.top_k, len(z))   # validated >= 1 at submit()
-            kth = np.partition(z, -k)[-k]
-            z = np.where(z >= kth, z, -np.inf)
+            keep = np.argpartition(z, -k)[-k:]   # EXACTLY k indices:
+            mask = np.full_like(z, -np.inf)      # ties beyond k drop, so
+            mask[keep] = z[keep]                 # top_k=1 stays greedy
+            z = mask
         z -= z.max()
         probs = np.exp(z)
         probs /= probs.sum()
@@ -714,7 +716,9 @@ class ContinuousBatcher:
         logits_h = (
             np.asarray(logits, np.float32)
             if any(
-                r is not None and r.temperature > 0.0 for r in self.slot_req
+                r is not None and r.temperature > 0.0
+                and self.slot_fed[i] >= len(r.prompt)  # past prompt feed
+                for i, r in enumerate(self.slot_req)
             )
             else None
         )

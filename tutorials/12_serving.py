@@ -71,7 +71,11 @@ for i, (plen, mnew) in enumerate([(3, 4), (5, 3), (2, 5)]):
         jnp.int32,
     )))
     batcher.submit(Request(p, max_new_tokens=mnew, uid=i))
-for uid, toks in sorted(batcher.run()):
+# a sampled request rides the same batch: per-slot RNG, seed-reproducible
+batcher.submit(Request(
+    [7, 8], max_new_tokens=4, temperature=1.2, top_k=8, seed=0, uid="sampled"
+))
+for uid, toks in sorted(batcher.run(), key=lambda kv: str(kv[0])):
     print(f"[serving] request {uid}: {toks}")
 
 # 4: the same loop serves a MoE model
