@@ -11,6 +11,12 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# share bench.py's persistent compile cache: the export's GEMM compile is
+# the slow phase of this check (observed timing out under a cold cache
+# when the host was CPU-starved or the tunnel was flaky)
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
 make -C csrc pjrt_runner
 
 EXE=/tmp/tdt_pjrt_check.bin
