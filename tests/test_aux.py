@@ -281,3 +281,39 @@ def test_autotuner_cached_or_first_policy(tmp_path, monkeypatch):
 
     np.testing.assert_allclose(np.asarray(op2(y)), 22.0)  # tuned winner
     assert calls2 == [22]
+
+
+def test_autotuner_precondition_filters_walk(tmp_path, monkeypatch):
+    """The shape-aware precondition prunes sweep-free walks (a config that
+    is best-known at one shape can be pathological at another); a filter
+    that rejects every candidate is ignored outright."""
+    import triton_dist_tpu.autotuner as at
+
+    monkeypatch.setattr(at, "_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("TDT_AUTOTUNE_POLICY", "cached_or_first")
+    calls = []
+
+    @contextual_autotune(
+        configs=[512, 128], name="toy6",
+        precondition=lambda cfg, x: cfg <= x.shape[0],
+    )
+    def op(x, *, config=None):
+        calls.append(config)
+        return x * config
+
+    np.testing.assert_allclose(np.asarray(op(jnp.ones((130,)))), 128.0)
+    assert calls == [128]  # 512 filtered for this shape, never applied
+
+    # filter rejects everything -> ignored, first candidate applies
+    calls2 = []
+
+    @contextual_autotune(
+        configs=[512, 128], name="toy7",
+        precondition=lambda cfg, x: False,
+    )
+    def op2(x, *, config=None):
+        calls2.append(config)
+        return x * config
+
+    np.testing.assert_allclose(np.asarray(op2(jnp.ones((2,)))), 512.0)
+    assert calls2 == [512]

@@ -277,3 +277,72 @@ def test_flash_decode_quant_distributed(mesh4):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=3e-2, atol=3e-2
     )
+
+
+@pytest.mark.parametrize("g", [1, 4])
+def test_flash_decode_fused_heads_matches_per_head(g):
+    """fuse_heads moves the kv-head loop inside the kernel (one K/V slab
+    per chunk step); the math is identical, so it must match the per-head
+    kernel bit-for-bit at the same chunking."""
+    b, h_kv, s, d = 2, 4, 256, 128
+    q, k, v, kv_lens = _rand_case(
+        jax.random.PRNGKey(40), b, h_kv * g, h_kv, s, d
+    )
+    want = flash_decode(q, k, v, kv_lens, config=FlashDecodeConfig(block_s=64))
+    got = flash_decode(
+        q, k, v, kv_lens,
+        config=FlashDecodeConfig(block_s=64, fuse_heads=True),
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6)
+    ref = _ref_decode(q, k, v, kv_lens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_flash_decode_fused_heads_ragged_and_lse():
+    """Ragged lens (incl. empty) and the (out, lse) contract under
+    fuse_heads — the SP combine consumes either kernel's partials."""
+    b, h_kv, g, s, d = 3, 2, 2, 128, 128
+    q, k, v, _ = _rand_case(jax.random.PRNGKey(41), b, h_kv * g, h_kv, s, d)
+    kv_lens = jnp.array([s, 37, 0], jnp.int32)
+    o_f, l_f = flash_decode(
+        q, k, v, kv_lens,
+        config=FlashDecodeConfig(block_s=32, fuse_heads=True),
+        return_lse=True,
+    )
+    o_p, l_p = flash_decode(
+        q, k, v, kv_lens, config=FlashDecodeConfig(block_s=32),
+        return_lse=True,
+    )
+    np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_p), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(l_f), np.asarray(l_p), rtol=1e-6, atol=1e-6)
+
+
+def test_flash_decode_fused_heads_quant():
+    """int8 + fuse_heads: per-position scales fold in per head."""
+    from triton_dist_tpu.ops.flash_decode import flash_decode_quant, quantize_kv
+
+    b, hq, h_kv, s, d = 2, 8, 4, 64, 128
+    q, k, v, _ = _rand_case(jax.random.PRNGKey(42), b, hq, h_kv, s, d)
+    kv_lens = jnp.array([s, 19], jnp.int32)
+    want = flash_decode(q, k, v, kv_lens, config=FlashDecodeConfig(block_s=16))
+    k_q, v_q, ks, vs = quantize_kv(k, v)
+    got = flash_decode_quant(
+        q, k_q, v_q, ks, vs, kv_lens,
+        config=FlashDecodeConfig(block_s=16, fuse_heads=True),
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=3e-2, atol=3e-2
+    )
+
+
+@pytest.mark.parametrize("fuse_heads", [True, False])
+def test_paged_flash_decode_head_fusion_paths(fuse_heads):
+    """Both paged index paths (one DMA per page vs per (head, page)) hit
+    the same answer on shuffled pools with ragged lens."""
+    b, h_kv, g, s, d, page = 2, 2, 2, 128, 128, 32
+    q, k, v, _ = _rand_case(jax.random.PRNGKey(43), b, h_kv * g, h_kv, s, d)
+    kv_lens = jnp.array([s, 41], jnp.int32)
+    kp, vp, bt = _paginate(k, v, page, key=jax.random.PRNGKey(44), n_extra_pages=2)
+    got = paged_flash_decode(q, kp, vp, kv_lens, bt, fuse_heads=fuse_heads)
+    want = _ref_decode(q, k, v, kv_lens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)

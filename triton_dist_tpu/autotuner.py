@@ -99,6 +99,7 @@ def contextual_autotune(
     iters: int = 60,
     trials: int = 3,
     dedupe: Callable[..., Any] | None = None,
+    precondition: Callable[..., bool] | None = None,
     sweep_in_interpret: bool = False,
 ) -> Callable:
     """Decorator: sweep `configs` for the wrapped op on first call per input
@@ -123,6 +124,17 @@ def contextual_autotune(
     `dedupe`, if given, maps ``(cfg, *args, **kwargs)`` to the config's
     EFFECTIVE key for this problem (e.g. the clamped block shape); configs
     that collapse to the same key are timed once and share the result.
+
+    `precondition`, if given, maps ``(cfg, *args, **kwargs)`` to whether
+    the candidate is SENSIBLE for this problem — a shape-aware guard for
+    the sweep-free paths (cached_or_first / interpreter), where the walk
+    applies the first surviving candidate untimed: a config that is
+    best-known at the bench shape can be pathological elsewhere (e.g. a
+    512-row MoE alignment block padding a 16-token problem 100×). Filtered
+    configs are skipped by sweeps too; if the filter rejects every
+    candidate it is ignored outright (never an error). Must be
+    deterministic in its arguments — multi-host relies on every process
+    walking the same candidate order.
     """
     configs = list(configs)
 
@@ -153,6 +165,20 @@ def contextual_autotune(
                 _memory_cache[mem_key] = configs[entry["i"]]
                 return fn(*args, config=_memory_cache[mem_key], **kwargs)
 
+            # shape-aware candidate filter (see docstring); a filter that
+            # rejects everything (or raises) is ignored, never fatal
+            cands = configs
+            if precondition is not None:
+                try:
+                    ok = [
+                        cfg for cfg in configs
+                        if precondition(cfg, *args, **kwargs)
+                    ]
+                except Exception:
+                    ok = []
+                if ok:
+                    cands = ok
+
             def _first_viable(reason: str):
                 """Apply the first candidate that runs — NEVER a sweep.
                 Skips are always logged to stderr: demoting the best-known
@@ -162,7 +188,7 @@ def contextual_autotune(
                 import sys
 
                 last_err: Exception | None = None
-                for cfg in configs:
+                for cfg in cands:
                     try:
                         out = fn(*args, config=cfg, **kwargs)
                     except Exception as e:
@@ -203,6 +229,8 @@ def contextual_autotune(
             times = [float("inf")] * len(configs)
             seen: dict[Any, int] = {}
             for i, cfg in enumerate(configs):
+                if cfg not in cands:
+                    continue  # filtered by the precondition: never timed
                 if dedupe is not None:
                     try:
                         eff = dedupe(cfg, *args, **kwargs)
@@ -256,6 +284,9 @@ def contextual_autotune(
                 best_i = int(
                     multihost_utils.broadcast_one_to_all(_np.int32(best_i))
                 )
+                # re-derive the logged timing for rank 0's choice (this
+                # rank's sample of it may be inf if the config failed here)
+                best_t = times[best_i]
             if tdt_config.get_config().verbose_autotune:
                 print(
                     f"[autotune {op_name}] {key} -> {configs[best_i]} "

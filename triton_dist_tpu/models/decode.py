@@ -683,18 +683,26 @@ class ContinuousBatcher:
             self.slot_req[i] = None
 
     def _admit(self) -> None:
-        for i, r in enumerate(self.slot_req):
-            if r is None and self.queue:
-                req = self.queue.pop(0)
-                self.slot_req[i] = req
-                self.slot_out[i] = []
-                self.slot_rng[i] = np.random.default_rng(req.seed)
-                if self.prefill and len(req.prompt) > 1:
-                    self._admit_prefill(i, req)
-                else:
-                    self.pos[i] = 0
-                    self.tok[i] = req.prompt[0]
-                    self.slot_fed[i] = 1
+        # _admit_prefill can free the slot it just filled (max_new_tokens=1
+        # or instant EOS), so one linear pass would leave that slot empty
+        # until the next step even with queued work — re-pass until a full
+        # sweep admits nothing
+        admitted = True
+        while admitted and self.queue:
+            admitted = False
+            for i, r in enumerate(self.slot_req):
+                if r is None and self.queue:
+                    req = self.queue.pop(0)
+                    admitted = True
+                    self.slot_req[i] = req
+                    self.slot_out[i] = []
+                    self.slot_rng[i] = np.random.default_rng(req.seed)
+                    if self.prefill and len(req.prompt) > 1:
+                        self._admit_prefill(i, req)
+                    else:
+                        self.pos[i] = 0
+                        self.tok[i] = req.prompt[0]
+                        self.slot_fed[i] = 1
 
     @property
     def idle(self) -> bool:

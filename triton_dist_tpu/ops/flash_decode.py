@@ -59,19 +59,68 @@ class FlashDecodeConfig:
     on chips where XLA's fusion already sits at the memory wall (measured
     v5e: XLA 344 µs vs Pallas 460 µs at b=8 hq=64 s=8192) the idiomatic
     TPU answer is to let XLA have the contiguous bf16 case; the Pallas
-    kernel remains the only path for paged and int8-quantized caches."""
+    kernel remains the only path for paged and int8-quantized caches.
+
+    ``fuse_heads=True`` moves the kv-head loop INSIDE the kernel: the grid
+    drops from (b, h_kv, chunks) to (b, chunks) and each step streams one
+    K slab + one V slab covering every kv head. At decode shapes the
+    per-step work is tiny (the GQA matmuls pad their handful of q rows up
+    to the MXU's 128), so the h_kv-fold reduction in grid steps — fewer
+    fixed per-step costs, h_kv-fold larger DMA transfers — is what moves
+    a kernel sitting below the HBM wall toward it."""
 
     block_s: int = 2048  # KV chunk per online-softmax step; 0 = XLA-native
+    fuse_heads: bool = False  # kv-head loop inside the kernel body
+
+
+def _online_softmax_step(
+    q, k_b, v_b, ks_row, vs_row, chunk_start, kv_len, scale,
+    m_prev, l_prev, acc_prev,
+):
+    """One KV-chunk update of one head's online-softmax carry; the single
+    source of the decode math for the per-head AND fused-heads kernels.
+    Returns ``(m_new, l_new, acc_new)``.
+
+    Both matmuls run in the cache dtype (bf16 MXU fast path, f32
+    accumulate); the f32-upcast variant costs a full VPU pass over
+    every K/V tile and measured 25% slower than the HBM-bandwidth
+    wall this kernel otherwise sits on. ``ks_row``/``vs_row`` are None on
+    the plain path; when present (int8 cache) the K/V tiles upcast to bf16
+    (riding under the halved DMA time) and the per-position row scales
+    fold into the scores / probabilities."""
+    if ks_row is not None:
+        k_b = k_b.astype(jnp.bfloat16)
+        v_b = v_b.astype(jnp.bfloat16)
+    s = jax.lax.dot_general(                            # [g, sc]
+        q, k_b, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * (scale if ks_row is None else ks_row * scale)
+    span = chunk_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(span < kv_len, s, NEG_INF)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                              # [g, sc]
+    l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    pv = p if vs_row is None else p * vs_row
+    acc_new = acc_prev * alpha + jax.lax.dot(
+        pv.astype(v_b.dtype), v_b, preferred_element_type=jnp.float32
+    )
+    return m_new, l_new, acc_new
+
+
+def _finalize_softmax(m, l, acc):
+    """(out, lse) from a finished carry. kv_len == 0 → l == 0: emit out=0,
+    lse=-inf (weight 0 in the SP merge)."""
+    out = jnp.where(l > 0, acc / jnp.maximum(l, 1e-30), 0.0)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return out, lse
 
 
 def _flash_decode_body(
     kv_lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, out_ref, lse_ref,
     m_scr, l_scr, acc_scr, *, n_chunks: int, block_s: int, scale: float,
 ):
-    """Shared online-softmax decode body. ``ks_ref``/``vs_ref`` are None on
-    the plain path; when present (int8 cache) the K/V tiles upcast to bf16
-    and the per-position row scales fold into the scores / probabilities —
-    the only differences between the two kernels."""
+    """Per-head online-softmax decode body: grid (b, h_kv, chunk)."""
     b_i = pl.program_id(0)
     c = pl.program_id(2)
 
@@ -85,41 +134,18 @@ def _flash_decode_body(
 
     @pl.when(c * block_s < kv_len)
     def _():
-        # Both matmuls run in the cache dtype (bf16 MXU fast path, f32
-        # accumulate); the f32-upcast variant costs a full VPU pass over
-        # every K/V tile and measured 25% slower than the HBM-bandwidth
-        # wall this kernel otherwise sits on. int8 tiles stream at half
-        # the bytes; their bf16 upcast rides under the halved DMA time.
-        q = q_ref[0, 0]                                     # [g, d]
-        k_b = k_ref[0, 0]
-        v_b = v_ref[0, 0]
-        if ks_ref is not None:
-            k_b = k_b.astype(jnp.bfloat16)
-            v_b = v_b.astype(jnp.bfloat16)
-        s = jax.lax.dot_general(                            # [g, sc]
-            q, k_b, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * (scale if ks_ref is None else ks_ref[0, 0] * scale)
-        span = c * block_s + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(span < kv_len, s, NEG_INF)
-        m_prev = m_scr[:]                                   # [g, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)                              # [g, sc]
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        pv = p if vs_ref is None else p * vs_ref[0, 0]
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
-            pv.astype(v_b.dtype), v_b,
-            preferred_element_type=jnp.float32,
+        m_scr[:], l_scr[:], acc_scr[:] = _online_softmax_step(
+            q_ref[0, 0], k_ref[0, 0], v_ref[0, 0],
+            None if ks_ref is None else ks_ref[0, 0],
+            None if vs_ref is None else vs_ref[0, 0],
+            c * block_s, kv_len, scale, m_scr[:], l_scr[:], acc_scr[:],
         )
-        m_scr[:] = m_new
 
     @pl.when(c == n_chunks - 1)
     def _():
-        l = l_scr[:]
-        # kv_len == 0 → l == 0: emit out=0, lse=-inf (weight 0 in the merge).
-        out_ref[0, 0] = jnp.where(l > 0, acc_scr[:] / jnp.maximum(l, 1e-30), 0.0)
-        lse_ref[0, 0] = m_scr[:] + jnp.log(jnp.maximum(l, 1e-30))
+        out_ref[0, 0], lse_ref[0, 0] = _finalize_softmax(
+            m_scr[:], l_scr[:], acc_scr[:]
+        )
 
 
 def _flash_decode_kernel(
@@ -130,6 +156,58 @@ def _flash_decode_kernel(
         kv_lens_ref, q_ref, k_ref, v_ref, None, None, out_ref, lse_ref,
         m_scr, l_scr, acc_scr, **kw,
     )
+
+
+def _flash_decode_fused_heads_body(
+    kv_lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, out_ref, lse_ref,
+    m_scr, l_scr, acc_scr,
+    *, n_chunks: int, block_s: int, scale: float, h_kv: int,
+):
+    """``fuse_heads`` decode body: grid (b, chunk), all kv heads of the
+    chunk arrive in ONE K slab + ONE V slab and the head loop unrolls
+    inside the step. Per-head math is identical to
+    :func:`_flash_decode_body`; scratches carry a leading h_kv dim."""
+    b_i = pl.program_id(0)
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    kv_len = kv_lens_ref[b_i]
+
+    @pl.when(c * block_s < kv_len)
+    def _():
+        for j in range(h_kv):  # static unroll over the slab's heads
+            m_scr[j], l_scr[j], acc_scr[j] = _online_softmax_step(
+                q_ref[0, j], k_ref[0, j], v_ref[0, j],
+                None if ks_ref is None else ks_ref[0, j],
+                None if vs_ref is None else vs_ref[0, j],
+                c * block_s, kv_len, scale,
+                m_scr[j], l_scr[j], acc_scr[j],
+            )
+
+    @pl.when(c == n_chunks - 1)
+    def _():
+        out_ref[0], lse_ref[0] = _finalize_softmax(
+            m_scr[:], l_scr[:], acc_scr[:]
+        )
+
+
+def _flash_decode_fused_heads_kernel(
+    kv_lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref, m_scr, l_scr, acc_scr,
+    **kw,
+):
+    _flash_decode_fused_heads_body(
+        kv_lens_ref, q_ref, k_ref, v_ref, None, None, out_ref, lse_ref,
+        m_scr, l_scr, acc_scr, **kw,
+    )
+
+
+def _flash_decode_fused_heads_quant_kernel(*refs, **kw):
+    _flash_decode_fused_heads_body(*refs, **kw)
 
 
 def flash_decode(
@@ -210,6 +288,64 @@ def _decode_call(q, k, v, scales, kv_lens, *, config, return_lse, interpret):
     q4 = q.reshape(b, h_kv, g, d).astype(
         jnp.bfloat16 if scales is not None else k.dtype
     )
+    args = [kv_lens.astype(jnp.int32), q4, k, v]
+    if scales is None:
+        kv_bytes = 2 * b * h_kv * s_len * d * k.dtype.itemsize
+    else:
+        args += [scales[0].astype(jnp.float32), scales[1].astype(jnp.float32)]
+        kv_bytes = 2 * b * h_kv * s_len * (d + 4)  # int8 payload + f32 scale
+    cost = pl.CostEstimate(
+        flops=4 * b * hq * s_len * d,
+        bytes_accessed=kv_bytes,
+        transcendentals=b * hq * s_len,
+    )
+    if cfg.fuse_heads:
+        # grid (b, chunk): each step's K/V slab spans every kv head — h_kv×
+        # fewer grid steps and h_kv× larger DMAs (see FlashDecodeConfig)
+        grid = (b, n_chunks)
+        in_specs = [
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # kv_lens
+            pl.BlockSpec((1, h_kv, g, d), lambda i, c: (i, 0, 0, 0)),
+            pl.BlockSpec((1, h_kv, sc, d), lambda i, c: (i, 0, c, 0)),
+            pl.BlockSpec((1, h_kv, sc, d), lambda i, c: (i, 0, c, 0)),
+        ]
+        if scales is None:
+            name, kernel = "flash_decode_fh", _flash_decode_fused_heads_kernel
+        else:
+            name = "flash_decode_fh_quant"
+            kernel = _flash_decode_fused_heads_quant_kernel
+            scale_spec = pl.BlockSpec(
+                (1, h_kv, 1, sc), lambda i, c: (i, 0, 0, c)
+            )
+            in_specs += [scale_spec, scale_spec]
+        out, lse = dist_pallas_call(
+            functools.partial(
+                kernel, n_chunks=n_chunks, block_s=sc, scale=scale, h_kv=h_kv,
+            ),
+            name=name,
+            grid=grid,
+            out_shape=(
+                jax.ShapeDtypeStruct((b, h_kv, g, d), jnp.float32),
+                jax.ShapeDtypeStruct((b, h_kv, g, 1), jnp.float32),
+            ),
+            in_specs=in_specs,
+            out_specs=(
+                pl.BlockSpec((1, h_kv, g, d), lambda i, c: (i, 0, 0, 0)),
+                pl.BlockSpec((1, h_kv, g, 1), lambda i, c: (i, 0, 0, 0)),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((h_kv, g, 1), jnp.float32),
+                pltpu.VMEM((h_kv, g, 1), jnp.float32),
+                pltpu.VMEM((h_kv, g, d), jnp.float32),
+            ],
+            cost_estimate=cost,
+            dimension_semantics=("parallel", "arbitrary"),
+            uses_barrier=False,
+            interpret=interpret,
+        )(*args)
+        out = out.reshape(b, hq, d)
+        lse = lse.reshape(b, hq)
+        return (out, lse) if return_lse else out
     grid = (b, h_kv, n_chunks)
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # kv_lens
@@ -217,16 +353,12 @@ def _decode_call(q, k, v, scales, kv_lens, *, config, return_lse, interpret):
         pl.BlockSpec((1, 1, sc, d), lambda i, j, c: (i, j, c, 0)),
         pl.BlockSpec((1, 1, sc, d), lambda i, j, c: (i, j, c, 0)),
     ]
-    args = [kv_lens.astype(jnp.int32), q4, k, v]
     if scales is None:
         name, kernel = "flash_decode", _flash_decode_kernel
-        kv_bytes = 2 * b * h_kv * s_len * d * k.dtype.itemsize
     else:
         name, kernel = "flash_decode_quant", _flash_decode_quant_kernel
         scale_spec = pl.BlockSpec((1, 1, 1, sc), lambda i, j, c: (i, j, 0, c))
         in_specs += [scale_spec, scale_spec]
-        args += [scales[0].astype(jnp.float32), scales[1].astype(jnp.float32)]
-        kv_bytes = 2 * b * h_kv * s_len * (d + 4)  # int8 payload + f32 scale
     out, lse = dist_pallas_call(
         functools.partial(kernel, n_chunks=n_chunks, block_s=sc, scale=scale),
         name=name,
@@ -247,11 +379,7 @@ def _decode_call(q, k, v, scales, kv_lens, *, config, return_lse, interpret):
             pltpu.VMEM((g, 1), jnp.float32),
             pltpu.VMEM((g, d), jnp.float32),
         ],
-        cost_estimate=pl.CostEstimate(
-            flops=4 * b * hq * s_len * d,
-            bytes_accessed=kv_bytes,
-            transcendentals=b * hq * s_len,
-        ),
+        cost_estimate=cost,
         dimension_semantics=("parallel", "parallel", "arbitrary"),
         uses_barrier=False,
         interpret=interpret,
@@ -347,6 +475,18 @@ def _paged_flash_decode_kernel(
     )
 
 
+def _paged_flash_decode_fh_kernel(
+    kv_lens_ref, block_table_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
+    m_scr, l_scr, acc_scr, **kw,
+):
+    # block table is consumed by the index_map only
+    del block_table_ref
+    _flash_decode_fused_heads_body(
+        kv_lens_ref, q_ref, k_ref, v_ref, None, None, out_ref, lse_ref,
+        m_scr, l_scr, acc_scr, **kw,
+    )
+
+
 def paged_flash_decode(
     q: jax.Array,
     k_pages: jax.Array,
@@ -354,6 +494,7 @@ def paged_flash_decode(
     kv_lens: jax.Array,
     block_table: jax.Array,
     *,
+    fuse_heads: bool = True,
     return_lse: bool = False,
     interpret: Any = None,
 ):
@@ -371,6 +512,11 @@ def paged_flash_decode(
     prefetch (SMEM), and the K/V BlockSpec index_map reads it to steer each
     grid step's page fetch — the double-buffered pipeline then streams
     pages exactly as the contiguous kernel streams chunks.
+
+    ``fuse_heads`` (default): a page holds every kv head's slab, so the
+    fused-heads grid (b, page) fetches each physical page in ONE DMA
+    instead of one 2·page_size·d slice per (head, page) — at typical page
+    sizes the per-head fetches are tens of KB, far below DMA efficiency.
     """
     b, hq, d = q.shape
     n_pages, h_kv, page_size, _ = k_pages.shape
@@ -380,6 +526,57 @@ def paged_flash_decode(
     scale = 1.0 / math.sqrt(d)
     # match q to the page-pool dtype (same contract as flash_decode)
     q4 = q.reshape(b, h_kv, g, d).astype(k_pages.dtype)
+    cost = pl.CostEstimate(
+        flops=4 * b * hq * max_pages * page_size * d,
+        bytes_accessed=(2 * b * h_kv * max_pages * page_size * d)
+        * k_pages.dtype.itemsize,
+        transcendentals=b * hq * max_pages * page_size,
+    )
+    if fuse_heads:
+        def kv_index_map_fh(i, c, kv_lens_ref, bt_ref):
+            return (bt_ref[i, c], 0, 0, 0)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, max_pages),
+            in_specs=[
+                pl.BlockSpec((1, h_kv, g, d), lambda i, c, *_: (i, 0, 0, 0)),
+                pl.BlockSpec((1, h_kv, page_size, d), kv_index_map_fh),
+                pl.BlockSpec((1, h_kv, page_size, d), kv_index_map_fh),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, h_kv, g, d), lambda i, c, *_: (i, 0, 0, 0)),
+                pl.BlockSpec((1, h_kv, g, 1), lambda i, c, *_: (i, 0, 0, 0)),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((h_kv, g, 1), jnp.float32),
+                pltpu.VMEM((h_kv, g, 1), jnp.float32),
+                pltpu.VMEM((h_kv, g, d), jnp.float32),
+            ],
+        )
+        out, lse = dist_pallas_call(
+            functools.partial(
+                _paged_flash_decode_fh_kernel,
+                n_chunks=max_pages, block_s=page_size, scale=scale,
+                h_kv=h_kv,
+            ),
+            name="paged_flash_decode_fh",
+            grid_spec=grid_spec,
+            out_shape=(
+                jax.ShapeDtypeStruct((b, h_kv, g, d), jnp.float32),
+                jax.ShapeDtypeStruct((b, h_kv, g, 1), jnp.float32),
+            ),
+            cost_estimate=cost,
+            dimension_semantics=("parallel", "arbitrary"),
+            uses_barrier=False,
+            interpret=interpret,
+        )(
+            kv_lens.astype(jnp.int32), block_table.astype(jnp.int32),
+            q4, k_pages, v_pages,
+        )
+        out = out.reshape(b, hq, d)
+        lse = lse.reshape(b, hq)
+        return (out, lse) if return_lse else out
 
     def kv_index_map(i, j, c, kv_lens_ref, bt_ref):
         return (bt_ref[i, c], j, 0, 0)
@@ -414,12 +611,7 @@ def paged_flash_decode(
             jax.ShapeDtypeStruct((b, h_kv, g, d), jnp.float32),
             jax.ShapeDtypeStruct((b, h_kv, g, 1), jnp.float32),
         ),
-        cost_estimate=pl.CostEstimate(
-            flops=4 * b * hq * max_pages * page_size * d,
-            bytes_accessed=(2 * b * h_kv * max_pages * page_size * d)
-            * k_pages.dtype.itemsize,
-            transcendentals=b * hq * max_pages * page_size,
-        ),
+        cost_estimate=cost,
         dimension_semantics=("parallel", "parallel", "arbitrary"),
         uses_barrier=False,
         interpret=interpret,
@@ -437,16 +629,19 @@ def paged_flash_decode_distributed(
     block_table: jax.Array,
     *,
     axis: str = "tp",
+    fuse_heads: bool = True,
     ag_method: str = "full_mesh_push",
     interpret: Any = None,
 ) -> jax.Array:
     """SP/CP decode over a paged, sequence-sharded KV cache: each PE holds
     its own page pool + block table covering its sequence shard (the paged
     analogue of :func:`flash_decode_distributed`; ≙ the reference SP layer,
-    which is paged end-to-end: sp_flash_decode_layer.py:78)."""
+    which is paged end-to-end: sp_flash_decode_layer.py:78).
+    ``fuse_heads=False`` selects the per-head grid — the escape hatch when
+    a many-kv-head pool's fused K/V slab exceeds VMEM."""
     out, lse = paged_flash_decode(
         q, k_pages, v_pages, kv_lens_shard, block_table,
-        return_lse=True, interpret=interpret,
+        fuse_heads=fuse_heads, return_lse=True, interpret=interpret,
     )
     return _sp_allgather_combine(out, lse, axis, ag_method, interpret)
 
@@ -562,12 +757,21 @@ def flash_decode_op(
 # chunks amortize per-grid-step overhead, smaller ones win on short
 # caches. FIRST entry = best-known for the long-cache bench shape
 # (applied sweep-free under cached_or_first): the XLA-native program —
-# measured fastest on v5e (344 µs vs the best Pallas chunking's 460 µs at
-# b=8 hq=64 s=8192; both HBM-bound, XLA's fusion wins). The Pallas
-# chunkings stay in the space for chips/shapes where they win, and carry
-# the paged/int8 variants which have no XLA form.
+# measured fastest on v5e (344 µs vs the best per-head Pallas chunking's
+# 460 µs at b=8 hq=64 s=8192; both HBM-bound, XLA's fusion wins). The
+# fused-heads chunkings collapse the grid h_kv-fold (the per-head
+# kernel's deficit was per-step cost, not math) and are the candidates
+# expected to retire the sentinel. The per-head ones stay for MANY-kv-head
+# shapes: the fused K/V slab is h_kv·block_s·d per buffer, so its VMEM
+# footprint grows linearly with h_kv and large (h_kv × block_s) products
+# exceed the budget — where the fused candidates fail to compile, the
+# sweep falls through to the per-head kernel.
 FLASH_DECODE_TUNE_SPACE = (
     FlashDecodeConfig(block_s=0),
+    FlashDecodeConfig(block_s=2048, fuse_heads=True),
+    FlashDecodeConfig(block_s=1024, fuse_heads=True),
+    FlashDecodeConfig(block_s=4096, fuse_heads=True),
+    FlashDecodeConfig(block_s=512, fuse_heads=True),
     FlashDecodeConfig(block_s=1024),
     FlashDecodeConfig(block_s=512),
     FlashDecodeConfig(block_s=2048),
@@ -581,7 +785,10 @@ def _fd_effective_block(cfg, q, k, v, kv_lens, mesh, *, axis="tp", **_):
     kernel — time one (pick_block caps block_s at the local KV length)."""
     if cfg.block_s == 0:
         return 0  # XLA-native path: its own kernel
-    return pick_block(k.shape[2] // mesh.shape[axis], cfg.block_s)
+    return (
+        pick_block(k.shape[2] // mesh.shape[axis], cfg.block_s),
+        cfg.fuse_heads,
+    )
 
 
 flash_decode_op = contextual_autotune(

@@ -586,14 +586,35 @@ def tp_moe_mlp_op(
 # Whole-pipeline sweep: both fused kernels (or both halves of the
 # sequential composition) are timed together per candidate. FIRST entry =
 # best-known default (applied sweep-free under cached_or_first).
+#
+# Large block_m entries lead: at block_m=128 the grouped GEMM re-fetches
+# each expert's K×block_n weight strip once per 128-row block, which at
+# Mixtral-class shapes is ~15 GB of B traffic per GEMM — memory-bound at
+# ~half the chip's dense MFU. block_m=512 cuts that 4× (the whole
+# pipeline goes compute-bound) and costs only the extra alignment padding
+# (expected E·block_m/2 rows ≈ 12% at the bench shape), which the
+# whole-pipeline timing prices in honestly.
 TP_MOE_TUNE_SPACE = (
+    GroupGemmConfig(512, 1024, 512),
+    GroupGemmConfig(512, 2048, 512),
+    GroupGemmConfig(256, 1024, 512),
+    GroupGemmConfig(256, 2048, 512),
     GroupGemmConfig(128, 1024, 512),
     GroupGemmConfig(128, 2048, 512),
     GroupGemmConfig(128, 512, 512),
     GroupGemmConfig(128, 1024, 1024),
-    GroupGemmConfig(256, 1024, 512),
 )
 
-tp_moe_mlp_op = contextual_autotune(TP_MOE_TUNE_SPACE, name="tp_moe_mlp")(
-    tp_moe_mlp_op
-)
+def _moe_block_sensible(cfg, x, w_up, w_down, topk_ids, topk_weights, *a, **k):
+    """Shape guard for the sweep-free walk: block_m is also the alignment
+    block, so each active expert pads to a block_m multiple — expected
+    E·block_m/2 padding rows. Candidates whose expected padding exceeds
+    ~25% of the problem's t = tokens·topk real rows are never sensible,
+    however fast their tiles; the 128-row entries always stay viable."""
+    t = topk_ids.shape[0] * topk_ids.shape[1]
+    return cfg.block_m <= 128 or w_up.shape[0] * cfg.block_m <= t // 2
+
+
+tp_moe_mlp_op = contextual_autotune(
+    TP_MOE_TUNE_SPACE, name="tp_moe_mlp", precondition=_moe_block_sensible
+)(tp_moe_mlp_op)
