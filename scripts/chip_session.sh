@@ -26,25 +26,35 @@ cd "$(dirname "$0")/.."
 mkdir -p docs/chip_logs
 stamp=$(date -u +%Y%m%d_%H%M)
 
-echo "=== [1/4] smoke stress"
+echo "=== [1/6] smoke stress"
 timeout 3600 python scripts/tpu_smoke.py > "docs/chip_logs/${stamp}_smoke.log" 2>&1
 smoke_rc=$?
 echo "smoke rc=$smoke_rc" >> "docs/chip_logs/${stamp}_smoke.log"
 
-echo "=== [2/4] bench with full sweeps (warms .autotune_cache/ + .jax_cache/)"
+echo "=== [2/6] bench with full sweeps (warms .autotune_cache/ + .jax_cache/)"
 TDT_BENCH_TUNE=1 timeout 3600 python bench.py > "docs/chip_logs/${stamp}_bench_tuned.log" 2>&1
 tuned_rc=$?
 echo "tuned rc=$tuned_rc" >> "docs/chip_logs/${stamp}_bench_tuned.log"
 
-echo "=== [3/4] bounded-time bench (driver mode, warm caches)"
+echo "=== [3/6] bounded-time bench (driver mode, warm caches)"
 timeout 1800 python bench.py > "docs/chip_logs/${stamp}_bench_driver_mode.log" 2>&1
 driver_rc=$?
 echo "driver rc=$driver_rc" >> "docs/chip_logs/${stamp}_bench_driver_mode.log"
 
-echo "=== [4/4] native PJRT runner round trip"
+echo "=== [4/6] native PJRT runner round trip"
 timeout 900 bash scripts/pjrt_runner_check.sh > "docs/chip_logs/${stamp}_pjrt_runner.log" 2>&1
 pjrt_rc=$?
 echo "pjrt rc=$pjrt_rc" >> "docs/chip_logs/${stamp}_pjrt_runner.log"
 
-echo "rc: smoke=$smoke_rc tuned=$tuned_rc driver=$driver_rc pjrt=$pjrt_rc"
-exit $(( smoke_rc || tuned_rc || driver_rc || pjrt_rc ))
+echo "=== [5/6] serving throughput (continuous batching, tokens/s)"
+timeout 1800 python scripts/serving_bench.py > "docs/chip_logs/${stamp}_serving.log" 2>&1
+serving_rc=$?
+echo "serving rc=$serving_rc" >> "docs/chip_logs/${stamp}_serving.log"
+
+echo "=== [6/6] native decode-step loop (pjrt_runner vs python, tokens/s)"
+timeout 1800 bash scripts/native_serving_bench.sh > "docs/chip_logs/${stamp}_native_serving.log" 2>&1
+native_rc=$?
+echo "native serving rc=$native_rc" >> "docs/chip_logs/${stamp}_native_serving.log"
+
+echo "rc: smoke=$smoke_rc tuned=$tuned_rc driver=$driver_rc pjrt=$pjrt_rc serving=$serving_rc native=$native_rc"
+exit $(( smoke_rc || tuned_rc || driver_rc || pjrt_rc || serving_rc || native_rc ))

@@ -1,0 +1,278 @@
+"""DCN-aware composition: collectives over a declared slice-crossing
+(DCN) axis must lower that axis to XLA collectives while the ICI axes
+keep the fused remote-DMA kernels, and the composition must match the
+flat XLA goldens exactly (≙ the reference's inter-node plane:
+allgather.py:291-375 2-D internode AG, reduce_scatter.py:525-560 P2P
+inter-node RS stage, ep_a2a.py:36-147 cross-node EP dispatch).
+
+The virtual-CPU mesh has no real slice boundary, so the DCN plane is
+DECLARED via ``config.update(dcn_axes=...)`` — the same override a user
+gives a virtual or irregular mesh; real Multislice meshes get it from
+``topology.detect_dcn_axes`` in ``make_mesh``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from triton_dist_tpu import config as tdt_config
+
+
+@pytest.fixture
+def dcn_dp():
+    """Declare 'dp' as the DCN axis for the duration of one test."""
+    prev = tdt_config.get_config().dcn_axes
+    tdt_config.update(dcn_axes=("dp",))
+    yield "dp"
+    tdt_config.update(dcn_axes=prev)
+
+
+def _run(mesh, fn, in_specs, out_specs, *args):
+    return jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    )(*args)
+
+
+def test_detect_dcn_axes_cpu_is_empty(mesh2x4):
+    """CPU devices report no slice ids: nothing auto-detected (and the
+    explicit declaration below is therefore the test vehicle)."""
+    from triton_dist_tpu.parallel.topology import detect_dcn_axes
+
+    assert detect_dcn_axes(mesh2x4) == ()
+
+
+def test_all_gather_dcn_outer(mesh2x4, dcn_dp):
+    """(dcn, ici) allgather == flat XLA golden; the dp hop must be the
+    XLA collective (no remote DMA crosses the declared slice boundary)."""
+    from triton_dist_tpu.ops.allgather import all_gather
+
+    m, d = 8, 64
+    x = jax.random.normal(jax.random.PRNGKey(0), (8 * m, d), jnp.float32)
+    out = _run(
+        mesh2x4, lambda x: all_gather(x, axis=("dp", "tp")),
+        P(("dp", "tp")), P(None), x,
+    )
+    ref = _run(
+        mesh2x4,
+        lambda x: jax.lax.all_gather(x, ("dp", "tp"), tiled=True),
+        P(("dp", "tp")), P(None), x,
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_all_gather_dcn_single_axis(mesh2x4, dcn_dp):
+    from triton_dist_tpu.ops.allgather import all_gather
+
+    m, d = 4, 64
+    x = jax.random.normal(jax.random.PRNGKey(1), (2 * m, d), jnp.float32)
+    out = _run(
+        mesh2x4, lambda x: all_gather(x, axis="dp"),
+        P("dp"), P(None, None), x,
+    )
+    ref = _run(
+        mesh2x4, lambda x: jax.lax.all_gather(x, "dp", tiled=True),
+        P("dp"), P(None, None), x,
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_reduce_scatter_dcn_outer(mesh2x4, dcn_dp):
+    """(dcn, ici) reduce-scatter: inner ICI axis pre-reduces every byte
+    before the DCN hop; result == flat psum_scatter golden."""
+    from triton_dist_tpu.ops.reduce_scatter import reduce_scatter
+
+    m, d = 8, 64
+    x = jax.random.normal(jax.random.PRNGKey(2), (8 * m, d), jnp.float32)
+    out = _run(
+        mesh2x4,
+        lambda x: reduce_scatter(x, axis=("dp", "tp")),
+        P(None, None), P(("dp", "tp")), x,
+    )
+    ref = _run(
+        mesh2x4,
+        lambda x: jax.lax.psum_scatter(x, ("dp", "tp"), tiled=True),
+        P(None, None), P(("dp", "tp")), x,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_gemm_rs_dcn_outer(mesh2x4, dcn_dp):
+    """Fused GEMM-RS inner + XLA psum-scatter across the slice boundary."""
+    from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs
+
+    m_tot, k_tot, nd = 64, 64, 32
+    ka, kb = jax.random.split(jax.random.PRNGKey(3))
+    a = jax.random.normal(ka, (m_tot, k_tot), jnp.float32) / 8
+    b = jax.random.normal(kb, (k_tot, nd), jnp.float32) / 8
+
+    out = _run(
+        mesh2x4,
+        lambda a, b: gemm_rs(a, b, axis=("dp", "tp")),
+        (P(None, ("dp", "tp")), P(("dp", "tp"), None)),
+        P(("dp", "tp"), None), a, b,
+    )
+    ref = _run(
+        mesh2x4,
+        lambda a, b: jax.lax.psum_scatter(a @ b, ("dp", "tp"), tiled=True),
+        (P(None, ("dp", "tp")), P(("dp", "tp"), None)),
+        P(("dp", "tp"), None), a, b,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_ag_gemm_dcn_outer(mesh2x4, dcn_dp):
+    """AG-GEMM over (dcn, ici): fused ring on ICI computes each outer
+    group's rows once; XLA's all-gather shares outputs across DCN."""
+    from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm
+
+    m_loc, k_dim, n_loc = 8, 64, 32
+    ka, kb = jax.random.split(jax.random.PRNGKey(4))
+    a = jax.random.normal(ka, (8 * m_loc, k_dim), jnp.float32) / 8
+    b = jax.random.normal(kb, (k_dim, 4 * n_loc), jnp.float32) / 8
+    cfg = AGGemmConfig(8, 32, 32)
+
+    out = _run(
+        mesh2x4,
+        lambda a, b: ag_gemm(a, b, axis=("dp", "tp"), config=cfg),
+        (P(("dp", "tp")), P(None, "tp")), P(None, "tp"), a, b,
+    )
+    ref = _run(
+        mesh2x4,
+        lambda a, b: jax.lax.all_gather(a, ("dp", "tp"), tiled=True) @ b,
+        (P(("dp", "tp")), P(None, "tp")), P(None, "tp"), a, b,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_fast_all_to_all_dcn(mesh2x4, dcn_dp):
+    """EP slab exchange over the DCN axis == the transpose golden; payload
+    metadata rides along exactly as on the ICI path."""
+    from triton_dist_tpu.ops.all_to_all import fast_all_to_all
+
+    n, max_m, hidden = 2, 4, 64
+    tokens = jax.random.normal(jax.random.PRNGKey(5), (2, n, max_m, hidden))
+    splits = jnp.full((2, n), max_m, jnp.int32)
+    meta = jnp.arange(2 * n * max_m, dtype=jnp.int32).reshape(2, n, max_m)
+
+    def fn(t, s, m):
+        r, rs, rm = fast_all_to_all(t[0], s[0], meta=m[0], axis="dp")
+        return r[None], rs[None], rm[None]
+
+    out, osp, om = _run(
+        mesh2x4, fn,
+        (P("dp"), P("dp"), P("dp")),
+        (P("dp"), P("dp"), P("dp")),
+        tokens, splits, meta,
+    )
+    # golden: slab p of PE q -> slab q of PE p (transpose over dp pairs)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(tokens).swapaxes(0, 1)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(om), np.asarray(meta).swapaxes(0, 1)
+    )
+    np.testing.assert_array_equal(np.asarray(osp), np.asarray(splits).T)
+
+
+def test_hier_ep_layer_dcn_outer(mesh2x4, dcn_dp):
+    """Hierarchical EP dispatch/combine with the OUTER (node) phase on
+    DCN: phase-1's a2a lowers to XLA transparently inside the layer, so
+    the identity-experts roundtrip still equals the topk-weighted
+    identity (mirrors test_hier_ep_a2a_roundtrip on the ICI path)."""
+    from triton_dist_tpu.layers.ep_a2a_layer import HierEPAll2AllLayer
+
+    n_o, n_i, m_loc, hidden, topk = 2, 4, 8, 64, 2
+    n_exp = 16
+    layer = HierEPAll2AllLayer(
+        n_experts=n_exp, topk=topk, max_m1=m_loc * topk,
+        max_m2=n_o * m_loc * topk, outer="dp", inner="tp",
+    )
+    m_tot = n_o * n_i * m_loc
+    x = jax.random.normal(jax.random.PRNGKey(30), (m_tot, hidden), jnp.float32)
+    ids = jax.random.randint(
+        jax.random.PRNGKey(31), (m_tot, topk), 0, n_exp, jnp.int32
+    )
+    tw = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(32), (m_tot, topk)))
+
+    def fn(x, ids, tw):
+        recv, info = layer.dispatch(x, ids, tw)
+        out = layer.combine(recv, info, m_loc)  # identity "experts"
+        return out, info.overflow[None]
+
+    got, ovf = _run(
+        mesh2x4, fn,
+        (P(("dp", "tp"), None),) * 3,
+        (P(("dp", "tp"), None), P(("dp", "tp"))),
+        x, ids, tw,
+    )
+    assert int(np.asarray(ovf).sum()) == 0
+    want = np.asarray(x) * np.asarray(tw.sum(-1))[:, None]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_axis_crosses_slices_checks_every_column():
+    """Slice detection must scan ALL columns of an axis, not just the one
+    at index 0 of the other axes — a miss sends remote DMA across a
+    boundary with no ICI path."""
+    import types
+
+    from triton_dist_tpu.parallel.topology import axis_crosses_slices
+
+    def dev(s):
+        return types.SimpleNamespace(slice_index=s)
+
+    # 3x4 mesh: row 0 all slice 0 (tp column at dp=0 is uniform), rows
+    # 1-2 interleave slices 1/2 along tp — tp DOES cross slices
+    grid = np.array(
+        [[dev(0)] * 4,
+         [dev(1), dev(2), dev(1), dev(2)],
+         [dev(2), dev(1), dev(2), dev(1)]]
+    )
+    mesh = types.SimpleNamespace(devices=grid, axis_names=("dp", "tp"))
+    assert axis_crosses_slices(mesh, "tp")
+    assert axis_crosses_slices(mesh, "dp")
+    # uniform 1-slice grid: nothing crosses
+    grid0 = np.array([[dev(0)] * 4] * 3)
+    mesh0 = types.SimpleNamespace(devices=grid0, axis_names=("dp", "tp"))
+    assert not axis_crosses_slices(mesh0, "tp")
+    assert not axis_crosses_slices(mesh0, "dp")
+    # slice-aligned outer axis: dp crosses, tp doesn't
+    grid2 = np.array([[dev(r)] * 4 for r in range(3)])
+    mesh2 = types.SimpleNamespace(devices=grid2, axis_names=("dp", "tp"))
+    assert axis_crosses_slices(mesh2, "dp")
+    assert not axis_crosses_slices(mesh2, "tp")
+
+
+def test_detected_dcn_scoped_per_mesh_name():
+    """A later mesh re-using an axis name overwrites the earlier
+    detection verdict for that name (no permanent contamination); user
+    declarations in config.dcn_axes are untouched."""
+    import types
+
+    from triton_dist_tpu.parallel import topology
+
+    def dev(s):
+        return types.SimpleNamespace(slice_index=s)
+
+    multi = types.SimpleNamespace(
+        devices=np.array([[dev(0)] * 2, [dev(1)] * 2]),
+        axis_names=("dp", "tp"),
+    )
+    single = types.SimpleNamespace(
+        devices=np.array([[dev(0)] * 2] * 2), axis_names=("dp", "tp")
+    )
+    prev = set(topology._DETECTED_DCN)
+    try:
+        topology.register_mesh_dcn(multi)
+        assert topology.is_dcn_axis_name("dp")
+        assert not topology.is_dcn_axis_name("tp")
+        topology.register_mesh_dcn(single)  # same names, pure ICI now
+        assert not topology.is_dcn_axis_name("dp")
+    finally:
+        topology._DETECTED_DCN.clear()
+        topology._DETECTED_DCN.update(prev)

@@ -38,6 +38,16 @@ class Config:
     debug_ep_overflow: bool = False
     # Print autotuner decisions.
     verbose_autotune: bool = bool(int(os.environ.get("TDT_VERBOSE_AUTOTUNE", "0")))
+    # USER-DECLARED mesh axes whose hops cross TPU slice boundaries
+    # (Multislice DCN, not ICI). Remote-DMA kernels cannot reach across
+    # slices, so collective ops lower these axes to XLA collectives
+    # (which ride DCN) and keep the fused kernels on the ICI axes. Real
+    # Multislice meshes are AUTO-detected separately (scoped per mesh:
+    # ``topology.register_mesh_dcn``, called by ``make_mesh``); declare
+    # here only for virtual meshes / tests (≙ the reference treating its
+    # inter-node plane differently from NVLink, allgather.py:291-375).
+    # Ops consult ``topology.is_dcn_axis_name`` = declared ∪ detected.
+    dcn_axes: tuple = ()
 
 
 _config = Config()

@@ -142,6 +142,21 @@ def fast_all_to_all(
         if meta is None:
             return tokens, splits.reshape(n)
         return tokens, splits.reshape(n), meta
+    from triton_dist_tpu.ops.allgather import _is_dcn
+
+    if _is_dcn(axis):
+        # slice-crossing axis: remote DMA cannot reach across slices, so
+        # the slab exchange lowers to XLA's all-to-all on DCN. The slab
+        # contract (slab p → PE p, payload alongside) is identical, so
+        # callers — including the hierarchical EP's outer phase — are
+        # oblivious (≙ the reference's cross-node EP dispatch over IB,
+        # ep_a2a.py:36-147).
+        recv = jax.lax.all_to_all(tokens, axis, 0, 0, tiled=True)
+        rpayload = jax.lax.all_to_all(payload, axis, 0, 0, tiled=True)
+        rsplits = rpayload[:, 0]
+        if meta is None:
+            return recv, rsplits
+        return recv, rsplits, rpayload[:, 1:].reshape(meta.shape)
     n_steps = n - 1
     recv, rpayload = dist_pallas_call(
         functools.partial(_a2a_kernel, axis=axis, n=n, chunks=chunks),

@@ -35,6 +35,12 @@ from triton_dist_tpu.parallel import topology
 from triton_dist_tpu.shmem import device as shmem
 
 
+def _is_dcn(axis) -> bool:
+    """Whether this mesh axis crosses TPU slice boundaries (DCN, not ICI):
+    declared via ``config.dcn_axes`` or auto-detected at mesh creation."""
+    return topology.is_dcn_axis_name(axis)
+
+
 def get_auto_all_gather_method(
     chunk_bytes: int, n_pes: int, devices: Any = None
 ) -> str:
@@ -289,22 +295,36 @@ def all_gather(x: jax.Array, *, axis: str = "tp", method: str = "auto", interpre
                 f"multi-axis all_gather always uses the ring hierarchy; got "
                 f"method={method!r} (only 'auto' is valid with >1 axis)"
             )
-        elif len(axis) == 2:
-            return all_gather_2d(x, axes=tuple(axis), interpret=interpret)
         else:
             # N-D (≙ the reference's 3-D node×numa×gpu push hierarchy,
             # low_latency_allgather.py:401): fused 2-D ring over the two
             # INNERMOST axes, then staged gathers outward — each outer hop
             # streams a block the inner hierarchy already assembled, and
             # the outermost-major concat order matches
-            # jax.lax.all_gather(x, axes, tiled=True).
-            out = all_gather_2d(x, axes=tuple(axis[-2:]), interpret=interpret)
-            for a in reversed(axis[:-2]):
+            # jax.lax.all_gather(x, axes, tiled=True). A DCN axis (slice
+            # boundary: no ICI path, remote DMA cannot reach — see
+            # config.dcn_axes) is never fused into the 2-D ring; it peels
+            # off to the single-axis path below, which lowers it to the
+            # XLA collective (≙ the reference's internode
+            # nvshmemx_putmem_signal stage, allgather.py:291-375 — here
+            # XLA owns the DCN transport).
+            axes = tuple(axis)
+            if len(axes) >= 2 and not _is_dcn(axes[-1]) and not _is_dcn(axes[-2]):
+                out = all_gather_2d(x, axes=axes[-2:], interpret=interpret)
+                rest = axes[:-2]
+            else:
+                out = all_gather(x, axis=axes[-1], interpret=interpret)
+                rest = axes[:-1]
+            for a in reversed(rest):
                 out = all_gather(out, axis=a, interpret=interpret)
             return out
     n = int(jax.lax.axis_size(axis))
     if n == 1:
         return x
+    if _is_dcn(axis):
+        # slice-crossing axis: XLA's all-gather rides DCN; the fused
+        # remote-DMA kernels are ICI-only by construction
+        return jax.lax.all_gather(x, axis, tiled=True)
     orig_shape = x.shape
     if x.ndim == 1:
         x = x.reshape(x.shape[0], 1)

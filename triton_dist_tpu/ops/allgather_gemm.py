@@ -240,11 +240,36 @@ def ag_gemm(
             raise ValueError("AGGemmConfig(block_m=0) (XLA dot) is world-1 only")
         out = jnp.dot(a, b, preferred_element_type=out_dtype)
         return (out, a) if gather_output else out
+    from triton_dist_tpu.ops.allgather import _is_dcn
+
     if isinstance(axis, (tuple, list)):
         if len(axis) == 1:
             axis = axis[0]
         else:
             assert len(axis) == 2, f"at most 2 axes supported, got {axis}"
+            outer_ax, inner_ax = axis
+            if _is_dcn(outer_ax) or _is_dcn(inner_ax):
+                # a slice-crossing axis (either position): keep the fused
+                # ring on whatever ICI axis remains and gather COMPUTED
+                # OUTPUT rows across the other — each inner group computes
+                # its own rows once (vs gathering A, which would
+                # n_o-plicate the FLOPs; ≙ the reference's 2-D internode
+                # AG staging its cross-node hop separately,
+                # allgather.py:291-375). Both recursive calls route
+                # per-axis: a DCN hop lowers to the XLA collective, an ICI
+                # hop keeps the fused kernel.
+                from triton_dist_tpu.ops.allgather import all_gather
+
+                res = ag_gemm(
+                    a, b, axis=inner_ax, config=config,
+                    gather_output=gather_output, out_dtype=out_dtype,
+                    interpret=interpret,
+                )
+                y, ag = res if gather_output else (res, None)
+                out = all_gather(y, axis=outer_ax, interpret=interpret)
+                if gather_output:
+                    return out, all_gather(ag, axis=outer_ax, interpret=interpret)
+                return out
             return _ag_gemm_2d(
                 a, b, axes=tuple(axis), cfg=cfg, gather_output=gather_output,
                 out_dtype=out_dtype, interpret=interpret,
@@ -252,6 +277,12 @@ def ag_gemm(
     n = int(jax.lax.axis_size(axis))
     m_loc, k_dim = a.shape
     n_loc = b.shape[1]
+    if n > 1 and _is_dcn(axis):
+        # a purely-DCN TP axis: no ICI for the fused ring — lower to XLA's
+        # all-gather + dot and let its scheduler overlap the DCN transfer
+        ag = jax.lax.all_gather(a, axis, tiled=True)
+        out = jnp.dot(ag, b, preferred_element_type=out_dtype)
+        return (out, ag) if gather_output else out
     bm = _pick_block(m_loc, cfg.block_m)
     bn = _pick_block(n_loc, cfg.block_n)
     if n == 1:

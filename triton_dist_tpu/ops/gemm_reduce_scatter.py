@@ -216,11 +216,42 @@ def gemm_rs(
     """
     cfg = config or GemmRSConfig()
     out_dtype = out_dtype or a.dtype
+    from triton_dist_tpu.ops.allgather import _is_dcn
+
     if isinstance(axis, (tuple, list)):
         if len(axis) == 1:
             axis = axis[0]
         else:
             assert len(axis) == 2, f"at most 2 axes supported, got {axis}"
+            outer_ax, inner_ax = axis
+            if _is_dcn(outer_ax) or _is_dcn(inner_ax):
+                # a slice-crossing axis (either position): fused GEMM-RS on
+                # the inner hop first (pre-reducing every byte n_i-fold
+                # before the outer hop), then a reduce-scatter on the outer
+                # hop — both recursive calls route per-axis, so a DCN hop
+                # lowers to XLA's psum-scatter and an ICI hop keeps the
+                # fused kernels (≙ the reference's inter-node P2P stage
+                # after the intra-node RS pipeline,
+                # reduce_scatter.py:525-560). Row layout: chunk (o, i) must
+                # end at outer-rank o, inner-rank i — the inner RS keeps
+                # rows [i*n_o*m + o*m, ...), so pre-swizzle a to slab-major
+                # (i, o) order as the N-D reduce_scatter does.
+                from triton_dist_tpu.ops.reduce_scatter import reduce_scatter
+
+                n_o = int(jax.lax.axis_size(outer_ax))
+                n_i = int(jax.lax.axis_size(inner_ax))
+                m_tot0 = a.shape[0]
+                m0 = m_tot0 // (n_o * n_i)
+                at = (
+                    a.reshape(n_o, n_i, m0, a.shape[1])
+                    .swapaxes(0, 1)
+                    .reshape(m_tot0, a.shape[1])
+                )
+                part = gemm_rs(
+                    at, b, axis=inner_ax, method=method, config=config,
+                    out_dtype=out_dtype, interpret=interpret,
+                )  # [n_o*m0, N] pre-reduced over the inner axis
+                return reduce_scatter(part, axis=outer_ax, interpret=interpret)
             return _gemm_rs_2d(
                 a, b, axes=tuple(axis), method=method, cfg=cfg,
                 out_dtype=out_dtype, interpret=interpret,
@@ -228,6 +259,12 @@ def gemm_rs(
     n = int(jax.lax.axis_size(axis))
     m_tot, k_loc = a.shape
     n_dim = b.shape[1]
+    if n > 1 and _is_dcn(axis):
+        # a purely-DCN axis: no ICI for the fused producer — XLA's
+        # dot + psum-scatter owns the DCN transport
+        return jax.lax.psum_scatter(
+            jnp.dot(a, b, preferred_element_type=out_dtype), axis, tiled=True
+        )
     if cfg.block_m == 0:
         if n != 1:
             raise ValueError("GemmRSConfig(block_m=0) (XLA dot) is world-1 only")

@@ -242,6 +242,16 @@ def reduce_scatter(
     n = int(jax.lax.axis_size(axis))
     if n == 1:
         return x
+    from triton_dist_tpu.ops.allgather import _is_dcn
+
+    if _is_dcn(axis):
+        # slice-crossing axis: no ICI path for remote DMA — XLA's
+        # psum-scatter rides DCN. The N-D recursion above already ordered
+        # inner (ICI) axes first, so every byte crossing DCN has been
+        # pre-reduced n_inner-fold (≙ the reference's P2P inter-node RS
+        # stage running AFTER the intra-node pipeline,
+        # reduce_scatter.py:525-560).
+        return jax.lax.psum_scatter(x, axis, tiled=True)
     orig_ndim = x.ndim
     if x.ndim == 1:
         x = x.reshape(x.shape[0], 1)

@@ -67,7 +67,15 @@ def make_mesh(shape: Mapping[str, int] | None = None, devices: Sequence[jax.Devi
     if int(np.prod(sizes)) != len(devices):
         raise ValueError(f"mesh shape {dict(shape)} does not cover {len(devices)} devices")
     arr = np.array(devices).reshape(sizes)
-    return Mesh(arr, tuple(shape.keys()))
+    mesh = Mesh(arr, tuple(shape.keys()))
+    # Multislice: axes whose hops cross slice boundaries have no ICI path —
+    # record them (scoped per axis name, latest mesh wins) so collectives
+    # lower those hops to XLA/DCN. User declarations via
+    # config.update(dcn_axes=...) live separately and always survive.
+    from triton_dist_tpu.parallel.topology import register_mesh_dcn
+
+    register_mesh_dcn(mesh)
+    return mesh
 
 
 def initialize_distributed(

@@ -45,6 +45,12 @@ HBM_GBPS = {
     "cpu": 50.0,
 }
 
+# Per-host DCN (data-center network) bandwidth, GB/s — the inter-slice
+# fabric of Multislice TPU (≙ the reference's inter-node IB plane,
+# utils.py:742 internode speeds). Conservative public 200 Gbps NIC figure;
+# used only by perf models and method auto-selection, never correctness.
+DCN_GBPS = 25.0
+
 
 def tpu_generation() -> str:
     """Best-effort TPU generation string ('v5e', 'v5p', ...) or 'cpu'."""
@@ -134,3 +140,74 @@ def device_coords(devices: Sequence[jax.Device] | None = None):
             return None
         coords.append(tuple(c))
     return coords
+
+
+def device_slice_ids(devices: Sequence[jax.Device] | None = None):
+    """Multislice slice index per device, or None when the backend does
+    not report one (single-slice TPU, CPU, interpreter). Devices with
+    different slice ids have NO ICI path between them — only DCN
+    (≙ the reference's node boundary: ranks on different hosts reach each
+    other over IB, not NVLink)."""
+    devices = list(devices if devices is not None else jax.devices())
+    ids = []
+    for d in devices:
+        s = getattr(d, "slice_index", None)
+        if s is None:
+            return None
+        ids.append(int(s))
+    return ids
+
+
+def axis_crosses_slices(mesh, axis: str) -> bool:
+    """Whether stepping along `axis` ever crosses a slice boundary — i.e.
+    whether this axis's collectives ride DCN. False when slice ids are
+    unavailable (single-slice and test backends).
+
+    EVERY column along the axis is checked (all positions of the other
+    axes, not just index 0): a user-ordered mesh can be slice-uniform in
+    one column and slice-crossing in another, and a miss here would send
+    remote DMA across a boundary with no ICI path."""
+    import numpy as _np
+
+    ids = device_slice_ids(list(mesh.devices.reshape(-1)))
+    if ids is None:
+        return False
+    ax = tuple(mesh.axis_names).index(axis)
+    grid = _np.array(ids).reshape(mesh.devices.shape)
+    cols = _np.moveaxis(grid, ax, 0).reshape(grid.shape[ax], -1)
+    return bool((cols != cols[0:1]).any())
+
+
+# Auto-DETECTED slice-crossing axis names, refreshed per make_mesh call:
+# a new mesh overwrites the verdict for ITS axis names (so a later
+# single-slice mesh reusing a name is not poisoned by an earlier
+# Multislice mesh), while names it doesn't use keep their last verdict.
+# User DECLARATIONS live separately in config.dcn_axes and are never
+# touched here.
+_DETECTED_DCN: set = set()
+
+
+def register_mesh_dcn(mesh) -> tuple[str, ...]:
+    """Record which of `mesh`'s axes cross slice boundaries (called by
+    ``parallel.mesh.make_mesh``). Returns the detected tuple."""
+    detected = detect_dcn_axes(mesh)
+    for ax in mesh.axis_names:
+        _DETECTED_DCN.discard(ax)
+    _DETECTED_DCN.update(detected)
+    return detected
+
+
+def detect_dcn_axes(mesh) -> tuple[str, ...]:
+    """The mesh axes whose hops cross slice boundaries, in mesh order."""
+    return tuple(
+        ax for ax in mesh.axis_names if axis_crosses_slices(mesh, ax)
+    )
+
+
+def is_dcn_axis_name(name) -> bool:
+    """Whether collectives on this axis name must ride DCN: declared via
+    ``config.dcn_axes`` (user) or auto-detected for the latest mesh using
+    the name (``register_mesh_dcn``)."""
+    from triton_dist_tpu import config as tdt_config
+
+    return name in tdt_config.get_config().dcn_axes or name in _DETECTED_DCN
