@@ -6,7 +6,19 @@ NOTE: on hosts with very few CPU cores, XLA:CPU's host thread pool can
 deadlock when many interpreted remote DMAs move large payloads concurrently
 (observed threshold ~16 KiB/chunk in 8-device ring kernels on a 1-core
 box). Keep per-DMA test payloads <= ~8 KiB; correctness coverage does not
-need more, and real-TPU runs are unaffected."""
+need more, and real-TPU runs are unaffected.
+
+Runtime budget (1-core box, measured 2026-07-31): the `-m quick` tier is
+the <5-minute gate; the full suite is ~22-25 min. The floor is
+structural, not shape-driven: every interpreted pallas_call pays ~44 ms
+of host machinery (≈112 io_callbacks + the per-call shared-memory
+setup/cleanup barriers across virtual devices — profiled against
+jax 0.9 interpret_pallas_call), and a model-level train-step test runs
+hundreds of such calls plus a ~35 s trace+XLA-compile of its fwd+bwd
+shard_map program that no persistent cache can hold (callback-bearing
+executables are not cacheable). Model tests therefore use the smallest
+layer count that still covers their property, and serving programs are
+shared across tests via the keyed `jit_shard_map` cache."""
 
 import os
 

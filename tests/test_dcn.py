@@ -276,3 +276,93 @@ def test_detected_dcn_scoped_per_mesh_name():
     finally:
         topology._DETECTED_DCN.clear()
         topology._DETECTED_DCN.update(prev)
+
+
+def test_gemm_rs_dcn_inner(mesh2x4, dcn_dp):
+    """DCN listed in the INNER tuple slot: the composition must still
+    pre-reduce on ICI before any byte crosses the boundary (transport
+    order, not tuple order) and match the flat golden for the GIVEN
+    tuple order."""
+    from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs
+
+    m_tot, k_tot, nd = 64, 64, 32
+    ka, kb = jax.random.split(jax.random.PRNGKey(8))
+    a = jax.random.normal(ka, (m_tot, k_tot), jnp.float32) / 8
+    b = jax.random.normal(kb, (k_tot, nd), jnp.float32) / 8
+
+    out = _run(
+        mesh2x4,
+        lambda a, b: gemm_rs(a, b, axis=("tp", "dp")),
+        (P(None, ("tp", "dp")), P(("tp", "dp"), None)),
+        P(("tp", "dp"), None), a, b,
+    )
+    ref = _run(
+        mesh2x4,
+        lambda a, b: jax.lax.psum_scatter(a @ b, ("tp", "dp"), tiled=True),
+        (P(None, ("tp", "dp")), P(("tp", "dp"), None)),
+        P(("tp", "dp"), None), a, b,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_ag_gemm_dcn_inner(mesh2x4, dcn_dp):
+    """AG-GEMM with DCN in the inner tuple slot: fused compute stays on
+    ICI, only outputs cross the boundary, and the row order matches the
+    golden for the given tuple order."""
+    from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm
+
+    m_loc, k_dim, n_tot = 8, 64, 128
+    ka, kb = jax.random.split(jax.random.PRNGKey(9))
+    a = jax.random.normal(ka, (8 * m_loc, k_dim), jnp.float32) / 8
+    b = jax.random.normal(kb, (k_dim, n_tot), jnp.float32) / 8
+    cfg = AGGemmConfig(8, 32, 32)
+
+    out = _run(
+        mesh2x4,
+        lambda a, b: ag_gemm(a, b, axis=("tp", "dp"), config=cfg),
+        (P(("tp", "dp")), P(None, "tp")), P(None, "tp"), a, b,
+    )
+    ref = _run(
+        mesh2x4,
+        lambda a, b: jax.lax.all_gather(a, ("tp", "dp"), tiled=True) @ b,
+        (P(("tp", "dp")), P(None, "tp")), P(None, "tp"), a, b,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_paged_fuse_heads_auto_fallback():
+    """fuse_heads=None picks the fused grid for small pools and falls
+    back to the per-head grid when the fused K/V slab would blow VMEM —
+    serving paths have no kwarg to thread, so the auto guard is what
+    keeps many-kv-head pools compiling."""
+    import importlib
+
+    # the ops package re-exports a FUNCTION named flash_decode that
+    # shadows the module attribute; import the module explicitly
+    fd = importlib.import_module("triton_dist_tpu.ops.flash_decode")
+
+    calls = []
+    orig = fd.dist_pallas_call
+
+    def spy(kernel, *a, **kw):
+        calls.append(kw.get("name"))
+        return orig(kernel, *a, **kw)
+
+    b, g, d, page = 1, 1, 128, 8
+    q = jnp.zeros((b, 2 * g, d), jnp.bfloat16)
+    lens = jnp.array([8], jnp.int32)
+    bt = jnp.zeros((b, 1), jnp.int32)
+    pool = jnp.zeros((1, 2, page, d), jnp.bfloat16)
+    fd.dist_pallas_call = spy
+    prev_budget = fd._FUSED_SLAB_VMEM_BUDGET
+    try:
+        fd.paged_flash_decode(q, pool, pool, lens, bt)
+        assert calls and calls[-1] == "paged_flash_decode_fh"
+        # same pool under a tiny budget: the guard must pick per-head
+        # (overriding the budget keeps the interpret-mode grid small)
+        fd._FUSED_SLAB_VMEM_BUDGET = 4 * page * d  # < one 2-head slab
+        fd.paged_flash_decode(q, pool, pool, lens, bt)
+        assert calls[-1] == "paged_flash_decode"
+    finally:
+        fd.dist_pallas_call = orig
+        fd._FUSED_SLAB_VMEM_BUDGET = prev_budget

@@ -61,6 +61,9 @@ def test_generate_paged_matches_contiguous(mesh4):
     """Paged serving cache (page pool + block table + runtime allocation)
     decodes exactly the tokens the contiguous cache decodes."""
     b, prompt_len, n_steps, s_max = 2, 4, 4, 16
+    # 2 layers ON PURPOSE: the paged pool is indexed per layer, and this
+    # is the one test that would catch a layer-index mix-up in the paged
+    # cache (the contiguous depth test alone would not)
     cfg = TransformerConfig(
         vocab=32, hidden=32, ffn=64, n_layers=2, n_q_heads=8, n_kv_heads=4,
         head_dim=8, batch=b, seq=prompt_len + n_steps,
@@ -89,7 +92,7 @@ def test_continuous_batcher_matches_solo_generate(mesh4, page_size):
 
     s_max = 16
     cfg = TransformerConfig(
-        vocab=32, hidden=32, ffn=64, n_layers=2, n_q_heads=8, n_kv_heads=4,
+        vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
         head_dim=8, batch=2, seq=8,
         ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
     )
@@ -162,7 +165,7 @@ def test_generate_prefill_matches_token_by_token(mesh4):
     same cache contents, same greedy tokens."""
     b, prompt_len, n_steps, s_max = 2, 4, 5, 16
     cfg = TransformerConfig(
-        vocab=32, hidden=32, ffn=64, n_layers=2, n_q_heads=8, n_kv_heads=4,
+        vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
         head_dim=8, batch=b, seq=prompt_len,
         ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
     )
@@ -190,7 +193,7 @@ def test_continuous_batcher_prefill_admission(mesh4):
 
     s_max = 16
     cfg = TransformerConfig(
-        vocab=32, hidden=32, ffn=64, n_layers=2, n_q_heads=8, n_kv_heads=4,
+        vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
         head_dim=8, batch=2, seq=8,
         ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
     )
@@ -234,7 +237,7 @@ def test_generate_moe_matches_full_forward(mesh4):
 
     b, prompt_len, n_steps, s_max = 2, 4, 4, 16
     cfg = MoETransformerConfig(
-        vocab=32, hidden=32, ffn=64, n_layers=2, n_q_heads=8, n_kv_heads=4,
+        vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
         head_dim=8, batch=b, seq=prompt_len, n_experts=4, topk=2,
         ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
         gg_config=GroupGemmConfig(4, 32, 32),

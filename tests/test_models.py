@@ -118,7 +118,9 @@ def test_tp_transformer_loss_parity(mesh4):
 def test_tp_transformer_train_step_dp_tp(mesh2x4):
     """Full dp(2) x tp(4) training step: loss decreases and sharded/
     replicated grads are consistent with the unsharded reference step."""
-    cfg = _cfg()
+    # 1 layer: the train-step property under test; 2-layer stacking stays
+    # covered by the much cheaper forward/loss parity tests
+    cfg = _cfg(n_layers=1)
     model = TPTransformer(cfg)
     params = init_params(jax.random.PRNGKey(5), cfg)
     m = cfg.batch * cfg.seq
@@ -301,7 +303,7 @@ def test_sp_transformer_forward_and_train(mesh4):
 
     b, s = 1, 32
     cfg = SPTransformerConfig(
-        vocab=32, hidden=32, ffn=64, n_layers=2, n_q_heads=2, n_kv_heads=1,
+        vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=2, n_kv_heads=1,
         head_dim=128, batch=b, seq=s,
         ring_config=RingAttentionConfig(block_q=8, block_kv=8),
     )
@@ -526,7 +528,7 @@ def test_sp_transformer_zigzag_matches_contig(mesh4):
 
     b, s, n = 1, 32, 4
     base = dict(
-        vocab=32, hidden=32, ffn=64, n_layers=2, n_q_heads=2, n_kv_heads=1,
+        vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=2, n_kv_heads=1,
         head_dim=128, batch=b, seq=s,
         ring_config=RingAttentionConfig(block_q=4, block_kv=4),
     )
@@ -563,7 +565,7 @@ def test_train_step_with_optax_adam(mesh4):
 
     from triton_dist_tpu.models import opt_state_specs
 
-    cfg = _cfg()
+    cfg = _cfg(n_layers=1)  # optimizer plumbing, not model depth
     model = TPTransformer(cfg)
     params = init_params(jax.random.PRNGKey(60), cfg)
     m = cfg.batch * cfg.seq
@@ -590,6 +592,4 @@ def test_train_step_with_optax_adam(mesh4):
     jax.block_until_ready(loss1)
     p2, o2, loss2 = step(tokens, targets, p1, o1)
     jax.block_until_ready(loss2)
-    p3, _, loss3 = step(tokens, targets, p2, o2)
     assert float(loss2) < float(loss1)
-    assert float(loss3) < float(loss2)

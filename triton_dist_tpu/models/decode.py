@@ -450,12 +450,17 @@ def generate(
 
     cache_specs = spec.specs(cfg)
     pspecs = _specs_for(cfg)
-    out = jax.jit(
-        jax.shard_map(
-            run_prefill if prefill else run, mesh=mesh,
-            in_specs=(pspecs, cache_specs, P(None, None)),
-            out_specs=P(None, None), check_vma=False,
-        )
+    from triton_dist_tpu.ops.common import jit_shard_map
+
+    out = jit_shard_map(
+        run_prefill if prefill else run, mesh,
+        (pspecs, cache_specs, P(None, None)),
+        P(None, None),
+        # the scan length and prompt split are baked into the trace
+        key=(
+            "generate", cfg, spec, fd_config, prefill, prompt_len, n_steps,
+            str(interpret),
+        ),
     )(
         jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
@@ -569,16 +574,18 @@ class ContinuousBatcher:
         )
         # cache donated: a serving-sized cache is gigabytes and the old
         # buffer is dead the moment the step returns — without donation
-        # every token pays a second full cache allocation + copy
-        self._step = jax.jit(
-            jax.shard_map(
-                step, mesh=mesh,
-                in_specs=(
-                    _specs_for(cfg), self.spec.specs(cfg), P(None), P(None),
-                ),
-                out_specs=(P(None, None), self.spec.specs(cfg)),
-                check_vma=False,
-            ),
+        # every token pays a second full cache allocation + copy.
+        # jit_shard_map (keyed cache) rather than raw jax.jit: re-creating
+        # a batcher with the same geometry must not recompile the step
+        # (jit keys on callable identity, and `step` is rebuilt per
+        # instance)
+        from triton_dist_tpu.ops.common import jit_shard_map
+
+        self._step = jit_shard_map(
+            step, mesh,
+            (_specs_for(cfg), self.spec.specs(cfg), P(None), P(None)),
+            (P(None, None), self.spec.specs(cfg)),
+            key=("batcher_step", cfg, self.spec, fd_config, str(interpret)),
             donate_argnums=(1,),
         )
         b = cfg.batch
@@ -622,16 +629,16 @@ class ContinuousBatcher:
                 slot_mask=mask, pick=pick,
             )
 
-        prog = jax.jit(
-            jax.shard_map(
-                fn, mesh=mesh,
-                in_specs=(
-                    _specs_for(cfg), spec.specs(cfg), P(None, None),
-                    P(None), P(None),
-                ),
-                out_specs=(spec.specs(cfg), P(None, None)),
-                check_vma=False,
+        from triton_dist_tpu.ops.common import jit_shard_map
+
+        prog = jit_shard_map(
+            fn, mesh,
+            (
+                _specs_for(cfg), spec.specs(cfg), P(None, None),
+                P(None), P(None),
             ),
+            (spec.specs(cfg), P(None, None)),
+            key=("batcher_prefill", cfg, spec, s_max, bucket),
             donate_argnums=(1,),  # see self._step: the old cache is dead
         )
         self._prefill_progs[bucket] = prog

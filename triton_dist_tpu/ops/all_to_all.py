@@ -142,7 +142,7 @@ def fast_all_to_all(
         if meta is None:
             return tokens, splits.reshape(n)
         return tokens, splits.reshape(n), meta
-    from triton_dist_tpu.ops.allgather import _is_dcn
+    from triton_dist_tpu.parallel.topology import is_dcn_axis_name as _is_dcn
 
     if _is_dcn(axis):
         # slice-crossing axis: remote DMA cannot reach across slices, so

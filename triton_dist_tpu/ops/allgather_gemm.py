@@ -240,7 +240,7 @@ def ag_gemm(
             raise ValueError("AGGemmConfig(block_m=0) (XLA dot) is world-1 only")
         out = jnp.dot(a, b, preferred_element_type=out_dtype)
         return (out, a) if gather_output else out
-    from triton_dist_tpu.ops.allgather import _is_dcn
+    from triton_dist_tpu.parallel.topology import is_dcn_axis_name as _is_dcn
 
     if isinstance(axis, (tuple, list)):
         if len(axis) == 1:
@@ -248,16 +248,44 @@ def ag_gemm(
         else:
             assert len(axis) == 2, f"at most 2 axes supported, got {axis}"
             outer_ax, inner_ax = axis
-            if _is_dcn(outer_ax) or _is_dcn(inner_ax):
-                # a slice-crossing axis (either position): keep the fused
-                # ring on whatever ICI axis remains and gather COMPUTED
-                # OUTPUT rows across the other — each inner group computes
-                # its own rows once (vs gathering A, which would
-                # n_o-plicate the FLOPs; ≙ the reference's 2-D internode
-                # AG staging its cross-node hop separately,
-                # allgather.py:291-375). Both recursive calls route
-                # per-axis: a DCN hop lowers to the XLA collective, an ICI
-                # hop keeps the fused kernel.
+            if _is_dcn(inner_ax) and not _is_dcn(outer_ax):
+                # DCN in the INNER slot: composition order must follow the
+                # TRANSPORT (fused compute on ICI, outputs shared across
+                # DCN), not the tuple order — otherwise the single-axis
+                # DCN fallback would gather A across DCN and n_dcn-plicate
+                # the FLOPs. AG over (a0, a1) is AG over (a1, a0) with the
+                # result's (n_i, n_o) block grid transposed, so route
+                # through the efficient DCN-outer branch and fix the row
+                # order locally.
+                n_o = int(jax.lax.axis_size(outer_ax))
+                n_i = int(jax.lax.axis_size(inner_ax))
+                m_loc0 = a.shape[0]
+
+                def _swap(y):
+                    blk = y.shape[0] // (n_o * n_i)
+                    return (
+                        y.reshape(n_i, n_o, blk, *y.shape[1:])
+                        .swapaxes(0, 1)
+                        .reshape(y.shape)
+                    )
+
+                res = ag_gemm(
+                    a, b, axis=(inner_ax, outer_ax), config=config,
+                    gather_output=gather_output, out_dtype=out_dtype,
+                    interpret=interpret,
+                )
+                if gather_output:
+                    return _swap(res[0]), _swap(res[1])
+                return _swap(res)
+            if _is_dcn(outer_ax):
+                # slice-crossing outer axis: keep the fused ring on the
+                # ICI inner axis and gather COMPUTED OUTPUT rows across
+                # DCN — each group computes its own rows once (vs
+                # gathering A, which would n_o-plicate the FLOPs; ≙ the
+                # reference's 2-D internode AG staging its cross-node hop
+                # separately, allgather.py:291-375). Both recursive calls
+                # route per-axis (a both-DCN tuple lowers everything to
+                # XLA).
                 from triton_dist_tpu.ops.allgather import all_gather
 
                 res = ag_gemm(

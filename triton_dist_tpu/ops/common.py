@@ -192,6 +192,7 @@ def jit_shard_map(
     out_specs,
     *,
     key: Any,
+    donate_argnums: tuple = (),
 ):
     """``jax.jit(jax.shard_map(fn, ...))`` cached across calls.
 
@@ -202,14 +203,15 @@ def jit_shard_map(
     that changes the traced program besides the mesh/specs (op name, config,
     method, static dims); argument shapes/dtypes are handled by jit itself.
     """
-    cache_key = (mesh, str(in_specs), str(out_specs), key)
+    cache_key = (mesh, str(in_specs), str(out_specs), donate_argnums, key)
     hit = _jit_cache.get(cache_key)
     if hit is None:
         hit = jax.jit(
             jax.shard_map(
                 fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False,
-            )
+            ),
+            donate_argnums=donate_argnums,
         )
         _jit_cache[cache_key] = hit
     return hit

@@ -48,6 +48,10 @@ from triton_dist_tpu.utils import pick_block
 
 NEG_INF = float("-inf")
 
+# fuse_heads auto-guard: the fused paged kernel's double-buffered K+V page
+# slabs must fit this conservative VMEM slice (see paged_flash_decode)
+_FUSED_SLAB_VMEM_BUDGET = 64 * 2**20
+
 
 @dataclasses.dataclass(frozen=True)
 class FlashDecodeConfig:
@@ -494,7 +498,7 @@ def paged_flash_decode(
     kv_lens: jax.Array,
     block_table: jax.Array,
     *,
-    fuse_heads: bool = True,
+    fuse_heads: bool | None = None,
     return_lse: bool = False,
     interpret: Any = None,
 ):
@@ -513,15 +517,25 @@ def paged_flash_decode(
     grid step's page fetch — the double-buffered pipeline then streams
     pages exactly as the contiguous kernel streams chunks.
 
-    ``fuse_heads`` (default): a page holds every kv head's slab, so the
-    fused-heads grid (b, page) fetches each physical page in ONE DMA
-    instead of one 2·page_size·d slice per (head, page) — at typical page
-    sizes the per-head fetches are tens of KB, far below DMA efficiency.
+    ``fuse_heads``: a page holds every kv head's slab, so the fused-heads
+    grid (b, page) fetches each physical page in ONE DMA instead of one
+    2·page_size·d slice per (head, page) — at typical page sizes the
+    per-head fetches are tens of KB, far below DMA efficiency. Default
+    (None) = auto: fused whenever the double-buffered K+V page slabs fit
+    a conservative VMEM budget, per-head otherwise — so serving paths
+    (which reach here through the cache spec, with no kwarg to thread)
+    never fail compilation on many-kv-head pools. Pass True/False to pin.
     """
     b, hq, d = q.shape
     n_pages, h_kv, page_size, _ = k_pages.shape
     assert hq % h_kv == 0, (hq, h_kv)
     g = hq // h_kv
+    if fuse_heads is None:
+        # 2 operands (K+V) × 2 (double buffer) × slab bytes, against a
+        # conservative slice of the 128 MB VMEM (accumulators, q, outs
+        # and the compiler's own scratch share it)
+        slab = h_kv * page_size * d * k_pages.dtype.itemsize
+        fuse_heads = 4 * slab <= _FUSED_SLAB_VMEM_BUDGET
     max_pages = block_table.shape[1]
     scale = 1.0 / math.sqrt(d)
     # match q to the page-pool dtype (same contract as flash_decode)
@@ -629,7 +643,7 @@ def paged_flash_decode_distributed(
     block_table: jax.Array,
     *,
     axis: str = "tp",
-    fuse_heads: bool = True,
+    fuse_heads: bool | None = None,
     ag_method: str = "full_mesh_push",
     interpret: Any = None,
 ) -> jax.Array:
@@ -637,8 +651,8 @@ def paged_flash_decode_distributed(
     its own page pool + block table covering its sequence shard (the paged
     analogue of :func:`flash_decode_distributed`; ≙ the reference SP layer,
     which is paged end-to-end: sp_flash_decode_layer.py:78).
-    ``fuse_heads=False`` selects the per-head grid — the escape hatch when
-    a many-kv-head pool's fused K/V slab exceeds VMEM."""
+    ``fuse_heads`` as in :func:`paged_flash_decode` (None = VMEM-aware
+    auto; False pins the per-head grid)."""
     out, lse = paged_flash_decode(
         q, k_pages, v_pages, kv_lens_shard, block_table,
         fuse_heads=fuse_heads, return_lse=True, interpret=interpret,

@@ -216,7 +216,7 @@ def gemm_rs(
     """
     cfg = config or GemmRSConfig()
     out_dtype = out_dtype or a.dtype
-    from triton_dist_tpu.ops.allgather import _is_dcn
+    from triton_dist_tpu.parallel.topology import is_dcn_axis_name as _is_dcn
 
     if isinstance(axis, (tuple, list)):
         if len(axis) == 1:
@@ -224,7 +224,26 @@ def gemm_rs(
         else:
             assert len(axis) == 2, f"at most 2 axes supported, got {axis}"
             outer_ax, inner_ax = axis
-            if _is_dcn(outer_ax) or _is_dcn(inner_ax):
+            if _is_dcn(inner_ax) and not _is_dcn(outer_ax):
+                # DCN in the INNER slot: follow the TRANSPORT order (fused
+                # reduce on ICI before any byte crosses DCN), not the
+                # tuple order. RS over (a0, a1) equals RS over (a1, a0)
+                # on block-transposed rows — route through the DCN-outer
+                # branch below with the input's (n_o, n_i) block grid
+                # swapped.
+                n_o = int(jax.lax.axis_size(outer_ax))
+                n_i = int(jax.lax.axis_size(inner_ax))
+                blk = a.shape[0] // (n_o * n_i)
+                a_sw = (
+                    a.reshape(n_o, n_i, blk, a.shape[1])
+                    .swapaxes(0, 1)
+                    .reshape(a.shape)
+                )
+                return gemm_rs(
+                    a_sw, b, axis=(inner_ax, outer_ax), method=method,
+                    config=config, out_dtype=out_dtype, interpret=interpret,
+                )
+            if _is_dcn(outer_ax):
                 # a slice-crossing axis (either position): fused GEMM-RS on
                 # the inner hop first (pre-reducing every byte n_i-fold
                 # before the outer hop), then a reduce-scatter on the outer

@@ -242,7 +242,7 @@ def reduce_scatter(
     n = int(jax.lax.axis_size(axis))
     if n == 1:
         return x
-    from triton_dist_tpu.ops.allgather import _is_dcn
+    from triton_dist_tpu.parallel.topology import is_dcn_axis_name as _is_dcn
 
     if _is_dcn(axis):
         # slice-crossing axis: no ICI path for remote DMA — XLA's

@@ -43,7 +43,7 @@ n = mesh.shape["tp"]
 S_MAX = 16
 
 kw = dict(
-    vocab=64, hidden=32, ffn=64, n_layers=2, n_q_heads=8,
+    vocab=64, hidden=32, ffn=64, n_layers=1, n_q_heads=8,
     n_kv_heads=max(4, n), head_dim=8, batch=2, seq=4,
     ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
 )
