@@ -259,7 +259,6 @@ def ag_gemm(
                 # order locally.
                 n_o = int(jax.lax.axis_size(outer_ax))
                 n_i = int(jax.lax.axis_size(inner_ax))
-                m_loc0 = a.shape[0]
 
                 def _swap(y):
                     blk = y.shape[0] // (n_o * n_i)

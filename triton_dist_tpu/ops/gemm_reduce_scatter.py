@@ -225,24 +225,20 @@ def gemm_rs(
             assert len(axis) == 2, f"at most 2 axes supported, got {axis}"
             outer_ax, inner_ax = axis
             if _is_dcn(inner_ax) and not _is_dcn(outer_ax):
-                # DCN in the INNER slot: follow the TRANSPORT order (fused
-                # reduce on ICI before any byte crosses DCN), not the
-                # tuple order. RS over (a0, a1) equals RS over (a1, a0)
-                # on block-transposed rows — route through the DCN-outer
-                # branch below with the input's (n_o, n_i) block grid
-                # swapped.
-                n_o = int(jax.lax.axis_size(outer_ax))
-                n_i = int(jax.lax.axis_size(inner_ax))
-                blk = a.shape[0] // (n_o * n_i)
-                a_sw = (
-                    a.reshape(n_o, n_i, blk, a.shape[1])
-                    .swapaxes(0, 1)
-                    .reshape(a.shape)
+                # Tuple (ici, dcn): transport order and tuple order agree
+                # for free — a's outer-major block layout already groups
+                # each ICI slab's blocks contiguously, so the fused ICI
+                # GEMM-RS runs DIRECTLY (no swizzle; the dcn-OUTER case
+                # below is the one needing the inner-major re-grouping),
+                # pre-reducing every byte before the DCN hop's XLA
+                # psum-scatter.
+                from triton_dist_tpu.ops.reduce_scatter import reduce_scatter
+
+                part = gemm_rs(
+                    a, b, axis=outer_ax, method=method, config=config,
+                    out_dtype=out_dtype, interpret=interpret,
                 )
-                return gemm_rs(
-                    a_sw, b, axis=(inner_ax, outer_ax), method=method,
-                    config=config, out_dtype=out_dtype, interpret=interpret,
-                )
+                return reduce_scatter(part, axis=inner_ax, interpret=interpret)
             if _is_dcn(outer_ax):
                 # a slice-crossing axis (either position): fused GEMM-RS on
                 # the inner hop first (pre-reducing every byte n_i-fold

@@ -90,6 +90,55 @@ def estimate_ring_collective_time_ms(
     return payload_bytes * (n_pes - 1) / n_pes / ici * 1e3
 
 
+def estimate_dcn_collective_time_ms(
+    payload_bytes: int, n_slices: int
+) -> float:
+    """Inter-slice (DCN) collective time for `payload_bytes` moved by THIS
+    stage: ring formula over the per-host DCN NIC (topology.DCN_GBPS)."""
+    from triton_dist_tpu.parallel.topology import DCN_GBPS
+
+    if n_slices <= 1:
+        return 0.0
+    return payload_bytes * (n_slices - 1) / n_slices / (DCN_GBPS * 1e9) * 1e3
+
+
+def estimate_hierarchical_collective_time_ms(
+    payload_bytes: int,
+    n_inner: int,
+    n_slices: int,
+    kind: str = "ag",
+    spec: ChipSpec | None = None,
+) -> float:
+    """(dcn, ici) composed collective: ICI ring inside each slice + DCN
+    hop between slices, with each stage billed only the bytes IT moves
+    (≙ the reference's inter-node stage after the intra-node pipeline,
+    reduce_scatter.py:525-560):
+
+    - ``kind="ag"``: `payload_bytes` = the FULL gathered size. The ICI
+      stage assembles each slice's 1/n_slices portion; the DCN stage then
+      shares the full payload across slices.
+    - ``kind="rs"``: `payload_bytes` = one PE's full partial array. The
+      ICI stage reduce-scatters it slice-locally; only the 1/n_inner
+      pre-reduced part crosses DCN.
+
+    The two stages pipeline poorly in the XLA schedule (the DCN
+    collective consumes the whole ICI result), so the estimate is their
+    sum — a deliberate upper bound."""
+    if kind == "ag":
+        t_ici = estimate_ring_collective_time_ms(
+            payload_bytes // max(n_slices, 1), n_inner, spec
+        )
+        t_dcn = estimate_dcn_collective_time_ms(payload_bytes, n_slices)
+    elif kind == "rs":
+        t_ici = estimate_ring_collective_time_ms(payload_bytes, n_inner, spec)
+        t_dcn = estimate_dcn_collective_time_ms(
+            payload_bytes // max(n_inner, 1), n_slices
+        )
+    else:
+        raise ValueError(f"kind must be 'ag' or 'rs', got {kind!r}")
+    return t_ici + t_dcn
+
+
 def estimate_all_to_all_time_ms(
     slab_bytes: int, n_pes: int, spec: ChipSpec | None = None
 ) -> float:

@@ -1,0 +1,137 @@
+"""Tutorial 13 — multi-slice (DCN) composition.
+
+A Multislice TPU job spans several slices; mesh axes that cross a slice
+boundary have NO ICI path, only the data-center network (≙ the
+reference's inter-node plane: its 2-D internode allgather stages an
+explicit cross-node nvshmem hop, allgather.py:291-375, and its RS
+pipeline runs an inter-node P2P stage after the intra-node reduction,
+reduce_scatter.py:525-560).
+
+This framework's rule: remote-DMA kernels serve ICI axes; every
+collective LOWERS its slice-crossing axes to XLA collectives (which ride
+DCN), composed so that
+
+- allgather / AG-GEMM cross DCN with COMPUTED outputs (each slice's rows
+  are computed once on ICI, never re-multiplied per slice), and
+- reduce-scatter / GEMM-RS pre-reduce every byte slice-locally on ICI
+  before it touches the slower fabric.
+
+On real Multislice hardware the boundary is AUTO-detected from device
+slice ids at mesh creation (`topology.register_mesh_dcn`). This tutorial
+runs anywhere by DECLARING a virtual boundary on a CPU mesh — the same
+override you'd use for any irregular topology:
+
+    python tutorials/13_multislice.py
+"""
+
+import common  # noqa: F401  (platform bootstrap — must be first)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu import config as tdt_config
+from triton_dist_tpu.ops.allgather import all_gather
+from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm
+from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs
+from triton_dist_tpu.ops.reduce_scatter import reduce_scatter
+from triton_dist_tpu.perf_model import (
+    estimate_hierarchical_collective_time_ms,
+)
+
+_, world = common.bootstrap()
+assert world % 2 == 0, "this tutorial wants an even device count"
+mesh2x4 = Mesh(
+    np.array(jax.devices()).reshape(2, world // 2), ("slice", "tp")
+)
+
+# Declare: hops along "slice" cross a slice boundary. (Real Multislice
+# meshes get this automatically from device.slice_index.)
+tdt_config.update(dcn_axes=("slice",))
+
+m_loc, k_dim, n_tot = 8, 64, 128
+ka, kb = jax.random.split(jax.random.PRNGKey(0))
+a = jax.random.normal(ka, (8 * m_loc, k_dim), jnp.float32) / 8
+b = jax.random.normal(kb, (k_dim, n_tot), jnp.float32) / 8
+
+
+def run(fn, in_specs, out_specs, *args):
+    return jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh2x4, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    )(*args)
+
+
+# 1. allgather over (slice, tp): the tp hop is the fused ICI ring kernel,
+#    the slice hop is XLA's all-gather on DCN; result == flat golden.
+got = run(
+    lambda x: all_gather(x, axis=("slice", "tp")),
+    P(("slice", "tp")), P(None), a,
+)
+ref = run(
+    lambda x: jax.lax.all_gather(x, ("slice", "tp"), tiled=True),
+    P(("slice", "tp")), P(None), a,
+)
+np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+common.report("13_multislice[allgather]", True, "fused ICI inner + XLA DCN outer")
+
+# 2. AG-GEMM over (slice, tp): each slice computes its rows ONCE on ICI;
+#    only outputs cross DCN.
+out = run(
+    lambda a, b: ag_gemm(a, b, axis=("slice", "tp"), config=AGGemmConfig(8, 32, 32)),
+    (P(("slice", "tp")), P(None, "tp")), P(None, "tp"), a, b,
+)
+want = run(
+    lambda a, b: jax.lax.all_gather(a, ("slice", "tp"), tiled=True) @ b,
+    (P(("slice", "tp")), P(None, "tp")), P(None, "tp"), a, b,
+)
+np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=2e-4, atol=2e-4)
+common.report("13_multislice[ag_gemm]", True, "outputs (not inputs) cross DCN")
+
+# 3. GEMM-RS over (slice, tp): the fused ICI kernel pre-reduces 4× before
+#    the DCN psum-scatter — the bytes crossing the slow fabric are the
+#    already-reduced size. Same when the DCN axis is listed INNER: the
+#    composition follows the transport, not the tuple order.
+for axes in (("slice", "tp"), ("tp", "slice")):
+    out = run(
+        lambda a, b, axes=axes: gemm_rs(a, b, axis=axes),
+        (P(None, ("slice", "tp")), P(("slice", "tp"), None)),
+        P(("slice", "tp"), None), a, b,
+    )
+    want = run(
+        lambda a, b, axes=axes: jax.lax.psum_scatter(a @ b, axes, tiled=True),
+        (P(None, ("slice", "tp")), P(("slice", "tp"), None)),
+        P(("slice", "tp"), None), a, b,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=2e-4, atol=2e-4)
+common.report(
+    "13_multislice[gemm_rs]", True,
+    "pre-reduced on ICI before DCN, either tuple order",
+)
+
+# 4. reduce_scatter composes the same way.
+x = jax.random.normal(jax.random.PRNGKey(3), (64, 64), jnp.float32)
+got = run(
+    lambda x: reduce_scatter(x, axis=("slice", "tp")),
+    P(None, None), P(("slice", "tp")), x,
+)
+ref = run(
+    lambda x: jax.lax.psum_scatter(x, ("slice", "tp"), tiled=True),
+    P(None, None), P(("slice", "tp")), x,
+)
+np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+common.report("13_multislice[reduce_scatter]", True, "inner-first N-D staging")
+
+# 5. The perf model prices the composed hop per stage (ICI assembles each
+#    slice's portion; DCN shares the full payload):
+t = estimate_hierarchical_collective_time_ms(
+    64 << 20, n_inner=4, n_slices=2, kind="ag"
+)
+print(f"[13_multislice] 64 MiB composed AG estimate: {t:.2f} ms "
+      "(ICI stage + DCN stage)")
+
+tdt_config.update(dcn_axes=())
+print("[13_multislice] OK")
