@@ -23,8 +23,40 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from triton_dist_tpu.ops.all_to_all import fast_all_to_all
 from triton_dist_tpu.ops.grads import fast_all_to_all_grad
 from triton_dist_tpu.ops.moe_utils import MoEAlignment, moe_align_block_size
+
+
+# Quantized-dispatch wire formats (≙ the reference's fp8 LL dispatch — its
+# headline a2a metric runs fp8 payloads with scales riding the transport,
+# README.md:87, low_latency_all_to_all.py:94-104).
+_QUANT_FORMATS = {
+    "int8": (jnp.int8, 127.0),
+    "fp8": (jnp.float8_e4m3fn, 448.0),
+}
+
+
+def _check_quant(quant) -> None:
+    """Fail at the call boundary, not with a KeyError mid-trace."""
+    if quant is not None and quant not in _QUANT_FORMATS:
+        raise ValueError(
+            f"quant must be one of {sorted(_QUANT_FORMATS)} or None, "
+            f"got {quant!r}"
+        )
+
+
+def _quantize_rows(send: jax.Array, quant: str):
+    """Per-row absmax quantization of a send slab ``[n, max_m, h]`` →
+    ``(slab_q, scale [n, max_m] f32)``; all-zero (padding) rows get scale
+    epsilon and quantize to exact zeros."""
+    qdt, qmax = _QUANT_FORMATS[quant]
+    xf = send.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1) / qmax, 1e-8)
+    q = xf / scale[..., None]
+    if quant == "int8":
+        q = jnp.clip(jnp.round(q), -qmax, qmax)
+    return q.astype(qdt), scale
 
 
 def _pack_slabs(dest: jax.Array, n_dest: int, max_m: int):
@@ -71,12 +103,22 @@ class EPAll2AllLayer:
     max_m is the per-(src,dst)-pair slab capacity; assignments beyond it are
     dropped (≙ the reference's fixed ``max_m`` symmetric buffers,
     low_latency_all_to_all.py:139-147 — size for the worst case).
+
+    ``quant`` ("int8" / "fp8") sends the dispatch slab quantized with
+    per-row absmax scales riding the metadata put — the reference's
+    headline a2a configuration (fp8 payload + traveling scales,
+    README.md:87): the wire bytes drop 2×/2× vs bf16 and the receiver
+    dequantizes to the original dtype. INFERENCE dispatch only — rounding
+    has no gradient, so quantized dispatch does not differentiate (the
+    combine return path stays full-precision either way, as in the
+    reference).
     """
 
     n_experts: int
     topk: int
     max_m: int
     axis: str = "ep"
+    quant: str | None = None
     interpret: Any = None
 
     def _world(self) -> int:
@@ -99,6 +141,7 @@ class EPAll2AllLayer:
                 f"n_experts={self.n_experts} must be a positive multiple of "
                 f"the {self.axis!r} axis size {n}"
             )
+        _check_quant(self.quant)
         epr = self.n_experts // n
         m_loc, hidden = tokens.shape
         t = m_loc * self.topk
@@ -121,11 +164,34 @@ class EPAll2AllLayer:
         send_exp = send_exp.at[dest_sorted, pos].set(
             flat_ids[order] % epr, mode="drop"
         )
-        # expert ids ride the splits payload of the SAME a2a — dispatch
-        # costs exactly one collective call (VERDICT r1 weak #7)
-        recv, recv_splits, recv_exp = fast_all_to_all_grad(
-            send, clamped, send_exp, self.axis, self.interpret
-        )
+        if self.quant is not None:
+            # quantized wire format: int8/fp8 slab, per-row f32 scales
+            # bitcast onto the SAME metadata put as the expert ids — the
+            # transport cost of quantized dispatch is the halved payload,
+            # zero extra collectives (≙ the reference's scales traveling
+            # with the data, low_latency_all_to_all.py:94-104)
+            send_q, scale = _quantize_rows(send, self.quant)
+            meta = jnp.concatenate(
+                [send_exp, jax.lax.bitcast_convert_type(scale, jnp.int32)],
+                axis=1,
+            )
+            recv_q, recv_splits, meta_r = fast_all_to_all(
+                send_q, clamped, meta=meta, axis=self.axis,
+                interpret=self.interpret,
+            )
+            recv_exp = meta_r[:, : self.max_m]
+            r_scale = jax.lax.bitcast_convert_type(
+                meta_r[:, self.max_m :], jnp.float32
+            )
+            recv = (
+                recv_q.astype(jnp.float32) * r_scale[..., None]
+            ).astype(tokens.dtype)
+        else:
+            # expert ids ride the splits payload of the SAME a2a — dispatch
+            # costs exactly one collective call (VERDICT r1 weak #7)
+            recv, recv_splits, recv_exp = fast_all_to_all_grad(
+                send, clamped, send_exp, self.axis, self.interpret
+            )
         info = DispatchInfo(
             order=order,
             send_splits=clamped,
@@ -247,6 +313,14 @@ class HierEPAll2AllLayer:
     max_m2: int   # per-(relay, dest-PE) slab capacity, phase 2
     outer: str = "dp"
     inner: str = "tp"
+    # "int8" / "fp8": quantize the PHASE-1 payload — the slow (node/DCN)
+    # axis, where the hierarchy's bandwidth win lives — with per-row
+    # scales riding the metadata put (≙ the reference's fp8 LL dispatch,
+    # README.md:87). INFERENCE only: quant mode drops the differentiable
+    # slab weight channel (the bitcast-exact metadata weights serve the
+    # forward), so the router gradient is cut. Phase 2 (fast ICI) stays
+    # in the token dtype.
+    quant: str | None = None
     interpret: Any = None
 
     def _dims(self) -> tuple[int, int]:
@@ -268,6 +342,7 @@ class HierEPAll2AllLayer:
                 f"n_experts={self.n_experts} must divide over the "
                 f"{n_o}x{n_i} mesh"
             )
+        _check_quant(self.quant)
         epr = self.n_experts // (n_o * n_i)
         m_loc, hidden = tokens.shape
         t = m_loc * self.topk
@@ -291,19 +366,6 @@ class HierEPAll2AllLayer:
         order1, dest1_sorted, pos1, offsets1, clamped1, overflow1 = _pack_slabs(
             dest1, n_o, self.max_m1
         )
-        # routing WEIGHTS travel on BOTH channels: bitcast-exact f32 on the
-        # int metadata put (the forward VALUE — no rounding, whatever the
-        # slab dtype) and as topk extra data-slab columns (the
-        # DIFFERENTIABLE channel — int metadata would cut the router
-        # gradient). A straight-through combine below uses the exact value
-        # with the slab channel's gradient.
-        row_payload = jnp.concatenate(
-            [tokens, topk_weights.astype(tokens.dtype)], axis=1
-        )                                                     # [m_loc, H+topk]
-        send1 = jnp.zeros((n_o, self.max_m1, hidden + self.topk), tokens.dtype)
-        send1 = send1.at[dest1_sorted, pos1].set(
-            row_payload[order1 // self.topk], mode="drop"
-        )
         # metadata per row: the token's full topk ids + bitcast f32 weights
         # (the relay filters to its own node's experts)
         meta_ids = jnp.full((n_o, self.max_m1, self.topk), -1, jnp.int32)
@@ -314,26 +376,78 @@ class HierEPAll2AllLayer:
         )[order1 // self.topk]
         meta_ids = meta_ids.at[dest1_sorted, pos1].set(row_ids, mode="drop")
         meta_w = meta_w.at[dest1_sorted, pos1].set(row_w, mode="drop")
-        meta1 = jnp.concatenate(
-            [meta_ids.reshape(n_o, -1), meta_w.reshape(n_o, -1)], axis=1
-        )
-        recv1, recv_splits1, rmeta1 = fast_all_to_all_grad(
-            send1, clamped1, meta1, self.outer, self.interpret,
-        )
-        rmeta1 = rmeta1.reshape(n_o, 2, self.max_m1, self.topk)
-        rel_ids = rmeta1[:, 0].reshape(-1, self.topk)          # [R, topk]
-        exact_w = jax.lax.bitcast_convert_type(
-            rmeta1[:, 1].reshape(-1, self.topk), jnp.float32
-        )
+        if self.quant is not None:
+            # inference wire format on the slow axis: int8/fp8 token slab
+            # (no weight columns — the bitcast-exact metadata weights
+            # serve the forward; no gradient in quant mode), per-row
+            # scales as a third metadata chunk
+            send1 = jnp.zeros((n_o, self.max_m1, hidden), tokens.dtype)
+            send1 = send1.at[dest1_sorted, pos1].set(
+                tokens[order1 // self.topk], mode="drop"
+            )
+            send1_q, scale1 = _quantize_rows(send1, self.quant)
+            meta1 = jnp.concatenate(
+                [
+                    meta_ids.reshape(n_o, -1),
+                    meta_w.reshape(n_o, -1),
+                    jax.lax.bitcast_convert_type(scale1, jnp.int32),
+                ],
+                axis=1,
+            )
+            recv1_q, recv_splits1, rmeta1 = fast_all_to_all(
+                send1_q, clamped1, meta=meta1, axis=self.outer,
+                interpret=self.interpret,
+            )
+            k_w = self.max_m1 * self.topk
+            rel_ids = rmeta1[:, :k_w].reshape(-1, self.topk)    # [R, topk]
+            rel_w = jax.lax.bitcast_convert_type(
+                rmeta1[:, k_w : 2 * k_w].reshape(-1, self.topk), jnp.float32
+            )
+            r_scale1 = jax.lax.bitcast_convert_type(
+                rmeta1[:, 2 * k_w :], jnp.float32
+            )
+            recv1 = (
+                recv1_q.astype(jnp.float32) * r_scale1[..., None]
+            ).astype(tokens.dtype)
+            R = n_o * self.max_m1
+            rows = recv1.reshape(R, hidden)
+        else:
+            # routing WEIGHTS travel on BOTH channels: bitcast-exact f32
+            # on the int metadata put (the forward VALUE — no rounding,
+            # whatever the slab dtype) and as topk extra data-slab columns
+            # (the DIFFERENTIABLE channel — int metadata would cut the
+            # router gradient). A straight-through combine below uses the
+            # exact value with the slab channel's gradient.
+            row_payload = jnp.concatenate(
+                [tokens, topk_weights.astype(tokens.dtype)], axis=1
+            )                                                 # [m_loc, H+topk]
+            send1 = jnp.zeros(
+                (n_o, self.max_m1, hidden + self.topk), tokens.dtype
+            )
+            send1 = send1.at[dest1_sorted, pos1].set(
+                row_payload[order1 // self.topk], mode="drop"
+            )
+            meta1 = jnp.concatenate(
+                [meta_ids.reshape(n_o, -1), meta_w.reshape(n_o, -1)], axis=1
+            )
+            recv1, recv_splits1, rmeta1 = fast_all_to_all_grad(
+                send1, clamped1, meta1, self.outer, self.interpret,
+            )
+            rmeta1 = rmeta1.reshape(n_o, 2, self.max_m1, self.topk)
+            rel_ids = rmeta1[:, 0].reshape(-1, self.topk)      # [R, topk]
+            exact_w = jax.lax.bitcast_convert_type(
+                rmeta1[:, 1].reshape(-1, self.topk), jnp.float32
+            )
+            R = n_o * self.max_m1
+            rows_full = recv1.reshape(R, hidden + self.topk)
+            rows = rows_full[:, :hidden]
+            slab_w = rows_full[:, hidden:].astype(jnp.float32)  # [R, topk]
+            # straight-through: VALUE = the bitcast-exact weights,
+            # GRADIENT = the differentiable slab channel's (identity
+            # cotangent)
+            rel_w = exact_w + (slab_w - jax.lax.stop_gradient(slab_w))
 
         # ---- phase 2: relay scatters rows to expert-owning inner PEs ----
-        R = n_o * self.max_m1
-        rows_full = recv1.reshape(R, hidden + self.topk)
-        rows = rows_full[:, :hidden]
-        slab_w = rows_full[:, hidden:].astype(jnp.float32)     # [R, topk]
-        # straight-through: VALUE = the bitcast-exact weights, GRADIENT =
-        # the differentiable slab channel's (identity cotangent)
-        rel_w = exact_w + (slab_w - jax.lax.stop_gradient(slab_w))
         pos_r = jnp.arange(R, dtype=jnp.int32) % self.max_m1
         slab_r = jnp.arange(R, dtype=jnp.int32) // self.max_m1
         row_valid = pos_r < recv_splits1[slab_r]               # [R]
