@@ -551,3 +551,45 @@ def test_hier_ep_a2a_quantized_phase1(mesh2x4, quant):
     want = np.asarray(x) * np.asarray(tw.sum(-1))[:, None]
     tol = 2e-2 if quant == "int8" else 6e-2
     np.testing.assert_allclose(np.asarray(got), want, rtol=tol, atol=tol)
+
+
+def test_ep_moe_mlp_quantized_dispatch(mesh4):
+    """EPMoEMLP(quant=...) threads the wire format through the transport:
+    expert compute on dequantized rows stays within quant tolerance of
+    the full-precision layer."""
+    from triton_dist_tpu.layers.ep_moe_mlp import EPMoEMLP
+
+    world, m_loc, H, F, n_exp, topk = 4, 8, 32, 64, 8, 2
+    m_tot = world * m_loc
+    kx, ku, kd, kl = jax.random.split(jax.random.PRNGKey(70), 4)
+    x = jax.random.normal(kx, (m_tot, H), jnp.float32)
+    w_up = jax.random.normal(ku, (n_exp, H, F)) / 8
+    w_down = jax.random.normal(kd, (n_exp, F, H)) / 8
+    logits = jax.random.normal(kl, (m_tot, n_exp), jnp.float32)
+    from triton_dist_tpu.ops.moe_utils import select_experts
+
+    tw, ids = select_experts(logits, topk)
+
+    def run(quant):
+        layer = EPMoEMLP(
+            n_experts=n_exp, topk=topk, max_m=m_loc * topk, axis="tp",
+            quant=quant, gg_config=GroupGemmConfig(4, 32, 32),
+        )
+
+        def fn(x, wu, wd, ids, tw):
+            return layer(x, wu, wd, ids, tw)
+
+        out = jax.jit(
+            jax.shard_map(
+                fn, mesh=mesh4,
+                in_specs=(P("tp", None), P("tp", None, None),
+                          P("tp", None, None), P("tp", None), P("tp", None)),
+                out_specs=P("tp", None), check_vma=False,
+            )
+        )(x, w_up, w_down, ids, tw)
+        jax.block_until_ready(out)
+        return np.asarray(out)
+
+    full = run(None)
+    q = run("int8")
+    np.testing.assert_allclose(q, full, rtol=4e-2, atol=4e-2)

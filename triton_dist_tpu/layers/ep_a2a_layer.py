@@ -46,6 +46,12 @@ def _check_quant(quant) -> None:
         )
 
 
+def _dequantize_rows(recv_q: jax.Array, scale: jax.Array, dtype):
+    """Inverse of :func:`_quantize_rows` (kept adjacent so the wire format
+    changes in one place)."""
+    return (recv_q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def _quantize_rows(send: jax.Array, quant: str):
     """Per-row absmax quantization of a send slab ``[n, max_m, h]`` →
     ``(slab_q, scale [n, max_m] f32)``; all-zero (padding) rows get scale
@@ -183,9 +189,7 @@ class EPAll2AllLayer:
             r_scale = jax.lax.bitcast_convert_type(
                 meta_r[:, self.max_m :], jnp.float32
             )
-            recv = (
-                recv_q.astype(jnp.float32) * r_scale[..., None]
-            ).astype(tokens.dtype)
+            recv = _dequantize_rows(recv_q, r_scale, tokens.dtype)
         else:
             # expert ids ride the splits payload of the SAME a2a — dispatch
             # costs exactly one collective call (VERDICT r1 weak #7)
@@ -406,9 +410,7 @@ class HierEPAll2AllLayer:
             r_scale1 = jax.lax.bitcast_convert_type(
                 rmeta1[:, 2 * k_w :], jnp.float32
             )
-            recv1 = (
-                recv1_q.astype(jnp.float32) * r_scale1[..., None]
-            ).astype(tokens.dtype)
+            recv1 = _dequantize_rows(recv1_q, r_scale1, tokens.dtype)
             R = n_o * self.max_m1
             rows = recv1.reshape(R, hidden)
         else:

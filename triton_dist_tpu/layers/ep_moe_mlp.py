@@ -82,6 +82,9 @@ class EPMoEMLP:
     max_m2: int | None = None
     activation: Callable[[jax.Array], jax.Array] = jax.nn.gelu
     gg_config: GroupGemmConfig | None = None
+    # int8/fp8 dispatch wire format (inference only — cuts the router
+    # gradient; see EPAll2AllLayer.quant)
+    quant: str | None = None
     interpret: Any = None
 
     def _transport(self):
@@ -93,11 +96,12 @@ class EPMoEMLP:
                 n_experts=self.n_experts, topk=self.topk,
                 max_m1=self.max_m,
                 max_m2=self.max_m2 or n_o * self.max_m * self.topk,
-                outer=self.outer, inner=self.inner, interpret=self.interpret,
+                outer=self.outer, inner=self.inner, quant=self.quant,
+                interpret=self.interpret,
             )
         return EPAll2AllLayer(
             n_experts=self.n_experts, topk=self.topk, max_m=self.max_m,
-            axis=self.axis, interpret=self.interpret,
+            axis=self.axis, quant=self.quant, interpret=self.interpret,
         )
 
     def __call__(
