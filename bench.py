@@ -391,6 +391,59 @@ def bench_moe(mesh, n):
     )
 
 
+def bench_moe_w8(mesh, n):
+    """Decode-shaped MoE grouped GEMM with int8 expert weights: at serving
+    token counts every routed expert's weight slab streams from HBM
+    regardless of how few rows hit it (weight-bound), so int8 weights
+    should BEAT the bf16 kernel toward 2× — a single-chip margin the
+    world-1 overlap metrics structurally cannot show (they tie XLA by
+    design). Baseline = the same grouped GEMM on bf16 weights."""
+    from triton_dist_tpu.ops.group_gemm import (
+        GroupGemmConfig, group_gemm, group_gemm_w8, quantize_expert_weights,
+    )
+    from triton_dist_tpu.ops.moe_utils import (
+        moe_align_block_size, select_experts,
+    )
+
+    m_tok, h_dim, f_dim, n_exp, topk = 256, _sc(4096), _sc(14336), 8, 2
+    bm = 128
+    kx, kw, kl = jax.random.split(jax.random.PRNGKey(7), 3)
+    tw, ids = select_experts(
+        jax.random.normal(kl, (m_tok, n_exp), jnp.float32), topk
+    )
+    al = moe_align_block_size(ids.reshape(-1), n_exp, bm)
+    x = jax.random.normal(kx, (m_tok, h_dim), jnp.bfloat16)
+    sti = al.sorted_token_ids
+    xs = jnp.where(
+        (sti < m_tok * topk)[:, None],
+        x[jnp.clip(sti // topk, 0, m_tok - 1)], 0,
+    )
+    w = jax.random.normal(kw, (n_exp, h_dim, f_dim), jnp.bfloat16) / 16
+    w_q, scale = quantize_expert_weights(w)
+    cfg = GroupGemmConfig(bm, 1024, 512)
+    eids = al.expert_ids
+
+    fused = lambda xs, w_q, scale: group_gemm_w8(
+        xs, w_q, scale, eids, config=cfg
+    )
+
+    def bf16(xs, w_q, scale):
+        del w_q, scale
+        return group_gemm(xs, w, eids, config=cfg)
+
+    out = fused(xs, w_q, scale)
+    ref = bf16(xs, w_q, scale)
+    np.testing.assert_allclose(
+        np.asarray(out[:64], np.float32), np.asarray(ref[:64], np.float32),
+        atol=0.5, rtol=6e-2,
+    )
+    t_f, t_b, ratio = bench_pair(fused, bf16, (xs, w_q, scale), iters=_it(200))
+    emit(
+        f"moe_w8_decode_gemm_ms_m{m_tok}e{n_exp}k{topk}h{h_dim}f{f_dim}",
+        t_f, "ms", ratio,
+    )
+
+
 def bench_ag_gemm(mesh, n):
     """Flagship: column-parallel up-proj, M=8192 LLaMA-3.1-8B (K=4096,
     N_ffn=14336), ≙ reference test_ag_gemm.py:149-156. Emits overlap
@@ -520,11 +573,12 @@ _METRICS = {
     "flash_decode_paged": bench_flash_decode_paged,
     "flash_decode_int8": bench_flash_decode_int8,
     "moe": bench_moe,
+    "moe_w8": bench_moe_w8,
     "ag_gemm": bench_ag_gemm,
 }
 _EXEC_ORDER = (
     "ag_gemm", "gemm_rs", "all_to_all", "flash_decode",
-    "flash_decode_paged", "flash_decode_int8", "moe",
+    "flash_decode_paged", "flash_decode_int8", "moe", "moe_w8",
 )
 _FLAGSHIP = _EXEC_ORDER[0]  # runs first (healthiest chip), EMITTED last
 _METRIC_TIMEOUT_S = int(os.environ.get("TDT_BENCH_METRIC_TIMEOUT", "1500"))

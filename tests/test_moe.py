@@ -414,3 +414,30 @@ def test_tp_moe_overlap_edge_routing(mesh4, routing):
         )(x, w_up, w_down, ids, tw))
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-5)
+
+
+def test_group_gemm_w8_matches_f32():
+    """int8-weight grouped GEMM (per-(expert, column) absmax scales):
+    within weight-quantization tolerance of the f32 kernel; experts with
+    zero rows and padded blocks behave identically."""
+    from triton_dist_tpu.ops.group_gemm import (
+        group_gemm, group_gemm_w8, quantize_expert_weights,
+    )
+
+    E, topk, m, H, F, bm = 4, 2, 96, 64, 128, 16
+    tw, ids = select_experts(
+        jax.random.normal(jax.random.PRNGKey(80), (m, E)), topk
+    )
+    al = moe_align_block_size(ids.reshape(-1), E, bm)
+    x = jax.random.normal(jax.random.PRNGKey(81), (m, H), jnp.float32)
+    sti = al.sorted_token_ids
+    xs = jnp.where(
+        (sti < m * topk)[:, None], x[jnp.clip(sti // topk, 0, m - 1)], 0
+    )
+    b = jax.random.normal(jax.random.PRNGKey(82), (E, H, F), jnp.float32) / 8
+    b_q, scale = quantize_expert_weights(b)
+    cfg = GroupGemmConfig(bm, 64, 32)
+    want = np.asarray(group_gemm(xs, b, al.expert_ids, config=cfg))
+    got = np.asarray(group_gemm_w8(xs, b_q, scale, al.expert_ids, config=cfg))
+    denom = np.abs(want).max() + 1e-9
+    assert np.abs(got - want).max() / denom < 2e-2

