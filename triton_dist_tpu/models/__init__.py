@@ -33,8 +33,11 @@ from triton_dist_tpu.models.tp_transformer import (
     init_moe_params,
     init_params,
     moe_param_specs,
+    moe_quantized_param_specs,
     opt_state_specs,
     param_specs,
+    quantize_moe_serving_params,
+    specs_for,
     train_step,
 )
 
@@ -59,7 +62,10 @@ __all__ = [
     "init_moe_params",
     "init_params",
     "moe_param_specs",
+    "moe_quantized_param_specs",
     "opt_state_specs",
     "param_specs",
+    "quantize_moe_serving_params",
+    "specs_for",
     "train_step",
 ]

@@ -43,17 +43,19 @@ from triton_dist_tpu.ops.flash_decode import (
 )
 
 
-def _specs_for(cfg: TransformerConfig):
+def _specs_for(cfg: TransformerConfig, params: dict | None = None):
     """Param specs for the serving path: dense or TP-MoE. EP configs are
     rejected — their expert placement (ep_outer/ep_max_m, tokens traveling
     to whole experts over the all-to-all) has no decode path here, and
-    silently serving them as plain TP-MoE would ignore those semantics."""
+    silently serving them as plain TP-MoE would ignore those semantics.
+    `params`, when given, lets serving-quantized expert banks
+    (quantize_moe_serving_params) resolve their scale-bearing spec tree."""
     if isinstance(cfg, EPMoETransformerConfig):
         raise NotImplementedError(
             "EP-MoE configs have no serving decode path (attention-TP + "
             "expert-parallel FFN); use a TP MoETransformerConfig"
         )
-    return specs_for(cfg)
+    return specs_for(cfg, params)
 
 
 def _shard_of(s_max: int, n: int) -> int:
@@ -327,9 +329,24 @@ def decode_step(
 
             logits = h.astype(jnp.float32) @ p["router"].astype(jnp.float32)
             tw, ids = select_experts(logits, c.topk)       # [b, topk]
-            hE = jnp.einsum("bh,ehf->ebf", h, p["w_up"])   # [E, b, F/n]
-            act = jax.nn.gelu(hE.astype(jnp.float32)).astype(x.dtype)
-            yE = jnp.einsum("ebf,efh->ebh", act, p["w_down"])
+            # int8 expert banks (quantize_moe_serving_params) read the
+            # int8 stream in the einsums — HALF the HBM bytes this
+            # weight-bound step is made of — and the per-(e, col) scales
+            # apply AFTER the contraction (exact: the scale is constant
+            # over the contracted dim) in the f32 stages that already
+            # exist (gelu input / combine), costing zero precision.
+            quant = "w_up_scale" in p
+            w_up = p["w_up"].astype(h.dtype) if quant else p["w_up"]
+            w_down = p["w_down"].astype(x.dtype) if quant else p["w_down"]
+            hE = jnp.einsum("bh,ehf->ebf", h, w_up)        # [E, b, F/n]
+            hE = hE.astype(jnp.float32)
+            if quant:
+                hE = hE * p["w_up_scale"]                  # [E,1,F] bcasts
+            act = jax.nn.gelu(hE).astype(x.dtype)
+            yE = jnp.einsum("ebf,efh->ebh", act, w_down)
+            yE = yE.astype(jnp.float32)
+            if quant:
+                yE = yE * p["w_down_scale"]
             wE = (
                 jnp.zeros((c.batch, c.n_experts), jnp.float32)
                 .at[jnp.arange(c.batch)[:, None], ids]
@@ -449,7 +466,7 @@ def generate(
         return jnp.concatenate([tok0[None], outs], axis=0)  # [n_steps, b]
 
     cache_specs = spec.specs(cfg)
-    pspecs = _specs_for(cfg)
+    pspecs = _specs_for(cfg, params)
     from triton_dist_tpu.ops.common import jit_shard_map
 
     out = jit_shard_map(
@@ -566,7 +583,7 @@ class ContinuousBatcher:
         )
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-            params, _specs_for(cfg),
+            params, _specs_for(cfg, params),
         )
         step = functools.partial(
             decode_step, cfg, spec=self.spec, fd_config=fd_config,
@@ -583,7 +600,10 @@ class ContinuousBatcher:
 
         self._step = jit_shard_map(
             step, mesh,
-            (_specs_for(cfg), self.spec.specs(cfg), P(None), P(None)),
+            (
+                _specs_for(cfg, params), self.spec.specs(cfg), P(None),
+                P(None),
+            ),
             (P(None, None), self.spec.specs(cfg)),
             key=("batcher_step", cfg, self.spec, fd_config, str(interpret)),
             donate_argnums=(1,),
@@ -634,7 +654,7 @@ class ContinuousBatcher:
         prog = jit_shard_map(
             fn, mesh,
             (
-                _specs_for(cfg), spec.specs(cfg), P(None, None),
+                _specs_for(cfg, self.params), spec.specs(cfg), P(None, None),
                 P(None), P(None),
             ),
             (spec.specs(cfg), P(None, None)),

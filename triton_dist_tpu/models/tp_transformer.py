@@ -302,6 +302,39 @@ def moe_param_specs(cfg: MoETransformerConfig) -> dict:
     return specs
 
 
+def quantize_moe_serving_params(params: dict) -> dict:
+    """int8-quantize every layer's expert banks for SERVING (weight-only
+    PTQ, per-(expert, out-column) scales — ``ops.quantize_expert_weights``):
+    replaces ``w_up``/``w_down`` with int8 pools and adds
+    ``w_up_scale``/``w_down_scale``. Halves the expert-weight HBM stream
+    that decode-shaped MoE is bound by; the model detects the quantized
+    keys and dequantizes appropriately per path (post-matmul scale on the
+    decode einsums; explicit dequant on the compute-bound prefill).
+    Returns a NEW params tree; specs via :func:`moe_quantized_param_specs`."""
+    from triton_dist_tpu.ops.group_gemm import quantize_expert_weights
+
+    params = dict(params)
+    params["layers"] = [dict(p) for p in params["layers"]]
+    for p in params["layers"]:
+        for name in ("w_up", "w_down"):
+            w_q, scale = quantize_expert_weights(p[name])
+            p[name] = w_q
+            p[name + "_scale"] = scale
+    return params
+
+
+def moe_quantized_param_specs(cfg: MoETransformerConfig) -> dict:
+    """Shardings for :func:`quantize_moe_serving_params` output: int8
+    pools keep their bank's sharding; scales ``[E, 1, N]`` shard with the
+    OUT dimension (w_up's F over the axis; w_down's H replicated)."""
+    specs = moe_param_specs(cfg)
+    t = cfg.axis
+    for p in specs["layers"]:
+        p["w_up_scale"] = P(None, None, t)
+        p["w_down_scale"] = P(None, None, None)
+    return specs
+
+
 @dataclasses.dataclass
 class TPMoETransformer(TPTransformer):
     """MoE decoder: the dense MLP half is replaced by router →
@@ -318,8 +351,18 @@ class TPMoETransformer(TPTransformer):
         h = rmsnorm(x, p["mlp_norm"], c.norm_eps)
         logits = h.astype(jnp.float32) @ p["router"].astype(jnp.float32)
         tw, ids = select_experts(logits, c.topk)
+        w_up, w_down = p["w_up"], p["w_down"]
+        if "w_up_scale" in p:
+            # serving-quantized experts on the prefill/full-forward path:
+            # explicit dequant — this path is MXU-compute-bound over the
+            # whole sequence, so the bf16 materialization amortizes (the
+            # decode einsums keep the int8 stream; models/decode.py)
+            w_up = (w_up.astype(jnp.float32) * p["w_up_scale"]).astype(x.dtype)
+            w_down = (
+                w_down.astype(jnp.float32) * p["w_down_scale"]
+            ).astype(x.dtype)
         return tp_moe_mlp_grad(
-            h, p["w_up"], p["w_down"], ids, tw.astype(jnp.float32),
+            h, w_up, w_down, ids, tw.astype(jnp.float32),
             c.axis, jax.nn.gelu, c.gg_config, c.interpret,
         ).astype(x.dtype)
 
@@ -389,11 +432,18 @@ class EPMoETransformer(TPMoETransformer):
         return moe(h, p["w_up"], p["w_down"], ids, tw.astype(jnp.float32))
 
 
-def specs_for(cfg: TransformerConfig) -> dict:
-    """Partition specs matching the model variant's param tree."""
+def specs_for(cfg: TransformerConfig, params: dict | None = None) -> dict:
+    """Partition specs matching the model variant's param tree. Pass the
+    actual `params` when they might be serving-quantized
+    (:func:`quantize_moe_serving_params` adds scale entries the spec tree
+    must mirror)."""
     if isinstance(cfg, EPMoETransformerConfig):
         return ep_moe_param_specs(cfg)
     if isinstance(cfg, MoETransformerConfig):
+        if params is not None and params["layers"] and (
+            "w_up_scale" in params["layers"][0]
+        ):
+            return moe_quantized_param_specs(cfg)
         return moe_param_specs(cfg)
     return param_specs(cfg)
 
