@@ -47,9 +47,19 @@ pjrt_rc=$?
 echo "pjrt rc=$pjrt_rc" >> "docs/chip_logs/${stamp}_pjrt_runner.log"
 
 echo "=== [5/6] serving throughput (continuous batching, tokens/s)"
-timeout 1800 python scripts/serving_bench.py > "docs/chip_logs/${stamp}_serving.log" 2>&1
-serving_rc=$?
-echo "serving rc=$serving_rc" >> "docs/chip_logs/${stamp}_serving.log"
+{
+  timeout 1800 python scripts/serving_bench.py
+  serving_rc=$?
+  # MoE serving A/B: full-precision vs int8 expert banks (weight-bound
+  # decode MLP — the w8 uplift is THE serving headline to capture)
+  timeout 1800 python scripts/serving_bench.py mixtral-8x7b 2 4 120
+  moe_rc=$?
+  TDT_SERVING_BENCH_QUANT=1 timeout 1800 python scripts/serving_bench.py mixtral-8x7b 2 4 120
+  moe_q_rc=$?
+} > "docs/chip_logs/${stamp}_serving.log" 2>&1
+echo "serving rc=$serving_rc moe=$moe_rc moe_w8=$moe_q_rc" \
+  >> "docs/chip_logs/${stamp}_serving.log"
+serving_rc=$(( serving_rc || moe_rc || moe_q_rc ))
 
 echo "=== [6/6] native decode-step loop (pjrt_runner vs python, tokens/s)"
 timeout 1800 bash scripts/native_serving_bench.sh > "docs/chip_logs/${stamp}_native_serving.log" 2>&1

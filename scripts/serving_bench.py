@@ -48,7 +48,23 @@ def main() -> int:
             cfg, hidden=64, ffn=128, n_q_heads=4, n_kv_heads=2,
             head_dim=16, vocab=128,
         )
-    params = init_params(jax.random.PRNGKey(0), cfg)
+    from triton_dist_tpu.models import (
+        MoETransformerConfig, init_moe_params, quantize_moe_serving_params,
+    )
+
+    params = (
+        init_moe_params(jax.random.PRNGKey(0), cfg)
+        if isinstance(cfg, MoETransformerConfig)
+        else init_params(jax.random.PRNGKey(0), cfg)
+    )
+    if isinstance(cfg, MoETransformerConfig) and (
+        os.environ.get("TDT_SERVING_BENCH_QUANT") == "1"
+    ):
+        # int8 expert banks: the weight-bound decode MLP reads half the
+        # bytes (quantize_moe_serving_params; run the same preset with
+        # and without this env var for the uplift)
+        params = quantize_moe_serving_params(params)
+        name += "+w8"
     mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("tp",))
 
     batcher = ContinuousBatcher(cfg, params, mesh, s_max=s_max)

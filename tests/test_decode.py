@@ -341,7 +341,8 @@ def test_generate_moe_quantized_experts(mesh4):
     )
     from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
 
-    b, prompt_len, n_steps, s_max = 2, 4, 3, 16
+    # seq = prompt_len + n_steps = 8: b*seq divides the 4-PE token shard
+    b, prompt_len, n_steps, s_max = 2, 4, 4, 16
     cfg = MoETransformerConfig(
         vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
         head_dim=8, batch=b, seq=prompt_len + n_steps, n_experts=4, topk=2,
@@ -356,6 +357,34 @@ def test_generate_moe_quantized_experts(mesh4):
         jax.random.PRNGKey(41), (b, prompt_len), 0, cfg.vocab, jnp.int32
     )
     fd = FlashDecodeConfig(block_s=4)
+    # primary check: full-forward LOGITS within weight-quant tolerance —
+    # diagnosable if a backend/rounding change ever flips a near-tie
+    from jax.sharding import PartitionSpec as P
+
+    from triton_dist_tpu.models import TPMoETransformer, specs_for
+
+    model = TPMoETransformer(cfg)
+    toks = jnp.concatenate(
+        [prompt, jnp.zeros((b, n_steps), jnp.int32)], axis=1
+    ).reshape(-1)  # [b * cfg.seq] (cfg.seq = prompt_len + n_steps)
+
+    def logits_of(p):
+        return jax.jit(
+            jax.shard_map(
+                lambda t, pp: model(t, pp), mesh=mesh4,
+                in_specs=(P("tp"), specs_for(cfg, p)),
+                out_specs=P(None, "tp"), check_vma=False,
+            )
+        )(toks, jax.tree.map(
+            lambda x, s: jax.device_put(
+                x, jax.sharding.NamedSharding(mesh4, s)
+            ), p, specs_for(cfg, p),
+        ))
+
+    lf = np.asarray(logits_of(params), np.float32)
+    lq = np.asarray(logits_of(q_params), np.float32)
+    np.testing.assert_allclose(lq, lf, rtol=3e-2, atol=3e-2 * np.abs(lf).max())
+
     full = generate(cfg, params, prompt, n_steps, mesh4, s_max=s_max, fd_config=fd)
     quant = generate(
         cfg, q_params, prompt, n_steps, mesh4, s_max=s_max, fd_config=fd

@@ -352,7 +352,7 @@ def decode_step(
                 .at[jnp.arange(c.batch)[:, None], ids]
                 .add(tw)
             )
-            y = jnp.einsum("be,ebh->bh", wE, yE.astype(jnp.float32))
+            y = jnp.einsum("be,ebh->bh", wE, yE)  # yE already f32
             x = x + jax.lax.psum(y.astype(x.dtype), c.axis)
         else:
             gu = (h @ p["w_gate_up"].reshape(c.hidden, -1)).reshape(c.batch, -1, 2)
