@@ -179,6 +179,7 @@ def test_fast_all_to_all_dcn(mesh2x4, dcn_dp):
     np.testing.assert_array_equal(np.asarray(osp), np.asarray(splits).T)
 
 
+@pytest.mark.slow  # layer-scale roundtrip; the op-level DCN tests keep quick-tier coverage
 def test_hier_ep_layer_dcn_outer(mesh2x4, dcn_dp):
     """Hierarchical EP dispatch/combine with the OUTER (node) phase on
     DCN: phase-1's a2a lowers to XLA transparently inside the layer, so
