@@ -570,26 +570,43 @@ def test_ep_moe_mlp_quantized_dispatch(mesh4):
 
     tw, ids = select_experts(logits, topk)
 
-    def run(quant):
+    def run(quant, w8=False):
+        from triton_dist_tpu.ops.group_gemm import quantize_expert_weights
+
         layer = EPMoEMLP(
             n_experts=n_exp, topk=topk, max_m=m_loc * topk, axis="tp",
             quant=quant, gg_config=GroupGemmConfig(4, 32, 32),
         )
 
-        def fn(x, wu, wd, ids, tw):
-            return layer(x, wu, wd, ids, tw)
+        def fn(x, wu, wd, ids, tw, *scales):
+            return layer(
+                x, wu, wd, ids, tw,
+                **(dict(w_up_scale=scales[0], w_down_scale=scales[1])
+                   if scales else {}),
+            )
 
+        args = [x, w_up, w_down, ids, tw]
+        specs = [P("tp", None), P("tp", None, None), P("tp", None, None),
+                 P("tp", None), P("tp", None)]
+        if w8:
+            # int8 expert banks (sharded like the banks: experts on dim 0)
+            uq, us = quantize_expert_weights(w_up)
+            dq, ds = quantize_expert_weights(w_down)
+            args[1], args[2] = uq, dq
+            args += [us, ds]
+            specs += [P("tp", None, None), P("tp", None, None)]
         out = jax.jit(
             jax.shard_map(
-                fn, mesh=mesh4,
-                in_specs=(P("tp", None), P("tp", None, None),
-                          P("tp", None, None), P("tp", None), P("tp", None)),
+                fn, mesh=mesh4, in_specs=tuple(specs),
                 out_specs=P("tp", None), check_vma=False,
             )
-        )(x, w_up, w_down, ids, tw)
+        )(*args)
         jax.block_until_ready(out)
         return np.asarray(out)
 
     full = run(None)
     q = run("int8")
     np.testing.assert_allclose(q, full, rtol=4e-2, atol=4e-2)
+    # everything int8: quantized wire AND int8 expert banks
+    q8 = run("int8", w8=True)
+    np.testing.assert_allclose(q8, full, rtol=6e-2, atol=6e-2)

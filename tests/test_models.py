@@ -593,3 +593,48 @@ def test_train_step_with_optax_adam(mesh4):
     p2, o2, loss2 = step(tokens, targets, p1, o1)
     jax.block_until_ready(loss2)
     assert float(loss2) < float(loss1)
+
+
+def test_ep_moe_transformer_quantized_forward(mesh2x4):
+    """EP-MoE forward with serving-quantized expert banks (int8 pools +
+    scales, EP expert-dim sharding): logits within weight-quant tolerance
+    of the full-precision model — the scales route through EPMoEMLP's
+    scale-folding grouped GEMM."""
+    from triton_dist_tpu.models import (
+        EPMoETransformer, EPMoETransformerConfig, init_moe_params,
+        quantize_moe_serving_params, specs_for,
+    )
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+
+    cfg = EPMoETransformerConfig(
+        vocab=64, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=2, seq=16, n_experts=8, topk=2, ep_outer="dp",
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+        gg_config=GroupGemmConfig(8, 16, 16),
+    )
+    model = EPMoETransformer(cfg)
+    params = init_moe_params(jax.random.PRNGKey(90), cfg)
+    q_params = quantize_moe_serving_params(params)
+    dp, m = 2, cfg.batch * cfg.seq
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(91), (dp * m,), 0, cfg.vocab, jnp.int32
+    )
+
+    def logits_of(p):
+        sp = specs_for(cfg, p)
+        p_sh = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh2x4, s)), p, sp
+        )
+        out = jax.jit(
+            jax.shard_map(
+                lambda t, pp: model(t, pp), mesh=mesh2x4,
+                in_specs=(P(("dp", "tp")), sp),
+                out_specs=P("dp", "tp"), check_vma=False,
+            )
+        )(tokens, p_sh)
+        jax.block_until_ready(out)
+        return out
+
+    lf = np.asarray(logits_of(params), np.float32)
+    lq = np.asarray(logits_of(q_params), np.float32)
+    np.testing.assert_allclose(lq, lf, rtol=3e-2, atol=3e-2 * np.abs(lf).max())

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from triton_dist_tpu import config as tdt_config
 from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer, HierEPAll2AllLayer
 from triton_dist_tpu.ops.grads import group_gemm_grad
-from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
 
 
 def _overflow_message(ov: int) -> str:
@@ -113,16 +113,28 @@ class EPMoEMLP:
         topk_weights: jax.Array,
         *,
         with_overflow: bool = False,
+        w_up_scale: jax.Array | None = None,
+        w_down_scale: jax.Array | None = None,
     ):
         """``with_overflow=True`` additionally returns the scalar count of
         assignments dropped by slab overflow — an undersized ``max_m``
         silently zeroes those tokens' expert contributions otherwise (the
         transport layers surface the same counter; don't swallow it in
-        anything user-facing)."""
+        anything user-facing).
+
+        ``w_up_scale``/``w_down_scale`` (``[E_loc, 1, N]`` from
+        ``ops.quantize_expert_weights``) mark the expert banks as int8:
+        the local grouped GEMMs stream half the weight bytes (the
+        resource decode-shaped expert compute is bound by) via the
+        scale-folding kernel. INFERENCE only — the int8 path takes the
+        non-VJP grouped GEMM."""
         cfg = self.gg_config or GroupGemmConfig()
         layer = self._transport()
         hier = self.outer is not None
         m_loc = x.shape[0]
+        if (w_up_scale is None) != (w_down_scale is None):
+            raise ValueError("pass both expert-weight scales, or neither")
+        w8 = w_up_scale is not None
 
         if hier:
             recv, info = layer.dispatch(x, topk_ids, topk_weights)
@@ -135,14 +147,20 @@ class EPMoEMLP:
         rows = recv.reshape(-1, x.shape[-1])            # [R, H]
         r_cap = rows.shape[0]
         a_sorted = rows[jnp.minimum(al.sorted_token_ids, r_cap - 1)]
-        h1 = group_gemm_grad(
-            a_sorted, w_up, al.expert_ids, cfg, None, self.interpret,
-            True,  # alignment ids are sorted by construction
-        )
+        if w8:
+            # int8 banks: the scale-folding kernel; non-differentiable
+            gg = lambda a, w, s: group_gemm(  # noqa: E731
+                a, w, al.expert_ids, scale=s, config=cfg,
+                interpret=self.interpret,
+            )
+        else:
+            # alignment ids are sorted by construction (assume_sorted)
+            gg = lambda a, w, s: group_gemm_grad(  # noqa: E731
+                a, w, al.expert_ids, cfg, None, self.interpret, True
+            )
+        h1 = gg(a_sorted, w_up, w_up_scale)
         h1 = self.activation(h1.astype(jnp.float32)).astype(x.dtype)
-        y_sorted = group_gemm_grad(
-            h1, w_down, al.expert_ids, cfg, None, self.interpret, True
-        )
+        y_sorted = gg(h1, w_down, w_down_scale)
         # back to the received slab layout: each valid row appears exactly
         # once in the sorted order; the sentinel id R is out of range → drop
         y = (

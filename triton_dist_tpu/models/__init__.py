@@ -30,6 +30,7 @@ from triton_dist_tpu.models.tp_transformer import (
     TPMoETransformer,
     TPTransformer,
     ep_moe_param_specs,
+    ep_moe_quantized_param_specs,
     init_moe_params,
     init_params,
     moe_param_specs,
@@ -59,6 +60,7 @@ __all__ = [
     "TPMoETransformer",
     "TPTransformer",
     "ep_moe_param_specs",
+    "ep_moe_quantized_param_specs",
     "init_moe_params",
     "init_params",
     "moe_param_specs",

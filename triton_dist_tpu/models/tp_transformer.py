@@ -398,6 +398,20 @@ def ep_moe_param_specs(cfg: EPMoETransformerConfig) -> dict:
     return specs
 
 
+def ep_moe_quantized_param_specs(cfg: EPMoETransformerConfig) -> dict:
+    """Shardings for :func:`quantize_moe_serving_params` output on the EP
+    layout: int8 pools keep the expert-dim sharding; the ``[E, 1, N]``
+    scales shard with their experts too."""
+    specs = ep_moe_param_specs(cfg)
+    exp_axes = (
+        (cfg.ep_outer, cfg.axis) if cfg.ep_outer is not None else cfg.axis
+    )
+    for p in specs["layers"]:
+        p["w_up_scale"] = P(exp_axes, None, None)
+        p["w_down_scale"] = P(exp_axes, None, None)
+    return specs
+
+
 @dataclasses.dataclass
 class EPMoETransformer(TPMoETransformer):
     """MoE decoder with expert-parallel FFNs: router →
@@ -429,7 +443,14 @@ class EPMoETransformer(TPMoETransformer):
             inner=c.axis if c.ep_outer is not None else None,
             gg_config=c.gg_config, interpret=c.interpret,
         )
-        return moe(h, p["w_up"], p["w_down"], ids, tw.astype(jnp.float32))
+        scales = (
+            dict(w_up_scale=p["w_up_scale"], w_down_scale=p["w_down_scale"])
+            if "w_up_scale" in p  # quantize_moe_serving_params banks
+            else {}
+        )
+        return moe(
+            h, p["w_up"], p["w_down"], ids, tw.astype(jnp.float32), **scales
+        )
 
 
 def specs_for(cfg: TransformerConfig, params: dict | None = None) -> dict:
@@ -437,14 +458,17 @@ def specs_for(cfg: TransformerConfig, params: dict | None = None) -> dict:
     actual `params` when they might be serving-quantized
     (:func:`quantize_moe_serving_params` adds scale entries the spec tree
     must mirror)."""
+    quantized = params is not None and params["layers"] and (
+        "w_up_scale" in params["layers"][0]
+    )
     if isinstance(cfg, EPMoETransformerConfig):
-        return ep_moe_param_specs(cfg)
+        return ep_moe_quantized_param_specs(cfg) if quantized else (
+            ep_moe_param_specs(cfg)
+        )
     if isinstance(cfg, MoETransformerConfig):
-        if params is not None and params["layers"] and (
-            "w_up_scale" in params["layers"][0]
-        ):
-            return moe_quantized_param_specs(cfg)
-        return moe_param_specs(cfg)
+        return moe_quantized_param_specs(cfg) if quantized else (
+            moe_param_specs(cfg)
+        )
     return param_specs(cfg)
 
 
