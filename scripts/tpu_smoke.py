@@ -120,6 +120,32 @@ def run_pass(key, interp, it, worst, fails):
     )
     oks.append(check("fast_all_to_all", recv, t.astype(jnp.float32)))
 
+    # quantized EP dispatch wire (int8 slab + scales on the metadata put):
+    # identity roundtrip through the flat layer at world-1
+    from jax.sharding import PartitionSpec as _P
+
+    from triton_dist_tpu.layers import EPAll2AllLayer
+
+    ql = EPAll2AllLayer(n_experts=4, topk=2, max_m=32, axis="tp", quant="int8")
+    xq = jax.random.normal(jax.random.fold_in(key, 9), (16, 256), jnp.bfloat16)
+    idq = jax.random.randint(jax.random.fold_in(key, 10), (16, 2), 0, 4, jnp.int32)
+    twq = jnp.full((16, 2), 0.5, jnp.float32)
+
+    def _q_roundtrip(x_, ids_, tw_):
+        recv_, info_ = ql.dispatch(x_, ids_)
+        return ql.combine(recv_, info_, tw_, 16)
+
+    qrt = jax.jit(
+        jax.shard_map(
+            _q_roundtrip, mesh=mesh,
+            in_specs=(_P(None, None), _P(None, None), _P(None, None)),
+            out_specs=_P(None, None), check_vma=False,
+        )
+    )(xq, idq, twq)
+    oks.append(check(
+        "ep_dispatch_int8_wire", qrt, xq.astype(jnp.float32), tol=5e-2
+    ))
+
     bq, h_kv, g, d = 2, 2, 4, 128
     q = jax.random.normal(key, (bq, h_kv * g, d), jnp.bfloat16)
     k = jax.random.normal(jax.random.fold_in(key, 2), (bq, h_kv, s, d), jnp.bfloat16)
@@ -137,11 +163,36 @@ def run_pass(key, interp, it, worst, fails):
         flash_decode_op(q, k, v, lens, mesh, config=FlashDecodeConfig(block_s=block_s)),
         fd_ref, tol=2e-2,
     ))
+    oks.append(check(
+        "flash_decode_fused_heads",
+        flash_decode_op(
+            q, k, v, lens, mesh,
+            config=FlashDecodeConfig(block_s=block_s, fuse_heads=True),
+        ),
+        fd_ref, tol=2e-2,
+    ))
+    from triton_dist_tpu.ops.flash_decode import flash_decode_quant, quantize_kv
+
+    k_q8, v_q8, ks8, vs8 = quantize_kv(k, v)
+    oks.append(check(
+        "flash_decode_int8_kv",
+        flash_decode_quant(
+            q, k_q8, v_q8, ks8, vs8, lens,
+            config=FlashDecodeConfig(block_s=block_s, fuse_heads=True),
+        ).reshape(bq, h_kv * g, d),
+        fd_ref, tol=8e-2,
+    ))
     ppseq = s // page
     bt = jnp.arange(bq * ppseq, dtype=jnp.int32).reshape(bq, ppseq)
     kp = k.reshape(bq, h_kv, ppseq, page, d).swapaxes(1, 2).reshape(bq * ppseq, h_kv, page, d)
     vp = v.reshape(bq, h_kv, ppseq, page, d).swapaxes(1, 2).reshape(bq * ppseq, h_kv, page, d)
+    # default fuse_heads=None auto-picks the fused grid at these shapes
     oks.append(check("paged_flash_decode", paged_flash_decode(q, kp, vp, lens, bt), fd_ref, tol=2e-2))
+    oks.append(check(
+        "paged_flash_decode_per_head",
+        paged_flash_decode(q, kp, vp, lens, bt, fuse_heads=False),
+        fd_ref, tol=2e-2,
+    ))
 
     # grouped GEMM (MoE): block-aligned rows, per-block expert ids
     n_exp, bm, h, f = 4, 8, 128, 256
@@ -155,6 +206,16 @@ def run_pass(key, interp, it, worst, fails):
     gg_ref = jnp.einsum("mh,mhf->mf", x.astype(jnp.float32),
                         w[row_exp].astype(jnp.float32))
     oks.append(check("group_gemm", gg, gg_ref, tol=1.0))
+    from triton_dist_tpu.ops.group_gemm import quantize_expert_weights
+
+    w_q8, w_s8 = quantize_expert_weights(w)
+    oks.append(check(
+        "group_gemm_w8",
+        group_gemm(
+            x, w_q8, eids, scale=w_s8, config=GroupGemmConfig(bm, 128, 128)
+        ),
+        gg_ref, tol=1.5,
+    ))
     del moe_align_block_size  # imported to assert availability
 
     # transpose grouped GEMM (MoE expert-weight grads)

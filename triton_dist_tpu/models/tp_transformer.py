@@ -401,12 +401,11 @@ def ep_moe_param_specs(cfg: EPMoETransformerConfig) -> dict:
 def ep_moe_quantized_param_specs(cfg: EPMoETransformerConfig) -> dict:
     """Shardings for :func:`quantize_moe_serving_params` output on the EP
     layout: int8 pools keep the expert-dim sharding; the ``[E, 1, N]``
-    scales shard with their experts too."""
+    scales shard with their experts (derived from the bank spec so the
+    two can never diverge)."""
     specs = ep_moe_param_specs(cfg)
-    exp_axes = (
-        (cfg.ep_outer, cfg.axis) if cfg.ep_outer is not None else cfg.axis
-    )
     for p in specs["layers"]:
+        exp_axes = p["w_up"][0]  # the banks' expert-dim sharding
         p["w_up_scale"] = P(exp_axes, None, None)
         p["w_down_scale"] = P(exp_axes, None, None)
     return specs
