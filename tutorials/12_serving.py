@@ -85,4 +85,13 @@ mcfg = MoETransformerConfig(
 mparams = init_moe_params(jax.random.PRNGKey(3), mcfg)
 mtoks = generate(mcfg, mparams, prompt, 3, mesh, s_max=S_MAX, fd_config=fd)
 print("[serving] MoE generate:", np.asarray(mtoks).tolist())
+
+# 5: int8 expert banks — the weight-bound decode MLP reads half the HBM
+# bytes; the spec tree resolves automatically from the scale entries
+from triton_dist_tpu.models import quantize_moe_serving_params
+
+q_params = quantize_moe_serving_params(mparams)
+qtoks = generate(mcfg, q_params, prompt, 3, mesh, s_max=S_MAX, fd_config=fd)
+np.testing.assert_array_equal(np.asarray(qtoks), np.asarray(mtoks))
+print("[serving] MoE int8-expert generate matches full precision")
 print("[serving] OK")
