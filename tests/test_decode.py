@@ -390,3 +390,67 @@ def test_generate_moe_quantized_experts(mesh4):
         cfg, q_params, prompt, n_steps, mesh4, s_max=s_max, fd_config=fd
     )
     np.testing.assert_array_equal(np.asarray(quant), np.asarray(full))
+
+
+def test_generate_prefill_paged_matches_token_by_token(mesh4):
+    """Paged prefill (batch page-range write into the static-table pool)
+    must reproduce the token-by-token paged warmup exactly."""
+    b, prompt_len, n_steps, s_max = 2, 4, 4, 16
+    cfg = TransformerConfig(
+        vocab=32, hidden=32, ffn=64, n_layers=2, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=b, seq=prompt_len,
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+    )
+    params = init_params(jax.random.PRNGKey(6), cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(7), (b, prompt_len), 0, cfg.vocab, jnp.int32
+    )
+    want = generate(
+        cfg, params, prompt, n_steps, mesh4, s_max=s_max, page_size=2,
+    )
+    got = generate(
+        cfg, params, prompt, n_steps, mesh4, s_max=s_max, page_size=2,
+        prefill=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_continuous_batcher_prefill_paged_admission(mesh4):
+    """MXU-rate prefill admission INTO THE PAGED POOL: slot-masked page
+    writes must not disturb neighbors, and each request's tokens match
+    the solo paged generate."""
+    from triton_dist_tpu.models.decode import ContinuousBatcher, Request
+
+    s_max = 16
+    cfg = TransformerConfig(
+        vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=2, seq=8,
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+    )
+    params = init_params(jax.random.PRNGKey(8), cfg)
+    key = jax.random.PRNGKey(9)
+    reqs = [
+        Request(list(np.asarray(jax.random.randint(
+            jax.random.fold_in(key, i), (pl,), 0, cfg.vocab, jnp.int32
+        ))), max_new_tokens=mn, uid=i)
+        for i, (pl, mn) in enumerate([(4, 3), (6, 2), (2, 4)])
+    ]
+    batcher = ContinuousBatcher(
+        cfg, params, mesh4, s_max=s_max, page_size=4, prefill=True,
+    )
+    for r in reqs:
+        batcher.submit(r)
+    done = dict(batcher.run(max_steps=200))
+    assert set(done) == {0, 1, 2}
+    import dataclasses as dc
+
+    for r in reqs:
+        cfg1 = dc.replace(cfg, batch=1, seq=8)
+        want = generate(
+            cfg1, params, jnp.asarray([r.prompt], jnp.int32),
+            r.max_new_tokens, mesh4, s_max=s_max, page_size=4,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(done[r.uid], np.int32), np.asarray(want)[0],
+            err_msg=f"request {r.uid}",
+        )

@@ -383,15 +383,16 @@ def generate(
 
     ``prefill=True`` runs the prompt through ONE full transformer forward
     (``prefill_cache`` — MXU-rate prompt processing, the serving-system
-    prefill/decode split) instead of token-by-token; contiguous cache
-    only, and ``b*prompt_len`` must divide over the axis.
+    prefill/decode split) instead of token-by-token, on either cache
+    layout; ``b*prompt_len`` must divide over the axis.
 
     ``page_size`` switches the KV cache to the paged layout (page pool +
-    block table, runtime page allocation) — the serving-shaped
-    configuration; default is the contiguous sequence-sharded cache. On
-    the paged path the page IS the attention block, so ``fd_config``
-    (whose ``block_s`` tiles the contiguous kernel) is not accepted
-    alongside ``page_size``.
+    block table; runtime page allocation, or static page ranges when
+    composed with ``prefill=True`` — the batch page write needs them) —
+    the serving-shaped configuration; default is the contiguous
+    sequence-sharded cache. On the paged path the page IS the attention
+    block, so ``fd_config`` (whose ``block_s`` tiles the contiguous
+    kernel) is not accepted alongside ``page_size``.
 
     Host-level entry; jits ONE fused program that lax.scans decode_step
     over all positions (prompt phase ignores the model's predictions)."""
@@ -410,15 +411,13 @@ def generate(
             "is the block — pass one or the other"
         )
     spec = (
-        PagedKVCacheSpec(s_max, page_size) if page_size else KVCacheSpec(s_max)
+        # prefill batch-writes whole page ranges, which needs the STATIC
+        # table; plain paged decode keeps the runtime bump allocator
+        PagedKVCacheSpec(s_max, page_size, static_table=prefill)
+        if page_size else KVCacheSpec(s_max)
     )
     n = mesh.shape[cfg.axis]
     if prefill:
-        if page_size:
-            raise ValueError(
-                "prefill=True writes the contiguous layout; the paged "
-                "cache warms token-by-token"
-            )
         if (b * prompt_len) % n:
             raise ValueError(
                 f"prefill needs b*prompt_len={b * prompt_len} divisible "
@@ -566,11 +565,9 @@ class ContinuousBatcher:
                 "fd_config tiles the contiguous kernel; with page_size the "
                 "page is the block — pass one or the other"
             )
-        if prefill and page_size:
-            raise ValueError(
-                "prefill admission writes the contiguous layout; the paged "
-                "cache warms token-by-token"
-            )
+        # prefill + paged composes: the batcher's tables are STATIC
+        # (pre-assigned page ranges), exactly what the paged prefill's
+        # batch page write needs
         self.prefill = prefill
         self._prefill_progs: dict[int, Any] = {}
         self.spec = (
@@ -822,8 +819,11 @@ def prefill_cache(
     post-RoPE k/v into the decode cache in ONE pass — prompt processing at
     MXU rates instead of token-by-token (the serving-side gap between a
     decode kernel and a serving system; the reference stops at the
-    kernel). Contiguous cache only: the per-layer head→sequence reshard
-    lands directly in the sequence-sharded layout.
+    kernel). The per-layer head→sequence reshard lands either directly
+    in the contiguous sequence-sharded layout, or — for a
+    ``PagedKVCacheSpec(static_table=True)`` — as a batch page-range
+    scatter into the pool (slot-masked admission gates the scatter
+    indices, the paged discipline).
 
     prompt_loc: ``[b*L/n]`` int32 flattened prompt shard (b-major).
     ``slot_mask [b] bool`` restricts the cache write to chosen sequences
@@ -841,10 +841,12 @@ def prefill_cache(
         TPMoETransformer, TPTransformer,
     )
 
-    if not isinstance(spec, KVCacheSpec):
+    paged = isinstance(spec, PagedKVCacheSpec)
+    if paged and not spec.static_table:
         raise ValueError(
-            "prefill_cache writes the contiguous layout; paged caches "
-            "warm token-by-token"
+            "paged prefill needs static_table=True (pre-assigned page "
+            "ranges): the bump allocator hands out pages one step at a "
+            "time and cannot batch-claim a whole prompt's worth"
         )
     c = cfg
     n = int(jax.lax.axis_size(c.axis))
@@ -877,6 +879,30 @@ def prefill_cache(
         start = jnp.minimum(me * s_shard, L)
         k_new = jax.lax.dynamic_slice_in_dim(k_buf, start, s_shard, 2)
         v_new = jax.lax.dynamic_slice_in_dim(v_buf, start, s_shard, 2)
+        if paged:
+            # page pool write: this PE's window splits into its slot's
+            # STATIC page range; slot_mask gates the scatter INDICES (the
+            # paged discipline — out-of-range ids drop), not the values
+            ps = spec.page_size
+            pps = s_shard // ps
+            kp = k_new.reshape(b, c.n_kv_heads, pps, ps, c.head_dim)
+            vp = v_new.reshape(b, c.n_kv_heads, pps, ps, c.head_dim)
+            kp = jnp.swapaxes(kp, 1, 2).reshape(b * pps, c.n_kv_heads, ps, c.head_dim)
+            vp = jnp.swapaxes(vp, 1, 2).reshape(b * pps, c.n_kv_heads, ps, c.head_dim)
+            ids = cache["block_table"][0]                # [b, pps] static
+            n_pool = cache["k"].shape[1]
+            if slot_mask is not None:
+                ids = jnp.where(slot_mask[:, None], ids, n_pool)  # drop
+            cache = dict(
+                cache,
+                k=cache["k"].at[li, ids.reshape(-1)].set(
+                    kp.astype(kd), mode="drop"
+                ),
+                v=cache["v"].at[li, ids.reshape(-1)].set(
+                    vp.astype(kd), mode="drop"
+                ),
+            )
+            continue
         if slot_mask is not None:
             sel = slot_mask.reshape(b, 1, 1, 1)
             k_new = jnp.where(sel, k_new, cache["k"][li])
