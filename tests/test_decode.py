@@ -454,3 +454,64 @@ def test_continuous_batcher_prefill_paged_admission(mesh4):
             np.asarray(done[r.uid], np.int32), np.asarray(want)[0],
             err_msg=f"request {r.uid}",
         )
+
+
+def test_generate_flat_ep_moe_matches_tp_moe(mesh4):
+    """Flat EP-MoE serving decode (batch sliced per PE, a2a dispatch to
+    whole-expert owners, all-gathered combine — the reference's headline
+    inference configuration) produces EXACTLY the tokens the TP-MoE
+    decode produces from the same weights."""
+    import dataclasses as dc
+
+    from triton_dist_tpu.models import (
+        EPMoETransformerConfig, MoETransformerConfig, init_moe_params,
+    )
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+
+    b, prompt_len, n_steps, s_max = 4, 4, 4, 16
+    kw = dict(
+        vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=b, seq=prompt_len + n_steps, n_experts=8, topk=2,
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+        gg_config=GroupGemmConfig(4, 32, 32),
+    )
+    tp_cfg = MoETransformerConfig(**kw)
+    ep_cfg = EPMoETransformerConfig(**kw)  # flat: ep_outer=None
+    params = init_moe_params(jax.random.PRNGKey(50), tp_cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(51), (b, prompt_len), 0, tp_cfg.vocab, jnp.int32
+    )
+    fd = FlashDecodeConfig(block_s=4)
+    tp_toks = generate(
+        tp_cfg, params, prompt, n_steps, mesh4, s_max=s_max, fd_config=fd
+    )
+    ep_toks = generate(
+        ep_cfg, params, prompt, n_steps, mesh4, s_max=s_max, fd_config=fd
+    )
+    np.testing.assert_array_equal(np.asarray(ep_toks), np.asarray(tp_toks))
+
+    # MXU-rate prefill runs the EP forward (EPMoEMLP in the full pass)
+    # and must land the same cache: same tokens again
+    ep_pf = generate(
+        ep_cfg, params, prompt, n_steps, mesh4, s_max=s_max, fd_config=fd,
+        prefill=True,
+    )
+    np.testing.assert_array_equal(np.asarray(ep_pf), np.asarray(tp_toks))
+
+    # int8 dispatch wire + int8 expert banks compose on the serving path
+    from triton_dist_tpu.models import quantize_moe_serving_params
+
+    ep_q_cfg = dc.replace(ep_cfg, ep_quant="int8")
+    q_params = quantize_moe_serving_params(params)
+    ep_q = generate(
+        ep_q_cfg, q_params, prompt, n_steps, mesh4, s_max=s_max, fd_config=fd
+    )
+    np.testing.assert_array_equal(np.asarray(ep_q), np.asarray(tp_toks))
+
+    # hierarchical EP still rejects loudly (1-axis serving mesh)
+    hier_cfg = dc.replace(ep_cfg, ep_outer="dp")
+    with pytest.raises(NotImplementedError):
+        generate(
+            hier_cfg, params, prompt, n_steps, mesh4, s_max=s_max,
+            fd_config=fd,
+        )

@@ -610,3 +610,31 @@ def test_ep_moe_mlp_quantized_dispatch(mesh4):
     # everything int8: quantized wire AND int8 expert banks
     q8 = run("int8", w8=True)
     np.testing.assert_allclose(q8, full, rtol=6e-2, atol=6e-2)
+
+
+def test_quant_dispatch_grad_is_zero(mesh4):
+    """Documented gradient semantics of the quantized wire: the int8 cast
+    cuts JAX's differentiation graph, so grads through a quant-mode
+    dispatch are silently ZERO (standard integer-boundary behavior — a
+    raising custom_vjp cannot intercept it because the pruned backward
+    never runs). This test pins that down so a future JAX change or
+    refactor that alters the behavior is noticed."""
+    layer = EPAll2AllLayer(
+        n_experts=4, topk=2, max_m=8, axis="tp", quant="int8"
+    )
+
+    def loss(x, ids, tw):
+        recv, info = layer.dispatch(x, ids)
+        return jnp.sum(layer.combine(recv, info, tw, 4))
+
+    x = jnp.ones((16, 32), jnp.float32)
+    ids = jnp.zeros((16, 2), jnp.int32)
+    tw = jnp.full((16, 2), 0.5)
+    g = jax.jit(
+        jax.shard_map(
+            jax.grad(loss), mesh=mesh4,
+            in_specs=(P("tp", None), P("tp", None), P("tp", None)),
+            out_specs=P("tp", None), check_vma=False,
+        )
+    )(x, ids, tw)
+    assert float(jnp.abs(g).sum()) == 0.0

@@ -48,7 +48,16 @@ def _check_quant(quant) -> None:
 
 def _dequantize_rows(recv_q: jax.Array, scale: jax.Array, dtype):
     """Inverse of :func:`_quantize_rows` (kept adjacent so the wire format
-    changes in one place)."""
+    changes in one place).
+
+    GRADIENT SEMANTICS: the integer wire cuts JAX's differentiation graph
+    at the int8/fp8 cast — d(anything)/d(dispatched tokens) is ZERO
+    through a quant-mode dispatch, silently, by standard JAX
+    integer-boundary semantics (a raising custom_vjp cannot catch it:
+    the backward subgraph is pruned before any bwd runs, verified
+    empirically). Hence quant is a SERVING knob; training configs must
+    leave it None — documented on every quant field and asserted by
+    tests/test_layers.py::test_quant_dispatch_grad_is_zero."""
     return (recv_q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 

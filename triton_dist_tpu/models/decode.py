@@ -44,16 +44,20 @@ from triton_dist_tpu.ops.flash_decode import (
 
 
 def _specs_for(cfg: TransformerConfig, params: dict | None = None):
-    """Param specs for the serving path: dense or TP-MoE. EP configs are
-    rejected — their expert placement (ep_outer/ep_max_m, tokens traveling
-    to whole experts over the all-to-all) has no decode path here, and
-    silently serving them as plain TP-MoE would ignore those semantics.
-    `params`, when given, lets serving-quantized expert banks
-    (quantize_moe_serving_params) resolve their scale-bearing spec tree."""
-    if isinstance(cfg, EPMoETransformerConfig):
+    """Param specs for the serving path: dense, TP-MoE, or FLAT EP-MoE
+    (whole experts over the serving axis; decode slices its replicated
+    activations per PE and dispatches over the a2a — the reference's
+    headline inference configuration). HIERARCHICAL EP is rejected: its
+    two-phase dispatch needs a (node, local) mesh and the serving loop
+    runs a 1-axis mesh. `params`, when given, lets serving-quantized
+    expert banks (quantize_moe_serving_params) resolve their
+    scale-bearing spec tree."""
+    if isinstance(cfg, EPMoETransformerConfig) and cfg.ep_outer is not None:
         raise NotImplementedError(
-            "EP-MoE configs have no serving decode path (attention-TP + "
-            "expert-parallel FFN); use a TP MoETransformerConfig"
+            "hierarchical EP-MoE (ep_outer set) has no serving decode "
+            "path: the two-phase dispatch needs a (node, local) mesh and "
+            "serving runs a 1-axis mesh — use a flat EP config "
+            "(ep_outer=None) or a TP MoETransformerConfig"
         )
     return specs_for(cfg, params)
 
@@ -318,7 +322,33 @@ def decode_step(
 
         # --- MLP ---
         h = rmsnorm(x, p["mlp_norm"], c.norm_eps)
-        if isinstance(c, MoETransformerConfig):
+        if isinstance(c, EPMoETransformerConfig):
+            # EP serving decode (the reference's headline inference
+            # configuration — its LL a2a IS decode-shaped EP dispatch,
+            # README.md:87): decode activations are replicated, so each
+            # PE takes its token slice, dispatches over the flat EP axis
+            # to the expert owners, and the combined shard all-gathers
+            # back to the replicated layout. Flat only — _specs_for
+            # rejects hierarchical EP (serving meshes here are 1-axis).
+            from triton_dist_tpu.models.tp_transformer import ep_moe_apply
+
+            if c.batch % n:
+                raise ValueError(
+                    f"EP serving decode shards the batch over the "
+                    f"{c.axis!r} axis: batch={c.batch} must divide evenly "
+                    f"over {n} PEs"
+                )
+            b_loc = c.batch // n
+            h_loc = jax.lax.dynamic_slice_in_dim(h, me * b_loc, b_loc, 0)
+            # per-(src, dest) slab worst case: a src PE holds b_loc
+            # tokens, each with topk assignments
+            y_loc = ep_moe_apply(
+                c, h_loc, p, c.ep_max_m or b_loc * c.topk,
+                interpret=interpret,
+            )
+            y = jax.lax.all_gather(y_loc, c.axis, axis=0, tiled=True)
+            x = x + y.astype(x.dtype)
+        elif isinstance(c, MoETransformerConfig):
             # decode-shaped MoE: at serving batch sizes every expert's
             # F-shard weights stream from HBM regardless (weight-bound),
             # so computing ALL experts with dense einsums + a one-hot
@@ -838,7 +868,7 @@ def prefill_cache(
     gather, so only ``[b, V]`` ever materializes).
     """
     from triton_dist_tpu.models.tp_transformer import (
-        TPMoETransformer, TPTransformer,
+        EPMoETransformer, TPMoETransformer, TPTransformer,
     )
 
     paged = isinstance(spec, PagedKVCacheSpec)
@@ -854,9 +884,12 @@ def prefill_cache(
     b, L = c.batch, c.seq
     s_shard = _shard_of(s_max, n)
 
-    model_cls = (
-        TPMoETransformer if isinstance(c, MoETransformerConfig) else TPTransformer
-    )
+    if isinstance(c, EPMoETransformerConfig):
+        model_cls = EPMoETransformer  # expert-parallel FFN in the forward
+    elif isinstance(c, MoETransformerConfig):
+        model_cls = TPMoETransformer
+    else:
+        model_cls = TPTransformer
     model = model_cls(c)
     model.kv_sink = []
     logits_loc = model(prompt_loc, params)            # [b*L, V/n]

@@ -383,6 +383,10 @@ class EPMoETransformerConfig(MoETransformerConfig):
     # ``layers.ep_moe_mlp`` — the flag applies to every EPMoEMLP call,
     # including this model's).
     ep_max_m: int | None = None
+    # "int8"/"fp8": quantize the dispatch WIRE (per-row scales on the
+    # metadata put — EPAll2AllLayer.quant). Inference only: it cuts the
+    # router gradient, so leave None for training.
+    ep_quant: str | None = None
 
 
 def ep_moe_param_specs(cfg: EPMoETransformerConfig) -> dict:
@@ -423,33 +427,47 @@ class EPMoETransformer(TPMoETransformer):
     so the router gradient survives both hops."""
 
     def _mlp(self, x: jax.Array, p: dict) -> jax.Array:
-        from triton_dist_tpu.layers.ep_moe_mlp import EPMoEMLP
-        from triton_dist_tpu.ops.moe_utils import select_experts
-
         c = self.cfg
         h = rmsnorm(x, p["mlp_norm"], c.norm_eps)
-        logits = h.astype(jnp.float32) @ p["router"].astype(jnp.float32)
-        tw, ids = select_experts(logits, c.topk)
         # worst-case slab bound: hierarchical phase 1 dedups to at most ONE
         # copy per (token, dest node), so m_loc suffices; flat dispatch can
         # send all topk assignments to one rank
         max_m = c.ep_max_m or (
             x.shape[0] if c.ep_outer is not None else x.shape[0] * c.topk
         )
-        moe = EPMoEMLP(
-            n_experts=c.n_experts, topk=c.topk, max_m=max_m,
-            axis=c.axis, outer=c.ep_outer,
-            inner=c.axis if c.ep_outer is not None else None,
-            gg_config=c.gg_config, interpret=c.interpret,
-        )
-        scales = (
-            dict(w_up_scale=p["w_up_scale"], w_down_scale=p["w_down_scale"])
-            if "w_up_scale" in p  # quantize_moe_serving_params banks
-            else {}
-        )
-        return moe(
-            h, p["w_up"], p["w_down"], ids, tw.astype(jnp.float32), **scales
-        )
+        return ep_moe_apply(c, h, p, max_m)
+
+
+def ep_moe_apply(
+    cfg: EPMoETransformerConfig, h: jax.Array, p: dict, max_m: int,
+    interpret: Any = None,
+) -> jax.Array:
+    """Router → EP dispatch → expert GEMMs → combine on a token shard —
+    ONE implementation shared by the model forward and the serving decode
+    (which differ only in how they shard the tokens and bound ``max_m``).
+    Serving-quantized expert banks (scale entries present) thread their
+    scales through automatically."""
+    from triton_dist_tpu.layers.ep_moe_mlp import EPMoEMLP
+    from triton_dist_tpu.ops.moe_utils import select_experts
+
+    c = cfg
+    logits = h.astype(jnp.float32) @ p["router"].astype(jnp.float32)
+    tw, ids = select_experts(logits, c.topk)
+    moe = EPMoEMLP(
+        n_experts=c.n_experts, topk=c.topk, max_m=max_m,
+        axis=c.axis, outer=c.ep_outer,
+        inner=c.axis if c.ep_outer is not None else None,
+        quant=c.ep_quant, gg_config=c.gg_config,
+        interpret=c.interpret if interpret is None else interpret,
+    )
+    scales = (
+        dict(w_up_scale=p["w_up_scale"], w_down_scale=p["w_down_scale"])
+        if "w_up_scale" in p  # quantize_moe_serving_params banks
+        else {}
+    )
+    return moe(
+        h, p["w_up"], p["w_down"], ids, tw.astype(jnp.float32), **scales
+    )
 
 
 def specs_for(cfg: TransformerConfig, params: dict | None = None) -> dict:
