@@ -1,0 +1,81 @@
+#!/usr/bin/env bash
+# Tunnel watcher: probe the accelerator backend on a short cadence and
+# fire the full chip session (scripts/chip_session.sh) the MOMENT a
+# probe succeeds — so any tunnel window during the round is captured
+# without anyone noticing it came back.
+#
+# Rounds 2-4 each lost their driver bench window to tunnel outages; the
+# only hardware numbers ever captured came from manually-started morning
+# sessions. This makes capture automatic (VERDICT r4, "Next round" #1).
+#
+# Behavior:
+#   - probe = `python -c "import jax; jax.devices()"` in a fresh
+#     subprocess with a hard deadline (the hang mode observed in rounds
+#     2-4 is an indefinite block inside backend init, not an exception).
+#   - on the first successful probe, touch CHIP_TUNNEL_UP and run the
+#     session; while it runs, CHIP_SESSION_RUNNING exists (builder-side
+#     heavy jobs should yield — a CPU-starved host inflates bench
+#     wall-times past their timeouts, see chip_session.sh header).
+#   - session rc==0  -> marker CHIP_SESSION_DONE, drop to slow probing
+#     (the tunnel may drop and return; a later `--again` rerun can be
+#     requested by deleting the DONE marker).
+#   - session rc!=0  -> retry on the next successful probe, up to
+#     MAX_SESSION_TRIES (a mid-session tunnel drop should not burn the
+#     whole round in a retry loop).
+# All state/log files live under docs/chip_logs/ so they get committed.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p docs/chip_logs
+LOG=docs/chip_logs/watcher.log
+DONE=docs/chip_logs/CHIP_SESSION_DONE
+RUNNING=docs/chip_logs/CHIP_SESSION_RUNNING
+UP=docs/chip_logs/CHIP_TUNNEL_UP
+PROBE_S=${CHIP_WATCH_PROBE_DEADLINE:-240}
+FAST_SLEEP=${CHIP_WATCH_FAST_SLEEP:-180}
+SLOW_SLEEP=${CHIP_WATCH_SLOW_SLEEP:-1200}
+MAX_SESSION_TRIES=${CHIP_WATCH_MAX_TRIES:-3}
+
+log() { echo "[$(date -u +%FT%TZ)] $*" >> "$LOG"; }
+
+probe() {
+  # Fresh subprocess per probe: a hung backend init must not wedge the
+  # watcher itself. JAX_PLATFORMS unset on purpose — we want the real
+  # backend path the bench will use.
+  timeout "$PROBE_S" python - <<'EOF' >/dev/null 2>&1
+import jax
+devs = jax.devices()
+assert devs and devs[0].platform == "tpu", devs
+EOF
+}
+
+tries=0
+log "watcher start (probe deadline ${PROBE_S}s, fast ${FAST_SLEEP}s, slow ${SLOW_SLEEP}s)"
+while :; do
+  if [ -f "$DONE" ]; then
+    sleep "$SLOW_SLEEP"
+    continue
+  fi
+  if probe; then
+    date -u +%FT%TZ > "$UP"
+    log "probe OK — tunnel is up"
+    if [ "$tries" -ge "$MAX_SESSION_TRIES" ]; then
+      log "session retry budget exhausted ($tries); staying idle (probes continue)"
+      sleep "$SLOW_SLEEP"
+      continue
+    fi
+    tries=$((tries + 1))
+    touch "$RUNNING"
+    log "firing chip_session.sh (attempt $tries/$MAX_SESSION_TRIES)"
+    bash scripts/chip_session.sh >> "$LOG" 2>&1
+    rc=$?
+    rm -f "$RUNNING"
+    log "chip_session.sh rc=$rc"
+    if [ "$rc" -eq 0 ]; then
+      date -u +%FT%TZ > "$DONE"
+      log "session complete — dropping to slow probing"
+    fi
+  else
+    log "probe failed/timed out"
+  fi
+  sleep "$FAST_SLEEP"
+done
