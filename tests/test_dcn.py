@@ -355,15 +355,15 @@ def test_paged_fuse_heads_auto_fallback():
     bt = jnp.zeros((b, 1), jnp.int32)
     pool = jnp.zeros((1, 2, page, d), jnp.bfloat16)
     fd.dist_pallas_call = spy
-    prev_budget = fd._FUSED_SLAB_VMEM_BUDGET
+    prev_budget = fd._fused_slab_vmem_budget
     try:
         fd.paged_flash_decode(q, pool, pool, lens, bt)
         assert calls and calls[-1] == "paged_flash_decode_fh"
         # same pool under a tiny budget: the guard must pick per-head
         # (overriding the budget keeps the interpret-mode grid small)
-        fd._FUSED_SLAB_VMEM_BUDGET = 4 * page * d  # < one 2-head slab
+        fd._fused_slab_vmem_budget = lambda: 4 * page * d  # < one 2-head slab
         fd.paged_flash_decode(q, pool, pool, lens, bt)
         assert calls[-1] == "paged_flash_decode"
     finally:
         fd.dist_pallas_call = orig
-        fd._FUSED_SLAB_VMEM_BUDGET = prev_budget
+        fd._fused_slab_vmem_budget = prev_budget

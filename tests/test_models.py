@@ -420,6 +420,23 @@ def test_ep_moe_transformer_train_step(mesh4):
     assert np.abs(r1 - r0).max() > 0
 
 
+def test_train_step_rejects_ep_quant():
+    """ep_quant is inference-only (the quantized wire zeroes the router
+    gradient — test_quant_dispatch_grad_is_zero); train_step must refuse
+    it loudly rather than train a dead router silently."""
+    import pytest
+
+    from triton_dist_tpu.models import EPMoETransformer, EPMoETransformerConfig
+
+    cfg = EPMoETransformerConfig(
+        vocab=64, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=2, seq=16, n_experts=4, topk=2, ep_quant="int8",
+    )
+    model = EPMoETransformer(cfg)
+    with pytest.raises(ValueError, match="ep_quant"):
+        train_step(model, {}, None, None)
+
+
 def _moe_dense_forward(tokens, params, cfg):
     """Differentiable dense golden forward for the (1-layer) MoE decoder
     (einsum MoE instead of _moe_ref_forward's numpy loop)."""

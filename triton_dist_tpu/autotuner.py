@@ -24,6 +24,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import json
+import math
 import os
 from typing import Any, Callable, Iterable, Sequence
 
@@ -306,13 +307,17 @@ def contextual_autotune(
                 best_i = int(
                     multihost_utils.broadcast_one_to_all(_np.int32(best_i))
                 )
-                # re-derive the logged timing for rank 0's choice (this
-                # rank's sample of it may be inf if the config failed here)
+                # the logged timing below is THIS RANK'S local sample of
+                # rank 0's choice — it can be inf when the config failed
+                # here (harmless: the disk cache stores only the index)
                 best_t = times[best_i]
             if tdt_config.get_config().verbose_autotune:
+                t_str = f"{best_t:.3f} ms" if math.isfinite(best_t) else (
+                    "n/a locally"  # rank 0's pick; this rank's sample failed
+                )
                 print(
                     f"[autotune {op_name}] {key} -> {configs[best_i]} "
-                    f"({best_t:.3f} ms; all={['%.3f' % t for t in times]})"
+                    f"({t_str}; all={['%.3f' % t for t in times]})"
                 )
             _memory_cache[mem_key] = configs[best_i]
             disk[key] = {"i": best_i, "cfg": repr(configs[best_i])}

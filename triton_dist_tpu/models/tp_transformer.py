@@ -537,6 +537,16 @@ def train_step(
     only the paths through this PE's token shard and need a tp-psum.
     Hence: psum replicated grads, divide everything by tp, pmean over dp."""
     c = model.cfg
+    if getattr(c, "ep_quant", None) is not None:
+        # The quantized dispatch wire zeroes the router gradient (pinned by
+        # test_quant_dispatch_grad_is_zero) — training with it set would
+        # converge with a dead router, silently. Fail loudly instead.
+        raise ValueError(
+            "train_step with ep_quant="
+            f"{c.ep_quant!r}: the quantized EP dispatch wire is "
+            "inference-only (it cuts the router gradient). Train with "
+            "ep_quant=None and quantize for serving."
+        )
     tp = int(jax.lax.axis_size(c.axis))
     loss, grads = jax.value_and_grad(
         lambda p: model.loss(tokens_loc, targets, p)

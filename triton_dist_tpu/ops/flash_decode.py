@@ -44,13 +44,20 @@ from jax.sharding import Mesh, PartitionSpec as P
 from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.allgather import all_gather
 from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
+from triton_dist_tpu.parallel import topology
 from triton_dist_tpu.utils import pick_block
 
 NEG_INF = float("-inf")
 
-# fuse_heads auto-guard: the fused paged kernel's double-buffered K+V page
-# slabs must fit this conservative VMEM slice (see paged_flash_decode)
-_FUSED_SLAB_VMEM_BUDGET = 64 * 2**20
+
+def _fused_slab_vmem_budget() -> int:
+    """fuse_heads auto-guard: the fused paged kernel's double-buffered K+V
+    page slabs must fit this conservative VMEM slice (see
+    :func:`paged_flash_decode`). Half the generation's VMEM — accumulators,
+    q, outs and the compiler's own scratch share the other half. Derived
+    from the topology table (not a constant) so a generation with smaller
+    VMEM auto-selects the per-head grid instead of failing to compile."""
+    return topology.vmem_bytes() // 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -531,11 +538,10 @@ def paged_flash_decode(
     assert hq % h_kv == 0, (hq, h_kv)
     g = hq // h_kv
     if fuse_heads is None:
-        # 2 operands (K+V) × 2 (double buffer) × slab bytes, against a
-        # conservative slice of the 128 MB VMEM (accumulators, q, outs
-        # and the compiler's own scratch share it)
+        # 2 operands (K+V) × 2 (double buffer) × slab bytes, against the
+        # generation-derived VMEM budget (see _fused_slab_vmem_budget)
         slab = h_kv * page_size * d * k_pages.dtype.itemsize
-        fuse_heads = 4 * slab <= _FUSED_SLAB_VMEM_BUDGET
+        fuse_heads = 4 * slab <= _fused_slab_vmem_budget()
     max_pages = block_table.shape[1]
     scale = 1.0 / math.sqrt(d)
     # match q to the page-pool dtype (same contract as flash_decode)

@@ -51,6 +51,22 @@ HBM_GBPS = {
 # used only by perf models and method auto-selection, never correctness.
 DCN_GBPS = 25.0
 
+# On-core VMEM per generation, MiB (public figures; like HBM_GBPS this
+# steers heuristics — kernel auto-modes size their scratch against it —
+# never correctness). Unknown generations fall back conservatively.
+VMEM_MIB = {
+    "v4": 128,
+    "v5e": 128,
+    "v5p": 128,
+    "v6e": 128,
+    "cpu": 128,
+}
+
+
+def vmem_bytes(gen: str | None = None) -> int:
+    g = gen or tpu_generation()
+    return VMEM_MIB.get(g, 64) * 2**20
+
 
 def tpu_generation() -> str:
     """Best-effort TPU generation string ('v5e', 'v5p', ...) or 'cpu'."""
