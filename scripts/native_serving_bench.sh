@@ -32,7 +32,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_dist_tpu import aot
 from triton_dist_tpu.models import init_params, presets
-from triton_dist_tpu.models.decode import KVCacheSpec, _specs_for, decode_step
+from triton_dist_tpu.models.decode import KVCacheSpec, decode_step
+from triton_dist_tpu.models.tp_transformer import specs_for as _specs_for
 
 import os
 n_layers, batch, iters = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])

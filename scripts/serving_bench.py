@@ -65,7 +65,18 @@ def main() -> int:
         # and without this env var for the uplift)
         params = quantize_moe_serving_params(params)
         name += "+w8"
-    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("tp",))
+    # EP presets (":ep" suffix) serve the expert-parallel deployment; the
+    # hierarchical one (":ep-hier", ep_outer="dcn") needs the 2-axis mesh
+    # — degenerate (1, 1) on a single chip, which still runs the full
+    # two-phase dispatch program (the deployment the multi-slice serving
+    # preset scales up; dryrun_multichip token-checks it at 2×4)
+    ep_outer = getattr(cfg, "ep_outer", None)
+    if ep_outer is not None:
+        mesh = jax.sharding.Mesh(
+            np.array(jax.devices()[:1]).reshape(1, 1), (ep_outer, cfg.axis)
+        )
+    else:
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("tp",))
 
     batcher = ContinuousBatcher(cfg, params, mesh, s_max=s_max)
     rng = np.random.default_rng(0)

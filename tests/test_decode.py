@@ -508,10 +508,112 @@ def test_generate_flat_ep_moe_matches_tp_moe(mesh4):
     )
     np.testing.assert_array_equal(np.asarray(ep_q), np.asarray(tp_toks))
 
-    # hierarchical EP still rejects loudly (1-axis serving mesh)
+    # hierarchical EP on a 1-axis mesh still fails loudly (needs the
+    # 2-axis (ep_outer, axis) serving mesh)
     hier_cfg = dc.replace(ep_cfg, ep_outer="dp")
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(ValueError, match="ep_outer"):
         generate(
             hier_cfg, params, prompt, n_steps, mesh4, s_max=s_max,
             fd_config=fd,
+        )
+
+
+def test_generate_hier_ep_moe_matches_flat(mesh2x4, mesh4):
+    """Hierarchical EP serving decode — the reference's headline
+    deployment shape (EPAll2AllLayer spanning nodes,
+    test_ep_moe_inference.py; README.md:87 is a 4-node × 8-GPU a2a): on a
+    (dp, tp) serving mesh, attention runs data-parallel per outer group
+    (batch + KV cache outer-sharded), the two-phase dispatch spans all 8
+    PEs, and the generated tokens are EXACTLY the flat-EP tokens from the
+    same weights."""
+    import dataclasses as dc
+
+    from triton_dist_tpu.models import (
+        EPMoETransformerConfig, init_moe_params,
+    )
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+
+    b, prompt_len, n_steps, s_max = 8, 4, 4, 16
+    kw = dict(
+        vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=b, seq=prompt_len + n_steps, n_experts=8, topk=2,
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+        gg_config=GroupGemmConfig(4, 32, 32),
+    )
+    flat_cfg = EPMoETransformerConfig(**kw)
+    hier_cfg = EPMoETransformerConfig(**kw, ep_outer="dp")
+    params = init_moe_params(jax.random.PRNGKey(60), flat_cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(61), (b, prompt_len), 0, flat_cfg.vocab, jnp.int32
+    )
+    fd = FlashDecodeConfig(block_s=4)
+    flat_toks = generate(
+        flat_cfg, params, prompt, n_steps, mesh4, s_max=s_max, fd_config=fd
+    )
+    hier_toks = generate(
+        hier_cfg, params, prompt, n_steps, mesh2x4, s_max=s_max, fd_config=fd
+    )
+    np.testing.assert_array_equal(np.asarray(hier_toks), np.asarray(flat_toks))
+
+    # MXU-rate prefill composes: the hier model forward fills each outer
+    # group's cache slice and decode continues identically
+    hier_pf = generate(
+        hier_cfg, params, prompt, n_steps, mesh2x4, s_max=s_max,
+        fd_config=fd, prefill=True,
+    )
+    np.testing.assert_array_equal(np.asarray(hier_pf), np.asarray(flat_toks))
+
+    # paged pool + block-table indirection on the 2-axis mesh
+    hier_paged = generate(
+        hier_cfg, params, prompt, n_steps, mesh2x4, s_max=s_max, page_size=2,
+    )
+    np.testing.assert_array_equal(np.asarray(hier_paged), np.asarray(flat_toks))
+
+    # quantized dispatch wire on the slow (outer) axis composes
+    hier_q = generate(
+        dc.replace(hier_cfg, ep_quant="int8"), params, prompt, n_steps,
+        mesh2x4, s_max=s_max, fd_config=fd,
+    )
+    np.testing.assert_array_equal(np.asarray(hier_q), np.asarray(flat_toks))
+
+
+def test_continuous_batcher_hier_ep(mesh2x4):
+    """The continuous batcher schedules against the hierarchical
+    deployment unchanged (the host loop is deployment-agnostic: decode
+    returns replicated [b, vocab] logits either way) — ragged slots,
+    admission, and completion match solo hier generates."""
+    from triton_dist_tpu.models import EPMoETransformerConfig, init_moe_params
+    from triton_dist_tpu.models.decode import ContinuousBatcher, Request
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+
+    b, s_max = 8, 16
+    cfg = EPMoETransformerConfig(
+        vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=b, seq=s_max, n_experts=8, topk=2, ep_outer="dp",
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+        gg_config=GroupGemmConfig(4, 32, 32),
+    )
+    params = init_moe_params(jax.random.PRNGKey(62), cfg)
+    fd = FlashDecodeConfig(block_s=4)
+    rng = np.random.default_rng(63)
+    reqs = [
+        Request(
+            prompt=list(rng.integers(0, cfg.vocab, rng.integers(1, 5))),
+            max_new_tokens=int(rng.integers(1, 4)), uid=i,
+        )
+        for i in range(10)
+    ]
+    batcher = ContinuousBatcher(cfg, params, mesh2x4, s_max=s_max, fd_config=fd)
+    for r in reqs:
+        batcher.submit(r)
+    done = dict(batcher.run())
+    assert set(done) == set(range(10))
+    for r in reqs:
+        solo = generate(
+            cfg, params,
+            jnp.asarray([r.prompt * 1], jnp.int32).reshape(1, -1).repeat(b, 0),
+            r.max_new_tokens, mesh2x4, s_max=s_max, fd_config=fd,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(solo)[0], np.asarray(done[r.uid], np.int32)
         )

@@ -43,23 +43,44 @@ from triton_dist_tpu.ops.flash_decode import (
 )
 
 
-def _specs_for(cfg: TransformerConfig, params: dict | None = None):
-    """Param specs for the serving path: dense, TP-MoE, or FLAT EP-MoE
-    (whole experts over the serving axis; decode slices its replicated
-    activations per PE and dispatches over the a2a — the reference's
-    headline inference configuration). HIERARCHICAL EP is rejected: its
-    two-phase dispatch needs a (node, local) mesh and the serving loop
-    runs a 1-axis mesh. `params`, when given, lets serving-quantized
-    expert banks (quantize_moe_serving_params) resolve their
-    scale-bearing spec tree."""
-    if isinstance(cfg, EPMoETransformerConfig) and cfg.ep_outer is not None:
-        raise NotImplementedError(
-            "hierarchical EP-MoE (ep_outer set) has no serving decode "
-            "path: the two-phase dispatch needs a (node, local) mesh and "
-            "serving runs a 1-axis mesh — use a flat EP config "
-            "(ep_outer=None) or a TP MoETransformerConfig"
+# Serving param specs are the model family's own (`specs_for`): dense,
+# TP-MoE, flat EP-MoE, or hierarchical EP-MoE — where, on the 2-axis
+# (ep_outer, axis) serving mesh, attention params come out TP over `axis`
+# and replicated over `ep_outer` (each outer group serves its own batch
+# slice — DP attention) while the expert banks shard over BOTH axes, the
+# reference's multi-node deployment (ep_a2a_layer.py:41,
+# test_ep_moe_inference.py). Pass the actual `params` so serving-quantized
+# expert banks (quantize_moe_serving_params) resolve their scale-bearing
+# spec tree.
+
+
+def _outer_of(cfg) -> str | None:
+    """The serving mesh's outer (node/slice) axis, or None on the flat
+    1-axis deployment."""
+    return getattr(cfg, "ep_outer", None)
+
+
+def _outer_dims(cfg) -> tuple[int, int]:
+    """(n_o, my_o) of the hierarchical deployment — (1, 0) when flat.
+    Call inside shard_map."""
+    o = _outer_of(cfg)
+    if o is None:
+        return 1, 0
+    return int(jax.lax.axis_size(o)), jax.lax.axis_index(o)
+
+
+def _mesh_outer(cfg, mesh: Mesh) -> int:
+    """Outer-axis size of the serving mesh (host side). Validates that a
+    hierarchical config actually got a 2-axis mesh."""
+    o = _outer_of(cfg)
+    if o is None:
+        return 1
+    if o not in mesh.shape:
+        raise ValueError(
+            f"hierarchical EP serving (ep_outer={o!r}) needs a mesh with "
+            f"axes ({o!r}, {cfg.axis!r}); got {dict(mesh.shape)}"
         )
-    return specs_for(cfg, params)
+    return mesh.shape[o]
 
 
 def _shard_of(s_max: int, n: int) -> int:
@@ -106,16 +127,24 @@ class KVCacheSpec:
 
     s_max: int
 
-    def init(self, cfg: TransformerConfig, n: int) -> dict:
+    def init(self, cfg: TransformerConfig, n: int, n_o: int = 1) -> dict:
         _shard_of(self.s_max, n)
+        if cfg.batch % n_o:
+            raise ValueError(
+                f"batch={cfg.batch} must divide over the {n_o} outer "
+                f"(node) groups — each group owns a batch slice"
+            )
         shape = (
             cfg.n_layers, cfg.batch, cfg.n_kv_heads, self.s_max, cfg.head_dim
         )
         return dict(k=jnp.zeros(shape, cfg.dtype), v=jnp.zeros(shape, cfg.dtype))
 
     def specs(self, cfg: TransformerConfig) -> dict:
-        t = cfg.axis
-        return dict(k=P(None, None, None, t, None), v=P(None, None, None, t, None))
+        # batch over the outer (node) axis when hierarchical — each outer
+        # group's attention serves only its own slots (DP attention);
+        # sequence over the inner axis as always (SP decode)
+        t, o = cfg.axis, _outer_of(cfg)
+        return dict(k=P(None, o, None, t, None), v=P(None, o, None, t, None))
 
     def pre_step(self, cfg, cache: dict, pos, me, n: int) -> dict:
         return cache
@@ -166,7 +195,7 @@ class PagedKVCacheSpec:
     # paged kernel path are identical either way.
     static_table: bool = False
 
-    def _geometry(self, cfg, n: int) -> tuple[int, int]:
+    def _geometry(self, cfg, n: int, n_o: int = 1) -> tuple[int, int]:
         s_shard = _shard_of(self.s_max, n)
         if s_shard % self.page_size != 0:
             # a non-dividing page size would let block_table gathers clamp
@@ -175,38 +204,50 @@ class PagedKVCacheSpec:
                 f"page_size={self.page_size} must divide the per-PE "
                 f"sequence shard {s_shard}"
             )
+        if cfg.batch % n_o:
+            raise ValueError(
+                f"batch={cfg.batch} must divide over the {n_o} outer "
+                f"(node) groups — each group owns a batch slice"
+            )
         pages_per_seq = s_shard // self.page_size
-        return pages_per_seq, cfg.batch * pages_per_seq  # local pool size
+        # local pool: one PE covers its OUTER GROUP's batch slice × its
+        # inner sequence shard
+        return pages_per_seq, (cfg.batch // n_o) * pages_per_seq
 
-    def init(self, cfg: TransformerConfig, n: int) -> dict:
-        pages_per_seq, n_pages = self._geometry(cfg, n)
+    def init(self, cfg: TransformerConfig, n: int, n_o: int = 1) -> dict:
+        pages_per_seq, n_pages = self._geometry(cfg, n, n_o)
+        b_att = cfg.batch // n_o   # per-outer-group batch slice
+        w = n_o * n                # total PEs
         shape = (
-            cfg.n_layers, n * n_pages, cfg.n_kv_heads, self.page_size,
+            cfg.n_layers, w * n_pages, cfg.n_kv_heads, self.page_size,
             cfg.head_dim,
         )
         if self.static_table:
             bt = jnp.broadcast_to(
                 (
-                    jnp.arange(cfg.batch, dtype=jnp.int32)[:, None]
+                    jnp.arange(b_att, dtype=jnp.int32)[:, None]
                     * pages_per_seq
                     + jnp.arange(pages_per_seq, dtype=jnp.int32)[None, :]
                 ),
-                (n, cfg.batch, pages_per_seq),
+                (w, b_att, pages_per_seq),
             )
         else:
-            bt = jnp.zeros((n, cfg.batch, pages_per_seq), jnp.int32)
+            bt = jnp.zeros((w, b_att, pages_per_seq), jnp.int32)
         return dict(
             k=jnp.zeros(shape, cfg.dtype),
             v=jnp.zeros(shape, cfg.dtype),
             block_table=bt,
-            n_alloc=jnp.zeros((n,), jnp.int32),
+            n_alloc=jnp.zeros((w,), jnp.int32),
         )
 
     def specs(self, cfg: TransformerConfig) -> dict:
-        t = cfg.axis
+        # the pool / table / allocator are PER-PE over the whole mesh:
+        # composite (outer, inner) sharding when hierarchical
+        t, o = cfg.axis, _outer_of(cfg)
+        pe = t if o is None else (o, t)
         return dict(
-            k=P(None, t, None, None, None), v=P(None, t, None, None, None),
-            block_table=P(t, None, None), n_alloc=P(t),
+            k=P(None, pe, None, None, None), v=P(None, pe, None, None, None),
+            block_table=P(pe, None, None), n_alloc=P(pe),
         )
 
     def pre_step(self, cfg, cache: dict, pos_b, me, n: int) -> dict:
@@ -281,8 +322,22 @@ def decode_step(
 ) -> tuple[jax.Array, dict]:
     """One decode step (call inside ``jax.shard_map``): returns
     ``(logits [b, vocab], new_cache)``. The cache layout and attention
-    kernel come from `spec` (contiguous or paged)."""
-    c = cfg
+    kernel come from `spec` (contiguous or paged).
+
+    HIERARCHICAL deployment (``cfg.ep_outer`` set, 2-axis mesh): each
+    outer group runs DP attention over ITS batch slice (cache batch dim
+    outer-sharded), the EP MLP's two-phase dispatch spans the whole mesh,
+    and the returned logits are re-gathered to the replicated ``[b,
+    vocab]`` layout — the host scheduling loop is deployment-agnostic."""
+    n_o, my_o = _outer_dims(cfg)
+    if cfg.batch % n_o:
+        raise ValueError(
+            f"batch={cfg.batch} must divide over the {n_o} outer groups"
+        )
+    b_att = cfg.batch // n_o
+    # everything below this line is per-outer-group: c.batch is the
+    # group's batch slice (identical to cfg on the flat deployment)
+    c = dataclasses.replace(cfg, batch=b_att) if n_o > 1 else cfg
     n = int(jax.lax.axis_size(c.axis))
     me = jax.lax.axis_index(c.axis)
     g = c.n_q_heads // c.n_kv_heads
@@ -290,8 +345,13 @@ def decode_step(
     # the tiled head all_gather below needs whole kv groups per PE
     assert c.n_kv_heads % n == 0, (c.n_kv_heads, n)
 
-    x = params["embed"][tokens]  # [b, H] replicated
-    pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (c.batch,))
+    pos_g = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (cfg.batch,))
+    if n_o > 1:
+        tokens = jax.lax.dynamic_slice_in_dim(tokens, my_o * b_att, b_att, 0)
+        pos_b = jax.lax.dynamic_slice_in_dim(pos_g, my_o * b_att, b_att, 0)
+    else:
+        pos_b = pos_g
+    x = params["embed"][tokens]  # [b_att, H] replicated per group
     cache = spec.pre_step(c, cache, pos_b, me, n)
 
     for li, p in enumerate(params["layers"]):
@@ -325,25 +385,31 @@ def decode_step(
         if isinstance(c, EPMoETransformerConfig):
             # EP serving decode (the reference's headline inference
             # configuration — its LL a2a IS decode-shaped EP dispatch,
-            # README.md:87): decode activations are replicated, so each
-            # PE takes its token slice, dispatches over the flat EP axis
-            # to the expert owners, and the combined shard all-gathers
-            # back to the replicated layout. Flat only — _specs_for
-            # rejects hierarchical EP (serving meshes here are 1-axis).
+            # README.md:87): each PE takes its token slice of the group's
+            # replicated activations, dispatches over the EP transport to
+            # the expert owners, and the combined shard all-gathers back.
+            # HIERARCHICAL (ep_outer set): sources are every (outer,
+            # inner) PE — the group's slice divides again over the inner
+            # axis — and the two-phase dispatch (node-dedup over the slow
+            # axis, expert scatter on the fast one) spans the whole mesh:
+            # the reference's 4-node × 8-GPU serving shape
+            # (test_ep_moe_inference.py) with DCN as the outer axis.
             from triton_dist_tpu.models.tp_transformer import ep_moe_apply
 
             if c.batch % n:
                 raise ValueError(
                     f"EP serving decode shards the batch over the "
-                    f"{c.axis!r} axis: batch={c.batch} must divide evenly "
-                    f"over {n} PEs"
+                    f"{c.axis!r} axis: per-group batch={c.batch} must "
+                    f"divide evenly over {n} PEs"
                 )
             b_loc = c.batch // n
             h_loc = jax.lax.dynamic_slice_in_dim(h, me * b_loc, b_loc, 0)
             # per-(src, dest) slab worst case: a src PE holds b_loc
-            # tokens, each with topk assignments
+            # tokens, each with topk assignments (flat) / at most one
+            # deduplicated copy per destination node (hierarchical)
             y_loc = ep_moe_apply(
-                c, h_loc, p, c.ep_max_m or b_loc * c.topk,
+                c, h_loc, p,
+                c.ep_max_m or (b_loc if n_o > 1 else b_loc * c.topk),
                 interpret=interpret,
             )
             y = jax.lax.all_gather(y_loc, c.axis, axis=0, tiled=True)
@@ -390,8 +456,15 @@ def decode_step(
             x = x + jax.lax.psum(act @ p["w_down"], c.axis)
 
     x = rmsnorm(x, params["final_norm"], c.norm_eps)
-    logits_loc = x @ params["lm_head"]                       # [b, V/n]
+    logits_loc = x @ params["lm_head"]                       # [b_att, V/n]
     logits = jax.lax.all_gather(logits_loc, c.axis, axis=1, tiled=True)
+    if n_o > 1:
+        # back to the replicated [b, V] layout the host loop expects:
+        # outer groups are batch-major, so a leading-dim gather restores
+        # global slot order
+        logits = jax.lax.all_gather(
+            logits, _outer_of(cfg), axis=0, tiled=True
+        )
     return logits, cache
 
 
@@ -424,6 +497,14 @@ def generate(
     block, so ``fd_config`` (whose ``block_s`` tiles the contiguous
     kernel) is not accepted alongside ``page_size``.
 
+    Hierarchical EP configs (``cfg.ep_outer`` set) need `mesh` to carry
+    both axes ``(ep_outer, axis)``: batch and KV cache shard over the
+    outer axis (DP attention per node group), sequence over the inner,
+    and the MoE layer spans every device via the two-phase dispatch —
+    the reference's multi-node serving deployment
+    (test_ep_moe_inference.py). The host-side contract (replicated
+    prompt in, [b, n_steps] tokens out) is deployment-independent.
+
     Host-level entry; jits ONE fused program that lax.scans decode_step
     over all positions (prompt phase ignores the model's predictions)."""
     b, prompt_len = prompt.shape
@@ -447,15 +528,17 @@ def generate(
         if page_size else KVCacheSpec(s_max)
     )
     n = mesh.shape[cfg.axis]
+    n_o = _mesh_outer(cfg, mesh)
     if prefill:
-        if (b * prompt_len) % n:
+        if (b * prompt_len) % (n * n_o):
             raise ValueError(
                 f"prefill needs b*prompt_len={b * prompt_len} divisible "
-                f"over {n} PEs (the prompt shard is the model's token shard)"
+                f"over {n * n_o} PEs (the prompt shard is the model's "
+                f"token shard)"
             )
     cache = jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        spec.init(cfg, n), spec.specs(cfg),
+        spec.init(cfg, n, n_o), spec.specs(cfg),
     )
     step = functools.partial(
         decode_step, cfg, spec=spec, fd_config=fd_config, interpret=interpret,
@@ -476,8 +559,12 @@ def generate(
         return outs  # [prompt_len + n_steps - 1, b]
 
     def run_prefill(params, cache, prompt):
-        pcfg = dataclasses.replace(cfg, seq=prompt_len)
-        prompt_loc = _prompt_shard(prompt, b, prompt_len, cfg.axis)
+        # per-group batch in the forward cfg: the model processes its
+        # outer group's sequences only (the prompt shard is outer-major)
+        pcfg = dataclasses.replace(
+            cfg, seq=prompt_len, batch=b // n_o
+        )
+        prompt_loc = _prompt_shard(prompt, b, prompt_len, cfg)
         cache, last = prefill_cache(
             pcfg, params, cache, prompt_loc, spec, s_max
         )
@@ -495,7 +582,7 @@ def generate(
         return jnp.concatenate([tok0[None], outs], axis=0)  # [n_steps, b]
 
     cache_specs = spec.specs(cfg)
-    pspecs = _specs_for(cfg, params)
+    pspecs = specs_for(cfg, params)
     from triton_dist_tpu.ops.common import jit_shard_map
 
     out = jit_shard_map(
@@ -590,6 +677,8 @@ class ContinuousBatcher:
     ):
         self.cfg, self.mesh, self.s_max = cfg, mesh, s_max
         n = mesh.shape[cfg.axis]
+        n_o = _mesh_outer(cfg, mesh)
+        self._n_o = n_o
         if page_size and fd_config is not None:
             raise ValueError(
                 "fd_config tiles the contiguous kernel; with page_size the "
@@ -606,11 +695,11 @@ class ContinuousBatcher:
         )
         self.cache = jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-            self.spec.init(cfg, n), self.spec.specs(cfg),
+            self.spec.init(cfg, n, n_o), self.spec.specs(cfg),
         )
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-            params, _specs_for(cfg, params),
+            params, specs_for(cfg, params),
         )
         step = functools.partial(
             decode_step, cfg, spec=self.spec, fd_config=fd_config,
@@ -628,7 +717,7 @@ class ContinuousBatcher:
         self._step = jit_shard_map(
             step, mesh,
             (
-                _specs_for(cfg, params), self.spec.specs(cfg), P(None),
+                specs_for(cfg, params), self.spec.specs(cfg), P(None),
                 P(None),
             ),
             (P(None, None), self.spec.specs(cfg)),
@@ -667,10 +756,10 @@ class ContinuousBatcher:
             return self._prefill_progs[bucket]
         cfg, mesh, spec, s_max = self.cfg, self.mesh, self.spec, self.s_max
         b = cfg.batch
-        pcfg = dataclasses.replace(cfg, seq=bucket)
+        pcfg = dataclasses.replace(cfg, seq=bucket, batch=b // self._n_o)
 
         def fn(params, cache, prompt, mask, pick):
-            prompt_loc = _prompt_shard(prompt, b, bucket, cfg.axis)
+            prompt_loc = _prompt_shard(prompt, b, bucket, cfg)
             return prefill_cache(
                 pcfg, params, cache, prompt_loc, spec, s_max,
                 slot_mask=mask, pick=pick,
@@ -681,7 +770,7 @@ class ContinuousBatcher:
         prog = jit_shard_map(
             fn, mesh,
             (
-                _specs_for(cfg, self.params), spec.specs(cfg), P(None, None),
+                specs_for(cfg, self.params), spec.specs(cfg), P(None, None),
                 P(None), P(None),
             ),
             (spec.specs(cfg), P(None, None)),
@@ -692,7 +781,7 @@ class ContinuousBatcher:
         return prog
 
     def _bucket(self, length: int) -> int:
-        n = self.mesh.shape[self.cfg.axis]
+        n = self.mesh.shape[self.cfg.axis] * self._n_o
         bucket = 1
         while bucket < self.s_max and (
             bucket < length or (self.cfg.batch * bucket) % n
@@ -829,15 +918,19 @@ class ContinuousBatcher:
         return out
 
 
-def _prompt_shard(prompt, b, length, axis):
+def _prompt_shard(prompt, b, length, cfg):
     """This PE's contiguous slice of the b-major flattened prompt — the
     model's token sharding (shared by generate's prefill and the
-    batcher's admission program)."""
-    n = int(jax.lax.axis_size(axis))
-    me = jax.lax.axis_index(axis)
-    m_loc = b * length // n
+    batcher's admission program). Hierarchical deployments shard over
+    BOTH axes outer-major: outer group ``o``'s PEs cover exactly
+    sequences ``[o*b_att, (o+1)*b_att)`` — the group's own slots."""
+    n = int(jax.lax.axis_size(cfg.axis))
+    me = jax.lax.axis_index(cfg.axis)
+    n_o, my_o = _outer_dims(cfg)
+    m_loc = b * length // (n * n_o)
+    r = my_o * n + me
     return jax.lax.dynamic_slice_in_dim(
-        prompt.reshape(-1), me * m_loc, m_loc, 0
+        prompt.reshape(-1), r * m_loc, m_loc, 0
     )
 
 
@@ -855,7 +948,11 @@ def prefill_cache(
     scatter into the pool (slot-masked admission gates the scatter
     indices, the paged discipline).
 
-    prompt_loc: ``[b*L/n]`` int32 flattened prompt shard (b-major).
+    prompt_loc: ``[b*L/world]`` int32 flattened prompt shard (b-major;
+    ``world`` = all PEs — outer-major over a hierarchical mesh). On a
+    hierarchical deployment ``cfg.batch`` is the outer GROUP's batch
+    slice and ``slot_mask``/``pick`` arrive global (sliced here); the
+    returned ``last`` is always the global ``[b_global, vocab]``.
     ``slot_mask [b] bool`` restricts the cache write to chosen sequences
     (continuous-batching admission: one slot prefills while its
     neighbors' cache rows must stay untouched); padded prompt positions
@@ -883,6 +980,15 @@ def prefill_cache(
     me = jax.lax.axis_index(c.axis)
     b, L = c.batch, c.seq
     s_shard = _shard_of(s_max, n)
+    # hierarchical deployment: `c.batch` is already the outer group's
+    # batch slice (the caller's pcfg); slot_mask/pick arrive GLOBAL and
+    # slice down to this group's slots here
+    n_o, my_o = _outer_dims(c)
+    if n_o > 1:
+        if slot_mask is not None:
+            slot_mask = jax.lax.dynamic_slice_in_dim(slot_mask, my_o * b, b, 0)
+        if pick is not None:
+            pick = jax.lax.dynamic_slice_in_dim(pick, my_o * b, b, 0)
 
     if isinstance(c, EPMoETransformerConfig):
         model_cls = EPMoETransformer  # expert-parallel FFN in the forward
@@ -950,4 +1056,7 @@ def prefill_cache(
     rows = jnp.arange(b, dtype=jnp.int32) * L + jnp.clip(pick, 0, L - 1)
     sel = logits_loc[rows]                            # [b, V/n]
     last = jax.lax.all_gather(sel, c.axis, axis=1, tiled=True)  # [b, V]
+    if n_o > 1:
+        # restore the global batch layout the host loop schedules against
+        last = jax.lax.all_gather(last, _outer_of(c), axis=0, tiled=True)
     return cache, last

@@ -21,6 +21,7 @@ from typing import Any
 import jax.numpy as jnp
 
 from triton_dist_tpu.models.tp_transformer import (
+    EPMoETransformerConfig,
     MoETransformerConfig,
     TransformerConfig,
 )
@@ -62,20 +63,44 @@ def preset(
     n_layers: int | None = None,
     dtype: Any = jnp.bfloat16,
     tp_check: int | None = None,
+    ep: bool = False,
+    ep_outer: str | None = None,
     **overrides: Any,
 ) -> TransformerConfig:
     """Build the named model's config. `n_layers` defaults to 1 (a single
     decoder block — the unit the reference's per-op benchmarks compose);
     pass the real depth for full-model runs. Extra keyword arguments
-    override any config field (e.g. ``ag_config=...``)."""
+    override any config field (e.g. ``ag_config=...``).
+
+    MoE presets additionally take the deployment: ``ep=True`` builds the
+    EXPERT-parallel config (whole experts per PE, tokens over the a2a —
+    the reference's serving deployment) instead of the tensor-parallel
+    one; ``ep_outer="dcn"`` further selects the hierarchical two-phase
+    dispatch over an (outer, inner) mesh (≙ the reference's multi-node
+    EPAll2AllLayer). A name suffix spells the same thing for CLI
+    callers: ``"mixtral-8x7b:ep"`` / ``"mixtral-8x7b:ep-hier"``."""
+    if name.endswith(":ep-hier"):
+        name, ep, ep_outer = name[: -len(":ep-hier")], True, ep_outer or "dcn"
+    elif name.endswith(":ep"):
+        name, ep = name[: -len(":ep")], True
     if name in _MOE:
         h, f, q, kv, d, vocab, n_exp, topk = _MOE[name]
-        cfg: TransformerConfig = MoETransformerConfig(
+        moe_cls = EPMoETransformerConfig if (ep or ep_outer) else (
+            MoETransformerConfig
+        )
+        if ep_outer is not None:
+            overrides = dict(overrides, ep_outer=ep_outer)
+        cfg: TransformerConfig = moe_cls(
             vocab=vocab, hidden=h, ffn=f, n_layers=n_layers or 1,
             n_q_heads=q, n_kv_heads=kv, head_dim=d, batch=batch, seq=seq,
             dtype=dtype, n_experts=n_exp, topk=topk, **overrides,
         )
     elif name in _DENSE:
+        if ep or ep_outer:
+            raise ValueError(
+                f"preset {name!r} is dense — expert parallelism applies "
+                f"to MoE presets only ({sorted(_MOE)})"
+            )
         h, f, q, kv, d, vocab = _DENSE[name]
         cfg = TransformerConfig(
             vocab=vocab, hidden=h, ffn=f, n_layers=n_layers or 1,
