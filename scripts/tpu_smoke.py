@@ -18,7 +18,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec as P
 
 
 def _poison_arena(interp: bool) -> None:
@@ -52,6 +52,20 @@ def main() -> int:
         if it == 0:
             names = [n for n, _ in oks]
         _poison_arena(interp)
+    # Race shaking (≙ reference allgather.py:72-76): when >1 device is
+    # visible, one extra pass drives the fused comm kernels over the FULL
+    # device mesh with per-PE busy delays armed (config.debug_comm_delay)
+    # — run_pass itself is world-1-shaped, where the knob no-ops by design.
+    if len(jax.devices()) > 1:
+        print(
+            f"[tpu_smoke] shake pass: fused comm kernels over all "
+            f"{len(jax.devices())} devices with per-PE delays armed"
+        )
+        shake_fails = run_shake_pass(interp)
+        names.append("shake_pass")
+        worst["shake_pass"] = 0.0
+        if shake_fails:
+            fails["shake_pass"] = shake_fails
     n_fail = sum(fails.values())
     for name in names:
         state = f"FAIL x{fails[name]}" if fails.get(name) else "OK"
@@ -62,6 +76,72 @@ def main() -> int:
         f"{jax.devices()[0].device_kind}"
     )
     return 1 if n_fail else 0
+
+
+def run_shake_pass(interp) -> int:
+    """Fused comm kernels over the FULL device mesh with per-PE busy
+    delays armed — the hardware race-shaking pass (exact goldens; returns
+    the number of failed checks). Sized small: the point is timing skew
+    across real ICI, not throughput."""
+    from triton_dist_tpu import config as tdt_config
+    from triton_dist_tpu.ops.allgather import all_gather_op
+    from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm_op
+    from triton_dist_tpu.ops.all_to_all import fast_all_to_all_op
+    from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs_op
+
+    n = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("tp",))
+    put = lambda x, s: jax.device_put(  # noqa: E731
+        x, jax.sharding.NamedSharding(mesh, P(*s))
+    )
+    m_loc, kd, nd = (8, 32, n * 8) if interp else (128, 512, n * 256)
+    key = jax.random.PRNGKey(7777)
+    x = put(jax.random.normal(key, (n * m_loc, kd), jnp.float32), ("tp", None))
+    b = put(
+        jax.random.normal(jax.random.fold_in(key, 1), (kd, nd), jnp.float32) / 8,
+        (None, "tp"),
+    )
+    a2 = put(
+        jax.random.normal(jax.random.fold_in(key, 2), (n * m_loc, n * 8), jnp.float32) / 8,
+        (None, "tp"),
+    )
+    b2 = put(
+        jax.random.normal(jax.random.fold_in(key, 3), (n * 8, nd), jnp.float32) / 8,
+        ("tp", None),
+    )
+    max_m = 8
+    toks = put(
+        jax.random.normal(jax.random.fold_in(key, 4), (n, n, max_m, 64), jnp.float32),
+        ("tp", None, None, None),
+    )
+    splits = put(jnp.full((n, n), max_m, jnp.int32), ("tp", None))
+
+    fails = 0
+    tdt_config.update(
+        debug_comm_delay=int(os.environ.get("TDT_SMOKE_SHAKE_DELAY", "4096"))
+    )
+    try:
+        xg = np.asarray(x, np.float32)
+        got = np.asarray(all_gather_op(x, mesh), np.float32)
+        fails += int(not np.array_equal(got, xg))
+        got = np.asarray(
+            ag_gemm_op(x, b, mesh, config=AGGemmConfig(8, 8, 16)), np.float32
+        )
+        ok = np.allclose(got, xg @ np.asarray(b, np.float32), atol=1e-2, rtol=1e-2)
+        fails += int(not ok)
+        got = np.asarray(
+            gemm_rs_op(a2, b2, mesh, config=GemmRSConfig(8, 8, 16)), np.float32
+        )
+        gold = np.asarray(a2, np.float32) @ np.asarray(b2, np.float32)
+        fails += int(not np.allclose(got, gold, atol=1e-2, rtol=1e-2))
+        rt, rs = fast_all_to_all_op(toks, splits, mesh)
+        want = np.asarray(toks, np.float32).swapaxes(0, 1)
+        fails += int(not np.array_equal(np.asarray(rt, np.float32), want))
+    finally:
+        tdt_config.update(debug_comm_delay=0)
+    if fails:
+        print(f"[tpu_smoke] shake pass: {fails} check(s) FAILED")
+    return fails
 
 
 def run_pass(key, interp, it, worst, fails):

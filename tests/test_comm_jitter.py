@@ -1,0 +1,93 @@
+"""Hardware race shaking (config.debug_comm_delay — VERDICT r4 #6,
+≙ the reference's random comm-stream sleeps, allgather.py:72-76): with
+the per-PE busy delay armed, every fused comm kernel must still produce
+EXACT results under the race detector. On the interpreter this validates
+the knob's plumbing (delay traced, semaphore consumption legal, goldens
+unchanged); its real shaking value is on multi-chip hardware, where the
+same flag skews physical DMA issue timing (scripts/tpu_smoke.py runs a
+delayed pass when chips allow)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu import config as tdt_config
+
+
+@pytest.fixture
+def jitter_on():
+    tdt_config.update(debug_comm_delay=8, detect_races=True)
+    yield
+    tdt_config.update(debug_comm_delay=0, detect_races=False)
+
+
+def test_fused_kernels_exact_under_jitter(mesh8, jitter_on):
+    from triton_dist_tpu.ops.allgather import all_gather_op
+    from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm_op
+    from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs_op
+    from triton_dist_tpu.ops.reduce_scatter import reduce_scatter_op
+
+    n, m_loc, kd, nd = 8, 8, 24, 8 * 5
+    x = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(0), (n * m_loc, kd), jnp.float32),
+        NamedSharding(mesh8, P("tp", None)),
+    )
+    b = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(1), (kd, nd), jnp.float32) / 8,
+        NamedSharding(mesh8, P(None, "tp")),
+    )
+    xg = np.asarray(x, np.float32)
+
+    got = np.asarray(all_gather_op(x, mesh8), np.float32)
+    np.testing.assert_array_equal(got, xg)
+
+    got = np.asarray(
+        ag_gemm_op(x, b, mesh8, config=AGGemmConfig(8, 8, 8)), np.float32
+    )
+    np.testing.assert_allclose(got, xg @ np.asarray(b, np.float32), atol=1e-3, rtol=1e-3)
+
+    xr = jax.random.normal(jax.random.PRNGKey(4), (n, 16, 128), jnp.float32)
+    rs = np.asarray(reduce_scatter_op(xr, mesh8), np.float32)
+    np.testing.assert_allclose(
+        rs, np.asarray(xr, np.float32).sum(0), atol=1e-3, rtol=1e-3
+    )
+
+    a2 = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(2), (n * m_loc, 8 * n), jnp.float32) / 8,
+        NamedSharding(mesh8, P(None, "tp")),
+    )
+    b2 = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(3), (8 * n, nd), jnp.float32) / 8,
+        NamedSharding(mesh8, P("tp", None)),
+    )
+    got = np.asarray(
+        gemm_rs_op(a2, b2, mesh8, config=GemmRSConfig(8, 8, 8)), np.float32
+    )
+    gold = np.asarray(a2, np.float32) @ np.asarray(b2, np.float32)
+    np.testing.assert_allclose(got, gold[: len(got)], atol=1e-2, rtol=1e-2)
+
+
+def test_jitter_noop_when_disabled(mesh8):
+    """delay=0 must trace NOTHING (the knob is free in production)."""
+    from triton_dist_tpu.shmem import device as shmem
+
+    calls = []
+    orig = jax.lax.fori_loop
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    assert tdt_config.get_config().debug_comm_delay == 0
+    jax.lax.fori_loop = spy
+    try:
+        # direct call outside a kernel: must return before touching
+        # anything trace-level
+        shmem.comm_jitter("tp")
+    finally:
+        jax.lax.fori_loop = orig
+    assert not calls

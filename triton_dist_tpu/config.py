@@ -38,6 +38,16 @@ class Config:
     debug_ep_overflow: bool = False
     # Print autotuner decisions.
     verbose_autotune: bool = bool(int(os.environ.get("TDT_VERBOSE_AUTOTUNE", "0")))
+    # Hardware race shaking (≙ the reference's random comm-stream sleeps,
+    # allgather.py:72-76): > 0 inserts a per-PE pseudo-random busy delay
+    # of roughly this many VPU loop iterations at the top of every fused
+    # comm kernel, skewing issue timing so arrival-order and
+    # barrier-aliasing assumptions get exercised under timing variance
+    # the interpreter's happens-before detector cannot model (its
+    # schedule is data-dependency-driven, not time-driven). Debug/stress
+    # only — tpu_smoke.py runs a delayed pass on real chips; keep 0 in
+    # production. Env: TDT_COMM_DELAY.
+    debug_comm_delay: int = int(os.environ.get("TDT_COMM_DELAY", "0"))
     # USER-DECLARED mesh axes whose hops cross TPU slice boundaries
     # (Multislice DCN, not ICI). Remote-DMA kernels cannot reach across
     # slices, so collective ops lower these axes to XLA collectives

@@ -65,6 +65,8 @@ def _a2a_kernel(
     me = shmem.my_pe(axis)
     max_m = send_ref.shape[1]
     rows = max_m // chunks
+    # race shaking (no-op unless config.debug_comm_delay)
+    shmem.comm_jitter(axis, salt=5)
     # Own slab moves locally; both copies ride the local DMA engines while
     # the remote puts below are in flight.
     c1 = pltpu.make_async_copy(send_ref.at[me], recv_ref.at[me], copy_sems.at[0])

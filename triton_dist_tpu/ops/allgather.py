@@ -70,6 +70,9 @@ def _ring_1d_kernel(x_ref, out_ref, copy_sem, send_sems, recv_sems, *, axis: str
     local = pltpu.make_async_copy(x_ref, out_ref.at[pl.ds(me * m, m)], copy_sem)
     local.start()
     local.wait()
+    # race shaking (no-op unless config.debug_comm_delay): per-PE skew of
+    # barrier entry + DMA issue
+    shmem.comm_jitter(axis, salt=1)
     shmem.barrier_all(axis)
     right = jax.lax.rem(me + 1, n)
     descs = []
@@ -95,6 +98,7 @@ def _ring_bidir_kernel(
     local = pltpu.make_async_copy(x_ref, out_ref.at[pl.ds(me * m, m)], copy_sem)
     local.start()
     local.wait()
+    shmem.comm_jitter(axis, salt=2)
     shmem.barrier_all(axis)
     right = jax.lax.rem(me + 1, n)
     left = jax.lax.rem(me - 1 + n, n)
@@ -134,6 +138,7 @@ def _full_mesh_push_kernel(x_ref, out_ref, copy_sem, send_sems, recv_sems, *, ax
     local = pltpu.make_async_copy(x_ref, out_ref.at[pl.ds(me * m, m)], copy_sem)
     local.start()
     local.wait()
+    shmem.comm_jitter(axis, salt=3)
     shmem.barrier_all(axis)
     my_sl = pl.ds(me * m, m)
     descs = []
@@ -181,6 +186,7 @@ def _ring_2d_kernel(
     local = pltpu.make_async_copy(x_ref, out_ref.at[slot(me_o, me_i)], copy_sem)
     local.start()
     local.wait()
+    shmem.comm_jitter((outer, inner), salt=4)
     shmem.barrier_all((outer, inner))
 
     right_i = jax.lax.rem(me_i + 1, n_i)

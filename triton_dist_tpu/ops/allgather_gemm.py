@@ -73,6 +73,8 @@ def _ag_gemm_kernel(
     local = pltpu.make_async_copy(a_ref, ag_ref.at[pl.ds(me * m_loc, m_loc)], copy_sem)
     local.start()
     local.wait()
+    # race shaking (no-op unless config.debug_comm_delay)
+    shmem.comm_jitter(axis, salt=8)
     shmem.barrier_all(axis)
 
     right = jax.lax.rem(me + 1, n)
@@ -122,6 +124,7 @@ def _ag_gemm_2d_kernel(
     local = pltpu.make_async_copy(a_ref, ag_ref.at[slot(me_o, me_i)], copy_sem)
     local.start()
     local.wait()
+    shmem.comm_jitter((outer, inner), salt=9)
     shmem.barrier_all((outer, inner))
 
     right_i = jax.lax.rem(me_i + 1, n_i)

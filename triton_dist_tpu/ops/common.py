@@ -203,7 +203,14 @@ def jit_shard_map(
     that changes the traced program besides the mesh/specs (op name, config,
     method, static dims); argument shapes/dtypes are handled by jit itself.
     """
-    cache_key = (mesh, str(in_specs), str(out_specs), donate_argnums, key)
+    from triton_dist_tpu import config as _tdt_config
+
+    cache_key = (
+        mesh, str(in_specs), str(out_specs), donate_argnums, key,
+        # trace-time config that changes the kernel program (a cached
+        # un-delayed program must not serve a race-shaking run)
+        _tdt_config.get_config().debug_comm_delay,
+    )
     hit = _jit_cache.get(cache_key)
     if hit is None:
         hit = jax.jit(

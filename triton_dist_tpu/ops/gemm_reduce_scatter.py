@@ -90,6 +90,8 @@ def _gemm_rs_scatter_kernel(
         bm, bn, bk, m_loc, n_dim, k_loc, acc_ref, out_dtype, n - 1
     )
 
+    # race shaking (no-op unless config.debug_comm_delay)
+    shmem.comm_jitter(axis, salt=10)
     # All PEs must be inside the kernel before any chunk may land in their
     # slots (≙ the barrier before the scatter stage, reduce_scatter.py:604).
     shmem.barrier_all(axis)
@@ -134,6 +136,7 @@ def _gemm_rs_ring_kernel(
     gemm = gemm_add_pipeline(bm, bn, bk, m_loc, n_dim, k_loc, acc_ref, out_dtype, 0)
     gemm_add = gemm_add_pipeline(bm, bn, bk, m_loc, n_dim, k_loc, acc_ref, out_dtype, 1)
 
+    shmem.comm_jitter(axis, salt=11)
     shmem.barrier_all(axis)
     right = jax.lax.rem(me + 1, n)
 

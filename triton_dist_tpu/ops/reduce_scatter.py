@@ -99,6 +99,8 @@ def _ring_rs_kernel(
     bn = pick_block(n_dim, cfg.block_n)
     add = _add2_pipeline(bm, bn, m_loc, n_dim, out_ref.dtype)
 
+    # race shaking (no-op unless config.debug_comm_delay)
+    shmem.comm_jitter(axis, salt=6)
     # All PEs must be inside the kernel before any remote DMA may land in
     # their landing slots (≙ barrier_all before scatter, reference
     # reduce_scatter.py:604-610).
@@ -142,6 +144,7 @@ def _scatter_reduce_kernel(
     m_loc, n_dim = out_ref.shape
     bm = pick_block(m_loc, cfg.block_m)
     bn = pick_block(n_dim, cfg.block_n)
+    shmem.comm_jitter(axis, salt=7)
     shmem.barrier_all(axis)
 
     # Push chunk me+d of our partial straight to its owner. Landing slot

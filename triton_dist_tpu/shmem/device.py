@@ -100,6 +100,58 @@ def pe_dev_id(axis: str | Sequence[str], pe):
 
 
 # ---------------------------------------------------------------------------
+# Hardware race shaking (≙ reference allgather.py:72-76 — random sleeps
+# injected into the comm streams to stress producer/consumer sync)
+# ---------------------------------------------------------------------------
+
+def comm_jitter(axis: str | Sequence[str], salt: int = 0):
+    """Per-PE pseudo-random busy delay at a comm point inside a kernel
+    body. No-op (traces nothing) unless ``config.debug_comm_delay > 0``.
+
+    The reference shakes races by sleeping its producer streams random
+    multi-second amounts (``allgather.py:72-76``) so consumer-side sync
+    bugs surface as wrong answers instead of lucky timing. The TPU
+    analogue: a VPU busy loop whose iteration count varies per (PE,
+    salt), run at the top of each fused comm kernel — PEs then issue
+    their DMAs at visibly different times, exercising arrival-order
+    assumptions, barrier aliasing across launches, and semaphore
+    versioning under timing variance the interpreter's happens-before
+    detector structurally cannot create (its schedule follows data
+    dependencies, not wall time).
+
+    The loop result is consumed as a data-dependent ZERO increment on
+    the kernel's barrier semaphore: side-effecting, so neither XLA nor
+    Mosaic can dead-code the delay; legal in every memory space (no ref
+    access at all); and invisible to the barrier protocol regardless of
+    concurrency (+0 is the identity whatever the peers are doing).
+    Callable only from kernels that own a collective_id — i.e. exactly
+    the barrier-bearing fused comm kernels this knob exists to shake."""
+    from triton_dist_tpu import config as _tdt_config
+
+    base = int(_tdt_config.get_config().debug_comm_delay)
+    if base <= 0:
+        return
+    if n_pes(axis) == 1:
+        # match barrier_all's world-1 early-out: a world-1 kernel carries
+        # no collective_id, so touching the barrier semaphore would be a
+        # Mosaic error — and there is nothing to shake anyway
+        return
+    me = my_pe(axis)
+    # deterministic 1×–8× spread per (PE, salt); primes decorrelate PEs
+    iters = base * (1 + jax.lax.rem(me * 7919 + jnp.int32(salt) * 104729, 8))
+
+    def body(_, acc):
+        return acc + jnp.sin(acc)  # non-foldable transcendental chain
+
+    # the seed keeps acc finite by construction (|sin| <= 1, bounded
+    # growth), so acc * 0.0 is exactly 0 — never NaN
+    acc = jax.lax.fori_loop(0, iters, body, me.astype(jnp.float32) * 1e-3)
+    pltpu.semaphore_signal(
+        pltpu.get_barrier_semaphore(), (acc * 0.0).astype(jnp.int32)
+    )
+
+
+# ---------------------------------------------------------------------------
 # One-sided puts (≙ putmem_* family)
 # ---------------------------------------------------------------------------
 
