@@ -597,6 +597,11 @@ def tp_moe_mlp_op(
 TP_MOE_TUNE_SPACE = (
     GroupGemmConfig(512, 1024, 512),
     GroupGemmConfig(512, 2048, 512),
+    # wider-N / deeper-K at block_m=512: if the 512-row tiles close only
+    # part of the measured 99.8->=140 TFLOPS gap (r3 chip log), these
+    # trade more VMEM for fewer B-operand re-fetches per expert pass
+    GroupGemmConfig(512, 4096, 512),
+    GroupGemmConfig(512, 1024, 1024),
     GroupGemmConfig(256, 1024, 512),
     GroupGemmConfig(256, 2048, 512),
     GroupGemmConfig(128, 1024, 512),
