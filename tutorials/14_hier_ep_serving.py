@@ -1,0 +1,92 @@
+"""Tutorial 14 — hierarchical EP-MoE serving: the reference's headline
+inference deployment (its `EPAll2AllLayer` spans NODES at inference —
+`layers/nvidia/ep_a2a_layer.py:41`, exercised end-to-end by
+`test/nvidia/test_ep_moe_inference.py`; the 137 µs a2a headline runs on
+4 nodes × 8 GPUs, README.md:87).
+
+The TPU shape of that deployment, on one 2-axis serving mesh
+``(ep_outer, axis)`` = (slow/DCN, fast/ICI):
+
+- **DP attention**: the request slots and the KV cache's batch dim shard
+  over the OUTER axis — each outer group (≙ a node / a slice) serves
+  only its own requests, nothing is replicated; the sequence dim shards
+  over the INNER axis (SP decode), as in the flat deployment.
+- **One MoE layer across the whole mesh**: every PE dispatches its token
+  slice through the two-phase HierEPAll2AllLayer — at most ONE copy of a
+  token crosses the slow axis per destination node (cross-node dedup),
+  the expert scatter rides the fast axis, and the combine pre-reduces at
+  the relay so only one partial per (token, node) re-crosses. On a real
+  Multislice mesh `config.dcn_axes` routes the outer hop over XLA
+  collectives (DCN) automatically.
+- **The host loop does not know any of this**: decode returns replicated
+  ``[b, vocab]`` logits, so ``generate`` and the ContinuousBatcher run
+  unchanged — the SAME code served the flat deployment in tutorial 12.
+
+Run:
+
+    python tutorials/14_hier_ep_serving.py
+"""
+
+import common  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from triton_dist_tpu.models import EPMoETransformerConfig, init_moe_params
+from triton_dist_tpu.models.decode import ContinuousBatcher, Request, generate
+from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig
+from triton_dist_tpu.ops.flash_decode import FlashDecodeConfig
+from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig
+from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+
+devs = np.array(jax.devices())
+assert devs.size >= 4, "this tutorial wants >= 4 devices (common.py)"
+inner = 4 if devs.size >= 8 else 2          # fast (ICI) axis width
+flat_mesh = Mesh(devs[:inner], ("tp",))
+hier_mesh = Mesh(devs[: 2 * inner].reshape(2, inner), ("dp", "tp"))
+S_MAX = 16
+
+kw = dict(
+    vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
+    head_dim=8, batch=8, seq=8, n_experts=8, topk=2,
+    ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+    gg_config=GroupGemmConfig(4, 32, 32),
+)
+flat_cfg = EPMoETransformerConfig(**kw)              # 1-axis flat EP
+hier_cfg = EPMoETransformerConfig(**kw, ep_outer="dp")  # 2-axis two-phase
+params = init_moe_params(jax.random.PRNGKey(0), flat_cfg)
+prompt = jax.random.randint(jax.random.PRNGKey(1), (8, 4), 0, 32, jnp.int32)
+fd = FlashDecodeConfig(block_s=4)
+
+# --- 1. same weights, two deployments, identical tokens -------------------
+flat_toks = generate(
+    flat_cfg, params, prompt, 4, flat_mesh, s_max=S_MAX, fd_config=fd
+)
+hier_toks = generate(
+    hier_cfg, params, prompt, 4, hier_mesh, s_max=S_MAX, fd_config=fd
+)
+np.testing.assert_array_equal(np.asarray(hier_toks), np.asarray(flat_toks))
+print("[1] hier (2x4 mesh, DP attention + two-phase EP) == flat EP tokens:")
+print("   ", np.asarray(hier_toks).tolist())
+
+# --- 2. the serving cache layouts compose unchanged -----------------------
+paged = generate(
+    hier_cfg, params, prompt, 4, hier_mesh, s_max=S_MAX, page_size=2
+)
+np.testing.assert_array_equal(np.asarray(paged), np.asarray(flat_toks))
+print("[2] paged pool + block tables on the 2-axis mesh: token-exact")
+
+# --- 3. continuous batching against the hierarchical deployment -----------
+batcher = ContinuousBatcher(
+    hier_cfg, params, hier_mesh, s_max=S_MAX, fd_config=fd
+)
+for uid in range(6):
+    batcher.submit(
+        Request(prompt=[1 + uid, 2, 3], max_new_tokens=3, uid=uid)
+    )
+done = dict(batcher.run())
+print(f"[3] continuous batcher served {len(done)} ragged requests on the "
+      "hierarchical mesh:", {u: t for u, t in sorted(done.items())})
+print("tutorial 14 OK")
