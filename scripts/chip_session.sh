@@ -41,6 +41,13 @@ timeout 1800 python bench.py > "docs/chip_logs/${stamp}_bench_driver_mode.log" 2
 driver_rc=$?
 echo "driver rc=$driver_rc" >> "docs/chip_logs/${stamp}_bench_driver_mode.log"
 
+echo "=== [3b] n>1 bench mode (multi-chip A/B if the backend has chips;"
+echo "    8-virtual-device CPU structural validation otherwise)"
+TDT_BENCH_PROBE_BUDGET=60 timeout 3600 python bench.py --world 8 \
+  > "docs/chip_logs/${stamp}_bench_world8.log" 2>&1
+world_rc=$?
+echo "world8 rc=$world_rc" >> "docs/chip_logs/${stamp}_bench_world8.log"
+
 echo "=== [4/6] native PJRT runner round trip"
 timeout 900 bash scripts/pjrt_runner_check.sh > "docs/chip_logs/${stamp}_pjrt_runner.log" 2>&1
 pjrt_rc=$?
@@ -56,15 +63,21 @@ echo "=== [5/6] serving throughput (continuous batching, tokens/s)"
   moe_rc=$?
   TDT_SERVING_BENCH_QUANT=1 timeout 1800 python scripts/serving_bench.py mixtral-8x7b 2 4 120
   moe_q_rc=$?
+  # EP deployments: flat a2a dispatch and the hierarchical two-phase
+  # program (the reference's multi-node serving shape, degenerate 1-chip)
+  timeout 1800 python scripts/serving_bench.py mixtral-8x7b:ep 2 4 120
+  ep_rc=$?
+  timeout 1800 python scripts/serving_bench.py mixtral-8x7b:ep-hier 2 4 120
+  eph_rc=$?
 } > "docs/chip_logs/${stamp}_serving.log" 2>&1
-echo "serving rc=$serving_rc moe=$moe_rc moe_w8=$moe_q_rc" \
+echo "serving rc=$serving_rc moe=$moe_rc moe_w8=$moe_q_rc ep=$ep_rc ep_hier=$eph_rc" \
   >> "docs/chip_logs/${stamp}_serving.log"
-serving_rc=$(( serving_rc || moe_rc || moe_q_rc ))
+serving_rc=$(( serving_rc || moe_rc || moe_q_rc || ep_rc || eph_rc ))
 
 echo "=== [6/6] native decode-step loop (pjrt_runner vs python, tokens/s)"
 timeout 1800 bash scripts/native_serving_bench.sh > "docs/chip_logs/${stamp}_native_serving.log" 2>&1
 native_rc=$?
 echo "native serving rc=$native_rc" >> "docs/chip_logs/${stamp}_native_serving.log"
 
-echo "rc: smoke=$smoke_rc tuned=$tuned_rc driver=$driver_rc pjrt=$pjrt_rc serving=$serving_rc native=$native_rc"
-exit $(( smoke_rc || tuned_rc || driver_rc || pjrt_rc || serving_rc || native_rc ))
+echo "rc: smoke=$smoke_rc tuned=$tuned_rc driver=$driver_rc world8=$world_rc pjrt=$pjrt_rc serving=$serving_rc native=$native_rc"
+exit $(( smoke_rc || tuned_rc || driver_rc || world_rc || pjrt_rc || serving_rc || native_rc ))
