@@ -89,6 +89,22 @@ _WORKER = textwrap.dedent(
         )
     checkpoint.close(ckdir)
     print("MP_CKPT_OK", flush=True)
+
+    # --- multi-host profile trace merge (utils.group_profile) ---
+    from triton_dist_tpu.utils import group_profile
+
+    prof_dir = os.environ["TDT_PROF_DIR"] + str(jax.process_index())
+    with group_profile("mp", log_dir=prof_dir):
+        jax.block_until_ready(jax.jit(jnp.sum)(a))  # traced global collective
+    if jax.process_index() == 0:
+        import glob
+        merged = glob.glob(
+            os.path.join(prof_dir, "mp", "plugins", "profile", "mp_merged", "*")
+        )
+        names = [os.path.basename(f) for f in merged]
+        assert any(n.startswith("rank0_") for n in names), names
+        assert any(n.startswith("rank1_") for n in names), names
+    print("MP_PROF_OK", flush=True)
     """
 )
 
@@ -117,6 +133,7 @@ def test_two_process_bootstrap_op_tune_checkpoint(tmp_path):
             TDT_REPO=repo,
             TDT_CKPT_DIR=str(ckdir),
             TDT_AUTOTUNE_CACHE=str(tmp_path / "tune"),
+            TDT_PROF_DIR=str(tmp_path / "prof"),
         )
         procs.append(
             subprocess.Popen(
@@ -136,5 +153,5 @@ def test_two_process_bootstrap_op_tune_checkpoint(tmp_path):
                 p.wait()
     for p, (out, err) in zip(procs, outs):
         assert p.returncode == 0, f"rc={p.returncode}\n{out}\n{err[-4000:]}"
-        for marker in ("MP_OP_OK", "MP_TUNE_OK", "MP_CKPT_OK"):
+        for marker in ("MP_OP_OK", "MP_TUNE_OK", "MP_CKPT_OK", "MP_PROF_OK"):
             assert marker in out, f"{marker} missing:\n{out}\n{err[-4000:]}"
