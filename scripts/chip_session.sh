@@ -69,10 +69,13 @@ echo "=== [5/6] serving throughput (continuous batching, tokens/s)"
   ep_rc=$?
   timeout 1800 python scripts/serving_bench.py mixtral-8x7b:ep-hier 2 4 120
   eph_rc=$?
+  # speculative decoding: plain vs draft-speculated greedy (same tokens)
+  timeout 1800 python scripts/speculative_bench.py llama-3.1-8b 8 4 96 4
+  spec_rc=$?
 } > "docs/chip_logs/${stamp}_serving.log" 2>&1
-echo "serving rc=$serving_rc moe=$moe_rc moe_w8=$moe_q_rc ep=$ep_rc ep_hier=$eph_rc" \
+echo "serving rc=$serving_rc moe=$moe_rc moe_w8=$moe_q_rc ep=$ep_rc ep_hier=$eph_rc spec=$spec_rc" \
   >> "docs/chip_logs/${stamp}_serving.log"
-serving_rc=$(( serving_rc || moe_rc || moe_q_rc || ep_rc || eph_rc ))
+serving_rc=$(( serving_rc || moe_rc || moe_q_rc || ep_rc || eph_rc || spec_rc ))
 
 echo "=== [6/6] native decode-step loop (pjrt_runner vs python, tokens/s)"
 timeout 1800 bash scripts/native_serving_bench.sh > "docs/chip_logs/${stamp}_native_serving.log" 2>&1

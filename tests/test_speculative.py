@@ -1,0 +1,136 @@
+"""Speculative decoding (models/speculative.py): greedy-exactness against
+plain generate, verify-step equivalence to successive decode steps, and
+the multi-position kernel's SP form."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.models import TransformerConfig, init_params
+from triton_dist_tpu.models.decode import KVCacheSpec, decode_step, generate, specs_for
+from triton_dist_tpu.models.speculative import speculative_generate, verify_step
+from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig
+from triton_dist_tpu.ops.flash_decode import FlashDecodeConfig
+from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab=32, hidden=32, ffn=64, n_layers=2, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=2, seq=8,
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def test_verify_step_matches_successive_decodes(mesh4):
+    """One verify forward over an S-chunk == S decode steps: same cache
+    writes, near-identical logits (the multi-row kernel re-partitions the
+    same f32 accumulations)."""
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    spec = KVCacheSpec(16)
+    n = mesh4.shape[cfg.axis]
+    pspecs, cspecs = specs_for(cfg), spec.specs(cfg)
+    cache0 = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh4, s)),
+        spec.init(cfg, n), cspecs,
+    )
+    params_sh = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh4, s)), params, pspecs
+    )
+    S = 3
+    chunk = jax.random.randint(jax.random.PRNGKey(1), (cfg.batch, S), 0, cfg.vocab)
+    fd = FlashDecodeConfig(block_s=4)
+
+    ver = jax.jit(
+        jax.shard_map(
+            lambda p, c, t: verify_step(
+                cfg, p, c, t, 0, spec=spec, fd_config=fd
+            ),
+            mesh=mesh4, in_specs=(pspecs, cspecs, P(None, None)),
+            out_specs=(P(None, None, None), cspecs), check_vma=False,
+        )
+    )
+    v_logits, v_cache = ver(params_sh, cache0, chunk)
+    jax.block_until_ready(v_logits)
+
+    step = jax.jit(
+        jax.shard_map(
+            lambda p, c, t, i: decode_step(
+                cfg, p, c, t, i, spec=spec, fd_config=fd
+            ),
+            mesh=mesh4, in_specs=(pspecs, cspecs, P(None), P()),
+            out_specs=(P(None, None), cspecs), check_vma=False,
+        )
+    )
+    cache = cache0
+    for i in range(S):
+        lg, cache = step(params_sh, cache, chunk[:, i], jnp.int32(i))
+        jax.block_until_ready(lg)
+        np.testing.assert_allclose(
+            np.asarray(v_logits[:, i]), np.asarray(lg), rtol=2e-3, atol=2e-3
+        )
+    # identical cache contents (both wrote positions 0..S-1)
+    for k in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(v_cache[k], np.float32),
+            np.asarray(cache[k], np.float32), rtol=1e-3, atol=1e-3,
+        )
+
+
+@pytest.mark.parametrize("moe", [False, True])
+def test_speculative_matches_greedy_generate(mesh4, moe):
+    """The whole speculative loop emits EXACTLY the target model's greedy
+    tokens — with a weaker draft (fewer layers), so rounds mix accepts
+    and rejects."""
+    if moe:
+        from triton_dist_tpu.models import (
+            MoETransformerConfig, init_moe_params,
+        )
+        from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+
+        kw = dict(
+            vocab=32, hidden=32, ffn=64, n_q_heads=8, n_kv_heads=4,
+            head_dim=8, batch=2, seq=8, n_experts=4, topk=2,
+            ag_config=AGGemmConfig(8, 16, 16),
+            rs_config=GemmRSConfig(8, 16, 16),
+            gg_config=GroupGemmConfig(8, 16, 16),
+        )
+        cfg = MoETransformerConfig(n_layers=2, **kw)
+        params = init_moe_params(jax.random.PRNGKey(2), cfg)
+        draft_cfg = MoETransformerConfig(n_layers=1, **kw)
+        draft_params = init_moe_params(jax.random.PRNGKey(3), draft_cfg)
+    else:
+        cfg = _cfg(n_layers=2)
+        params = init_params(jax.random.PRNGKey(2), cfg)
+        draft_cfg = _cfg(n_layers=1)
+        draft_params = init_params(jax.random.PRNGKey(3), draft_cfg)
+
+    b, prompt_len, n_steps, s_max = cfg.batch, 3, 6, 16
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(4), (b, prompt_len), 0, cfg.vocab, jnp.int32
+    )
+    fd = FlashDecodeConfig(block_s=4)
+    want = generate(
+        cfg, params, prompt, n_steps, mesh4, s_max=s_max, fd_config=fd
+    )
+    got = speculative_generate(
+        cfg, params, draft_cfg, draft_params, prompt, n_steps, mesh4,
+        s_max=s_max, draft_k=3, fd_config=fd, draft_fd_config=fd,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    # self-speculation (draft == target): every draft accepted, same tokens
+    got_self = speculative_generate(
+        cfg, params, cfg, params, prompt, n_steps, mesh4,
+        s_max=s_max, draft_k=3, fd_config=fd, draft_fd_config=fd,
+    )
+    np.testing.assert_array_equal(np.asarray(got_self), np.asarray(want))

@@ -16,6 +16,10 @@ from triton_dist_tpu.models.decode import (
     generate,
 )
 from triton_dist_tpu.models.pipeline import pipeline_apply, stage_slice
+from triton_dist_tpu.models.speculative import (
+    speculative_generate,
+    verify_step,
+)
 from triton_dist_tpu.models import presets
 from triton_dist_tpu.models.sp_transformer import (
     SPTransformer,
@@ -53,6 +57,8 @@ __all__ = [
     "sp_train_step",
     "decode_step",
     "generate",
+    "speculative_generate",
+    "verify_step",
     "EPMoETransformer",
     "EPMoETransformerConfig",
     "MoETransformerConfig",

@@ -174,6 +174,48 @@ class KVCacheSpec:
         )
         return attn, cache
 
+    def update_multi_and_attend(
+        self, cfg, cache, li, k_new, v_new, q, pos0, me, n,
+        fd_config, interpret,
+    ):
+        """Speculative-verify variant: append S consecutive positions per
+        sequence (owner-gated per position — a chunk may straddle shard
+        boundaries) and run the multi-row SP verify attention. k_new,
+        v_new ``[b, S, h_kv, d]``; q ``[b, S, hq, d]``; pos0 ``[b]``.
+        Returns ``(attn [b, S, hq, d] f32, cache)``."""
+        from triton_dist_tpu.ops.flash_decode import flash_verify_distributed
+
+        S = k_new.shape[1]
+        s_shard = _shard_of(self.s_max, n)
+        kc, vc = cache["k"][li], cache["v"][li]
+        # ONE scatter for all (sequence, chunk-position) pairs: ownership
+        # gates the INDICES — non-owner entries go out of range and drop
+        # (the paged pool's discipline) — so the append costs one pass,
+        # not S full-shard copies
+        pos_mat = pos0[:, None] + jnp.arange(S, dtype=jnp.int32)  # [b, S]
+        own = me == pos_mat // s_shard
+        safe_off = jnp.where(own, pos_mat % s_shard, s_shard)     # OOB drop
+        bmat = jnp.broadcast_to(
+            jnp.arange(cfg.batch)[:, None], safe_off.shape
+        )
+        kc = kc.at[bmat, :, safe_off, :].set(
+            k_new.astype(kc.dtype), mode="drop"
+        )
+        vc = vc.at[bmat, :, safe_off, :].set(
+            v_new.astype(vc.dtype), mode="drop"
+        )
+        cache = dict(cache, k=cache["k"].at[li].set(kc), v=cache["v"].at[li].set(vc))
+        # per-(sequence, chunk-row) valid prefix in the LOCAL shard: row i
+        # attends global positions < pos0 + i + 1
+        lens = jax.vmap(
+            lambda i: _local_lens(pos0 + i, me, s_shard), out_axes=1
+        )(jnp.arange(S))                                   # [b, S]
+        attn = flash_verify_distributed(
+            q.astype(kc.dtype), kc, vc, lens,
+            axis=cfg.axis, config=fd_config, interpret=interpret,
+        )
+        return attn, cache
+
 
 @dataclasses.dataclass(frozen=True)
 class PagedKVCacheSpec:
@@ -308,6 +350,86 @@ class PagedKVCacheSpec:
         return attn, cache
 
 
+def _decode_mlp(c, x, p, me, n, n_o, interpret):
+    """Decode-shaped MLP residual on ``m`` replicated rows (``m`` =
+    per-group batch for decode, batch × chunk for the speculative verify
+    step): dense SwiGLU, all-experts-einsum TP-MoE, or EP dispatch over
+    the a2a (flat and hierarchical). Returns the updated residual."""
+    m = x.shape[0]
+    h = rmsnorm(x, p["mlp_norm"], c.norm_eps)
+    if isinstance(c, EPMoETransformerConfig):
+        # EP serving decode (the reference's headline inference
+        # configuration — its LL a2a IS decode-shaped EP dispatch,
+        # README.md:87): each PE takes its row slice of the group's
+        # replicated activations, dispatches over the EP transport to
+        # the expert owners, and the combined shard all-gathers back.
+        # HIERARCHICAL (ep_outer set): sources are every (outer, inner)
+        # PE — the group's slice divides again over the inner axis — and
+        # the two-phase dispatch (node-dedup over the slow axis, expert
+        # scatter on the fast one) spans the whole mesh: the reference's
+        # 4-node × 8-GPU serving shape (test_ep_moe_inference.py) with
+        # DCN as the outer axis.
+        from triton_dist_tpu.models.tp_transformer import ep_moe_apply
+
+        if m % n:
+            raise ValueError(
+                f"EP serving decode shards its rows over the "
+                f"{c.axis!r} axis: per-group rows={m} must divide "
+                f"evenly over {n} PEs"
+            )
+        m_loc = m // n
+        h_loc = jax.lax.dynamic_slice_in_dim(h, me * m_loc, m_loc, 0)
+        # per-(src, dest) slab worst case: a src PE holds m_loc rows,
+        # each with topk assignments (flat) / at most one deduplicated
+        # copy per destination node (hierarchical)
+        y_loc = ep_moe_apply(
+            c, h_loc, p,
+            c.ep_max_m or (m_loc if n_o > 1 else m_loc * c.topk),
+            interpret=interpret,
+        )
+        y = jax.lax.all_gather(y_loc, c.axis, axis=0, tiled=True)
+        return x + y.astype(x.dtype)
+    if isinstance(c, MoETransformerConfig):
+        # decode-shaped MoE: at serving row counts every expert's F-shard
+        # weights stream from HBM regardless (weight-bound), so computing
+        # ALL experts with dense einsums + a one-hot topk combine is the
+        # TPU-shaped move — no gather/sort on a [m, H] activation.
+        # (Prefill-sized token counts go through the fused AG-GroupGEMM
+        # pipeline instead.)
+        from triton_dist_tpu.ops.moe_utils import select_experts
+
+        logits = h.astype(jnp.float32) @ p["router"].astype(jnp.float32)
+        tw, ids = select_experts(logits, c.topk)           # [m, topk]
+        # int8 expert banks (quantize_moe_serving_params) read the int8
+        # stream in the einsums — HALF the HBM bytes this weight-bound
+        # step is made of — and the per-(e, col) scales apply AFTER the
+        # contraction (exact: the scale is constant over the contracted
+        # dim) in the f32 stages that already exist (gelu input /
+        # combine), costing zero precision.
+        quant = "w_up_scale" in p
+        w_up = p["w_up"].astype(h.dtype) if quant else p["w_up"]
+        w_down = p["w_down"].astype(x.dtype) if quant else p["w_down"]
+        hE = jnp.einsum("bh,ehf->ebf", h, w_up)            # [E, m, F/n]
+        hE = hE.astype(jnp.float32)
+        if quant:
+            hE = hE * p["w_up_scale"]                      # [E,1,F] bcasts
+        act = jax.nn.gelu(hE).astype(x.dtype)
+        yE = jnp.einsum("ebf,efh->ebh", act, w_down)
+        yE = yE.astype(jnp.float32)
+        if quant:
+            yE = yE * p["w_down_scale"]
+        wE = (
+            jnp.zeros((m, c.n_experts), jnp.float32)
+            .at[jnp.arange(m)[:, None], ids]
+            .add(tw)
+        )
+        y = jnp.einsum("be,ebh->bh", wE, yE)  # yE already f32
+        return x + jax.lax.psum(y.astype(x.dtype), c.axis)
+    gu = (h @ p["w_gate_up"].reshape(c.hidden, -1)).reshape(m, -1, 2)
+    act = jax.nn.silu(gu[..., 0].astype(jnp.float32)).astype(x.dtype) * gu[..., 1]
+    return x + jax.lax.psum(act @ p["w_down"], c.axis)
+
+
 def decode_step(
     cfg: TransformerConfig,
     params: dict,
@@ -380,80 +502,9 @@ def decode_step(
         ).reshape(c.batch, -1).astype(x.dtype)
         x = x + jax.lax.psum(attn_loc @ p["wo"], c.axis)
 
-        # --- MLP ---
-        h = rmsnorm(x, p["mlp_norm"], c.norm_eps)
-        if isinstance(c, EPMoETransformerConfig):
-            # EP serving decode (the reference's headline inference
-            # configuration — its LL a2a IS decode-shaped EP dispatch,
-            # README.md:87): each PE takes its token slice of the group's
-            # replicated activations, dispatches over the EP transport to
-            # the expert owners, and the combined shard all-gathers back.
-            # HIERARCHICAL (ep_outer set): sources are every (outer,
-            # inner) PE — the group's slice divides again over the inner
-            # axis — and the two-phase dispatch (node-dedup over the slow
-            # axis, expert scatter on the fast one) spans the whole mesh:
-            # the reference's 4-node × 8-GPU serving shape
-            # (test_ep_moe_inference.py) with DCN as the outer axis.
-            from triton_dist_tpu.models.tp_transformer import ep_moe_apply
-
-            if c.batch % n:
-                raise ValueError(
-                    f"EP serving decode shards the batch over the "
-                    f"{c.axis!r} axis: per-group batch={c.batch} must "
-                    f"divide evenly over {n} PEs"
-                )
-            b_loc = c.batch // n
-            h_loc = jax.lax.dynamic_slice_in_dim(h, me * b_loc, b_loc, 0)
-            # per-(src, dest) slab worst case: a src PE holds b_loc
-            # tokens, each with topk assignments (flat) / at most one
-            # deduplicated copy per destination node (hierarchical)
-            y_loc = ep_moe_apply(
-                c, h_loc, p,
-                c.ep_max_m or (b_loc if n_o > 1 else b_loc * c.topk),
-                interpret=interpret,
-            )
-            y = jax.lax.all_gather(y_loc, c.axis, axis=0, tiled=True)
-            x = x + y.astype(x.dtype)
-        elif isinstance(c, MoETransformerConfig):
-            # decode-shaped MoE: at serving batch sizes every expert's
-            # F-shard weights stream from HBM regardless (weight-bound),
-            # so computing ALL experts with dense einsums + a one-hot
-            # topk combine is the TPU-shaped move — no gather/sort on a
-            # [b, H] activation. (Prefill-sized token counts go through
-            # the fused AG-GroupGEMM pipeline instead.)
-            from triton_dist_tpu.ops.moe_utils import select_experts
-
-            logits = h.astype(jnp.float32) @ p["router"].astype(jnp.float32)
-            tw, ids = select_experts(logits, c.topk)       # [b, topk]
-            # int8 expert banks (quantize_moe_serving_params) read the
-            # int8 stream in the einsums — HALF the HBM bytes this
-            # weight-bound step is made of — and the per-(e, col) scales
-            # apply AFTER the contraction (exact: the scale is constant
-            # over the contracted dim) in the f32 stages that already
-            # exist (gelu input / combine), costing zero precision.
-            quant = "w_up_scale" in p
-            w_up = p["w_up"].astype(h.dtype) if quant else p["w_up"]
-            w_down = p["w_down"].astype(x.dtype) if quant else p["w_down"]
-            hE = jnp.einsum("bh,ehf->ebf", h, w_up)        # [E, b, F/n]
-            hE = hE.astype(jnp.float32)
-            if quant:
-                hE = hE * p["w_up_scale"]                  # [E,1,F] bcasts
-            act = jax.nn.gelu(hE).astype(x.dtype)
-            yE = jnp.einsum("ebf,efh->ebh", act, w_down)
-            yE = yE.astype(jnp.float32)
-            if quant:
-                yE = yE * p["w_down_scale"]
-            wE = (
-                jnp.zeros((c.batch, c.n_experts), jnp.float32)
-                .at[jnp.arange(c.batch)[:, None], ids]
-                .add(tw)
-            )
-            y = jnp.einsum("be,ebh->bh", wE, yE)  # yE already f32
-            x = x + jax.lax.psum(y.astype(x.dtype), c.axis)
-        else:
-            gu = (h @ p["w_gate_up"].reshape(c.hidden, -1)).reshape(c.batch, -1, 2)
-            act = jax.nn.silu(gu[..., 0].astype(jnp.float32)).astype(x.dtype) * gu[..., 1]
-            x = x + jax.lax.psum(act @ p["w_down"], c.axis)
+        # --- MLP (shared row-wise helper: decode feeds [b, H] rows, the
+        # speculative verify step feeds [b*S, H]) ---
+        x = _decode_mlp(c, x, p, me, n, n_o, interpret)
 
     x = rmsnorm(x, params["final_norm"], c.norm_eps)
     logits_loc = x @ params["lm_head"]                       # [b_att, V/n]

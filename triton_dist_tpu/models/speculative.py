@@ -1,0 +1,263 @@
+"""Speculative decoding — draft-model speculation with multi-position
+verification (beyond the reference, whose serving surface stops at the
+single-token decode kernel; this is the standard big-model serving
+accelerant built ON TOP of that kernel family).
+
+Why it is TPU-shaped: single-token decode is HBM-bound — every step
+streams the whole KV cache and every weight matrix for ONE token's worth
+of MXU work per sequence. The verify step scores S = k+1 positions in
+one pass: the cache and the weights stream ONCE for S tokens
+(``ops.flash_decode.flash_verify`` — per-row prefix masks inside the
+same online-softmax kernel), and every matmul feeds the MXU S× the rows.
+Accepted-draft tokens therefore cost ~1/S of a decode step each.
+
+Greedy-exact: the emitted stream equals the target model's own greedy
+decode (tested token-for-token against ``decode.generate``). Accepted
+tokens are verified (target argmax == draft token); the bonus token is
+the target's argmax at the first divergence. Rollback is free by the
+cache design: positions past the accepted prefix hold stale k/v that
+``kv_lens = pos+1`` masks until they are overwritten.
+
+Batch acceptance is LOCKSTEP (the round accepts ``min`` over sequences,
+capped at k-1): every slot advances the same number of positions per
+round, which keeps positions scalar and — with the k-1 cap — keeps the
+draft's cache rows equal to the accepted inputs without a catch-up step.
+Flat (1-axis) deployments, contiguous cache.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_dist_tpu.models.decode import (
+    KVCacheSpec,
+    _decode_mlp,
+    _outer_of,
+    decode_step,
+    specs_for,
+)
+from triton_dist_tpu.models.tp_transformer import (
+    TransformerConfig,
+    rmsnorm,
+    rope,
+)
+from triton_dist_tpu.ops.flash_decode import FlashDecodeConfig
+
+
+def verify_step(
+    cfg: TransformerConfig,
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,   # [b, S] int32 — chunk inputs per sequence
+    pos0: jax.Array,     # [] or [b] int32 — first chunk position
+    *,
+    spec: KVCacheSpec,
+    fd_config: FlashDecodeConfig | None = None,
+    interpret: Any = None,
+) -> tuple[jax.Array, dict]:
+    """Score S consecutive input tokens per sequence in ONE forward (call
+    inside ``jax.shard_map``): returns ``(logits [b, S, vocab],
+    new_cache)`` — row i's logits are the model's next-token distribution
+    after inputs ``tokens[:, :i+1]``, exactly what S successive
+    decode_steps would produce, at one cache/weight pass. The chunk's k/v
+    are appended (owner-gated per position) before attention; causality
+    within the chunk rides the per-row prefix lengths."""
+    c = cfg
+    if _outer_of(c) is not None:
+        raise NotImplementedError(
+            "speculative verify currently runs flat (1-axis) deployments; "
+            "hierarchical EP serving uses plain decode"
+        )
+    if not isinstance(spec, KVCacheSpec):
+        raise NotImplementedError(
+            "speculative verify needs the contiguous KV cache (paged "
+            "multi-position append is not wired yet)"
+        )
+    n = int(jax.lax.axis_size(c.axis))
+    me = jax.lax.axis_index(c.axis)
+    g = c.n_q_heads // c.n_kv_heads
+    d = c.head_dim
+    assert c.n_kv_heads % n == 0, (c.n_kv_heads, n)
+    b, S = tokens.shape
+    m = b * S
+    pos0_b = jnp.broadcast_to(jnp.asarray(pos0, jnp.int32), (b,))
+    pos_flat = (pos0_b[:, None] + jnp.arange(S, dtype=jnp.int32)).reshape(-1)
+
+    x = params["embed"][tokens.reshape(-1)]                # [m, H] b-major
+    for li, p in enumerate(params["layers"]):
+        h = rmsnorm(x, p["attn_norm"], c.norm_eps)
+        qkv_loc = h @ p["wqkv"].reshape(c.hidden, -1)      # [m, qkv/n]
+        qkv = jax.lax.all_gather(qkv_loc, c.axis, axis=1, tiled=True)
+        qkv = qkv.reshape(m, c.n_kv_heads, g + 2, d)
+        q = qkv[:, :, :g, :].reshape(m, 1, c.n_q_heads, d)
+        k_new = qkv[:, :, g, :].reshape(m, 1, c.n_kv_heads, d)
+        v_new = qkv[:, :, g + 1, :]                        # [m, h_kv, d]
+        rope_b = jax.vmap(lambda xi, pi: rope(xi, pi, c.rope_theta))
+        q = rope_b(q, pos_flat[:, None])[:, 0]             # [m, hq, d]
+        k_new = rope_b(k_new, pos_flat[:, None])[:, 0]     # [m, h_kv, d]
+
+        attn, cache = spec.update_multi_and_attend(
+            c, cache, li,
+            k_new.reshape(b, S, c.n_kv_heads, d),
+            v_new.reshape(b, S, c.n_kv_heads, d),
+            q.reshape(b, S, c.n_q_heads, d),
+            pos0_b, me, n, fd_config, interpret,
+        )                                                  # [b, S, hq, d]
+        attn_loc = jax.lax.dynamic_slice_in_dim(
+            attn.reshape(m, c.n_q_heads, d),
+            me * (c.n_q_heads // n), c.n_q_heads // n, axis=1,
+        ).reshape(m, -1).astype(x.dtype)
+        x = x + jax.lax.psum(attn_loc @ p["wo"], c.axis)
+        x = _decode_mlp(c, x, p, me, n, 1, interpret)
+
+    x = rmsnorm(x, params["final_norm"], c.norm_eps)
+    logits_loc = x @ params["lm_head"]                     # [m, V/n]
+    logits = jax.lax.all_gather(logits_loc, c.axis, axis=1, tiled=True)
+    return logits.reshape(b, S, c.vocab), cache
+
+
+def speculative_generate(
+    cfg: TransformerConfig,
+    params: dict,
+    draft_cfg: TransformerConfig,
+    draft_params: dict,
+    prompt: jax.Array,   # [b, prompt_len] int32
+    n_steps: int,
+    mesh: Mesh,
+    *,
+    s_max: int,
+    draft_k: int = 4,
+    fd_config: FlashDecodeConfig | None = None,
+    draft_fd_config: FlashDecodeConfig | None = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """Greedy speculative generation: the draft model proposes ``draft_k``
+    tokens per round, one verify forward on the target accepts the
+    longest matching prefix plus the target's own bonus token. Returns
+    ``[b, n_steps]`` — TOKEN-IDENTICAL to ``decode.generate(cfg, params,
+    ...)`` (greedy equivalence), in ~``n_steps / (accepted+1)`` target
+    forwards instead of ``n_steps``.
+
+    `draft_cfg`/`draft_params` are a (smaller) model over the SAME vocab
+    and serving axis; both caches live on `mesh` (contiguous layout)."""
+    from triton_dist_tpu.ops.common import jit_shard_map
+
+    b, prompt_len = prompt.shape
+    if cfg.vocab != draft_cfg.vocab or cfg.batch != draft_cfg.batch:
+        raise ValueError("target and draft must share vocab and batch")
+    # +k+1: each round may write up to draft_k chunk positions beyond the
+    # accepted prefix before the position pointer rolls back
+    if prompt_len + n_steps + draft_k + 1 > s_max:
+        raise ValueError(
+            f"speculative rounds write up to draft_k={draft_k} positions "
+            f"past the accepted prefix: need prompt+steps+k+1 <= "
+            f"s_max={s_max}"
+        )
+    if draft_k < 2:
+        raise ValueError("draft_k must be >= 2 (k-1 accepted tokens max)")
+    spec_t, spec_d = KVCacheSpec(s_max), KVCacheSpec(s_max)
+    n = mesh.shape[cfg.axis]
+
+    def put_tree(tree, specs):
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+        )
+
+    cache_t = put_tree(spec_t.init(cfg, n), spec_t.specs(cfg))
+    cache_d = put_tree(spec_d.init(draft_cfg, n), spec_d.specs(draft_cfg))
+    params_t = put_tree(params, specs_for(cfg, params))
+    params_d = put_tree(draft_params, specs_for(draft_cfg, draft_params))
+    step_t = functools.partial(
+        decode_step, cfg, spec=spec_t, fd_config=fd_config,
+        interpret=interpret,
+    )
+    step_d = functools.partial(
+        decode_step, draft_cfg, spec=spec_d, fd_config=draft_fd_config,
+        interpret=interpret,
+    )
+
+    def warm(pt, pd, ct, cd, prompt):
+        # feed the prompt into BOTH caches; the target's logits at the
+        # last prompt position yield the first emitted token
+        def body(carry, i):
+            ct, cd = carry
+            lt, ct = step_t(pt, ct, prompt[:, i], i)
+            _, cd = step_d(pd, cd, prompt[:, i], i)
+            return (ct, cd), lt
+
+        (ct, cd), lts = jax.lax.scan(
+            body, (ct, cd), jnp.arange(prompt_len)
+        )
+        t1 = jnp.argmax(lts[-1], axis=-1).astype(jnp.int32)
+        return ct, cd, t1
+
+    def draft_roll(pd, cd, tok, pos0):
+        def body(carry, j):
+            cd, tok = carry
+            lg, cd = step_d(pd, cd, tok, pos0 + j)
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return (cd, nxt), nxt
+
+        (cd, _), ds = jax.lax.scan(body, (cd, tok), jnp.arange(draft_k))
+        return cd, ds.T                                    # [b, draft_k]
+
+    def verify(pt, ct, chunk, pos0):
+        logits, ct = verify_step(
+            cfg, pt, ct, chunk, pos0, spec=spec_t, fd_config=fd_config,
+            interpret=interpret,
+        )
+        return ct, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    cs_t, cs_d = spec_t.specs(cfg), spec_d.specs(draft_cfg)
+    ps_t, ps_d = specs_for(cfg, params), specs_for(draft_cfg, draft_params)
+    key = (cfg, draft_cfg, s_max, draft_k, fd_config, draft_fd_config,
+           str(interpret))
+    warm_p = jit_shard_map(
+        warm, mesh, (ps_t, ps_d, cs_t, cs_d, P(None, None)),
+        (cs_t, cs_d, P(None)),
+        key=("spec_warm", prompt_len, *key),
+    )
+    draft_p = jit_shard_map(
+        draft_roll, mesh, (ps_d, cs_d, P(None), P()),
+        (cs_d, P(None, None)),
+        key=("spec_draft", *key),
+    )
+    verify_p = jit_shard_map(
+        verify, mesh, (ps_t, cs_t, P(None, None), P()),
+        (cs_t, P(None, None)),
+        key=("spec_verify", *key),
+    )
+
+    cache_t, cache_d, tok = warm_p(params_t, params_d, cache_t, cache_d, prompt)
+    out = [np.asarray(tok)]
+    pos = prompt_len
+    k = draft_k
+    while len(out) < n_steps:
+        cache_d, drafts = draft_p(params_d, cache_d, tok, jnp.int32(pos))
+        chunk = jnp.concatenate([tok[:, None], drafts], axis=1)  # [b, k+1]
+        cache_t, preds = verify_p(params_t, cache_t, chunk, jnp.int32(pos))
+        preds_h, drafts_h = np.asarray(preds), np.asarray(drafts)
+        # longest verified prefix, lockstep over the batch, capped at k-1
+        # (the cap keeps the draft cache consistent without a catch-up
+        # forward — see module docstring)
+        match = preds_h[:, :k] == drafts_h                 # [b, k]
+        a = int(
+            min(
+                (match.cumprod(axis=1).sum(axis=1)).min(),
+                k - 1,
+                n_steps - len(out) - 1,  # don't overrun the output
+            )
+        )
+        for j in range(a):
+            out.append(drafts_h[:, j])
+        out.append(preds_h[:, a])                          # the bonus token
+        tok = jnp.asarray(preds_h[:, a], jnp.int32)
+        pos += a + 1
+    return np.stack(out[:n_steps], axis=1)                 # [b, n_steps]

@@ -109,8 +109,14 @@ def _online_softmax_step(
     span = chunk_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     s = jnp.where(span < kv_len, s, NEG_INF)
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)                              # [g, sc]
+    # all-masked rows keep m_new == -inf: subtract a clamped copy so the
+    # update is exp(-inf) = 0, not exp(-inf - -inf) = NaN. The verify
+    # kernel hits this (per-ROW lengths — a zero-length row shares its
+    # grid step with live rows); the single-position kernels' chunk gate
+    # merely made it unreachable.
+    m_safe = jnp.maximum(m_new, -1e30)
+    alpha = jnp.exp(m_prev - m_safe)
+    p = jnp.exp(s - m_safe)                             # [g, sc]
     l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
     pv = p if vs_row is None else p * vs_row
     acc_new = acc_prev * alpha + jax.lax.dot(
@@ -399,6 +405,180 @@ def _decode_call(q, k, v, scales, kv_lens, *, config, return_lse, interpret):
     lse = lse.reshape(b, hq)
     return (out, lse) if return_lse else out
 
+
+
+def _flash_verify_body(
+    max_lens_ref, lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
+    m_scr, l_scr, acc_scr, *, n_chunks: int, block_s: int, scale: float,
+):
+    """Multi-position (speculative-verify) decode body: grid
+    (b, h_kv, chunk) exactly like :func:`_flash_decode_body`, but the q
+    block carries ``S*g`` rows — S draft positions × the GQA group — and
+    each ROW masks its own cache prefix via a per-row length column
+    (``lens_ref``, VMEM). The per-sequence MAX length (SMEM) gates whole
+    chunks. The S-fold wider score matmul is the point: the cache streams
+    from HBM ONCE for all S draft positions, where S single-token decodes
+    would stream it S times — and the MXU sees S*g rows instead of g."""
+    b_i = pl.program_id(0)
+    c = pl.program_id(2)
+
+    @pl.when(c == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(c * block_s < max_lens_ref[b_i])
+    def _():
+        m_scr[:], l_scr[:], acc_scr[:] = _online_softmax_step(
+            q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], None, None,
+            c * block_s, lens_ref[0, 0], scale,
+            m_scr[:], l_scr[:], acc_scr[:],
+        )
+
+    @pl.when(c == n_chunks - 1)
+    def _():
+        out_ref[0, 0], lse_ref[0, 0] = _finalize_softmax(
+            m_scr[:], l_scr[:], acc_scr[:]
+        )
+
+
+def _xla_verify(q, k, v, kv_lens, *, return_lse):
+    """XLA-native multi-position decode (block_s=0 sentinel + golden):
+    per-(sequence, position) prefix masks over one einsum."""
+    b, S, hq, d = q.shape
+    _, h_kv, s_len, _ = k.shape
+    g = hq // h_kv
+    q5 = q.reshape(b, S, h_kv, g, d).astype(jnp.float32)
+    s = jnp.einsum(
+        "bshgd,bhtd->bshgt", q5, k.astype(jnp.float32)
+    ) / math.sqrt(d)
+    span = jnp.arange(s_len, dtype=jnp.int32)
+    mask = span[None, None, :] < kv_lens[:, :, None]       # [b, S, t]
+    s = jnp.where(mask[:, :, None, None, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m_safe = jnp.maximum(m, -1e30)
+    p = jnp.exp(s - m_safe)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bshgt,bhtd->bshgd", p, v.astype(jnp.float32))
+    out = (out / jnp.maximum(l, 1e-30)).reshape(b, S, hq, d)
+    out = jnp.where(l.reshape(b, S, hq, 1) > 0, out, 0.0)
+    if not return_lse:
+        return out
+    lse = (m_safe + jnp.log(jnp.maximum(l, 1e-30))).reshape(b, S, hq)
+    lse = jnp.where(l.reshape(b, S, hq) > 0, lse, NEG_INF)
+    return out, lse
+
+
+def flash_verify(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_lens: jax.Array,
+    *,
+    config: FlashDecodeConfig | None = None,
+    return_lse: bool = False,
+    interpret: Any = None,
+):
+    """Multi-position GQA decode — the speculative-decoding VERIFY
+    attention (beyond the reference, whose serving surface stops at
+    single-token decode): score S draft positions of every sequence in
+    ONE pass over the cache.
+
+    q: ``[b, S, q_heads, d]`` (position i = draft token i); k, v:
+    ``[b, kv_heads, s, d]`` with the S draft tokens' own k/v ALREADY
+    WRITTEN; kv_lens: ``[b, S]`` int32 — row (b, i) attends cache
+    positions ``< kv_lens[b, i]`` (the verify caller passes
+    ``pos0+i+1``: its prefix plus draft tokens ``<= i`` — causal within
+    the chunk via the cache). Returns f32 ``[b, S, q_heads, d]`` (+
+    ``lse [b, S, q_heads]``)."""
+    cfg = config or FlashDecodeConfig()
+    b, S, hq, d = q.shape
+    _, h_kv, s_len, _ = k.shape
+    assert hq % h_kv == 0, (hq, h_kv)
+    g = hq // h_kv
+    kv_lens = kv_lens.astype(jnp.int32)
+    if cfg.block_s == 0:
+        return _xla_verify(q, k, v, kv_lens, return_lse=return_lse)
+    sc = pick_block(s_len, cfg.block_s)
+    n_chunks = s_len // sc
+    rows = S * g
+    q5 = (
+        q.reshape(b, S, h_kv, g, d)
+        .swapaxes(1, 2)
+        .reshape(b, h_kv, rows, d)
+        .astype(k.dtype)
+    )
+    # per-row length column: row s*g + j masks with kv_lens[b, s]
+    lens_rows = jnp.repeat(kv_lens, g, axis=1).reshape(b, 1, rows, 1)
+    max_lens = jnp.max(kv_lens, axis=1)
+    cost = pl.CostEstimate(
+        flops=4 * b * S * hq * s_len * d,
+        bytes_accessed=2 * b * h_kv * s_len * d * k.dtype.itemsize,
+        transcendentals=b * S * hq * s_len,
+    )
+    out, lse = dist_pallas_call(
+        functools.partial(
+            _flash_verify_body, n_chunks=n_chunks, block_s=sc,
+            scale=1.0 / math.sqrt(d),
+        ),
+        name="flash_verify",
+        grid=(b, h_kv, n_chunks),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h_kv, rows, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h_kv, rows, 1), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # max_lens (chunk gate)
+            pl.BlockSpec((1, 1, rows, 1), lambda i, j, c: (i, 0, 0, 0)),
+            pl.BlockSpec((1, 1, rows, d), lambda i, j, c: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, sc, d), lambda i, j, c: (i, j, c, 0)),
+            pl.BlockSpec((1, 1, sc, d), lambda i, j, c: (i, j, c, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, rows, d), lambda i, j, c: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, rows, 1), lambda i, j, c: (i, j, 0, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, d), jnp.float32),
+        ],
+        cost_estimate=cost,
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+        uses_barrier=False,
+        interpret=interpret,
+    )(max_lens, lens_rows, q5, k, v)
+    out = out.reshape(b, h_kv, S, g, d).swapaxes(1, 2).reshape(b, S, hq, d)
+    lse = lse.reshape(b, h_kv, S, g).swapaxes(1, 2).reshape(b, S, hq)
+    return (out, lse) if return_lse else out
+
+
+def flash_verify_distributed(
+    q: jax.Array,
+    k_shard: jax.Array,
+    v_shard: jax.Array,
+    lens_shard: jax.Array,
+    *,
+    axis: str = "tp",
+    config: FlashDecodeConfig | None = None,
+    ag_method: str = "full_mesh_push",
+    interpret: Any = None,
+) -> jax.Array:
+    """SP form of :func:`flash_verify` (call inside ``jax.shard_map``):
+    per-shard multi-position partials, then the same (out ‖ lse)
+    allgather-merge the single-token SP decode rides — the S dim folds
+    into the payload's row dim."""
+    out, lse = flash_verify(
+        q, k_shard, v_shard, lens_shard,
+        config=config, return_lse=True, interpret=interpret,
+    )
+    b, S, hq, d = out.shape
+    merged = _sp_allgather_combine(
+        out.reshape(b * S, hq, d), lse.reshape(b * S, hq), axis, ag_method,
+        interpret,
+    )
+    return merged.reshape(b, S, hq, d)
 
 
 def quantize_kv(k: jax.Array, v: jax.Array):
