@@ -4,8 +4,6 @@ the multi-position kernel's SP form."""
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -134,3 +132,36 @@ def test_speculative_matches_greedy_generate(mesh4, moe):
         s_max=s_max, draft_k=3, fd_config=fd, draft_fd_config=fd,
     )
     np.testing.assert_array_equal(np.asarray(got_self), np.asarray(want))
+
+
+def test_speculative_hier_ep_target(mesh2x4, mesh4):
+    """The two round-5 serving features compose: a dense draft speculates
+    for a HIERARCHICAL EP-MoE target on the 2-axis mesh — emitted tokens
+    equal the flat-EP greedy decode of the same weights."""
+    from triton_dist_tpu.models import EPMoETransformerConfig, init_moe_params
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+
+    b, prompt_len, n_steps, s_max = 8, 3, 5, 16
+    kw = dict(
+        vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=8, n_kv_heads=4,
+        head_dim=8, batch=b, seq=8, n_experts=8, topk=2,
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+        gg_config=GroupGemmConfig(4, 32, 32),
+    )
+    flat_cfg = EPMoETransformerConfig(**kw)
+    hier_cfg = EPMoETransformerConfig(**kw, ep_outer="dp")
+    params = init_moe_params(jax.random.PRNGKey(7), flat_cfg)
+    draft_cfg = _cfg(n_layers=1, batch=b)
+    draft_params = init_params(jax.random.PRNGKey(8), draft_cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(9), (b, prompt_len), 0, flat_cfg.vocab, jnp.int32
+    )
+    fd = FlashDecodeConfig(block_s=4)
+    want = generate(
+        flat_cfg, params, prompt, n_steps, mesh4, s_max=s_max, fd_config=fd
+    )
+    got = speculative_generate(
+        hier_cfg, params, draft_cfg, draft_params, prompt, n_steps, mesh2x4,
+        s_max=s_max, draft_k=3, fd_config=fd, draft_fd_config=fd,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -22,11 +22,16 @@ Batch acceptance is LOCKSTEP (the round accepts ``min`` over sequences,
 capped at k-1): every slot advances the same number of positions per
 round, which keeps positions scalar and — with the k-1 cap — keeps the
 draft's cache rows equal to the accepted inputs without a catch-up step.
-Flat (1-axis) deployments, contiguous cache.
+Contiguous cache; every serving deployment composes — flat 1-axis
+(dense / TP-MoE / flat EP) and the hierarchical EP mesh (DP attention
+per outer group + the two-phase dispatch, mirrored from decode_step),
+including a flat/dense draft speculating for a hierarchical target on
+the same 2-axis mesh.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any
 
@@ -39,6 +44,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from triton_dist_tpu.models.decode import (
     KVCacheSpec,
     _decode_mlp,
+    _mesh_outer,
+    _outer_dims,
     _outer_of,
     decode_step,
     specs_for,
@@ -69,25 +76,35 @@ def verify_step(
     decode_steps would produce, at one cache/weight pass. The chunk's k/v
     are appended (owner-gated per position) before attention; causality
     within the chunk rides the per-row prefix lengths."""
-    c = cfg
-    if _outer_of(c) is not None:
-        raise NotImplementedError(
-            "speculative verify currently runs flat (1-axis) deployments; "
-            "hierarchical EP serving uses plain decode"
-        )
     if not isinstance(spec, KVCacheSpec):
         raise NotImplementedError(
             "speculative verify needs the contiguous KV cache (paged "
             "multi-position append is not wired yet)"
         )
+    # hierarchical deployment: DP attention per outer group exactly as in
+    # decode_step — the group's batch slice, then the EP MLP spans the
+    # mesh and the logits re-gather to the global layout
+    n_o, my_o = _outer_dims(cfg)
+    if cfg.batch % n_o:
+        raise ValueError(
+            f"batch={cfg.batch} must divide over the {n_o} outer groups"
+        )
+    b_att = cfg.batch // n_o
+    c = dataclasses.replace(cfg, batch=b_att) if n_o > 1 else cfg
     n = int(jax.lax.axis_size(c.axis))
     me = jax.lax.axis_index(c.axis)
     g = c.n_q_heads // c.n_kv_heads
     d = c.head_dim
     assert c.n_kv_heads % n == 0, (c.n_kv_heads, n)
-    b, S = tokens.shape
+    S = tokens.shape[1]
+    pos0_g = jnp.broadcast_to(jnp.asarray(pos0, jnp.int32), (cfg.batch,))
+    if n_o > 1:
+        tokens = jax.lax.dynamic_slice_in_dim(tokens, my_o * b_att, b_att, 0)
+        pos0_b = jax.lax.dynamic_slice_in_dim(pos0_g, my_o * b_att, b_att, 0)
+    else:
+        pos0_b = pos0_g
+    b = b_att
     m = b * S
-    pos0_b = jnp.broadcast_to(jnp.asarray(pos0, jnp.int32), (b,))
     pos_flat = (pos0_b[:, None] + jnp.arange(S, dtype=jnp.int32)).reshape(-1)
 
     x = params["embed"][tokens.reshape(-1)]                # [m, H] b-major
@@ -115,12 +132,17 @@ def verify_step(
             me * (c.n_q_heads // n), c.n_q_heads // n, axis=1,
         ).reshape(m, -1).astype(x.dtype)
         x = x + jax.lax.psum(attn_loc @ p["wo"], c.axis)
-        x = _decode_mlp(c, x, p, me, n, 1, interpret)
+        x = _decode_mlp(c, x, p, me, n, n_o, interpret)
 
     x = rmsnorm(x, params["final_norm"], c.norm_eps)
     logits_loc = x @ params["lm_head"]                     # [m, V/n]
     logits = jax.lax.all_gather(logits_loc, c.axis, axis=1, tiled=True)
-    return logits.reshape(b, S, c.vocab), cache
+    logits = logits.reshape(b, S, c.vocab)
+    if n_o > 1:
+        logits = jax.lax.all_gather(
+            logits, _outer_of(cfg), axis=0, tiled=True
+        )
+    return logits, cache
 
 
 def speculative_generate(
@@ -164,14 +186,19 @@ def speculative_generate(
         raise ValueError("draft_k must be >= 2 (k-1 accepted tokens max)")
     spec_t, spec_d = KVCacheSpec(s_max), KVCacheSpec(s_max)
     n = mesh.shape[cfg.axis]
+    # hierarchical targets serve on the 2-axis mesh (DP attention per
+    # outer group — verify_step mirrors decode_step); a flat/dense DRAFT
+    # on the same mesh simply replicates over the outer axis
+    n_o_t = _mesh_outer(cfg, mesh)
+    n_o_d = _mesh_outer(draft_cfg, mesh)
 
     def put_tree(tree, specs):
         return jax.tree.map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
         )
 
-    cache_t = put_tree(spec_t.init(cfg, n), spec_t.specs(cfg))
-    cache_d = put_tree(spec_d.init(draft_cfg, n), spec_d.specs(draft_cfg))
+    cache_t = put_tree(spec_t.init(cfg, n, n_o_t), spec_t.specs(cfg))
+    cache_d = put_tree(spec_d.init(draft_cfg, n, n_o_d), spec_d.specs(draft_cfg))
     params_t = put_tree(params, specs_for(cfg, params))
     params_d = put_tree(draft_params, specs_for(draft_cfg, draft_params))
     step_t = functools.partial(
@@ -184,18 +211,20 @@ def speculative_generate(
     )
 
     def warm(pt, pd, ct, cd, prompt):
-        # feed the prompt into BOTH caches; the target's logits at the
-        # last prompt position yield the first emitted token
+        # feed the prompt into BOTH caches; only the LAST position's
+        # argmax is needed (carried, not stacked — a stacked
+        # [prompt_len, b, vocab] would dwarf the model at serving shapes)
         def body(carry, i):
-            ct, cd = carry
+            ct, cd, _ = carry
             lt, ct = step_t(pt, ct, prompt[:, i], i)
             _, cd = step_d(pd, cd, prompt[:, i], i)
-            return (ct, cd), lt
+            return (ct, cd, jnp.argmax(lt, axis=-1).astype(jnp.int32)), None
 
-        (ct, cd), lts = jax.lax.scan(
-            body, (ct, cd), jnp.arange(prompt_len)
+        b = prompt.shape[0]
+        (ct, cd, t1), _ = jax.lax.scan(
+            body, (ct, cd, jnp.zeros((b,), jnp.int32)),
+            jnp.arange(prompt_len),
         )
-        t1 = jnp.argmax(lts[-1], axis=-1).astype(jnp.int32)
         return ct, cd, t1
 
     def draft_roll(pd, cd, tok, pos0):
