@@ -44,6 +44,27 @@ def test_unknown_preset_raises():
         presets.preset("nope-13b")
 
 
+def test_ep_preset_variants():
+    """MoE presets carry their deployment: ep=True / ep_outer= build the
+    expert-parallel configs; the :ep / :ep-hier name suffixes spell the
+    same for CLI callers; dense presets reject EP."""
+    from triton_dist_tpu.models import EPMoETransformerConfig
+
+    flat = presets.preset("mixtral-8x7b:ep")
+    assert isinstance(flat, EPMoETransformerConfig) and flat.ep_outer is None
+    hier = presets.preset("mixtral-8x7b:ep-hier")
+    assert isinstance(hier, EPMoETransformerConfig)
+    assert hier.ep_outer == "dcn"
+    kw = presets.preset("mixtral-8x7b", ep=True)
+    assert isinstance(kw, EPMoETransformerConfig) and kw.ep_outer is None
+    kw2 = presets.preset("mixtral-8x7b", ep_outer="dp")
+    assert kw2.ep_outer == "dp"
+    with pytest.raises(ValueError, match="dense"):
+        presets.preset("llama-3.1-8b", ep=True)
+    with pytest.raises(KeyError):
+        presets.preset("nope-13b:ep")
+
+
 @pytest.mark.slow
 def test_layer_check_interpreted():
     """CI mirror of scripts/layer_check.py (tiny seq, interpreter)."""

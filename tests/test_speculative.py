@@ -112,7 +112,8 @@ def test_speculative_matches_greedy_generate(mesh4, moe):
         draft_cfg = _cfg(n_layers=1)
         draft_params = init_params(jax.random.PRNGKey(3), draft_cfg)
 
-    b, prompt_len, n_steps, s_max = cfg.batch, 3, 6, 16
+    # prompt_len 4: b*L divides the 4-PE mesh (the prefill warm-up shard)
+    b, prompt_len, n_steps, s_max = cfg.batch, 4, 6, 16
     prompt = jax.random.randint(
         jax.random.PRNGKey(4), (b, prompt_len), 0, cfg.vocab, jnp.int32
     )
@@ -125,6 +126,14 @@ def test_speculative_matches_greedy_generate(mesh4, moe):
         s_max=s_max, draft_k=3, fd_config=fd, draft_fd_config=fd,
     )
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    # MXU-rate prefill warm-up: same tokens on both model families
+    got_pf = speculative_generate(
+        cfg, params, draft_cfg, draft_params, prompt, n_steps, mesh4,
+        s_max=s_max, draft_k=3, fd_config=fd, draft_fd_config=fd,
+        prefill=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got_pf), np.asarray(want))
 
     # self-speculation (draft == target): every draft accepted, same tokens
     got_self = speculative_generate(
@@ -165,3 +174,13 @@ def test_speculative_hier_ep_target(mesh2x4, mesh4):
         s_max=s_max, draft_k=3, fd_config=fd, draft_fd_config=fd,
     )
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    # prefill warm-up on the 2-axis deployment: the hier target's prompt
+    # shards over (outer, inner) — 8 PEs — while the flat draft's shards
+    # over the inner 4 alone; same tokens either way
+    got_pf = speculative_generate(
+        hier_cfg, params, draft_cfg, draft_params, prompt, n_steps, mesh2x4,
+        s_max=s_max, draft_k=3, fd_config=fd, draft_fd_config=fd,
+        prefill=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got_pf), np.asarray(want))

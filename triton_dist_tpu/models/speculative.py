@@ -47,7 +47,9 @@ from triton_dist_tpu.models.decode import (
     _mesh_outer,
     _outer_dims,
     _outer_of,
+    _prompt_shard,
     decode_step,
+    prefill_cache,
     specs_for,
 )
 from triton_dist_tpu.models.tp_transformer import (
@@ -158,6 +160,7 @@ def speculative_generate(
     draft_k: int = 4,
     fd_config: FlashDecodeConfig | None = None,
     draft_fd_config: FlashDecodeConfig | None = None,
+    prefill: bool = False,
     interpret: Any = None,
 ) -> jax.Array:
     """Greedy speculative generation: the draft model proposes ``draft_k``
@@ -168,7 +171,10 @@ def speculative_generate(
     forwards instead of ``n_steps``.
 
     `draft_cfg`/`draft_params` are a (smaller) model over the SAME vocab
-    and serving axis; both caches live on `mesh` (contiguous layout)."""
+    and serving axis; both caches live on `mesh` (contiguous layout).
+    ``prefill=True`` warms BOTH caches through one full-forward prompt
+    pass each (MXU-rate admission, as in ``generate``) instead of
+    token-by-token."""
     from triton_dist_tpu.ops.common import jit_shard_map
 
     b, prompt_len = prompt.shape
@@ -210,6 +216,27 @@ def speculative_generate(
         interpret=interpret,
     )
 
+    def warm_prefill(pt, pd, ct, cd, prompt):
+        # one full transformer forward per model writes the whole
+        # prompt's KV (decode.prefill_cache — the chunked-prefill path
+        # generate's prefill=True rides); the target's last-position
+        # logits yield the first emitted token
+        pcfg_t = dataclasses.replace(
+            cfg, seq=prompt_len, batch=b // n_o_t
+        )
+        ct, last = prefill_cache(
+            pcfg_t, pt, ct, _prompt_shard(prompt, b, prompt_len, cfg),
+            spec_t, s_max,
+        )
+        pcfg_d = dataclasses.replace(
+            draft_cfg, seq=prompt_len, batch=b // n_o_d
+        )
+        cd, _ = prefill_cache(
+            pcfg_d, pd, cd, _prompt_shard(prompt, b, prompt_len, draft_cfg),
+            spec_d, s_max,
+        )
+        return ct, cd, jnp.argmax(last, axis=-1).astype(jnp.int32)
+
     def warm(pt, pd, ct, cd, prompt):
         # feed the prompt into BOTH caches; only the LAST position's
         # argmax is needed (carried, not stacked — a stacked
@@ -248,10 +275,19 @@ def speculative_generate(
     ps_t, ps_d = specs_for(cfg, params), specs_for(draft_cfg, draft_params)
     key = (cfg, draft_cfg, s_max, draft_k, fd_config, draft_fd_config,
            str(interpret))
+    if prefill:
+        for nm, n_o_x in (("target", n_o_t), ("draft", n_o_d)):
+            if (b * prompt_len) % (n * n_o_x):
+                raise ValueError(
+                    f"prefill warm-up shards b*prompt_len="
+                    f"{b * prompt_len} over the {nm}'s {n * n_o_x} PEs — "
+                    f"must divide evenly"
+                )
     warm_p = jit_shard_map(
-        warm, mesh, (ps_t, ps_d, cs_t, cs_d, P(None, None)),
+        warm_prefill if prefill else warm, mesh,
+        (ps_t, ps_d, cs_t, cs_d, P(None, None)),
         (cs_t, cs_d, P(None)),
-        key=("spec_warm", prompt_len, *key),
+        key=("spec_warm", prefill, prompt_len, *key),
     )
     draft_p = jit_shard_map(
         draft_roll, mesh, (ps_d, cs_d, P(None), P()),
