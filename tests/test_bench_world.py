@@ -1,0 +1,41 @@
+"""CI pin for the n>1 bench mode's CPU-fallback path (bench.py --world N
+— VERDICT r4 #5): one representative metric must run green on a virtual
+8-device mesh with the world-size-tagged metric name, so the staged
+multi-chip measurement path can't rot between hardware windows."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def test_bench_metric_cpu_fallback_world8():
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    env.update(
+        TDT_BENCH_PLATFORM="cpu",
+        TDT_BENCH_WORLD="8",
+        TDT_BENCH_SCALE="32",
+        TDT_BENCH_PAIR_ROUNDS="2",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        JAX_PLATFORMS="cpu",
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--metric", "gemm_rs"],
+        capture_output=True, text=True, timeout=1200, env=env, cwd=repo,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert lines, out.stdout
+    rec = json.loads(lines[-1])
+    # the metric name carries the pinned world size — the A/B ran the
+    # 8-PE ring, not the world-1 degenerate path
+    assert "_tp8_" in rec["metric"], rec
+    assert rec["vs_baseline"] > 0, rec

@@ -135,6 +135,13 @@ def test_speculative_matches_greedy_generate(mesh4, moe):
     )
     np.testing.assert_array_equal(np.asarray(got_pf), np.asarray(want))
 
+    if not moe:  # paged pools + static tables: the serving cache layout
+        got_paged = speculative_generate(
+            cfg, params, draft_cfg, draft_params, prompt, n_steps, mesh4,
+            s_max=s_max, draft_k=3, page_size=2,
+        )
+        np.testing.assert_array_equal(np.asarray(got_paged), np.asarray(want))
+
     # self-speculation (draft == target): every draft accepted, same tokens
     got_self = speculative_generate(
         cfg, params, cfg, params, prompt, n_steps, mesh4,
@@ -184,3 +191,11 @@ def test_speculative_hier_ep_target(mesh2x4, mesh4):
         prefill=True,
     )
     np.testing.assert_array_equal(np.asarray(got_pf), np.asarray(want))
+
+    # paged pools on the 2-axis deployment: per-group batch slices over
+    # composite (outer, inner) pool sharding, block tables per PE
+    got_paged = speculative_generate(
+        hier_cfg, params, draft_cfg, draft_params, prompt, n_steps, mesh2x4,
+        s_max=s_max, draft_k=3, page_size=2,
+    )
+    np.testing.assert_array_equal(np.asarray(got_paged), np.asarray(want))

@@ -349,6 +349,56 @@ class PagedKVCacheSpec:
         )
         return attn, cache
 
+    def update_multi_and_attend(
+        self, cfg, cache, li, k_new, v_new, q, pos0, me, n,
+        fd_config, interpret,
+    ):
+        """Speculative-verify append on the page pool: all (sequence,
+        chunk-position) pairs land in ONE scatter — ownership AND the
+        static block table gate the indices (non-owner pairs go out of
+        range and drop) — then the multi-row paged kernel attends via
+        the same table. Static tables only: the bump allocator hands out
+        pages one decode step at a time and cannot batch-claim a chunk
+        that opens several pages."""
+        from triton_dist_tpu.ops.flash_decode import (
+            paged_flash_verify_distributed,
+        )
+
+        if not self.static_table:
+            raise NotImplementedError(
+                "speculative verify on the paged cache needs "
+                "static_table=True (pre-assigned page ranges)"
+            )
+        S = k_new.shape[1]
+        s_shard = _shard_of(self.s_max, n)
+        pos_mat = pos0[:, None] + jnp.arange(S, dtype=jnp.int32)  # [b, S]
+        off_mat = pos_mat % s_shard
+        own = me == pos_mat // s_shard
+        bt = cache["block_table"][0]                       # [b, pps]
+        page_ids = jnp.take_along_axis(
+            bt, off_mat // self.page_size, axis=1
+        )                                                  # [b, S]
+        n_pool = cache["k"].shape[1]
+        safe_ids = jnp.where(own, page_ids, n_pool)        # OOB → dropped
+        slot = off_mat % self.page_size
+        kc = cache["k"][li].at[safe_ids, :, slot].set(
+            k_new.astype(cache["k"].dtype), mode="drop"
+        )
+        vc = cache["v"][li].at[safe_ids, :, slot].set(
+            v_new.astype(cache["v"].dtype), mode="drop"
+        )
+        cache = dict(
+            cache, k=cache["k"].at[li].set(kc), v=cache["v"].at[li].set(vc)
+        )
+        lens = jax.vmap(
+            lambda i: _local_lens(pos0 + i, me, s_shard), out_axes=1
+        )(jnp.arange(S))                                   # [b, S]
+        attn = paged_flash_verify_distributed(
+            q.astype(kc.dtype), kc, vc, lens, bt,
+            axis=cfg.axis, interpret=interpret,
+        )
+        return attn, cache
+
 
 def _decode_mlp(c, x, p, me, n, n_o, interpret):
     """Decode-shaped MLP residual on ``m`` replicated rows (``m`` =

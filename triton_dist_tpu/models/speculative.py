@@ -43,6 +43,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from triton_dist_tpu.models.decode import (
     KVCacheSpec,
+    PagedKVCacheSpec,
     _decode_mlp,
     _mesh_outer,
     _outer_dims,
@@ -67,7 +68,7 @@ def verify_step(
     tokens: jax.Array,   # [b, S] int32 — chunk inputs per sequence
     pos0: jax.Array,     # [] or [b] int32 — first chunk position
     *,
-    spec: KVCacheSpec,
+    spec: KVCacheSpec | PagedKVCacheSpec,
     fd_config: FlashDecodeConfig | None = None,
     interpret: Any = None,
 ) -> tuple[jax.Array, dict]:
@@ -78,11 +79,9 @@ def verify_step(
     decode_steps would produce, at one cache/weight pass. The chunk's k/v
     are appended (owner-gated per position) before attention; causality
     within the chunk rides the per-row prefix lengths."""
-    if not isinstance(spec, KVCacheSpec):
-        raise NotImplementedError(
-            "speculative verify needs the contiguous KV cache (paged "
-            "multi-position append is not wired yet)"
-        )
+    # cache layouts dispatch through spec.update_multi_and_attend
+    # (contiguous, or paged with a static table — the paged spec raises
+    # on the runtime bump allocator, which cannot batch-claim a chunk)
     # hierarchical deployment: DP attention per outer group exactly as in
     # decode_step — the group's batch slice, then the EP MLP spans the
     # mesh and the logits re-gather to the global layout
@@ -158,6 +157,7 @@ def speculative_generate(
     *,
     s_max: int,
     draft_k: int = 4,
+    page_size: int | None = None,
     fd_config: FlashDecodeConfig | None = None,
     draft_fd_config: FlashDecodeConfig | None = None,
     prefill: bool = False,
@@ -190,7 +190,19 @@ def speculative_generate(
         )
     if draft_k < 2:
         raise ValueError("draft_k must be >= 2 (k-1 accepted tokens max)")
-    spec_t, spec_d = KVCacheSpec(s_max), KVCacheSpec(s_max)
+    if page_size:
+        # the serving cache layout: page pools + STATIC tables (the
+        # chunk append batch-writes page ranges, like prefill) for both
+        # models; both verify and single-token decode ride the tables
+        if fd_config is not None or draft_fd_config is not None:
+            raise ValueError(
+                "fd_config tiles the contiguous kernel; with page_size "
+                "the page is the block — pass one or the other"
+            )
+        spec_t = PagedKVCacheSpec(s_max, page_size, static_table=True)
+        spec_d = PagedKVCacheSpec(s_max, page_size, static_table=True)
+    else:
+        spec_t, spec_d = KVCacheSpec(s_max), KVCacheSpec(s_max)
     n = mesh.shape[cfg.axis]
     # hierarchical targets serve on the 2-axis mesh (DP attention per
     # outer group — verify_step mirrors decode_step); a flat/dense DRAFT
@@ -273,8 +285,8 @@ def speculative_generate(
 
     cs_t, cs_d = spec_t.specs(cfg), spec_d.specs(draft_cfg)
     ps_t, ps_d = specs_for(cfg, params), specs_for(draft_cfg, draft_params)
-    key = (cfg, draft_cfg, s_max, draft_k, fd_config, draft_fd_config,
-           str(interpret))
+    key = (cfg, draft_cfg, s_max, draft_k, page_size, fd_config,
+           draft_fd_config, str(interpret))
     if prefill:
         for nm, n_o_x in (("target", n_o_t), ("draft", n_o_d)):
             if (b * prompt_len) % (n * n_o_x):

@@ -581,6 +581,129 @@ def flash_verify_distributed(
     return merged.reshape(b, S, hq, d)
 
 
+def _paged_flash_verify_kernel(
+    max_lens_ref, bt_ref, lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
+    m_scr, l_scr, acc_scr, *, n_chunks: int, page_size: int, scale: float,
+):
+    # the block table is consumed by the index_map only; the body is the
+    # contiguous verify body with page-sized chunks
+    del bt_ref
+    _flash_verify_body(
+        max_lens_ref, lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
+        m_scr, l_scr, acc_scr,
+        n_chunks=n_chunks, block_s=page_size, scale=scale,
+    )
+
+
+def paged_flash_verify(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    kv_lens: jax.Array,
+    block_table: jax.Array,
+    *,
+    return_lse: bool = False,
+    interpret: Any = None,
+):
+    """Multi-position decode over a PAGED cache — :func:`flash_verify`
+    with the block-table indirection of :func:`paged_flash_decode`: q
+    ``[b, S, q_heads, d]``, kv_lens ``[b, S]`` per-row prefix lengths,
+    pages/table as in the paged decode (the S chunk positions' k/v
+    already written into their pages). Per-head grid (the fused-heads
+    variant can follow the decode kernel's pattern when a pool's
+    per-head page fetches measure too small)."""
+    b, S, hq, d = q.shape
+    n_pages, h_kv, page_size, _ = k_pages.shape
+    assert hq % h_kv == 0, (hq, h_kv)
+    g = hq // h_kv
+    rows = S * g
+    max_pages = block_table.shape[1]
+    kv_lens = kv_lens.astype(jnp.int32)
+    q5 = (
+        q.reshape(b, S, h_kv, g, d)
+        .swapaxes(1, 2)
+        .reshape(b, h_kv, rows, d)
+        .astype(k_pages.dtype)
+    )
+    lens_rows = jnp.repeat(kv_lens, g, axis=1).reshape(b, 1, rows, 1)
+    max_lens = jnp.max(kv_lens, axis=1)
+    cost = pl.CostEstimate(
+        flops=4 * b * S * hq * max_pages * page_size * d,
+        bytes_accessed=(2 * b * h_kv * max_pages * page_size * d)
+        * k_pages.dtype.itemsize,
+        transcendentals=b * S * hq * max_pages * page_size,
+    )
+
+    def kv_index_map(i, j, c, max_lens_ref, bt_ref):
+        return (bt_ref[i, c], j, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h_kv, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, rows, 1), lambda i, j, c, *_: (i, 0, 0, 0)),
+            pl.BlockSpec((1, 1, rows, d), lambda i, j, c, *_: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), kv_index_map),
+            pl.BlockSpec((1, 1, page_size, d), kv_index_map),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, rows, d), lambda i, j, c, *_: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, rows, 1), lambda i, j, c, *_: (i, j, 0, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, d), jnp.float32),
+        ],
+    )
+    out, lse = dist_pallas_call(
+        functools.partial(
+            _paged_flash_verify_kernel,
+            n_chunks=max_pages, page_size=page_size,
+            scale=1.0 / math.sqrt(d),
+        ),
+        name="paged_flash_verify",
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h_kv, rows, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h_kv, rows, 1), jnp.float32),
+        ),
+        cost_estimate=cost,
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+        uses_barrier=False,
+        interpret=interpret,
+    )(max_lens, block_table.astype(jnp.int32), lens_rows, q5, k_pages, v_pages)
+    out = out.reshape(b, h_kv, S, g, d).swapaxes(1, 2).reshape(b, S, hq, d)
+    lse = lse.reshape(b, h_kv, S, g).swapaxes(1, 2).reshape(b, S, hq)
+    return (out, lse) if return_lse else out
+
+
+def paged_flash_verify_distributed(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    lens_shard: jax.Array,
+    block_table: jax.Array,
+    *,
+    axis: str = "tp",
+    ag_method: str = "full_mesh_push",
+    interpret: Any = None,
+) -> jax.Array:
+    """SP form of :func:`paged_flash_verify` (call inside shard_map):
+    per-shard multi-position partials over each PE's page pool, merged by
+    the shared (out ‖ lse) allgather tail."""
+    out, lse = paged_flash_verify(
+        q, k_pages, v_pages, lens_shard, block_table,
+        return_lse=True, interpret=interpret,
+    )
+    b, S, hq, d = out.shape
+    merged = _sp_allgather_combine(
+        out.reshape(b * S, hq, d), lse.reshape(b * S, hq), axis, ag_method,
+        interpret,
+    )
+    return merged.reshape(b, S, hq, d)
+
+
 def quantize_kv(k: jax.Array, v: jax.Array):
     """Per-(batch, head, position) absmax int8 quantization of a KV cache
     (k, v ``[b, h_kv, s, d]``) → ``(k_q, v_q, k_scale, v_scale)`` with
