@@ -60,8 +60,12 @@ from triton_dist_tpu.ops.flash_decode import (
     flash_decode_op,
     flash_decode_quant,
     flash_decode_quant_distributed,
+    flash_verify,
+    flash_verify_distributed,
     paged_flash_decode,
     paged_flash_decode_distributed,
+    paged_flash_verify,
+    paged_flash_verify_distributed,
     quantize_kv,
 )
 from triton_dist_tpu.ops.grads import ring_attention_grad
