@@ -22,11 +22,12 @@ Batch acceptance is LOCKSTEP (the round accepts ``min`` over sequences,
 capped at k-1): every slot advances the same number of positions per
 round, which keeps positions scalar and — with the k-1 cap — keeps the
 draft's cache rows equal to the accepted inputs without a catch-up step.
-Contiguous cache; every serving deployment composes — flat 1-axis
-(dense / TP-MoE / flat EP) and the hierarchical EP mesh (DP attention
-per outer group + the two-phase dispatch, mirrored from decode_step),
-including a flat/dense draft speculating for a hierarchical target on
-the same 2-axis mesh.
+Every serving deployment composes — flat 1-axis (dense / TP-MoE / flat
+EP) and the hierarchical EP mesh (DP attention per outer group + the
+two-phase dispatch, mirrored from decode_step), including a flat/dense
+draft speculating for a hierarchical target on the same 2-axis mesh —
+on EITHER cache layout (contiguous, or paged pools with static block
+tables via ``page_size=``).
 """
 
 from __future__ import annotations
@@ -171,10 +172,10 @@ def speculative_generate(
     forwards instead of ``n_steps``.
 
     `draft_cfg`/`draft_params` are a (smaller) model over the SAME vocab
-    and serving axis; both caches live on `mesh` (contiguous layout).
-    ``prefill=True`` warms BOTH caches through one full-forward prompt
-    pass each (MXU-rate admission, as in ``generate``) instead of
-    token-by-token."""
+    and serving axis; both caches live on `mesh` (contiguous by default,
+    page pools + static tables with ``page_size=``). ``prefill=True``
+    warms BOTH caches through one full-forward prompt pass each
+    (MXU-rate admission, as in ``generate``) instead of token-by-token."""
     from triton_dist_tpu.ops.common import jit_shard_map
 
     b, prompt_len = prompt.shape
