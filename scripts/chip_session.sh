@@ -1,16 +1,18 @@
 #!/usr/bin/env bash
-# One real-chip session, end to end (run whenever the accelerator tunnel
-# is up):
-#   1. correctness stress: >= 20 re-randomized, arena-poisoned passes of
-#      every op (exits nonzero on any golden mismatch)
-#   2. full autotune sweeps (TDT_BENCH_TUNE=1) — winners persist to
-#      .autotune_cache/ so later bounded-time bench runs (the driver's)
-#      resolve tuned configs without sweeping
-#   3. a bounded-time bench pass exactly as the driver runs it (the
-#      persistent .jax_cache/ written by step 2 makes this mostly
-#      compile-free)
-#   4. the native-serving round trip: AOT export -> C++ PJRT runner ->
-#      bit-exact byte-sum vs the jitted Python run
+# One real-chip session, end to end (chip_watch.sh fires this the moment
+# the accelerator tunnel comes up; run it manually any time the tunnel
+# is known up). Steps are ordered by EVIDENCE VALUE under a possibly
+# short tunnel window (rounds 2-4 each lost windows mid-session):
+#   1. full autotune sweeps (TDT_BENCH_TUNE=1) — the round's headline
+#      perf numbers (tuned winners persist to .autotune_cache/ so later
+#      bounded-time driver runs resolve them without sweeping)
+#   2. driver-mode bench (warm caches — what BENCH_r{N}.json records)
+#   3. correctness stress (re-randomized, arena-poisoned passes + the
+#      race-shaking pass when >1 chip)
+#   4. n>1 bench mode (real multi-chip A/Bs if chips exist)
+#   5. native PJRT runner round trip
+#   6. serving tokens/s (dense/MoE/w8/EP/hier-EP/speculative)
+#   7. native decode-step loop
 # Logs land in docs/chip_logs/ (commit them).
 #
 # NOTE: .autotune_cache/ and .jax_cache/ are gitignored, so the warm-up
@@ -26,34 +28,34 @@ cd "$(dirname "$0")/.."
 mkdir -p docs/chip_logs
 stamp=$(date -u +%Y%m%d_%H%M)
 
-echo "=== [1/6] smoke stress"
-timeout 3600 python scripts/tpu_smoke.py > "docs/chip_logs/${stamp}_smoke.log" 2>&1
-smoke_rc=$?
-echo "smoke rc=$smoke_rc" >> "docs/chip_logs/${stamp}_smoke.log"
-
-echo "=== [2/6] bench with full sweeps (warms .autotune_cache/ + .jax_cache/)"
+echo "=== [1/7] bench with full sweeps (warms .autotune_cache/ + .jax_cache/)"
 TDT_BENCH_TUNE=1 timeout 3600 python bench.py > "docs/chip_logs/${stamp}_bench_tuned.log" 2>&1
 tuned_rc=$?
 echo "tuned rc=$tuned_rc" >> "docs/chip_logs/${stamp}_bench_tuned.log"
 
-echo "=== [3/6] bounded-time bench (driver mode, warm caches)"
+echo "=== [2/7] bounded-time bench (driver mode, warm caches)"
 timeout 1800 python bench.py > "docs/chip_logs/${stamp}_bench_driver_mode.log" 2>&1
 driver_rc=$?
 echo "driver rc=$driver_rc" >> "docs/chip_logs/${stamp}_bench_driver_mode.log"
 
-echo "=== [3b] n>1 bench mode (multi-chip A/B if the backend has chips;"
+echo "=== [3/7] smoke stress"
+timeout 3600 python scripts/tpu_smoke.py > "docs/chip_logs/${stamp}_smoke.log" 2>&1
+smoke_rc=$?
+echo "smoke rc=$smoke_rc" >> "docs/chip_logs/${stamp}_smoke.log"
+
+echo "=== [4/7] n>1 bench mode (multi-chip A/B if the backend has chips;"
 echo "    8-virtual-device CPU structural validation otherwise)"
 TDT_BENCH_PROBE_BUDGET=60 timeout 3600 python bench.py --world 8 \
   > "docs/chip_logs/${stamp}_bench_world8.log" 2>&1
 world_rc=$?
 echo "world8 rc=$world_rc" >> "docs/chip_logs/${stamp}_bench_world8.log"
 
-echo "=== [4/6] native PJRT runner round trip"
+echo "=== [5/7] native PJRT runner round trip"
 timeout 900 bash scripts/pjrt_runner_check.sh > "docs/chip_logs/${stamp}_pjrt_runner.log" 2>&1
 pjrt_rc=$?
 echo "pjrt rc=$pjrt_rc" >> "docs/chip_logs/${stamp}_pjrt_runner.log"
 
-echo "=== [5/6] serving throughput (continuous batching, tokens/s)"
+echo "=== [6/7] serving throughput (continuous batching, tokens/s)"
 {
   timeout 1800 python scripts/serving_bench.py
   serving_rc=$?
@@ -77,10 +79,10 @@ echo "serving rc=$serving_rc moe=$moe_rc moe_w8=$moe_q_rc ep=$ep_rc ep_hier=$eph
   >> "docs/chip_logs/${stamp}_serving.log"
 serving_rc=$(( serving_rc || moe_rc || moe_q_rc || ep_rc || eph_rc || spec_rc ))
 
-echo "=== [6/6] native decode-step loop (pjrt_runner vs python, tokens/s)"
+echo "=== [7/7] native decode-step loop (pjrt_runner vs python, tokens/s)"
 timeout 1800 bash scripts/native_serving_bench.sh > "docs/chip_logs/${stamp}_native_serving.log" 2>&1
 native_rc=$?
 echo "native serving rc=$native_rc" >> "docs/chip_logs/${stamp}_native_serving.log"
 
-echo "rc: smoke=$smoke_rc tuned=$tuned_rc driver=$driver_rc world8=$world_rc pjrt=$pjrt_rc serving=$serving_rc native=$native_rc"
-exit $(( smoke_rc || tuned_rc || driver_rc || world_rc || pjrt_rc || serving_rc || native_rc ))
+echo "rc: tuned=$tuned_rc driver=$driver_rc smoke=$smoke_rc world8=$world_rc pjrt=$pjrt_rc serving=$serving_rc native=$native_rc"
+exit $(( tuned_rc || driver_rc || smoke_rc || world_rc || pjrt_rc || serving_rc || native_rc ))
