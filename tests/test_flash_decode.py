@@ -346,3 +346,28 @@ def test_paged_flash_decode_head_fusion_paths(fuse_heads):
     got = paged_flash_decode(q, kp, vp, kv_lens, bt, fuse_heads=fuse_heads)
     want = _ref_decode(q, k, v, kv_lens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("fuse_heads", [True, False])
+def test_paged_flash_verify_grids(fuse_heads):
+    """Multi-position paged verify (speculative serving attention): both
+    grid shapes — fused-heads (one DMA per physical page, the serving
+    default) and per-head — match the contiguous XLA verify golden over
+    a shuffled page pool with per-row prefix lengths."""
+    from triton_dist_tpu.ops.flash_decode import _xla_verify, paged_flash_verify
+
+    b, S, h_kv, g, d, page = 2, 3, 2, 2, 64, 8
+    hq = h_kv * g
+    q = jax.random.normal(jax.random.PRNGKey(70), (b, S, hq, d), jnp.float32)
+    kp = jax.random.normal(jax.random.PRNGKey(71), (8, h_kv, page, d), jnp.float32)
+    vp = jax.random.normal(jax.random.PRNGKey(72), (8, h_kv, page, d), jnp.float32)
+    bt = jnp.array([[6, 2, 4], [1, 3, 5]], jnp.int32)
+    pos0 = jnp.array([5, 13], jnp.int32)
+    lens = pos0[:, None] + jnp.arange(1, S + 1)[None, :]
+    got = paged_flash_verify(q, kp, vp, lens, bt, fuse_heads=fuse_heads)
+    kc = kp[bt].transpose(0, 2, 1, 3, 4).reshape(b, h_kv, 3 * page, d)
+    vc = vp[bt].transpose(0, 2, 1, 3, 4).reshape(b, h_kv, 3 * page, d)
+    want = _xla_verify(q, kc, vc, lens, return_lse=False)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )

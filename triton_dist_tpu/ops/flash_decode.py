@@ -175,34 +175,30 @@ def _flash_decode_kernel(
     )
 
 
-def _flash_decode_fused_heads_body(
-    kv_lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, out_ref, lse_ref,
-    m_scr, l_scr, acc_scr,
+def _fused_heads_core(
+    c, gate_len, row_len, q_ref, k_ref, v_ref, ks_ref, vs_ref, out_ref,
+    lse_ref, m_scr, l_scr, acc_scr,
     *, n_chunks: int, block_s: int, scale: float, h_kv: int,
 ):
-    """``fuse_heads`` decode body: grid (b, chunk), all kv heads of the
-    chunk arrive in ONE K slab + ONE V slab and the head loop unrolls
-    inside the step. Per-head math is identical to
-    :func:`_flash_decode_body`; scratches carry a leading h_kv dim."""
-    b_i = pl.program_id(0)
-    c = pl.program_id(1)
-
+    """Shared ``fuse_heads`` skeleton (decode AND verify): all kv heads of
+    the chunk arrive in ONE K slab + ONE V slab, the head loop unrolls
+    inside the step, scratches carry a leading h_kv dim. ``gate_len``
+    (scalar) skips whole chunks; ``row_len`` (scalar for decode, a
+    per-row column for verify) masks inside the step."""
     @pl.when(c == 0)
     def _():
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    kv_len = kv_lens_ref[b_i]
-
-    @pl.when(c * block_s < kv_len)
+    @pl.when(c * block_s < gate_len)
     def _():
         for j in range(h_kv):  # static unroll over the slab's heads
             m_scr[j], l_scr[j], acc_scr[j] = _online_softmax_step(
                 q_ref[0, j], k_ref[0, j], v_ref[0, j],
                 None if ks_ref is None else ks_ref[0, j],
                 None if vs_ref is None else vs_ref[0, j],
-                c * block_s, kv_len, scale,
+                c * block_s, row_len, scale,
                 m_scr[j], l_scr[j], acc_scr[j],
             )
 
@@ -211,6 +207,17 @@ def _flash_decode_fused_heads_body(
         out_ref[0], lse_ref[0] = _finalize_softmax(
             m_scr[:], l_scr[:], acc_scr[:]
         )
+
+
+def _flash_decode_fused_heads_body(
+    kv_lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, out_ref, lse_ref,
+    m_scr, l_scr, acc_scr, **kw,
+):
+    kv_len = kv_lens_ref[pl.program_id(0)]
+    _fused_heads_core(
+        pl.program_id(1), kv_len, kv_len, q_ref, k_ref, v_ref, ks_ref,
+        vs_ref, out_ref, lse_ref, m_scr, l_scr, acc_scr, **kw,
+    )
 
 
 def _flash_decode_fused_heads_kernel(
@@ -595,6 +602,24 @@ def _paged_flash_verify_kernel(
     )
 
 
+def _paged_flash_verify_fh_kernel(
+    max_lens_ref, bt_ref, lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
+    m_scr, l_scr, acc_scr,
+    *, n_chunks: int, page_size: int, scale: float, h_kv: int,
+):
+    """Fused-heads verify: one DMA per physical page (the decode serving
+    pools' grid), every head's S*g rows masking with the per-row length
+    column — the shared fused-heads skeleton with (gate=per-sequence
+    max, row=per-row column) lengths."""
+    del bt_ref
+    _fused_heads_core(
+        pl.program_id(1), max_lens_ref[pl.program_id(0)], lens_ref[0, 0],
+        q_ref, k_ref, v_ref, None, None, out_ref, lse_ref,
+        m_scr, l_scr, acc_scr,
+        n_chunks=n_chunks, block_s=page_size, scale=scale, h_kv=h_kv,
+    )
+
+
 def paged_flash_verify(
     q: jax.Array,
     k_pages: jax.Array,
@@ -602,6 +627,7 @@ def paged_flash_verify(
     kv_lens: jax.Array,
     block_table: jax.Array,
     *,
+    fuse_heads: bool | None = None,
     return_lse: bool = False,
     interpret: Any = None,
 ):
@@ -609,9 +635,11 @@ def paged_flash_verify(
     with the block-table indirection of :func:`paged_flash_decode`: q
     ``[b, S, q_heads, d]``, kv_lens ``[b, S]`` per-row prefix lengths,
     pages/table as in the paged decode (the S chunk positions' k/v
-    already written into their pages). Per-head grid (the fused-heads
-    variant can follow the decode kernel's pattern when a pool's
-    per-head page fetches measure too small)."""
+    already written into their pages). ``fuse_heads`` (None = the same
+    VMEM-aware auto as :func:`paged_flash_decode`, with the verify
+    rows' larger q/accumulator footprint counted): the fused grid
+    fetches each physical page in ONE DMA — the decode serving pools'
+    default — with the per-head grid as the many-kv-head fallback."""
     b, S, hq, d = q.shape
     n_pages, h_kv, page_size, _ = k_pages.shape
     assert hq % h_kv == 0, (hq, h_kv)
@@ -619,6 +647,17 @@ def paged_flash_verify(
     rows = S * g
     max_pages = block_table.shape[1]
     kv_lens = kv_lens.astype(jnp.int32)
+    if fuse_heads is None:
+        # the decode-style double-buffered page slabs PLUS everything the
+        # verify grid holds resident across the whole pass: the q block,
+        # the f32 out/lse blocks, and the f32 scratch accumulators
+        slab = h_kv * page_size * d * k_pages.dtype.itemsize
+        resident = h_kv * rows * (
+            d * k_pages.dtype.itemsize        # q block (cache dtype)
+            + (d + 1) * 4                     # out + lse blocks (f32)
+            + (d + 2) * 4                     # m/l/acc scratches (f32)
+        )
+        fuse_heads = 4 * slab + resident <= _fused_slab_vmem_budget()
     q5 = (
         q.reshape(b, S, h_kv, g, d)
         .swapaxes(1, 2)
@@ -633,6 +672,52 @@ def paged_flash_verify(
         * k_pages.dtype.itemsize,
         transcendentals=b * S * hq * max_pages * page_size,
     )
+    if fuse_heads:
+        def kv_index_map_fh(i, c, max_lens_ref, bt_ref):
+            return (bt_ref[i, c], 0, 0, 0)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, max_pages),
+            in_specs=[
+                pl.BlockSpec((1, 1, rows, 1), lambda i, c, *_: (i, 0, 0, 0)),
+                pl.BlockSpec((1, h_kv, rows, d), lambda i, c, *_: (i, 0, 0, 0)),
+                pl.BlockSpec((1, h_kv, page_size, d), kv_index_map_fh),
+                pl.BlockSpec((1, h_kv, page_size, d), kv_index_map_fh),
+            ],
+            out_specs=(
+                pl.BlockSpec((1, h_kv, rows, d), lambda i, c, *_: (i, 0, 0, 0)),
+                pl.BlockSpec((1, h_kv, rows, 1), lambda i, c, *_: (i, 0, 0, 0)),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((h_kv, rows, 1), jnp.float32),
+                pltpu.VMEM((h_kv, rows, 1), jnp.float32),
+                pltpu.VMEM((h_kv, rows, d), jnp.float32),
+            ],
+        )
+        out, lse = dist_pallas_call(
+            functools.partial(
+                _paged_flash_verify_fh_kernel,
+                n_chunks=max_pages, page_size=page_size,
+                scale=1.0 / math.sqrt(d), h_kv=h_kv,
+            ),
+            name="paged_flash_verify_fh",
+            grid_spec=grid_spec,
+            out_shape=(
+                jax.ShapeDtypeStruct((b, h_kv, rows, d), jnp.float32),
+                jax.ShapeDtypeStruct((b, h_kv, rows, 1), jnp.float32),
+            ),
+            cost_estimate=cost,
+            dimension_semantics=("parallel", "arbitrary"),
+            uses_barrier=False,
+            interpret=interpret,
+        )(
+            max_lens, block_table.astype(jnp.int32), lens_rows, q5,
+            k_pages, v_pages,
+        )
+        out = out.reshape(b, h_kv, S, g, d).swapaxes(1, 2).reshape(b, S, hq, d)
+        lse = lse.reshape(b, h_kv, S, g).swapaxes(1, 2).reshape(b, S, hq)
+        return (out, lse) if return_lse else out
 
     def kv_index_map(i, j, c, max_lens_ref, bt_ref):
         return (bt_ref[i, c], j, 0, 0)
@@ -686,6 +771,7 @@ def paged_flash_verify_distributed(
     block_table: jax.Array,
     *,
     axis: str = "tp",
+    fuse_heads: bool | None = None,
     ag_method: str = "full_mesh_push",
     interpret: Any = None,
 ) -> jax.Array:
@@ -694,7 +780,7 @@ def paged_flash_verify_distributed(
     the shared (out ‖ lse) allgather tail."""
     out, lse = paged_flash_verify(
         q, k_pages, v_pages, lens_shard, block_table,
-        return_lse=True, interpret=interpret,
+        fuse_heads=fuse_heads, return_lse=True, interpret=interpret,
     )
     b, S, hq, d = out.shape
     merged = _sp_allgather_combine(
