@@ -289,17 +289,20 @@ def bench_flash_decode_paged(mesh, n):
     kp = kp.at[bt.reshape(-1)].set(kc.reshape(b * ppseq, h_kv, page, d))
     vp = vp.at[bt.reshape(-1)].set(vc.reshape(b * ppseq, h_kv, page, d))
 
-    fused = lambda q, kp, vp: paged_flash_decode(q, kp, vp, kv_lens, bt)
+    # both sides take every array as a PARAMETER: closing over k/v would
+    # bake 100s of MB of literals into the jitted program, which the axon
+    # remote-compile tunnel rejects (HTTP 413, observed r5 chip session)
+    fused = lambda q, kp, vp, k, v: paged_flash_decode(q, kp, vp, kv_lens, bt)
 
     @jax.jit
-    def xla_contig(q, kp, vp):
+    def xla_contig(q, kp, vp, k, v):
         # same logical attention, contiguous layout (kp/vp consumed so the
         # paired loop's perturbation chain stays well-formed)
         del kp, vp
         return _xla_decode(q, k, v, kv_lens, return_lse=False)
 
-    out = fused(q, kp, vp)
-    ref = xla_contig(q, kp, vp)
+    out = fused(q, kp, vp, k, v)
+    ref = xla_contig(q, kp, vp, k, v)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), atol=2e-2, rtol=2e-2
     )
@@ -307,7 +310,7 @@ def bench_flash_decode_paged(mesh, n):
     # the Pallas kernel (no XLA sentinel to collapse to), and interpreted
     # kernel steps are ~1000× a real chip's
     t_f, t_b, ratio = bench_pair(
-        fused, xla_contig, (q, kp, vp), iters=_it(_it(1500))
+        fused, xla_contig, (q, kp, vp, k, v), iters=_it(_it(1500))
     )
     emit(
         f"flash_decode_paged_us_b{b}hq{hq}kv{h_kv}s{s}p{page}",
@@ -329,23 +332,24 @@ def bench_flash_decode_int8(mesh, n):
     k_q, v_q, ks, vs = quantize_kv(k, v)
     cfg = FlashDecodeConfig(block_s=2048, fuse_heads=True)
 
-    fused = lambda q, k_q, v_q: flash_decode_quant(
+    # k/v as parameters, not closures — see bench_flash_decode_paged
+    fused = lambda q, k_q, v_q, k, v: flash_decode_quant(
         q, k_q, v_q, ks, vs, kv_lens, config=cfg
     )
 
     @jax.jit
-    def xla_bf16(q, k_q, v_q):
+    def xla_bf16(q, k_q, v_q, k, v):
         del k_q, v_q
         return _xla_decode(q, k, v, kv_lens, return_lse=False)
 
-    out = fused(q, k_q, v_q)
-    ref = xla_bf16(q, k_q, v_q)
+    out = fused(q, k_q, v_q, k, v)
+    ref = xla_bf16(q, k_q, v_q, k, v)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), atol=8e-2, rtol=8e-2
     )
     # quadratic plumbing-mode shrink: see bench_flash_decode_paged
     t_f, t_b, ratio = bench_pair(
-        fused, xla_bf16, (q, k_q, v_q), iters=_it(_it(1500))
+        fused, xla_bf16, (q, k_q, v_q, k, v), iters=_it(_it(1500))
     )
     emit(
         f"flash_decode_int8_us_b{b}hq{hq}kv{h_kv}s{s}",
@@ -454,21 +458,25 @@ def bench_moe_w8(mesh, n):
     cfg = GroupGemmConfig(bm, 1024, 512)
     eids = al.expert_ids
 
-    fused = lambda xs, w_q, scale: group_gemm_w8(
+    # w as a parameter, not a closure: baked-literal programs exceed the
+    # axon remote-compile body limit (see bench_flash_decode_paged)
+    fused = lambda xs, w_q, scale, w: group_gemm_w8(
         xs, w_q, scale, eids, config=cfg
     )
 
-    def bf16(xs, w_q, scale):
+    def bf16(xs, w_q, scale, w):
         del w_q, scale
         return group_gemm(xs, w, eids, config=cfg)
 
-    out = fused(xs, w_q, scale)
-    ref = bf16(xs, w_q, scale)
+    out = fused(xs, w_q, scale, w)
+    ref = bf16(xs, w_q, scale, w)
     np.testing.assert_allclose(
         np.asarray(out[:64], np.float32), np.asarray(ref[:64], np.float32),
         atol=0.5, rtol=6e-2,
     )
-    t_f, t_b, ratio = bench_pair(fused, bf16, (xs, w_q, scale), iters=_it(200))
+    t_f, t_b, ratio = bench_pair(
+        fused, bf16, (xs, w_q, scale, w), iters=_it(200)
+    )
     emit(
         f"moe_w8_decode_gemm_ms_m{m_tok}e{n_exp}k{topk}h{h_dim}f{f_dim}",
         t_f, "ms", ratio,

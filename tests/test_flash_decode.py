@@ -362,7 +362,10 @@ def test_paged_flash_verify_grids(fuse_heads):
     kp = jax.random.normal(jax.random.PRNGKey(71), (8, h_kv, page, d), jnp.float32)
     vp = jax.random.normal(jax.random.PRNGKey(72), (8, h_kv, page, d), jnp.float32)
     bt = jnp.array([[6, 2, 4], [1, 3, 5]], jnp.int32)
-    pos0 = jnp.array([5, 13], jnp.int32)
+    # pos0=7 puts row 0's span entirely inside page 0 while the seq's max
+    # len (10) admits chunk 1 — a fully-masked row in an ACTIVE chunk, the
+    # verify-specific case the online-softmax NaN guard (m_safe) exists for
+    pos0 = jnp.array([7, 13], jnp.int32)
     lens = pos0[:, None] + jnp.arange(1, S + 1)[None, :]
     got = paged_flash_verify(q, kp, vp, lens, bt, fuse_heads=fuse_heads)
     kc = kp[bt].transpose(0, 2, 1, 3, 4).reshape(b, h_kv, 3 * page, d)
