@@ -1,0 +1,183 @@
+"""Chip experiment: where does the MoE grouped-GEMM MFU go, and which
+kernel structure gets it back? (VERDICT r4 #2: 99.8 measured r3 ->
+113.0 with block_m=512 in the r5 sweep -> target >= 140 TFLOPS.)
+
+Run SOLO on the real chip (competes for the one core + chip):
+
+    python scripts/moe_mfu_experiment.py            # full matrix
+    python scripts/moe_mfu_experiment.py quick      # first config per arm
+
+Decomposes the bench-shape MoE MLP (M=8192 tokens, topk=2 -> 16384
+sorted rows, E=8, K=4096, N=14336 up / reversed down) into:
+
+  A. pure grouped-GEMM time per candidate tiling, up and down proj.
+     Hypothesis under test: with multi-step K (block_k < K) the B
+     operand is re-fetched per 512-row block (the k loop cycles the
+     B index between same-expert m-blocks, so Pallas's
+     consecutive-same-index copy elision never fires); block_k = K
+     makes the grid's last dim trivial, B's index depends only on
+     (expert_of(i), j), and consecutive same-expert blocks reuse the
+     resident tile -> each expert strip streams once per n-tile.
+  B. jax.lax.ragged_dot on the same sorted rows (XLA's native grouped
+     GEMM; whatever Mosaic path it lowers to is free perf if faster).
+  C. the alignment/gather/scatter overhead around the GEMMs (full
+     tp_moe_mlp_op pipeline minus 2x the best pure-GEMM time).
+
+Prints one line per measurement: arm, config, ms, TFLOPS (per-GEMM
+flops = 2 * rows * K * N with rows = the UNPADDED 16384 — padding work
+is priced as overhead, matching bench.py's accounting).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
+from triton_dist_tpu.ops.moe_utils import moe_align_block_size, select_experts
+from triton_dist_tpu.utils import perf_func_loop
+
+QUICK = len(sys.argv) > 1 and sys.argv[1] == "quick"
+
+M_TOK, K_DIM, N_DIM, N_EXP, TOPK = 8192, 4096, 14336, 8, 2
+ROWS = M_TOK * TOPK
+
+
+def make_case(bm: int, k_dim: int, n_dim: int, seed: int = 11):
+    """Sorted, block-aligned activation rows + expert ids at block size
+    ``bm`` for a [k_dim -> n_dim] expert GEMM (same construction as
+    bench.py's bench_moe_w8, production routing via moe_align)."""
+    kx, kw, kl = jax.random.split(jax.random.PRNGKey(seed), 3)
+    _, ids = select_experts(
+        jax.random.normal(kl, (M_TOK, N_EXP), jnp.float32), TOPK
+    )
+    al = moe_align_block_size(ids.reshape(-1), N_EXP, bm)
+    x = jax.random.normal(kx, (M_TOK, k_dim), jnp.bfloat16)
+    sti = al.sorted_token_ids
+    xs = jnp.where(
+        (sti < ROWS)[:, None], x[jnp.clip(sti // TOPK, 0, M_TOK - 1)], 0
+    )
+    w = jax.random.normal(kw, (N_EXP, k_dim, n_dim), jnp.bfloat16) / 16
+    return jax.block_until_ready(xs), jax.block_until_ready(w), al
+
+
+def tflops(rows: int, k_dim: int, n_dim: int, ms: float) -> float:
+    return 2 * rows * k_dim * n_dim / (ms * 1e-3) / 1e12
+
+
+def run_group_gemm_arm():
+    # (block_m, block_n, block_k); block_k == K rows are the elision arm
+    candidates = [
+        (512, 1024, 1024),   # r5 sweep winner: the baseline to beat
+        (512, 1024, 0),      # block_k = K (single k step, B elision)
+        (512, 2048, 0),
+        (1024, 1024, 0),
+        (2048, 1024, 0),
+        (512, 512, 0),
+        (1024, 2048, 0),
+    ]
+    if QUICK:
+        candidates = candidates[:2]
+    for proj, (k_dim, n_dim) in (
+        ("up", (K_DIM, N_DIM)), ("down", (N_DIM, K_DIM)),
+    ):
+        for bm, bn, bk in candidates:
+            bk_eff = bk or k_dim
+            if bm * bk_eff + 2 * (bk_eff * bn + bm * bn) > 48 * 2**20:
+                # rough VMEM guard: skip tilings whose working set
+                # (A + 2x B + acc+out, bf16/f32 mixed, halved) can't fit
+                print(f"group_gemm {proj} bm={bm} bn={bn} bk={bk_eff}: "
+                      "skipped (VMEM)")
+                continue
+            xs, w, al = make_case(bm, k_dim, n_dim)
+            cfg = GroupGemmConfig(bm, bn, bk_eff)
+            try:
+                ms = perf_func_loop(
+                    lambda xs, w: group_gemm(
+                        xs, w, al.expert_ids, config=cfg
+                    ),
+                    (xs, w), iters=30 if QUICK else 60,
+                )
+            except Exception as e:  # noqa: BLE001 - sweep must survive
+                print(f"group_gemm {proj} bm={bm} bn={bn} bk={bk_eff}: "
+                      f"FAILED {type(e).__name__}: {e}")
+                continue
+            print(
+                f"group_gemm {proj} bm={bm} bn={bn} bk={bk_eff}: "
+                f"{ms:.3f} ms  {tflops(ROWS, k_dim, n_dim, ms):.1f} TFLOPS"
+            )
+
+
+def run_ragged_arm():
+    """lax.ragged_dot over the same sorted rows. Group sizes = padded
+    per-expert row counts (padding rows carry zeros; their flops are the
+    alignment tax and are billed to the measured time, not the flop
+    numerator — same accounting as the Pallas arm)."""
+    for proj, (k_dim, n_dim) in (
+        ("up", (K_DIM, N_DIM)), ("down", (N_DIM, K_DIM)),
+    ):
+        bm = 512
+        xs, w, al = make_case(bm, k_dim, n_dim)
+        counts = jnp.bincount(
+            jnp.clip(al.expert_ids, 0, N_EXP - 1), length=N_EXP
+        ) * bm
+        try:
+            ms = perf_func_loop(
+                lambda xs, w: jax.lax.ragged_dot(xs, w, counts),
+                (xs, w), iters=30 if QUICK else 60,
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"ragged_dot {proj}: FAILED {type(e).__name__}: {e}")
+            continue
+        print(
+            f"ragged_dot {proj} (bm={bm} aligned): "
+            f"{ms:.3f} ms  {tflops(ROWS, k_dim, n_dim, ms):.1f} TFLOPS"
+        )
+
+
+def run_pipeline_arm():
+    """Full tp_moe_mlp_op on a world-1 mesh — the bench's 113-TFLOPS
+    number, re-measured here so overhead = pipeline - (up + down)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from triton_dist_tpu.ops.grads import tp_moe_mlp_op
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    kx, ku, kd, kl = jax.random.split(jax.random.PRNGKey(5), 4)
+    x = jax.random.normal(kx, (M_TOK, K_DIM), jnp.bfloat16)
+    w_up = jax.random.normal(ku, (N_EXP, K_DIM, N_DIM), jnp.bfloat16) / 32
+    w_down = jax.random.normal(kd, (N_EXP, N_DIM, K_DIM), jnp.bfloat16) / 32
+    tw, ids = select_experts(
+        jax.random.normal(kl, (M_TOK, N_EXP), jnp.float32), TOPK
+    )
+    dev = lambda a, s: jax.device_put(a, NamedSharding(mesh, s))
+    args = (
+        dev(x, P("tp", None)), dev(w_up, P(None, None, "tp")),
+        dev(w_down, P(None, "tp", None)), dev(ids, P("tp", None)),
+        dev(tw.astype(jnp.float32), P("tp", None)),
+    )
+    for overlap in (True, False):
+        ms = perf_func_loop(
+            lambda x, wu, wd, ids, tw: tp_moe_mlp_op(
+                x, wu, wd, ids, tw, mesh, overlap=overlap
+            ),
+            args, iters=8 if QUICK else 16,
+        )
+        fl = 2 * 2 * M_TOK * TOPK * K_DIM * N_DIM
+        print(
+            f"tp_moe_mlp_op overlap={overlap}: {ms:.3f} ms  "
+            f"{fl / (ms * 1e-3) / 1e12:.1f} TFLOPS"
+        )
+
+
+if __name__ == "__main__":
+    assert jax.devices()[0].platform == "tpu", jax.devices()
+    print(f"chip: {jax.devices()[0]}")
+    run_group_gemm_arm()
+    run_ragged_arm()
+    run_pipeline_arm()

@@ -78,7 +78,7 @@ for _ in range(iters):
     jax.block_until_ready(out[0])
 py_s = (time.perf_counter() - t0) / iters
 with open("/tmp/tdt_decode_step.py_tps", "w") as f:
-    f.write(f"{batch / py_s:.1f} {py_s * 1e3:.3f}")
+    f.write(f"{batch / py_s:.1f} {py_s * 1e3:.3f}\n")
 
 try:
     cmd = aot.export_pjrt(flat_step, leaves, "/tmp/tdt_decode_step.bin")
@@ -120,11 +120,29 @@ else
   OPTS=()
 fi
 
-# shellcheck disable=SC2046
-OUT=$(./csrc/pjrt_runner "$PLUGIN" "$EXE" "${OPTS[@]}" \
-      $(cat "$SPEC_FILE") --iters "$ITERS" 2>/dev/null | tail -1)
+# The relay serves one session at a time and the exporter's teardown
+# overlaps the runner's dial for a few seconds — retry instead of dying
+# on the first connect (observed: first attempt fails right after the
+# python process exits, an identical retry succeeds).
+OUT=""
+for attempt in 1 2 3; do
+  # shellcheck disable=SC2046
+  if RAW=$(./csrc/pjrt_runner "$PLUGIN" "$EXE" "${OPTS[@]}" \
+        $(cat "$SPEC_FILE") --iters "$ITERS" 2>&1); then
+    # pick the result line explicitly: stderr is merged for diagnostics,
+    # so `tail -1` could hand a late plugin log line to the sed below
+    OUT=$(grep -E 'avg [0-9.]+ ms' <<<"$RAW" | tail -1)
+    [ -n "$OUT" ] && break
+  fi
+  echo "runner attempt $attempt failed: $(tail -3 <<<"$RAW")" >&2
+  OUT=""
+  if [ "$attempt" -lt 3 ]; then sleep 20; fi
+done
+[ -n "$OUT" ] || { echo "pjrt_runner failed after 3 attempts"; exit 1; }
 AVG_MS=$(sed -E 's/.*avg ([0-9.]+) ms.*/\1/' <<<"$OUT")
-read -r PY_TPS PY_MS < "$PY_TPS_FILE"
+# `|| :`: read returns EOF (rc 1) on a newline-less final line, which
+# set -e turned into a silent mid-script death (the original native=1)
+read -r PY_TPS PY_MS < "$PY_TPS_FILE" || :
 NATIVE_TPS=$(python -c "print(f'{$BATCH / ($AVG_MS / 1e3):.1f}')")
 RATIO=$(python -c "print(f'{$NATIVE_TPS / $PY_TPS:.3f}')")
 echo "decode step b=$BATCH layers=$N_LAYERS: native $NATIVE_TPS tok/s ($AVG_MS ms/step), python $PY_TPS tok/s ($PY_MS ms/step), native/python = $RATIO"
