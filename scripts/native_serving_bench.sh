@@ -131,7 +131,8 @@ for attempt in 1 2 3; do
         $(cat "$SPEC_FILE") --iters "$ITERS" 2>&1); then
     # pick the result line explicitly: stderr is merged for diagnostics,
     # so `tail -1` could hand a late plugin log line to the sed below
-    OUT=$(grep -E 'avg [0-9.]+ ms' <<<"$RAW" | tail -1)
+    # `|| :`: grep rc=1 on no match would set -e the whole script here
+    OUT=$(grep -E 'avg [0-9.]+ ms' <<<"$RAW" | tail -1 || :)
     [ -n "$OUT" ] && break
   fi
   echo "runner attempt $attempt failed: $(tail -3 <<<"$RAW")" >&2
