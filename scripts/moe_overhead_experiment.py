@@ -1,0 +1,110 @@
+"""Chip experiment #2: decompose the MoE MLP pipeline's non-GEMM
+overhead (moe_mfu_experiment.py measured pure grouped GEMMs at
+142/146 TFLOPS but the pipeline at 116 — ~6.2 ms of the 33 ms step is
+NOT the two GEMMs). Times each stage of the world-1 sequential path
+separately on the real chip:
+
+    python scripts/moe_overhead_experiment.py
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, ".")
+
+from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
+from triton_dist_tpu.ops.moe_utils import moe_align_block_size, select_experts
+from triton_dist_tpu.utils import perf_func_loop
+
+M_TOK, K_DIM, N_DIM, N_EXP, TOPK, BM = 8192, 4096, 14336, 8, 2, 512
+CFG = GroupGemmConfig(BM, 1024, 1024)
+
+
+def main():
+    assert jax.devices()[0].platform == "tpu", jax.devices()
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    kx, ku, kd, kl = jax.random.split(jax.random.PRNGKey(5), 4)
+    x = jax.random.normal(kx, (M_TOK, K_DIM), jnp.bfloat16)
+    w_up = jax.random.normal(ku, (N_EXP, K_DIM, N_DIM), jnp.bfloat16) / 32
+    w_down = jax.random.normal(kd, (N_EXP, N_DIM, K_DIM), jnp.bfloat16) / 32
+    tw, ids = select_experts(
+        jax.random.normal(kl, (M_TOK, N_EXP), jnp.float32), TOPK
+    )
+    tw = tw.astype(jnp.float32)
+
+    # pre-build the aligned layout once (its own stage times the build)
+    al = moe_align_block_size(ids.reshape(-1), N_EXP, BM)
+    sti = jax.block_until_ready(al.sorted_token_ids)
+    eids = al.expert_ids
+    t_pad = sti.shape[0]
+    print(f"t_pad={t_pad} ({t_pad - M_TOK * TOPK} padding rows)")
+
+    def stage(name, fn, args, iters=40, consume="all"):
+        ms = perf_func_loop(fn, args, iters=iters, consume=consume)
+        print(f"{name}: {ms:.3f} ms")
+        return ms
+
+    # 1. routing + alignment metadata (argsort machinery)
+    stage(
+        "align (select+sort+meta)",
+        lambda logits: moe_align_block_size(
+            jnp.argsort(-logits, axis=1)[:, :TOPK].reshape(-1)
+            .astype(jnp.int32), N_EXP, BM,
+        ).sorted_token_ids,
+        (jax.random.normal(kl, (M_TOK, N_EXP), jnp.float32),),
+    )
+
+    # 2. the gather: sorted padded rows from x
+    def gather(x):
+        return jnp.where(
+            (sti < M_TOK * TOPK)[:, None],
+            x[jnp.clip(sti // TOPK, 0, M_TOK - 1)], 0,
+        )
+
+    stage("gather rows", gather, (x,))
+    xs = jax.block_until_ready(jax.jit(gather)(x))
+
+    # 3/4. the two grouped GEMMs at the tuned tiling
+    up = lambda xs, w: group_gemm(xs, w, eids, config=CFG)
+    stage("up GEMM", up, (xs, w_up), consume="first")
+    h = jax.block_until_ready(jax.jit(up)(xs, w_up))
+
+    # 5. activation round trip (what an epilogue fusion would delete)
+    act = lambda h: jax.nn.silu(h.astype(jnp.float32)).astype(h.dtype)
+    stage("activation", act, (h,))
+    a = jax.block_until_ready(jax.jit(act)(h))
+
+    stage(
+        "down GEMM", lambda a, w: group_gemm(a, w, eids, config=CFG),
+        (a, w_down), consume="first",
+    )
+    y = jax.block_until_ready(
+        jax.jit(lambda a, w: group_gemm(a, w, eids, config=CFG))(a, w_down)
+    )
+
+    # 6. the weighted scatter-add combine back to token order
+    def combine(y, tw):
+        valid = sti < M_TOK * TOPK
+        tok = jnp.clip(sti // TOPK, 0, M_TOK - 1)
+        slot = jnp.clip(sti % TOPK, 0, TOPK - 1)
+        w_row = jnp.where(
+            valid, tw[tok, slot], 0.0
+        )[:, None].astype(jnp.float32)
+        return (
+            jnp.zeros((M_TOK, K_DIM), jnp.float32)
+            .at[tok].add(y.astype(jnp.float32) * w_row)
+            .astype(y.dtype)
+        )
+
+    stage("combine scatter-add", combine, (y, tw))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,6 +2,8 @@
 test_moe_reduce_rs.py: golden = torch grouped matmul + NCCL collectives;
 here per-expert einsum + lax collectives)."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -78,6 +80,26 @@ def test_gather_scatter_roundtrip():
     back = scatter_add_unsorted(rows, al, w, n_tokens)
     # each token appears topk times with weight 0.5 → back == x * topk * 0.5
     np.testing.assert_allclose(np.asarray(back), np.asarray(x), rtol=1e-5, atol=1e-5)
+    # the masked-scatter contract path (capacity-style alignments) must
+    # agree on a bijective alignment
+    back_sc = scatter_add_unsorted(rows, al, w, n_tokens, assume_bijective=False)
+    np.testing.assert_allclose(
+        np.asarray(back_sc), np.asarray(x), rtol=1e-5, atol=1e-5
+    )
+    # a DROPPED slot (simulated capacity overflow: its row goes sentinel)
+    # contributes zero under the masked path instead of shifting rows
+    al_drop = dataclasses.replace(
+        al,
+        sorted_token_ids=jnp.where(
+            al.sorted_token_ids == 0, n_tokens * topk, al.sorted_token_ids
+        ),
+    )
+    back_dr = scatter_add_unsorted(
+        rows, al_drop, w, n_tokens, assume_bijective=False
+    )
+    want = np.asarray(x).copy()
+    want[0] = want[0] / 2  # token 0 lost one of its two 0.5-weight slots
+    np.testing.assert_allclose(np.asarray(back_dr), want, rtol=1e-5, atol=1e-5)
 
 
 def _moe_golden(a, b, topk_ids):

@@ -290,11 +290,13 @@ def _tp_moe_forward_impl(x, w_up, w_down, topk_ids, topk_weights, axis,
             x, w_up, topk_ids, axis=axis, config=gg_config,
             gather_output=True, interpret=interpret,
         )
-        act = activation(h_sorted.astype(jnp.float32)).astype(x.dtype)
+        # no standalone activation pass: it rides the down-GEMM's A-tile
+        # load (group_gemm act_fn) — h_sorted stays pre-activation, which
+        # is exactly what the backward's residual wants
         out = moe_reduce_rs(
-            act, w_down, alignment, tw_full, axis=axis,
+            h_sorted, w_down, alignment, tw_full, axis=axis,
             n_tokens=n * m_loc, config=gg_config, out_dtype=x.dtype,
-            interpret=interpret,
+            act_fn=activation, interpret=interpret,
         ).astype(x.dtype)
     # a_sorted: block-aligned gathered rows [t_pad, H] — BOTH paths return
     # the sorted slab (the backward's direct input; raw gathered tokens are

@@ -34,7 +34,9 @@ class GroupGemmConfig:
     block_k: int = 512
 
 
-def _group_gemm_kernel(e_ref, a_ref, b_ref, o_ref, acc_ref, *, n_k: int, out_dtype):
+def _group_gemm_kernel(
+    e_ref, a_ref, b_ref, o_ref, acc_ref, *, n_k: int, out_dtype, act_fn=None,
+):
     del e_ref  # consumed by the index maps
     kk = pl.program_id(2)
 
@@ -42,8 +44,15 @@ def _group_gemm_kernel(e_ref, a_ref, b_ref, o_ref, acc_ref, *, n_k: int, out_dty
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
+    a = a_ref[:]
+    if act_fn is not None:
+        # fused producer activation on the A tile: VPU work hidden under
+        # the B-operand DMA, replacing a full separate HBM read+write
+        # pass over A (measured 0.9 ms at the bench shape). Numerics
+        # match the standalone pass: f32 activation, cast back.
+        a = act_fn(a.astype(jnp.float32)).astype(a_ref.dtype)
     acc_ref[:] += jnp.dot(
-        a_ref[:], b_ref[0], preferred_element_type=jnp.float32
+        a, b_ref[0], preferred_element_type=jnp.float32
     )
 
     @pl.when(kk == n_k - 1)
@@ -53,6 +62,7 @@ def _group_gemm_kernel(e_ref, a_ref, b_ref, o_ref, acc_ref, *, n_k: int, out_dty
 
 def _group_gemm_w8_kernel(
     e_ref, a_ref, b_ref, s_ref, o_ref, acc_ref, *, n_k: int, out_dtype,
+    act_fn=None,
 ):
     """int8-weight variant: the B tile streams at half the bytes (the
     resource the serving-shaped grouped GEMM is bound by), upcasts to the
@@ -66,8 +76,11 @@ def _group_gemm_w8_kernel(
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
+    a = a_ref[:]
+    if act_fn is not None:
+        a = act_fn(a.astype(jnp.float32)).astype(a_ref.dtype)
     acc_ref[:] += jnp.dot(
-        a_ref[:], b_ref[0].astype(a_ref.dtype),
+        a, b_ref[0].astype(a_ref.dtype),
         preferred_element_type=jnp.float32,
     )
 
@@ -84,6 +97,7 @@ def group_gemm(
     scale: jax.Array | None = None,
     config: GroupGemmConfig | None = None,
     out_dtype: Any = None,
+    act_fn: Any = None,
     interpret: Any = None,
 ) -> jax.Array:
     """``out[i*bm:(i+1)*bm] = a_sorted[i*bm:(i+1)*bm] @ b[expert_ids[i]]``.
@@ -91,6 +105,12 @@ def group_gemm(
     a_sorted: ``[t_pad, K]`` block-aligned rows; b: ``[E, K, N]``;
     expert_ids: ``[t_pad // block_m]`` int32 (runtime values — scalar
     prefetch). Returns ``[t_pad, N]``. Golden: ``jax.lax.ragged_dot``.
+
+    ``act_fn`` (e.g. ``jax.nn.silu``) is applied to every A tile inside
+    the kernel (f32, cast back to A's dtype) — the fused epilogue→
+    producer form of ``group_gemm(act(a), ...)`` that deletes the
+    standalone activation's full HBM pass over A; the redundant per-
+    n-tile VPU recompute hides under the B-operand stream.
 
     With ``scale`` (``[E, 1, N]`` f32 from
     :func:`quantize_expert_weights`), `b` is an int8-quantized weight
@@ -133,7 +153,7 @@ def group_gemm(
         args.append(scale.astype(jnp.float32))
         w_bytes = n_exp * k_dim * n_dim  # int8: 1 byte
     return dist_pallas_call(
-        functools.partial(kernel, n_k=n_k, out_dtype=out_dtype),
+        functools.partial(kernel, n_k=n_k, out_dtype=out_dtype, act_fn=act_fn),
         name=name,
         out_shape=jax.ShapeDtypeStruct((t_pad, n_dim), out_dtype),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -147,7 +167,10 @@ def group_gemm(
             flops=2 * t_pad * k_dim * n_dim,
             bytes_accessed=(t_pad * k_dim + t_pad * n_dim)
             * a_sorted.dtype.itemsize + w_bytes,
-            transcendentals=0,
+            # the fused act_fn re-runs over every A tile once per n-tile
+            transcendentals=(
+                t_pad * k_dim * (n_dim // bn) if act_fn is not None else 0
+            ),
         ),
         dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         uses_barrier=False,
@@ -175,6 +198,7 @@ def group_gemm_w8(
     *,
     config: GroupGemmConfig | None = None,
     out_dtype: Any = None,
+    act_fn: Any = None,
     interpret: Any = None,
 ) -> jax.Array:
     """:func:`group_gemm` over int8-quantized expert weights (from
@@ -189,7 +213,7 @@ def group_gemm_w8(
     Thin alias of :func:`group_gemm` with the ``scale`` operand."""
     return group_gemm(
         a_sorted, b_q, expert_ids, scale=scale, config=config,
-        out_dtype=out_dtype, interpret=interpret,
+        out_dtype=out_dtype, act_fn=act_fn, interpret=interpret,
     )
 
 

@@ -47,24 +47,31 @@ def moe_reduce_rs(
     rs_config: ReduceScatterConfig | None = None,
     rs_method: str = "auto",
     out_dtype: Any = None,
+    act_fn: Any = None,
+    assume_bijective: bool = True,
     interpret: Any = None,
 ) -> jax.Array:
     """MoE second GEMM + weighted combine + reduce-scatter (call inside
     ``jax.shard_map``; ≙ ``moe_reduce_rs``, reference moe_reduce_rs.py:882).
 
     h_sorted: ``[t_pad, f_loc]`` block-aligned expert-major hidden rows
-    (e.g. the activated output of :func:`ag_group_gemm`) — `f_loc` is this
-    PE's TP shard of the expert FFN dim. w_down: ``[E, f_loc, H]``.
-    topk_weights: ``[n_tokens, topk]`` routing weights of the *gathered*
-    tokens. Returns ``[n_tokens / n, H]`` — this PE's token chunk of the
-    fully-reduced MoE output.
+    (the activated output of :func:`ag_group_gemm` — or, with ``act_fn``,
+    its PRE-activation output: the activation then rides the grouped
+    GEMM's A-tile load instead of paying its own HBM pass, see
+    :func:`group_gemm`) — `f_loc` is this PE's TP shard of the expert FFN
+    dim. w_down: ``[E, f_loc, H]``. topk_weights: ``[n_tokens, topk]``
+    routing weights of the *gathered* tokens. Returns ``[n_tokens / n,
+    H]`` — this PE's token chunk of the fully-reduced MoE output.
     """
     out_dtype = out_dtype or h_sorted.dtype
     y_sorted = group_gemm(
         h_sorted, w_down, alignment.expert_ids, config=config,
-        out_dtype=jnp.float32, interpret=interpret,
+        out_dtype=jnp.float32, act_fn=act_fn, interpret=interpret,
     )
-    partial = scatter_add_unsorted(y_sorted, alignment, topk_weights, n_tokens)
+    partial = scatter_add_unsorted(
+        y_sorted, alignment, topk_weights, n_tokens,
+        assume_bijective=assume_bijective,
+    )
     return reduce_scatter(
         partial.astype(out_dtype), axis=axis, method=rs_method,
         config=rs_config, interpret=interpret,
@@ -376,11 +383,16 @@ def moe_reduce_rs_op(
     *,
     axis: str = "tp",
     config: GroupGemmConfig | None = None,
+    assume_bijective: bool = True,
     interpret: Any = None,
 ) -> jax.Array:
     """Host-level entry: `h_sorted` ``[t_pad, F]`` with F sharded over
     `axis`, `w_down` ``[E, F, H]`` sharded on F; alignment arrays and
-    weights replicated. Result ``[n_tokens, H]`` sharded on tokens."""
+    weights replicated. Result ``[n_tokens, H]`` sharded on tokens.
+
+    ``assume_bijective=False`` for externally-built capacity-style
+    alignments whose slots may be dropped to the sentinel — see
+    :func:`triton_dist_tpu.ops.moe_utils.scatter_add_unsorted`."""
     n_tokens = topk_weights.shape[0]
     topk = topk_weights.shape[1]
 
@@ -397,7 +409,8 @@ def moe_reduce_rs_op(
         )
         return moe_reduce_rs(
             h, w, alignment, tw, axis=axis, n_tokens=n_tokens,
-            config=config, interpret=interpret,
+            config=config, assume_bijective=assume_bijective,
+            interpret=interpret,
         )
 
     return jit_shard_map(
@@ -410,7 +423,10 @@ def moe_reduce_rs_op(
             P(None, None),
         ),
         P(axis, None),
-        key=("moe_reduce_rs", axis, config, n_tokens, topk, str(interpret)),
+        key=(
+            "moe_reduce_rs", axis, config, n_tokens, topk, assume_bijective,
+            str(interpret),
+        ),
     )(h_sorted, w_down, sorted_token_ids, expert_ids, topk_weights)
 
 
