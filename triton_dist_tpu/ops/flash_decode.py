@@ -45,19 +45,47 @@ from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.allgather import all_gather
 from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
 from triton_dist_tpu.parallel import topology
-from triton_dist_tpu.utils import pick_block
+from triton_dist_tpu.utils import cdiv, pick_block
 
 NEG_INF = float("-inf")
+
+
+# XLA's per-kernel scoped-vmem stack limit (the default
+# --xla_tpu_scoped_vmem_limit_kib): pipeline buffers + scratch of ONE
+# pallas_call must fit this, regardless of how much physical VMEM the
+# generation has — chip-measured r5: a 16.19 MB allocation is rejected
+# with "limit 16.00M" on v5e while vmem_bytes() reports 128 MB.
+_SCOPED_VMEM_LIMIT = 16 * 2**20
+
+# Per-step attention span both paged grids aim for when auto-picking
+# pages_per_step: the contiguous sweep's winning block_s on chip (r5) —
+# smaller spans pay the per-tile mask/max/exp/sum fixed costs too often.
+_TARGET_SPAN = 4096
+
+
+def _auto_pages_per_step(slab: int, page_size: int, max_pages: int) -> int:
+    """Page slots per grid step for a paged decode grid whose per-page
+    K or V slab is ``slab`` bytes: enough slots to reach the target
+    span (at least one when a single page already exceeds it), bounded
+    by the table width and by what the double-buffered K+V pipeline
+    (4·slab·P) affords under the scoped-VMEM budget. Returns 0 when not
+    even one slot fits — the caller must prefer the other grid."""
+    return min(
+        max(1, _TARGET_SPAN // page_size), max_pages,
+        _fused_slab_vmem_budget() // (4 * slab),
+    )
 
 
 def _fused_slab_vmem_budget() -> int:
     """fuse_heads auto-guard: the fused paged kernel's double-buffered K+V
     page slabs must fit this conservative VMEM slice (see
-    :func:`paged_flash_decode`). Half the generation's VMEM — accumulators,
-    q, outs and the compiler's own scratch share the other half. Derived
-    from the topology table (not a constant) so a generation with smaller
-    VMEM auto-selects the per-head grid instead of failing to compile."""
-    return topology.vmem_bytes() // 2
+    :func:`paged_flash_decode`). Bounded by BOTH the generation's VMEM
+    (half of it — accumulators, q, outs and the compiler's own scratch
+    share the rest) and XLA's scoped-vmem stack limit less a 2 MiB
+    allowance for those residents. Derived from the topology table (not
+    a constant) so a generation with smaller VMEM auto-selects the
+    per-head grid instead of failing to compile."""
+    return min(topology.vmem_bytes() // 2, _SCOPED_VMEM_LIMIT - 2 * 2**20)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -861,30 +889,105 @@ def flash_decode_quant_distributed(
 
 
 def _paged_flash_decode_kernel(
-    kv_lens_ref, block_table_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
-    m_scr, l_scr, acc_scr,
-    *, n_chunks: int, page_size: int, scale: float,
+    kv_lens_ref, block_table_ref, q_ref, *rest,
+    n_steps: int, pages_per_step: int, page_size: int,
+    scale: float,
 ):
-    # Same online-softmax body as the contiguous kernel; the difference is
-    # entirely in the index_map (physical page via the prefetched block
-    # table ≙ the reference's block_table indirection, flash_decode.py:136,203)
-    _flash_decode_kernel(
-        kv_lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
-        m_scr, l_scr, acc_scr,
-        n_chunks=n_chunks, block_s=page_size, scale=scale,
-    )
+    """Per-head paged decode, ``pages_per_step`` pages concatenated into
+    one [g, P·page] span per step — the per-head analogue of
+    :func:`_paged_flash_decode_fh_kernel` (same chip finding: the span,
+    not the indirection, is the cost; the contiguous winner's shape is
+    per-head block_s=4096 = 16 pages). Online-softmax body otherwise
+    matches the contiguous kernel; physical pages arrive via the
+    prefetched block table (≙ the reference's block_table indirection,
+    flash_decode.py:136,203)."""
+    del block_table_ref
+    P = pages_per_step
+    kv_refs = rest[: 2 * P]
+    out_ref, lse_ref, m_scr, l_scr, acc_scr = rest[2 * P :]
+    b_i, c = pl.program_id(0), pl.program_id(2)
+    kv_len = kv_lens_ref[b_i]
+
+    @pl.when(c == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # clamped duplicate tail slots are length-masked (see the fh kernel)
+    @pl.when(c * P * page_size < kv_len)
+    def _():
+        k_cat = jnp.concatenate(
+            [kv_refs[2 * p][0, 0] for p in range(P)], axis=0
+        ) if P > 1 else kv_refs[0][0, 0]
+        v_cat = jnp.concatenate(
+            [kv_refs[2 * p + 1][0, 0] for p in range(P)], axis=0
+        ) if P > 1 else kv_refs[1][0, 0]
+        m_scr[:], l_scr[:], acc_scr[:] = _online_softmax_step(
+            q_ref[0, 0], k_cat, v_cat, None, None,
+            c * P * page_size, kv_len, scale, m_scr[:], l_scr[:], acc_scr[:],
+        )
+
+    @pl.when(c == n_steps - 1)
+    def _():
+        out_ref[0, 0], lse_ref[0, 0] = _finalize_softmax(
+            m_scr[:], l_scr[:], acc_scr[:]
+        )
 
 
 def _paged_flash_decode_fh_kernel(
-    kv_lens_ref, block_table_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
-    m_scr, l_scr, acc_scr, **kw,
+    kv_lens_ref, block_table_ref, q_ref, *rest,
+    n_steps: int, pages_per_step: int, page_size: int,
+    scale: float, h_kv: int,
 ):
-    # block table is consumed by the index_map only
+    """Fused-heads paged decode, ``pages_per_step`` physical pages per
+    grid step, CONCATENATED into one attention span. Chip finding (r5):
+    the paged kernel's 571-vs-359 µs deficit against the contiguous
+    winner is NOT the page indirection — the contiguous fused-heads
+    kernel at block_s=256 measures the same 577 µs. The cost is the
+    tiny per-step softmax span the page size forces (mask/max/exp/sum
+    fixed costs per [g, 256] tile); the fix is the span, not the step
+    count. Each step's P page slots arrive through P separate (K, V)
+    BlockSpecs whose index maps read consecutive block-table columns
+    (one DMA per physical page, P in flight), and the kernel fuses them
+    into a single [g, P·page] online-softmax update per head — the same
+    compute shape as the contiguous kernel at block_s = P·page."""
+    # block table is consumed by the index maps only
     del block_table_ref
-    _flash_decode_fused_heads_body(
-        kv_lens_ref, q_ref, k_ref, v_ref, None, None, out_ref, lse_ref,
-        m_scr, l_scr, acc_scr, **kw,
-    )
+    P = pages_per_step
+    kv_refs = rest[: 2 * P]
+    out_ref, lse_ref, m_scr, l_scr, acc_scr = rest[2 * P :]
+    i, c = pl.program_id(0), pl.program_id(1)
+    kv_len = kv_lens_ref[i]
+
+    @pl.when(c == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # clamped duplicate tail slots (logical chunk >= max_pages) sit at
+    # span positions >= max_pages*page_size >= kv_len: length-masked
+    @pl.when(c * P * page_size < kv_len)
+    def _():
+        for j in range(h_kv):  # static unroll over the slab's heads
+            k_cat = jnp.concatenate(
+                [kv_refs[2 * p][0, j] for p in range(P)], axis=0
+            ) if P > 1 else kv_refs[0][0, j]
+            v_cat = jnp.concatenate(
+                [kv_refs[2 * p + 1][0, j] for p in range(P)], axis=0
+            ) if P > 1 else kv_refs[1][0, j]
+            m_scr[j], l_scr[j], acc_scr[j] = _online_softmax_step(
+                q_ref[0, j], k_cat, v_cat, None, None,
+                c * P * page_size, kv_len, scale,
+                m_scr[j], l_scr[j], acc_scr[j],
+            )
+
+    @pl.when(c == n_steps - 1)
+    def _():
+        out_ref[0], lse_ref[0] = _finalize_softmax(
+            m_scr[:], l_scr[:], acc_scr[:]
+        )
 
 
 def paged_flash_decode(
@@ -895,6 +998,7 @@ def paged_flash_decode(
     block_table: jax.Array,
     *,
     fuse_heads: bool | None = None,
+    pages_per_step: int | None = None,
     return_lse: bool = False,
     interpret: Any = None,
 ):
@@ -914,24 +1018,40 @@ def paged_flash_decode(
     pages exactly as the contiguous kernel streams chunks.
 
     ``fuse_heads``: a page holds every kv head's slab, so the fused-heads
-    grid (b, page) fetches each physical page in ONE DMA instead of one
-    2·page_size·d slice per (head, page) — at typical page sizes the
-    per-head fetches are tens of KB, far below DMA efficiency. Default
-    (None) = auto: fused whenever the double-buffered K+V page slabs fit
-    a conservative VMEM budget, per-head otherwise — so serving paths
-    (which reach here through the cache spec, with no kwarg to thread)
-    never fail compilation on many-kv-head pools. Pass True/False to pin.
+    grid (b, step) fetches each physical page in ONE DMA; the per-head
+    grid (b, h_kv, step) fetches page_size·d slices. Default (None) =
+    auto, decided by the per-step softmax SPAN each grid can afford
+    under the scoped-VMEM budget (r5 chip finding: span, not DMA size,
+    decides throughput — per-head at span 4096 measured 347 µs where
+    fused capped at 1792 gave 392, and the span-256 grids 577). Pass
+    True/False to pin.
+
+    ``pages_per_step``: physical pages CONCATENATED into one online-
+    softmax span per grid step (each page still its own DMA, P in
+    flight). None = auto: reach a 4096 span, bounded by the VMEM
+    budget and the table width. The one-page grids measured 571 µs vs
+    the contiguous kernel's 359 for identical bytes (r5); the span fix
+    recovers all of it and the indirection costs nothing.
     """
     b, hq, d = q.shape
     n_pages, h_kv, page_size, _ = k_pages.shape
     assert hq % h_kv == 0, (hq, h_kv)
     g = hq // h_kv
-    if fuse_heads is None:
-        # 2 operands (K+V) × 2 (double buffer) × slab bytes, against the
-        # generation-derived VMEM budget (see _fused_slab_vmem_budget)
-        slab = h_kv * page_size * d * k_pages.dtype.itemsize
-        fuse_heads = 4 * slab <= _fused_slab_vmem_budget()
     max_pages = block_table.shape[1]
+    slab_h = page_size * d * k_pages.dtype.itemsize
+    slab_f = h_kv * slab_h
+    if fuse_heads is None:
+        # span-driven choice (r5 chip finding: the per-step softmax span,
+        # not the page indirection or DMA size, decides throughput): each
+        # grid shape concatenates as many page slots as its double-
+        # buffered slabs afford — pick the grid that reaches the wider
+        # span; ties go to fused (one DMA per page covers all heads), but
+        # only when at least one fused slot actually fits the budget.
+        # This preserves the old guarantee that many-kv-head pools never
+        # fail to compile: per-head slabs are h_kv× smaller.
+        p_f = _auto_pages_per_step(slab_f, page_size, max_pages)
+        p_h = _auto_pages_per_step(slab_h, page_size, max_pages)
+        fuse_heads = p_f >= 1 and p_f >= p_h
     scale = 1.0 / math.sqrt(d)
     # match q to the page-pool dtype (same contract as flash_decode)
     q4 = q.reshape(b, h_kv, g, d).astype(k_pages.dtype)
@@ -942,16 +1062,29 @@ def paged_flash_decode(
         transcendentals=b * hq * max_pages * page_size,
     )
     if fuse_heads:
-        def kv_index_map_fh(i, c, kv_lens_ref, bt_ref):
-            return (bt_ref[i, c], 0, 0, 0)
+        if pages_per_step is None:
+            pages_per_step = max(
+                1, _auto_pages_per_step(slab_f, page_size, max_pages)
+            )
+        P = pages_per_step
+        n_steps = cdiv(max_pages, P)
 
+        def kv_index_map_p(p):
+            def index_map(i, c, kv_lens_ref, bt_ref):
+                return (
+                    bt_ref[i, jnp.minimum(c * P + p, max_pages - 1)], 0, 0, 0,
+                )
+            return index_map
+
+        page_spec = lambda p: pl.BlockSpec(
+            (1, h_kv, page_size, d), kv_index_map_p(p)
+        )
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(b, max_pages),
+            grid=(b, n_steps),
             in_specs=[
                 pl.BlockSpec((1, h_kv, g, d), lambda i, c, *_: (i, 0, 0, 0)),
-                pl.BlockSpec((1, h_kv, page_size, d), kv_index_map_fh),
-                pl.BlockSpec((1, h_kv, page_size, d), kv_index_map_fh),
+                *(page_spec(p) for p in range(P) for _ in (0, 1)),
             ],
             out_specs=(
                 pl.BlockSpec((1, h_kv, g, d), lambda i, c, *_: (i, 0, 0, 0)),
@@ -966,8 +1099,8 @@ def paged_flash_decode(
         out, lse = dist_pallas_call(
             functools.partial(
                 _paged_flash_decode_fh_kernel,
-                n_chunks=max_pages, block_s=page_size, scale=scale,
-                h_kv=h_kv,
+                n_steps=n_steps, pages_per_step=P,
+                page_size=page_size, scale=scale, h_kv=h_kv,
             ),
             name="paged_flash_decode_fh",
             grid_spec=grid_spec,
@@ -981,22 +1114,33 @@ def paged_flash_decode(
             interpret=interpret,
         )(
             kv_lens.astype(jnp.int32), block_table.astype(jnp.int32),
-            q4, k_pages, v_pages,
+            q4, *(kv for _ in range(P) for kv in (k_pages, v_pages)),
         )
         out = out.reshape(b, hq, d)
         lse = lse.reshape(b, hq)
         return (out, lse) if return_lse else out
 
-    def kv_index_map(i, j, c, kv_lens_ref, bt_ref):
-        return (bt_ref[i, c], j, 0, 0)
+    if pages_per_step is None:
+        pages_per_step = max(
+            1, _auto_pages_per_step(slab_h, page_size, max_pages)
+        )
+    P = pages_per_step
+    n_steps = cdiv(max_pages, P)
 
+    def kv_index_map_p(p):
+        def index_map(i, j, c, kv_lens_ref, bt_ref):
+            return (bt_ref[i, jnp.minimum(c * P + p, max_pages - 1)], j, 0, 0)
+        return index_map
+
+    page_spec = lambda p: pl.BlockSpec(
+        (1, 1, page_size, d), kv_index_map_p(p)
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b, h_kv, max_pages),
+        grid=(b, h_kv, n_steps),
         in_specs=[
             pl.BlockSpec((1, 1, g, d), lambda i, j, c, *_: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d), kv_index_map),
-            pl.BlockSpec((1, 1, page_size, d), kv_index_map),
+            *(page_spec(p) for p in range(P) for _ in (0, 1)),
         ],
         out_specs=(
             pl.BlockSpec((1, 1, g, d), lambda i, j, c, *_: (i, j, 0, 0)),
@@ -1012,7 +1156,8 @@ def paged_flash_decode(
     out, lse = dist_pallas_call(
         functools.partial(
             _paged_flash_decode_kernel,
-            n_chunks=max_pages, page_size=page_size, scale=scale,
+            n_steps=n_steps, pages_per_step=P,
+            page_size=page_size, scale=scale,
         ),
         name="paged_flash_decode",
         grid_spec=grid_spec,
@@ -1024,7 +1169,10 @@ def paged_flash_decode(
         dimension_semantics=("parallel", "parallel", "arbitrary"),
         uses_barrier=False,
         interpret=interpret,
-    )(kv_lens.astype(jnp.int32), block_table.astype(jnp.int32), q4, k_pages, v_pages)
+    )(
+        kv_lens.astype(jnp.int32), block_table.astype(jnp.int32),
+        q4, *(kv for _ in range(P) for kv in (k_pages, v_pages)),
+    )
     out = out.reshape(b, hq, d)
     lse = lse.reshape(b, hq)
     return (out, lse) if return_lse else out
@@ -1039,6 +1187,7 @@ def paged_flash_decode_distributed(
     *,
     axis: str = "tp",
     fuse_heads: bool | None = None,
+    pages_per_step: int | None = None,
     ag_method: str = "full_mesh_push",
     interpret: Any = None,
 ) -> jax.Array:
@@ -1046,11 +1195,12 @@ def paged_flash_decode_distributed(
     its own page pool + block table covering its sequence shard (the paged
     analogue of :func:`flash_decode_distributed`; ≙ the reference SP layer,
     which is paged end-to-end: sp_flash_decode_layer.py:78).
-    ``fuse_heads`` as in :func:`paged_flash_decode` (None = VMEM-aware
-    auto; False pins the per-head grid)."""
+    ``fuse_heads`` / ``pages_per_step`` as in :func:`paged_flash_decode`
+    (None = span-driven auto)."""
     out, lse = paged_flash_decode(
         q, k_pages, v_pages, kv_lens_shard, block_table,
-        fuse_heads=fuse_heads, return_lse=True, interpret=interpret,
+        fuse_heads=fuse_heads, pages_per_step=pages_per_step,
+        return_lse=True, interpret=interpret,
     )
     return _sp_allgather_combine(out, lse, axis, ag_method, interpret)
 
