@@ -63,16 +63,21 @@ _SCOPED_VMEM_LIMIT = 16 * 2**20
 _TARGET_SPAN = 4096
 
 
-def _auto_pages_per_step(slab: int, page_size: int, max_pages: int) -> int:
-    """Page slots per grid step for a paged decode grid whose per-page
-    K or V slab is ``slab`` bytes: enough slots to reach the target
-    span (at least one when a single page already exceeds it), bounded
-    by the table width and by what the double-buffered K+V pipeline
-    (4·slab·P) affords under the scoped-VMEM budget. Returns 0 when not
-    even one slot fits — the caller must prefer the other grid."""
+def _auto_pages_per_step(
+    slab: int, page_size: int, max_pages: int, resident: int = 0,
+) -> int:
+    """Page slots per grid step for a paged decode/verify grid whose
+    per-page K or V slab is ``slab`` bytes: enough slots to reach the
+    target span (at least one when a single page already exceeds it),
+    bounded by the table width and by what the double-buffered K+V
+    pipeline (4·slab·P) affords under the scoped-VMEM budget after
+    ``resident`` bytes (q/out/lse blocks + scratch accumulators the
+    grid holds across the whole pass — the verify grids' rows make
+    these significant). Returns 0 when not even one slot fits — the
+    caller must prefer the other grid."""
     return min(
         max(1, _TARGET_SPAN // page_size), max_pages,
-        _fused_slab_vmem_budget() // (4 * slab),
+        max(0, _fused_slab_vmem_budget() - resident) // (4 * slab),
     )
 
 
@@ -617,35 +622,50 @@ def flash_verify_distributed(
 
 
 def _paged_flash_verify_kernel(
-    max_lens_ref, bt_ref, lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
-    m_scr, l_scr, acc_scr, *, n_chunks: int, page_size: int, scale: float,
+    max_lens_ref, bt_ref, lens_ref, q_ref, *rest,
+    n_steps: int, pages_per_step: int, page_size: int, scale: float,
+    h_kv: int, chunk_dim: int,
 ):
-    # the block table is consumed by the index_map only; the body is the
-    # contiguous verify body with page-sized chunks
+    """Paged verify over ``pages_per_step`` pages concatenated into one
+    [rows, P·page] span per step (same r5 chip finding as
+    :func:`_paged_flash_decode_kernel`, whose shared-body shape this
+    mirrors: fused grid = pool ``h_kv`` + ``chunk_dim=1``, per-head
+    grid = the ``h_kv=1, chunk_dim=2`` instance). The per-sequence max
+    length gates whole steps; the per-row length column masks inside
+    the span. Clamped duplicate tail slots are length-masked: their
+    span positions are >= max_pages*page >= every row length."""
     del bt_ref
-    _flash_verify_body(
-        max_lens_ref, lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
-        m_scr, l_scr, acc_scr,
-        n_chunks=n_chunks, block_s=page_size, scale=scale,
-    )
+    P = pages_per_step
+    kv_refs = rest[: 2 * P]
+    out_ref, lse_ref, m_scr, l_scr, acc_scr = rest[2 * P :]
+    c = pl.program_id(chunk_dim)
 
+    @pl.when(c == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
 
-def _paged_flash_verify_fh_kernel(
-    max_lens_ref, bt_ref, lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
-    m_scr, l_scr, acc_scr,
-    *, n_chunks: int, page_size: int, scale: float, h_kv: int,
-):
-    """Fused-heads verify: one DMA per physical page (the decode serving
-    pools' grid), every head's S*g rows masking with the per-row length
-    column — the shared fused-heads skeleton with (gate=per-sequence
-    max, row=per-row column) lengths."""
-    del bt_ref
-    _fused_heads_core(
-        pl.program_id(1), max_lens_ref[pl.program_id(0)], lens_ref[0, 0],
-        q_ref, k_ref, v_ref, None, None, out_ref, lse_ref,
-        m_scr, l_scr, acc_scr,
-        n_chunks=n_chunks, block_s=page_size, scale=scale, h_kv=h_kv,
-    )
+    @pl.when(c * P * page_size < max_lens_ref[pl.program_id(0)])
+    def _():
+        for j in range(h_kv):  # static unroll over the slab's heads
+            k_cat = jnp.concatenate(
+                [kv_refs[2 * p][0, j] for p in range(P)], axis=0
+            ) if P > 1 else kv_refs[0][0, j]
+            v_cat = jnp.concatenate(
+                [kv_refs[2 * p + 1][0, j] for p in range(P)], axis=0
+            ) if P > 1 else kv_refs[1][0, j]
+            m_scr[j], l_scr[j], acc_scr[j] = _online_softmax_step(
+                q_ref[0, j], k_cat, v_cat, None, None,
+                c * P * page_size, lens_ref[0, 0], scale,
+                m_scr[j], l_scr[j], acc_scr[j],
+            )
+
+    @pl.when(c == n_steps - 1)
+    def _():
+        out_ref[0], lse_ref[0] = _finalize_softmax(
+            m_scr[:], l_scr[:], acc_scr[:]
+        )
 
 
 def paged_flash_verify(
@@ -656,6 +676,7 @@ def paged_flash_verify(
     block_table: jax.Array,
     *,
     fuse_heads: bool | None = None,
+    pages_per_step: int | None = None,
     return_lse: bool = False,
     interpret: Any = None,
 ):
@@ -663,11 +684,10 @@ def paged_flash_verify(
     with the block-table indirection of :func:`paged_flash_decode`: q
     ``[b, S, q_heads, d]``, kv_lens ``[b, S]`` per-row prefix lengths,
     pages/table as in the paged decode (the S chunk positions' k/v
-    already written into their pages). ``fuse_heads`` (None = the same
-    VMEM-aware auto as :func:`paged_flash_decode`, with the verify
-    rows' larger q/accumulator footprint counted): the fused grid
-    fetches each physical page in ONE DMA — the decode serving pools'
-    default — with the per-head grid as the many-kv-head fallback."""
+    already written into their pages). ``fuse_heads`` /
+    ``pages_per_step`` (None = the same span-driven auto as
+    :func:`paged_flash_decode`, with the verify rows' larger
+    q/out/accumulator residents counted against the VMEM budget)."""
     b, S, hq, d = q.shape
     n_pages, h_kv, page_size, _ = k_pages.shape
     assert hq % h_kv == 0, (hq, h_kv)
@@ -675,17 +695,22 @@ def paged_flash_verify(
     rows = S * g
     max_pages = block_table.shape[1]
     kv_lens = kv_lens.astype(jnp.int32)
+    # per-head-grid resident bytes (q block in the cache dtype, f32
+    # out/lse blocks, f32 m/l/acc scratches); the fused grid holds h_kv×
+    slab_h = page_size * d * k_pages.dtype.itemsize
+    res_h = rows * (
+        d * k_pages.dtype.itemsize + (d + 1) * 4 + (d + 2) * 4
+    )
+    p_f = _auto_pages_per_step(
+        h_kv * slab_h, page_size, max_pages, resident=h_kv * res_h
+    )
+    p_h = _auto_pages_per_step(slab_h, page_size, max_pages, resident=res_h)
     if fuse_heads is None:
-        # the decode-style double-buffered page slabs PLUS everything the
-        # verify grid holds resident across the whole pass: the q block,
-        # the f32 out/lse blocks, and the f32 scratch accumulators
-        slab = h_kv * page_size * d * k_pages.dtype.itemsize
-        resident = h_kv * rows * (
-            d * k_pages.dtype.itemsize        # q block (cache dtype)
-            + (d + 1) * 4                     # out + lse blocks (f32)
-            + (d + 2) * 4                     # m/l/acc scratches (f32)
-        )
-        fuse_heads = 4 * slab + resident <= _fused_slab_vmem_budget()
+        fuse_heads = p_f >= 1 and p_f >= p_h
+    if pages_per_step is None:
+        pages_per_step = max(1, p_f if fuse_heads else p_h)
+    P = pages_per_step
+    n_steps = cdiv(max_pages, P)
     q5 = (
         q.reshape(b, S, h_kv, g, d)
         .swapaxes(1, 2)
@@ -701,17 +726,23 @@ def paged_flash_verify(
         transcendentals=b * S * hq * max_pages * page_size,
     )
     if fuse_heads:
-        def kv_index_map_fh(i, c, max_lens_ref, bt_ref):
-            return (bt_ref[i, c], 0, 0, 0)
+        def kv_index_map_fh_p(p):
+            def index_map(i, c, max_lens_ref, bt_ref):
+                return (
+                    bt_ref[i, jnp.minimum(c * P + p, max_pages - 1)], 0, 0, 0,
+                )
+            return index_map
 
+        page_spec = lambda p: pl.BlockSpec(
+            (1, h_kv, page_size, d), kv_index_map_fh_p(p)
+        )
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(b, max_pages),
+            grid=(b, n_steps),
             in_specs=[
                 pl.BlockSpec((1, 1, rows, 1), lambda i, c, *_: (i, 0, 0, 0)),
                 pl.BlockSpec((1, h_kv, rows, d), lambda i, c, *_: (i, 0, 0, 0)),
-                pl.BlockSpec((1, h_kv, page_size, d), kv_index_map_fh),
-                pl.BlockSpec((1, h_kv, page_size, d), kv_index_map_fh),
+                *(page_spec(p) for p in range(P) for _ in (0, 1)),
             ],
             out_specs=(
                 pl.BlockSpec((1, h_kv, rows, d), lambda i, c, *_: (i, 0, 0, 0)),
@@ -725,9 +756,9 @@ def paged_flash_verify(
         )
         out, lse = dist_pallas_call(
             functools.partial(
-                _paged_flash_verify_fh_kernel,
-                n_chunks=max_pages, page_size=page_size,
-                scale=1.0 / math.sqrt(d), h_kv=h_kv,
+                _paged_flash_verify_kernel,
+                n_steps=n_steps, pages_per_step=P, page_size=page_size,
+                scale=1.0 / math.sqrt(d), h_kv=h_kv, chunk_dim=1,
             ),
             name="paged_flash_verify_fh",
             grid_spec=grid_spec,
@@ -741,39 +772,44 @@ def paged_flash_verify(
             interpret=interpret,
         )(
             max_lens, block_table.astype(jnp.int32), lens_rows, q5,
-            k_pages, v_pages,
+            *(kv for _ in range(P) for kv in (k_pages, v_pages)),
         )
         out = out.reshape(b, h_kv, S, g, d).swapaxes(1, 2).reshape(b, S, hq, d)
         lse = lse.reshape(b, h_kv, S, g).swapaxes(1, 2).reshape(b, S, hq)
         return (out, lse) if return_lse else out
 
-    def kv_index_map(i, j, c, max_lens_ref, bt_ref):
-        return (bt_ref[i, c], j, 0, 0)
+    def kv_index_map_p(p):
+        def index_map(i, j, c, max_lens_ref, bt_ref):
+            return (bt_ref[i, jnp.minimum(c * P + p, max_pages - 1)], j, 0, 0)
+        return index_map
 
+    page_spec = lambda p: pl.BlockSpec(
+        (1, 1, page_size, d), kv_index_map_p(p)
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b, h_kv, max_pages),
+        grid=(b, h_kv, n_steps),
         in_specs=[
             pl.BlockSpec((1, 1, rows, 1), lambda i, j, c, *_: (i, 0, 0, 0)),
             pl.BlockSpec((1, 1, rows, d), lambda i, j, c, *_: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d), kv_index_map),
-            pl.BlockSpec((1, 1, page_size, d), kv_index_map),
+            *(page_spec(p) for p in range(P) for _ in (0, 1)),
         ],
         out_specs=(
             pl.BlockSpec((1, 1, rows, d), lambda i, j, c, *_: (i, j, 0, 0)),
             pl.BlockSpec((1, 1, rows, 1), lambda i, j, c, *_: (i, j, 0, 0)),
         ),
         scratch_shapes=[
-            pltpu.VMEM((rows, 1), jnp.float32),
-            pltpu.VMEM((rows, 1), jnp.float32),
-            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((1, rows, 1), jnp.float32),
+            pltpu.VMEM((1, rows, 1), jnp.float32),
+            pltpu.VMEM((1, rows, d), jnp.float32),
         ],
     )
+    # the shared body's h_kv=1 instance (leading head dim on scratches)
     out, lse = dist_pallas_call(
         functools.partial(
             _paged_flash_verify_kernel,
-            n_chunks=max_pages, page_size=page_size,
-            scale=1.0 / math.sqrt(d),
+            n_steps=n_steps, pages_per_step=P, page_size=page_size,
+            scale=1.0 / math.sqrt(d), h_kv=1, chunk_dim=2,
         ),
         name="paged_flash_verify",
         grid_spec=grid_spec,
@@ -785,7 +821,10 @@ def paged_flash_verify(
         dimension_semantics=("parallel", "parallel", "arbitrary"),
         uses_barrier=False,
         interpret=interpret,
-    )(max_lens, block_table.astype(jnp.int32), lens_rows, q5, k_pages, v_pages)
+    )(
+        max_lens, block_table.astype(jnp.int32), lens_rows, q5,
+        *(kv for _ in range(P) for kv in (k_pages, v_pages)),
+    )
     out = out.reshape(b, h_kv, S, g, d).swapaxes(1, 2).reshape(b, S, hq, d)
     lse = lse.reshape(b, h_kv, S, g).swapaxes(1, 2).reshape(b, S, hq)
     return (out, lse) if return_lse else out
@@ -800,6 +839,7 @@ def paged_flash_verify_distributed(
     *,
     axis: str = "tp",
     fuse_heads: bool | None = None,
+    pages_per_step: int | None = None,
     ag_method: str = "full_mesh_push",
     interpret: Any = None,
 ) -> jax.Array:
@@ -808,7 +848,8 @@ def paged_flash_verify_distributed(
     the shared (out ‖ lse) allgather tail."""
     out, lse = paged_flash_verify(
         q, k_pages, v_pages, lens_shard, block_table,
-        fuse_heads=fuse_heads, return_lse=True, interpret=interpret,
+        fuse_heads=fuse_heads, pages_per_step=pages_per_step,
+        return_lse=True, interpret=interpret,
     )
     b, S, hq, d = out.shape
     merged = _sp_allgather_combine(
@@ -891,74 +932,23 @@ def flash_decode_quant_distributed(
 def _paged_flash_decode_kernel(
     kv_lens_ref, block_table_ref, q_ref, *rest,
     n_steps: int, pages_per_step: int, page_size: int,
-    scale: float,
+    scale: float, h_kv: int, chunk_dim: int,
 ):
-    """Per-head paged decode, ``pages_per_step`` pages concatenated into
-    one [g, P·page] span per step — the per-head analogue of
-    :func:`_paged_flash_decode_fh_kernel` (same chip finding: the span,
-    not the indirection, is the cost; the contiguous winner's shape is
-    per-head block_s=4096 = 16 pages). Online-softmax body otherwise
-    matches the contiguous kernel; physical pages arrive via the
+    """Paged decode over ``pages_per_step`` pages concatenated into one
+    [g, P·page] span per step (r5 chip finding: the span, not the page
+    indirection, is the cost — the contiguous winner's shape is
+    block_s=4096 = 16 pages). ONE body for BOTH grids: the fused-heads
+    grid passes the pool's ``h_kv`` and ``chunk_dim=1``; the per-head
+    grid is the ``h_kv=1, chunk_dim=2`` instance (its blocks/scratches
+    carry a leading head dim of 1). Physical pages arrive via the
     prefetched block table (≙ the reference's block_table indirection,
     flash_decode.py:136,203)."""
     del block_table_ref
     P = pages_per_step
     kv_refs = rest[: 2 * P]
     out_ref, lse_ref, m_scr, l_scr, acc_scr = rest[2 * P :]
-    b_i, c = pl.program_id(0), pl.program_id(2)
-    kv_len = kv_lens_ref[b_i]
-
-    @pl.when(c == 0)
-    def _():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
-
-    # clamped duplicate tail slots are length-masked (see the fh kernel)
-    @pl.when(c * P * page_size < kv_len)
-    def _():
-        k_cat = jnp.concatenate(
-            [kv_refs[2 * p][0, 0] for p in range(P)], axis=0
-        ) if P > 1 else kv_refs[0][0, 0]
-        v_cat = jnp.concatenate(
-            [kv_refs[2 * p + 1][0, 0] for p in range(P)], axis=0
-        ) if P > 1 else kv_refs[1][0, 0]
-        m_scr[:], l_scr[:], acc_scr[:] = _online_softmax_step(
-            q_ref[0, 0], k_cat, v_cat, None, None,
-            c * P * page_size, kv_len, scale, m_scr[:], l_scr[:], acc_scr[:],
-        )
-
-    @pl.when(c == n_steps - 1)
-    def _():
-        out_ref[0, 0], lse_ref[0, 0] = _finalize_softmax(
-            m_scr[:], l_scr[:], acc_scr[:]
-        )
-
-
-def _paged_flash_decode_fh_kernel(
-    kv_lens_ref, block_table_ref, q_ref, *rest,
-    n_steps: int, pages_per_step: int, page_size: int,
-    scale: float, h_kv: int,
-):
-    """Fused-heads paged decode, ``pages_per_step`` physical pages per
-    grid step, CONCATENATED into one attention span. Chip finding (r5):
-    the paged kernel's 571-vs-359 µs deficit against the contiguous
-    winner is NOT the page indirection — the contiguous fused-heads
-    kernel at block_s=256 measures the same 577 µs. The cost is the
-    tiny per-step softmax span the page size forces (mask/max/exp/sum
-    fixed costs per [g, 256] tile); the fix is the span, not the step
-    count. Each step's P page slots arrive through P separate (K, V)
-    BlockSpecs whose index maps read consecutive block-table columns
-    (one DMA per physical page, P in flight), and the kernel fuses them
-    into a single [g, P·page] online-softmax update per head — the same
-    compute shape as the contiguous kernel at block_s = P·page."""
-    # block table is consumed by the index maps only
-    del block_table_ref
-    P = pages_per_step
-    kv_refs = rest[: 2 * P]
-    out_ref, lse_ref, m_scr, l_scr, acc_scr = rest[2 * P :]
-    i, c = pl.program_id(0), pl.program_id(1)
-    kv_len = kv_lens_ref[i]
+    c = pl.program_id(chunk_dim)
+    kv_len = kv_lens_ref[pl.program_id(0)]
 
     @pl.when(c == 0)
     def _():
@@ -1098,9 +1088,9 @@ def paged_flash_decode(
         )
         out, lse = dist_pallas_call(
             functools.partial(
-                _paged_flash_decode_fh_kernel,
+                _paged_flash_decode_kernel,
                 n_steps=n_steps, pages_per_step=P,
-                page_size=page_size, scale=scale, h_kv=h_kv,
+                page_size=page_size, scale=scale, h_kv=h_kv, chunk_dim=1,
             ),
             name="paged_flash_decode_fh",
             grid_spec=grid_spec,
@@ -1147,17 +1137,18 @@ def paged_flash_decode(
             pl.BlockSpec((1, 1, g, 1), lambda i, j, c, *_: (i, j, 0, 0)),
         ),
         scratch_shapes=[
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, d), jnp.float32),
+            pltpu.VMEM((1, g, 1), jnp.float32),
+            pltpu.VMEM((1, g, 1), jnp.float32),
+            pltpu.VMEM((1, g, d), jnp.float32),
         ],
     )
-    # pages are viewed [n_pages, h_kv, page_size, d] → block (1,1,ps,d)
+    # pages are viewed [n_pages, h_kv, page_size, d] → block (1,1,ps,d);
+    # the shared body's h_kv=1 instance (leading head dim on scratches)
     out, lse = dist_pallas_call(
         functools.partial(
             _paged_flash_decode_kernel,
             n_steps=n_steps, pages_per_step=P,
-            page_size=page_size, scale=scale,
+            page_size=page_size, scale=scale, h_kv=1, chunk_dim=2,
         ),
         name="paged_flash_decode",
         grid_spec=grid_spec,
