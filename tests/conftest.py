@@ -8,9 +8,12 @@ deadlock when many interpreted remote DMAs move large payloads concurrently
 box). Keep per-DMA test payloads <= ~8 KiB; correctness coverage does not
 need more, and real-TPU runs are unaffected.
 
-Runtime budget (1-core box, measured 2026-07-31): the `-m quick` tier is
-the fast gate (~6 min at 157 tests — it grows with kernel-family
-coverage); the full suite is ~25-31 min. The floor is
+Runtime budget (1-core box, re-measured 2026-08-01): the `-m quick` tier
+is the fast gate (~8 min at 164 tests — it grows with kernel-family
+coverage; the whole-loop speculative integration tests moved to the
+slow tier when the r5 device-side while_loop rewrite tripled their
+interpret-mode cost); the full suite is ~65 min (test_decode ~14 min
+and test_models ~9 min dominate). The floor is
 structural, not shape-driven: every interpreted pallas_call pays ~44 ms
 of host machinery (≈112 io_callbacks + the per-call shared-memory
 setup/cleanup barriers across virtual devices — profiled against
@@ -45,7 +48,7 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "quick: first-tier kernel-family coverage; `pytest -m quick` is "
-        "the fast gate (~6 min on a 1-core box)",
+        "the fast gate (~8 min on a 1-core box)",
     )
 
 

@@ -84,6 +84,9 @@ def test_verify_step_matches_successive_decodes(mesh4):
         )
 
 
+@pytest.mark.slow  # whole-loop interpret-mode integration (~4 min/case
+# since the r5 device-side while_loop rewrite); the quick tier keeps the
+# verify-kernel equivalence test above
 @pytest.mark.parametrize("moe", [False, True])
 def test_speculative_matches_greedy_generate(mesh4, moe):
     """The whole speculative loop emits EXACTLY the target model's greedy
@@ -150,6 +153,7 @@ def test_speculative_matches_greedy_generate(mesh4, moe):
     np.testing.assert_array_equal(np.asarray(got_self), np.asarray(want))
 
 
+@pytest.mark.slow  # see test_speculative_matches_greedy_generate
 def test_speculative_hier_ep_target(mesh2x4, mesh4):
     """The two round-5 serving features compose: a dense draft speculates
     for a HIERARCHICAL EP-MoE target on the 2-axis mesh — emitted tokens

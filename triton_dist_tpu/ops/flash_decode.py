@@ -1306,27 +1306,27 @@ def flash_decode_op(
 # KV-chunk tune space (≙ the reference's split-KV block sweep); larger
 # chunks amortize per-grid-step overhead, smaller ones win on short
 # caches. FIRST entry = best-known for the long-cache bench shape
-# (applied sweep-free under cached_or_first): the XLA-native program —
-# measured fastest on v5e (344 µs vs the best per-head Pallas chunking's
-# 460 µs at b=8 hq=64 s=8192; both HBM-bound, XLA's fusion wins). The
-# fused-heads chunkings collapse the grid h_kv-fold (the per-head
-# kernel's deficit was per-step cost, not math) and are the candidates
-# expected to retire the sentinel. The per-head ones stay for MANY-kv-head
-# shapes: the fused K/V slab is h_kv·block_s·d per buffer, so its VMEM
-# footprint grows linearly with h_kv and large (h_kv × block_s) products
-# exceed the budget — where the fused candidates fail to compile, the
-# sweep falls through to the per-head kernel.
+# (applied sweep-free under cached_or_first): the per-head Pallas kernel
+# at block_s=4096, which RETIRED the XLA sentinel on chip in the r5
+# sweep (359.5 µs vs the sentinel's ~374, vs_baseline 1.04 — the span
+# finding: wide per-step softmax spans win; the r3-era "XLA fusion wins"
+# measurement was against span-512 chunkings). The sentinel stays as
+# the second candidate for shapes where XLA's one-fusion form still
+# wins (short caches). Fused-heads chunkings above span 1024 exceed the
+# 16 MiB scoped-VMEM stack at h_kv=8 and fail candidate compilation —
+# the sweep prices that in by falling through; they remain for
+# few-kv-head shapes where their one-DMA-per-chunk slabs fit.
 FLASH_DECODE_TUNE_SPACE = (
+    FlashDecodeConfig(block_s=4096),
     FlashDecodeConfig(block_s=0),
+    FlashDecodeConfig(block_s=8192),
+    FlashDecodeConfig(block_s=2048),
+    FlashDecodeConfig(block_s=1024),
+    FlashDecodeConfig(block_s=512),
     FlashDecodeConfig(block_s=2048, fuse_heads=True),
     FlashDecodeConfig(block_s=1024, fuse_heads=True),
     FlashDecodeConfig(block_s=4096, fuse_heads=True),
     FlashDecodeConfig(block_s=512, fuse_heads=True),
-    FlashDecodeConfig(block_s=1024),
-    FlashDecodeConfig(block_s=512),
-    FlashDecodeConfig(block_s=2048),
-    FlashDecodeConfig(block_s=4096),
-    FlashDecodeConfig(block_s=8192),
 )
 
 

@@ -604,6 +604,12 @@ TP_MOE_TUNE_SPACE = (
     # trade more VMEM for fewer B-operand re-fetches per expert pass
     GroupGemmConfig(512, 4096, 512),
     GroupGemmConfig(512, 1024, 1024),
+    # bm=256 at DEEP K (the r5 sweep only had bm=256 with bk=512, which
+    # doubles the B re-fetch): half the 512-row alignment-padding tax
+    # (~25% of GEMM rows at the bench shape, measured r5) while the
+    # bk=1024 tile keeps the extra B traffic under the compute roof
+    GroupGemmConfig(256, 1024, 1024),
+    GroupGemmConfig(256, 2048, 1024),
     GroupGemmConfig(256, 1024, 512),
     GroupGemmConfig(256, 2048, 512),
     GroupGemmConfig(128, 1024, 512),
