@@ -126,6 +126,35 @@ def test_paged_flash_decode_matches_contiguous(g):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("fuse_heads", [True, False])
+def test_paged_flash_decode_quant(fuse_heads):
+    """int8 page pools (the paged × int8 cell of the serving cache
+    matrix): per-position absmax row scales fold in-kernel; tolerance
+    matches the contiguous int8 path's quantization error, and ragged
+    lengths mask exactly as in the bf16 kernel."""
+    from triton_dist_tpu.ops.flash_decode import (
+        paged_flash_decode_quant, quantize_kv_pages,
+    )
+
+    b, h_kv, g, s, d, page = 3, 2, 2, 256, 128, 64
+    q, k, v, _ = _rand_case(jax.random.PRNGKey(21), b, h_kv * g, h_kv, s, d)
+    # min length 1: the dense _ref_decode golden is NaN over an empty
+    # prefix (0/0 softmax) while the kernel's contract emits zeros —
+    # the zero-length path is covered by the SP-op test's golden
+    kv_lens = jnp.array([s, 97, 1], jnp.int32)
+    kp, vp, bt = _paginate(k, v, page, key=jax.random.PRNGKey(22),
+                           n_extra_pages=2)
+    k_q, v_q, ks, vs = quantize_kv_pages(kp, vp)
+    got = paged_flash_decode_quant(
+        q, k_q, v_q, ks, vs, kv_lens, bt, fuse_heads=fuse_heads,
+    )
+    want = _ref_decode(q, k, v, kv_lens)
+    # same tolerance as the contiguous int8 tests — the quantization
+    # error is identical by construction (shared quantize_kv math)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-2, atol=3e-2)
+
+
 def test_paged_flash_decode_ragged_lens():
     """Partial last page + empty sequences mask correctly."""
     b, h_kv, g, s, d, page = 3, 1, 2, 128, 128, 32

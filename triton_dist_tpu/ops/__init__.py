@@ -64,9 +64,11 @@ from triton_dist_tpu.ops.flash_decode import (
     flash_verify_distributed,
     paged_flash_decode,
     paged_flash_decode_distributed,
+    paged_flash_decode_quant,
     paged_flash_verify,
     paged_flash_verify_distributed,
     quantize_kv,
+    quantize_kv_pages,
 )
 from triton_dist_tpu.ops.grads import ring_attention_grad
 from triton_dist_tpu.ops.ring_attention import (

@@ -74,11 +74,21 @@ def _auto_pages_per_step(
     ``resident`` bytes (q/out/lse blocks + scratch accumulators the
     grid holds across the whole pass — the verify grids' rows make
     these significant). Returns 0 when not even one slot fits — the
-    caller must prefer the other grid."""
-    return min(
+    caller must prefer the other grid.
+
+    Prefers the largest P ≤ the cap that DIVIDES the table width (down
+    to cap/2): a non-divisor pads the last step with clamped duplicate
+    page fetches — dead DMAs the length mask discards (chip r5: the
+    quant fused grid measured 247 µs at the cap P=12 over a 32-page
+    table vs 193 at the divisor P=8)."""
+    cap = min(
         max(1, _TARGET_SPAN // page_size), max_pages,
         max(0, _fused_slab_vmem_budget() - resident) // (4 * slab),
     )
+    for p in range(cap, max(1, cap // 2) - 1, -1):
+        if max_pages % p == 0:
+            return p
+    return cap
 
 
 def _fused_slab_vmem_budget() -> int:
@@ -932,7 +942,7 @@ def flash_decode_quant_distributed(
 def _paged_flash_decode_kernel(
     kv_lens_ref, block_table_ref, q_ref, *rest,
     n_steps: int, pages_per_step: int, page_size: int,
-    scale: float, h_kv: int, chunk_dim: int,
+    scale: float, h_kv: int, chunk_dim: int, quant: bool = False,
 ):
     """Paged decode over ``pages_per_step`` pages concatenated into one
     [g, P·page] span per step (r5 chip finding: the span, not the page
@@ -942,11 +952,15 @@ def _paged_flash_decode_kernel(
     grid is the ``h_kv=1, chunk_dim=2`` instance (its blocks/scratches
     carry a leading head dim of 1). Physical pages arrive via the
     prefetched block table (≙ the reference's block_table indirection,
-    flash_decode.py:136,203)."""
+    flash_decode.py:136,203). ``quant``: int8 page pools — 2P extra
+    scale-page slots follow the data slots, concatenated into per-
+    position scale rows exactly as :func:`flash_decode_quant` folds
+    them (payload DMAs at half the bytes)."""
     del block_table_ref
     P = pages_per_step
     kv_refs = rest[: 2 * P]
-    out_ref, lse_ref, m_scr, l_scr, acc_scr = rest[2 * P :]
+    s_refs = rest[2 * P : 4 * P] if quant else ()
+    out_ref, lse_ref, m_scr, l_scr, acc_scr = rest[(4 if quant else 2) * P :]
     c = pl.program_id(chunk_dim)
     kv_len = kv_lens_ref[pl.program_id(0)]
 
@@ -967,8 +981,17 @@ def _paged_flash_decode_kernel(
             v_cat = jnp.concatenate(
                 [kv_refs[2 * p + 1][0, j] for p in range(P)], axis=0
             ) if P > 1 else kv_refs[1][0, j]
+            if quant:  # int8 page pools: per-position scale rows ride
+                ks_cat = jnp.concatenate(
+                    [s_refs[2 * p][0, j] for p in range(P)], axis=1
+                ) if P > 1 else s_refs[0][0, j]
+                vs_cat = jnp.concatenate(
+                    [s_refs[2 * p + 1][0, j] for p in range(P)], axis=1
+                ) if P > 1 else s_refs[1][0, j]
+            else:
+                ks_cat = vs_cat = None
             m_scr[j], l_scr[j], acc_scr[j] = _online_softmax_step(
-                q_ref[0, j], k_cat, v_cat, None, None,
+                q_ref[0, j], k_cat, v_cat, ks_cat, vs_cat,
                 c * P * page_size, kv_len, scale,
                 m_scr[j], l_scr[j], acc_scr[j],
             )
@@ -987,6 +1010,8 @@ def paged_flash_decode(
     kv_lens: jax.Array,
     block_table: jax.Array,
     *,
+    k_scales: jax.Array | None = None,
+    v_scales: jax.Array | None = None,
     fuse_heads: bool | None = None,
     pages_per_step: int | None = None,
     return_lse: bool = False,
@@ -1022,13 +1047,29 @@ def paged_flash_decode(
     budget and the table width. The one-page grids measured 571 µs vs
     the contiguous kernel's 359 for identical bytes (r5); the span fix
     recovers all of it and the indirection costs nothing.
+
+    ``k_scales``/``v_scales`` (``[n_pages, kv_heads, 1, page_size]``
+    f32, from :func:`quantize_kv_pages`): int8 page pools — the paged
+    form of :func:`flash_decode_quant`'s per-position row scales. The
+    payload DMAs stream at half the bytes (the resource decode is
+    bound by) and the scales ride 2P extra page-slot fetches; this
+    completes the serving cache matrix (contiguous/paged ×
+    bf16/int8), which the reference's bf16-only paged decode lacks.
     """
     b, hq, d = q.shape
     n_pages, h_kv, page_size, _ = k_pages.shape
     assert hq % h_kv == 0, (hq, h_kv)
     g = hq // h_kv
     max_pages = block_table.shape[1]
-    slab_h = page_size * d * k_pages.dtype.itemsize
+    quant = k_scales is not None
+    if quant:
+        assert v_scales is not None
+        assert k_scales.shape == (n_pages, h_kv, 1, page_size), k_scales.shape
+        assert v_scales.shape == k_scales.shape, (v_scales.shape, k_scales.shape)
+    # int8 pools stream half the payload bytes plus the f32 scale rows
+    slab_h = page_size * (
+        d * k_pages.dtype.itemsize + (4 if quant else 0)
+    )
     slab_f = h_kv * slab_h
     if fuse_heads is None:
         # span-driven choice (r5 chip finding: the per-step softmax span,
@@ -1041,14 +1082,26 @@ def paged_flash_decode(
         # fail to compile: per-head slabs are h_kv× smaller.
         p_f = _auto_pages_per_step(slab_f, page_size, max_pages)
         p_h = _auto_pages_per_step(slab_h, page_size, max_pages)
-        fuse_heads = p_f >= 1 and p_f >= p_h
+        if quant:
+            # int8 pools halve payload bytes and add per-page scale
+            # fetches: the per-head grid's [page, d] slices drop to tens
+            # of KB and the pipeline goes DMA-ISSUE-bound (chip r5:
+            # per-head 478 µs vs fused 218 at the serving shape, even
+            # though per-head affords the wider span) — prefer the fused
+            # grid whenever one of its slots fits.
+            fuse_heads = p_f >= 1
+        else:
+            fuse_heads = p_f >= 1 and p_f >= p_h
     scale = 1.0 / math.sqrt(d)
-    # match q to the page-pool dtype (same contract as flash_decode)
-    q4 = q.reshape(b, h_kv, g, d).astype(k_pages.dtype)
+    # match q to the pool's COMPUTE dtype (int8 pools upcast to bf16 in
+    # the kernel — the same contract as flash_decode_quant)
+    q4 = q.reshape(b, h_kv, g, d).astype(
+        jnp.bfloat16 if quant else k_pages.dtype
+    )
     cost = pl.CostEstimate(
         flops=4 * b * hq * max_pages * page_size * d,
-        bytes_accessed=(2 * b * h_kv * max_pages * page_size * d)
-        * k_pages.dtype.itemsize,
+        bytes_accessed=(2 * b * h_kv * max_pages * page_size)
+        * (d * k_pages.dtype.itemsize + (4 if quant else 0)),
         transcendentals=b * hq * max_pages * page_size,
     )
     if fuse_heads:
@@ -1069,12 +1122,16 @@ def paged_flash_decode(
         page_spec = lambda p: pl.BlockSpec(
             (1, h_kv, page_size, d), kv_index_map_p(p)
         )
+        scale_spec = lambda p: pl.BlockSpec(
+            (1, h_kv, 1, page_size), kv_index_map_p(p)
+        )
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, n_steps),
             in_specs=[
                 pl.BlockSpec((1, h_kv, g, d), lambda i, c, *_: (i, 0, 0, 0)),
                 *(page_spec(p) for p in range(P) for _ in (0, 1)),
+                *(scale_spec(p) for p in range(P) for _ in (0, 1) if quant),
             ],
             out_specs=(
                 pl.BlockSpec((1, h_kv, g, d), lambda i, c, *_: (i, 0, 0, 0)),
@@ -1091,8 +1148,9 @@ def paged_flash_decode(
                 _paged_flash_decode_kernel,
                 n_steps=n_steps, pages_per_step=P,
                 page_size=page_size, scale=scale, h_kv=h_kv, chunk_dim=1,
+                quant=quant,
             ),
-            name="paged_flash_decode_fh",
+            name="paged_flash_decode_q_fh" if quant else "paged_flash_decode_fh",
             grid_spec=grid_spec,
             out_shape=(
                 jax.ShapeDtypeStruct((b, h_kv, g, d), jnp.float32),
@@ -1105,6 +1163,7 @@ def paged_flash_decode(
         )(
             kv_lens.astype(jnp.int32), block_table.astype(jnp.int32),
             q4, *(kv for _ in range(P) for kv in (k_pages, v_pages)),
+            *(sc for _ in range(P) for sc in (k_scales, v_scales) if quant),
         )
         out = out.reshape(b, hq, d)
         lse = lse.reshape(b, hq)
@@ -1125,12 +1184,16 @@ def paged_flash_decode(
     page_spec = lambda p: pl.BlockSpec(
         (1, 1, page_size, d), kv_index_map_p(p)
     )
+    scale_spec = lambda p: pl.BlockSpec(
+        (1, 1, 1, page_size), kv_index_map_p(p)
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, h_kv, n_steps),
         in_specs=[
             pl.BlockSpec((1, 1, g, d), lambda i, j, c, *_: (i, j, 0, 0)),
             *(page_spec(p) for p in range(P) for _ in (0, 1)),
+            *(scale_spec(p) for p in range(P) for _ in (0, 1) if quant),
         ],
         out_specs=(
             pl.BlockSpec((1, 1, g, d), lambda i, j, c, *_: (i, j, 0, 0)),
@@ -1149,8 +1212,9 @@ def paged_flash_decode(
             _paged_flash_decode_kernel,
             n_steps=n_steps, pages_per_step=P,
             page_size=page_size, scale=scale, h_kv=1, chunk_dim=2,
+            quant=quant,
         ),
-        name="paged_flash_decode",
+        name="paged_flash_decode_q" if quant else "paged_flash_decode",
         grid_spec=grid_spec,
         out_shape=(
             jax.ShapeDtypeStruct((b, h_kv, g, d), jnp.float32),
@@ -1163,10 +1227,44 @@ def paged_flash_decode(
     )(
         kv_lens.astype(jnp.int32), block_table.astype(jnp.int32),
         q4, *(kv for _ in range(P) for kv in (k_pages, v_pages)),
+        *(sc for _ in range(P) for sc in (k_scales, v_scales) if quant),
     )
     out = out.reshape(b, hq, d)
     lse = lse.reshape(b, hq)
     return (out, lse) if return_lse else out
+
+
+def quantize_kv_pages(k_pages: jax.Array, v_pages: jax.Array):
+    """Per-(page, head, position) absmax int8 quantization of a paged KV
+    pool (k_pages, v_pages ``[n_pages, h_kv, page, d]``) →
+    ``(k_q, v_q, k_scale, v_scale)`` with int8 payloads and
+    ``[n_pages, h_kv, 1, page]`` f32 row scales — the paged layout of
+    :func:`quantize_kv`'s scales. The math is dimension-agnostic over
+    the leading axis, so this IS :func:`quantize_kv` applied to the
+    pool (one implementation: a fix to the shared quantization cannot
+    diverge the two cache layouts). Feed to :func:`paged_flash_decode`
+    via ``k_scales``/``v_scales``."""
+    return quantize_kv(k_pages, v_pages)
+
+
+def paged_flash_decode_quant(
+    q: jax.Array,
+    k_pages_q: jax.Array,
+    v_pages_q: jax.Array,
+    k_scales: jax.Array,
+    v_scales: jax.Array,
+    kv_lens: jax.Array,
+    block_table: jax.Array,
+    **kw,
+):
+    """int8-pool paged decode (:func:`flash_decode_quant` × the paged
+    layout — the last cell of the serving cache matrix): thin alias of
+    :func:`paged_flash_decode` with the scale pools attached; argument
+    order mirrors the contiguous quant entry."""
+    return paged_flash_decode(
+        q, k_pages_q, v_pages_q, kv_lens, block_table,
+        k_scales=k_scales, v_scales=v_scales, **kw,
+    )
 
 
 def paged_flash_decode_distributed(
