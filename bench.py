@@ -652,7 +652,28 @@ def _run_one(name: str) -> None:
         devs = devs[:world]
     n = len(devs)
     mesh = Mesh(np.array(devs), ("tp",))
-    _METRICS[name](mesh, n)
+    from triton_dist_tpu.resilience import health
+
+    # reset the statistics so the report below attributes downgrades and
+    # timeouts to THIS metric, not to whatever ran earlier — but keep the
+    # golden-path pins: a quarantined family's device semaphore stays dirty
+    # across metrics, and pinned families serve golden silently (no fresh
+    # counter), so the snapshot below must still name them
+    health.reset(keep_short_circuit=True)
+    try:
+        _METRICS[name](mesh, n)
+    finally:
+        # resilience surface (docs/resilience.md): a metric that quietly
+        # served golden XLA fallbacks is CORRECT but not evidence about
+        # the fused kernels — say so next to the numbers
+        if not health.is_healthy() or health.snapshot()["short_circuited"]:
+            import sys
+
+            print(
+                f"[bench {name}] resilience health: "
+                + json.dumps(health.snapshot()),
+                file=sys.stderr, flush=True,
+            )
 
 
 def main() -> None:

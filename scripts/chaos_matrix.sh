@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Run the FULL resilience fault-injection matrix standalone
+# (tests/test_chaos.py, docs/resilience.md): every kernel family ×
+# drop/dup/delay signal + straggler PE, plus the forced-compile-failure
+# degradation cases, including the cells marked `slow` that tier-1 skips.
+#
+# The live injection cells need the Mosaic TPU interpreter (jax >= 0.6);
+# on older jax lines they skip and the degradation tier still runs.
+#
+# Usage: scripts/chaos_matrix.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
+    -m chaos -v -rs -p no:cacheprovider -p no:xdist -p no:randomly "$@"

@@ -50,6 +50,13 @@ def pytest_configure(config):
         "quick: first-tier kernel-family coverage; `pytest -m quick` is "
         "the fast gate (~8 min on a 1-core box)",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: resilience-layer fault injection (tests/test_chaos.py). "
+        "Fast interpret-mode cases ride tier-1 automatically; the full "
+        "drop/dup/delay/straggler × kernel-family matrix is additionally "
+        "marked slow — run it standalone via scripts/chaos_matrix.sh",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

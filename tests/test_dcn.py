@@ -360,10 +360,18 @@ def test_paged_fuse_heads_auto_fallback():
         fd.paged_flash_decode(q, pool, pool, lens, bt)
         assert calls and calls[-1] == "paged_flash_decode_fh"
         # same pool under a tiny budget: the guard must pick per-head
-        # (overriding the budget keeps the interpret-mode grid small)
-        fd._fused_slab_vmem_budget = lambda: 4 * page * d  # < one 2-head slab
+        # (overriding the budget keeps the interpret-mode grid small).
+        # 8*page*d = exactly one double-buffered per-head K+V slot (bf16),
+        # half a fused one — per-head fits, fused doesn't
+        fd._fused_slab_vmem_budget = lambda: 8 * page * d
         fd.paged_flash_decode(q, pool, pool, lens, bt)
         assert calls[-1] == "paged_flash_decode"
+        # below even the per-head minimum, neither grid affords a slot:
+        # the descriptive ValueError must fire instead of a forced
+        # pages_per_step=1 dying deep inside Mosaic compilation
+        fd._fused_slab_vmem_budget = lambda: 4 * page * d
+        with pytest.raises(ValueError, match="single page slot"):
+            fd.paged_flash_decode(q, pool, pool, lens, bt)
     finally:
         fd.dist_pallas_call = orig
         fd._fused_slab_vmem_budget = prev_budget

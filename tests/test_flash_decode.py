@@ -403,3 +403,47 @@ def test_paged_flash_verify_grids(fuse_heads):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
     )
+
+
+@pytest.mark.parametrize("fuse_heads", [True, False])
+def test_paged_decode_nondivisor_pages_per_step(fuse_heads):
+    """Clamped duplicate-tail path (ADVICE r5 #3): P=2 over a 5-page table
+    leaves the last step with one real page + one clamped DUPLICATE fetch
+    of the table's final entry. Those duplicate span positions sit at
+    >= max_pages*page_size >= kv_len, so the length mask must discard
+    them — a regression here double-counts the final page's scores."""
+    b, h_kv, g, s, d, page = 2, 2, 2, 160, 128, 32  # 5 pages/sequence
+    q, k, v, _ = _rand_case(jax.random.PRNGKey(80), b, h_kv * g, h_kv, s, d)
+    # one full-length sequence (every tail position live) and one ragged
+    kv_lens = jnp.array([s, 77], jnp.int32)
+    kp, vp, bt = _paginate(k, v, page, key=jax.random.PRNGKey(81), n_extra_pages=2)
+    assert bt.shape[1] % 2 == 1  # non-divisor: the tail step is clamped
+    got = paged_flash_decode(
+        q, kp, vp, kv_lens, bt, fuse_heads=fuse_heads, pages_per_step=2
+    )
+    want = _ref_decode(q, k, v, kv_lens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("fuse_heads", [True, False])
+def test_paged_verify_nondivisor_pages_per_step(fuse_heads):
+    """The verify grids' clamped duplicate tail, same P=2-over-5-pages
+    shape, asserted against the contiguous golden with per-row lengths
+    reaching into the final (partially duplicated) step."""
+    from triton_dist_tpu.ops.flash_decode import _xla_verify, paged_flash_verify
+
+    b, S, h_kv, g, s, d, page = 2, 3, 2, 2, 160, 128, 32
+    hq = h_kv * g
+    q = jax.random.normal(jax.random.PRNGKey(82), (b, S, hq, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(83), (b, h_kv, s, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(84), (b, h_kv, s, d), jnp.float32)
+    kp, vp, bt = _paginate(k, v, page, key=jax.random.PRNGKey(85), n_extra_pages=2)
+    pos0 = jnp.array([s - S, 100], jnp.int32)  # row spans end inside page 4
+    lens = pos0[:, None] + jnp.arange(1, S + 1)[None, :]
+    got = paged_flash_verify(
+        q, kp, vp, lens, bt, fuse_heads=fuse_heads, pages_per_step=2
+    )
+    want = _xla_verify(q, k, v, lens, return_lse=False)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )

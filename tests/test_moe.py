@@ -100,6 +100,14 @@ def test_gather_scatter_roundtrip():
     want = np.asarray(x).copy()
     want[0] = want[0] / 2  # token 0 lost one of its two 0.5-weight slots
     np.testing.assert_allclose(np.asarray(back_dr), want, rtol=1e-5, atol=1e-5)
+    # interpret/debug mode VALIDATES the bijection contract (ADVICE r5 #1):
+    # the same dropped slot under assume_bijective=True is detected and
+    # routed to the masked-scatter semantics instead of silently shifting
+    # every later token onto the wrong rows
+    back_guard = scatter_add_unsorted(rows, al_drop, w, n_tokens)
+    np.testing.assert_allclose(
+        np.asarray(back_guard), want, rtol=1e-5, atol=1e-5
+    )
 
 
 def _moe_golden(a, b, topk_ids):

@@ -26,6 +26,7 @@ Layer map (mirrors SURVEY.md §1 of the reference):
 __version__ = "0.1.0"
 
 from triton_dist_tpu import config as config
+from triton_dist_tpu import resilience as resilience
 from triton_dist_tpu.parallel.mesh import (
     initialize_distributed,
     get_default_context,

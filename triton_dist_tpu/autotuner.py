@@ -31,6 +31,7 @@ from typing import Any, Callable, Iterable, Sequence
 import jax
 
 from triton_dist_tpu import config as tdt_config
+from triton_dist_tpu.resilience import DistTimeoutError
 from triton_dist_tpu.utils import perf_func_loop, perf_pair_loop
 
 
@@ -192,6 +193,12 @@ def contextual_autotune(
                 for cfg in cands:
                     try:
                         out = fn(*args, config=cfg, **kwargs)
+                    except DistTimeoutError:
+                        # a watchdog trip is a peer-loss event, not a
+                        # candidate-viability problem: retrying per config
+                        # would burn one full timeout budget per candidate
+                        # and mask a sick fleet as "all configs failed"
+                        raise
                     except Exception as e:
                         last_err = e
                         print(
@@ -253,6 +260,8 @@ def contextual_autotune(
                         trials=trials,
                         consume="all",
                     )
+                except DistTimeoutError:
+                    raise  # peer loss, not a config problem (see above)
                 except Exception as e:  # config doesn't fit this problem
                     if tdt_config.get_config().verbose_autotune:
                         print(f"[autotune {op_name}] cfg {cfg} failed: {e!r}")

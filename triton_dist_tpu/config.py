@@ -58,6 +58,32 @@ class Config:
     # inter-node plane differently from NVLink, allgather.py:291-375).
     # Ops consult ``topology.is_dcn_axis_name`` = declared ∪ detected.
     dcn_axes: tuple = ()
+    # --- resilience subsystem (docs/resilience.md) ---------------------
+    # Watchdog budget for every distributed wait (signal_wait_until /
+    # wait / barrier_all rounds), in POLL ITERATIONS, not wall time:
+    # > 0 arms bounded waits that, on expiry, write a structured
+    # diagnostic record into the kernel's diag buffer, NaN-poison the
+    # output, and surface host-side as resilience.DistTimeoutError.
+    # 0 (default) keeps the classic blocking waits — zero overhead, no
+    # extra kernel outputs. Calibrate per deployment: a compiled poll
+    # iteration is tens of ns; an interpret-mode iteration costs a host
+    # callback (chaos tests use small budgets). Env: TDT_TIMEOUT_ITERS.
+    timeout_iters: int = int(os.environ.get("TDT_TIMEOUT_ITERS", "0"))
+    # On a watchdog trip: True raises DistTimeoutError from the op entry
+    # (serving code sees a loud, decodable failure); False returns the
+    # fully NaN-poisoned output instead and only records the event in
+    # resilience.health (for pipelines that prefer poison-and-continue).
+    raise_on_timeout: bool = True
+    # Armed resilience.FaultPlan (interpret-mode signal chaos: drop /
+    # duplicate / delay a signal op, straggle a PE) — see
+    # resilience/faults.py and tests/test_chaos.py. None = no injection.
+    fault_plan: object = None
+    # Graceful degradation: let resilience.guarded_call serve the golden
+    # jax.lax collective path when a fused op fails for environmental
+    # reasons (Mosaic compile failure, unsupported topology, missing jax
+    # API), recording the downgrade in resilience.health. False = every
+    # failure is loud (CI posture). Env: TDT_FALLBACK_TO_XLA.
+    fallback_to_xla: bool = bool(int(os.environ.get("TDT_FALLBACK_TO_XLA", "1")))
 
 
 _config = Config()
@@ -71,7 +97,23 @@ def update(**kwargs: Any) -> None:
     for k, v in kwargs.items():
         if not hasattr(_config, k):
             raise ValueError(f"unknown config key: {k}")
+        if k == "fault_plan" and v is not None:
+            from triton_dist_tpu.resilience.faults import FaultPlan
+
+            if not isinstance(v, FaultPlan):
+                raise ValueError(
+                    f"fault_plan must be a resilience.FaultPlan (or None), "
+                    f"got {type(v).__name__}"
+                )
+            v.validate()
         setattr(_config, k, v)
+
+
+def interpreting() -> bool:
+    """Whether distributed kernels currently resolve to interpret mode
+    (the debug/validation posture: CPU tests, dry runs)."""
+    cfg = get_config()
+    return cfg.interpret if cfg.interpret is not None else not on_tpu()
 
 
 def on_tpu() -> bool:
@@ -236,14 +278,32 @@ def interpret_params():
     from jax.experimental.pallas import tpu as pltpu
 
     cfg = get_config()
-    use_interpret = cfg.interpret if cfg.interpret is not None else not on_tpu()
-    if not use_interpret:
+    if not interpreting():
         return False
+    if not hasattr(pltpu, "InterpretParams"):
+        # a jax line without the Mosaic TPU interpreter: the fused kernels
+        # cannot be simulated — raise a resilience-fallbackable error so
+        # guarded op entries degrade to the golden XLA collectives instead
+        # of failing deep inside pallas_call
+        raise NotImplementedError(
+            "jax.experimental.pallas.tpu has no InterpretParams on this jax "
+            "version; interpreted distributed kernels need the Mosaic TPU "
+            "interpreter (jax >= 0.6). Fused ops degrade to the golden XLA "
+            "collective path via triton_dist_tpu.resilience.guarded_call."
+        )
     _ensure_cpu_tpu_info()
     _patch_interpreter_scheduler()
+    dma_mode = cfg.dma_execution_mode
+    if cfg.timeout_iters > 0 or cfg.fault_plan is not None:
+        # Watchdogged waits POLL semaphores (semaphore_read) instead of
+        # blocking; under 'on_wait' the interpreter only executes pending
+        # DMAs from inside Semaphore.wait, so a poll-only consumer would
+        # starve its producers and every wait would time out spuriously.
+        # Chaos/watchdog runs therefore force eager DMA execution.
+        dma_mode = "eager"
     return pltpu.InterpretParams(
         detect_races=cfg.detect_races,
-        dma_execution_mode=cfg.dma_execution_mode,
+        dma_execution_mode=dma_mode,
         # Distributed kernels intentionally read buffers that are filled by
         # remote DMAs; OOB reads stay fatal but uninit memory must be lax.
         uninitialized_memory="zero",

@@ -41,9 +41,30 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from triton_dist_tpu import resilience
 from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
 from triton_dist_tpu.shmem import device as shmem
+
+
+def _fast_all_to_all_xla(
+    tokens: jax.Array, splits: jax.Array, *, meta=None, axis="tp", **_
+):
+    """The golden slow path: XLA's all-to-all over the slab dim, with the
+    splits (and optional metadata) exchanged the same way — identical slab
+    contract to the fused kernel and to its DCN branch."""
+    recv = jax.lax.all_to_all(tokens, axis, 0, 0, tiled=True)
+    n = tokens.shape[0]
+    payload = splits.reshape(n, 1).astype(jnp.int32)
+    if meta is not None:
+        payload = jnp.concatenate(
+            [payload, meta.reshape(n, -1).astype(jnp.int32)], axis=1
+        )
+    rpayload = jax.lax.all_to_all(payload, axis, 0, 0, tiled=True)
+    rsplits = rpayload[:, 0]
+    if meta is None:
+        return recv, rsplits
+    return recv, rsplits, rpayload[:, 1:].reshape(meta.shape)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -112,6 +133,9 @@ def fast_all_to_all(
 ) -> tuple[jax.Array, jax.Array] | tuple[jax.Array, jax.Array, jax.Array]:
     """Exchange padded token slabs between all PEs of `axis` (call inside
     ``jax.shard_map``; ≙ ``fast_all_to_all``, low_latency_all_to_all.py:189).
+    Degrades to the golden :func:`_fast_all_to_all_xla` when the fused
+    kernel cannot run in this environment (resilience layer,
+    docs/resilience.md).
 
     tokens: ``[n, max_m, hidden]`` — slab ``p`` holds the ``splits[p]``
     tokens this PE sends to PE ``p`` (rows beyond the count are padding).
@@ -127,6 +151,24 @@ def fast_all_to_all(
     holds the tokens PE ``j`` sent here (``recv_splits[j]`` valid rows).
     Golden: ``jax.lax.all_to_all`` over the slab dim.
     """
+    return resilience.guarded_call(
+        "fast_all_to_all",
+        _fast_all_to_all_fused,
+        _fast_all_to_all_xla,
+        tokens, splits, meta=meta, axis=axis, config=config,
+        interpret=interpret,
+    )
+
+
+def _fast_all_to_all_fused(
+    tokens: jax.Array,
+    splits: jax.Array,
+    *,
+    meta: jax.Array | None = None,
+    axis: str = "tp",
+    config: A2AConfig | None = None,
+    interpret: Any = None,
+):
     cfg = config or A2AConfig()
     n = int(jax.lax.axis_size(axis))
     n_slabs, max_m, hidden = tokens.shape
@@ -211,6 +253,31 @@ def all_to_all_post_process(
     return packed, jnp.sum(recv_splits)
 
 
+def _fast_all_to_all_op_xla(
+    tokens: jax.Array,
+    splits: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "tp",
+    **_,
+) -> tuple[jax.Array, jax.Array]:
+    """Op-level golden: the same shard_map entry serving XLA's all-to-all
+    (identical slab contract, so callers are oblivious to the downgrade)."""
+    if mesh.shape[axis] == 1:
+        return tokens, splits.astype(jnp.int32)
+
+    def wrapped(t, s):
+        r, rs = _fast_all_to_all_xla(t[0], s[0], axis=axis)
+        return r[None], rs[None]
+
+    return jit_shard_map(
+        wrapped, mesh,
+        (P(axis, None, None, None), P(axis, None)),
+        (P(axis, None, None, None), P(axis, None)),
+        key=("fast_all_to_all_xla", axis),
+    )(tokens, splits.astype(jnp.int32))
+
+
 def fast_all_to_all_op(
     tokens: jax.Array,
     splits: jax.Array,
@@ -249,3 +316,8 @@ A2A_TUNE_SPACE = (A2AConfig(1), A2AConfig(2), A2AConfig(4))
 fast_all_to_all_op = contextual_autotune(A2A_TUNE_SPACE, name="fast_all_to_all")(
     fast_all_to_all_op
 )
+# guard OUTSIDE the autotuner: the sweep still prices failing candidates;
+# only a failure of the whole tuned entry degrades to the XLA golden
+fast_all_to_all_op = resilience.guard_op(
+    "fast_all_to_all_op", _fast_all_to_all_op_xla
+)(fast_all_to_all_op)

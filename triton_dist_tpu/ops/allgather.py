@@ -30,9 +30,17 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from triton_dist_tpu import resilience
 from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
 from triton_dist_tpu.parallel import topology
 from triton_dist_tpu.shmem import device as shmem
+
+
+def _all_gather_xla(x: jax.Array, *, axis="tp", **_) -> jax.Array:
+    """The golden slow path (the same program every fused method is tested
+    against): XLA's all-gather, single- or multi-axis."""
+    axes = tuple(axis) if isinstance(axis, (tuple, list)) else axis
+    return jax.lax.all_gather(x, axes, tiled=True)
 
 
 def _is_dcn(axis) -> bool:
@@ -243,6 +251,20 @@ def all_gather_2d(
     axes: tuple[str, str],
     interpret: Any = None,
 ) -> jax.Array:
+    return resilience.guarded_call(
+        "all_gather_2d",
+        _all_gather_2d_fused,
+        functools.partial(_all_gather_xla, axis=tuple(axes)),
+        x, axes=axes, interpret=interpret,
+    )
+
+
+def _all_gather_2d_fused(
+    x: jax.Array,
+    *,
+    axes: tuple[str, str],
+    interpret: Any = None,
+) -> jax.Array:
     """Hierarchical allgather over two mesh axes ``(outer, inner)`` — the
     multi-axis composition VERDICT r1 called for (≙ 2-D rings, reference
     allgather.py:194,291). Call inside ``jax.shard_map``; golden:
@@ -291,8 +313,19 @@ def all_gather(x: jax.Array, *, axis: str = "tp", method: str = "auto", interpre
 
     `x` is this PE's shard ``(m, ...)``; returns ``(n*m, ...)`` with shard i
     at rows ``[i*m, (i+1)*m)``. Golden reference:
-    ``jax.lax.all_gather(x, axis, tiled=True)``.
+    ``jax.lax.all_gather(x, axis, tiled=True)`` — served automatically when
+    the fused kernel cannot run in this environment (resilience layer,
+    docs/resilience.md).
     """
+    return resilience.guarded_call(
+        "all_gather",
+        _all_gather_fused,
+        _all_gather_xla,
+        x, axis=axis, method=method, interpret=interpret, devices=devices,
+    )
+
+
+def _all_gather_fused(x: jax.Array, *, axis: str = "tp", method: str = "auto", interpret: Any = None, devices: Any = None) -> jax.Array:
     if isinstance(axis, (tuple, list)):
         if len(axis) == 1:
             axis = axis[0]
@@ -359,6 +392,19 @@ def all_gather(x: jax.Array, *, axis: str = "tp", method: str = "auto", interpre
     return out
 
 
+def _all_gather_op_xla(
+    x: jax.Array, mesh: Mesh, *, axis: str = "tp", **_
+) -> jax.Array:
+    """Op-level golden: the same shard_map entry serving XLA's all-gather."""
+    in_spec = P(axis, *([None] * (x.ndim - 1)))
+    out_spec = P(*([None] * x.ndim))
+    return jit_shard_map(
+        functools.partial(_all_gather_xla, axis=axis), mesh, in_spec, out_spec,
+        key=("all_gather_xla", axis),
+    )(x)
+
+
+@resilience.guard_op("all_gather_op", _all_gather_op_xla)
 def all_gather_op(
     x: jax.Array, mesh: Mesh, *, axis: str = "tp", method: str = "auto", interpret: Any = None
 ) -> jax.Array:

@@ -16,9 +16,35 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec
 
 from triton_dist_tpu import config as tdt_config
 from triton_dist_tpu.shmem import device as shmem
+
+# Renamed across jax lines (TPUCompilerParams before ~0.6, CompilerParams
+# after); resolving here keeps kernels buildable on both, and a total API
+# miss surfaces as an AttributeError the resilience guard recognizes.
+_COMPILER_PARAMS_CLS = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams", None
+)
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across the supported jax range: the public API
+    (``check_vma``) on newer lines, ``jax.experimental.shard_map``
+    (``check_rep``) before it."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as legacy_shard_map
+
+    return legacy_shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
 
 
 _collective_id_counter = itertools.count(1)
@@ -66,7 +92,27 @@ def dist_pallas_call(
 
     `uses_barrier` must be False for degenerate single-PE calls: Mosaic
     rejects a collective_id on kernels that never touch the barrier
-    semaphore."""
+    semaphore.
+
+    Resilience plumbing (zero-cost unless armed, docs/resilience.md): when
+    ``config.timeout_iters > 0`` every kernel gains one extra
+    ``int32[DIAG_LEN]`` SMEM output — the watchdog's diagnostic buffer —
+    and its body is traced inside a ``watchdog.kernel_scope`` so the SHMEM
+    wait primitives become bounded without any kernel changing its
+    signature; the traced diag output is stripped from the caller-visible
+    result and offered to the ambient ``jit_shard_map`` collection. An
+    armed ``config.fault_plan`` opens the scope too (the signal-chaos
+    injector needs the family/site bookkeeping) but adds no output."""
+    if _COMPILER_PARAMS_CLS is None:
+        raise NotImplementedError(
+            "jax.experimental.pallas.tpu exposes neither CompilerParams nor "
+            "TPUCompilerParams on this jax version; fused distributed "
+            "kernels cannot be built — ops degrade to the golden XLA "
+            "collective path via triton_dist_tpu.resilience.guarded_call"
+        )
+    from triton_dist_tpu.resilience import records as _records
+    from triton_dist_tpu.resilience import watchdog as _watchdog
+
     params: dict[str, Any] = dict(has_side_effects=True)
     if uses_barrier:
         params["collective_id"] = collective_id_for(name)
@@ -74,6 +120,82 @@ def dist_pallas_call(
         params["vmem_limit_bytes"] = vmem_limit_bytes
     if dimension_semantics is not None:
         params["dimension_semantics"] = dimension_semantics
+
+    cfg = tdt_config.get_config()
+    arm_diag = int(cfg.timeout_iters) > 0
+    arm_scope = arm_diag or cfg.fault_plan is not None
+    if arm_diag and params.get("dimension_semantics") is not None:
+        # megacore chips split 'parallel' grid dims across two TensorCores;
+        # the armed diag protocol (zero-init on grid step (0,…,0),
+        # first-record-wins, fast-fail budget chaining) relies on in-order
+        # execution on ONE core — a watchdogged run trades the parallel
+        # split for a sound protocol (diagnostic posture, not a fast path)
+        params["dimension_semantics"] = tuple(
+            "arbitrary" for _ in params["dimension_semantics"]
+        )
+
+    single_out = not isinstance(out_shape, (tuple, list))
+    out_shapes = [out_shape] if single_out else list(out_shape)
+    n_user_outs = len(out_shapes)
+    n_scratch = len(scratch_shapes)
+    grid_dims = 0
+    if grid_spec is not None:
+        n_scratch += len(grid_spec.scratch_shapes)
+        grid_dims = len(grid_spec.grid)
+    elif grid is not None:
+        grid_dims = len(grid)
+
+    if arm_diag:
+        # the diagnostic buffer: unblocked SMEM, last output, so existing
+        # input/output aliases and ref positions stay untouched
+        out_shapes.append(jax.ShapeDtypeStruct((_records.DIAG_LEN,), jnp.int32))
+        diag_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+        if grid_spec is not None:
+            gs_outs = grid_spec.out_specs
+            if not isinstance(gs_outs, (tuple, list)):
+                gs_outs = (gs_outs,)
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=grid_spec.num_scalar_prefetch,
+                grid=grid_spec.grid,
+                in_specs=list(grid_spec.in_specs),
+                out_specs=(*gs_outs, diag_spec),
+                scratch_shapes=list(grid_spec.scratch_shapes),
+            )
+        else:
+            if out_specs is None:
+                user_specs: tuple = tuple(pl.BlockSpec() for _ in range(n_user_outs))
+            elif isinstance(out_specs, (tuple, list)):
+                user_specs = tuple(out_specs)
+            else:
+                user_specs = (out_specs,)
+            out_specs = (*user_specs, diag_spec)
+
+    body = kernel
+    if arm_scope:
+        def body(*refs):  # noqa: F811 — deliberate armed override
+            diag_ref = None
+            user_refs = refs
+            if arm_diag:
+                i = len(refs) - n_scratch - 1
+                diag_ref = refs[i]
+                user_refs = refs[:i] + refs[i + 1:]
+
+                def _zero_diag():
+                    for j in range(_records.DIAG_LEN):
+                        diag_ref[j] = jnp.int32(0)
+
+                if grid_dims == 0:
+                    _zero_diag()
+                else:
+                    # compiled outputs start uninitialized: clear once, on
+                    # the first grid step (TPU grids execute in order)
+                    first = pl.program_id(0) == 0
+                    for d in range(1, grid_dims):
+                        first = jnp.logical_and(first, pl.program_id(d) == 0)
+                    pl.when(first)(_zero_diag)
+            with _watchdog.kernel_scope(diag_ref, name):
+                kernel(*user_refs)
+
     kwargs: dict[str, Any] = {}
     if grid_spec is not None:
         kwargs["grid_spec"] = grid_spec
@@ -86,16 +208,32 @@ def dist_pallas_call(
             kwargs["out_specs"] = out_specs
     if input_output_aliases:
         kwargs["input_output_aliases"] = input_output_aliases
-    return pl.pallas_call(
-        kernel,
-        out_shape=out_shape,
+    call = pl.pallas_call(
+        body,
+        out_shape=tuple(out_shapes) if arm_diag else out_shape,
         scratch_shapes=list(scratch_shapes),
-        compiler_params=pltpu.CompilerParams(**params),
+        compiler_params=_COMPILER_PARAMS_CLS(**params),
         cost_estimate=cost_estimate,
         interpret=tdt_config.interpret_params() if interpret is None else interpret,
         name=name,
         **kwargs,
     )
+    if not arm_diag:
+        return call
+
+    def invoke(*args):
+        outs = call(*args)
+        *user, diag = outs
+        if not _watchdog.offer(diag):
+            # traced inside a USER-level shard_map, not jit_shard_map: no
+            # host boundary will decode this diag and raise, so poison the
+            # outputs in-trace — a timed-out launch must never hand back
+            # plausible partial data
+            bad = diag[_records.F_STATUS] != _records.STATUS_OK
+            user = [_watchdog.poison(u, bad) for u in user]
+        return user[0] if single_out else tuple(user)
+
+    return invoke
 
 
 def gemm_add_pipeline(
@@ -202,26 +340,80 @@ def jit_shard_map(
     measured ~2 s per call on a tunneled TPU. `key` must capture everything
     that changes the traced program besides the mesh/specs (op name, config,
     method, static dims); argument shapes/dtypes are handled by jit itself.
+
+    When the watchdog is armed (``config.timeout_iters > 0``) the traced fn
+    runs inside a ``watchdog.collect`` scope: every ``dist_pallas_call`` it
+    launches contributes its diagnostic buffer, the merged per-PE record
+    rides back as one extra shard_map output (outputs are NaN-poisoned
+    in-program on the PEs that tripped), and host-side a non-clean record
+    raises :class:`resilience.DistTimeoutError` (or, with
+    ``config.raise_on_timeout=False``, returns the poisoned outputs after
+    recording the event in ``resilience.health``).
     """
     from triton_dist_tpu import config as _tdt_config
+    from triton_dist_tpu.resilience import records as _records
+    from triton_dist_tpu.resilience import watchdog as _watchdog
 
+    cfg = _tdt_config.get_config()
+    armed = int(cfg.timeout_iters) > 0
     cache_key = (
         mesh, str(in_specs), str(out_specs), donate_argnums, key,
         # trace-time config that changes the kernel program (a cached
-        # un-delayed program must not serve a race-shaking run)
-        _tdt_config.get_config().debug_comm_delay,
+        # un-delayed program must not serve a race-shaking, watchdogged,
+        # or fault-injected run, and vice versa)
+        cfg.debug_comm_delay, cfg.timeout_iters, cfg.fault_plan,
     )
     hit = _jit_cache.get(cache_key)
     if hit is None:
-        hit = jax.jit(
-            jax.shard_map(
-                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                check_vma=False,
-            ),
-            donate_argnums=donate_argnums,
-        )
+        if armed:
+            def fn_diag(*args):
+                with _watchdog.collect() as diags:
+                    out = fn(*args)
+                diag = _watchdog.merge(diags)
+                bad = diag[0, _records.F_STATUS] != _records.STATUS_OK
+                return _watchdog.poison(out, bad), diag
+
+            diag_out_spec = PartitionSpec(tuple(mesh.axis_names), None)
+            hit = jax.jit(
+                _shard_map(fn_diag, mesh, in_specs, (out_specs, diag_out_spec)),
+                donate_argnums=donate_argnums,
+            )
+        else:
+            hit = jax.jit(
+                _shard_map(fn, mesh, in_specs, out_specs),
+                donate_argnums=donate_argnums,
+            )
         _jit_cache[cache_key] = hit
-    return hit
+    if not armed:
+        return hit
+
+    jitted = hit
+    family = key[0] if isinstance(key, tuple) and key and isinstance(key[0], str) else str(key)
+
+    def call(*args):
+        from triton_dist_tpu.resilience import health
+
+        reason = health.short_circuited(family)
+        if reason is not None:
+            # the family's collective semaphore state is undefined after an
+            # earlier trip (even under raise_on_timeout=False, which raised
+            # nothing): refuse the launch with a fallbackable error so an
+            # enclosing guard serves the golden path — loud otherwise
+            raise NotImplementedError(
+                f"distributed kernel family {family!r} refused to launch: "
+                f"{reason}; its collective semaphore may hold residue. "
+                f"Guarded op entries serve the golden XLA path; see "
+                f"docs/resilience.md."
+            )
+        out, diag = jitted(*args)
+        recs = _records.decode_diag(diag)  # forces the device sync
+        if recs:
+            health.record_timeout(family, recs)
+            if _tdt_config.get_config().raise_on_timeout:
+                raise _records.DistTimeoutError(family, recs)
+        return out
+
+    return call
 
 
 def barrier_all_op(axis: str = "tp", interpret: Any = None) -> None:

@@ -33,6 +33,8 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import os
+import re
 from typing import Any
 
 import jax
@@ -41,6 +43,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from triton_dist_tpu import resilience
 from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.allgather import all_gather
 from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
@@ -50,12 +53,27 @@ from triton_dist_tpu.utils import cdiv, pick_block
 NEG_INF = float("-inf")
 
 
-# XLA's per-kernel scoped-vmem stack limit (the default
-# --xla_tpu_scoped_vmem_limit_kib): pipeline buffers + scratch of ONE
-# pallas_call must fit this, regardless of how much physical VMEM the
-# generation has — chip-measured r5: a 16.19 MB allocation is rejected
-# with "limit 16.00M" on v5e while vmem_bytes() reports 128 MB.
-_SCOPED_VMEM_LIMIT = 16 * 2**20
+def _scoped_vmem_limit_bytes() -> int:
+    """XLA's per-kernel scoped-vmem stack limit: pipeline buffers + scratch
+    of ONE pallas_call must fit this, regardless of how much physical VMEM
+    the generation has — chip-measured r5: a 16.19 MB allocation is
+    rejected with "limit 16.00M" on v5e while vmem_bytes() reports 128 MB.
+
+    Deployments override the limit with ``--xla_tpu_scoped_vmem_limit_kib``
+    (in XLA_FLAGS or LIBTPU_INIT_ARGS) or ``TDT_SCOPED_VMEM_LIMIT_KIB``;
+    the grid auto-selection must respect that, not a baked-in constant —
+    read it per call (flags can be set after import), 16 MiB fallback."""
+    kib = os.environ.get("TDT_SCOPED_VMEM_LIMIT_KIB")
+    if kib is None:
+        for var in ("XLA_FLAGS", "LIBTPU_INIT_ARGS"):
+            m = re.search(
+                r"--xla_tpu_scoped_vmem_limit_kib=(\d+)",
+                os.environ.get(var, ""),
+            )
+            if m:
+                kib = m.group(1)
+                break
+    return int(kib) * 1024 if kib is not None else 16 * 2**20
 
 # Per-step attention span both paged grids aim for when auto-picking
 # pages_per_step: the contiguous sweep's winning block_s on chip (r5) —
@@ -100,7 +118,9 @@ def _fused_slab_vmem_budget() -> int:
     allowance for those residents. Derived from the topology table (not
     a constant) so a generation with smaller VMEM auto-selects the
     per-head grid instead of failing to compile."""
-    return min(topology.vmem_bytes() // 2, _SCOPED_VMEM_LIMIT - 2 * 2**20)
+    return min(
+        topology.vmem_bytes() // 2, _scoped_vmem_limit_bytes() - 2 * 2**20
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -331,7 +351,11 @@ def _xla_decode(q, k, v, kv_lens, *, return_lse):
 
 def _decode_call(q, k, v, scales, kv_lens, *, config, return_lse, interpret):
     """Shared host-side builder for the plain and int8 decode paths; the
-    only deltas are the two optional scale operands and the q dtype."""
+    only deltas are the two optional scale operands and the q dtype.
+
+    The bf16 path degrades to :func:`_xla_decode` when the Pallas kernel
+    cannot run in this environment (resilience layer, docs/resilience.md);
+    int8 caches have no golden slow path, so their failures stay loud."""
     cfg = config or FlashDecodeConfig()
     if cfg.block_s == 0:
         if scales is not None:
@@ -342,6 +366,21 @@ def _decode_call(q, k, v, scales, kv_lens, *, config, return_lse, interpret):
         return _xla_decode(
             q, k, v, kv_lens.astype(jnp.int32), return_lse=return_lse
         )
+    return resilience.guarded_call(
+        "flash_decode_quant" if scales is not None else "flash_decode",
+        lambda: _decode_call_fused(
+            q, k, v, scales, kv_lens, cfg=cfg, return_lse=return_lse,
+            interpret=interpret,
+        ),
+        None if scales is not None else (
+            lambda: _xla_decode(
+                q, k, v, kv_lens.astype(jnp.int32), return_lse=return_lse
+            )
+        ),
+    )
+
+
+def _decode_call_fused(q, k, v, scales, kv_lens, *, cfg, return_lse, interpret):
     b, hq, d = q.shape
     _, h_kv, s_len, _ = k.shape
     assert hq % h_kv == 0, (hq, h_kv)
@@ -543,13 +582,24 @@ def flash_verify(
     the chunk via the cache). Returns f32 ``[b, S, q_heads, d]`` (+
     ``lse [b, S, q_heads]``)."""
     cfg = config or FlashDecodeConfig()
-    b, S, hq, d = q.shape
-    _, h_kv, s_len, _ = k.shape
-    assert hq % h_kv == 0, (hq, h_kv)
-    g = hq // h_kv
+    assert q.shape[2] % k.shape[1] == 0, (q.shape, k.shape)
     kv_lens = kv_lens.astype(jnp.int32)
     if cfg.block_s == 0:
         return _xla_verify(q, k, v, kv_lens, return_lse=return_lse)
+    return resilience.guarded_call(
+        "flash_verify",
+        lambda: _flash_verify_fused(
+            q, k, v, kv_lens, cfg=cfg, return_lse=return_lse,
+            interpret=interpret,
+        ),
+        lambda: _xla_verify(q, k, v, kv_lens, return_lse=return_lse),
+    )
+
+
+def _flash_verify_fused(q, k, v, kv_lens, *, cfg, return_lse, interpret):
+    b, S, hq, d = q.shape
+    _, h_kv, s_len, _ = k.shape
+    g = hq // h_kv
     sc = pick_block(s_len, cfg.block_s)
     n_chunks = s_len // sc
     rows = S * g
@@ -631,6 +681,39 @@ def flash_verify_distributed(
     return merged.reshape(b, S, hq, d)
 
 
+def _paged_to_contiguous(pages, block_table):
+    """Gather a paged pool back into per-sequence contiguous caches:
+    ``[n_pages, h_kv, page, d]`` + ``[b, max_pages]`` →
+    ``[b, h_kv, max_pages*page, d]`` — a pure XLA gather, so the paged
+    entries get a golden slow path with the identical masking contract
+    (positions past ``kv_lens`` are masked either way)."""
+    b, max_pages = block_table.shape
+    x = pages[block_table.astype(jnp.int32)]  # [b, max_pages, h_kv, pg, d]
+    _, _, h_kv, page, d = x.shape
+    return x.swapaxes(1, 2).reshape(b, h_kv, max_pages * page, d)
+
+
+def _xla_paged_decode(q, k_pages, v_pages, kv_lens, block_table, *,
+                      return_lse=False):
+    """Golden slow path for the paged decode: block-table gather to a
+    contiguous cache + the XLA-native masked attention."""
+    return _xla_decode(
+        q, _paged_to_contiguous(k_pages, block_table),
+        _paged_to_contiguous(v_pages, block_table),
+        kv_lens, return_lse=return_lse,
+    )
+
+
+def _xla_paged_verify(q, k_pages, v_pages, kv_lens, block_table, *,
+                      return_lse=False):
+    """Golden slow path for the paged multi-position verify."""
+    return _xla_verify(
+        q, _paged_to_contiguous(k_pages, block_table),
+        _paged_to_contiguous(v_pages, block_table),
+        kv_lens, return_lse=return_lse,
+    )
+
+
 def _paged_flash_verify_kernel(
     max_lens_ref, bt_ref, lens_ref, q_ref, *rest,
     n_steps: int, pages_per_step: int, page_size: int, scale: float,
@@ -697,14 +780,34 @@ def paged_flash_verify(
     already written into their pages). ``fuse_heads`` /
     ``pages_per_step`` (None = the same span-driven auto as
     :func:`paged_flash_decode`, with the verify rows' larger
-    q/out/accumulator residents counted against the VMEM budget)."""
+    q/out/accumulator residents counted against the VMEM budget).
+    Degrades to the gather-reconstructed :func:`_xla_paged_verify` golden
+    when the Pallas kernel cannot run in this environment (resilience
+    layer, docs/resilience.md)."""
+    assert q.shape[2] % k_pages.shape[1] == 0, (q.shape, k_pages.shape)
+    kv_lens = kv_lens.astype(jnp.int32)
+    return resilience.guarded_call(
+        "paged_flash_verify",
+        lambda: _paged_flash_verify_fused(
+            q, k_pages, v_pages, kv_lens, block_table,
+            fuse_heads=fuse_heads, pages_per_step=pages_per_step,
+            return_lse=return_lse, interpret=interpret,
+        ),
+        lambda: _xla_paged_verify(
+            q, k_pages, v_pages, kv_lens, block_table, return_lse=return_lse
+        ),
+    )
+
+
+def _paged_flash_verify_fused(
+    q, k_pages, v_pages, kv_lens, block_table, *,
+    fuse_heads, pages_per_step, return_lse, interpret,
+):
     b, S, hq, d = q.shape
     n_pages, h_kv, page_size, _ = k_pages.shape
-    assert hq % h_kv == 0, (hq, h_kv)
     g = hq // h_kv
     rows = S * g
     max_pages = block_table.shape[1]
-    kv_lens = kv_lens.astype(jnp.int32)
     # per-head-grid resident bytes (q block in the cache dtype, f32
     # out/lse blocks, f32 m/l/acc scratches); the fused grid holds h_kv×
     slab_h = page_size * d * k_pages.dtype.itemsize
@@ -717,6 +820,26 @@ def paged_flash_verify(
     p_h = _auto_pages_per_step(slab_h, page_size, max_pages, resident=res_h)
     if fuse_heads is None:
         fuse_heads = p_f >= 1 and p_f >= p_h
+    if pages_per_step is None and (p_f if fuse_heads else p_h) == 0:
+        # the SELECTED grid (auto never picks a dead grid while the other
+        # lives, but an explicit fuse_heads can force one) affords not even
+        # ONE page slot: without this check the forced pages_per_step=1
+        # dies deep inside Mosaic compilation with an allocation error
+        # naming none of these numbers
+        raise ValueError(
+            f"paged_flash_verify: the selected "
+            f"{'fused' if fuse_heads else 'per-head'} grid affords no "
+            f"single page slot under the scoped-VMEM budget — "
+            f"rows=S*g={rows} (S={S}, g={g}), page_size={page_size}, "
+            f"head_dim={d}, h_kv={h_kv}: residents "
+            f"{(h_kv * res_h) if fuse_heads else res_h} B + one "
+            f"double-buffered K+V page slot "
+            f"{4 * ((h_kv * slab_h) if fuse_heads else slab_h)} B exceed "
+            f"the {_fused_slab_vmem_budget()} B budget "
+            f"(--xla_tpu_scoped_vmem_limit_kib / TDT_SCOPED_VMEM_LIMIT_KIB "
+            f"raises it). Reduce S or page_size, toggle fuse_heads, or use "
+            f"flash_verify on a contiguous cache."
+        )
     if pages_per_step is None:
         pages_per_step = max(1, p_f if fuse_heads else p_h)
     P = pages_per_step
@@ -1055,10 +1178,37 @@ def paged_flash_decode(
     bound by) and the scales ride 2P extra page-slot fetches; this
     completes the serving cache matrix (contiguous/paged ×
     bf16/int8), which the reference's bf16-only paged decode lacks.
+
+    The bf16 pool degrades to the gather-reconstructed
+    :func:`_xla_paged_decode` golden when the Pallas kernel cannot run in
+    this environment (resilience layer, docs/resilience.md); int8 pools
+    have no golden slow path, so their failures stay loud.
     """
+    assert q.shape[1] % k_pages.shape[1] == 0, (q.shape, k_pages.shape)
+    kv_lens = kv_lens.astype(jnp.int32)
+    return resilience.guarded_call(
+        "paged_flash_decode_q" if k_scales is not None else "paged_flash_decode",
+        lambda: _paged_flash_decode_fused(
+            q, k_pages, v_pages, kv_lens, block_table,
+            k_scales=k_scales, v_scales=v_scales, fuse_heads=fuse_heads,
+            pages_per_step=pages_per_step, return_lse=return_lse,
+            interpret=interpret,
+        ),
+        None if k_scales is not None else (
+            lambda: _xla_paged_decode(
+                q, k_pages, v_pages, kv_lens, block_table,
+                return_lse=return_lse,
+            )
+        ),
+    )
+
+
+def _paged_flash_decode_fused(
+    q, k_pages, v_pages, kv_lens, block_table, *,
+    k_scales, v_scales, fuse_heads, pages_per_step, return_lse, interpret,
+):
     b, hq, d = q.shape
     n_pages, h_kv, page_size, _ = k_pages.shape
-    assert hq % h_kv == 0, (hq, h_kv)
     g = hq // h_kv
     max_pages = block_table.shape[1]
     quant = k_scales is not None
@@ -1071,6 +1221,8 @@ def paged_flash_decode(
         d * k_pages.dtype.itemsize + (4 if quant else 0)
     )
     slab_f = h_kv * slab_h
+    p_f = _auto_pages_per_step(slab_f, page_size, max_pages)
+    p_h = _auto_pages_per_step(slab_h, page_size, max_pages)
     if fuse_heads is None:
         # span-driven choice (r5 chip finding: the per-step softmax span,
         # not the page indirection or DMA size, decides throughput): each
@@ -1080,8 +1232,6 @@ def paged_flash_decode(
         # only when at least one fused slot actually fits the budget.
         # This preserves the old guarantee that many-kv-head pools never
         # fail to compile: per-head slabs are h_kv× smaller.
-        p_f = _auto_pages_per_step(slab_f, page_size, max_pages)
-        p_h = _auto_pages_per_step(slab_h, page_size, max_pages)
         if quant:
             # int8 pools halve payload bytes and add per-page scale
             # fetches: the per-head grid's [page, d] slices drop to tens
@@ -1092,6 +1242,24 @@ def paged_flash_decode(
             fuse_heads = p_f >= 1
         else:
             fuse_heads = p_f >= 1 and p_f >= p_h
+    if pages_per_step is None and (p_f if fuse_heads else p_h) == 0:
+        # the SELECTED grid (auto never picks a dead grid while the other
+        # lives, but an explicit fuse_heads can force one) affords not even
+        # ONE page slot: without this check the forced pages_per_step=1
+        # dies deep inside Mosaic compilation with an allocation error
+        # naming none of these numbers
+        raise ValueError(
+            f"paged_flash_decode: the selected "
+            f"{'fused' if fuse_heads else 'per-head'} grid affords no "
+            f"single page slot under the scoped-VMEM budget — "
+            f"page_size={page_size}, head_dim={d}, h_kv={h_kv}: one "
+            f"double-buffered K+V page slot "
+            f"{4 * (slab_f if fuse_heads else slab_h)} B exceeds the "
+            f"{_fused_slab_vmem_budget()} B budget "
+            f"(--xla_tpu_scoped_vmem_limit_kib / TDT_SCOPED_VMEM_LIMIT_KIB "
+            f"raises it). Reduce page_size, toggle fuse_heads, or use "
+            f"flash_decode on a contiguous cache."
+        )
     scale = 1.0 / math.sqrt(d)
     # match q to the pool's COMPUTE dtype (int8 pools upcast to bf16 in
     # the kernel — the same contract as flash_decode_quant)
@@ -1106,9 +1274,7 @@ def paged_flash_decode(
     )
     if fuse_heads:
         if pages_per_step is None:
-            pages_per_step = max(
-                1, _auto_pages_per_step(slab_f, page_size, max_pages)
-            )
+            pages_per_step = max(1, p_f)
         P = pages_per_step
         n_steps = cdiv(max_pages, P)
 
@@ -1170,9 +1336,7 @@ def paged_flash_decode(
         return (out, lse) if return_lse else out
 
     if pages_per_step is None:
-        pages_per_step = max(
-            1, _auto_pages_per_step(slab_h, page_size, max_pages)
-        )
+        pages_per_step = max(1, p_h)
     P = pages_per_step
     n_steps = cdiv(max_pages, P)
 
@@ -1439,6 +1603,20 @@ def _fd_effective_block(cfg, q, k, v, kv_lens, mesh, *, axis="tp", **_):
     )
 
 
+def _flash_decode_op_xla(q, k, v, kv_lens, mesh, **_):
+    """Op-level golden: the XLA-native masked attention over the full
+    cache — no SPMD machinery at all (jit shards the einsums under the
+    arrays' placement), so it survives any topology the fused SP
+    pipeline cannot."""
+    del mesh
+    return _xla_decode(q, k, v, kv_lens.astype(jnp.int32), return_lse=False)
+
+
 flash_decode_op = contextual_autotune(
     FLASH_DECODE_TUNE_SPACE, name="flash_decode", dedupe=_fd_effective_block
 )(flash_decode_op)
+# guard OUTSIDE the autotuner: the sweep still prices failing candidates;
+# only a failure of the whole tuned entry degrades to the XLA golden
+flash_decode_op = resilience.guard_op("flash_decode_op", _flash_decode_op_xla)(
+    flash_decode_op
+)

@@ -43,6 +43,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from triton_dist_tpu import resilience
 from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.common import (
     dist_pallas_call,
@@ -53,6 +54,18 @@ from triton_dist_tpu.ops.common import (
 from triton_dist_tpu.ops.reduce_scatter import get_auto_reduce_scatter_method
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block
+
+
+def _gemm_rs_xla(
+    a: jax.Array, b: jax.Array, *, axis="tp", out_dtype=None, **_
+) -> jax.Array:
+    """The golden slow path (the same program every fused method is tested
+    against): XLA's dot + psum-scatter, single- or multi-axis."""
+    axes = tuple(axis) if isinstance(axis, (tuple, list)) else axis
+    out_dtype = out_dtype or a.dtype
+    return jax.lax.psum_scatter(
+        jnp.dot(a, b, preferred_element_type=out_dtype), axes, tiled=True
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -215,8 +228,30 @@ def gemm_rs(
     b: ``[k_loc, N]`` — K-shard of the weight (row-parallel).
     Returns ``[m_loc, N]`` — this PE's M-chunk of the fully-reduced product.
     Golden: ``jax.lax.psum_scatter(a @ b, axis, tiled=True)``
-    (≙ ``gemm_rs_op``, reference gemm_reduce_scatter.py:498).
+    (≙ ``gemm_rs_op``, reference gemm_reduce_scatter.py:498) — served
+    automatically when the fused kernel cannot run in this environment
+    (resilience layer, docs/resilience.md).
     """
+    return resilience.guarded_call(
+        "gemm_rs",
+        _gemm_rs_fused,
+        _gemm_rs_xla,
+        a, b, axis=axis, method=method, config=config, out_dtype=out_dtype,
+        interpret=interpret, devices=devices,
+    )
+
+
+def _gemm_rs_fused(
+    a: jax.Array,
+    b: jax.Array,
+    *,
+    axis: str = "tp",
+    method: str = "auto",
+    config: GemmRSConfig | None = None,
+    out_dtype: Any = None,
+    interpret: Any = None,
+    devices: Any = None,
+) -> jax.Array:
     cfg = config or GemmRSConfig()
     out_dtype = out_dtype or a.dtype
     from triton_dist_tpu.parallel.topology import is_dcn_axis_name as _is_dcn
@@ -338,6 +373,23 @@ def gemm_rs(
     return outs[0]
 
 
+def _gemm_rs_op_xla(
+    a: jax.Array,
+    b: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "tp",
+    **_,
+) -> jax.Array:
+    """Op-level golden: the same shard_map entry serving XLA's
+    dot + psum-scatter."""
+    return jit_shard_map(
+        functools.partial(_gemm_rs_xla, axis=axis),
+        mesh, (P(None, axis), P(axis, None)), P(axis, None),
+        key=("gemm_rs_xla", axis),
+    )(a, b)
+
+
 def gemm_rs_op(
     a: jax.Array,
     b: jax.Array,
@@ -388,3 +440,6 @@ GEMM_RS_TUNE_SPACE = (
 )
 
 gemm_rs_op = contextual_autotune(GEMM_RS_TUNE_SPACE, name="gemm_rs")(gemm_rs_op)
+# guard OUTSIDE the autotuner: the sweep still prices failing candidates;
+# only a failure of the whole tuned entry degrades to the XLA golden
+gemm_rs_op = resilience.guard_op("gemm_rs_op", _gemm_rs_op_xla)(gemm_rs_op)

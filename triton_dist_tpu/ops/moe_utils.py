@@ -260,16 +260,27 @@ def scatter_add_unsorted(
     combine becomes gather + weighted sum, both streaming ops (0.89 ms
     on chip).
 
-    ``assume_bijective`` is that CONTRACT, not a runtime check (a traced
-    guard + ``lax.cond`` costs ~1.1 ms — re-measured r5): pass ``False``
-    for capacity-style alignments that DROP slots (a dropped slot would
-    shift every later token onto the wrong rows under the gather form)
-    to get the masked-scatter semantics where dropped slots contribute
-    zero."""
+    ``assume_bijective`` is that CONTRACT, not a PRODUCTION runtime check
+    (a traced guard + ``lax.cond`` costs ~1.1 ms — re-measured r5): pass
+    ``False`` for capacity-style alignments that DROP slots (a dropped
+    slot would shift every later token onto the wrong rows under the
+    gather form) to get the masked-scatter semantics where dropped slots
+    contribute zero.
+
+    Under interpret/debug mode (``config.interpreting()``) the contract IS
+    validated: the sorted slot ids must be exactly ``arange(t)`` followed
+    by sentinels, and a violating alignment is routed to the masked-
+    scatter path via ``lax.cond`` — a dropped slot then contributes zero
+    instead of silently shifting every later token's rows (ADVICE r5 #1).
+    The debug-tier cost never ships: compiled TPU runs keep the unguarded
+    gather form."""
+    from triton_dist_tpu import config as tdt_config
+
     topk = weights.shape[1]
     ids = alignment.sorted_token_ids  # [t_pad], sentinel = n_tokens*topk
     t = n_tokens * topk
-    if not assume_bijective:
+
+    def masked_scatter(ids):
         valid = ids < t
         flat_w = jnp.where(
             valid, weights.reshape(-1)[jnp.clip(ids, 0, t - 1)], 0.0
@@ -280,12 +291,24 @@ def scatter_add_unsorted(
             jnp.zeros((n_tokens, y_sorted.shape[1]), jnp.float32)
             .at[token_of_row].add(jnp.where(valid[:, None], contrib, 0.0))
         )
-    inv = jnp.argsort(ids, stable=True)[:t].reshape(n_tokens, topk)
-    w = weights.astype(jnp.float32)
-    # one row-gather per k slot: the obvious single [t, k, d] gather
-    # measures 2.6x slower on chip (the 3-D intermediate's layout defeats
-    # the streaming fusion); topk is small and static
-    out = y_sorted[inv[:, 0]].astype(jnp.float32) * w[:, 0][:, None]
-    for k in range(1, topk):
-        out = out + y_sorted[inv[:, k]].astype(jnp.float32) * w[:, k][:, None]
-    return out
+
+    def bijective_gather(ids):
+        inv = jnp.argsort(ids, stable=True)[:t].reshape(n_tokens, topk)
+        w = weights.astype(jnp.float32)
+        # one row-gather per k slot: the obvious single [t, k, d] gather
+        # measures 2.6x slower on chip (the 3-D intermediate's layout
+        # defeats the streaming fusion); topk is small and static
+        out = y_sorted[inv[:, 0]].astype(jnp.float32) * w[:, 0][:, None]
+        for k in range(1, topk):
+            out = out + y_sorted[inv[:, k]].astype(jnp.float32) * w[:, k][:, None]
+        return out
+
+    if not assume_bijective:
+        return masked_scatter(ids)
+    if tdt_config.interpreting():
+        sorted_ids = jnp.sort(ids)
+        ok = jnp.all(sorted_ids[:t] == jnp.arange(t, dtype=sorted_ids.dtype))
+        if ids.shape[0] > t:
+            ok = jnp.logical_and(ok, jnp.all(sorted_ids[t:] == t))
+        return jax.lax.cond(ok, bijective_gather, masked_scatter, ids)
+    return bijective_gather(ids)

@@ -33,11 +33,18 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
+from triton_dist_tpu import resilience
 from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
 from triton_dist_tpu.parallel import topology
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block
+
+
+def _reduce_scatter_xla(x: jax.Array, *, axis="tp", **_) -> jax.Array:
+    """The golden slow path: XLA's psum-scatter, single- or multi-axis."""
+    axes = tuple(axis) if isinstance(axis, (tuple, list)) else axis
+    return jax.lax.psum_scatter(x, axes, tiled=True)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -203,8 +210,28 @@ def reduce_scatter(
     `x` is this PE's full partial array ``(n*m_loc, n_dim)``; returns
     ``(m_loc, n_dim)`` — the sum over PEs of rows ``[me*m_loc, (me+1)*m_loc)``.
     Golden: ``jax.lax.psum_scatter(x, axis, tiled=True)``
-    (≙ ``reduce_scatter_2d_op``, reference reduce_scatter.py:863).
+    (≙ ``reduce_scatter_2d_op``, reference reduce_scatter.py:863) — served
+    automatically when the fused kernel cannot run in this environment
+    (resilience layer, docs/resilience.md).
     """
+    return resilience.guarded_call(
+        "reduce_scatter",
+        _reduce_scatter_fused,
+        _reduce_scatter_xla,
+        x, axis=axis, method=method, config=config, interpret=interpret,
+        devices=devices,
+    )
+
+
+def _reduce_scatter_fused(
+    x: jax.Array,
+    *,
+    axis: str = "tp",
+    method: str = "auto",
+    config: ReduceScatterConfig | None = None,
+    interpret: Any = None,
+    devices: Any = None,
+) -> jax.Array:
     if isinstance(axis, (tuple, list)):
         if len(axis) == 1:
             axis = axis[0]
@@ -327,6 +354,21 @@ def reduce_scatter_2d(
     )
 
 
+def _reduce_scatter_op_xla(
+    x: jax.Array, mesh: Mesh, *, axis: str = "tp", **_
+) -> jax.Array:
+    """Op-level golden: the same shard_map entry serving XLA's psum-scatter."""
+
+    def wrapped(xs):
+        return _reduce_scatter_xla(xs[0], axis=axis)
+
+    in_spec = P(axis, *([None] * (x.ndim - 1)))
+    out_spec = P(axis, *([None] * (x.ndim - 2)))
+    return jit_shard_map(
+        wrapped, mesh, (in_spec,), out_spec, key=("reduce_scatter_xla", axis)
+    )(x)
+
+
 def reduce_scatter_op(
     x: jax.Array,
     mesh: Mesh,
@@ -374,5 +416,10 @@ RS_TUNE_SPACE = (
 )
 
 reduce_scatter_op = contextual_autotune(RS_TUNE_SPACE, name="reduce_scatter")(
+    reduce_scatter_op
+)
+# guard OUTSIDE the autotuner: the sweep still prices failing candidates;
+# only a failure of the whole tuned entry degrades to the XLA golden
+reduce_scatter_op = resilience.guard_op("reduce_scatter_op", _reduce_scatter_op_xla)(
     reduce_scatter_op
 )

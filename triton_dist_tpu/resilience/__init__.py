@@ -1,0 +1,52 @@
+"""Resilience subsystem: watchdogged waits, signal fault injection, and
+graceful fallback to XLA collectives.
+
+Three parts (see docs/resilience.md for the full contract):
+
+- :mod:`watchdog` / :mod:`records` — bounded distributed waits that write a
+  structured diagnostic record and NaN-poison outputs instead of spinning
+  forever; surfaced host-side as :class:`DistTimeoutError`.
+  Arm with ``config.update(timeout_iters=N)``.
+- :mod:`faults` — deterministic interpret-mode signal chaos
+  (drop/duplicate/delay a signal, straggle a PE).
+  Arm with ``config.update(fault_plan=FaultPlan(...))``.
+- :mod:`guard` / :mod:`health` — ``guarded_call`` degrades a failing fused
+  op to its golden ``jax.lax`` collective and records the downgrade in the
+  process-wide health registry. On by default
+  (``config.update(fallback_to_xla=False)`` for the loud CI posture).
+"""
+
+from triton_dist_tpu.resilience import health as health
+from triton_dist_tpu.resilience import watchdog as watchdog
+from triton_dist_tpu.resilience.faults import KINDS as FAULT_KINDS, FaultPlan
+from triton_dist_tpu.resilience.guard import (
+    UnsupportedTopologyError,
+    fallbackable,
+    guard_op,
+    guarded_call,
+)
+from triton_dist_tpu.resilience.records import (
+    DIAG_LEN,
+    DistTimeoutError,
+    decode_diag,
+    decode_record,
+    family_code_for,
+    family_name_for,
+)
+
+__all__ = [
+    "DIAG_LEN",
+    "DistTimeoutError",
+    "FAULT_KINDS",
+    "FaultPlan",
+    "UnsupportedTopologyError",
+    "decode_diag",
+    "decode_record",
+    "fallbackable",
+    "family_code_for",
+    "family_name_for",
+    "guard_op",
+    "guarded_call",
+    "health",
+    "watchdog",
+]

@@ -1,0 +1,170 @@
+"""Signal fault injection — interpret-mode chaos for distributed kernels.
+
+The reference shakes races by sleeping its comm streams random amounts
+(Triton-distributed ``allgather.py:72-76``); that perturbs timing but can
+never create the production failure mode that actually kills jobs: a LOST
+or MISCOUNTED signal. This injector can, deterministically:
+
+- ``drop_signal``      — a chosen PE's signal increment becomes 0
+- ``dup_signal``       — a chosen PE's signal increment doubles
+- ``delay_signal``     — a chosen PE busy-spins before issuing the signal
+- ``straggler``        — a chosen PE busy-spins on entering ``barrier_all``
+                         (skewing its whole issue schedule)
+
+Configured host-side via ``config.update(fault_plan=FaultPlan(...))`` and
+applied at TRACE time inside the SHMEM signal/barrier primitives: the
+injected alteration is a data-dependent ``jnp.where`` on ``my_pe``, so one
+SPMD trace serves every PE and only the targeted one misbehaves. Faults are
+interpret-mode only by design (the injector refuses to arm on real TPU —
+chaos against production silicon is a different tool); ``tests/test_chaos.py``
+uses it to prove every kernel family either completes correctly or trips
+the watchdog with a decoded diagnostic — never silently corrupts.
+
+Puts are deliberately NOT droppable: on TPU the data and its completion
+signal are one DMA (the data-coupled recv semaphore), so "signal lost,
+data arrived" — NVSHMEM's classic fence/ordering bug — cannot exist; the
+lossy edges are the *pure* signal ops and barrier rounds, which is exactly
+what this injector covers. Dropping whole puts would model link loss, which
+ICI handles below the programming model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from triton_dist_tpu.resilience import watchdog
+
+KINDS = ("drop_signal", "dup_signal", "delay_signal", "straggler")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """One injected fault (set via ``config.update(fault_plan=...)``).
+
+    kind:   one of :data:`KINDS`.
+    pe:     flattened PE index (along the kernel's comm axis) to afflict;
+            -1 afflicts every PE.
+    site:   trace-time ordinal of the signal site inside the kernel
+            (``None`` = all sites). Signal sites and barrier rounds share
+            one counter per kernel launch, so site 0 is the first signal
+            the kernel body issues.
+    family: restrict to one ``dist_pallas_call(name=...)`` family
+            (``None`` = all families).
+    delay_iters: busy-loop iterations for delay_signal / straggler.
+    """
+
+    kind: str
+    pe: int = 0
+    site: int | None = None
+    family: str | None = None
+    delay_iters: int = 20_000
+
+    def validate(self) -> "FaultPlan":
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"FaultPlan.kind must be one of {KINDS}, got {self.kind!r}"
+            )
+        if self.pe < -1:
+            raise ValueError(f"FaultPlan.pe must be >= -1, got {self.pe}")
+        if self.site is not None and self.site < 0:
+            raise ValueError(f"FaultPlan.site must be >= 0, got {self.site}")
+        if self.delay_iters < 0:
+            raise ValueError(
+                f"FaultPlan.delay_iters must be >= 0, got {self.delay_iters}"
+            )
+        return self
+
+
+def active_plan(family: str | None = None) -> FaultPlan | None:
+    """The armed plan, if any, gated to interpret mode and filtered by
+    kernel family. Returns None on real TPU (and warns once)."""
+    from triton_dist_tpu import config as tdt_config
+
+    plan = tdt_config.get_config().fault_plan
+    if plan is None:
+        return None
+    if tdt_config.on_tpu() and tdt_config.get_config().interpret is not True:
+        import warnings
+
+        warnings.warn(
+            "triton_dist_tpu: fault_plan is set but this is a compiled TPU "
+            "run — fault injection is interpret-mode only and was ignored",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+        return None
+    if plan.family is not None and family is not None and plan.family != family:
+        return None
+    return plan
+
+
+def _busy_zero(iters, anchor):
+    """A VPU busy loop of (traced) ``iters`` iterations whose result is a
+    data-dependent int32 zero — same non-DCE-able construction as
+    ``shmem.comm_jitter`` (|sin| <= 1 keeps the chain finite, so *0.0 is
+    exactly 0, never NaN)."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(_, acc):
+        return acc + jnp.sin(acc)
+
+    acc = jax.lax.fori_loop(
+        0, jnp.asarray(iters, jnp.int32), body,
+        jnp.asarray(anchor, jnp.float32) * 1e-3,
+    )
+    return (acc * 0.0).astype(jnp.int32)
+
+
+def apply_signal_fault(inc, me):
+    """Transform one signal increment at trace time per the armed plan.
+
+    ``me`` is the sender's flattened PE index (traced). Returns the possibly
+    altered increment; identity when no plan targets this site/family, when
+    the scope has no PE hint yet, or for straggler plans (those act at
+    barrier entry, see :func:`straggler_entry_delay`)."""
+    import jax.numpy as jnp
+
+    scope = watchdog.active()
+    if scope is None:
+        return inc
+    plan = active_plan(scope.family)
+    if plan is None or plan.kind == "straggler":
+        return inc
+    site = scope.next_signal_site()
+    if plan.site is not None and plan.site != site:
+        return inc
+    if me is None:
+        return inc
+    inc = jnp.asarray(inc, jnp.int32)
+    hit = (
+        jnp.asarray(me, jnp.int32) == plan.pe if plan.pe >= 0
+        else jnp.bool_(True)
+    )
+    if plan.kind == "drop_signal":
+        alt = jnp.int32(0)
+    elif plan.kind == "dup_signal":
+        alt = inc * 2
+    else:  # delay_signal: spin only on the afflicted PE, then signal as-is
+        spins = jnp.where(hit, jnp.int32(plan.delay_iters), 0)
+        alt = inc + _busy_zero(spins, me)
+    return jnp.where(hit, alt, inc)
+
+
+def straggler_entry_delay(me):
+    """Data-dependent int32 zero that costs ``delay_iters`` busy-loop
+    iterations on the straggler PE (0 elsewhere / without a straggler
+    plan). ``barrier_all`` folds it into its first signal increment, so
+    every comm kernel family inherits the skew at its sync point."""
+    import jax.numpy as jnp
+
+    scope = watchdog.active()
+    plan = active_plan(scope.family if scope is not None else None)
+    if plan is None or plan.kind != "straggler":
+        return None
+    hit = (
+        jnp.asarray(me, jnp.int32) == plan.pe if plan.pe >= 0
+        else jnp.bool_(True)
+    )
+    spins = jnp.where(hit, jnp.int32(plan.delay_iters), 0)
+    return _busy_zero(spins, me)

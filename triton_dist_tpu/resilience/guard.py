@@ -1,0 +1,213 @@
+"""Graceful degradation: guarded fused-op calls with golden XLA fallback.
+
+Every fused distributed op in this framework has a mathematically identical
+golden path built from ``jax.lax`` collectives (the same goldens the test
+suite asserts against). :func:`guarded_call` runs the fused path and, when
+it fails for an ENVIRONMENTAL reason — a Mosaic compile failure, an
+unsupported topology, a jax API the installed version lacks — records the
+downgrade in :mod:`triton_dist_tpu.resilience.health` and returns the
+golden result instead, so a serving step degrades to a correct slow path
+rather than taking the process down (the collective-fallback discipline
+NCCL-era stacks get from their watchdog/abort machinery).
+
+What does NOT fall back:
+
+- user errors (bad shapes/dtypes/arguments): assertion/Value/Type errors
+  raised by our own host-side validation re-raise unchanged;
+- :class:`DistTimeoutError`: a runtime watchdog trip is a peer-loss event,
+  not a compile problem — retrying the same step on the slow path would
+  mask a sick fleet; it propagates (the health registry records it);
+- anything raised by the fallback itself.
+
+Set ``config.update(fallback_to_xla=False)`` to make every failure loud
+(CI posture); the default is to degrade (serving posture).
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+import threading
+from typing import Any, Callable
+
+from triton_dist_tpu.resilience import health
+from triton_dist_tpu.resilience.records import DistTimeoutError
+
+_tls = threading.local()
+
+
+def _guard_depth() -> int:
+    return getattr(_tls, "depth", 0)
+
+
+class UnsupportedTopologyError(NotImplementedError):
+    """The fused kernel cannot serve this mesh/topology (e.g. an axis with
+    no ICI path). Always eligible for the golden-XLA fallback."""
+
+
+# Compile-layer failures carry these markers (Mosaic lowering, scoped-vmem
+# rejection, Pallas lowering, collective-id exhaustion) — matched against
+# the exception text because jax raises them as several concrete types
+# across versions. Deliberately NO catch-all for XlaRuntimeError: a
+# runtime/device fault (INTERNAL, HBM OOM at dispatch) is not an
+# environmental failure the golden path cures — masking a dying chip as a
+# quiet downgrade is exactly what this module's contract forbids.
+_COMPILE_MARKERS = re.compile(
+    r"mosaic|mlir|vmem|scoped|pallas|collective_id"
+    r"|lowering|Unsupported|not supported|not implemented"
+    # the autotuner's terminal failure: every candidate config failed — on a
+    # healthy install that means the problem/topology fits no fused config
+    r"|every candidate config failed",
+    re.IGNORECASE,
+)
+# Missing-API failures from running against a jax outside the tested range
+# (pyproject allows jax>=0.4.35; the fused kernels need the Mosaic
+# interpreter / CompilerParams surface of newer lines).
+_API_MARKERS = re.compile(
+    r"module '?jax|'?jax\.[a-z_.]+'? has no attribute|InterpretParams"
+    r"|shard_map|CompilerParams",
+    re.IGNORECASE,
+)
+
+
+def _timeout_in_chain(exc: BaseException) -> bool:
+    """A DistTimeoutError anywhere in the cause chain (e.g. wrapped by the
+    autotuner's terminal RuntimeError)."""
+    seen: set[int] = set()
+    cause: BaseException | None = exc
+    while cause is not None and id(cause) not in seen:
+        if isinstance(cause, DistTimeoutError):
+            return True
+        seen.add(id(cause))
+        cause = cause.__cause__ or cause.__context__
+    return False
+
+
+def fallbackable(exc: BaseException) -> bool:
+    """Is this exception an environmental failure the golden path cures?"""
+    # a watchdog trip is a peer-loss event: never cured by the slow path,
+    # must stay loud (quarantine handles subsequent calls)
+    if _timeout_in_chain(exc):
+        return False
+    if isinstance(exc, NotImplementedError):  # incl. UnsupportedTopologyError
+        return True
+    mod = type(exc).__module__ or ""
+    if isinstance(exc, (AttributeError, TypeError)) and _API_MARKERS.search(str(exc)):
+        return True
+    if mod.startswith(("jaxlib", "jax.")) or mod == "jax":
+        # compile/lowering-layer failures only; a genuine runtime/device
+        # fault must stay loud (see _COMPILE_MARKERS note)
+        return bool(_COMPILE_MARKERS.search(str(exc)))
+    if isinstance(exc, RuntimeError) and _COMPILE_MARKERS.search(str(exc)):
+        return True
+    return False
+
+
+def _process_global(exc: BaseException) -> bool:
+    """Is this failure inherent to the PROCESS environment (a jax API the
+    install lacks), as opposed to this particular shape/topology/config?
+    Only the former is safe to memoize: an UnsupportedTopologyError for one
+    mesh axis says nothing about the next, but a missing Mosaic interpreter
+    cannot heal mid-process."""
+    if isinstance(exc, UnsupportedTopologyError):
+        return False
+    if isinstance(exc, NotImplementedError):
+        return True
+    return isinstance(exc, (AttributeError, TypeError)) and bool(
+        _API_MARKERS.search(str(exc))
+    )
+
+
+def guarded_call(
+    family: str,
+    primary: Callable[..., Any],
+    fallback: Callable[..., Any] | None,
+    *args: Any,
+    **kwargs: Any,
+) -> Any:
+    """Run ``primary(*args, **kwargs)``; on a :func:`fallbackable` failure
+    record the downgrade and return ``fallback(*args, **kwargs)``.
+
+    ``fallback=None`` means this configuration has no golden path (e.g.
+    int8-quantized caches) — the failure re-raises unchanged.
+
+    Nested under an OUTER guard (the ``guard_op`` entries wrap the
+    autotuner, whose candidates trace the shard-level guarded functions),
+    the inner fallback is suppressed: failures propagate so the sweep
+    prices failing candidates honestly and only the outermost entry
+    degrades — otherwise every candidate would silently degrade to an
+    identical XLA program and the tuner would persist a meaningless
+    "best" config. Direct shard-level calls (a user's own ``shard_map``)
+    have no outer guard and keep their fallback."""
+    return _guarded(family, primary, fallback, args, kwargs, pin_global=False)
+
+
+def _guarded(family, primary, fallback, args, kwargs, *, pin_global):
+    from triton_dist_tpu import config as tdt_config
+
+    if fallback is None or not tdt_config.get_config().fallback_to_xla:
+        return primary(*args, **kwargs)
+    if _guard_depth() > 0:
+        return primary(*args, **kwargs)
+    if health.short_circuited(family) is not None:
+        # pinned to the golden path: a process-global env failure already
+        # proved the fused path cannot build (no point re-paying the failing
+        # trace per call), or a watchdog trip left the family's collective
+        # semaphore state undefined (quarantine; see docs/resilience.md).
+        # Recorded once at pin time — not per call, to keep the event deque
+        # and counters meaningful.
+        return fallback(*args, **kwargs)
+    try:
+        _tls.depth = _guard_depth() + 1
+        try:
+            return primary(*args, **kwargs)
+        finally:
+            _tls.depth -= 1
+    except Exception as exc:  # noqa: BLE001 — filtered by fallbackable()
+        if not fallbackable(exc):
+            if _timeout_in_chain(exc):
+                # the trip itself stays loud (this raise); LATER calls of
+                # this family serve the golden path — its barrier semaphore
+                # may hold residue (partially-drained credits, a late
+                # straggler signal), and reusing it could pass a wait early
+                # and silently serve last-step buffers
+                health.short_circuit(
+                    family, "quarantined after watchdog timeout"
+                )
+            raise
+        if pin_global and _process_global(exc):
+            # memoize ONLY at the op-entry level (the serving/bench surface,
+            # where re-paying a failing trace per step is real cost) and
+            # ONLY for process-global failures; direct shard-level calls
+            # keep re-attempting the fused path — a debug session that
+            # patches the environment mid-process should see it recover
+            health.short_circuit(
+                family, f"environment cannot build fused kernels: {exc}"
+            )
+        health.record_downgrade(
+            family,
+            reason="fused path failed; served golden XLA collective path",
+            exc=exc,
+        )
+        return fallback(*args, **kwargs)
+
+
+def guard_op(family: str, golden: Callable[..., Any] | None):
+    """Decorator form of :func:`guarded_call` for the host-level ``*_op``
+    entries: the decorated fused entry runs under the guard with ``golden``
+    (same signature, extra kwargs ignored) as its XLA fallback. Applied
+    OUTSIDE ``contextual_autotune`` so the sweep still prices failing
+    candidates by falling through them — only a failure of the whole tuned
+    entry (every candidate dead, or an explicit config that cannot serve
+    this environment) degrades to the golden path."""
+
+    def deco(fused: Callable[..., Any]) -> Callable[..., Any]:
+        @functools.wraps(fused)
+        def entry(*args: Any, **kwargs: Any) -> Any:
+            return _guarded(family, fused, golden, args, kwargs, pin_global=True)
+
+        entry.__wrapped_fused__ = fused
+        entry.__golden__ = golden
+        return entry
+
+    return deco

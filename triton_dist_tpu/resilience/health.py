@@ -1,0 +1,153 @@
+"""Process-wide health registry for the resilience layer.
+
+Every graceful degradation (fused kernel → golden XLA collective) and every
+watchdog timeout is recorded here, so serving/bench loops can answer "is
+this process running the fast path?" without scraping logs — the TPU
+analogue of the health surface NCCL watchdog threads give GPU stacks.
+
+The registry is deliberately tiny and dependency-free: a bounded deque of
+events plus per-(family, kind) counters behind one lock. Query it from
+bench/serving code (``snapshot()``, ``degraded_families()``); reset it
+between benchmark phases (``reset()``).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Any
+
+MAX_EVENTS = 256
+
+# event kinds
+DOWNGRADE = "downgrade"   # fused op fell back to the golden XLA path
+TIMEOUT = "timeout"       # a watchdogged wait expired (DistTimeoutError)
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthEvent:
+    kind: str               # DOWNGRADE or TIMEOUT
+    family: str             # kernel family / op entry name
+    reason: str             # human-readable cause
+    detail: Any = None      # decoded diag records / exception repr
+    walltime: float = 0.0   # time.time() at record
+
+
+_lock = threading.Lock()
+_events: collections.deque[HealthEvent] = collections.deque(maxlen=MAX_EVENTS)
+_counters: dict[tuple[str, str], int] = {}
+_total_dropped = 0
+# families guarded_call serves straight from the golden path without
+# retrying the fused one: {family: reason}. Two ways in — a process-global
+# environmental failure (the install cannot build fused kernels; retrying
+# re-pays a failing trace per call), or a watchdog quarantine (after a
+# timeout the family's collective semaphore state is undefined; reusing it
+# could silently corrupt the next launch).
+_short_circuit: dict[str, str] = {}
+
+
+def record_downgrade(family: str, reason: str, exc: BaseException | None = None) -> None:
+    _record(HealthEvent(
+        kind=DOWNGRADE, family=family, reason=reason,
+        detail=None if exc is None else f"{type(exc).__name__}: {exc}",
+        walltime=time.time(),
+    ))
+
+
+def record_timeout(family: str, records: list[dict]) -> None:
+    _record(HealthEvent(
+        kind=TIMEOUT, family=family,
+        reason=f"watchdog expired on {len(records)} PE(s)",
+        detail=records, walltime=time.time(),
+    ))
+    # quarantine regardless of raise posture: the family's persistent
+    # collective semaphore may hold residue (a straggler signal landing
+    # after the in-kernel drain); relaunching the fused kernel on it could
+    # pass a wait early and silently serve stale buffers. jit_shard_map
+    # refuses quarantined launches; guarded entries serve the golden path.
+    short_circuit(family, "quarantined after watchdog timeout")
+
+
+def _record(ev: HealthEvent) -> None:
+    global _total_dropped
+    with _lock:
+        if len(_events) == _events.maxlen:
+            _total_dropped += 1
+        _events.append(ev)
+        key = (ev.family, ev.kind)
+        _counters[key] = _counters.get(key, 0) + 1
+
+
+def events(kind: str | None = None) -> list[HealthEvent]:
+    with _lock:
+        return [e for e in _events if kind is None or e.kind == kind]
+
+
+def counters() -> dict[tuple[str, str], int]:
+    with _lock:
+        return dict(_counters)
+
+
+def degraded_families() -> set[str]:
+    """Families that have taken the golden-XLA fallback at least once."""
+    with _lock:
+        return {f for (f, k), n in _counters.items() if k == DOWNGRADE and n > 0}
+
+
+def timed_out_families() -> set[str]:
+    with _lock:
+        return {f for (f, k), n in _counters.items() if k == TIMEOUT and n > 0}
+
+
+def is_healthy() -> bool:
+    """True iff no downgrade or timeout has been recorded since reset()."""
+    with _lock:
+        return not _counters
+
+
+def snapshot() -> dict:
+    """One JSON-able view for bench/serving logs."""
+    with _lock:
+        return {
+            "healthy": not _counters,
+            "counters": {f"{f}:{k}": n for (f, k), n in sorted(_counters.items())},
+            "short_circuited": dict(_short_circuit),
+            "dropped_events": _total_dropped,
+            "last_events": [
+                {
+                    "kind": e.kind, "family": e.family, "reason": e.reason,
+                    "detail": e.detail, "walltime": e.walltime,
+                }
+                for e in list(_events)[-8:]
+            ],
+        }
+
+
+def short_circuit(family: str, reason: str) -> None:
+    """Pin ``family`` to its golden path for the rest of the process (or
+    until :func:`reset`)."""
+    with _lock:
+        _short_circuit.setdefault(family, reason)
+
+
+def short_circuited(family: str) -> str | None:
+    """The reason ``family`` is pinned to its golden path, or None."""
+    with _lock:
+        return _short_circuit.get(family)
+
+
+def reset(*, keep_short_circuit: bool = False) -> None:
+    """Clear the statistics. ``keep_short_circuit=True`` preserves the
+    golden-path pins — use it when resetting between phases of one process
+    (bench): clearing a Python dict does not clean a quarantined family's
+    device semaphore, so re-enabling its fused kernel would risk exactly
+    the silent corruption the quarantine exists to prevent."""
+    global _total_dropped
+    with _lock:
+        _events.clear()
+        _counters.clear()
+        if not keep_short_circuit:
+            _short_circuit.clear()
+        _total_dropped = 0

@@ -1,0 +1,128 @@
+"""Diagnostic-record layout for watchdogged waits.
+
+Every distributed kernel launched through ``dist_pallas_call`` carries one
+extra SMEM output — the *diagnostic buffer*, ``int32[DIAG_LEN]`` — when the
+watchdog is armed (``config.timeout_iters > 0``). A bounded wait that
+expires writes one structured record into it (first record wins; later
+waits in the same launch fast-fail with a zero budget so a single lost
+signal cannot stall the kernel once per wait site). The host side decodes
+the per-PE buffers gathered through ``shard_map`` and raises
+:class:`DistTimeoutError` carrying the decoded records.
+
+This is the failure-mode answer the reference lacks: its race shaking
+(Triton-distributed ``allgather.py:72-76``) perturbs timing but a lost or
+miscounted signal still turns ``signal_wait_until`` into an infinite spin.
+NCCL-era stacks solve it host-side with watchdog threads; on TPU the host
+cannot observe device semaphores mid-program, so the watchdog lives in the
+kernel and reports through a dedicated output buffer.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# int32 slots of the per-kernel diagnostic buffer
+DIAG_LEN = 8
+
+# slot indices
+F_STATUS = 0      # STATUS_OK / STATUS_TIMEOUT
+F_FAMILY = 1      # kernel family code (family_code_for)
+F_PE = 2          # flattened PE index along the kernel's comm axis (-1 unknown)
+F_SITE = 3        # trace-time ordinal of the wait site inside the kernel
+F_KIND = 4        # KIND_* of the wait that expired
+F_EXPECTED = 5    # semaphore value the wait needed
+F_OBSERVED = 6    # semaphore value last read before giving up
+F_BUDGET = 7      # timeout_iters budget that was exhausted
+
+STATUS_OK = 0
+STATUS_TIMEOUT = 1
+
+# wait kinds
+KIND_SIGNAL = 1   # shmem.signal_wait_until
+KIND_WAIT = 2     # shmem.wait (dl.wait parity)
+KIND_BARRIER = 3  # a dissemination-barrier round in shmem.barrier_all
+
+_KIND_NAMES = {
+    KIND_SIGNAL: "signal_wait_until",
+    KIND_WAIT: "wait",
+    KIND_BARRIER: "barrier_all",
+}
+
+
+# ---------------------------------------------------------------------------
+# Kernel-family registry: a stable small int per dist_pallas_call(name=...)
+# so the in-kernel record can name the family without strings. Separate from
+# ops.common.collective_id_for — that pool is capped at 31 by Mosaic;
+# family codes are unbounded and purely diagnostic.
+# ---------------------------------------------------------------------------
+
+_registry_lock = threading.Lock()
+_family_codes: dict[str, int] = {}
+_family_names: dict[int, str] = {}
+
+
+def family_code_for(name: str) -> int:
+    with _registry_lock:
+        code = _family_codes.get(name)
+        if code is None:
+            code = len(_family_codes) + 1
+            _family_codes[name] = code
+            _family_names[code] = name
+        return code
+
+
+def family_name_for(code: int) -> str:
+    with _registry_lock:
+        return _family_names.get(int(code), f"<unknown family {int(code)}>")
+
+
+def decode_record(row) -> dict:
+    """Decode one int32[DIAG_LEN] diagnostic row into a readable dict."""
+    row = [int(v) for v in row]
+    return {
+        "status": "timeout" if row[F_STATUS] == STATUS_TIMEOUT else "ok",
+        "family": family_name_for(row[F_FAMILY]),
+        "pe": row[F_PE],
+        "site": row[F_SITE],
+        "kind": _KIND_NAMES.get(row[F_KIND], f"<kind {row[F_KIND]}>"),
+        "expected": row[F_EXPECTED],
+        "observed": row[F_OBSERVED],
+        "budget": row[F_BUDGET],
+    }
+
+
+def decode_diag(diag) -> list[dict]:
+    """Decode a host-side ``[n_devices, DIAG_LEN]`` diag array into the list
+    of timeout records (one per device that tripped; empty = clean run)."""
+    import numpy as np
+
+    arr = np.asarray(diag).reshape(-1, DIAG_LEN)
+    return [
+        decode_record(row) for row in arr if int(row[F_STATUS]) != STATUS_OK
+    ]
+
+
+class DistTimeoutError(RuntimeError):
+    """A watchdogged distributed wait expired.
+
+    ``records`` holds one decoded diagnostic dict per PE that tripped:
+    family, PE index, wait site and kind, expected vs. observed semaphore
+    count, and the exhausted budget — enough to name the missing signal
+    edge without a device debugger. The op's output was NaN-poisoned
+    before this was raised; nothing downstream can silently consume it.
+    """
+
+    def __init__(self, family: str, records: list[dict]):
+        self.family = family
+        self.records = records
+        detail = "; ".join(
+            f"pe {r['pe']}: {r['kind']} site {r['site']} expected "
+            f"{r['expected']} observed {r['observed']} (budget {r['budget']})"
+            for r in records
+        )
+        super().__init__(
+            f"distributed kernel family {family!r} timed out on "
+            f"{len(records)} PE(s): {detail}. A peer's signal was lost, "
+            f"miscounted, or catastrophically late; outputs were "
+            f"NaN-poisoned. See docs/resilience.md."
+        )

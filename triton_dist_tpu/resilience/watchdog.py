@@ -1,0 +1,244 @@
+"""Watchdogged waits: bounded spin-waits with in-kernel diagnostics.
+
+Mechanism (all trace-time plumbing, zero cost when disabled):
+
+- ``dist_pallas_call`` (ops/common.py) appends an ``int32[DIAG_LEN]`` SMEM
+  output to every barrier-bearing kernel when ``config.timeout_iters > 0``
+  and enters a :func:`kernel_scope` while tracing the body. The scope makes
+  the diag ref and kernel-family code ambient, so the SHMEM wait primitives
+  (shmem/device.py) pick them up without any kernel changing its signature.
+- Waits become :func:`bounded_wait`: a ``while_loop`` polling
+  ``pltpu.semaphore_read`` against the expected value under an iteration
+  budget. On success the semaphore is consumed exactly as the blocking wait
+  would; on expiry a diagnostic record is written (first record wins) and
+  the kernel CONTINUES — it still issues every later signal and put, so a
+  timed-out PE can never deadlock its peers; its own later bounded waits
+  fast-fail with a zero budget.
+- The traced diag outputs are offered to the ambient :func:`collect` scope
+  opened by ``jit_shard_map``, which returns them through an extra shard_map
+  output and, host-side, decodes + raises :class:`DistTimeoutError` (or
+  NaN-poisons and returns, with ``config.raise_on_timeout=False``).
+
+The budget counts *poll iterations*, not wall time: calibrate it to the
+deployment (a v5e poll iteration is tens of ns compiled; interpret-mode
+iterations cost a host callback each, so chaos tests use small budgets).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any
+
+from triton_dist_tpu.resilience import records as R
+
+
+class KernelDiagScope:
+    """Ambient per-kernel-trace state: the diag ref, the family code, the
+    wait/signal site counters, and the PE hint ``shmem.my_pe`` registers."""
+
+    __slots__ = ("diag_ref", "family", "family_code", "pe", "_wait_sites",
+                 "_signal_sites")
+
+    def __init__(self, diag_ref, family: str):
+        self.diag_ref = diag_ref
+        self.family = family
+        self.family_code = R.family_code_for(family)
+        self.pe = None  # traced my_pe, registered by shmem.my_pe
+        self._wait_sites = 0
+        self._signal_sites = 0
+
+    def next_wait_site(self) -> int:
+        s = self._wait_sites
+        self._wait_sites += 1
+        return s
+
+    def next_signal_site(self) -> int:
+        s = self._signal_sites
+        self._signal_sites += 1
+        return s
+
+
+_tls = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_tls, "kernel_scopes", None)
+    if st is None:
+        st = _tls.kernel_scopes = []
+    return st
+
+
+def active() -> KernelDiagScope | None:
+    st = _stack()
+    return st[-1] if st else None
+
+
+@contextlib.contextmanager
+def kernel_scope(diag_ref, family: str):
+    scope = KernelDiagScope(diag_ref, family)
+    _stack().append(scope)
+    try:
+        yield scope
+    finally:
+        _stack().pop()
+
+
+def enabled() -> bool:
+    from triton_dist_tpu import config as tdt_config
+
+    return int(tdt_config.get_config().timeout_iters) > 0
+
+
+def register_pe(pe) -> None:
+    """Called by ``shmem.my_pe`` so records can name the PE without the wait
+    primitives knowing the mesh axis."""
+    scope = active()
+    if scope is not None and scope.pe is None:
+        scope.pe = pe
+
+
+# ---------------------------------------------------------------------------
+# The bounded wait itself (device-side, called from shmem.device)
+# ---------------------------------------------------------------------------
+
+def bounded_wait(sem, value, *, kind: int):
+    """Consume ``value`` from ``sem`` within the configured poll budget, or
+    record a timeout diagnostic and return. Returns the traced ``ok`` bool
+    (True = consumed). Must be called inside a :func:`kernel_scope`; callers
+    outside one should use the plain blocking wait instead."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from triton_dist_tpu import config as tdt_config
+
+    scope = active()
+    assert scope is not None, "bounded_wait outside a kernel_scope"
+    diag = scope.diag_ref
+    site = scope.next_wait_site()
+    budget = jnp.int32(int(tdt_config.get_config().timeout_iters))
+    # fast-fail chaining: after the first recorded timeout every later wait
+    # in this launch gets a zero budget (one lost signal must cost one
+    # budget, not one per downstream wait site)
+    budget = jnp.where(diag[R.F_STATUS] == R.STATUS_OK, budget, 0)
+    value = jnp.asarray(value, jnp.int32)
+
+    def cond(state):
+        i, seen = state
+        return jnp.logical_and(i < budget, seen < value)
+
+    def body(state):
+        i, _ = state
+        return i + 1, pltpu.semaphore_read(sem)
+
+    _, seen = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), pltpu.semaphore_read(sem))
+    )
+    ok = seen >= value
+
+    @pl.when(ok)
+    def _consume():
+        # satisfied: consume without blocking, preserving the exact
+        # semantics of the unbounded wait
+        pltpu.semaphore_wait(sem, value)
+
+    @pl.when(jnp.logical_not(ok))
+    def _drain():
+        # best-effort residue control: consume the credits that DID arrive
+        # so they cannot pre-satisfy the next launch's wait on this
+        # (persistent, per-collective_id) semaphore. A straggler signal
+        # landing after this drain still leaves residue — which is why the
+        # host quarantines the family after a trip (guard.py).
+        pltpu.semaphore_wait(sem, seen)
+
+    @pl.when(jnp.logical_not(ok) & (diag[R.F_STATUS] == R.STATUS_OK))
+    def _record():
+        pe = scope.pe if scope.pe is not None else jnp.int32(-1)
+        diag[R.F_STATUS] = jnp.int32(R.STATUS_TIMEOUT)
+        diag[R.F_FAMILY] = jnp.int32(scope.family_code)
+        diag[R.F_PE] = jnp.asarray(pe, jnp.int32)
+        diag[R.F_SITE] = jnp.int32(site)
+        diag[R.F_KIND] = jnp.int32(kind)
+        diag[R.F_EXPECTED] = value
+        diag[R.F_OBSERVED] = jnp.asarray(seen, jnp.int32)
+        diag[R.F_BUDGET] = budget
+
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# Trace-time diag collection (dist_pallas_call → jit_shard_map)
+# ---------------------------------------------------------------------------
+
+def _collections() -> list:
+    st = getattr(_tls, "collections", None)
+    if st is None:
+        st = _tls.collections = []
+    return st
+
+
+@contextlib.contextmanager
+def collect():
+    """Collect the diag outputs of every ``dist_pallas_call`` traced inside
+    this scope (jit_shard_map opens one around the traced fn)."""
+    diags: list[Any] = []
+    _collections().append(diags)
+    try:
+        yield diags
+    finally:
+        _collections().pop()
+
+
+def offer(diag) -> bool:
+    """Offer one kernel launch's traced ``int32[DIAG_LEN]`` diag array to
+    the innermost active collection. Returns False outside one (a
+    dist_pallas_call traced inside a USER-level shard_map rather than
+    jit_shard_map) — the caller must then poison its outputs in-trace,
+    because no host boundary exists to decode the record and raise."""
+    st = _collections()
+    if st:
+        st[-1].append(diag)
+        return True
+    return False
+
+
+def poison(out, bad):
+    """Poison every array leaf of ``out`` where the traced bool ``bad`` is
+    true: NaN for inexact dtypes, ``iinfo.min`` for integer dtypes (counts
+    and indices go loudly negative instead of plausibly wrong — the
+    DistTimeoutError contract is that nothing downstream can silently
+    consume a timed-out launch's outputs, int32 split tables included)."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(o):
+        o = jnp.asarray(o)
+        if jnp.issubdtype(o.dtype, jnp.inexact):
+            return jnp.where(bad, jnp.asarray(jnp.nan, o.dtype), o)
+        if jnp.issubdtype(o.dtype, jnp.integer):
+            return jnp.where(
+                bad, jnp.asarray(jnp.iinfo(o.dtype).min, o.dtype), o
+            )
+        return o
+
+    return jax.tree_util.tree_map(one, out)
+
+
+def merge(diags: list) -> Any:
+    """Merge the collected per-launch diags into one ``[1, DIAG_LEN]`` row
+    for this PE: the first launch that timed out wins (element-wise select
+    on the status slot); all-clean merges to zeros."""
+    import jax.numpy as jnp
+
+    out = jnp.zeros((1, R.DIAG_LEN), jnp.int32)
+    hit = jnp.bool_(False)
+    for d in diags:
+        d = d.reshape(1, R.DIAG_LEN)
+        take = jnp.logical_and(
+            jnp.logical_not(hit), d[0, R.F_STATUS] != R.STATUS_OK
+        )
+        out = jnp.where(take, d, out)
+        hit = jnp.logical_or(hit, d[0, R.F_STATUS] != R.STATUS_OK)
+    return out

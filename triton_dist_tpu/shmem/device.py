@@ -69,10 +69,16 @@ from jax.experimental.pallas import tpu as pltpu
 def my_pe(axis: str | Sequence[str]):
     """This device's index along `axis` (flattened if several axes)."""
     if isinstance(axis, str):
-        return jax.lax.axis_index(axis)
-    idx = jnp.int32(0)
-    for name in axis:
-        idx = idx * n_pes(name) + jax.lax.axis_index(name)
+        idx = jax.lax.axis_index(axis)
+    else:
+        idx = jnp.int32(0)
+        for name in axis:
+            idx = idx * n_pes(name) + jax.lax.axis_index(name)
+    # PE hint for the watchdog's diagnostic records (trace-time side
+    # channel; no-op outside a dist_pallas_call diag scope)
+    from triton_dist_tpu.resilience import watchdog as _watchdog
+
+    _watchdog.register_pe(idx)
     return idx
 
 
@@ -247,11 +253,28 @@ remote_ptr = getmem_nbi_block  # ≙ symm_at / nvshmem_ptr: intentionally absent
 # Signals (≙ signal_op / signal_wait_until / dl.wait / dl.notify)
 # ---------------------------------------------------------------------------
 
+def _maybe_inject(inc):
+    """Route a signal increment through the chaos injector (identity unless
+    a ``config.fault_plan`` is armed and this trace is in a diag scope)."""
+    from triton_dist_tpu.resilience import faults as _faults
+    from triton_dist_tpu.resilience import watchdog as _watchdog
+
+    scope = _watchdog.active()
+    if scope is None:
+        return inc
+    return _faults.apply_signal_fault(inc, scope.pe)
+
+
 def signal_op(sem, inc=1, pe=None, axis: str | None = None):
     """Increment a (possibly remote) semaphore (≙ ``signal_op`` with
     NVSHMEM_SIGNAL_ADD, and ≙ ``dl.notify(sig="add")``,
     language.py:98-112). SET semantics do not exist on TPU semaphores —
-    use monotonically increasing expected values instead."""
+    use monotonically increasing expected values instead.
+
+    This is a chaos injection site: an armed ``config.fault_plan`` may
+    drop, duplicate, or delay the increment on its target PE (see
+    resilience/faults.py; interpret-mode only)."""
+    inc = _maybe_inject(inc)
     if pe is None:
         pltpu.semaphore_signal(sem, inc)
     else:
@@ -263,18 +286,38 @@ def signal_op(sem, inc=1, pe=None, axis: str | None = None):
         )
 
 
+def _wait_or_watchdog(sem, value, kind):
+    """Blocking wait, or the bounded watchdogged variant when armed
+    (``config.timeout_iters > 0`` inside a dist_pallas_call diag scope):
+    poll up to the budget, consume on success, or write the diagnostic
+    record and RETURN — the kernel keeps issuing its later signals/puts so
+    a timed-out PE can never deadlock its peers (its own later waits
+    fast-fail on a zero budget; the host raises DistTimeoutError)."""
+    from triton_dist_tpu.resilience import watchdog as _watchdog
+
+    if _watchdog.enabled() and _watchdog.active() is not None:
+        _watchdog.bounded_wait(sem, value, kind=kind)
+    else:
+        pltpu.semaphore_wait(sem, value)
+
+
 def signal_wait_until(sem, value):
     """Block until `sem` >= value, then consume (sem -= value)
-    (≙ ``signal_wait_until(CMP_EQ)`` given monotonic counters)."""
-    pltpu.semaphore_wait(sem, value)
+    (≙ ``signal_wait_until(CMP_EQ)`` given monotonic counters). Bounded by
+    the watchdog when ``config.timeout_iters > 0`` (docs/resilience.md)."""
+    from triton_dist_tpu.resilience import records as _records
+
+    _wait_or_watchdog(sem, value, _records.KIND_SIGNAL)
 
 
 def wait(sem, value=1):
     """≙ ``dl.wait(barrier_ptr, n, scope, semantic)`` (language.py:57-70):
     spin until the flag semaphore reaches `value`. The acquire semantics and
     the follow-up ``dl.consume_token`` are implicit — Pallas orders ref
-    reads after the wait."""
-    pltpu.semaphore_wait(sem, value)
+    reads after the wait. Bounded by the watchdog when armed."""
+    from triton_dist_tpu.resilience import records as _records
+
+    _wait_or_watchdog(sem, value, _records.KIND_WAIT)
 
 
 def consume_token(token=None):  # noqa: ARG001
@@ -342,6 +385,9 @@ def barrier_all(axis: str | Sequence[str] = "tp"):
     on the barrier (data rides recv semaphores). Multi-chip hardware
     stress remains the outstanding validation.
     """
+    from triton_dist_tpu.resilience import faults as _faults
+    from triton_dist_tpu.resilience import records as _records
+
     axes = [axis] if isinstance(axis, str) else list(axis)
     sizes = [n_pes(a) for a in axes]
     n = int(math.prod(sizes))
@@ -349,6 +395,11 @@ def barrier_all(axis: str | Sequence[str] = "tp"):
         return
     sem = pltpu.get_barrier_semaphore()
     me = my_pe(axes if len(axes) > 1 else axes[0])
+    # chaos: a straggler fault_plan skews this PE's entry into the barrier
+    # (and hence its whole downstream issue schedule). The busy loop's
+    # data-dependent zero rides the first round's signal increment so
+    # neither XLA nor Mosaic can dead-code the delay (comm_jitter's trick).
+    straggle_zero = _faults.straggler_entry_delay(me)
     rounds = max(1, math.ceil(math.log2(n)))
     for r in range(rounds):
         partner = jax.lax.rem(me + (1 << r), n)
@@ -358,8 +409,11 @@ def barrier_all(axis: str | Sequence[str] = "tp"):
         for a, s in zip(reversed(axes), reversed(sizes)):
             dev_id[a] = jax.lax.rem(rem_idx, s)
             rem_idx = jax.lax.div(rem_idx, s)
-        pltpu.semaphore_signal(sem, 1, device_id=dev_id, device_id_type=pltpu.DeviceIdType.MESH)
-        pltpu.semaphore_wait(sem, 1)
+        inc = 1 if (r > 0 or straggle_zero is None) else 1 + straggle_zero
+        # each round's signal is a chaos injection site (drop/dup/delay)
+        inc = _maybe_inject(inc)
+        pltpu.semaphore_signal(sem, inc, device_id=dev_id, device_id_type=pltpu.DeviceIdType.MESH)
+        _wait_or_watchdog(sem, 1, _records.KIND_BARRIER)
 
 
 sync_all = barrier_all  # ≙ sync_all (no quiet needed: see quiet() contract)
@@ -372,10 +426,18 @@ def barrier_neighbors(axis: str = "tp"):
     n = n_pes(axis)
     if n == 1:
         return
+    from triton_dist_tpu.resilience import records as _records
+
     sem = pltpu.get_barrier_semaphore()
     me = my_pe(axis)
     left = jax.lax.rem(me - 1 + n, n)
     right = jax.lax.rem(me + 1, n)
-    pltpu.semaphore_signal(sem, 1, device_id={axis: left}, device_id_type=pltpu.DeviceIdType.MESH)
-    pltpu.semaphore_signal(sem, 1, device_id={axis: right}, device_id_type=pltpu.DeviceIdType.MESH)
-    pltpu.semaphore_wait(sem, 2)
+    pltpu.semaphore_signal(
+        sem, _maybe_inject(1), device_id={axis: left},
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    pltpu.semaphore_signal(
+        sem, _maybe_inject(1), device_id={axis: right},
+        device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    _wait_or_watchdog(sem, 2, _records.KIND_BARRIER)
