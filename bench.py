@@ -665,13 +665,24 @@ def _run_one(name: str) -> None:
     finally:
         # resilience surface (docs/resilience.md): a metric that quietly
         # served golden XLA fallbacks is CORRECT but not evidence about
-        # the fused kernels — say so next to the numbers
-        if not health.is_healthy() or health.snapshot()["short_circuited"]:
+        # the fused kernels — say so next to the numbers. The same goes
+        # for the elastic layer: absorbed retries, quarantined PEs, or a
+        # shrunk world mean the numbers were earned at reduced
+        # parallelism (snapshot carries the retry/quarantine/readmission
+        # counters and per-peer states)
+        snap = health.snapshot()
+        degraded = (
+            not snap["healthy"]
+            or snap["short_circuited"]
+            or snap["elastic"]["degraded"]
+            or any(k.endswith((":retry", ":recovery"))
+                   for k in snap["counters"])
+        )
+        if degraded:
             import sys
 
             print(
-                f"[bench {name}] resilience health: "
-                + json.dumps(health.snapshot()),
+                f"[bench {name}] resilience health: " + json.dumps(snap),
                 file=sys.stderr, flush=True,
             )
 

@@ -1,14 +1,55 @@
 #!/usr/bin/env bash
 # Run the FULL resilience fault-injection matrix standalone
-# (tests/test_chaos.py, docs/resilience.md): every kernel family ×
-# drop/dup/delay signal + straggler PE, plus the forced-compile-failure
-# degradation cases, including the cells marked `slow` that tier-1 skips.
+# (tests/test_chaos.py + tests/test_elastic.py, docs/resilience.md):
+# every kernel family × drop/dup/delay signal + straggler PE, the
+# forced-compile-failure degradation cases, and the elastic arcs
+# (retry/quarantine/shrink/readmit), including the cells marked `slow`
+# that tier-1 skips.
 #
 # The live injection cells need the Mosaic TPU interpreter (jax >= 0.6);
-# on older jax lines they skip and the degradation tier still runs.
+# on older jax lines they skip and the degradation + host-arc tiers
+# still run.
+#
+# Per-cell failures propagate into the exit code (CI gates on it), and a
+# pass/fail summary table is printed after the run.
 #
 # Usage: scripts/chaos_matrix.sh [extra pytest args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py \
-    -m chaos -v -rs -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+
+log="$(mktemp /tmp/chaos_matrix.XXXXXX.log)"
+trap 'rm -f "$log"' EXIT
+
+# -v so every cell prints its own PASSED/FAILED/SKIPPED line for the
+# summary; the pytest exit code is captured, not exec'd away, so the
+# table still prints when cells fail.
+set +e
+env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_elastic.py \
+    -m chaos -v -rs -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
+    2>&1 | tee "$log"
+rc=${PIPESTATUS[0]}
+set -e
+
+echo
+echo "== chaos matrix summary =="
+# one row per cell: "tests/test_chaos.py::test_chaos_matrix[drop-ag] PASSED"
+awk '
+    / (PASSED|FAILED|ERROR|SKIPPED|XFAIL|XPASS)/ && /::/ {
+        split($1, path, "::"); cell = path[2];
+        for (i = 2; i <= NF; i++)
+            if ($i ~ /^(PASSED|FAILED|ERROR|SKIPPED|XFAIL|XPASS)$/) verdict = $i;
+        printf "  %-72s %s\n", cell, verdict;
+        n[verdict]++;
+    }
+    END {
+        printf "  %d passed, %d failed, %d errors, %d skipped\n",
+            n["PASSED"], n["FAILED"], n["ERROR"], n["SKIPPED"];
+    }
+' "$log"
+
+failed=$(grep -cE ' (FAILED|ERROR)$| (FAILED|ERROR) ' "$log" || true)
+if [ "$rc" -ne 0 ] || [ "$failed" -gt 0 ]; then
+    echo "chaos matrix: FAIL (pytest rc=$rc, failing cells=$failed)"
+    exit 1
+fi
+echo "chaos matrix: PASS"

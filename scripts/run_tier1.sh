@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# The ONE tier-1 gate: builders and CI run this same script, so "tests
+# pass" means the same thing everywhere (ROADMAP.md "Tier-1 verify" is
+# this command; keep the two in sync).
+#
+# Two phases:
+#   1. the full tier-1 suite (everything not marked `slow`, 870 s budget,
+#      CPU backend, 8 virtual devices via tests/conftest.py);
+#   2. a fast `chaos`-marker smoke subset (resilience + elastic layers) —
+#      a focused re-run of the cells most likely to regress silently,
+#      cheap enough to eyeball on every PR.
+#
+# Prints PASSED/FAILED counts per phase (record them in CHANGES.md) and
+# exits non-zero if either phase fails.
+#
+# Gate semantics: on a healthy install the tier-1 phase must exit 0. On
+# environments with DOCUMENTED pre-existing failures (e.g. a jax line
+# without the Mosaic interpreter — see CHANGES.md baselines), the
+# acceptance bar is "no worse than seed": set TDT_TIER1_MIN_PASS=<N> /
+# TDT_TIER1_MAX_FAIL=<M> to gate on counts instead of the raw exit code
+# (the chaos smoke must always exit 0 either way).
+#
+# Usage: scripts/run_tier1.sh [extra pytest args for the tier-1 phase]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+count() { # count <word> <log>: occurrences of "N <word>" in the summary
+    grep -aoE "[0-9]+ $1" "$2" | tail -1 | grep -oE '[0-9]+' || echo 0
+}
+
+echo "== tier-1 (ROADMAP verify) =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@" 2>&1 | tee /tmp/_t1.log
+t1_rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+
+echo
+echo "== chaos smoke (resilience + elastic) =="
+rm -f /tmp/_t1_chaos.log
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'chaos and not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1_chaos.log
+chaos_rc=${PIPESTATUS[0]}
+
+echo
+echo "== tier-1 summary =="
+printf '  tier-1:      rc=%s  %s passed / %s failed / %s skipped\n' \
+    "$t1_rc" "$(count passed /tmp/_t1.log)" "$(count failed /tmp/_t1.log)" \
+    "$(count skipped /tmp/_t1.log)"
+printf '  chaos smoke: rc=%s  %s passed / %s failed / %s skipped\n' \
+    "$chaos_rc" "$(count passed /tmp/_t1_chaos.log)" \
+    "$(count failed /tmp/_t1_chaos.log)" "$(count skipped /tmp/_t1_chaos.log)"
+
+t1_ok=0
+if [ "$t1_rc" -ne 0 ]; then
+    t1_ok=1
+    # count-based gate for environments with documented seed failures
+    if [ -n "${TDT_TIER1_MIN_PASS:-}" ]; then
+        passed=$(count passed /tmp/_t1.log)
+        failed=$(count failed /tmp/_t1.log)
+        if [ "$passed" -ge "$TDT_TIER1_MIN_PASS" ] \
+            && [ "$failed" -le "${TDT_TIER1_MAX_FAIL:-$failed}" ]; then
+            echo "  tier-1 rc=$t1_rc but counts meet the baseline floor" \
+                "(>= $TDT_TIER1_MIN_PASS passed," \
+                "<= ${TDT_TIER1_MAX_FAIL:-any} failed)"
+            t1_ok=0
+        fi
+    fi
+fi
+if [ "$t1_ok" -ne 0 ] || [ "$chaos_rc" -ne 0 ]; then
+    echo "tier-1 gate: FAIL"
+    exit 1
+fi
+echo "tier-1 gate: PASS"

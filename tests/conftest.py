@@ -75,6 +75,21 @@ def _interpret_mode():
     yield
 
 
+@pytest.fixture(autouse=True)
+def _resilience_isolation():
+    """The resilience health registry is process-global: a watchdog
+    quarantine or downgrade recorded by one test would pin later tests'
+    op entries to the golden path, silently changing what they cover.
+    Reset around every test — keeping only the environment pins (whether
+    this jax install can build fused kernels doesn't change per test, and
+    re-paying the failing trace hundreds of times would)."""
+    from triton_dist_tpu import resilience
+
+    resilience.reset(keep_env=True)
+    yield
+    resilience.reset(keep_env=True)
+
+
 @pytest.fixture(scope="session")
 def mesh8() -> Mesh:
     return Mesh(np.array(jax.devices()), ("tp",))

@@ -1,0 +1,214 @@
+"""Retry layer (resilience/retry.py): deterministic backoff, jitter
+bounds, transient/deterministic classification, budget exhaustion — all
+driven by a fake clock, so nothing here sleeps or needs Pallas."""
+
+import pytest
+
+from triton_dist_tpu import config as tdt_config
+from triton_dist_tpu import resilience
+from triton_dist_tpu.resilience import health, retry
+from triton_dist_tpu.resilience.records import DistTimeoutError
+
+
+@pytest.fixture(autouse=True)
+def _restore_config():
+    cfg = tdt_config.get_config()
+    snap = (cfg.retry_policy, cfg.elastic, cfg.suspect_threshold,
+            cfg.probation_probes)
+    yield
+    tdt_config.update(
+        retry_policy=snap[0], elastic=snap[1], suspect_threshold=snap[2],
+        probation_probes=snap[3],
+    )
+    retry.set_clock(None)
+
+
+def _timeout(family="fam", pes=(0,), world_size=None):
+    recs = [
+        {"status": "timeout", "family": family, "pe": pe, "site": 0,
+         "kind": "barrier_all", "expected": 1, "observed": 0, "budget": 10}
+        for pe in pes
+    ]
+    return DistTimeoutError(family, recs, world_size=world_size)
+
+
+# ---------------------------------------------------------------------------
+# Policy + schedule
+# ---------------------------------------------------------------------------
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="max_attempts"):
+        retry.RetryPolicy(max_attempts=0).validate()
+    with pytest.raises(ValueError, match="multiplier"):
+        retry.RetryPolicy(multiplier=0.5).validate()
+    with pytest.raises(ValueError, match="jitter"):
+        retry.RetryPolicy(jitter=1.5).validate()
+    with pytest.raises(ValueError, match="delays"):
+        retry.RetryPolicy(base_delay_s=-1.0).validate()
+    with pytest.raises(ValueError, match="total_delay_budget_s"):
+        retry.RetryPolicy(total_delay_budget_s=-1.0).validate()
+    retry.RetryPolicy().validate()
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="RetryPolicy"):
+        tdt_config.update(retry_policy="retry please")
+    with pytest.raises(ValueError, match="max_attempts"):
+        tdt_config.update(retry_policy=retry.RetryPolicy(max_attempts=0))
+    with pytest.raises(ValueError, match="suspect_threshold"):
+        tdt_config.update(suspect_threshold=0)
+    with pytest.raises(ValueError, match="probation_probes"):
+        tdt_config.update(probation_probes=0)
+    tdt_config.update(retry_policy=retry.RetryPolicy())
+    tdt_config.update(retry_policy=None)
+
+
+def test_backoff_sequence_deterministic_and_bounded():
+    p = retry.RetryPolicy(
+        max_attempts=6, base_delay_s=0.1, multiplier=2.0, max_delay_s=0.5,
+        jitter=0.25, seed=3,
+    )
+    d1, d2 = p.delays("all_gather"), p.delays("all_gather")
+    assert d1 == d2, "same (policy, family) must give the same schedule"
+    assert len(d1) == 5
+    # jitter bounds around the capped geometric nominal
+    for n, d in enumerate(d1):
+        nominal = min(0.1 * 2.0**n, 0.5)
+        assert nominal * 0.75 <= d <= nominal * 1.25, (n, d, nominal)
+    # decorrelated across families and seeds
+    assert d1 != p.delays("gemm_rs")
+    assert d1 != retry.RetryPolicy(
+        max_attempts=6, base_delay_s=0.1, multiplier=2.0, max_delay_s=0.5,
+        jitter=0.25, seed=4,
+    ).delays("all_gather")
+
+
+def test_zero_jitter_is_exact_geometric():
+    p = retry.RetryPolicy(
+        max_attempts=5, base_delay_s=0.01, multiplier=3.0, max_delay_s=0.1,
+        jitter=0.0,
+    )
+    assert p.delays("x") == (0.01, 0.03, 0.09, 0.1)
+
+
+def test_classify():
+    assert retry.classify(_timeout()) == retry.TRANSIENT
+    wrapped = RuntimeError("autotune(x): every candidate config failed")
+    wrapped.__cause__ = _timeout()
+    assert retry.classify(wrapped) == retry.TRANSIENT
+    assert retry.classify(ValueError("bad shape")) == retry.DETERMINISTIC
+    assert retry.classify(
+        RuntimeError("Mosaic lowering failed")
+    ) == retry.DETERMINISTIC
+    assert retry.classify(
+        NotImplementedError("no interpreter")
+    ) == retry.DETERMINISTIC
+
+
+# ---------------------------------------------------------------------------
+# call_with_retry under a fake clock
+# ---------------------------------------------------------------------------
+
+def test_transient_failure_recovers_with_backoff():
+    clock = retry.FakeClock()
+    policy = retry.RetryPolicy(max_attempts=4, base_delay_s=0.1, jitter=0.25,
+                               seed=11)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise _timeout("flaky_fam")
+        return 42
+
+    out = retry.call_with_retry("flaky_fam", flaky, policy=policy, clock=clock)
+    assert out == 42 and calls["n"] == 3
+    # slept exactly the first two scheduled backoffs, in order
+    assert tuple(clock.sleeps) == policy.delays("flaky_fam")[:2]
+    snap = health.snapshot()
+    assert snap["counters"]["flaky_fam:retry"] == 2
+    assert snap["counters"]["flaky_fam:recovery"] == 1
+    # absorbed transients do not make the process unhealthy
+    assert health.is_healthy()
+
+
+def test_budget_exhaustion_reraises_after_max_attempts():
+    clock = retry.FakeClock()
+    policy = retry.RetryPolicy(max_attempts=3, base_delay_s=0.1, jitter=0.0)
+    calls = {"n": 0}
+
+    def dead():
+        calls["n"] += 1
+        raise _timeout("dead_fam")
+
+    with pytest.raises(DistTimeoutError):
+        retry.call_with_retry("dead_fam", dead, policy=policy, clock=clock)
+    assert calls["n"] == 3
+    assert len(clock.sleeps) == 2
+    assert health.snapshot()["counters"]["dead_fam:retry"] == 2
+    assert "dead_fam:recovery" not in health.snapshot()["counters"]
+
+
+def test_total_delay_budget_escalates_early():
+    clock = retry.FakeClock()
+    policy = retry.RetryPolicy(
+        max_attempts=10, base_delay_s=1.0, multiplier=1.0, jitter=0.0,
+        total_delay_budget_s=2.5,
+    )
+
+    def dead():
+        raise _timeout("budget_fam")
+
+    with pytest.raises(DistTimeoutError):
+        retry.call_with_retry("budget_fam", dead, policy=policy, clock=clock)
+    # 1s + 1s fit the 2.5s budget; the third retry would exceed it
+    assert clock.sleeps == [1.0, 1.0]
+
+
+def test_deterministic_failures_never_retried():
+    clock = retry.FakeClock()
+    policy = retry.RetryPolicy(max_attempts=5)
+    for exc in (ValueError("m must divide n"),
+                RuntimeError("Mosaic lowering failed: unsupported op")):
+        calls = {"n": 0}
+
+        def bad(exc=exc):
+            calls["n"] += 1
+            raise exc
+
+        with pytest.raises(type(exc)):
+            retry.call_with_retry("det_fam", bad, policy=policy, clock=clock)
+        assert calls["n"] == 1, "deterministic failures go straight back"
+    assert clock.sleeps == []
+
+
+def test_no_policy_is_single_attempt_passthrough():
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        return 7
+
+    assert tdt_config.get_config().retry_policy is None
+    assert retry.call_with_retry("plain", fn) == 7
+    assert calls["n"] == 1
+    assert health.snapshot()["counters"] == {}
+
+
+def test_transient_failures_feed_elastic_attribution():
+    """Each failed attempt strikes the attributed peer, so retry exhaustion
+    lands on an already-quarantined PE (the escalation contract)."""
+    from triton_dist_tpu.resilience import elastic
+
+    tdt_config.update(elastic=True, suspect_threshold=2)
+    clock = retry.FakeClock()
+    policy = retry.RetryPolicy(max_attempts=3, jitter=0.0)
+
+    def dead():
+        # PEs 0, 2, 3 of a 4-wide world trip; PE 1 is silent — the culprit
+        raise _timeout("esc_fam", pes=(0, 2, 3), world_size=4)
+
+    with pytest.raises(DistTimeoutError):
+        retry.call_with_retry("esc_fam", dead, policy=policy, clock=clock)
+    assert elastic.state(1) == elastic.QUARANTINED
+    assert health.snapshot()["counters"]["pe1:pe_quarantine"] == 1

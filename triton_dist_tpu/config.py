@@ -84,6 +84,27 @@ class Config:
     # API), recording the downgrade in resilience.health. False = every
     # failure is loud (CI posture). Env: TDT_FALLBACK_TO_XLA.
     fallback_to_xla: bool = bool(int(os.environ.get("TDT_FALLBACK_TO_XLA", "1")))
+    # --- elastic degraded mode (docs/resilience.md) --------------------
+    # Armed resilience.RetryPolicy: watchdog-armed op entries retry
+    # TRANSIENT failures (DistTimeoutError — comm jitter, one lost
+    # signal) with deterministic exponential backoff before escalating;
+    # deterministic failures (compile/shape/API) are never retried and
+    # keep going straight to the golden-path guard. None (default)
+    # disables retry entirely — op entries take the pre-existing
+    # single-attempt path with zero added per-step work.
+    retry_policy: object = None
+    # PE quarantine + topology shrink (resilience/elastic.py): attribute
+    # watchdog timeouts to a straggler peer, quarantine it after
+    # suspect_threshold strikes, rebuild collectives over the survivors
+    # (elastic.effective_mesh), probe with a cheap barrier and re-admit
+    # after probation_probes clean probes. False (default) = every
+    # elastic entry point is a no-op and effective_mesh is identity.
+    elastic: bool = False
+    # Timeouts attributed to one peer before it is quarantined (the
+    # first strike only marks it suspect; clean steps decay strikes).
+    suspect_threshold: int = 2
+    # Clean world-barrier probes required to re-admit a quarantined PE.
+    probation_probes: int = 1
 
 
 _config = Config()
@@ -97,15 +118,29 @@ def update(**kwargs: Any) -> None:
     for k, v in kwargs.items():
         if not hasattr(_config, k):
             raise ValueError(f"unknown config key: {k}")
-        if k == "fault_plan" and v is not None:
-            from triton_dist_tpu.resilience.faults import FaultPlan
+        if k == "fault_plan":
+            from triton_dist_tpu.resilience import faults as _faults
 
-            if not isinstance(v, FaultPlan):
+            if v is not None:
+                if not isinstance(v, _faults.FaultPlan):
+                    raise ValueError(
+                        f"fault_plan must be a resilience.FaultPlan (or None), "
+                        f"got {type(v).__name__}"
+                    )
+                v.validate()
+            # a (re)armed plan starts with a full trigger budget
+            _faults.reset_triggers()
+        if k == "retry_policy" and v is not None:
+            from triton_dist_tpu.resilience.retry import RetryPolicy
+
+            if not isinstance(v, RetryPolicy):
                 raise ValueError(
-                    f"fault_plan must be a resilience.FaultPlan (or None), "
-                    f"got {type(v).__name__}"
+                    f"retry_policy must be a resilience.RetryPolicy (or "
+                    f"None), got {type(v).__name__}"
                 )
             v.validate()
+        if k in ("suspect_threshold", "probation_probes") and int(v) < 1:
+            raise ValueError(f"{k} must be >= 1, got {v}")
         setattr(_config, k, v)
 
 

@@ -5,10 +5,13 @@
 The reference layers are torch ``nn.Module``s that own symmetric-buffer
 contexts; under JAX the buffers are SPMD-symmetric by construction, so the
 layers here are light callable configs — everything stateful lives in the
-kernels' own workspaces. All ``__call__``s run inside ``jax.shard_map``.
+kernels' own workspaces. All ``__call__``s run inside ``jax.shard_map``,
+except :class:`ElasticStep`, the host-level wrapper that picks WHICH world
+a step runs over (retry + quarantine shrink + probation re-admission).
 """
 
 from triton_dist_tpu.layers.allgather_layer import AllGatherLayer
+from triton_dist_tpu.layers.elastic_step import ElasticStep
 from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer, HierEPAll2AllLayer
 from triton_dist_tpu.layers.ep_moe_mlp import EPMoEMLP
 from triton_dist_tpu.layers.sp_flash_decode_layer import SpGQAFlashDecodeAttention

@@ -1,0 +1,77 @@
+"""ElasticStep — the mesh-level wrapper that makes a serving/training step
+survive a sick world (resilience/elastic.py, docs/resilience.md).
+
+The shard-level layers (TPMLP, EPAll2AllLayer, …) run *inside*
+``jax.shard_map`` and cannot change the world mid-trace; the elastic
+decisions — which mesh to run over, whether to retry a step, when to probe
+quarantined PEs back in — are host-level. This wrapper owns them:
+
+- each call resolves the CURRENT surviving world
+  (``elastic.effective_mesh``) and builds/caches the step for it, so the
+  call after a quarantine runs at reduced parallelism without the caller
+  re-plumbing anything;
+- transient failures are retried under ``config.retry_policy``
+  (``retry.call_with_retry`` — exhaustion feeds peer attribution and
+  raises, and the NEXT call sees the shrunk world);
+- :meth:`probe` runs the probation barrier and re-admits recovered PEs,
+  after which calls run the full world again.
+
+The caller stays in charge of data placement: ``world_size`` says how many
+PEs the next call will run over, and the step builder receives the mesh so
+it can re-derive its shardings (the op entries' existing divisibility
+contracts apply at the reduced size).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+from jax.sharding import Mesh
+
+from triton_dist_tpu.resilience import elastic, retry
+
+
+@dataclasses.dataclass
+class ElasticStep:
+    """Wrap ``build(mesh) -> step`` so the step always runs over the
+    surviving world.
+
+    build:  given the current (possibly shrunk) mesh, return the step
+            callable; called once per distinct world and cached, so the
+            healthy path costs one dict lookup.
+    mesh:   the full world this step was provisioned for.
+    axis:   the comm axis quarantined PEs are dropped from.
+    family: name for retry/health bookkeeping.
+    """
+
+    build: Callable[[Mesh], Callable[..., Any]]
+    mesh: Mesh
+    axis: str = "tp"
+    family: str = "elastic_step"
+
+    def __post_init__(self) -> None:
+        self._steps: dict[Any, Callable[..., Any]] = {}
+
+    def current_mesh(self) -> Mesh:
+        """The mesh the next call will run over (full world while healthy,
+        survivors after a quarantine, full again after re-admission)."""
+        return elastic.effective_mesh(self.mesh, axis=self.axis)
+
+    @property
+    def world_size(self) -> int:
+        ax = tuple(self.mesh.axis_names).index(self.axis)
+        return int(self.current_mesh().devices.shape[ax])
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        mesh = self.current_mesh()
+        step = self._steps.get(mesh)
+        if step is None:
+            step = self._steps[mesh] = self.build(mesh)
+        return retry.call_with_retry(self.family, step, *args, **kwargs)
+
+    def probe(self) -> dict[int, str]:
+        """One probation round over the FULL provisioned mesh: quarantined
+        PEs that answer the barrier cleanly are re-admitted (per
+        ``config.probation_probes``). Returns {pe: new_state}."""
+        return elastic.probe_quarantined(self.mesh, axis=self.axis)

@@ -110,6 +110,7 @@ def dist_pallas_call(
             "kernels cannot be built — ops degrade to the golden XLA "
             "collective path via triton_dist_tpu.resilience.guarded_call"
         )
+    from triton_dist_tpu.resilience import faults as _faults
     from triton_dist_tpu.resilience import records as _records
     from triton_dist_tpu.resilience import watchdog as _watchdog
 
@@ -123,7 +124,10 @@ def dist_pallas_call(
 
     cfg = tdt_config.get_config()
     arm_diag = int(cfg.timeout_iters) > 0
-    arm_scope = arm_diag or cfg.fault_plan is not None
+    # a spent (healed) fault plan no longer needs the injector scope
+    arm_scope = arm_diag or (
+        cfg.fault_plan is not None and not _faults.plan_spent()
+    )
     if arm_diag and params.get("dimension_semantics") is not None:
         # megacore chips split 'parallel' grid dims across two TensorCores;
         # the armed diag protocol (zero-init on grid step (0,…,0),
@@ -351,67 +355,157 @@ def jit_shard_map(
     recording the event in ``resilience.health``).
     """
     from triton_dist_tpu import config as _tdt_config
+    from triton_dist_tpu.resilience import faults as _faults
     from triton_dist_tpu.resilience import records as _records
     from triton_dist_tpu.resilience import watchdog as _watchdog
 
     cfg = _tdt_config.get_config()
     armed = int(cfg.timeout_iters) > 0
-    cache_key = (
-        mesh, str(in_specs), str(out_specs), donate_argnums, key,
-        # trace-time config that changes the kernel program (a cached
-        # un-delayed program must not serve a race-shaking, watchdogged,
-        # or fault-injected run, and vice versa)
-        cfg.debug_comm_delay, cfg.timeout_iters, cfg.fault_plan,
-    )
-    hit = _jit_cache.get(cache_key)
-    if hit is None:
-        if armed:
-            def fn_diag(*args):
-                with _watchdog.collect() as diags:
-                    out = fn(*args)
-                diag = _watchdog.merge(diags)
-                bad = diag[0, _records.F_STATUS] != _records.STATUS_OK
-                return _watchdog.poison(out, bad), diag
 
-            diag_out_spec = PartitionSpec(tuple(mesh.axis_names), None)
-            hit = jax.jit(
-                _shard_map(fn_diag, mesh, in_specs, (out_specs, diag_out_spec)),
-                donate_argnums=donate_argnums,
-            )
-        else:
-            hit = jax.jit(
-                _shard_map(fn, mesh, in_specs, out_specs),
-                donate_argnums=donate_argnums,
-            )
-        _jit_cache[cache_key] = hit
-    if not armed:
+    def _resolve():
+        cfg = _tdt_config.get_config()
+        cache_key = (
+            mesh, str(in_specs), str(out_specs), donate_argnums, key,
+            # trace-time config that changes the kernel program (a cached
+            # un-delayed program must not serve a race-shaking, watchdogged,
+            # or fault-injected run, and vice versa). The fault-plan token
+            # flips when a bounded plan's trigger budget is spent, so a
+            # healed retry traces — and caches — the clean program.
+            cfg.debug_comm_delay, cfg.timeout_iters, _faults.plan_token(),
+        )
+        hit = _jit_cache.get(cache_key)
+        if hit is None:
+            if armed:
+                def fn_diag(*args):
+                    with _watchdog.collect() as diags:
+                        out = fn(*args)
+                    diag = _watchdog.merge(diags)
+                    bad = diag[0, _records.F_STATUS] != _records.STATUS_OK
+                    return _watchdog.poison(out, bad), diag
+
+                diag_out_spec = PartitionSpec(tuple(mesh.axis_names), None)
+                hit = jax.jit(
+                    _shard_map(fn_diag, mesh, in_specs, (out_specs, diag_out_spec)),
+                    donate_argnums=donate_argnums,
+                )
+            else:
+                hit = jax.jit(
+                    _shard_map(fn, mesh, in_specs, out_specs),
+                    donate_argnums=donate_argnums,
+                )
+            _jit_cache[cache_key] = hit
         return hit
 
-    jitted = hit
+    jitted = _resolve()
+    if not armed:
+        return jitted
+
     family = key[0] if isinstance(key, tuple) and key and isinstance(key[0], str) else str(key)
+    n_world = int(mesh.devices.size)
+    # peer attribution is keyed by flattened device index; on a multi-axis
+    # mesh the diag rows span the product world while records carry the PE
+    # along one comm axis, so attribution only runs on 1-D worlds
+    single_axis = mesh.devices.ndim == 1
+
+    def _refuse(reason):
+        # the family's collective semaphore state is undefined after an
+        # earlier trip (even under raise_on_timeout=False, which raised
+        # nothing): refuse the launch with a fallbackable error so an
+        # enclosing guard serves the golden path — loud otherwise
+        raise NotImplementedError(
+            f"distributed kernel family {family!r} refused to launch: "
+            f"{reason}; its collective semaphore may hold residue. "
+            f"Guarded op entries serve the golden XLA path; see "
+            f"docs/resilience.md."
+        )
 
     def call(*args):
         from triton_dist_tpu.resilience import health
 
         reason = health.short_circuited(family)
         if reason is not None:
-            # the family's collective semaphore state is undefined after an
-            # earlier trip (even under raise_on_timeout=False, which raised
-            # nothing): refuse the launch with a fallbackable error so an
-            # enclosing guard serves the golden path — loud otherwise
-            raise NotImplementedError(
-                f"distributed kernel family {family!r} refused to launch: "
-                f"{reason}; its collective semaphore may hold residue. "
-                f"Guarded op entries serve the golden XLA path; see "
-                f"docs/resilience.md."
+            _refuse(reason)
+        cfg = _tdt_config.get_config()
+        policy = cfg.retry_policy
+        if policy is None and not cfg.elastic:
+            # pre-existing single-attempt path (retry/elastic disabled).
+            # Resolved per call, not at wrap time: callers store these
+            # wrappers (models/decode serving steps), and a stored wrapper
+            # must pick up a healed fault plan's clean program
+            out, diag = _resolve()(*args)
+            if cfg.fault_plan is not None:
+                _faults.note_launch()
+            recs = _records.decode_diag(diag)  # forces the device sync
+            if recs:
+                health.record_timeout(family, recs)
+                if _tdt_config.get_config().raise_on_timeout:
+                    raise _records.DistTimeoutError(
+                        family, recs, world_size=n_world
+                    )
+            return out
+
+        # elastic degraded-mode path: transient timeouts are retried with
+        # backoff, every failed attempt feeds peer attribution, and
+        # exhaustion records the timeout (quarantining the family) and
+        # escalates — by which point a persistent straggler has collected
+        # enough strikes to be PE-quarantined (docs/resilience.md)
+        from triton_dist_tpu.resilience import elastic as _elastic
+        from triton_dist_tpu.resilience import retry as _retry
+
+        attempts = policy.max_attempts if policy is not None else 1
+        delays = policy.delays(key=family) if policy is not None else ()
+        slept = 0.0
+        for attempt in range(attempts):
+            out, diag = _resolve()(*args)
+            if cfg.fault_plan is not None:
+                _faults.note_launch()
+            recs = _records.decode_diag(diag)
+            if not recs:
+                if attempt:
+                    health.record_recovery(family, attempt)
+                if cfg.elastic:
+                    _elastic.note_clean_step(n_world)
+                return out
+            if cfg.elastic and single_axis:
+                _elastic.note_timeout_records(recs, n_world, family=family)
+            last = attempt == attempts - 1
+            if donate_argnums:
+                # donated inputs are deleted by the first invocation; a
+                # relaunch with the same tuple would read freed buffers.
+                # Timeouts on donating entries escalate immediately —
+                # host-level retries (ElasticStep) own re-materialization.
+                last = True
+            if not _tdt_config.interpreting():
+                # compiled TPU: the family's collective semaphore may hold
+                # residue after the trip (a straggler signal landing after
+                # the in-kernel drain) — relaunching the fused kernel on it
+                # could pass a wait early and serve stale buffers, so the
+                # first trip escalates here. The pin below sends later
+                # calls to the golden path, where host-level retries
+                # (retry.call_with_retry / ElasticStep) remain safe.
+                # Interpret mode rebuilds simulated semaphores per launch,
+                # so in-place retry is sound there.
+                last = True
+            delay = 0.0 if last else delays[attempt]
+            over_budget = (
+                policy is not None
+                and policy.total_delay_budget_s is not None
+                and slept + delay > policy.total_delay_budget_s
             )
-        out, diag = jitted(*args)
-        recs = _records.decode_diag(diag)  # forces the device sync
-        if recs:
+            if not last and not over_budget:
+                health.record_retry(family, attempt + 1, delay, records=recs)
+                _retry.get_clock().sleep(delay)
+                slept += delay
+                continue
             health.record_timeout(family, recs)
-            if _tdt_config.get_config().raise_on_timeout:
-                raise _records.DistTimeoutError(family, recs)
-        return out
+            # the elastic world is about to shrink (or already did): in
+            # interpret mode the family pin record_timeout just made is
+            # hardware-residue protection with nothing to protect — release
+            # it so the rebuilt world runs the fused path, not the golden
+            _elastic.maybe_release_family_pins()
+            if cfg.raise_on_timeout:
+                raise _records.DistTimeoutError(family, recs, world_size=n_world)
+            return out
 
     return call
 

@@ -78,6 +78,36 @@ def make_mesh(shape: Mapping[str, int] | None = None, devices: Sequence[jax.Devi
     return mesh
 
 
+def shrink_mesh(mesh: Mesh, quarantined, axis: str = "tp") -> Mesh:
+    """Rebuild ``mesh`` without the quarantined positions along ``axis`` —
+    the elastic layer's topology shrink (resilience/elastic.py). Survivors
+    keep their relative order (``topology.surviving_ring``), the axis names
+    are unchanged, and the new mesh re-runs slice-boundary detection so a
+    shrink that removes the only cross-slice column also sheds the DCN
+    verdict. Returns ``mesh`` itself when nothing is quarantined.
+
+    Shardings are re-derived, not preserved: callers re-place their global
+    arrays over the returned mesh (sizes along ``axis`` must divide by the
+    surviving count — the op entries' existing divisibility contracts)."""
+    from triton_dist_tpu.parallel.topology import (
+        register_mesh_dcn,
+        surviving_ring,
+    )
+
+    if axis not in mesh.axis_names:
+        raise ValueError(
+            f"axis {axis!r} not in mesh axes {tuple(mesh.axis_names)}"
+        )
+    ax = tuple(mesh.axis_names).index(axis)
+    keep = surviving_ring(mesh.devices.shape[ax], quarantined)
+    if len(keep) == mesh.devices.shape[ax]:
+        return mesh
+    arr = np.take(mesh.devices, keep, axis=ax)
+    shrunk = Mesh(arr, tuple(mesh.axis_names))
+    register_mesh_dcn(shrunk)
+    return shrunk
+
+
 def initialize_distributed(
     mesh_shape: Mapping[str, int] | None = None,
     seed: int = 42,

@@ -220,6 +220,41 @@ def detect_dcn_axes(mesh) -> tuple[str, ...]:
     )
 
 
+# ---------------------------------------------------------------------------
+# Elastic shrink (resilience/elastic.py): a quarantined PE is excised from
+# the world and the comm topology is re-derived over the survivors.
+# ---------------------------------------------------------------------------
+
+def surviving_ring(axis_size: int, quarantined) -> tuple[int, ...]:
+    """Ring order of the surviving flattened positions after dropping
+    ``quarantined`` from an axis of ``axis_size`` PEs. Survivors keep their
+    relative order, so the shrunk ring is the old ring with the sick hops
+    spliced out — each survivor's new neighbor is its nearest surviving
+    ex-neighbor. Raises if nothing survives (an all-quarantined world is an
+    operator problem, not a topology)."""
+    dropped = {int(q) for q in quarantined}
+    bad = [q for q in dropped if not 0 <= q < axis_size]
+    if bad:
+        raise ValueError(
+            f"quarantined positions {sorted(bad)} outside axis of size "
+            f"{axis_size}"
+        )
+    ring = tuple(i for i in range(axis_size) if i not in dropped)
+    if not ring:
+        raise ValueError(
+            f"all {axis_size} PEs quarantined — no surviving topology"
+        )
+    return ring
+
+
+def remap_world(axis_size: int, quarantined) -> dict[int, int]:
+    """Old→new flattened index for the survivors of a shrink — the rank
+    remapping collectives and shardings are re-derived under (quarantined
+    positions are absent from the map)."""
+    return {old: new for new, old in
+            enumerate(surviving_ring(axis_size, quarantined))}
+
+
 def is_dcn_axis_name(name) -> bool:
     """Whether collectives on this axis name must ride DCN: declared via
     ``config.dcn_axes`` (user) or auto-detected for the latest mesh using

@@ -1,22 +1,34 @@
-"""Resilience subsystem: watchdogged waits, signal fault injection, and
-graceful fallback to XLA collectives.
+"""Resilience subsystem: watchdogged waits, fault injection, graceful
+fallback to XLA collectives, and elastic degraded-mode execution.
 
-Three parts (see docs/resilience.md for the full contract):
+Five parts (see docs/resilience.md for the full contract):
 
 - :mod:`watchdog` / :mod:`records` — bounded distributed waits that write a
   structured diagnostic record and NaN-poison outputs instead of spinning
   forever; surfaced host-side as :class:`DistTimeoutError`.
   Arm with ``config.update(timeout_iters=N)``.
 - :mod:`faults` — deterministic interpret-mode signal chaos
-  (drop/duplicate/delay a signal, straggle a PE).
+  (drop/duplicate/delay a signal, straggle a PE; ``max_triggers`` bounds a
+  plan to model transient vs persistent faults).
   Arm with ``config.update(fault_plan=FaultPlan(...))``.
 - :mod:`guard` / :mod:`health` — ``guarded_call`` degrades a failing fused
   op to its golden ``jax.lax`` collective and records the downgrade in the
   process-wide health registry. On by default
   (``config.update(fallback_to_xla=False)`` for the loud CI posture).
+- :mod:`retry` — transient failures (watchdog trips) retried with
+  deterministic exponential backoff before escalating; deterministic
+  failures keep going straight to the guard.
+  Arm with ``config.update(retry_policy=RetryPolicy(...))``.
+- :mod:`elastic` — PE state machine (healthy → suspect → quarantined →
+  probation → healthy): persistent stragglers are quarantined, the
+  topology is rebuilt over the survivors (``elastic.effective_mesh``),
+  and recovered PEs are probed back in.
+  Arm with ``config.update(elastic=True)``.
 """
 
+from triton_dist_tpu.resilience import elastic as elastic
 from triton_dist_tpu.resilience import health as health
+from triton_dist_tpu.resilience import retry as retry
 from triton_dist_tpu.resilience import watchdog as watchdog
 from triton_dist_tpu.resilience.faults import KINDS as FAULT_KINDS, FaultPlan
 from triton_dist_tpu.resilience.guard import (
@@ -33,20 +45,48 @@ from triton_dist_tpu.resilience.records import (
     family_code_for,
     family_name_for,
 )
+from triton_dist_tpu.resilience.retry import (
+    FakeClock,
+    RetryPolicy,
+    call_with_retry,
+    classify,
+)
+
+
+def reset(*, keep_env: bool = False) -> None:
+    """Clear all process-global resilience state — health statistics and
+    pins, elastic peer states, and fault-plan trigger counts — between
+    tests or benchmark phases. ``keep_env=True`` preserves the
+    environment pins (a jax install that cannot build fused kernels is
+    still the same install afterwards), which is the per-test isolation
+    posture ``tests/conftest.py`` uses."""
+    from triton_dist_tpu.resilience import faults as _faults
+
+    health.reset(keep_env=keep_env)
+    elastic.reset()
+    _faults.reset_triggers()
+
 
 __all__ = [
     "DIAG_LEN",
     "DistTimeoutError",
     "FAULT_KINDS",
+    "FakeClock",
     "FaultPlan",
+    "RetryPolicy",
     "UnsupportedTopologyError",
+    "call_with_retry",
+    "classify",
     "decode_diag",
     "decode_record",
+    "elastic",
     "fallbackable",
     "family_code_for",
     "family_name_for",
     "guard_op",
     "guarded_call",
     "health",
+    "reset",
+    "retry",
     "watchdog",
 ]

@@ -1,0 +1,409 @@
+"""Elastic degraded-mode execution: PE quarantine and topology shrink.
+
+The retry layer (retry.py) absorbs *transient* timeouts; this module
+absorbs *persistent* ones. Watchdog diagnostic records are attributed to a
+peer, strikes accumulate through a per-PE state machine, and a PE that
+keeps costing timeouts is quarantined: the collective topology is rebuilt
+over the survivors (``effective_mesh`` → ``parallel.mesh.shrink_mesh`` /
+``parallel.topology.surviving_ring``) so every op family keeps producing
+mathematically correct results at reduced parallelism. Quarantined PEs are
+probed with a cheap world barrier and re-admitted after a clean probation.
+
+PE state machine (one ``PeerHealth`` per flattened device index of the
+governing world mesh)::
+
+    healthy --timeout--> suspect --timeouts >= suspect_threshold--> quarantined
+      ^  ^                  |                                          |
+      |  +---strikes decay--+                              probe (probation)
+      |                                                        |         |
+      +---- clean probes >= probation_probes ---- probation <--+    failed probe
+                                                      |                  |
+                                                      +---> quarantined <+
+
+Attribution: on TPU the kernel that times out is the *victim*, not the
+culprit — the straggler is busy spinning (or its signal was dropped) while
+everyone else's bounded wait expires. So the per-PE diagnostic records
+name the culprit by absence: when every surviving PE but one reports a
+timeout, the silent one is the straggler. Ambiguous patterns (all PEs
+tripped, several silent) attribute nothing — quarantining the wrong PE is
+strictly worse than staying degraded-but-correct.
+
+Everything here is keyed by flattened device position along the governing
+world's comm axis (1-D worlds; multi-axis meshes skip attribution). All
+state is process-global behind one lock, observable via
+``health.snapshot()["elastic"]``, and reset by :func:`reset`. Disabled
+(``config.elastic=False``, the default) every entry point is a cheap
+no-op and ``effective_mesh`` returns its argument unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable
+
+from triton_dist_tpu.resilience import health
+from triton_dist_tpu.resilience import retry as _retry
+
+# PE states
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+QUARANTINED = "quarantined"
+PROBATION = "probation"
+
+STATES = (HEALTHY, SUSPECT, QUARANTINED, PROBATION)
+
+
+@dataclasses.dataclass
+class PeerHealth:
+    pe: int
+    state: str = HEALTHY
+    strikes: int = 0
+    clean_probes: int = 0
+
+
+_lock = threading.Lock()
+_peers: dict[int, PeerHealth] = {}
+
+
+def enabled() -> bool:
+    from triton_dist_tpu import config as tdt_config
+
+    return bool(tdt_config.get_config().elastic)
+
+
+def _get(pe: int) -> PeerHealth:
+    p = _peers.get(pe)
+    if p is None:
+        p = _peers[pe] = PeerHealth(pe=int(pe))
+    return p
+
+
+def state(pe: int) -> str:
+    with _lock:
+        p = _peers.get(pe)
+        return p.state if p is not None else HEALTHY
+
+
+def peer_states() -> dict[int, str]:
+    with _lock:
+        return {pe: p.state for pe, p in sorted(_peers.items())}
+
+
+def quarantined_pes() -> tuple[int, ...]:
+    with _lock:
+        return tuple(
+            pe for pe, p in sorted(_peers.items()) if p.state == QUARANTINED
+        )
+
+
+def summary() -> dict:
+    """Light JSON-able view for ``health.snapshot()`` / bench logs."""
+    with _lock:
+        non_healthy = {
+            str(pe): {"state": p.state, "strikes": p.strikes}
+            for pe, p in sorted(_peers.items())
+            if p.state != HEALTHY
+        }
+    return {"enabled": enabled(), "degraded": bool(non_healthy),
+            "peers": non_healthy}
+
+
+def reset() -> None:
+    """Forget all peer state (between tests / benchmark phases)."""
+    with _lock:
+        _peers.clear()
+    _shrunk_cache.clear()
+
+
+# ---------------------------------------------------------------------------
+# Attribution + strikes
+# ---------------------------------------------------------------------------
+
+def attribute_straggler(records: list[dict], world_size: int) -> int | None:
+    """The culprit PE named by absence: with ``world_size`` PEs in the
+    collective and decoded timeout ``records`` from the victims, exactly
+    one silent PE is the straggler. Returns None when the pattern is
+    ambiguous (no victims, several silent PEs, or every PE tripped —
+    which points at the fabric, not a peer)."""
+    if not records or world_size < 2:
+        return None
+    tripped = {int(r["pe"]) for r in records if 0 <= int(r["pe"]) < world_size}
+    if not tripped:
+        return None
+    silent = set(range(world_size)) - tripped
+    if len(silent) == 1:
+        return silent.pop()
+    return None
+
+
+def report_timeout(pe: int, family: str | None = None) -> str:
+    """One timeout attributed to ``pe``: healthy→suspect, suspect strikes
+    accumulate to quarantine at ``config.suspect_threshold``, and a strike
+    during probation re-quarantines immediately. Returns the new state."""
+    from triton_dist_tpu import config as tdt_config
+
+    threshold = max(1, int(tdt_config.get_config().suspect_threshold))
+    with _lock:
+        p = _get(pe)
+        if p.state == QUARANTINED:
+            return p.state
+        p.strikes += 1
+        p.clean_probes = 0
+        if p.state == PROBATION or p.strikes >= threshold:
+            _quarantine_locked(p, family)
+        else:
+            p.state = SUSPECT
+        return p.state
+
+
+def report_success(pe: int) -> str:
+    """One clean step involving ``pe``: strikes decay by one; a suspect
+    with no strikes left returns to healthy. Quarantine/probation are only
+    exited through probes."""
+    with _lock:
+        p = _peers.get(pe)
+        if p is None:
+            return HEALTHY
+        if p.state in (QUARANTINED, PROBATION):
+            return p.state
+        p.strikes = max(0, p.strikes - 1)
+        if p.strikes == 0:
+            p.state = HEALTHY
+        return p.state
+
+
+def note_clean_step(world_size: int | None = None) -> None:
+    """A watchdog-armed step completed cleanly: decay every suspect's
+    strikes (called by the op entries; no-op unless elastic is enabled)."""
+    if not enabled():
+        return
+    with _lock:
+        suspects = [pe for pe, p in _peers.items() if p.state == SUSPECT]
+    for pe in suspects:
+        report_success(pe)
+
+
+def note_timeout_records(
+    records: list[dict], world_size: int, family: str | None = None
+) -> int | None:
+    """Attribute one timed-out step's records to a peer and strike it.
+    Returns the struck PE (or None: disabled / unattributable)."""
+    if not enabled():
+        return None
+    pe = attribute_straggler(records, world_size)
+    if pe is None:
+        return None
+    report_timeout(pe, family=family)
+    return pe
+
+
+def note_timeout_exc(exc: BaseException, family: str | None = None) -> int | None:
+    """Exception-path attribution: pull the DistTimeoutError out of the
+    cause chain and strike the attributed peer (needs the error to carry
+    ``world_size``, which op entries set)."""
+    if not enabled():
+        return None
+    err = _retry.timeout_in_chain(exc)
+    if err is None or getattr(err, "world_size", None) is None:
+        return None
+    return note_timeout_records(
+        err.records, int(err.world_size), family=family or err.family
+    )
+
+
+def _quarantine_locked(p: PeerHealth, family: str | None) -> None:
+    p.state = QUARANTINED
+    p.clean_probes = 0
+    health.record_pe_quarantine(
+        p.pe,
+        reason=f"{p.strikes} timeout(s) attributed"
+        + (f" (last family {family!r})" if family else ""),
+    )
+    _maybe_release_family_pins()
+
+
+def quarantine(pe: int, reason: str = "operator request") -> None:
+    """Force a PE into quarantine (operator/test entry)."""
+    with _lock:
+        p = _get(pe)
+        if p.state == QUARANTINED:
+            return
+        p.state = QUARANTINED
+        p.clean_probes = 0
+        health.record_pe_quarantine(pe, reason=reason)
+    _maybe_release_family_pins()
+
+
+def maybe_release_family_pins() -> None:
+    """In interpret mode, excising the culprit PE (or re-admitting a healed
+    one) clears the watchdog family quarantines: simulated semaphores are
+    rebuilt per launch, so the hardware residue the pin protects against
+    cannot exist, and the shrunk/recovered world should run the fused path.
+    Compiled TPU runs keep their pins — a quarantined family's device
+    semaphore stays dirty regardless of which peer caused the trip. With
+    the elastic layer disabled this is a no-op: the pre-existing pin
+    semantics (docs/resilience.md) apply unchanged."""
+    from triton_dist_tpu import config as tdt_config
+
+    if enabled() and tdt_config.interpreting():
+        health.clear_timeout_quarantines()
+
+
+_maybe_release_family_pins = maybe_release_family_pins
+
+
+# ---------------------------------------------------------------------------
+# Topology shrink + recovery
+# ---------------------------------------------------------------------------
+
+# shrunk meshes cached per (mesh, axis, quarantined set): the degraded
+# serving path runs effective_mesh every step, and rebuilding the Mesh
+# (plus re-running slice-boundary detection) per step would put host work
+# on exactly the path this layer keeps cheap. Cleared by reset().
+_shrunk_cache: dict = {}
+
+
+def effective_mesh(mesh, axis: str = "tp"):
+    """The mesh this step should run over: ``mesh`` itself while every PE
+    is serviceable, or the survivor mesh (quarantined positions dropped
+    along ``axis``, shardings re-derivable from the returned mesh) once the
+    elastic layer has quarantined peers. Identity (same object, zero work
+    beyond one config read) when elastic is disabled.
+
+    Elastic worlds are 1-D: quarantined PEs are tracked by flattened
+    device index, which only names a position along ``axis`` when the
+    mesh has a single axis — a multi-axis mesh with quarantined peers is
+    refused rather than excising the wrong device column."""
+    if not enabled():
+        return mesh
+    dropped = quarantined_pes()
+    if not dropped:
+        return mesh
+    if mesh.devices.ndim != 1:
+        raise ValueError(
+            f"elastic.effective_mesh: quarantined PEs {dropped} are "
+            f"flattened world indices, but mesh {dict(mesh.shape)} has "
+            f"{mesh.devices.ndim} axes — elastic shrink supports 1-D "
+            f"worlds only (shrink multi-axis meshes explicitly via "
+            f"parallel.mesh.shrink_mesh with axis positions)"
+        )
+    cache_key = (mesh, axis, dropped)
+    hit = _shrunk_cache.get(cache_key)
+    if hit is None:
+        from triton_dist_tpu.parallel.mesh import shrink_mesh
+
+        hit = _shrunk_cache[cache_key] = shrink_mesh(mesh, dropped, axis=axis)
+    return hit
+
+
+def _probe_fused(mesh, axis: str):
+    """Watchdogged device barrier over the whole world — the cheap probe.
+    Times out (DistTimeoutError) if any PE, including the quarantined one,
+    fails to join within the budget."""
+    from jax.sharding import PartitionSpec as P
+
+    from triton_dist_tpu.ops import common as ops_common
+
+    fn = lambda: ops_common.barrier_all_op(axis=axis)  # noqa: E731
+    return ops_common.jit_shard_map(
+        fn, mesh, (), P(axis), key=("elastic_probe_fused", axis)
+    )()
+
+
+def _probe_golden(mesh, axis: str):
+    """XLA-collective probe for environments where the fused barrier cannot
+    build (no Mosaic interpreter / compile failure): a psum over the axis
+    still requires every PE to participate; XLA owns the transport."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from triton_dist_tpu.ops import common as ops_common
+
+    def fn():
+        return jnp.full((1,), jax.lax.psum(jnp.int32(1), axis), jnp.int32)
+
+    return ops_common.jit_shard_map(
+        fn, mesh, (), P(axis), key=("elastic_probe_xla", axis)
+    )()
+
+
+def probe_world(mesh, axis: str = "tp") -> bool:
+    """One probation probe: a cheap barrier over the FULL world mesh
+    (quarantined PEs included). True = every PE joined within the watchdog
+    budget; False = the probe itself timed out. Deterministic failures of
+    the fused probe (it cannot build in this environment) fall through to
+    the golden XLA probe rather than failing the probation."""
+    from triton_dist_tpu import config as tdt_config
+    from triton_dist_tpu.resilience import guard as _guard
+    from triton_dist_tpu.resilience.records import DistTimeoutError
+
+    # a previous failed probe must not pin probing itself to a refused
+    # launch — probes are the recovery path, they always get a fresh try
+    health.clear_short_circuit("elastic_probe_fused")
+    # the probe's failure signal IS the DistTimeoutError: under the
+    # poison-and-continue posture (raise_on_timeout=False) a timed-out
+    # probe would return normally and count as clean, re-admitting a
+    # still-sick PE — force the loud posture for the probe's duration
+    prev_raise = tdt_config.get_config().raise_on_timeout
+    tdt_config.update(raise_on_timeout=True)
+    try:
+        _probe_fused(mesh, axis)
+        return True
+    except DistTimeoutError:
+        return False
+    except Exception as exc:  # noqa: BLE001 — guard taxonomy decides
+        if not _guard.fallbackable(exc):
+            raise
+        _probe_golden(mesh, axis)
+        return True
+    finally:
+        tdt_config.update(raise_on_timeout=prev_raise)
+
+
+def probe_quarantined(
+    mesh,
+    axis: str = "tp",
+    probe: Callable[[], bool] | None = None,
+) -> dict[int, str]:
+    """Move every quarantined PE to probation and run one world probe over
+    the full mesh. A clean probe counts toward ``config.probation_probes``;
+    reaching it re-admits the PE (healthy, strikes cleared, re-admission
+    recorded in the health registry). A failed probe sends every candidate
+    straight back to quarantine. Returns {pe: new_state} for the
+    candidates probed (empty when none are quarantined)."""
+    from triton_dist_tpu import config as tdt_config
+
+    with _lock:
+        targets = [
+            pe for pe, p in sorted(_peers.items())
+            if p.state in (QUARANTINED, PROBATION)
+        ]
+        for pe in targets:
+            _peers[pe].state = PROBATION
+    if not targets:
+        return {}
+    ok = probe() if probe is not None else probe_world(mesh, axis=axis)
+    needed = max(1, int(tdt_config.get_config().probation_probes))
+    out: dict[int, str] = {}
+    readmitted = []
+    with _lock:
+        for pe in targets:
+            p = _get(pe)
+            if not ok:
+                p.state = QUARANTINED
+                p.clean_probes = 0
+            else:
+                p.clean_probes += 1
+                if p.clean_probes >= needed:
+                    p.state = HEALTHY
+                    p.strikes = 0
+                    p.clean_probes = 0
+                    readmitted.append(pe)
+            out[pe] = p.state
+    for pe in readmitted:
+        health.record_pe_readmission(pe)
+    if readmitted:
+        _maybe_release_family_pins()
+    return out

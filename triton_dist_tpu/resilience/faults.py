@@ -31,6 +31,7 @@ ICI handles below the programming model.
 from __future__ import annotations
 
 import dataclasses
+import threading
 
 from triton_dist_tpu.resilience import watchdog
 
@@ -51,6 +52,15 @@ class FaultPlan:
     family: restrict to one ``dist_pallas_call(name=...)`` family
             (``None`` = all families).
     delay_iters: busy-loop iterations for delay_signal / straggler.
+    max_triggers: how many WATCHDOG-ARMED OP-ENTRY LAUNCHES the fault
+            afflicts before it "heals" (``None`` = persistent for the
+            life of the plan). This is the transient/persistent axis the
+            elastic layer exercises: ``max_triggers=1`` models one burst
+            of comm jitter (the retry layer's backoff outlives it), while
+            ``None`` models a persistently sick PE that only quarantine
+            can excise. Counted host-side per armed ``jit_shard_map``
+            launch (``note_launch``) — a healed plan changes the trace
+            cache token, so the next launch runs the clean program.
     """
 
     kind: str
@@ -58,6 +68,7 @@ class FaultPlan:
     site: int | None = None
     family: str | None = None
     delay_iters: int = 20_000
+    max_triggers: int | None = None
 
     def validate(self) -> "FaultPlan":
         if self.kind not in KINDS:
@@ -72,7 +83,93 @@ class FaultPlan:
             raise ValueError(
                 f"FaultPlan.delay_iters must be >= 0, got {self.delay_iters}"
             )
+        if self.max_triggers is not None and self.max_triggers < 1:
+            raise ValueError(
+                f"FaultPlan.max_triggers must be >= 1 (or None), got "
+                f"{self.max_triggers}"
+            )
+        if self.max_triggers is not None and self.family is not None:
+            # note_launch() counts every watchdog-armed op-entry launch,
+            # process-wide; it cannot see which kernel families an entry
+            # traces, so a family-scoped budget would be spent by launches
+            # the fault never touched — the plan would heal without ever
+            # firing. Refuse the combination rather than silently testing
+            # nothing.
+            raise ValueError(
+                "FaultPlan.max_triggers cannot be combined with a family "
+                "filter (trigger accounting is per armed op-entry launch, "
+                "process-wide); use family=None for bounded plans"
+            )
         return self
+
+    @classmethod
+    def persistent_straggler(
+        cls, pe: int, delay_iters: int = 20_000, family: str | None = None
+    ) -> "FaultPlan":
+        """The elastic layer's flagship scenario: PE ``pe`` straggles at
+        every barrier entry forever (never heals), so retries exhaust and
+        the only way back to a clean world is quarantining the PE."""
+        return cls(
+            "straggler", pe=pe, family=family, delay_iters=delay_iters,
+            max_triggers=None,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Trigger accounting (host-side): how many armed launches the current plan
+# has afflicted. A plan whose budget is spent stops injecting — the next
+# launch traces (and caches) the clean program.
+# ---------------------------------------------------------------------------
+
+_trigger_lock = threading.Lock()
+_trigger_count = 0
+
+
+def reset_triggers() -> None:
+    """Forget the trigger count (config.update(fault_plan=...) calls this:
+    a new plan starts with a full budget)."""
+    global _trigger_count
+    with _trigger_lock:
+        _trigger_count = 0
+
+
+def plan_spent(plan: "FaultPlan | None" = None) -> bool:
+    """Whether the plan's trigger budget is exhausted (always False for
+    persistent plans and when no plan is armed)."""
+    if plan is None:
+        from triton_dist_tpu import config as tdt_config
+
+        plan = tdt_config.get_config().fault_plan
+    if plan is None or plan.max_triggers is None:
+        return False
+    with _trigger_lock:
+        return _trigger_count >= plan.max_triggers
+
+
+def note_launch() -> None:
+    """Record one watchdog-armed op-entry launch against the armed plan's
+    trigger budget (no-op without a live plan)."""
+    global _trigger_count
+    from triton_dist_tpu import config as tdt_config
+
+    plan = tdt_config.get_config().fault_plan
+    if plan is None or plan.max_triggers is None:
+        return
+    with _trigger_lock:
+        if _trigger_count < plan.max_triggers:
+            _trigger_count += 1
+
+
+def plan_token():
+    """Trace-cache token for the armed plan: (plan, spent). A spent plan
+    must not serve the cached FAULTY program — the token flips, so
+    ``jit_shard_map`` retraces cleanly (and vice versa)."""
+    from triton_dist_tpu import config as tdt_config
+
+    plan = tdt_config.get_config().fault_plan
+    if plan is None:
+        return None
+    return (plan, plan_spent(plan))
 
 
 def active_plan(family: str | None = None) -> FaultPlan | None:
@@ -81,7 +178,7 @@ def active_plan(family: str | None = None) -> FaultPlan | None:
     from triton_dist_tpu import config as tdt_config
 
     plan = tdt_config.get_config().fault_plan
-    if plan is None:
+    if plan is None or plan_spent(plan):
         return None
     if tdt_config.on_tpu() and tdt_config.get_config().interpret is not True:
         import warnings

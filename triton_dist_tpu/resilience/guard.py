@@ -174,6 +174,12 @@ def _guarded(family, primary, fallback, args, kwargs, *, pin_global):
                 health.short_circuit(
                     family, "quarantined after watchdog timeout"
                 )
+                # elastic interpret-mode runs release the pin straight
+                # away: the world is about to shrink around the culprit
+                # PE and simulated semaphores cannot hold residue
+                from triton_dist_tpu.resilience import elastic
+
+                elastic.maybe_release_family_pins()
             raise
         if pin_global and _process_global(exc):
             # memoize ONLY at the op-entry level (the serving/bench surface,
@@ -182,7 +188,8 @@ def _guarded(family, primary, fallback, args, kwargs, *, pin_global):
             # keep re-attempting the fused path — a debug session that
             # patches the environment mid-process should see it recover
             health.short_circuit(
-                family, f"environment cannot build fused kernels: {exc}"
+                family, f"environment cannot build fused kernels: {exc}",
+                kind=health.PIN_ENV,
             )
         health.record_downgrade(
             family,

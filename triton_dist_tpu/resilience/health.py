@@ -22,8 +22,16 @@ from typing import Any
 MAX_EVENTS = 256
 
 # event kinds
-DOWNGRADE = "downgrade"   # fused op fell back to the golden XLA path
-TIMEOUT = "timeout"       # a watchdogged wait expired (DistTimeoutError)
+DOWNGRADE = "downgrade"       # fused op fell back to the golden XLA path
+TIMEOUT = "timeout"           # a watchdogged wait expired (DistTimeoutError)
+RETRY = "retry"               # a transient failure was retried with backoff
+RECOVERY = "recovery"         # an op entry succeeded after >= 1 retry
+PE_QUARANTINE = "pe_quarantine"   # elastic: a peer left the world
+PE_READMIT = "pe_readmit"         # elastic: a peer rejoined after probation
+
+# short-circuit pin kinds (why a family is pinned to its golden path)
+PIN_ENV = "env"               # process-global environment failure
+PIN_QUARANTINE = "quarantine"  # watchdog trip: device semaphore residue
 
 
 @dataclasses.dataclass(frozen=True)
@@ -40,12 +48,15 @@ _events: collections.deque[HealthEvent] = collections.deque(maxlen=MAX_EVENTS)
 _counters: dict[tuple[str, str], int] = {}
 _total_dropped = 0
 # families guarded_call serves straight from the golden path without
-# retrying the fused one: {family: reason}. Two ways in — a process-global
-# environmental failure (the install cannot build fused kernels; retrying
-# re-pays a failing trace per call), or a watchdog quarantine (after a
-# timeout the family's collective semaphore state is undefined; reusing it
-# could silently corrupt the next launch).
-_short_circuit: dict[str, str] = {}
+# retrying the fused one: {family: (reason, pin_kind)}. Two ways in — a
+# process-global environmental failure (PIN_ENV: the install cannot build
+# fused kernels; retrying re-pays a failing trace per call), or a watchdog
+# quarantine (PIN_QUARANTINE: after a timeout the family's collective
+# semaphore state is undefined; reusing it could silently corrupt the next
+# launch). The kind matters to reset(): env pins describe the process and
+# survive a keep_env reset; quarantine pins describe device state and are
+# released by the elastic layer in interpret mode (elastic.py).
+_short_circuit: dict[str, tuple[str, str]] = {}
 
 
 def record_downgrade(family: str, reason: str, exc: BaseException | None = None) -> None:
@@ -67,7 +78,48 @@ def record_timeout(family: str, records: list[dict]) -> None:
     # after the in-kernel drain); relaunching the fused kernel on it could
     # pass a wait early and silently serve stale buffers. jit_shard_map
     # refuses quarantined launches; guarded entries serve the golden path.
-    short_circuit(family, "quarantined after watchdog timeout")
+    short_circuit(family, "quarantined after watchdog timeout",
+                  kind=PIN_QUARANTINE)
+
+
+def record_retry(
+    family: str, attempt: int, delay_s: float, records: Any = None,
+    exc: BaseException | None = None,
+) -> None:
+    """One transient failure absorbed by the retry layer (retry.py)."""
+    _record(HealthEvent(
+        kind=RETRY, family=family,
+        reason=f"transient failure; retry {attempt} after {delay_s:.3g}s",
+        detail=records if records is not None
+        else (None if exc is None else f"{type(exc).__name__}: {exc}"),
+        walltime=time.time(),
+    ))
+
+
+def record_recovery(family: str, retries: int) -> None:
+    """An op entry succeeded after ``retries`` retried attempts."""
+    _record(HealthEvent(
+        kind=RECOVERY, family=family,
+        reason=f"recovered after {retries} retry(ies)",
+        walltime=time.time(),
+    ))
+
+
+def record_pe_quarantine(pe: int, reason: str) -> None:
+    """The elastic layer quarantined peer ``pe`` (elastic.py)."""
+    _record(HealthEvent(
+        kind=PE_QUARANTINE, family=f"pe{int(pe)}", reason=reason,
+        walltime=time.time(),
+    ))
+
+
+def record_pe_readmission(pe: int) -> None:
+    """Peer ``pe`` passed probation and rejoined the world."""
+    _record(HealthEvent(
+        kind=PE_READMIT, family=f"pe{int(pe)}",
+        reason="clean probation probe(s); re-admitted",
+        walltime=time.time(),
+    ))
 
 
 def _record(ev: HealthEvent) -> None:
@@ -101,19 +153,30 @@ def timed_out_families() -> set[str]:
         return {f for (f, k), n in _counters.items() if k == TIMEOUT and n > 0}
 
 
-def is_healthy() -> bool:
-    """True iff no downgrade or timeout has been recorded since reset()."""
+def retried_families() -> set[str]:
+    """Families that have absorbed at least one transient retry."""
     with _lock:
-        return not _counters
+        return {f for (f, k), n in _counters.items() if k == RETRY and n > 0}
+
+
+def is_healthy() -> bool:
+    """True iff no downgrade or timeout has been recorded since reset().
+    Retries/recoveries alone don't flip this — an absorbed transient is
+    the system working — but quarantines and unrecovered timeouts do."""
+    with _lock:
+        return not any(
+            k in (DOWNGRADE, TIMEOUT, PE_QUARANTINE)
+            for (_, k), n in _counters.items() if n > 0
+        )
 
 
 def snapshot() -> dict:
     """One JSON-able view for bench/serving logs."""
     with _lock:
-        return {
-            "healthy": not _counters,
+        snap = {
+            "healthy": True,
             "counters": {f"{f}:{k}": n for (f, k), n in sorted(_counters.items())},
-            "short_circuited": dict(_short_circuit),
+            "short_circuited": {f: r for f, (r, _) in _short_circuit.items()},
             "dropped_events": _total_dropped,
             "last_events": [
                 {
@@ -123,31 +186,66 @@ def snapshot() -> dict:
                 for e in list(_events)[-8:]
             ],
         }
+    snap["healthy"] = is_healthy()
+    # the elastic layer's peer states ride along so one snapshot answers
+    # "is this process fast AND whole?" (lazy import: elastic imports us)
+    from triton_dist_tpu.resilience import elastic
+
+    snap["elastic"] = elastic.summary()
+    return snap
 
 
-def short_circuit(family: str, reason: str) -> None:
+def short_circuit(family: str, reason: str, kind: str = PIN_QUARANTINE) -> None:
     """Pin ``family`` to its golden path for the rest of the process (or
-    until :func:`reset`)."""
+    until :func:`reset` / :func:`clear_short_circuit`)."""
     with _lock:
-        _short_circuit.setdefault(family, reason)
+        _short_circuit.setdefault(family, (reason, kind))
 
 
 def short_circuited(family: str) -> str | None:
     """The reason ``family`` is pinned to its golden path, or None."""
     with _lock:
-        return _short_circuit.get(family)
+        pin = _short_circuit.get(family)
+        return pin[0] if pin is not None else None
 
 
-def reset(*, keep_short_circuit: bool = False) -> None:
-    """Clear the statistics. ``keep_short_circuit=True`` preserves the
+def clear_short_circuit(family: str) -> None:
+    """Release one family's golden-path pin. Callers own the safety
+    argument (the elastic layer clears quarantine pins in interpret mode,
+    where simulated semaphores cannot hold residue; probes clear their own
+    family so recovery is never refused)."""
+    with _lock:
+        _short_circuit.pop(family, None)
+
+
+def clear_timeout_quarantines() -> None:
+    """Release every PIN_QUARANTINE pin (interpret-mode recovery: the
+    elastic layer excised or re-admitted the culprit PE and simulated
+    semaphores are rebuilt per launch). Env pins always survive."""
+    with _lock:
+        for f in [f for f, (_, k) in _short_circuit.items()
+                  if k == PIN_QUARANTINE]:
+            del _short_circuit[f]
+
+
+def reset(*, keep_short_circuit: bool = False, keep_env: bool = False) -> None:
+    """Clear the statistics. ``keep_short_circuit=True`` preserves ALL
     golden-path pins — use it when resetting between phases of one process
     (bench): clearing a Python dict does not clean a quarantined family's
     device semaphore, so re-enabling its fused kernel would risk exactly
-    the silent corruption the quarantine exists to prevent."""
+    the silent corruption the quarantine exists to prevent.
+    ``keep_env=True`` preserves only the PIN_ENV pins (a jax install that
+    cannot build fused kernels is still the same install after the reset)
+    while releasing quarantine pins — the per-test isolation posture."""
     global _total_dropped
     with _lock:
         _events.clear()
         _counters.clear()
         if not keep_short_circuit:
-            _short_circuit.clear()
+            if keep_env:
+                for f in [f for f, (_, k) in _short_circuit.items()
+                          if k != PIN_ENV]:
+                    del _short_circuit[f]
+            else:
+                _short_circuit.clear()
         _total_dropped = 0

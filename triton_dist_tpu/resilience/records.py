@@ -110,11 +110,19 @@ class DistTimeoutError(RuntimeError):
     count, and the exhausted budget — enough to name the missing signal
     edge without a device debugger. The op's output was NaN-poisoned
     before this was raised; nothing downstream can silently consume it.
+
+    ``world_size`` (when the raising op entry knows it) is the number of
+    PEs in the collective — the elastic layer's peer attribution names the
+    straggler by absence, which needs the full roster (elastic.py).
     """
 
-    def __init__(self, family: str, records: list[dict]):
+    def __init__(
+        self, family: str, records: list[dict],
+        world_size: int | None = None,
+    ):
         self.family = family
         self.records = records
+        self.world_size = world_size
         detail = "; ".join(
             f"pe {r['pe']}: {r['kind']} site {r['site']} expected "
             f"{r['expected']} observed {r['observed']} (budget {r['budget']})"
