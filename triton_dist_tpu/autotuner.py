@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import hashlib
 import json
 import math
 import os
@@ -50,8 +51,15 @@ def _sig_key(args: Sequence[Any], kwargs: dict[str, Any]) -> str:
         else:
             # non-array context (Mesh, method enums, …) must key the cache
             # too: distinct contexts with identical array shapes are
-            # different tuning problems
-            parts.append(str(a)[:160])
+            # different tuning problems. Long strings keep a readable
+            # prefix plus a hash of the FULL text — a bare truncation let
+            # two contexts sharing a 160-char prefix collide and silently
+            # serve each other's cached config
+            s = str(a)
+            if len(s) > 160:
+                digest = hashlib.sha256(s.encode("utf-8", "replace")).hexdigest()[:16]
+                s = f"{s[:120]}#{digest}"
+            parts.append(s)
     try:
         parts.append(f"dev={jax.devices()[0].device_kind}x{len(jax.devices())}")
     except Exception:

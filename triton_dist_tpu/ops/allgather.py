@@ -31,7 +31,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_dist_tpu import resilience
-from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
+from triton_dist_tpu.ops.common import chunk_schedule, dist_pallas_call, jit_shard_map
 from triton_dist_tpu.parallel import topology
 from triton_dist_tpu.shmem import device as shmem
 
@@ -132,6 +132,101 @@ def _ring_bidir_kernel(
             descs_l.append(
                 shmem.putmem_nbi_block(
                     out_ref.at[sl], out_ref.at[sl], left, axis, send_l.at[s], recv_l.at[s]
+                )
+            )
+    descs_r[-1].wait_recv()
+    if descs_l:
+        descs_l[-1].wait_recv()
+    shmem.quiet(*descs_r, *descs_l)
+
+
+def _ring_1d_chunked_kernel(
+    x_ref, out_ref, copy_sem, send_sems, recv_sems, sig_sems,
+    *, axis: str, n: int, spans,
+):
+    """Chunk-granular 1-D ring (ISSUE 3 tentpole): each ring-step shard is
+    `len(spans)` independent chunk DMAs, and step ``s`` forwards chunk ``j``
+    the moment chunk ``j`` of step ``s-1`` lands — so the per-hop exposed
+    latency is one *chunk*, not one shard (wormhole pipelining; the chunk=1
+    schedule is exactly :func:`_ring_1d_kernel` and is dispatched there)."""
+    me = shmem.my_pe(axis)
+    m = x_ref.shape[0]
+    local = pltpu.make_async_copy(x_ref, out_ref.at[pl.ds(me * m, m)], copy_sem)
+    local.start()
+    local.wait()
+    shmem.comm_jitter(axis, salt=1)
+    shmem.barrier_all(axis)
+    right = jax.lax.rem(me + 1, n)
+    descs = []
+    for s in range(n - 1):
+        c = jax.lax.rem(me - s + n, n)
+        base = c * m
+        ready = None
+        if s > 0:
+            prev = descs[s - 1]
+            ready = prev.wait_recv_chunk  # chunk j arrived during step s-1
+        descs.append(
+            shmem.putmem_signal_chunked_nbi_block(
+                lambda off, rows, base=base: out_ref.at[pl.ds(base + off, rows)],
+                lambda off, rows, base=base: out_ref.at[pl.ds(base + off, rows)],
+                right, axis,
+                lambda j, s=s: send_sems.at[s, j],
+                lambda j, s=s: recv_sems.at[s, j],
+                lambda j, s=s: sig_sems.at[s, j],
+                spans, ready=ready,
+            )
+        )
+    descs[-1].wait_recv()
+    shmem.quiet(*descs)
+
+
+def _ring_bidir_chunked_kernel(
+    x_ref, out_ref, copy_sem, send_r, recv_r, sig_r, send_l, recv_l, sig_l,
+    *, axis: str, n: int, spans,
+):
+    """Chunk-granular bidirectional ring: both directions run the chunked
+    forward-on-arrival schedule of :func:`_ring_1d_chunked_kernel`."""
+    me = shmem.my_pe(axis)
+    m = x_ref.shape[0]
+    local = pltpu.make_async_copy(x_ref, out_ref.at[pl.ds(me * m, m)], copy_sem)
+    local.start()
+    local.wait()
+    shmem.comm_jitter(axis, salt=2)
+    shmem.barrier_all(axis)
+    right = jax.lax.rem(me + 1, n)
+    left = jax.lax.rem(me - 1 + n, n)
+    steps_r = (n - 1 + 1) // 2
+    steps_l = (n - 1) // 2
+    descs_r, descs_l = [], []
+    for s in range(max(steps_r, steps_l)):
+        if s < steps_r:
+            c = jax.lax.rem(me - s + n, n)
+            base = c * m
+            ready = descs_r[s - 1].wait_recv_chunk if s > 0 else None
+            descs_r.append(
+                shmem.putmem_signal_chunked_nbi_block(
+                    lambda off, rows, base=base: out_ref.at[pl.ds(base + off, rows)],
+                    lambda off, rows, base=base: out_ref.at[pl.ds(base + off, rows)],
+                    right, axis,
+                    lambda j, s=s: send_r.at[s, j],
+                    lambda j, s=s: recv_r.at[s, j],
+                    lambda j, s=s: sig_r.at[s, j],
+                    spans, ready=ready,
+                )
+            )
+        if s < steps_l:
+            c = jax.lax.rem(me + s, n)
+            base = c * m
+            ready = descs_l[s - 1].wait_recv_chunk if s > 0 else None
+            descs_l.append(
+                shmem.putmem_signal_chunked_nbi_block(
+                    lambda off, rows, base=base: out_ref.at[pl.ds(base + off, rows)],
+                    lambda off, rows, base=base: out_ref.at[pl.ds(base + off, rows)],
+                    left, axis,
+                    lambda j, s=s: send_l.at[s, j],
+                    lambda j, s=s: recv_l.at[s, j],
+                    lambda j, s=s: sig_l.at[s, j],
+                    spans, ready=ready,
                 )
             )
     descs_r[-1].wait_recv()
@@ -244,6 +339,15 @@ _KERNELS = {
     "full_mesh_push": (_full_mesh_push_kernel, 1),
 }
 
+# chunk-granular variants (ISSUE 3): ring methods only — full_mesh_push is
+# a single hardware-routed hop per peer, so chunking buys no cross-hop
+# pipelining there (chunks_per_shard is ignored for it, as for DCN/XLA
+# fallbacks)
+_CHUNKED_KERNELS = {
+    "ring_1d": (_ring_1d_chunked_kernel, 1),
+    "ring_bidir": (_ring_bidir_chunked_kernel, 2),
+}
+
 
 def all_gather_2d(
     x: jax.Array,
@@ -308,7 +412,7 @@ def _all_gather_2d_fused(
     return out
 
 
-def all_gather(x: jax.Array, *, axis: str = "tp", method: str = "auto", interpret: Any = None, devices: Any = None) -> jax.Array:
+def all_gather(x: jax.Array, *, axis: str = "tp", method: str = "auto", interpret: Any = None, devices: Any = None, chunks_per_shard: int = 1) -> jax.Array:
     """Gather shards along mesh `axis` (call inside ``jax.shard_map``).
 
     `x` is this PE's shard ``(m, ...)``; returns ``(n*m, ...)`` with shard i
@@ -316,16 +420,23 @@ def all_gather(x: jax.Array, *, axis: str = "tp", method: str = "auto", interpre
     ``jax.lax.all_gather(x, axis, tiled=True)`` — served automatically when
     the fused kernel cannot run in this environment (resilience layer,
     docs/resilience.md).
+
+    ``chunks_per_shard > 1`` splits every ring-step payload into that many
+    per-chunk DMAs forwarded the moment each lands (chunk-granular overlap,
+    ISSUE 3); 1 (default) is the legacy shard-granular schedule, bit for
+    bit. Ring methods only — ignored by full_mesh_push and the DCN/XLA
+    paths.
     """
     return resilience.guarded_call(
         "all_gather",
         _all_gather_fused,
         _all_gather_xla,
         x, axis=axis, method=method, interpret=interpret, devices=devices,
+        chunks_per_shard=chunks_per_shard,
     )
 
 
-def _all_gather_fused(x: jax.Array, *, axis: str = "tp", method: str = "auto", interpret: Any = None, devices: Any = None) -> jax.Array:
+def _all_gather_fused(x: jax.Array, *, axis: str = "tp", method: str = "auto", interpret: Any = None, devices: Any = None, chunks_per_shard: int = 1) -> jax.Array:
     if isinstance(axis, (tuple, list)):
         if len(axis) == 1:
             axis = axis[0]
@@ -349,13 +460,21 @@ def _all_gather_fused(x: jax.Array, *, axis: str = "tp", method: str = "auto", i
             # XLA owns the DCN transport).
             axes = tuple(axis)
             if len(axes) >= 2 and not _is_dcn(axes[-1]) and not _is_dcn(axes[-2]):
+                # the fused 2-D ring keeps shard granularity (its inner ring
+                # already pipelines per-segment across the outer axis)
                 out = all_gather_2d(x, axes=axes[-2:], interpret=interpret)
                 rest = axes[:-2]
             else:
-                out = all_gather(x, axis=axes[-1], interpret=interpret)
+                out = all_gather(
+                    x, axis=axes[-1], interpret=interpret,
+                    chunks_per_shard=chunks_per_shard,
+                )
                 rest = axes[:-1]
             for a in reversed(rest):
-                out = all_gather(out, axis=a, interpret=interpret)
+                out = all_gather(
+                    out, axis=a, interpret=interpret,
+                    chunks_per_shard=chunks_per_shard,
+                )
             return out
     n = int(jax.lax.axis_size(axis))
     if n == 1:
@@ -371,16 +490,35 @@ def _all_gather_fused(x: jax.Array, *, axis: str = "tp", method: str = "auto", i
         method = get_auto_all_gather_method(
             x.size * x.dtype.itemsize, n, devices
         )
-    kernel_fn, n_sem_pairs = _KERNELS[method]
     m = x.shape[0]
     out_shape = (n * m, *x.shape[1:])
     n_steps = max(1, n - 1)
-    scratch = [pltpu.SemaphoreType.DMA(())]
-    for _ in range(n_sem_pairs):
-        scratch += [pltpu.SemaphoreType.DMA((n_steps,)), pltpu.SemaphoreType.DMA((n_steps,))]
+    chunks = max(1, int(chunks_per_shard))
+    spans = chunk_schedule(m, chunks)
+    if len(spans) > 1 and method in _CHUNKED_KERNELS:
+        kernel_fn, n_sem_pairs = _CHUNKED_KERNELS[method]
+        kernel = functools.partial(kernel_fn, axis=axis, n=n, spans=spans)
+        name = f"all_gather_{method}"  # same family: never runs concurrently
+        # per-(step, chunk) DMA sem pairs + the pure chunk-signal slots
+        # (REGULAR; only exercised under an armed watchdog — see
+        # shmem.putmem_signal_chunked_nbi_block)
+        scratch = [pltpu.SemaphoreType.DMA(())]
+        for _ in range(n_sem_pairs):
+            scratch += [
+                pltpu.SemaphoreType.DMA((n_steps, len(spans))),
+                pltpu.SemaphoreType.DMA((n_steps, len(spans))),
+                pltpu.SemaphoreType.REGULAR((n_steps, len(spans))),
+            ]
+    else:
+        kernel_fn, n_sem_pairs = _KERNELS[method]
+        kernel = functools.partial(kernel_fn, axis=axis, n=n)
+        name = f"all_gather_{method}"
+        scratch = [pltpu.SemaphoreType.DMA(())]
+        for _ in range(n_sem_pairs):
+            scratch += [pltpu.SemaphoreType.DMA((n_steps,)), pltpu.SemaphoreType.DMA((n_steps,))]
     out = dist_pallas_call(
-        functools.partial(kernel_fn, axis=axis, n=n),
-        name=f"all_gather_{method}",
+        kernel,
+        name=name,
         out_shape=jax.ShapeDtypeStruct(out_shape, x.dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
@@ -406,17 +544,18 @@ def _all_gather_op_xla(
 
 @resilience.guard_op("all_gather_op", _all_gather_op_xla)
 def all_gather_op(
-    x: jax.Array, mesh: Mesh, *, axis: str = "tp", method: str = "auto", interpret: Any = None
+    x: jax.Array, mesh: Mesh, *, axis: str = "tp", method: str = "auto", interpret: Any = None, chunks_per_shard: int = 1
 ) -> jax.Array:
     """Convenience wrapper applying shard_map over `mesh` for a global array
     sharded on dim 0 (≙ the host-level ``ag_gemm``-style entry points)."""
     fn = functools.partial(
         all_gather, axis=axis, method=method, interpret=interpret,
         devices=topology.axis_devices(mesh, axis),
+        chunks_per_shard=chunks_per_shard,
     )
     in_spec = P(axis, *([None] * (x.ndim - 1)))
     out_spec = P(*([None] * x.ndim))
     return jit_shard_map(
         fn, mesh, in_spec, out_spec,
-        key=("all_gather", axis, method, str(interpret)),
+        key=("all_gather", axis, method, str(interpret), chunks_per_shard),
     )(x)

@@ -35,6 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.common import (
+    chunk_schedule,
     dist_pallas_call,
     gemm_add_pipeline,
     gemm_only,
@@ -57,6 +58,11 @@ class AGGemmConfig:
     # no-comm case to jnp.dot (XLA's matmul), a first-class autotune
     # candidate. Non-viable (raises) at n>1, where the fused ring kernel
     # is the whole point.
+    # Ring-step payload granularity (ISSUE 3): > 1 splits each shard into
+    # that many per-chunk DMAs, the MXU computing on chunk j while chunk
+    # j+1 is in flight; 1 reproduces the legacy shard-granular schedule
+    # bit for bit (the tuner's no-regression anchor).
+    chunks_per_shard: int = 1
 
 
 def _ag_gemm_kernel(
@@ -96,6 +102,65 @@ def _ag_gemm_kernel(
                 )
             )
         pipeline(ag_ref.at[sl], b_ref, out_ref.at[sl])
+    shmem.quiet(*descs)
+
+
+def _ag_gemm_chunked_kernel(
+    a_ref, b_ref, out_ref, ag_ref, acc_ref, copy_sem, send_sems, recv_sems,
+    sig_sems, *, axis: str, n: int, cfg: AGGemmConfig, out_dtype, spans,
+):
+    """Chunk-granular fused AG-GEMM (ISSUE 3 tentpole): step ``s`` waits,
+    forwards, and COMPUTES shard ``me-s`` chunk by chunk — the MXU runs on
+    chunk ``j`` while chunk ``j+1`` is still crossing the ICI, restoring the
+    reference's per-M-tile progress (``dl.wait``/``dl.consume_token``,
+    allgather_gemm.py:226-227) that the shard-granular port collapsed.
+    chunk=1 dispatches to :func:`_ag_gemm_kernel` (bit-identical legacy)."""
+    me = shmem.my_pe(axis)
+    m_loc, k_dim = a_ref.shape
+    n_loc = b_ref.shape[1]
+    bn = _pick_block(n_loc, cfg.block_n)
+    bk = _pick_block(k_dim, cfg.block_k)
+    # one pipeline per distinct chunk row-count (non-divisor spans differ by
+    # one row); the f32 accumulator scratch is sized for the largest chunk
+    # tile and sliced only for the smaller ones
+    bms = [_pick_block(rows, cfg.block_m) for _, rows in spans]
+    bm_max = max(bms)
+    pipes = []
+    for (_, rows), bm_j in zip(spans, bms):
+        acc_j = acc_ref if bm_j == bm_max else acc_ref.at[pl.ds(0, bm_j), :]
+        pipes.append(
+            gemm_add_pipeline(bm_j, bn, bk, rows, n_loc, k_dim, acc_j, out_dtype)
+        )
+
+    local = pltpu.make_async_copy(a_ref, ag_ref.at[pl.ds(me * m_loc, m_loc)], copy_sem)
+    local.start()
+    local.wait()
+    shmem.comm_jitter(axis, salt=8)
+    shmem.barrier_all(axis)
+
+    right = jax.lax.rem(me + 1, n)
+    descs = []
+    for s in range(n):
+        c = jax.lax.rem(me - s + 2 * n, n)
+        base = c * m_loc
+        handles = []
+        for j, (off, rows) in enumerate(spans):
+            if s > 0:
+                descs[s - 1].wait_recv_chunk(j)  # chunk j of shard c landed
+            sl = pl.ds(base + off, rows)
+            if s < n - 1:
+                # forward chunk j before computing on it: its ICI hop rides
+                # under this chunk's (and later chunks') MXU work
+                handles.append(
+                    shmem.putmem_signal2_nbi_block(
+                        ag_ref.at[sl], ag_ref.at[sl], right, axis,
+                        send_sems.at[s, j], recv_sems.at[s, j],
+                        sig_sems.at[s, j],
+                    )
+                )
+            pipes[j](ag_ref.at[sl], b_ref, out_ref.at[sl])
+        if handles:
+            descs.append(shmem.ChunkedPutHandle(handles))
     shmem.quiet(*descs)
 
 
@@ -323,10 +388,37 @@ def ag_gemm(
             a, b, cfg=cfg, out_dtype=out_dtype, name="ag_gemm", interpret=interpret
         )
         return (out, a) if gather_output else out
-    out, ag = dist_pallas_call(
-        functools.partial(
+    chunks = max(1, int(cfg.chunks_per_shard))
+    # span boundaries quantize to the MXU row tile a chunk of this size
+    # would pick, so chunking shrinks tiles predictably (m_loc/chunks)
+    # instead of collapsing them on odd row counts (see chunk_schedule)
+    spans = chunk_schedule(
+        m_loc, chunks,
+        quantum=_pick_block(m_loc, min(cfg.block_m, max(1, m_loc // chunks))),
+    )
+    n_steps = max(n - 1, 1)
+    if len(spans) > 1:
+        kernel = functools.partial(
+            _ag_gemm_chunked_kernel, axis=axis, n=n, cfg=cfg,
+            out_dtype=out_dtype, spans=spans,
+        )
+        bm_acc = max(_pick_block(rows, cfg.block_m) for _, rows in spans)
+        sem_shapes = [
+            pltpu.SemaphoreType.DMA((n_steps, len(spans))),
+            pltpu.SemaphoreType.DMA((n_steps, len(spans))),
+            pltpu.SemaphoreType.REGULAR((n_steps, len(spans))),
+        ]
+    else:
+        kernel = functools.partial(
             _ag_gemm_kernel, axis=axis, n=n, cfg=cfg, out_dtype=out_dtype
-        ),
+        )
+        bm_acc = bm
+        sem_shapes = [
+            pltpu.SemaphoreType.DMA((n_steps,)),
+            pltpu.SemaphoreType.DMA((n_steps,)),
+        ]
+    out, ag = dist_pallas_call(
+        kernel,
         name="ag_gemm",
         out_shape=(
             jax.ShapeDtypeStruct((n * m_loc, n_loc), out_dtype),
@@ -341,10 +433,9 @@ def ag_gemm(
             pl.BlockSpec(memory_space=pl.ANY),
         ),
         scratch_shapes=[
-            pltpu.VMEM((bm, bn), jnp.float32),
+            pltpu.VMEM((bm_acc, bn), jnp.float32),
             pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
-            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            *sem_shapes,
         ],
         cost_estimate=pl.CostEstimate(
             flops=2 * n * m_loc * n_loc * k_dim,
@@ -401,6 +492,16 @@ AG_GEMM_TUNE_SPACE = (
     AGGemmConfig(512, 2048, 2048),
     AGGemmConfig(512, 1024, 512),
     AGGemmConfig(256, 1024, 512),
+    # chunks_per_shard axis (ISSUE 3): chunk-granular ring overlap over the
+    # best-known tiles. Listed AFTER every chunk=1 candidate, so the
+    # sweep-free walks (cached_or_first / interpreter) can never pick a
+    # chunked schedule untimed, and a sweep only crowns one that beats the
+    # legacy leader by the paired-confirmation margin — the tuner cannot
+    # regress below today's schedule by construction.
+    AGGemmConfig(1024, 2048, 1024, chunks_per_shard=2),
+    AGGemmConfig(1024, 2048, 1024, chunks_per_shard=4),
+    AGGemmConfig(512, 2048, 512, chunks_per_shard=4),
+    AGGemmConfig(512, 2048, 1024, chunks_per_shard=8),
 )
 
 ag_gemm_op = contextual_autotune(AG_GEMM_TUNE_SPACE, name="ag_gemm")(ag_gemm_op)

@@ -135,6 +135,19 @@ def _ag_group_gemm_overlap_kernel(
     shmem.barrier_all(axis)
     right = jax.lax.rem(me + 1, n)
 
+    # Weight-slab prefetch chain (VERDICT r5 `moe` gap): the FIRST slab of
+    # every gather group used to be fetched in the group preamble and
+    # waited immediately — a full [K, bn] HBM stall per group/step
+    # boundary. Now the double-buffer slot carries across groups AND ring
+    # steps, and each boundary's first slab is prefetched from inside the
+    # previous group's compute loop (the `_iter` boundary arm below) — so
+    # a step boundary's weight fetch also rides under the ring-chunk wait.
+    # Only the very first slab of the whole schedule is fetched here.
+    pltpu.make_async_copy(
+        b_ref.at[eid_ref[me, 0], :, pl.ds(0, bn)], b_buf.at[0], bsem.at[0]
+    ).start()
+    slot_carry = [jnp.int32(1)]  # traced carry: _iter's weight buffer slot
+
     descs = []
     for s in range(n):
         c = jax.lax.rem(me - s + 2 * n, n)
@@ -169,14 +182,20 @@ def _ag_group_gemm_overlap_kernel(
             _group_desc(g, gslot).wait()
             nb_g = min(bpg, nb - g * bpg)  # blocks in this group
 
-            # first weight slab of this group
-            e0 = eid_ref[c, g * bpg]
-            pltpu.make_async_copy(
-                b_ref.at[e0, :, pl.ds(0, bn)], b_buf.at[0], bsem.at[0]
-            ).start()
+            # first slab of the NEXT group/step: prefetched by this group's
+            # last iteration (the `_iter` boundary arm), so the boundary
+            # never stalls on a cold weight fetch. None = end of schedule.
+            if g + 1 < n_groups:
+                e_next = eid_ref[c, (g + 1) * bpg]
+            elif s + 1 < n:
+                c_next = jax.lax.rem(me - (s + 1) + 2 * n, n)
+                e_next = eid_ref[c_next, 0]
+            else:
+                e_next = None
             it_base = it_counter[0]
 
-            def _iter(i, slot, g=g, gslot=gslot, nb_g=nb_g, it_base=it_base):
+            def _iter(i, slot, g=g, gslot=gslot, nb_g=nb_g, it_base=it_base,
+                      e_next=e_next):
                 jn = i // nb_g
                 b_rel = jax.lax.rem(i, nb_g)
                 b = g * bpg + b_rel
@@ -210,11 +229,21 @@ def _ag_group_gemm_overlap_kernel(
                     nxt < nb_g * n_jn,
                     jnp.logical_or(jn2 != jn, e2 != e),
                 )
+                jn2v = jn2
+                if e_next is not None:
+                    # boundary arm: the loop's last iteration prefetches the
+                    # next group's/step's first slab into the buffer the
+                    # boundary's i=0 `fresh` wait will target (slot carries
+                    # across loops, so 1-slot here IS that buffer)
+                    boundary = nxt >= nb_g * n_jn
+                    e2 = jnp.where(boundary, e_next, e2)
+                    jn2v = jnp.where(boundary, 0, jn2)
+                    fresh2 = jnp.logical_or(fresh2, boundary)
 
                 @pl.when(fresh2)
                 def _():
                     pltpu.make_async_copy(
-                        b_ref.at[e2, :, pl.ds(jn2 * bn, bn)],
+                        b_ref.at[e2, :, pl.ds(jn2v * bn, bn)],
                         b_buf.at[1 - slot],
                         bsem.at[1 - slot],
                     ).start()
@@ -250,7 +279,9 @@ def _ag_group_gemm_overlap_kernel(
                 ).start()
                 return slot
 
-            jax.lax.fori_loop(0, nb_g * n_jn, _iter, jnp.int32(1))
+            slot_carry[0] = jax.lax.fori_loop(
+                0, nb_g * n_jn, _iter, slot_carry[0]
+            )
             it_counter[0] += nb_g * n_jn
     # Drain the final pending output store per used slot, then wait local
     # send completion of the ring puts.

@@ -240,6 +240,41 @@ def dist_pallas_call(
     return invoke
 
 
+def chunk_schedule(
+    rows: int, chunks: int, quantum: int = 1
+) -> tuple[tuple[int, int], ...]:
+    """Static ``(offset, rows)`` spans splitting a shard's `rows` into
+    `chunks` contiguous near-equal chunks — the chunk-granular transfer
+    schedule of the ring families (ISSUE 3; ≙ the per-M-tile readiness
+    granularity of the reference's consumer GEMM, allgather_gemm.py:226).
+
+    `quantum` > 1 aligns every span boundary to a multiple of it (the last
+    chunk absorbs any sub-quantum tail): the GEMM families pass their MXU
+    row tile here so a non-divisor chunk count can never hand
+    ``pick_block`` an odd row count that collapses the tile toward 1 row —
+    a silent orders-of-magnitude cliff. With the default quantum=1 counts
+    balance to within one row; a request for more chunks than quanta
+    clamps. Every PE computes the same spans from the same static shapes,
+    so senders and receivers agree on per-chunk semaphore slots and byte
+    counts by construction."""
+    if rows < 1:
+        raise ValueError(f"chunk_schedule: rows must be >= 1, got {rows}")
+    if chunks < 1:
+        raise ValueError(f"chunk_schedule: chunks must be >= 1, got {chunks}")
+    quantum = max(1, min(int(quantum), rows))
+    units = rows // quantum
+    chunks = min(chunks, max(1, units))
+    base, extra = divmod(units, chunks)
+    spans, off = [], 0
+    for j in range(chunks):
+        sz = (base + (1 if j < extra else 0)) * quantum
+        if j == chunks - 1:
+            sz += rows - units * quantum  # sub-quantum tail
+        spans.append((off, sz))
+        off += sz
+    return tuple(spans)
+
+
 def gemm_add_pipeline(
     bm: int, bn: int, bk: int, m_dim: int, n_dim: int, k_dim: int,
     acc_ref, out_dtype, n_adds: int = 0,

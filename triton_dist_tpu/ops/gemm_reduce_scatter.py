@@ -46,6 +46,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from triton_dist_tpu import resilience
 from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.common import (
+    chunk_schedule,
     dist_pallas_call,
     gemm_add_pipeline,
     gemm_only,
@@ -79,6 +80,11 @@ class GemmRSConfig:
     block_k: int = 512
     # block_m=0: world-1 XLA-native sentinel (see AGGemmConfig) — the
     # no-comm degenerate case goes to jnp.dot; raises at n>1.
+    # Ring-step payload granularity (ISSUE 3): > 1 splits each ring hop's
+    # partial chunk into that many per-chunk DMAs produced/added/forwarded
+    # independently; 1 is the legacy shard-granular schedule, bit for bit.
+    # Ring method only (the scatter method's puts are single-hop).
+    chunks_per_shard: int = 1
 
 
 def _blocks(cfg: GemmRSConfig, m_loc: int, n_dim: int, k_loc: int):
@@ -174,6 +180,74 @@ def _gemm_rs_ring_kernel(
                     send_sems.at[s], recv_sems.at[s],
                 )
             )
+    shmem.quiet(*descs)
+
+
+def _gemm_rs_ring_chunked_kernel(
+    a_ref, b_ref, out_ref, comp_buf, recv_buf, acc_ref, send_sems, recv_sems,
+    sig_sems, *, axis: str, n: int, cfg: GemmRSConfig, out_dtype, spans,
+):
+    """Chunk-granular fused ring GEMM-RS (ISSUE 3 tentpole): step ``s``
+    produces, fused-adds, and forwards its partial chunk in ``len(spans)``
+    independent sub-chunks — chunk ``j``'s MXU work runs while chunk ``j+1``
+    of the incoming partial is still in flight, so each hop exposes one
+    *chunk* of ICI latency instead of one m_loc-row shard. chunk=1
+    dispatches to :func:`_gemm_rs_ring_kernel` (bit-identical legacy)."""
+    me = shmem.my_pe(axis)
+    m_tot, k_loc = a_ref.shape
+    n_dim = b_ref.shape[1]
+    m_loc = m_tot // n
+    bn = pick_block(n_dim, cfg.block_n)
+    bk = pick_block(k_loc, cfg.block_k)
+    bms = [pick_block(rows, cfg.block_m) for _, rows in spans]
+    bm_max = max(bms)
+    gemms, gemm_adds = [], []
+    for (_, rows), bm_j in zip(spans, bms):
+        acc_j = acc_ref if bm_j == bm_max else acc_ref.at[pl.ds(0, bm_j), :]
+        gemms.append(
+            gemm_add_pipeline(bm_j, bn, bk, rows, n_dim, k_loc, acc_j, out_dtype, 0)
+        )
+        gemm_adds.append(
+            gemm_add_pipeline(bm_j, bn, bk, rows, n_dim, k_loc, acc_j, out_dtype, 1)
+        )
+
+    shmem.comm_jitter(axis, salt=11)
+    shmem.barrier_all(axis)
+    right = jax.lax.rem(me + 1, n)
+
+    # Step s, chunk j: produce partial rows of chunk (me-1-s), fused-add
+    # the partially-reduced rows that landed from the left during step s-1,
+    # forward them right — all at chunk granularity.
+    descs = []
+    for s in range(n):
+        cbase = jax.lax.rem(me - 1 - s + 2 * n, n) * m_loc
+        handles = []
+        for j, (off, rows) in enumerate(spans):
+            sl_a = pl.ds(cbase + off, rows)
+            target = (
+                out_ref.at[pl.ds(off, rows)] if s == n - 1
+                else comp_buf.at[s % 2, pl.ds(off, rows)]
+            )
+            if 2 <= s < n - 1:
+                descs[s - 2].wait_send_chunk(j)  # comp_buf rows free again
+            if s == 0:
+                gemms[j](a_ref.at[sl_a], b_ref, target)
+            else:
+                descs[s - 1].wait_recv_chunk(j)  # partial chunk j landed
+                gemm_adds[j](
+                    a_ref.at[sl_a], b_ref,
+                    recv_buf.at[s - 1, pl.ds(off, rows)], target,
+                )
+            if s < n - 1:
+                handles.append(
+                    shmem.putmem_signal2_nbi_block(
+                        recv_buf.at[s, pl.ds(off, rows)], target, right, axis,
+                        send_sems.at[s, j], recv_sems.at[s, j],
+                        sig_sems.at[s, j],
+                    )
+                )
+        if handles:
+            descs.append(shmem.ChunkedPutHandle(handles))
     shmem.quiet(*descs)
 
 
@@ -342,8 +416,33 @@ def _gemm_rs_fused(
         raise ValueError(f"unknown gemm_rs method: {method!r} (want scatter|ring)")
     kernel = kernels[method]
     n_steps = n - 1
+    chunks = max(1, int(cfg.chunks_per_shard))
+    # quantize spans to the MXU row tile (see chunk_schedule / ag_gemm)
+    spans = chunk_schedule(
+        m_loc, chunks,
+        quantum=pick_block(m_loc, min(cfg.block_m, max(1, m_loc // chunks))),
+    )
+    sem_shapes = [
+        pltpu.SemaphoreType.DMA((n_steps,)),
+        pltpu.SemaphoreType.DMA((n_steps,)),
+    ]
+    kern = functools.partial(kernel, axis=axis, n=n, cfg=cfg, out_dtype=out_dtype)
+    acc_bm = bm
+    if method == "ring" and len(spans) > 1:
+        # chunk-granular ring (the scatter method's puts are single-hop —
+        # chunking buys no cross-hop pipelining there)
+        kern = functools.partial(
+            _gemm_rs_ring_chunked_kernel, axis=axis, n=n, cfg=cfg,
+            out_dtype=out_dtype, spans=spans,
+        )
+        acc_bm = max(pick_block(rows, cfg.block_m) for _, rows in spans)
+        sem_shapes = [
+            pltpu.SemaphoreType.DMA((n_steps, len(spans))),
+            pltpu.SemaphoreType.DMA((n_steps, len(spans))),
+            pltpu.SemaphoreType.REGULAR((n_steps, len(spans))),
+        ]
     outs = dist_pallas_call(
-        functools.partial(kernel, axis=axis, n=n, cfg=cfg, out_dtype=out_dtype),
+        kern,
         name=f"gemm_rs_{method}",
         out_shape=(
             jax.ShapeDtypeStruct((m_loc, n_dim), out_dtype),
@@ -358,9 +457,8 @@ def _gemm_rs_fused(
         ],
         out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY) for _ in range(3)),
         scratch_shapes=[
-            pltpu.VMEM((bm, bn), jnp.float32),
-            pltpu.SemaphoreType.DMA((n_steps,)),
-            pltpu.SemaphoreType.DMA((n_steps,)),
+            pltpu.VMEM((acc_bm, bn), jnp.float32),
+            *sem_shapes,
         ],
         cost_estimate=pl.CostEstimate(
             flops=2 * m_tot * n_dim * k_loc,
@@ -437,6 +535,12 @@ GEMM_RS_TUNE_SPACE = (
     GemmRSConfig(1024, 2048, 1024),
     GemmRSConfig(512, 4096, 2048),
     GemmRSConfig(128, 1024, 512),
+    # chunks_per_shard axis (ISSUE 3): chunk-granular ring staging over the
+    # best-known tiles — after every chunk=1 candidate so the sweep-free
+    # walks never apply a chunked schedule untimed (see AG_GEMM_TUNE_SPACE)
+    GemmRSConfig(512, 2048, 1024, chunks_per_shard=2),
+    GemmRSConfig(512, 2048, 1024, chunks_per_shard=4),
+    GemmRSConfig(256, 1024, 512, chunks_per_shard=4),
 )
 
 gemm_rs_op = contextual_autotune(GEMM_RS_TUNE_SPACE, name="gemm_rs")(gemm_rs_op)

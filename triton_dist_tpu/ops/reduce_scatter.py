@@ -35,7 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_dist_tpu import resilience
 from triton_dist_tpu.autotuner import contextual_autotune
-from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
+from triton_dist_tpu.ops.common import chunk_schedule, dist_pallas_call, jit_shard_map
 from triton_dist_tpu.parallel import topology
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block
@@ -57,6 +57,11 @@ class ReduceScatterConfig:
     block_m: int = 256
     block_n: int = 1024
     method: str | None = None
+    # Ring-step payload granularity (ISSUE 3): > 1 splits each hop's chunk
+    # into that many per-chunk DMAs whose add-pipeline runs the moment each
+    # lands; 1 is the legacy shard-granular staging, bit for bit. Ring
+    # method only (scatter_reduce's puts are single-hop).
+    chunks_per_shard: int = 1
 
 
 def get_auto_reduce_scatter_method(
@@ -140,6 +145,70 @@ def _ring_rs_kernel(
                     send_sems.at[s], recv_sems.at[s],
                 )
             )
+    shmem.quiet(*sends)
+
+
+def _ring_rs_chunked_kernel(
+    x_ref, out_ref, recv_buf, acc_buf, send_sems, recv_sems, sig_sems,
+    *, axis: str, n: int, cfg: ReduceScatterConfig, spans,
+):
+    """Chunk-granular ring reduce-scatter (ISSUE 3 tentpole): the
+    add-pipeline of step ``s`` runs on chunk ``j`` the moment chunk ``j``
+    of the incoming partial lands, and forwards it immediately — per-hop
+    staging exposes one *chunk* of ICI latency, not one m_loc-row shard.
+    chunk=1 dispatches to :func:`_ring_rs_kernel` (bit-identical legacy)."""
+    me = shmem.my_pe(axis)
+    m_loc, n_dim = out_ref.shape
+    bn = pick_block(n_dim, cfg.block_n)
+    adds = [
+        _add2_pipeline(
+            pick_block(rows, cfg.block_m), bn, rows, n_dim, out_ref.dtype
+        )
+        for _, rows in spans
+    ]
+
+    shmem.comm_jitter(axis, salt=6)
+    shmem.barrier_all(axis)
+    right = jax.lax.rem(me + 1, n)
+
+    sends = []
+    # Step 0: own untouched chunk me-1 starts its trip, chunk by chunk.
+    c0base = jax.lax.rem(me - 1 + n, n) * m_loc
+    sends.append(
+        shmem.putmem_signal_chunked_nbi_block(
+            lambda off, rows: recv_buf.at[0, pl.ds(off, rows)],
+            lambda off, rows: x_ref.at[pl.ds(c0base + off, rows)],
+            right, axis,
+            lambda j: send_sems.at[0, j],
+            lambda j: recv_sems.at[0, j],
+            lambda j: sig_sems.at[0, j],
+            spans,
+        )
+    )
+    for s in range(1, n):
+        cbase = jax.lax.rem(me - 1 - s + 2 * n, n) * m_loc
+        handles = []
+        for j, (off, rows) in enumerate(spans):
+            sends[s - 1].wait_recv_chunk(j)  # chunk j of partial landed
+            sl_x = pl.ds(cbase + off, rows)
+            sl = pl.ds(off, rows)
+            if s == n - 1:
+                adds[j](x_ref.at[sl_x], recv_buf.at[s - 1, sl], out_ref.at[sl])
+            else:
+                if s >= 3:
+                    # acc rows were the source of the step s-2 put
+                    sends[s - 2].wait_send_chunk(j)
+                acc = acc_buf.at[s % 2, sl]
+                adds[j](x_ref.at[sl_x], recv_buf.at[s - 1, sl], acc)
+                handles.append(
+                    shmem.putmem_signal2_nbi_block(
+                        recv_buf.at[s, sl], acc, right, axis,
+                        send_sems.at[s, j], recv_sems.at[s, j],
+                        sig_sems.at[s, j],
+                    )
+                )
+        if handles:
+            sends.append(shmem.ChunkedPutHandle(handles))
     shmem.quiet(*sends)
 
 
@@ -295,26 +364,44 @@ def _reduce_scatter_fused(
             m_loc * n_dim * x.dtype.itemsize, n, devices
         )
     n_steps = n - 1
+    chunks = max(1, int(cfg.chunks_per_shard))
+    # quantize spans to the VPU row tile (see chunk_schedule / ag_gemm)
+    spans = chunk_schedule(
+        m_loc, chunks,
+        quantum=pick_block(m_loc, min(cfg.block_m, max(1, m_loc // chunks))),
+    )
+    scratch = [
+        pltpu.SemaphoreType.DMA((n_steps,)),
+        pltpu.SemaphoreType.DMA((n_steps,)),
+    ]
     workspace = [
         jax.ShapeDtypeStruct((n_steps, m_loc, n_dim), x.dtype),  # landing slots
     ]
     if method == "ring":
-        kernel = _ring_rs_kernel
+        kernel = functools.partial(_ring_rs_kernel, axis=axis, n=n, cfg=cfg)
         workspace.append(jax.ShapeDtypeStruct((2, m_loc, n_dim), x.dtype))  # accumulator
+        if len(spans) > 1:
+            # chunk-granular ring staging (scatter_reduce's puts are
+            # single-hop — chunking buys no cross-hop pipelining there)
+            kernel = functools.partial(
+                _ring_rs_chunked_kernel, axis=axis, n=n, cfg=cfg, spans=spans
+            )
+            scratch = [
+                pltpu.SemaphoreType.DMA((n_steps, len(spans))),
+                pltpu.SemaphoreType.DMA((n_steps, len(spans))),
+                pltpu.SemaphoreType.REGULAR((n_steps, len(spans))),
+            ]
     elif method == "scatter_reduce":
-        kernel = _scatter_reduce_kernel
+        kernel = functools.partial(_scatter_reduce_kernel, axis=axis, n=n, cfg=cfg)
     else:
         raise ValueError(f"unknown reduce_scatter method: {method!r}")
     outs = dist_pallas_call(
-        functools.partial(kernel, axis=axis, n=n, cfg=cfg),
+        kernel,
         name=f"reduce_scatter_{method}",
         out_shape=(jax.ShapeDtypeStruct((m_loc, n_dim), x.dtype), *workspace),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY) for _ in range(1 + len(workspace))),
-        scratch_shapes=[
-            pltpu.SemaphoreType.DMA((n_steps,)),
-            pltpu.SemaphoreType.DMA((n_steps,)),
-        ],
+        scratch_shapes=scratch,
         cost_estimate=pl.CostEstimate(
             flops=m_total * n_dim,
             bytes_accessed=(m_total + 3 * n_steps * m_loc) * n_dim * x.dtype.itemsize,
@@ -413,6 +500,10 @@ RS_TUNE_SPACE = (
     ReduceScatterConfig(256, 1024, "ring"),
     ReduceScatterConfig(512, 2048, "ring"),
     ReduceScatterConfig(128, 512, "scatter_reduce"),
+    # chunks_per_shard axis (ISSUE 3): chunk-granular ring staging — after
+    # every chunk=1 candidate so sweep-free walks never apply one untimed
+    ReduceScatterConfig(256, 1024, "ring", chunks_per_shard=2),
+    ReduceScatterConfig(256, 1024, "ring", chunks_per_shard=4),
 )
 
 reduce_scatter_op = contextual_autotune(RS_TUNE_SPACE, name="reduce_scatter")(

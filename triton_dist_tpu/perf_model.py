@@ -169,6 +169,74 @@ def estimate_ag_ring_time_ms(
     return (n_pes - 1) * per_hop
 
 
+def estimate_ring_chunked_time_ms(
+    shard_bytes: int,
+    n_pes: int,
+    chunks_per_shard: int = 1,
+    spec: ChipSpec | None = None,
+) -> float:
+    """Chunk-pipelined store-and-forward ring (ISSUE 3): each ring-step
+    shard moves as ``chunks_per_shard`` independent DMAs forwarded the
+    moment they land, so the ``n-2`` intermediate hops hide behind the
+    chunk stream and the total is ``(n - 2 + chunks)`` stages of one chunk
+    each (the classic wormhole pipeline). ``chunks=1`` reduces exactly to
+    :func:`estimate_ag_ring_time_ms` — the shard-granular schedule this
+    model must stay honest against."""
+    spec = spec or detect_chip()
+    if n_pes <= 1:
+        return 0.0
+    chunks = max(1, int(chunks_per_shard))
+    per_stage = ICI_HOP_LATENCY_MS + (
+        shard_bytes / chunks
+    ) / (2 * spec.ici_gbps_per_link * 1e9) * 1e3
+    return (n_pes - 2 + chunks) * per_stage
+
+
+def estimate_fused_ring_bubble_ms(
+    shard_bytes: int,
+    n_pes: int,
+    chunks_per_shard: int = 1,
+    spec: ChipSpec | None = None,
+) -> float:
+    """Exposed (non-overlappable) comm bubble of a fused ring op whose MXU
+    work dominates: at each of the ``n-1`` hops the MXU stalls only until
+    the FIRST chunk of the next shard lands ≈ one chunk's latency + wire
+    time, not one shard's — the per-chunk bubble term the chunk-granular
+    schedules exist to shrink (ISSUE 3). With ``chunks=1`` this is the
+    shard-granular bubble the legacy schedules expose."""
+    spec = spec or detect_chip()
+    if n_pes <= 1:
+        return 0.0
+    chunks = max(1, int(chunks_per_shard))
+    chunk_wire = (shard_bytes / chunks) / (
+        2 * spec.ici_gbps_per_link * 1e9
+    ) * 1e3
+    return (n_pes - 1) * (ICI_HOP_LATENCY_MS + chunk_wire)
+
+
+def suggest_chunks_per_shard(
+    shard_bytes: int,
+    n_pes: int,
+    spec: ChipSpec | None = None,
+    max_chunks: int = 16,
+) -> int:
+    """Model-driven ``chunks_per_shard`` pick: the power-of-two chunk count
+    minimizing :func:`estimate_ring_chunked_time_ms` (more chunks pipeline
+    hops but pay one per-chunk latency each; tiny shards want 1). A hint
+    for the autotune spaces and the docs' sizing guidance, not a binding
+    choice — the tuner still times the real schedules."""
+    if n_pes <= 2:
+        return 1
+    best, best_t = 1, float("inf")
+    c = 1
+    while c <= max_chunks:
+        t = estimate_ring_chunked_time_ms(shard_bytes, n_pes, c, spec)
+        if t < best_t:
+            best, best_t = c, t
+        c *= 2
+    return best
+
+
 def _mean_ring_distance(n_pes: int) -> float:
     """Exact mean shortest-path hops to the n-1 peers on a wrapped 1-D
     axis: mean over d in 1..n-1 of min(d, n-d)."""

@@ -41,11 +41,14 @@ STATUS_TIMEOUT = 1
 KIND_SIGNAL = 1   # shmem.signal_wait_until
 KIND_WAIT = 2     # shmem.wait (dl.wait parity)
 KIND_BARRIER = 3  # a dissemination-barrier round in shmem.barrier_all
+KIND_CHUNK = 4    # shmem.wait_chunk: a per-chunk arrival wait of a chunked
+                  # put (the sub-shard granularity of the ring pipelines)
 
 _KIND_NAMES = {
     KIND_SIGNAL: "signal_wait_until",
     KIND_WAIT: "wait",
     KIND_BARRIER: "barrier_all",
+    KIND_CHUNK: "chunk_wait",
 }
 
 

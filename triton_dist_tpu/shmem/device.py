@@ -171,13 +171,19 @@ class PutHandle:
     twice deadlocks on real hardware exactly as in the interpreter; the
     record lets :func:`quiet` be safely called on every handle at kernel end
     without double-waiting ones that were recycled mid-loop.
+
+    ``sig_sem``, set by the chunked put family, names the pure signal
+    semaphore that rode along with the data (armed diag scopes only) —
+    :func:`wait_chunk` consumes it through the watchdogged/injectable wait
+    path before the data-coupled recv wait.
     """
 
-    __slots__ = ("desc", "send_waited")
+    __slots__ = ("desc", "send_waited", "sig_sem")
 
-    def __init__(self, desc):
+    def __init__(self, desc, sig_sem=None):
         self.desc = desc
         self.send_waited = False
+        self.sig_sem = sig_sem
 
     def wait_send(self):
         """Wait local completion: the source buffer is reusable after this."""
@@ -234,6 +240,143 @@ def putmem_signal_nbi_block(dst_ref, src_ref, sig_sem, pe, axis: str, send_sem):
     the data (stronger than NVSHMEM, which needs NVSHMEM_SIGNAL_ADD +
     ordering)."""
     return putmem_nbi_block(dst_ref, src_ref, pe, axis, send_sem, recv_sem=sig_sem)
+
+
+class ChunkedPutHandle:
+    """Handle for a shard transfer split into per-chunk puts
+    (:func:`putmem_signal_chunked_nbi_block`).
+
+    Each chunk is its own DMA with its own send/recv semaphore slot, so the
+    consumer can wait — and compute on — chunk ``j`` while chunks ``j+1..``
+    are still in flight. This is the TPU form of the reference's
+    tile-granular progress (``dl.wait`` per M-tile, allgather_gemm.py:226):
+    the readiness flag granularity becomes the DMA granularity.
+    """
+
+    __slots__ = ("chunks",)
+
+    def __init__(self, chunks: "list[PutHandle]"):
+        self.chunks = list(chunks)
+
+    def __len__(self):
+        return len(self.chunks)
+
+    def wait_recv_chunk(self, j: int):
+        """Chunk-aware arrival wait for chunk `j` (see :func:`wait_chunk`)."""
+        wait_chunk(self.chunks[j])
+
+    def wait_send_chunk(self, j: int):
+        """Local completion of chunk `j`'s put: its source rows are
+        reusable. Idempotent at trace time (consuming-wait safety, as
+        :func:`quiet`)."""
+        h = self.chunks[j]
+        if not h.send_waited:
+            h.wait_send()
+
+    def wait_recv(self):
+        """Arrival of the WHOLE shard: chunk waits in order."""
+        for j in range(len(self.chunks)):
+            self.wait_recv_chunk(j)
+
+    def wait_send(self):
+        """Local completion of every chunk's put (skips chunks already
+        waited mid-loop — :func:`quiet` calls this blindly)."""
+        for j in range(len(self.chunks)):
+            self.wait_send_chunk(j)
+
+    def wait(self):
+        self.wait_send()
+        self.wait_recv()
+
+
+def putmem_signal_chunked_nbi_block(
+    dst_at, src_at, pe, axis: str, send_at, recv_at, sig_at, spans,
+    ready=None,
+):
+    """Chunked put + per-chunk signal (≙ one ``putmem_signal_nbi_block`` per
+    sub-shard chunk, reference docs/primitives.md:40 — the producer side of
+    tile-granular progress): split one shard transfer into the static
+    ``spans`` from :func:`ops.common.chunk_schedule`, each chunk pushed as
+    its own DMA whose data-coupled recv semaphore slot signals that chunk's
+    arrival alone.
+
+    ``dst_at(off, rows)`` / ``src_at(off, rows)`` map a span to the ref
+    views to transfer (callers fold their traced shard base offset into the
+    slice — Pallas refs are sliced once, not nested). ``send_at(j)`` /
+    ``recv_at(j)`` / ``sig_at(j)`` map a chunk index to its semaphore slot;
+    slot agreement across PEs is SPMD symmetry, exactly as for the unchunked
+    puts. ``ready(j)``, if given, runs before chunk ``j``'s put starts —
+    ring kernels pass the previous step's ``wait_recv_chunk(j)`` so each
+    chunk is forwarded the moment it lands (wormhole pipelining across
+    hops).
+
+    Inside an armed WATCHDOG scope (``config.timeout_iters > 0`` and a
+    diag scope open — trace-time, so producer and consumer agree) each
+    chunk additionally carries a pure ``signal_op`` on its ``sig_at(j)``
+    slot: that op is the chaos-injection site (drop/dup/delay per
+    FaultPlan) and the bounded-wait site of :func:`wait_chunk`, giving
+    chunk-granular watchdog diagnostics. Without the watchdog no extra
+    signals are issued — the data-coupled recv semaphore is the only (and
+    sufficient) signal, as everywhere else on TPU; a fault plan armed
+    WITHOUT the watchdog must not add a droppable edge whose wait would
+    then be unbounded (chunk-signal chaos requires ``timeout_iters > 0``,
+    like every drop-fault scenario in tests/test_chaos.py).
+    """
+    handles = []
+    for j, (off, rows) in enumerate(spans):
+        if ready is not None:
+            ready(j)
+        handles.append(
+            putmem_signal2_nbi_block(
+                dst_at(off, rows), src_at(off, rows), pe, axis,
+                send_at(j), recv_at(j),
+                sig_at(j) if sig_at is not None else None,
+            )
+        )
+    return ChunkedPutHandle(handles)
+
+
+def putmem_signal2_nbi_block(
+    dst_ref, src_ref, pe, axis: str, send_sem, recv_sem, sig_sem=None
+):
+    """Single-chunk building block of the chunked put family: a
+    ``putmem_nbi_block`` that, inside an armed WATCHDOG scope, also issues
+    the pure per-chunk signal on ``sig_sem`` (the injectable, bounded edge
+    :func:`wait_chunk` consumes; never issued without the watchdog — see
+    :func:`putmem_signal_chunked_nbi_block`). Fused kernels that interleave
+    compute between chunk puts call this directly and aggregate the
+    handles in a :class:`ChunkedPutHandle`."""
+    from triton_dist_tpu.resilience import watchdog as _watchdog
+
+    h = putmem_nbi_block(dst_ref, src_ref, pe, axis, send_sem, recv_sem)
+    if (
+        sig_sem is not None
+        and _watchdog.active() is not None
+        and _watchdog.enabled()
+    ):
+        h.sig_sem = sig_sem
+        signal_op(sig_sem, 1, pe, axis)
+    return h
+
+
+def wait_chunk(handle: "PutHandle"):
+    """Chunk-aware arrival wait (≙ the reference's per-tile ``dl.wait`` +
+    ``dl.consume_token``, allgather_gemm.py:226-227): block until this
+    chunk's data has landed on this PE.
+
+    Two layers, both consuming: when the chunk carried a pure signal (armed
+    diag scope) the signal is waited first through the watchdogged path —
+    bounded by ``config.timeout_iters``, chaos-injectable, recorded as
+    ``KIND_CHUNK`` ("chunk_wait") in the diagnostic buffer on expiry — and
+    then the data-coupled recv semaphore is waited, which is authoritative:
+    data puts cannot be dropped (faults.py), so a lost/duped chunk *signal*
+    either trips the watchdog with a chunk-site record or leaves the result
+    untouched, never corrupts it."""
+    from triton_dist_tpu.resilience import records as _records
+
+    if handle.sig_sem is not None:
+        _wait_or_watchdog(handle.sig_sem, 1, _records.KIND_CHUNK)
+    handle.wait_recv()
 
 
 def getmem_nbi_block(*_args, **_kwargs):
