@@ -116,6 +116,19 @@ def emit(metric, value, unit, vs_baseline):
     )
 
 
+def emit_info(metric, value, unit):
+    """Informational line: deliberately NO vs_baseline key, so
+    scripts/perf_gate.sh never gates it (its parser only collects
+    vs_baseline-bearing lines). Used for the per-stage attribution
+    breakdowns (ISSUE 4), which have no A/B to gate on."""
+    print(
+        json.dumps(
+            {"metric": metric, "value": round(float(value), 3), "unit": unit}
+        ),
+        flush=True,
+    )
+
+
 def bench_gemm_rs(mesh, n):
     """Row-parallel down-proj shape: A [M, K_ffn/n], B [K_ffn/n, N=hidden]."""
     from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs_op
@@ -207,6 +220,35 @@ def bench_all_to_all(mesh, n):
         f"fast_all_to_all_p50_us_ep{n}_m{max_m}h{hidden}",
         t_f * 1e3, "us", ratio,
     )
+
+    # chunk-granular schedule A/B (ISSUE 4): the same slab exchange with
+    # the model-suggested chunks_per_shard, paired against the SAME XLA
+    # baseline as the legacy line — comparing the two emitted ratios
+    # attributes the chunking delta directly. The "_chunked" token routes
+    # the line past the family floor in scripts/perf_gate.sh (explicit
+    # "all_to_all_chunked" floor only): this is a forced experimental
+    # schedule with no on-chip baseline yet, and it must not fail the
+    # gate while the shipped chunk=1 default holds its floor. n > 1 only:
+    # world-1 a2a is the identity — there is no chunked kernel to time.
+    if n > 1:
+        from triton_dist_tpu import perf_model
+        from triton_dist_tpu.ops.all_to_all import A2AConfig
+
+        cs = perf_model.suggest_a2a_chunks_per_shard(
+            max_m * hidden * jnp.dtype(jnp.bfloat16).itemsize, n
+        )
+        cs = max(cs, 2)  # always exercise the chunked kernel in the A/B
+        chunked = lambda t, s: fast_all_to_all_op(
+            t, s, mesh, config=A2AConfig(chunks_per_shard=cs)
+        )
+        chunked(tokens, splits)  # compile before the loop
+        t_c, _, ratio_c = bench_pair(
+            chunked, xla_a2a, (tokens, splits), iters=iters
+        )
+        emit(
+            f"fast_all_to_all_chunked{cs}_p50_us_ep{n}_m{max_m}h{hidden}",
+            t_c * 1e3, "us", ratio_c,
+        )
 
 
 def bench_flash_decode(mesh, n):
@@ -424,6 +466,82 @@ def bench_moe(mesh, n):
         f"moe_mlp_bf16_tflops_per_chip_tp{n}_m{m_tot}e{n_exp}k{topk}",
         tflops, "TFLOPS", ratio,
     )
+
+    # ---- per-stage attribution (ISSUE 4 satellite) ----
+    # Standalone proxies for the three pipeline stages at the real
+    # payload sizes, emitted as informational lines (emit_info: no
+    # vs_baseline, never gated) so a chip session can attribute the MoE
+    # delta — dispatch-bound vs GEMM-bound vs combine-bound — instead of
+    # re-deriving it from whole-op numbers. Best-effort by design: a
+    # stage proxy that cannot build in this environment must not discard
+    # the main line the driver already earned (main() drops ALL of a
+    # metric's lines on rc != 0).
+    try:
+        _bench_moe_stages(mesh, n, m_tot, f_dim, n_exp, topk, x,
+                          w_up, w_down, ids, tw)
+    except Exception as e:  # noqa: BLE001 — attribution is optional
+        import sys
+
+        print(f"[bench moe] stage attribution skipped: {e!r:.200}",
+              file=sys.stderr, flush=True)
+
+
+def _bench_moe_stages(mesh, n, m_tot, f_dim, n_exp, topk, x,
+                      w_up, w_down, ids, tw):
+    from triton_dist_tpu.ops.allgather import all_gather_op
+    from triton_dist_tpu.ops.group_gemm import group_gemm
+    from triton_dist_tpu.ops.moe_utils import (
+        gather_sorted_rows, moe_align_block_size, scatter_add_unsorted,
+    )
+    from triton_dist_tpu.ops.reduce_scatter import reduce_scatter_op
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+
+    gcfg = GroupGemmConfig(8, 32, 32) if _CPU_FALLBACK else GroupGemmConfig()
+    # dispatch: the ring allgather of the per-assignment token payload
+    # (the overlap kernel ships the pre-sorted slab — same bytes/rank
+    # up to alignment padding)
+    xx = jax.device_put(
+        np.repeat(np.asarray(x), topk, axis=0),
+        NamedSharding(mesh, P("tp", None)),
+    )
+    t_disp = perf_func_loop(
+        lambda a: all_gather_op(a, mesh), (xx,), iters=_it(16),
+        consume="first",
+    )
+    # gemm: the two grouped expert GEMMs (+ activation) on this chip's
+    # shard of the FFN dim, over the block-aligned gathered rows
+    al = moe_align_block_size(ids.reshape(-1), n_exp, gcfg.block_m)
+    a_sorted = gather_sorted_rows(jnp.asarray(np.asarray(x)), al, topk)
+    wu_loc = jnp.asarray(np.asarray(w_up)[:, :, : f_dim // n])
+    wd_loc = jnp.asarray(np.asarray(w_down)[: , : f_dim // n, :])
+
+    def gemm_stage(a_s, wu, wd):
+        h1 = group_gemm(a_s, wu, al.expert_ids, config=gcfg)
+        h1 = jax.nn.gelu(h1.astype(jnp.float32)).astype(a_s.dtype)
+        return group_gemm(h1, wd, al.expert_ids, config=gcfg)
+
+    y_sorted = gemm_stage(a_sorted, wu_loc, wd_loc)
+    t_gemm = perf_func_loop(
+        gemm_stage, (a_sorted, wu_loc, wd_loc), iters=_it(16), consume="all"
+    )
+    # combine: topk-weighted scatter-add + the reduce-scatter of the
+    # per-rank partials (n traffic-equivalent copies of this chip's)
+    tw_full = jnp.asarray(np.asarray(tw))
+
+    def combine_stage(y_s, tw_f):
+        partial = scatter_add_unsorted(y_s, al, tw_f, m_tot).astype(
+            jnp.bfloat16
+        )
+        ps = jnp.broadcast_to(partial[None], (n, *partial.shape))
+        return reduce_scatter_op(ps, mesh)
+
+    t_comb = perf_func_loop(
+        combine_stage, (y_sorted, tw_full), iters=_it(16), consume="all"
+    )
+    tag = f"tp{n}_m{m_tot}e{n_exp}k{topk}"
+    emit_info(f"moe_stage_dispatch_us_{tag}", t_disp * 1e3, "us")
+    emit_info(f"moe_stage_gemm_us_{tag}", t_gemm * 1e3, "us")
+    emit_info(f"moe_stage_combine_us_{tag}", t_comb * 1e3, "us")
 
 
 def bench_moe_w8(mesh, n):

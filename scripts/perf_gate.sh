@@ -35,7 +35,13 @@ if not floors:
     print("perf gate: no perf_floors in BASELINE.json — nothing to gate")
     sys.exit(0)
 
-metrics = os.environ.get("TDT_PERF_GATE_METRICS", "").split() or sorted(floors)
+# suffix floors ("<family>_overlap_efficiency", "<family>_chunked") scope
+# specific LINES of a family's run (see the per-line routing below) — they
+# are not bench metric families themselves and must not be enumerated as
+# `bench.py --metric` targets
+_SUFFIXES = ("_overlap_efficiency", "_chunked")
+families = sorted(k for k in floors if not k.endswith(_SUFFIXES))
+metrics = os.environ.get("TDT_PERF_GATE_METRICS", "").split() or families
 
 if os.environ.get("TDT_PERF_GATE_FORCE", "0") != "1":
     # skip cleanly off-chip: bench timings are only meaningful on TPU
@@ -98,9 +104,15 @@ for name in metrics:
         # ratio (serial/fused) than the pair-timed ratio the family floor
         # is calibrated against, so they gate only through an explicit
         # "<family>_overlap_efficiency" floor and are otherwise
-        # informational.
+        # informational. Chunked-schedule A/B lines (ISSUE 4) likewise
+        # gate only through an explicit "<family>_chunked" floor: they
+        # time a forced experimental schedule with no baseline reading
+        # yet, and must not fail the gate while the shipped chunk=1
+        # default holds its own floor.
         if "overlap_efficiency" in rec["metric"]:
             line_floor = floors.get(f"{name}_overlap_efficiency")
+        elif "_chunked" in rec["metric"]:
+            line_floor = floors.get(f"{name}_chunked")
         else:
             line_floor = floor
         if line_floor is None:

@@ -23,7 +23,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from triton_dist_tpu.ops.all_to_all import fast_all_to_all
+from triton_dist_tpu.ops.all_to_all import A2AConfig, fast_all_to_all
 from triton_dist_tpu.ops.grads import fast_all_to_all_grad
 from triton_dist_tpu.ops.moe_utils import MoEAlignment, moe_align_block_size
 
@@ -134,6 +134,11 @@ class EPAll2AllLayer:
     max_m: int
     axis: str = "ep"
     quant: str | None = None
+    # transport schedule knob (ISSUE 4): an A2AConfig with
+    # chunks_per_shard > 1 moves every dispatch/combine slab as
+    # chunk-granular per-(peer, chunk) DMAs; None/chunk=1 is the legacy
+    # whole-slab exchange, bit for bit
+    a2a_config: A2AConfig | None = None
     interpret: Any = None
 
     def _world(self) -> int:
@@ -192,7 +197,7 @@ class EPAll2AllLayer:
             )
             recv_q, recv_splits, meta_r = fast_all_to_all(
                 send_q, clamped, meta=meta, axis=self.axis,
-                interpret=self.interpret,
+                config=self.a2a_config, interpret=self.interpret,
             )
             recv_exp = meta_r[:, : self.max_m]
             r_scale = jax.lax.bitcast_convert_type(
@@ -203,7 +208,8 @@ class EPAll2AllLayer:
             # expert ids ride the splits payload of the SAME a2a — dispatch
             # costs exactly one collective call (VERDICT r1 weak #7)
             recv, recv_splits, recv_exp = fast_all_to_all_grad(
-                send, clamped, send_exp, self.axis, self.interpret
+                send, clamped, send_exp, self.axis, self.interpret,
+                self.a2a_config,
             )
         info = DispatchInfo(
             order=order,
@@ -243,7 +249,8 @@ class EPAll2AllLayer:
         """
         n = self._world()
         back, _, _ = fast_all_to_all_grad(
-            y, info.recv_splits, None, self.axis, self.interpret
+            y, info.recv_splits, None, self.axis, self.interpret,
+            self.a2a_config,
         )
         # slab p row i ↔ sorted assignment offsets[p]+i ↔ assignment order[...]
         # (offsets from the UNCLAMPED counts — they index the sorted
@@ -334,6 +341,9 @@ class HierEPAll2AllLayer:
     # forward), so the router gradient is cut. Phase 2 (fast ICI) stays
     # in the token dtype.
     quant: str | None = None
+    # chunk-granular transport schedule for BOTH phases (ISSUE 4); None /
+    # chunk=1 is the legacy whole-slab exchange (see EPAll2AllLayer)
+    a2a_config: A2AConfig | None = None
     interpret: Any = None
 
     def _dims(self) -> tuple[int, int]:
@@ -409,7 +419,7 @@ class HierEPAll2AllLayer:
             )
             recv1_q, recv_splits1, rmeta1 = fast_all_to_all(
                 send1_q, clamped1, meta=meta1, axis=self.outer,
-                interpret=self.interpret,
+                config=self.a2a_config, interpret=self.interpret,
             )
             k_w = self.max_m1 * self.topk
             rel_ids = rmeta1[:, :k_w].reshape(-1, self.topk)    # [R, topk]
@@ -443,6 +453,7 @@ class HierEPAll2AllLayer:
             )
             recv1, recv_splits1, rmeta1 = fast_all_to_all_grad(
                 send1, clamped1, meta1, self.outer, self.interpret,
+                self.a2a_config,
             )
             rmeta1 = rmeta1.reshape(n_o, 2, self.max_m1, self.topk)
             rel_ids = rmeta1[:, 0].reshape(-1, self.topk)      # [R, topk]
@@ -484,7 +495,8 @@ class HierEPAll2AllLayer:
             jnp.where(g >= 0, g % epr, -1)[order2], mode="drop"
         )
         recv2, recv_splits2, recv_exp2 = fast_all_to_all_grad(
-            send2, clamped2, send_exp2, self.inner, self.interpret
+            send2, clamped2, send_exp2, self.inner, self.interpret,
+            self.a2a_config,
         )
         info = HierDispatchInfo(
             order1=order1, send_splits1=clamped1, send_offsets1=offsets1,
@@ -513,7 +525,8 @@ class HierEPAll2AllLayer:
 
         # reverse phase 2 (inner axis): expert outputs back to the relay
         back2, _, _ = fast_all_to_all_grad(
-            y, info.recv_splits2, None, self.inner, self.interpret
+            y, info.recv_splits2, None, self.inner, self.interpret,
+            self.a2a_config,
         )
         flat2 = back2.reshape(n_i * self.max_m2, h)
         pos2 = jnp.arange(n_i * self.max_m2, dtype=jnp.int32) % self.max_m2
@@ -535,6 +548,7 @@ class HierEPAll2AllLayer:
         back1, _, _ = fast_all_to_all_grad(
             partial.reshape(n_o, self.max_m1, h).astype(y.dtype),
             info.recv_splits1, None, self.outer, self.interpret,
+            self.a2a_config,
         )
         flat1 = back1.reshape(R, h)
         pos1 = jnp.arange(R, dtype=jnp.int32) % self.max_m1

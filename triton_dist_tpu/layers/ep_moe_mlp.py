@@ -85,6 +85,9 @@ class EPMoEMLP:
     # int8/fp8 dispatch wire format (inference only — cuts the router
     # gradient; see EPAll2AllLayer.quant)
     quant: str | None = None
+    # chunk-granular dispatch/combine transport (ISSUE 4; see
+    # EPAll2AllLayer.a2a_config); None/chunk=1 = legacy whole-slab moves
+    a2a_config: Any = None
     interpret: Any = None
 
     def _transport(self):
@@ -97,11 +100,12 @@ class EPMoEMLP:
                 max_m1=self.max_m,
                 max_m2=self.max_m2 or n_o * self.max_m * self.topk,
                 outer=self.outer, inner=self.inner, quant=self.quant,
-                interpret=self.interpret,
+                a2a_config=self.a2a_config, interpret=self.interpret,
             )
         return EPAll2AllLayer(
             n_experts=self.n_experts, topk=self.topk, max_m=self.max_m,
-            axis=self.axis, quant=self.quant, interpret=self.interpret,
+            axis=self.axis, quant=self.quant,
+            a2a_config=self.a2a_config, interpret=self.interpret,
         )
 
     def __call__(

@@ -73,9 +73,19 @@ class A2AConfig:
     row-chunk puts: more descriptors, but chunks can ride different ICI
     routes/engines concurrently and the receiver's first rows land sooner.
     1 (one put per peer) is the latency-optimal default for the small slabs
-    of the MoE dispatch headline shape; the autotuner sweeps it."""
+    of the MoE dispatch headline shape; the autotuner sweeps it.
+
+    ``chunks_per_shard`` (ISSUE 4) is the chunk-GRANULAR form of the same
+    split: per-(peer, chunk) semaphore slots, chunk-major issue order, and
+    a receiver that consumes each peer's payload chunk by chunk through
+    ``shmem.wait_chunk`` — so the chunk-signal watchdog/chaos machinery
+    covers the a2a edges and downstream consumers can overlap on partial
+    slabs. 1 (default) dispatches to the UNCHANGED legacy kernel, bit for
+    bit; >1 supersedes ``puts_per_slab`` (the chunked schedule subsumes
+    it)."""
 
     puts_per_slab: int = 1
+    chunks_per_shard: int = 1
 
 
 def _a2a_kernel(
@@ -120,6 +130,59 @@ def _a2a_kernel(
     for desc in descs:
         desc.wait_recv()
     shmem.quiet(*descs)
+
+
+def _a2a_chunked_kernel(
+    send_ref, splits_ref, recv_ref, rsplits_ref, copy_sems,
+    data_send, data_recv, data_sig, spl_send, spl_recv,
+    *, axis: str, n: int, spans,
+):
+    """Chunk-granular a2a (ISSUE 4 tentpole): each peer's slab moves as
+    ``len(spans)`` independent chunk DMAs on per-(peer, chunk) semaphore
+    slots, issued chunk-major (every peer's chunk j before any chunk j+1 —
+    ``shmem.putmem_signal_chunked_a2a_nbi_block``), and the receiver
+    consumes per-peer payloads chunk by chunk in the same order, so the
+    earliest-landing chunks unblock first and a chunk-signal fault trips
+    the watchdog at a ``chunk_wait`` site instead of corrupting (the
+    chunks=1 schedule is exactly :func:`_a2a_kernel` and is dispatched
+    there)."""
+    me = shmem.my_pe(axis)
+    shmem.comm_jitter(axis, salt=5)
+    # own slab moves locally, riding under the remote chunk rounds
+    c1 = pltpu.make_async_copy(send_ref.at[me], recv_ref.at[me], copy_sems.at[0])
+    c2 = pltpu.make_async_copy(splits_ref.at[me], rsplits_ref.at[me], copy_sems.at[1])
+    c1.start()
+    c2.start()
+    shmem.barrier_all(axis)
+    peers = [jax.lax.rem(me + d, n) for d in range(1, n)]
+    # splits first (tiny): the receiver-side counts land before the bulk
+    spl_descs = [
+        shmem.putmem_nbi_block(
+            rsplits_ref.at[me], splits_ref.at[dst], dst, axis,
+            spl_send.at[d], spl_recv.at[d],
+        )
+        for d, dst in enumerate(peers)
+    ]
+    handles = shmem.putmem_signal_chunked_a2a_nbi_block(
+        lambda i, off, rows, me=me: recv_ref.at[me, pl.ds(off, rows)],
+        lambda i, off, rows: send_ref.at[peers[i], pl.ds(off, rows)],
+        peers, axis,
+        lambda i, j: data_send.at[i, j],
+        lambda i, j: data_recv.at[i, j],
+        lambda i, j: data_sig.at[i, j],
+        spans,
+    )
+    c1.wait()
+    c2.wait()
+    for desc in spl_descs:
+        desc.wait_recv()
+    # Symmetric SPMD: handle i's recv slots count the equal-shaped chunks
+    # arriving from peer me-1-i. Consume chunk-major — the issue order —
+    # so each round's waits release as the round lands.
+    for j in range(len(spans)):
+        for h in handles:
+            h.wait_recv_chunk(j)
+    shmem.quiet(*spl_descs, *handles)
 
 
 def fast_all_to_all(
@@ -202,8 +265,36 @@ def _fast_all_to_all_fused(
             return recv, rsplits
         return recv, rsplits, rpayload[:, 1:].reshape(meta.shape)
     n_steps = n - 1
+    from triton_dist_tpu.ops.common import chunk_schedule
+
+    spans = chunk_schedule(max_m, max(1, int(cfg.chunks_per_shard)))
+    if len(spans) > 1:
+        # chunk-granular schedule (per-(peer, chunk) slots + chunk-major
+        # consumption); chunks_per_shard=1 falls through to the UNCHANGED
+        # legacy kernel below, bit for bit. The sig slots are REGULAR:
+        # only exercised under an armed watchdog (shmem contract).
+        kernel = functools.partial(
+            _a2a_chunked_kernel, axis=axis, n=n, spans=spans
+        )
+        scratch = [
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((n_steps, len(spans))),
+            pltpu.SemaphoreType.DMA((n_steps, len(spans))),
+            pltpu.SemaphoreType.REGULAR((n_steps, len(spans))),
+            pltpu.SemaphoreType.DMA((n_steps,)),
+            pltpu.SemaphoreType.DMA((n_steps,)),
+        ]
+    else:
+        kernel = functools.partial(_a2a_kernel, axis=axis, n=n, chunks=chunks)
+        scratch = [
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((n_steps, chunks)),
+            pltpu.SemaphoreType.DMA((n_steps, chunks)),
+            pltpu.SemaphoreType.DMA((n_steps,)),
+            pltpu.SemaphoreType.DMA((n_steps,)),
+        ]
     recv, rpayload = dist_pallas_call(
-        functools.partial(_a2a_kernel, axis=axis, n=n, chunks=chunks),
+        kernel,
         name="fast_all_to_all",
         out_shape=(
             jax.ShapeDtypeStruct((n, max_m, hidden), tokens.dtype),
@@ -217,13 +308,7 @@ def _fast_all_to_all_fused(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ),
-        scratch_shapes=[
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((n_steps, chunks)),
-            pltpu.SemaphoreType.DMA((n_steps, chunks)),
-            pltpu.SemaphoreType.DMA((n_steps,)),
-            pltpu.SemaphoreType.DMA((n_steps,)),
-        ],
+        scratch_shapes=scratch,
         interpret=interpret,
     )(tokens, payload)
     rsplits = rpayload[:, 0]
@@ -310,12 +395,44 @@ def fast_all_to_all_op(
 
 
 # FIRST entry = best-known default (one put per peer is latency-optimal
-# for the dispatch headline shape; applied sweep-free under cached_or_first)
-A2A_TUNE_SPACE = (A2AConfig(1), A2AConfig(2), A2AConfig(4))
-
-fast_all_to_all_op = contextual_autotune(A2A_TUNE_SPACE, name="fast_all_to_all")(
-    fast_all_to_all_op
+# for the dispatch headline shape; applied sweep-free under cached_or_first).
+# chunks_per_shard axis (ISSUE 4): chunk-granular schedules AFTER every
+# chunk=1 candidate, so the sweep-free walks can never apply one untimed
+# and a sweep only crowns one that beats the legacy leader by the paired
+# margin — the tuner cannot regress (the PR 3 ordering invariant).
+A2A_TUNE_SPACE = (
+    A2AConfig(1),
+    A2AConfig(2),
+    A2AConfig(4),
+    A2AConfig(chunks_per_shard=2),
+    A2AConfig(chunks_per_shard=4),
 )
+
+
+def _a2a_chunk_sensible(cfg, tokens, splits, mesh, *, axis: str = "tp", **_):
+    """Shape guard wiring the perf model into the walk (ISSUE 4
+    satellite): chunked candidates the model calls dominated for this slab
+    size are never timed (nor applied by a sweep-free walk); chunk=1
+    candidates always survive (prune_chunk_candidates keeps the legacy
+    anchor by construction)."""
+    from triton_dist_tpu import perf_model
+
+    if getattr(cfg, "chunks_per_shard", 1) <= 1:
+        return True
+    slab_bytes = (
+        int(tokens.shape[-2]) * int(tokens.shape[-1]) * tokens.dtype.itemsize
+    )
+    return bool(
+        perf_model.prune_chunk_candidates(
+            (cfg,), slab_bytes, int(mesh.shape[axis]),
+            suggest=perf_model.suggest_a2a_chunks_per_shard,
+        )
+    )
+
+
+fast_all_to_all_op = contextual_autotune(
+    A2A_TUNE_SPACE, name="fast_all_to_all", precondition=_a2a_chunk_sensible
+)(fast_all_to_all_op)
 # guard OUTSIDE the autotuner: the sweep still prices failing candidates;
 # only a failure of the whole tuned entry degrades to the XLA golden
 fast_all_to_all_op = resilience.guard_op(

@@ -301,6 +301,201 @@ def _ag_group_gemm_overlap_kernel(
     shmem.quiet(*descs)
 
 
+def _ag_group_gemm_overlap_chunked_kernel(
+    eid_ref, a_ref, b_ref,
+    out_ref, ag_ref,
+    a_all, b_buf, out_stage,
+    copy_sem, send_sems, recv_sems, sig_sems, gsems, bsem, outsem,
+    *, axis: str, n: int, nb: int, n_jn: int, bn: int, bpg: int, bm: int,
+    out_dtype, spans,
+):
+    """Chunk-granular fused ring-AG + grouped GEMM (ISSUE 4 tentpole): the
+    schedule of :func:`_ag_group_gemm_overlap_kernel` with each ring-step
+    shard split into the ``spans`` (quantized to the gather-group size, so
+    every chunk holds whole groups). Step ``s`` waits chunk ``j`` of the
+    previous step, forwards it to the right neighbor immediately, and
+    starts group-GEMM work on ITS expert rows while chunk ``j+1`` is still
+    crossing the ICI — the group-GEMM no longer stalls until the full peer
+    shard arrives, which is the dispatch→GEMM leg of the three-stage MoE
+    pipeline (dispatch of chunk j+1, GEMM of chunk j, combine of j−1
+    concurrently in flight). The only schedule difference vs legacy is
+    that a gather-group DMA is never prefetched across a chunk boundary
+    (its rows may not have landed); the weight-slab prefetch chain is
+    chunk-independent (weights are local) and carries across chunk, group
+    AND step boundaries exactly as in the legacy kernel. ``chunks=1``
+    dispatches to the unchanged legacy kernel."""
+    me = shmem.my_pe(axis)
+    t_pad_loc = nb * bm
+    gq = bpg * bm                       # group quantum: spans align to it
+    n_groups = (nb + bpg - 1) // bpg
+    it_counter = [0]
+
+    local = pltpu.make_async_copy(
+        a_ref, ag_ref.at[pl.ds(me * t_pad_loc, t_pad_loc)], copy_sem
+    )
+    local.start()
+    local.wait()
+    shmem.barrier_all(axis)
+    right = jax.lax.rem(me + 1, n)
+
+    pltpu.make_async_copy(
+        b_ref.at[eid_ref[me, 0], :, pl.ds(0, bn)], b_buf.at[0], bsem.at[0]
+    ).start()
+    slot_carry = [jnp.int32(1)]  # traced carry: _iter's weight buffer slot
+
+    descs = []
+    for s in range(n):
+        c = jax.lax.rem(me - s + 2 * n, n)
+
+        def _group_desc(g, slot, c=c):
+            base = g * bpg * bm
+            cnt = min(bpg * bm, t_pad_loc - base)
+            return pltpu.make_async_copy(
+                ag_ref.at[pl.ds(c * t_pad_loc + base, cnt), :],
+                a_all.at[slot, pl.ds(0, cnt), :],
+                gsems.at[slot],
+            )
+
+        chunk_handles = []
+        for j, (off, rows) in enumerate(spans):
+            if s > 0:
+                descs[s - 1].wait_recv_chunk(j)  # landed during step s-1
+            if s < n - 1:
+                # forward chunk j before computing on it (wormhole
+                # pipelining across hops, as _ring_1d_chunked_kernel)
+                sl = pl.ds(c * t_pad_loc + off, rows)
+                chunk_handles.append(
+                    shmem.putmem_signal2_nbi_block(
+                        ag_ref.at[sl], ag_ref.at[sl], right, axis,
+                        send_sems.at[s, j], recv_sems.at[s, j],
+                        sig_sems.at[s, j],
+                    )
+                )
+            g_lo = off // gq
+            g_hi = n_groups if j == len(spans) - 1 else (off + rows) // gq
+            _group_desc(g_lo, g_lo % 2).start()
+            for g in range(g_lo, g_hi):  # python: group sizes are static
+                gslot = g % 2
+                if g + 1 < g_hi:
+                    # within-chunk prefetch only: a cross-chunk group's
+                    # rows are not guaranteed landed yet
+                    _group_desc(g + 1, 1 - gslot).start()
+                _group_desc(g, gslot).wait()
+                nb_g = min(bpg, nb - g * bpg)
+
+                # boundary weight prefetch target (chunk-independent — the
+                # weight bank is local HBM), exactly as legacy
+                if g + 1 < n_groups:
+                    e_next = eid_ref[c, (g + 1) * bpg]
+                elif s + 1 < n:
+                    c_next = jax.lax.rem(me - (s + 1) + 2 * n, n)
+                    e_next = eid_ref[c_next, 0]
+                else:
+                    e_next = None
+                it_base = it_counter[0]
+
+                def _iter(i, slot, g=g, gslot=gslot, nb_g=nb_g,
+                          it_base=it_base, e_next=e_next, c=c):
+                    jn = i // nb_g
+                    b_rel = jax.lax.rem(i, nb_g)
+                    b = g * bpg + b_rel
+                    e = eid_ref[c, b]
+                    prev_rel = jax.lax.rem(jax.lax.max(i - 1, 0), nb_g)
+                    fresh = jnp.logical_or(
+                        i == 0,
+                        jnp.logical_or(
+                            jn != jax.lax.max(i - 1, 0) // nb_g,
+                            e != eid_ref[c, g * bpg + prev_rel],
+                        ),
+                    )
+                    slot = jnp.where(fresh, 1 - slot, slot)
+
+                    @pl.when(fresh)
+                    def _():
+                        pltpu.make_async_copy(
+                            b_ref.at[e, :, pl.ds(jn * bn, bn)],
+                            b_buf.at[slot],
+                            bsem.at[slot],
+                        ).wait()
+
+                    # prefetch the NEXT distinct weight slab while this
+                    # dot runs (carries across chunk/group/step bounds)
+                    nxt = i + 1
+                    jn2 = nxt // nb_g
+                    b2 = jax.lax.rem(nxt, nb_g)
+                    e2 = eid_ref[c, g * bpg + jax.lax.min(b2, nb_g - 1)]
+                    fresh2 = jnp.logical_and(
+                        nxt < nb_g * n_jn,
+                        jnp.logical_or(jn2 != jn, e2 != e),
+                    )
+                    jn2v = jn2
+                    if e_next is not None:
+                        boundary = nxt >= nb_g * n_jn
+                        e2 = jnp.where(boundary, e_next, e2)
+                        jn2v = jnp.where(boundary, 0, jn2)
+                        fresh2 = jnp.logical_or(fresh2, boundary)
+
+                    @pl.when(fresh2)
+                    def _():
+                        pltpu.make_async_copy(
+                            b_ref.at[e2, :, pl.ds(jn2v * bn, bn)],
+                            b_buf.at[1 - slot],
+                            bsem.at[1 - slot],
+                        ).start()
+
+                    y = jnp.dot(
+                        a_all[gslot, pl.ds(b_rel * bm, bm), :],
+                        b_buf[slot],
+                        preferred_element_type=jnp.float32,
+                    )
+                    gi = it_base + i
+                    oslot = jax.lax.rem(gi, 2)
+
+                    @pl.when(gi >= 2)
+                    def _():
+                        pltpu.make_async_copy(
+                            out_stage.at[pl.ds(oslot * bm, bm), :],
+                            out_ref.at[
+                                pl.ds(c * t_pad_loc + b * bm, bm),
+                                pl.ds(jn * bn, bn),
+                            ],
+                            outsem.at[oslot],
+                        ).wait()
+
+                    out_stage[pl.ds(oslot * bm, bm), :] = y.astype(out_dtype)
+                    pltpu.make_async_copy(
+                        out_stage.at[pl.ds(oslot * bm, bm), :],
+                        out_ref.at[
+                            pl.ds(c * t_pad_loc + b * bm, bm),
+                            pl.ds(jn * bn, bn),
+                        ],
+                        outsem.at[oslot],
+                    ).start()
+                    return slot
+
+                slot_carry[0] = jax.lax.fori_loop(
+                    0, nb_g * n_jn, _iter, slot_carry[0]
+                )
+                it_counter[0] += nb_g * n_jn
+        if s < n - 1:
+            descs.append(shmem.ChunkedPutHandle(chunk_handles))
+
+    total_iters = n * nb * n_jn
+
+    def _drain(oslot):
+        pltpu.make_async_copy(
+            out_stage.at[pl.ds(oslot * bm, bm), :],
+            out_ref.at[pl.ds(0, bm), pl.ds(0, bn)],
+            outsem.at[oslot],
+        ).wait()
+
+    if total_iters >= 1:
+        _drain((total_iters - 1) % 2)
+    if total_iters >= 2:
+        _drain(total_iters % 2)
+    shmem.quiet(*descs)
+
+
 def presort_local_rows(a: jax.Array, ral: RankedAlignment, axis: str) -> jax.Array:
     """This rank's block-aligned slab ``[t_pad_loc, K]``: one fused XLA
     gather (HBM-bandwidth pass). Sentinel rows clamp to row 0 of the own
@@ -370,11 +565,40 @@ def ag_group_gemm_overlap(
         + 2 * 2 * bm * bn * jnp.dtype(out_dtype).itemsize
         + 4 * 2**20
     )
-    out, ag = dist_pallas_call(
-        functools.partial(
+    from triton_dist_tpu.ops.common import chunk_schedule
+
+    # chunk-granular ring (ISSUE 4): spans quantized to the gather-group
+    # size so every chunk holds whole groups (the unit the compute loop
+    # consumes); a schedule that collapses to one span — including every
+    # chunks_per_shard=1 config — dispatches to the UNCHANGED legacy
+    # kernel, bit for bit
+    spans = chunk_schedule(
+        t_pad_loc, max(1, int(getattr(cfg, "chunks_per_shard", 1))),
+        quantum=bpg * bm,
+    )
+    if len(spans) > 1:
+        kernel = functools.partial(
+            _ag_group_gemm_overlap_chunked_kernel, axis=axis, n=n, nb=nb,
+            n_jn=n_jn, bn=bn, bpg=bpg, bm=bm, out_dtype=out_dtype,
+            spans=spans,
+        )
+        ring_scratch = [
+            pltpu.SemaphoreType.DMA((max(n - 1, 1), len(spans))),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1), len(spans))),
+            # pure chunk-signal slots (REGULAR; armed watchdog only)
+            pltpu.SemaphoreType.REGULAR((max(n - 1, 1), len(spans))),
+        ]
+    else:
+        kernel = functools.partial(
             _ag_group_gemm_overlap_kernel, axis=axis, n=n, nb=nb,
             n_jn=n_jn, bn=bn, bpg=bpg, bm=bm, out_dtype=out_dtype,
-        ),
+        )
+        ring_scratch = [
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+        ]
+    out, ag = dist_pallas_call(
+        kernel,
         name="ag_group_gemm_overlap",
         out_shape=(
             jax.ShapeDtypeStruct((n * t_pad_loc, n_loc), out_dtype),
@@ -397,8 +621,7 @@ def ag_group_gemm_overlap(
             pltpu.VMEM((2, k_dim, bn), b.dtype),
             pltpu.VMEM((2 * bm, bn), out_dtype),
             pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
-            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            *ring_scratch,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),

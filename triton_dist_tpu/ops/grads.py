@@ -444,13 +444,14 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, overlap, res, dout):
 tp_moe_mlp_grad.defvjp(_tp_moe_fwd, _tp_moe_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def fast_all_to_all_grad(
     tokens: jax.Array,
     splits: jax.Array,
     meta: jax.Array | None = None,
     axis: str = "tp",
     interpret: Any = None,
+    config: Any = None,
 ):
     """Differentiable padded-slab all-to-all (call inside shard_map).
 
@@ -459,12 +460,15 @@ def fast_all_to_all_grad(
     its VJP is the SAME exchange applied to the output cotangent — one
     fused collective each way. splits/meta are integer bookkeeping and
     carry zero cotangents. Always returns ``(recv, recv_splits,
-    recv_meta-or-None)``.
+    recv_meta-or-None)``. `config` (an ``A2AConfig``; e.g. a chunk-granular
+    schedule, ISSUE 4) applies to BOTH directions — forward and cotangent
+    exchange ride the same kernel family.
     """
     from triton_dist_tpu.ops.all_to_all import fast_all_to_all
 
     out = fast_all_to_all(
-        tokens, splits, meta=meta, axis=axis, interpret=interpret
+        tokens, splits, meta=meta, axis=axis, config=config,
+        interpret=interpret,
     )
     if meta is None:
         recv, rs = out
@@ -472,20 +476,20 @@ def fast_all_to_all_grad(
     return out
 
 
-def _a2a_fwd(tokens, splits, meta, axis, interpret):
-    out = fast_all_to_all_grad(tokens, splits, meta, axis, interpret)
+def _a2a_fwd(tokens, splits, meta, axis, interpret, config):
+    out = fast_all_to_all_grad(tokens, splits, meta, axis, interpret, config)
     # only static shapes are needed for the float0 zeros — don't keep the
     # integer arrays alive across the forward/backward gap
     return out, (out[1], splits.shape, None if meta is None else meta.shape)
 
 
-def _a2a_bwd(axis, interpret, res, cots):
+def _a2a_bwd(axis, interpret, config, res, cots):
     from triton_dist_tpu.ops.all_to_all import fast_all_to_all
 
     recv_splits, splits_shape, meta_shape = res
     d_recv = cots[0]  # cotangent dtype matches the primal tokens dtype
     dx, _ = fast_all_to_all(
-        d_recv, recv_splits, axis=axis, interpret=interpret
+        d_recv, recv_splits, axis=axis, config=config, interpret=interpret
     )
     d_splits = np.zeros(splits_shape, jax.dtypes.float0)
     d_meta = None if meta_shape is None else np.zeros(meta_shape, jax.dtypes.float0)
@@ -616,16 +620,46 @@ TP_MOE_TUNE_SPACE = (
     GroupGemmConfig(128, 2048, 512),
     GroupGemmConfig(128, 512, 512),
     GroupGemmConfig(128, 1024, 1024),
+    # chunks_per_shard axis (ISSUE 4): chunk-granular EP overlap — the
+    # overlapped pipeline's ring ships each rank's aligned slab as
+    # per-chunk DMAs consumed group-by-group, and the combine pushes
+    # retire chunked. AFTER every chunk=1 candidate (PR 3's ordering
+    # invariant): sweep-free walks can never apply one untimed, so the
+    # tuner cannot regress below today's schedules.
+    GroupGemmConfig(512, 1024, 512, chunks_per_shard=2),
+    GroupGemmConfig(512, 1024, 512, chunks_per_shard=4),
+    GroupGemmConfig(256, 1024, 1024, chunks_per_shard=2),
+    GroupGemmConfig(128, 1024, 512, chunks_per_shard=2),
 )
 
-def _moe_block_sensible(cfg, x, w_up, w_down, topk_ids, topk_weights, *a, **k):
+def _moe_block_sensible(cfg, x, w_up, w_down, topk_ids, topk_weights,
+                        mesh=None, *a, axis: str = "tp", **k):
     """Shape guard for the sweep-free walk: block_m is also the alignment
     block, so each active expert pads to a block_m multiple — expected
     E·block_m/2 padding rows. Candidates whose expected padding exceeds
     ~25% of the problem's t = tokens·topk real rows are never sensible,
-    however fast their tiles; the 128-row entries always stay viable."""
+    however fast their tiles; the 128-row entries always stay viable.
+
+    Chunked candidates additionally pass the perf model's pruning hook
+    (ISSUE 4 satellite): the ring suggester prices the per-rank aligned
+    slab this problem would ship per ring step — chunk counts it calls
+    dominated are never timed nor applied; chunk=1 candidates always
+    survive."""
     t = topk_ids.shape[0] * topk_ids.shape[1]
-    return cfg.block_m <= 128 or w_up.shape[0] * cfg.block_m <= t // 2
+    if cfg.block_m > 128 and w_up.shape[0] * cfg.block_m > t // 2:
+        return False
+    if getattr(cfg, "chunks_per_shard", 1) <= 1 or mesh is None:
+        return True
+    from triton_dist_tpu import perf_model
+
+    n = int(mesh.shape[axis]) if axis in mesh.shape else int(mesh.devices.size)
+    # per-rank ring-step payload: the block-aligned slab ≈ (local
+    # assignments + expert padding) × hidden bytes
+    t_pad_loc = t // max(n, 1) + w_up.shape[0] * cfg.block_m // 2
+    shard_bytes = t_pad_loc * x.shape[1] * x.dtype.itemsize
+    return bool(
+        perf_model.prune_chunk_candidates((cfg,), shard_bytes, n)
+    )
 
 
 tp_moe_mlp_op = contextual_autotune(

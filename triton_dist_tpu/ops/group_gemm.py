@@ -32,6 +32,13 @@ class GroupGemmConfig:
     block_m: int = 128  # must equal the alignment block size
     block_n: int = 1024
     block_k: int = 512
+    # Chunk-granular MoE overlap (ISSUE 4): the OVERLAPPED pipeline kernels
+    # (ag_group_gemm_overlap ring + moe_reduce_rs_overlap combine pushes)
+    # split each ring-step shard / combine slab into this many per-chunk
+    # DMAs consumed the moment each lands. 1 (default) dispatches to the
+    # unchanged legacy kernels bit for bit; the grid-based group_gemm and
+    # the sequential compositions ignore it (nothing to chunk there).
+    chunks_per_shard: int = 1
 
 
 def _group_gemm_kernel(

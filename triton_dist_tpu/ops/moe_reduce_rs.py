@@ -277,6 +277,187 @@ def _moe_reduce_rs_overlap_kernel(
     )
 
 
+def _moe_reduce_rs_overlap_chunked_kernel(
+    eid_ref, h_ref, w_ref, dst_ref, wrow_ref,
+    out_ref, own_buf, landing,
+    h_buf, w_buf, push_stage, ids_v, w_v, partial_ref,
+    hsem, wsem, metasem, stage_sems, local_sem, recv_sems, sig_sems,
+    *, axis: str, n: int, nb: int, n_jn: int, bn: int, m_out: int,
+    out_dtype, spans,
+):
+    """Chunk-granular combine side of the fused MoE down-projection
+    (ISSUE 4 tentpole): the schedule of :func:`_moe_reduce_rs_overlap_kernel`
+    with every retired (destination, H-slab) output block pushed as the
+    ``spans`` chunk DMAs (``shmem.putmem_signal_chunked_nbi_block``) on
+    per-(step, slab, chunk) semaphore slots — the first bytes of a
+    finished slab are on the wire while the accumulator's copy of the
+    later rows still drains, the chunks ride distinct routes, and the
+    receiver's final reduction consumes each landing chunk by chunk
+    through ``wait_chunk`` (so a dropped chunk signal surfaces as a
+    ``chunk_wait`` diagnostic, never corruption). Compute schedule —
+    GEMMs, one-hot combine, slab retirement order — is identical to
+    legacy; ``chunks=1`` (or world-1) dispatches there."""
+    me = shmem.my_pe(axis)
+    t_pad_tot, f_loc = h_ref.shape
+    t_pad_loc = t_pad_tot // n
+    bm = t_pad_loc // nb
+    cdt = h_ref.dtype
+    shmem.barrier_all(axis)  # n >= 2: the host entry dispatches chunked
+    # schedules only on multi-PE worlds
+
+    def _issue_h(c, b, slot):
+        pltpu.make_async_copy(
+            h_ref.at[pl.ds(c * t_pad_loc + b * bm, bm), :],
+            h_buf.at[slot],
+            hsem.at[slot],
+        ).start()
+
+    pending = {}       # pslot -> send-side drain closure (slot reuse)
+    push_handles = {}  # step s -> [ChunkedPutHandle per jn]
+    for s in range(n):
+        # own chunk LAST: remote pushes get the whole kernel to land
+        c = jax.lax.rem(me + 1 + s, n)
+        ids_cp = pltpu.make_async_copy(dst_ref.at[c], ids_v, metasem)
+        ids_cp.start()
+        w_cp = pltpu.make_async_copy(wrow_ref.at[c], w_v, metasem)
+        w_cp.start()
+        ids_cp.wait()
+        w_cp.wait()
+
+        for jn in range(n_jn):
+            partial_ref[:] = jnp.zeros_like(partial_ref)
+            e0 = eid_ref[c, 0]
+            pltpu.make_async_copy(
+                w_ref.at[e0, :, pl.ds(jn * bn, bn)], w_buf.at[0], wsem.at[0]
+            ).start()
+            _issue_h(c, 0, 0)
+
+            def _blk(b, slot):
+                e = eid_ref[c, b]
+                e_prev = eid_ref[c, jax.lax.max(b - 1, 0)]
+                fresh = jnp.logical_or(b == 0, e != e_prev)
+                slot = jnp.where(fresh, 1 - slot, slot)
+
+                @pl.when(fresh)
+                def _():
+                    pltpu.make_async_copy(
+                        w_ref.at[e, :, pl.ds(jn * bn, bn)],
+                        w_buf.at[slot],
+                        wsem.at[slot],
+                    ).wait()
+
+                e2 = eid_ref[c, jax.lax.min(b + 1, nb - 1)]
+
+                @pl.when(jnp.logical_and(b + 1 < nb, e2 != e))
+                def _():
+                    pltpu.make_async_copy(
+                        w_ref.at[e2, :, pl.ds(jn * bn, bn)],
+                        w_buf.at[1 - slot],
+                        wsem.at[1 - slot],
+                    ).start()
+
+                hslot = jax.lax.rem(b, 2)
+                pltpu.make_async_copy(
+                    h_ref.at[pl.ds(0, bm), :], h_buf.at[hslot], hsem.at[hslot]
+                ).wait()
+
+                @pl.when(b + 1 < nb)
+                def _():
+                    pltpu.make_async_copy(
+                        h_ref.at[
+                            pl.ds(c * t_pad_loc + (b + 1) * bm, bm), :
+                        ],
+                        h_buf.at[1 - hslot],
+                        hsem.at[1 - hslot],
+                    ).start()
+
+                y = jnp.dot(
+                    h_buf[hslot],
+                    w_buf[slot],
+                    preferred_element_type=jnp.float32,
+                )
+                d = ids_v[b]
+                w_r = w_v[b]
+                sel = jax.lax.broadcasted_iota(
+                    jnp.int32, (m_out, bm), 0
+                ) == d[None, :]
+                scat = jnp.where(sel, w_r[None, :], 0.0).astype(cdt)
+                partial_ref[:] += jnp.dot(
+                    scat, y.astype(cdt), preferred_element_type=jnp.float32
+                )
+                return slot
+
+            jax.lax.fori_loop(0, nb, _blk, jnp.int32(1))
+
+            pc = s * n_jn + jn
+            pslot = pc % 2
+            if pc >= 2:
+                pending.pop(pslot)()  # send-side completion before reuse
+            push_stage[pslot] = partial_ref[:].astype(out_dtype)
+            if s < n - 1:
+                # combine-side chunked put: the retired slab ships as
+                # per-chunk DMAs on per-(s, jn, chunk) slots; landing slot
+                # s is the sender-distance convention of the legacy kernel
+                handle = shmem.putmem_signal_chunked_nbi_block(
+                    lambda off, rows, s=s, jn=jn: landing.at[
+                        s, pl.ds(off, rows), pl.ds(jn * bn, bn)
+                    ],
+                    lambda off, rows, pslot=pslot: push_stage.at[
+                        pslot, pl.ds(off, rows)
+                    ],
+                    c, axis,
+                    lambda j, pslot=pslot: stage_sems.at[pslot, j],
+                    lambda j, s=s, jn=jn: recv_sems.at[s, jn, j],
+                    lambda j, s=s, jn=jn: sig_sems.at[s, jn, j],
+                    spans,
+                )
+                push_handles.setdefault(s, []).append(handle)
+                pending[pslot] = handle.wait_send
+            else:
+                cp = pltpu.make_async_copy(
+                    push_stage.at[pslot],
+                    own_buf.at[:, pl.ds(jn * bn, bn)],
+                    local_sem.at[pslot],
+                )
+                cp.start()
+                pending[pslot] = cp.wait
+
+    for drain in pending.values():
+        drain()
+
+    # consume every incoming slab chunk by chunk (the handle's recv side
+    # observes the equal-shaped chunks from the mirror sender, SPMD
+    # symmetry — and its sig slot routes through the watchdogged
+    # chunk_wait path when armed), then one n-way f32 reduction pass
+    for d in range(n - 1):
+        for jn in range(n_jn):
+            for j in range(len(spans)):
+                push_handles[d][jn].wait_recv_chunk(j)
+
+    h_dim = out_ref.shape[1]
+    bmo = pick_block(m_out, 256)
+    bno = pick_block(h_dim, 1024)
+
+    def reduce_body(*blks):
+        o_blk = blks[-1]
+        acc = blks[0][:].astype(jnp.float32)
+        for r in blks[1:-1]:
+            acc = acc + r[:].astype(jnp.float32)
+        o_blk[:] = acc.astype(out_dtype)
+
+    blk = lambda i, j: (i, j)  # noqa: E731
+    pltpu.emit_pipeline(
+        reduce_body,
+        grid=(m_out // bmo, h_dim // bno),
+        in_specs=[pl.BlockSpec((bmo, bno), blk)] * n,
+        out_specs=[pl.BlockSpec((bmo, bno), blk)],
+    )(
+        own_buf,
+        *(landing.at[d] for d in range(n - 1)),
+        out_ref,
+    )
+
+
 def moe_reduce_rs_overlap(
     h_sorted: jax.Array,
     w_down: jax.Array,
@@ -315,11 +496,40 @@ def moe_reduce_rs_overlap(
         jax.ShapeDtypeStruct((m_out, h_dim), out_dtype),            # own_buf
         jax.ShapeDtypeStruct((max(n - 1, 1), m_out, h_dim), out_dtype),
     ]
-    outs = dist_pallas_call(
-        functools.partial(
+    from triton_dist_tpu.ops.common import chunk_schedule
+
+    # combine-side chunk schedule (ISSUE 4): spans over the pushed slab's
+    # m_out rows, quantized to 128 so every chunk boundary stays
+    # tile-aligned in VMEM/HBM for any dtype; a single-span schedule —
+    # including every chunks_per_shard=1 config and world-1 — dispatches
+    # to the UNCHANGED legacy kernel, bit for bit
+    spans = chunk_schedule(
+        m_out, max(1, int(getattr(cfg, "chunks_per_shard", 1))) if n > 1 else 1,
+        quantum=128,
+    )
+    if len(spans) > 1:
+        kernel = functools.partial(
+            _moe_reduce_rs_overlap_chunked_kernel, axis=axis, n=n, nb=nb,
+            n_jn=n_jn, bn=bn, m_out=m_out, out_dtype=out_dtype, spans=spans,
+        )
+        push_scratch = [
+            pltpu.SemaphoreType.DMA((2, len(spans))),   # stage_sems
+            pltpu.SemaphoreType.DMA((2,)),              # local_sem
+            pltpu.SemaphoreType.DMA((max(n - 1, 1), n_jn, len(spans))),
+            # pure chunk-signal slots (REGULAR; armed watchdog only)
+            pltpu.SemaphoreType.REGULAR((max(n - 1, 1), n_jn, len(spans))),
+        ]
+    else:
+        kernel = functools.partial(
             _moe_reduce_rs_overlap_kernel, axis=axis, n=n, nb=nb,
             n_jn=n_jn, bn=bn, m_out=m_out, out_dtype=out_dtype,
-        ),
+        )
+        push_scratch = [
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1), n_jn)),
+        ]
+    outs = dist_pallas_call(
+        kernel,
         name="moe_reduce_rs_overlap",
         out_shape=(
             jax.ShapeDtypeStruct((m_out, h_dim), out_dtype),
@@ -348,8 +558,7 @@ def moe_reduce_rs_overlap(
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((max(n - 1, 1), n_jn)),
+            *push_scratch,
         ],
         cost_estimate=pl.CostEstimate(
             flops=2 * t_pad_tot * f_loc * h_dim

@@ -237,6 +237,106 @@ def suggest_chunks_per_shard(
     return best
 
 
+def estimate_a2a_chunked_time_ms(
+    slab_bytes: int,
+    n_pes: int,
+    chunks_per_shard: int = 1,
+    spec: ChipSpec | None = None,
+) -> float:
+    """Chunk-granular padded-slab all-to-all (ISSUE 4): every PE still
+    injects ``(n-1) * slab`` bytes through ~2 engaged link pairs, but the
+    transfer is issued as ``chunks_per_shard`` rounds of per-peer chunk
+    DMAs (chunk-major, ``shmem.putmem_signal_chunked_a2a_nbi_block``) —
+    each extra round pays one descriptor/hop latency while the wire time
+    stays the injection total. ``chunks=1`` reduces exactly to
+    :func:`estimate_all_to_all_time_ms` plus the single hop latency — the
+    shard-granular schedule this model must stay honest against."""
+    if n_pes <= 1:
+        return 0.0
+    chunks = max(1, int(chunks_per_shard))
+    return chunks * ICI_HOP_LATENCY_MS + estimate_all_to_all_time_ms(
+        slab_bytes, n_pes, spec
+    )
+
+
+def estimate_a2a_chunk_bubble_ms(
+    slab_bytes: int,
+    n_pes: int,
+    chunks_per_shard: int = 1,
+    spec: ChipSpec | None = None,
+) -> float:
+    """Exposed dispatch bubble of the chunk-granular EP pipeline: a
+    chunk-consuming group-GEMM stalls only until the FIRST chunk of a
+    peer's slab lands ≈ one hop latency + one chunk's wire time, not one
+    slab's — the term the chunked a2a exists to shrink (the a2a analogue
+    of :func:`estimate_fused_ring_bubble_ms`). ``chunks=1`` is the
+    whole-slab bubble the legacy schedule exposes."""
+    spec = spec or detect_chip()
+    if n_pes <= 1:
+        return 0.0
+    chunks = max(1, int(chunks_per_shard))
+    chunk_wire = (slab_bytes / chunks) / (
+        2 * spec.ici_gbps_per_link * 1e9
+    ) * 1e3
+    return ICI_HOP_LATENCY_MS + chunk_wire
+
+
+def suggest_a2a_chunks_per_shard(
+    slab_bytes: int,
+    n_pes: int,
+    spec: ChipSpec | None = None,
+    max_chunks: int = 8,
+) -> int:
+    """Model-driven ``chunks_per_shard`` pick for the a2a/EP family: the
+    power-of-two count minimizing completion + exposed bubble
+    (``C·lat + wire + lat + slab/C/bw`` — more chunks shrink the
+    consumer's first-chunk wait but pay one issue latency each; tiny
+    slabs want 1). A hint for tune-space pruning
+    (:func:`prune_chunk_candidates`), not a binding choice — the tuner
+    still times the real schedules."""
+    if n_pes <= 1:
+        return 1
+    best, best_t = 1, float("inf")
+    c = 1
+    while c <= max_chunks:
+        t = estimate_a2a_chunked_time_ms(
+            slab_bytes, n_pes, c, spec
+        ) + estimate_a2a_chunk_bubble_ms(slab_bytes, n_pes, c, spec)
+        if t < best_t:
+            best, best_t = c, t
+        c *= 2
+    return best
+
+
+def prune_chunk_candidates(
+    space,
+    shard_bytes: int,
+    n_pes: int,
+    spec: ChipSpec | None = None,
+    suggest=None,
+):
+    """Tune-space pruning hook (ISSUE 4 satellite): filter chunked
+    candidates the model calls obviously dominated for this problem —
+    every chunked candidate when the suggester says 1 (per-chunk latency
+    swamps the pipelining), otherwise counts beyond 2× the suggestion
+    (past the optimum the extra rounds only add latency). ``chunk=1``
+    candidates ALWAYS survive, in their original positions, so the
+    no-regression ordering invariant (every chunk=1 candidate before any
+    chunked one) is preserved by construction and the sweep-free walks
+    keep their proven legacy leader.
+
+    `suggest` defaults to the ring model
+    (:func:`suggest_chunks_per_shard`); a2a spaces pass
+    :func:`suggest_a2a_chunks_per_shard`."""
+    suggest = suggest or suggest_chunks_per_shard
+    s = int(suggest(shard_bytes, n_pes, spec))
+    return tuple(
+        cfg for cfg in space
+        if getattr(cfg, "chunks_per_shard", 1) <= 1
+        or (s > 1 and getattr(cfg, "chunks_per_shard", 1) <= 2 * s)
+    )
+
+
 def _mean_ring_distance(n_pes: int) -> float:
     """Exact mean shortest-path hops to the n-1 peers on a wrapped 1-D
     axis: mean over d in 1..n-1 of min(d, n-d)."""

@@ -336,6 +336,52 @@ def putmem_signal_chunked_nbi_block(
     return ChunkedPutHandle(handles)
 
 
+def putmem_signal_chunked_a2a_nbi_block(
+    dst_at, src_at, peers, axis: str, send_at, recv_at, sig_at, spans
+):
+    """Peer-direct chunked all-to-all put (≙ the per-peer
+    ``putmem_signal_nbi_block`` loop of the reference's LL dispatch,
+    low_latency_all_to_all.py:94-118, at tile granularity): push a distinct
+    per-peer payload to EVERY peer, each split into the static ``spans``
+    from :func:`ops.common.chunk_schedule`, on per-(peer, chunk) semaphore
+    slots.
+
+    Issue order is CHUNK-MAJOR — every peer's chunk ``j`` is started before
+    any peer's chunk ``j+1`` — so the earliest chunks ride the distinct
+    hardware routes to all peers concurrently and each receiver's FIRST
+    chunk lands as soon as the wire allows; a chunk-granular consumer
+    (:class:`ChunkedPutHandle.wait_recv_chunk`) starts computing on it
+    while the later rounds are still in flight. This is the a2a form of
+    the ring families' wormhole pipelining: there are no multi-hop
+    forwards to pipeline (puts are hardware-routed in one hop), the win is
+    first-chunk latency and per-round route concurrency.
+
+    ``dst_at(i, off, rows)`` / ``src_at(i, off, rows)`` map (peer index
+    into `peers`, span) to the ref views; ``send_at(i, j)`` /
+    ``recv_at(i, j)`` / ``sig_at(i, j)`` map (peer index, chunk) to
+    semaphore slots — slot agreement across PEs is SPMD symmetry, exactly
+    as for the unchunked puts. Chunk signals follow the
+    :func:`putmem_signal2_nbi_block` contract (armed watchdog scopes only;
+    drop/dup/delay injectable; bounded waits record ``chunk_wait``).
+
+    Returns one :class:`ChunkedPutHandle` per peer, in `peers` order; by
+    SPMD symmetry handle ``i``'s recv side observes the equal-shaped
+    incoming chunks from the mirror peer, so receivers consume per-peer
+    payloads chunk by chunk through ``wait_recv_chunk``.
+    """
+    handles: list[list[PutHandle]] = [[] for _ in peers]
+    for j, (off, rows) in enumerate(spans):
+        for i, pe in enumerate(peers):
+            handles[i].append(
+                putmem_signal2_nbi_block(
+                    dst_at(i, off, rows), src_at(i, off, rows), pe, axis,
+                    send_at(i, j), recv_at(i, j),
+                    sig_at(i, j) if sig_at is not None else None,
+                )
+            )
+    return [ChunkedPutHandle(hs) for hs in handles]
+
+
 def putmem_signal2_nbi_block(
     dst_ref, src_ref, pe, axis: str, send_sem, recv_sem, sig_sem=None
 ):
@@ -349,14 +395,20 @@ def putmem_signal2_nbi_block(
     from triton_dist_tpu.resilience import watchdog as _watchdog
 
     h = putmem_nbi_block(dst_ref, src_ref, pe, axis, send_sem, recv_sem)
-    if (
-        sig_sem is not None
-        and _watchdog.active() is not None
-        and _watchdog.enabled()
-    ):
+    if sig_sem is not None and chunk_signals_armed():
         h.sig_sem = sig_sem
         signal_op(sig_sem, 1, pe, axis)
     return h
+
+
+def chunk_signals_armed() -> bool:
+    """Whether per-chunk pure signals are issued/waited in this trace
+    (an armed watchdog scope — trace-time, so producers and consumers of a
+    chunk slot agree by construction; see
+    :func:`putmem_signal_chunked_nbi_block`)."""
+    from triton_dist_tpu.resilience import watchdog as _watchdog
+
+    return _watchdog.active() is not None and _watchdog.enabled()
 
 
 def wait_chunk(handle: "PutHandle"):
