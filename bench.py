@@ -662,6 +662,147 @@ def bench_ag_gemm(mesh, n):
     )
 
 
+def _run_shapes() -> None:
+    """``bench.py --shapes`` (VERDICT r5 next-round #7): sweep the
+    ``models/presets.py`` model table — M=8192 with the
+    8B/70B/405B/Mistral/Qwen projections — for ag_gemm / gemm_rs, plus the
+    MoE pipeline for the MoE presets, so per-op perf is a CURVE over the
+    open-model shapes instead of the single 8B-shaped point each metric
+    measures. Emits ``emit_info`` lines only (no vs_baseline — the gate
+    never reads them): this is a characterization pass for the chip log,
+    not an A/B. Each shape is best-effort: one failing shape (VMEM, OOM,
+    a tune space gap) is reported to stderr and must not discard the rest
+    of the curve."""
+    import sys
+
+    from triton_dist_tpu.models import presets
+
+    # runs IN-PROCESS after main() may have armed the CPU fallback, so the
+    # module-level _SCALE/_CPU_FALLBACK (frozen at import) are stale here —
+    # re-read the environment locally
+    scale = max(1, int(os.environ.get("TDT_BENCH_SCALE", "1")))
+    cpu_fb = os.environ.get("TDT_BENCH_PLATFORM") == "cpu"
+
+    def sc(dim: int, quantum: int = 128) -> int:
+        return max(quantum, (dim // scale) // quantum * quantum)
+
+    def it(iters: int) -> int:
+        return max(2, iters // (scale * (32 if cpu_fb else 1)))
+
+    if cpu_fb:
+        jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()
+    world = int(os.environ.get("TDT_BENCH_WORLD", "0"))
+    if world:
+        if len(devs) < world:
+            raise SystemExit(
+                f"bench --shapes: world={world} but the backend exposes "
+                f"{len(devs)} devices"
+            )
+        devs = devs[:world]
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("tp",))
+    from triton_dist_tpu.ops.allgather_gemm import ag_gemm_op
+    from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs_op
+    from triton_dist_tpu.ops.grads import tp_moe_mlp_op
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+    from triton_dist_tpu.ops.moe_utils import select_experts
+
+    for name, entry in presets.shape_sweep(m=sc(8192)).items():
+        for fam, shape in entry.items():
+            try:
+                if fam == "ag_gemm":
+                    m, k, nn = shape
+                    nn = (nn // n) * n
+                    ka, kb = jax.random.split(jax.random.PRNGKey(0))
+                    a = jax.device_put(
+                        jax.random.normal(ka, (m, k), jnp.bfloat16),
+                        NamedSharding(mesh, P("tp", None)),
+                    )
+                    b = jax.device_put(
+                        jax.random.normal(kb, (k, nn), jnp.bfloat16) / 64,
+                        NamedSharding(mesh, P(None, "tp")),
+                    )
+                    t_ms = perf_func_loop(
+                        lambda a, b: ag_gemm_op(a, b, mesh), (a, b),
+                        iters=it(40), consume="all",
+                    )
+                    flops = 2.0 * m * k * nn
+                    tag = f"{name}_m{m}k{k}n{nn}"
+                elif fam == "gemm_rs":
+                    m, k, nn = shape
+                    k = (k // n) * n
+                    ka, kb = jax.random.split(jax.random.PRNGKey(1))
+                    a = jax.device_put(
+                        jax.random.normal(ka, (m, k), jnp.bfloat16) / 8,
+                        NamedSharding(mesh, P(None, "tp")),
+                    )
+                    b = jax.device_put(
+                        jax.random.normal(kb, (k, nn), jnp.bfloat16) / 8,
+                        NamedSharding(mesh, P("tp", None)),
+                    )
+                    t_ms = perf_func_loop(
+                        lambda a, b: gemm_rs_op(a, b, mesh), (a, b),
+                        iters=it(40), consume="all",
+                    )
+                    flops = 2.0 * m * k * nn
+                    tag = f"{name}_m{m}k{k}n{nn}"
+                else:  # moe
+                    m, h_dim, f_dim, n_exp, topk = shape
+                    f_dim = (f_dim // n) * n
+                    kx, ku, kd, kl = jax.random.split(
+                        jax.random.PRNGKey(5), 4
+                    )
+                    x = jax.device_put(
+                        jax.random.normal(kx, (m, h_dim), jnp.bfloat16),
+                        NamedSharding(mesh, P("tp", None)),
+                    )
+                    w_up = jax.device_put(
+                        jax.random.normal(
+                            ku, (n_exp, h_dim, f_dim), jnp.bfloat16
+                        ) / 32,
+                        NamedSharding(mesh, P(None, None, "tp")),
+                    )
+                    w_down = jax.device_put(
+                        jax.random.normal(
+                            kd, (n_exp, f_dim, h_dim), jnp.bfloat16
+                        ) / 32,
+                        NamedSharding(mesh, P(None, "tp", None)),
+                    )
+                    tw, ids = select_experts(
+                        jax.random.normal(kl, (m, n_exp), jnp.float32), topk
+                    )
+                    tw = jax.device_put(
+                        tw.astype(jnp.float32),
+                        NamedSharding(mesh, P("tp", None)),
+                    )
+                    ids = jax.device_put(
+                        ids, NamedSharding(mesh, P("tp", None))
+                    )
+                    cfgk = (
+                        GroupGemmConfig(8, 32, 32) if cpu_fb else None
+                    )
+                    t_ms = perf_func_loop(
+                        lambda *a: tp_moe_mlp_op(
+                            *a, mesh, overlap=True, config=cfgk
+                        ),
+                        (x, w_up, w_down, ids, tw),
+                        iters=it(8), consume="all",
+                    )
+                    flops = 2.0 * 2 * m * topk * h_dim * f_dim
+                    tag = f"{name}_m{m}e{n_exp}k{topk}"
+                tflops = flops / (t_ms * 1e-3) / 1e12 / n
+                emit_info(
+                    f"{fam}_shape_{tag}_tflops_per_chip_tp{n}", tflops,
+                    "TFLOPS",
+                )
+            except Exception as e:  # noqa: BLE001 — per-shape best effort
+                print(
+                    f"bench --shapes: {fam} @ {name} skipped: {e!r:.200}",
+                    file=sys.stderr, flush=True,
+                )
+
+
 def _wait_for_backend(budget_s: float | None = None) -> int | None:
     """Block until the accelerator backend is reachable — returning its
     device count — or return None once ``budget_s`` (default
@@ -873,6 +1014,12 @@ def main() -> None:
             file=sys.stderr, flush=True,
         )
         raise SystemExit(2)
+
+    if "--shapes" in sys.argv:
+        # model-table characterization sweep (info lines only) — its own
+        # mode so the driver's metric pass never pays for it
+        _run_shapes()
+        return
 
     # Only the flagship's lines are buffered (it EXECUTES first, while the
     # chip session is healthiest, but must be EMITTED last — the driver

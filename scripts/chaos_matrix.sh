@@ -1,9 +1,11 @@
 #!/usr/bin/env bash
 # Run the FULL resilience fault-injection matrix standalone
 # (tests/test_chaos.py + tests/test_elastic.py + the chunk-signal cells
-# of tests/test_chunked.py and tests/test_chunked_a2a.py,
-# docs/resilience.md): every kernel family × drop/dup/delay signal +
-# straggler PE, the ring and a2a/MoE chunk-fault cells (ISSUE 3/4), the
+# of tests/test_chunked.py and tests/test_chunked_a2a.py + the ragged
+# chunk-fault cells of tests/test_ragged.py, docs/resilience.md): every
+# kernel family × drop/dup/delay signal + straggler PE, the ring and
+# a2a/MoE chunk-fault cells (ISSUE 3/4), the ragged-pipeline cells
+# (ISSUE 5: ragged tail blocks must add no droppable signal edge), the
 # forced-compile-failure degradation cases, and the elastic arcs
 # (retry/quarantine/shrink/readmit), including the cells marked `slow`
 # that tier-1 skips.
@@ -27,7 +29,7 @@ trap 'rm -f "$log"' EXIT
 # table still prints when cells fail.
 set +e
 env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_elastic.py \
-    tests/test_chunked.py tests/test_chunked_a2a.py \
+    tests/test_chunked.py tests/test_chunked_a2a.py tests/test_ragged.py \
     -m chaos -v -rs -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
     2>&1 | tee "$log"
 rc=${PIPESTATUS[0]}

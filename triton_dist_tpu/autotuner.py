@@ -11,9 +11,14 @@ TPU-native form: time the whole jitted thunk per candidate config with
 ``perf_func``; under SPMD one process drives all local devices, so the
 cross-rank aggregation the reference needs (NCCL all-reduce of timings)
 reduces to the walltime of the slowest device — which walltime already is.
-Multi-host runs aggregate via ``jax.process_count`` broadcast of the rank-0
-choice (all processes must pick identically or collectives deadlock — same
-constraint the reference handles, autotuner.py:97).
+Multi-host runs gather every process's per-config timings
+(``multihost_utils.process_allgather``) and pick the config minimizing the
+MAX over processes — the reference's slowest-rank rule (autotuner.py:97):
+on DCN-attached heterogeneous topologies rank 0's local winner can be a
+straggler's worst case. A config that failed on ANY process is
+disqualified everywhere, and rank 0's (identical, deterministic) pick is
+still broadcast as the authoritative tie-break so all processes apply the
+same config or collectives would deadlock.
 
 Decisions persist to ``.autotune_cache/<name>.json`` keyed by the call
 signature, so production runs pay zero tuning cost.
@@ -100,6 +105,30 @@ def _store_disk_cache(name: str, table: dict[str, Any]) -> None:
 class AutotuneResult:
     config: Any
     times_ms: list[float]
+
+
+def _slowest_rank_best(all_times, margin: float = 0.02) -> int:
+    """Min-max cross-rank aggregation (≙ reference ``autotuner.py:97``):
+    given ``[n_proc, n_cfg]`` per-process timings, pick the config whose
+    SLOWEST process is fastest. ``inf`` anywhere disqualifies the config
+    everywhere (it failed on that rank — applying it would desync the
+    fleet). The same order-preference walk as the local sweep applies: a
+    later candidate must beat the current leader's worst-case time by
+    `margin` to displace it, so spaces' best-known leaders keep their seat
+    against cross-host timing noise. Returns -1 when every config failed
+    somewhere (caller falls back to its local pick)."""
+    import numpy as np
+
+    worst = np.max(np.asarray(all_times, np.float64), axis=0)
+    finite = np.isfinite(worst)
+    if not finite.any():
+        return -1
+    leader = int(np.argmax(finite))   # first config finite on every rank
+    best = leader
+    for i in range(leader + 1, worst.size):
+        if finite[i] and worst[i] < worst[best] * (1.0 - margin):
+            best = i
+    return best
 
 
 def contextual_autotune(
@@ -300,8 +329,9 @@ def contextual_autotune(
                 # interleaved paired timing the bench trusts; the leader
                 # keeps its seat unless the challenger wins it paired.
                 # (Multi-host skips this: the confirm pass would need every
-                # rank to join both loops in lockstep — rank 0's sweep pick
-                # is broadcast instead, as before.)
+                # rank to join both loops in lockstep — the slowest-rank
+                # aggregation below decides from the gathered sweep
+                # timings instead.)
                 try:
                     _, _, ratio = perf_pair_loop(
                         functools.partial(fn, config=configs[best_i], **kwargs),
@@ -315,18 +345,30 @@ def contextual_autotune(
                     best_i = leader  # confirm failed: trust the order bias
             best_t = times[best_i]
             if jax.process_count() > 1:
-                # all processes must apply the same config or collectives
-                # mismatch (≙ the reference's cross-rank aggregation,
-                # autotuner.py:97): rank 0's choice wins everywhere
+                # slowest-rank aggregation (≙ the reference's cross-rank
+                # rule, autotuner.py:97): gather every process's timings
+                # and pick the config minimizing the max over ranks — on
+                # heterogeneous (DCN-attached) topologies rank 0's local
+                # winner can be another rank's straggler. Every process
+                # computes the same min-max pick from the same gathered
+                # matrix; rank 0's broadcast remains the authoritative
+                # tie-break (all processes must apply the same config or
+                # collectives mismatch).
                 from jax.experimental import multihost_utils
                 import numpy as _np
 
+                all_times = multihost_utils.process_allgather(
+                    _np.asarray(times, _np.float64)
+                )
+                agg = _slowest_rank_best(all_times, margin)
+                if agg >= 0:
+                    best_i = agg
                 best_i = int(
                     multihost_utils.broadcast_one_to_all(_np.int32(best_i))
                 )
                 # the logged timing below is THIS RANK'S local sample of
-                # rank 0's choice — it can be inf when the config failed
-                # here (harmless: the disk cache stores only the index)
+                # the fleet's choice — it can be inf when the config
+                # failed here (harmless: the disk cache stores the index)
                 best_t = times[best_i]
             if tdt_config.get_config().verbose_autotune:
                 t_str = f"{best_t:.3f} ms" if math.isfinite(best_t) else (

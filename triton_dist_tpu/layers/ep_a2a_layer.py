@@ -222,15 +222,17 @@ class EPAll2AllLayer:
         return recv, info
 
     def receiver_alignment(
-        self, info: DispatchInfo, block_m: int
+        self, info: DispatchInfo, block_m: int, *, ragged: bool = False
     ) -> MoEAlignment:
         """Block-align the received rows by LOCAL expert for group_gemm.
         Invalid (padding) rows go to a virtual trailing expert whose blocks
-        compute garbage on clamped weights; combine drops them."""
+        compute garbage on clamped weights; combine drops them —
+        ``ragged=True`` skips them in-kernel instead (ISSUE 5)."""
         n = self._world()
         epr = self.n_experts // n
         return _align_received(
-            info.recv_expert, info.recv_splits, self.max_m, epr, block_m
+            info.recv_expert, info.recv_splits, self.max_m, epr, block_m,
+            ragged=ragged,
         )
 
     def combine(
@@ -507,13 +509,16 @@ class HierEPAll2AllLayer:
         )
         return recv2, info
 
-    def receiver_alignment(self, info: HierDispatchInfo, block_m: int) -> MoEAlignment:
+    def receiver_alignment(
+        self, info: HierDispatchInfo, block_m: int, *, ragged: bool = False
+    ) -> MoEAlignment:
         """Block-align received rows by LOCAL expert for group_gemm (same
         scheme as the flat layer's)."""
         n_o, n_i = self._dims()
         epr = self.n_experts // (n_o * n_i)
         return _align_received(
-            info.recv_expert, info.recv_splits2, self.max_m2, epr, block_m
+            info.recv_expert, info.recv_splits2, self.max_m2, epr, block_m,
+            ragged=ragged,
         )
 
     def combine(self, y: jax.Array, info: HierDispatchInfo, m_loc: int) -> jax.Array:
@@ -567,17 +572,27 @@ class HierEPAll2AllLayer:
 
 def _align_received(
     recv_expert: jax.Array, recv_splits: jax.Array, max_m: int,
-    epr: int, block_m: int,
+    epr: int, block_m: int, ragged: bool = False,
 ) -> MoEAlignment:
-    """Shared receiver-side block alignment (flat + hierarchical layers)."""
+    """Shared receiver-side block alignment (flat + hierarchical layers).
+
+    ``ragged=True`` (ISSUE 5) additionally carries the per-block live-row
+    map, with the virtual trailing expert's blocks zeroed outright: its
+    rows are slab-padding tokens whose outputs the combine drops anyway —
+    under the padded contract those blocks compute garbage on clamped
+    weights; ragged skips them entirely."""
     flat_exp = recv_expert.reshape(-1)
     pos = jnp.arange(flat_exp.shape[0], dtype=jnp.int32) % max_m
     slab = jnp.arange(flat_exp.shape[0], dtype=jnp.int32) // max_m
     valid = pos < recv_splits[slab]
     padded_exp = jnp.where(valid, flat_exp, epr)
-    al = moe_align_block_size(padded_exp, epr + 1, block_m)
+    al = moe_align_block_size(padded_exp, epr + 1, block_m, ragged=ragged)
+    valid_rows = al.valid_rows
+    if ragged:
+        valid_rows = jnp.where(al.expert_ids >= epr, 0, valid_rows)
     return MoEAlignment(
         sorted_token_ids=al.sorted_token_ids,
         expert_ids=jnp.minimum(al.expert_ids, epr - 1),
         num_tokens_post_pad=al.num_tokens_post_pad,
+        valid_rows=valid_rows,
     )

@@ -146,21 +146,27 @@ class EPMoEMLP:
             recv, info = layer.dispatch(x, topk_ids)
 
         # local expert compute on block-aligned received rows (sentinel
-        # rows land on the clamped last expert and are dropped on scatter)
-        al = layer.receiver_alignment(info, block_m=cfg.block_m)
+        # rows land on the clamped last expert and are dropped on scatter;
+        # with cfg.ragged the alignment also carries the live-row map and
+        # the grouped GEMMs skip the dead panels — incl. the whole virtual
+        # padding expert, ISSUE 5)
+        al = layer.receiver_alignment(
+            info, block_m=cfg.block_m, ragged=cfg.ragged
+        )
         rows = recv.reshape(-1, x.shape[-1])            # [R, H]
         r_cap = rows.shape[0]
         a_sorted = rows[jnp.minimum(al.sorted_token_ids, r_cap - 1)]
         if w8:
             # int8 banks: the scale-folding kernel; non-differentiable
             gg = lambda a, w, s: group_gemm(  # noqa: E731
-                a, w, al.expert_ids, scale=s, config=cfg,
-                interpret=self.interpret,
+                a, w, al.expert_ids, valid_rows=al.valid_rows, scale=s,
+                config=cfg, interpret=self.interpret,
             )
         else:
             # alignment ids are sorted by construction (assume_sorted)
             gg = lambda a, w, s: group_gemm_grad(  # noqa: E731
-                a, w, al.expert_ids, cfg, None, self.interpret, True
+                a, w, al.expert_ids, al.valid_rows, cfg, None,
+                self.interpret, True,
             )
         h1 = gg(a_sorted, w_up, w_up_scale)
         h1 = self.activation(h1.astype(jnp.float32)).astype(x.dtype)

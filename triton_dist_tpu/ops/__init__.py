@@ -45,6 +45,7 @@ from triton_dist_tpu.ops.moe_utils import (
     ranked_global_view,
     ranked_scatter_meta,
     select_experts,
+    valid_rows_from_sorted,
 )
 from triton_dist_tpu.ops.all_to_all import (
     A2AConfig,

@@ -43,7 +43,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.allgather import all_gather
 from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
-from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
+from triton_dist_tpu.ops.group_gemm import (
+    GroupGemmConfig,
+    _panel_for,
+    group_gemm,
+)
 from triton_dist_tpu.ops.moe_utils import (
     MoEAlignment,
     RankedAlignment,
@@ -85,11 +89,12 @@ def ag_group_gemm(
     a_full = all_gather(a, axis=axis, method=ag_method, interpret=interpret)
     ids_full = jax.lax.all_gather(topk_ids, axis, tiled=True)  # [m_tot, topk]
     alignment = moe_align_block_size(
-        ids_full.reshape(-1), n_exp, cfg.block_m
+        ids_full.reshape(-1), n_exp, cfg.block_m, ragged=cfg.ragged
     )
     a_sorted = gather_sorted_rows(a_full, alignment, topk)
     h_sorted = group_gemm(
-        a_sorted, b, alignment.expert_ids, config=cfg, interpret=interpret
+        a_sorted, b, alignment.expert_ids, valid_rows=alignment.valid_rows,
+        config=cfg, interpret=interpret,
     )
     if gather_output:
         return h_sorted, alignment, a_sorted
@@ -106,13 +111,47 @@ def gather_group_blocks_for(
     return max(1, min(nb, budget // (2 * bm * k_dim * itemsize)))
 
 
+def _ragged_block_emit(
+    a_rows, b_tile, out_stage, oslot_base, v, bm, bn, panel, out_dtype,
+):
+    """Ragged compute+stage for one row block of an overlapped kernel
+    (ISSUE 5): MXU dots run only for the block's live ``panel``-row panels
+    (``pl.when``-guarded), the tail panel's dead rows are zero-masked, and
+    dead panels stage exact zeros — so the out buffer is fully defined and
+    a downstream 0-weight combine can never meet NaN junk. ``a_rows`` maps
+    a panel's row span to its A rows; ``oslot_base`` is the block's first
+    staged row."""
+    for p in range(bm // panel):
+        live = p * panel < v
+
+        @pl.when(live)
+        def _(p=p):
+            yp = jnp.dot(
+                a_rows(p * panel, panel), b_tile,
+                preferred_element_type=jnp.float32,
+            )
+            rows = (
+                jax.lax.broadcasted_iota(jnp.int32, (panel, bn), 0)
+                + p * panel
+            )
+            out_stage[pl.ds(oslot_base + p * panel, panel), :] = jnp.where(
+                rows < v, yp, 0.0
+            ).astype(out_dtype)
+
+        @pl.when(jnp.logical_not(live))
+        def _(p=p):
+            out_stage[pl.ds(oslot_base + p * panel, panel), :] = jnp.zeros(
+                (panel, bn), out_dtype
+            )
+
+
 def _ag_group_gemm_overlap_kernel(
     eid_ref, a_ref, b_ref,
     out_ref, ag_ref,
     a_all, b_buf, out_stage,
     copy_sem, send_sems, recv_sems, gsems, bsem, outsem,
     *, axis: str, n: int, nb: int, n_jn: int, bn: int, bpg: int, bm: int,
-    out_dtype,
+    out_dtype, vid_ref=None, panel: int = 0,
 ):
     """Fused ring-AG + grouped GEMM over PRE-SORTED slabs: the ring
     delivers each rank's block-aligned [t_pad_loc, K] slab; arriving chunks
@@ -120,7 +159,15 @@ def _ag_group_gemm_overlap_kernel(
     bulk aligned DMA per group — no per-row traffic) and consumed by a
     jn-outer / block-inner MXU loop that re-fetches an expert's weight slab
     only when the expert changes (the consecutive-block reuse the grid-based
-    ``group_gemm`` gets from Pallas's index-map equality)."""
+    ``group_gemm`` gets from Pallas's index-map equality).
+
+    ``vid_ref`` (ragged mode, ISSUE 5 — fed by the
+    ``_ag_group_gemm_overlap_ragged_kernel`` entry) carries the per-(rank,
+    block) live-row map: each block's dot runs as ``pl.when``-guarded
+    ``panel``-row panels so alignment pad rows cost no MXU time, and dead
+    rows stage exact zeros. ``vid_ref=None`` (the legacy entry) traces the
+    original schedule unchanged — ring, DMA, and semaphore structure are
+    identical in both modes (ragged adds NO signal edges)."""
     me = shmem.my_pe(axis)
     t_pad_loc = nb * bm
     it_counter = [0]  # trace-time global (block, jn) iteration count
@@ -248,11 +295,12 @@ def _ag_group_gemm_overlap_kernel(
                         bsem.at[1 - slot],
                     ).start()
 
-                y = jnp.dot(
-                    a_all[gslot, pl.ds(b_rel * bm, bm), :],
-                    b_buf[slot],
-                    preferred_element_type=jnp.float32,
-                )
+                if vid_ref is None:
+                    y = jnp.dot(
+                        a_all[gslot, pl.ds(b_rel * bm, bm), :],
+                        b_buf[slot],
+                        preferred_element_type=jnp.float32,
+                    )
                 # out_stage slots alternate on the GLOBAL iteration count
                 # (group iteration counts may be odd); a slot's first-ever
                 # use has no pending store to wait for
@@ -269,7 +317,19 @@ def _ag_group_gemm_overlap_kernel(
                         outsem.at[oslot],
                     ).wait()
 
-                out_stage[pl.ds(oslot * bm, bm), :] = y.astype(out_dtype)
+                if vid_ref is None:
+                    out_stage[pl.ds(oslot * bm, bm), :] = y.astype(out_dtype)
+                else:
+                    # ragged (ISSUE 5): panel-guarded dots write the staged
+                    # tile directly — dead panels stage zeros, so they ride
+                    # AFTER the slot-reuse wait like the legacy store
+                    _ragged_block_emit(
+                        lambda off, rows: a_all[
+                            gslot, pl.ds(b_rel * bm + off, rows), :
+                        ],
+                        b_buf[slot], out_stage, oslot * bm, vid_ref[c, b],
+                        bm, bn, panel, out_dtype,
+                    )
                 pltpu.make_async_copy(
                     out_stage.at[pl.ds(oslot * bm, bm), :],
                     out_ref.at[
@@ -307,7 +367,7 @@ def _ag_group_gemm_overlap_chunked_kernel(
     a_all, b_buf, out_stage,
     copy_sem, send_sems, recv_sems, sig_sems, gsems, bsem, outsem,
     *, axis: str, n: int, nb: int, n_jn: int, bn: int, bpg: int, bm: int,
-    out_dtype, spans,
+    out_dtype, spans, vid_ref=None, panel: int = 0,
 ):
     """Chunk-granular fused ring-AG + grouped GEMM (ISSUE 4 tentpole): the
     schedule of :func:`_ag_group_gemm_overlap_kernel` with each ring-step
@@ -443,11 +503,12 @@ def _ag_group_gemm_overlap_chunked_kernel(
                             bsem.at[1 - slot],
                         ).start()
 
-                    y = jnp.dot(
-                        a_all[gslot, pl.ds(b_rel * bm, bm), :],
-                        b_buf[slot],
-                        preferred_element_type=jnp.float32,
-                    )
+                    if vid_ref is None:
+                        y = jnp.dot(
+                            a_all[gslot, pl.ds(b_rel * bm, bm), :],
+                            b_buf[slot],
+                            preferred_element_type=jnp.float32,
+                        )
                     gi = it_base + i
                     oslot = jax.lax.rem(gi, 2)
 
@@ -462,7 +523,22 @@ def _ag_group_gemm_overlap_chunked_kernel(
                             outsem.at[oslot],
                         ).wait()
 
-                    out_stage[pl.ds(oslot * bm, bm), :] = y.astype(out_dtype)
+                    if vid_ref is None:
+                        out_stage[pl.ds(oslot * bm, bm), :] = y.astype(
+                            out_dtype
+                        )
+                    else:
+                        # ragged × chunked (ISSUE 5): identical panel rule;
+                        # the chunk schedule is row-layout-driven and never
+                        # consults valid_rows, so ragged adds no signal
+                        # edges to the chunk protocol
+                        _ragged_block_emit(
+                            lambda off, rows: a_all[
+                                gslot, pl.ds(b_rel * bm + off, rows), :
+                            ],
+                            b_buf[slot], out_stage, oslot * bm,
+                            vid_ref[c, b], bm, bn, panel, out_dtype,
+                        )
                     pltpu.make_async_copy(
                         out_stage.at[pl.ds(oslot * bm, bm), :],
                         out_ref.at[
@@ -494,6 +570,45 @@ def _ag_group_gemm_overlap_chunked_kernel(
     if total_iters >= 2:
         _drain(total_iters % 2)
     shmem.quiet(*descs)
+
+
+def _ag_group_gemm_overlap_ragged_kernel(
+    eid_ref, vid_ref, a_ref, b_ref,
+    out_ref, ag_ref,
+    a_all, b_buf, out_stage,
+    copy_sem, send_sems, recv_sems, gsems, bsem, outsem,
+    *, axis: str, n: int, nb: int, n_jn: int, bn: int, bpg: int, bm: int,
+    out_dtype, panel: int,
+):
+    """Ragged entry (ISSUE 5): the legacy schedule with the per-(rank,
+    block) live-row map as a second SMEM operand — see the base kernel's
+    docstring. Same ring/DMA/semaphore structure; only the MXU work and
+    the staged values differ."""
+    _ag_group_gemm_overlap_kernel(
+        eid_ref, a_ref, b_ref, out_ref, ag_ref, a_all, b_buf, out_stage,
+        copy_sem, send_sems, recv_sems, gsems, bsem, outsem,
+        axis=axis, n=n, nb=nb, n_jn=n_jn, bn=bn, bpg=bpg, bm=bm,
+        out_dtype=out_dtype, vid_ref=vid_ref, panel=panel,
+    )
+
+
+def _ag_group_gemm_overlap_chunked_ragged_kernel(
+    eid_ref, vid_ref, a_ref, b_ref,
+    out_ref, ag_ref,
+    a_all, b_buf, out_stage,
+    copy_sem, send_sems, recv_sems, sig_sems, gsems, bsem, outsem,
+    *, axis: str, n: int, nb: int, n_jn: int, bn: int, bpg: int, bm: int,
+    out_dtype, spans, panel: int,
+):
+    """Ragged × chunked entry (ISSUE 5 × ISSUE 4): chunk schedule and
+    signal protocol identical to the chunked kernel; blocks consume the
+    live-row map as above."""
+    _ag_group_gemm_overlap_chunked_kernel(
+        eid_ref, a_ref, b_ref, out_ref, ag_ref, a_all, b_buf, out_stage,
+        copy_sem, send_sems, recv_sems, sig_sems, gsems, bsem, outsem,
+        axis=axis, n=n, nb=nb, n_jn=n_jn, bn=bn, bpg=bpg, bm=bm,
+        out_dtype=out_dtype, spans=spans, vid_ref=vid_ref, panel=panel,
+    )
 
 
 def presort_local_rows(a: jax.Array, ral: RankedAlignment, axis: str) -> jax.Array:
@@ -545,13 +660,26 @@ def ag_group_gemm_overlap(
     bm = ral.block_m
     t_pad_loc = ral.t_pad_loc
     assert bm == cfg.block_m, (bm, cfg.block_m)
+    ragged = bool(cfg.ragged) and cfg.backend == "pallas"
+    if ragged and ral.valid_rows is None:
+        raise ValueError(
+            "GroupGemmConfig.ragged needs a ragged RankedAlignment — build "
+            "it with moe_align_ranked(..., ragged=True)"
+        )
+    if cfg.backend != "pallas" and n > 1:
+        raise ValueError(
+            "the ragged_dot sentinel backend needs globally expert-sorted "
+            "blocks; route it through the sequential composition "
+            "(tp_moe_mlp does this automatically)"
+        )
 
     a_srt = presort_local_rows(a, ral, axis)
 
     if n == 1:
         h = group_gemm(
-            a_srt, b, ral.expert_ids[0], config=cfg, out_dtype=out_dtype,
-            interpret=interpret,
+            a_srt, b, ral.expert_ids[0],
+            valid_rows=None if ral.valid_rows is None else ral.valid_rows[0],
+            config=cfg, out_dtype=out_dtype, interpret=interpret,
         )
         return (h, a_srt) if gather_output else h
 
@@ -576,11 +704,14 @@ def ag_group_gemm_overlap(
         t_pad_loc, max(1, int(getattr(cfg, "chunks_per_shard", 1))),
         quantum=bpg * bm,
     )
+    ragged_kw = {"panel": _panel_for(bm)} if ragged else {}
     if len(spans) > 1:
         kernel = functools.partial(
-            _ag_group_gemm_overlap_chunked_kernel, axis=axis, n=n, nb=nb,
+            _ag_group_gemm_overlap_chunked_ragged_kernel if ragged
+            else _ag_group_gemm_overlap_chunked_kernel,
+            axis=axis, n=n, nb=nb,
             n_jn=n_jn, bn=bn, bpg=bpg, bm=bm, out_dtype=out_dtype,
-            spans=spans,
+            spans=spans, **ragged_kw,
         )
         ring_scratch = [
             pltpu.SemaphoreType.DMA((max(n - 1, 1), len(spans))),
@@ -590,13 +721,29 @@ def ag_group_gemm_overlap(
         ]
     else:
         kernel = functools.partial(
-            _ag_group_gemm_overlap_kernel, axis=axis, n=n, nb=nb,
+            _ag_group_gemm_overlap_ragged_kernel if ragged
+            else _ag_group_gemm_overlap_kernel,
+            axis=axis, n=n, nb=nb,
             n_jn=n_jn, bn=bn, bpg=bpg, bm=bm, out_dtype=out_dtype,
+            **ragged_kw,
         )
         ring_scratch = [
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
             pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
         ]
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),   # expert ids [n, nb]
+        # HBM pinned (not ANY): chunk slices at traced-but-aligned
+        # offsets must DMA from untiled HBM, not from VMEM the
+        # compiler might pick for small inputs
+        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # a_srt
+        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # b
+    ]
+    args = [ral.expert_ids, a_srt, b]
+    if ragged:
+        # the per-(rank, block) live-row map rides SMEM next to the ids
+        in_specs.insert(1, pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.insert(1, ral.valid_rows.astype(jnp.int32))
     out, ag = dist_pallas_call(
         kernel,
         name="ag_group_gemm_overlap",
@@ -604,14 +751,7 @@ def ag_group_gemm_overlap(
             jax.ShapeDtypeStruct((n * t_pad_loc, n_loc), out_dtype),
             jax.ShapeDtypeStruct((n * t_pad_loc, k_dim), a.dtype),
         ),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),   # expert ids [n, nb]
-            # HBM pinned (not ANY): chunk slices at traced-but-aligned
-            # offsets must DMA from untiled HBM, not from VMEM the
-            # compiler might pick for small inputs
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # a_srt
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # b
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
@@ -637,7 +777,7 @@ def ag_group_gemm_overlap(
         vmem_limit_bytes=min(vmem_bytes, 100 * 2**20),
         uses_barrier=True,
         interpret=interpret,
-    )(ral.expert_ids, a_srt, b)
+    )(*args)
     return (out, ag) if gather_output else out
 
 
@@ -683,13 +823,17 @@ def ag_group_gemm_op(
 # allgather_group_gemm.py:130-180 config lists). block_m is also the
 # alignment block, so the sweep may change padding, not just tiling.
 # FIRST entry = best-known default (applied sweep-free under
-# cached_or_first).
+# cached_or_first). Ragged twins (ISSUE 5) sit strictly AFTER their padded
+# originals — the no-regression ordering invariant: sweep-free walks can
+# never apply a ragged schedule untimed.
 AG_GROUP_GEMM_TUNE_SPACE = (
     GroupGemmConfig(128, 1024, 512),
     GroupGemmConfig(128, 2048, 512),
     GroupGemmConfig(128, 1024, 1024),
     GroupGemmConfig(128, 512, 512),
     GroupGemmConfig(256, 1024, 512),
+    GroupGemmConfig(128, 1024, 512, ragged=True),
+    GroupGemmConfig(256, 1024, 512, ragged=True),
 )
 
 ag_group_gemm_op = contextual_autotune(

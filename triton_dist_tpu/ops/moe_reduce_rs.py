@@ -17,6 +17,7 @@ layer's work in the XLA schedule.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any
 
@@ -28,8 +29,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
-from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
-from triton_dist_tpu.ops.moe_utils import MoEAlignment, scatter_add_unsorted
+from triton_dist_tpu.ops.group_gemm import (
+    GroupGemmConfig,
+    _panel_for,
+    group_gemm,
+)
+from triton_dist_tpu.ops.moe_utils import (
+    MoEAlignment,
+    scatter_add_unsorted,
+    valid_rows_from_sorted,
+)
 from triton_dist_tpu.ops.reduce_scatter import ReduceScatterConfig, reduce_scatter
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block
@@ -65,7 +74,8 @@ def moe_reduce_rs(
     """
     out_dtype = out_dtype or h_sorted.dtype
     y_sorted = group_gemm(
-        h_sorted, w_down, alignment.expert_ids, config=config,
+        h_sorted, w_down, alignment.expert_ids,
+        valid_rows=alignment.valid_rows, config=config,
         out_dtype=jnp.float32, act_fn=act_fn, interpret=interpret,
     )
     partial = scatter_add_unsorted(
@@ -93,12 +103,44 @@ def rs_block_n_for(
     return pick_block(h_dim, min(want_bn, cap))
 
 
+def _moe_ragged_blk(
+    h_buf, w_buf, ids_v, w_v, partial_ref, hslot, slot, b, v, m_out, bm,
+    panel, cdt,
+):
+    """Ragged block step of the fused down-projection (ISSUE 5): the
+    ``h_block @ W_down`` dot AND the one-hot combine run only for the
+    block's live ``panel``-row panels (``pl.when``-guarded) — the combine's
+    FLOPs scale with live rows too, since its contraction dim IS the block
+    rows. Dead panels contribute nothing; partial_ref is accumulative so
+    skipping is exact."""
+    d = ids_v[b]
+    w_r = w_v[b]
+    for p in range(bm // panel):
+        @pl.when(p * panel < v)
+        def _(p=p):
+            yp = jnp.dot(
+                h_buf[hslot, pl.ds(p * panel, panel), :],
+                w_buf[slot],
+                preferred_element_type=jnp.float32,
+            )
+            dp = d[p * panel:(p + 1) * panel]
+            wp = w_r[p * panel:(p + 1) * panel]
+            sel = jax.lax.broadcasted_iota(
+                jnp.int32, (m_out, panel), 0
+            ) == dp[None, :]
+            scat = jnp.where(sel, wp[None, :], 0.0).astype(cdt)
+            partial_ref[:] += jnp.dot(
+                scat, yp.astype(cdt), preferred_element_type=jnp.float32
+            )
+
+
 def _moe_reduce_rs_overlap_kernel(
     eid_ref, h_ref, w_ref, dst_ref, wrow_ref,
     out_ref, own_buf, landing,
     h_buf, w_buf, push_stage, ids_v, w_v, partial_ref,
     hsem, wsem, metasem, stage_sem, recv_sems,
     *, axis: str, n: int, nb: int, n_jn: int, bn: int, m_out: int, out_dtype,
+    vid_ref=None, panel: int = 0,
 ):
     """Fused grouped-GEMM → weighted combine → reduce-scatter: destination
     rank c's chunk is computed from ITS aligned rows (rank-major layout:
@@ -181,20 +223,31 @@ def _moe_reduce_rs_overlap_kernel(
                         hsem.at[1 - hslot],
                     ).start()
 
-                y = jnp.dot(
-                    h_buf[hslot],
-                    w_buf[slot],
-                    preferred_element_type=jnp.float32,
-                )
-                d = ids_v[b]                       # [bm] destination tokens
-                w_r = w_v[b]                       # [bm] routing weights
-                sel = jax.lax.broadcasted_iota(
-                    jnp.int32, (m_out, bm), 0
-                ) == d[None, :]
-                scat = jnp.where(sel, w_r[None, :], 0.0).astype(cdt)
-                partial_ref[:] += jnp.dot(
-                    scat, y.astype(cdt), preferred_element_type=jnp.float32
-                )
+                if vid_ref is None:
+                    y = jnp.dot(
+                        h_buf[hslot],
+                        w_buf[slot],
+                        preferred_element_type=jnp.float32,
+                    )
+                    d = ids_v[b]                   # [bm] destination tokens
+                    w_r = w_v[b]                   # [bm] routing weights
+                    sel = jax.lax.broadcasted_iota(
+                        jnp.int32, (m_out, bm), 0
+                    ) == d[None, :]
+                    scat = jnp.where(sel, w_r[None, :], 0.0).astype(cdt)
+                    partial_ref[:] += jnp.dot(
+                        scat, y.astype(cdt), preferred_element_type=jnp.float32
+                    )
+                else:
+                    # ragged (ISSUE 5): both the down-GEMM and the one-hot
+                    # combine shrink to the block's live panels. Sentinel
+                    # rows inside the tail panel keep their 0 routing
+                    # weight (ranked_scatter_meta), so their computed rows
+                    # contribute exact zeros.
+                    _moe_ragged_blk(
+                        h_buf, w_buf, ids_v, w_v, partial_ref, hslot, slot,
+                        b, vid_ref[c, b], m_out, bm, panel, cdt,
+                    )
                 return slot
 
             jax.lax.fori_loop(0, nb, _blk, jnp.int32(1))
@@ -283,7 +336,7 @@ def _moe_reduce_rs_overlap_chunked_kernel(
     h_buf, w_buf, push_stage, ids_v, w_v, partial_ref,
     hsem, wsem, metasem, stage_sems, local_sem, recv_sems, sig_sems,
     *, axis: str, n: int, nb: int, n_jn: int, bn: int, m_out: int,
-    out_dtype, spans,
+    out_dtype, spans, vid_ref=None, panel: int = 0,
 ):
     """Chunk-granular combine side of the fused MoE down-projection
     (ISSUE 4 tentpole): the schedule of :func:`_moe_reduce_rs_overlap_kernel`
@@ -371,20 +424,29 @@ def _moe_reduce_rs_overlap_chunked_kernel(
                         hsem.at[1 - hslot],
                     ).start()
 
-                y = jnp.dot(
-                    h_buf[hslot],
-                    w_buf[slot],
-                    preferred_element_type=jnp.float32,
-                )
-                d = ids_v[b]
-                w_r = w_v[b]
-                sel = jax.lax.broadcasted_iota(
-                    jnp.int32, (m_out, bm), 0
-                ) == d[None, :]
-                scat = jnp.where(sel, w_r[None, :], 0.0).astype(cdt)
-                partial_ref[:] += jnp.dot(
-                    scat, y.astype(cdt), preferred_element_type=jnp.float32
-                )
+                if vid_ref is None:
+                    y = jnp.dot(
+                        h_buf[hslot],
+                        w_buf[slot],
+                        preferred_element_type=jnp.float32,
+                    )
+                    d = ids_v[b]
+                    w_r = w_v[b]
+                    sel = jax.lax.broadcasted_iota(
+                        jnp.int32, (m_out, bm), 0
+                    ) == d[None, :]
+                    scat = jnp.where(sel, w_r[None, :], 0.0).astype(cdt)
+                    partial_ref[:] += jnp.dot(
+                        scat, y.astype(cdt), preferred_element_type=jnp.float32
+                    )
+                else:
+                    # ragged × chunked (ISSUE 5): the combine-push chunk
+                    # schedule spans m_out rows and never consults
+                    # valid_rows — ragged adds no signal edges here either
+                    _moe_ragged_blk(
+                        h_buf, w_buf, ids_v, w_v, partial_ref, hslot, slot,
+                        b, vid_ref[c, b], m_out, bm, panel, cdt,
+                    )
                 return slot
 
             jax.lax.fori_loop(0, nb, _blk, jnp.int32(1))
@@ -458,6 +520,45 @@ def _moe_reduce_rs_overlap_chunked_kernel(
     )
 
 
+def _moe_reduce_rs_overlap_ragged_kernel(
+    eid_ref, vid_ref, h_ref, w_ref, dst_ref, wrow_ref,
+    out_ref, own_buf, landing,
+    h_buf, w_buf, push_stage, ids_v, w_v, partial_ref,
+    hsem, wsem, metasem, stage_sem, recv_sems,
+    *, axis: str, n: int, nb: int, n_jn: int, bn: int, m_out: int,
+    out_dtype, panel: int,
+):
+    """Ragged entry (ISSUE 5): the legacy schedule with the per-(rank,
+    block) live-row map as a second SMEM operand — push/landing/semaphore
+    structure identical; only each block's MXU work shrinks."""
+    _moe_reduce_rs_overlap_kernel(
+        eid_ref, h_ref, w_ref, dst_ref, wrow_ref, out_ref, own_buf, landing,
+        h_buf, w_buf, push_stage, ids_v, w_v, partial_ref,
+        hsem, wsem, metasem, stage_sem, recv_sems,
+        axis=axis, n=n, nb=nb, n_jn=n_jn, bn=bn, m_out=m_out,
+        out_dtype=out_dtype, vid_ref=vid_ref, panel=panel,
+    )
+
+
+def _moe_reduce_rs_overlap_chunked_ragged_kernel(
+    eid_ref, vid_ref, h_ref, w_ref, dst_ref, wrow_ref,
+    out_ref, own_buf, landing,
+    h_buf, w_buf, push_stage, ids_v, w_v, partial_ref,
+    hsem, wsem, metasem, stage_sems, local_sem, recv_sems, sig_sems,
+    *, axis: str, n: int, nb: int, n_jn: int, bn: int, m_out: int,
+    out_dtype, spans, panel: int,
+):
+    """Ragged × chunked entry (ISSUE 5 × ISSUE 4): chunked combine pushes
+    with ragged per-block compute; the chunk protocol is untouched."""
+    _moe_reduce_rs_overlap_chunked_kernel(
+        eid_ref, h_ref, w_ref, dst_ref, wrow_ref, out_ref, own_buf, landing,
+        h_buf, w_buf, push_stage, ids_v, w_v, partial_ref,
+        hsem, wsem, metasem, stage_sems, local_sem, recv_sems, sig_sems,
+        axis=axis, n=n, nb=nb, n_jn=n_jn, bn=bn, m_out=m_out,
+        out_dtype=out_dtype, spans=spans, vid_ref=vid_ref, panel=panel,
+    )
+
+
 def moe_reduce_rs_overlap(
     h_sorted: jax.Array,
     w_down: jax.Array,
@@ -467,6 +568,7 @@ def moe_reduce_rs_overlap(
     *,
     axis: str = "tp",
     m_out: int,
+    valid_rows: jax.Array | None = None,
     config: GroupGemmConfig | None = None,
     out_dtype: Any = None,
     interpret: Any = None,
@@ -485,6 +587,19 @@ def moe_reduce_rs_overlap(
     nb = expert_ids.shape[1]
     bm = t_pad_loc // nb
     assert bm == cfg.block_m, (bm, cfg.block_m)
+    if cfg.backend != "pallas":
+        raise ValueError(
+            "the ragged_dot sentinel backend has no fused overlap form — "
+            "route it through the sequential composition (tp_moe_mlp does "
+            "this automatically); timing the Pallas pipeline under the "
+            "sentinel's label would falsify the A/B"
+        )
+    ragged = bool(cfg.ragged)
+    if ragged and valid_rows is None:
+        raise ValueError(
+            "GroupGemmConfig.ragged needs the ranked alignment's "
+            "valid_rows map (moe_align_ranked(..., ragged=True))"
+        )
     h_dim = w_down.shape[2]
     itemsize = jnp.dtype(h_sorted.dtype).itemsize
     bn = rs_block_n_for(
@@ -507,10 +622,14 @@ def moe_reduce_rs_overlap(
         m_out, max(1, int(getattr(cfg, "chunks_per_shard", 1))) if n > 1 else 1,
         quantum=128,
     )
+    ragged_kw = {"panel": _panel_for(bm)} if ragged else {}
     if len(spans) > 1:
         kernel = functools.partial(
-            _moe_reduce_rs_overlap_chunked_kernel, axis=axis, n=n, nb=nb,
+            _moe_reduce_rs_overlap_chunked_ragged_kernel if ragged
+            else _moe_reduce_rs_overlap_chunked_kernel,
+            axis=axis, n=n, nb=nb,
             n_jn=n_jn, bn=bn, m_out=m_out, out_dtype=out_dtype, spans=spans,
+            **ragged_kw,
         )
         push_scratch = [
             pltpu.SemaphoreType.DMA((2, len(spans))),   # stage_sems
@@ -521,13 +640,31 @@ def moe_reduce_rs_overlap(
         ]
     else:
         kernel = functools.partial(
-            _moe_reduce_rs_overlap_kernel, axis=axis, n=n, nb=nb,
+            _moe_reduce_rs_overlap_ragged_kernel if ragged
+            else _moe_reduce_rs_overlap_kernel,
+            axis=axis, n=n, nb=nb,
             n_jn=n_jn, bn=bn, m_out=m_out, out_dtype=out_dtype,
+            **ragged_kw,
         )
         push_scratch = [
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((max(n - 1, 1), n_jn)),
         ]
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),   # expert ids [n, nb]
+        # HBM pinned: block/meta slices at dynamic offsets must DMA
+        # from untiled HBM, not from VMEM the compiler might choose
+        # for small inputs (see ag_group_gemm_overlap)
+        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # h_sorted
+        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # w_down
+        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # dst_ids
+        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # w_rows
+    ]
+    args = [expert_ids, h_sorted, w_down, dst_ids, w_rows]
+    if ragged:
+        # the per-(rank, block) live-row map rides SMEM next to the ids
+        in_specs.insert(1, pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.insert(1, valid_rows.astype(jnp.int32))
     outs = dist_pallas_call(
         kernel,
         name="moe_reduce_rs_overlap",
@@ -535,16 +672,7 @@ def moe_reduce_rs_overlap(
             jax.ShapeDtypeStruct((m_out, h_dim), out_dtype),
             *workspace,
         ),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),   # expert ids [n, nb]
-            # HBM pinned: block/meta slices at dynamic offsets must DMA
-            # from untiled HBM, not from VMEM the compiler might choose
-            # for small inputs (see ag_group_gemm_overlap)
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # h_sorted
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # w_down
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # dst_ids
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # w_rows
-        ],
+        in_specs=in_specs,
         out_specs=tuple(
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM) for _ in range(3)
         ),
@@ -578,7 +706,7 @@ def moe_reduce_rs_overlap(
         ),
         uses_barrier=n > 1,
         interpret=interpret,
-    )(expert_ids, h_sorted, w_down, dst_ids, w_rows)
+    )(*args)
     return outs[0]
 
 
@@ -608,6 +736,13 @@ def moe_reduce_rs_op(
     def fn(h, w, sti, eid, tw):
         # every block inside an expert's padded segment has >=1 valid row,
         # so valid-block count * block_m recovers num_tokens_post_pad
+        cfg_ = config or GroupGemmConfig()
+        if cfg_.ragged and not assume_bijective:
+            # capacity-style alignments DROP slots to the sentinel
+            # mid-block, which breaks the valid-rows-are-a-block-prefix
+            # contract the ragged kernels skip on — degrade to the padded
+            # schedule (correct everywhere) rather than skip live rows
+            cfg_ = dataclasses.replace(cfg_, ragged=False)
         bm = sti.shape[0] // eid.shape[0]
         block_valid = jnp.any(
             sti.reshape(-1, bm) < n_tokens * topk, axis=1
@@ -615,10 +750,16 @@ def moe_reduce_rs_op(
         alignment = MoEAlignment(
             sorted_token_ids=sti, expert_ids=eid,
             num_tokens_post_pad=(jnp.sum(block_valid) * bm).astype(jnp.int32),
+            # externally-built alignment: reconstruct the ragged live-row
+            # map from the sentinel layout when the config asks for it
+            valid_rows=(
+                valid_rows_from_sorted(sti, bm, n_tokens * topk)
+                if cfg_.ragged else None
+            ),
         )
         return moe_reduce_rs(
             h, w, alignment, tw, axis=axis, n_tokens=n_tokens,
-            config=config, assume_bijective=assume_bijective,
+            config=cfg_, assume_bijective=assume_bijective,
             interpret=interpret,
         )
 
@@ -642,11 +783,14 @@ def moe_reduce_rs_op(
 # block_m is pinned by the caller-provided alignment (128 = moe_align
 # default); the sweep covers the N/K tiling of the grouped GEMM. FIRST
 # entry = best-known default (applied sweep-free under cached_or_first).
+# Ragged twins (ISSUE 5) strictly after their padded originals (the
+# no-regression ordering invariant).
 MOE_RS_TUNE_SPACE = (
     GroupGemmConfig(128, 1024, 512),
     GroupGemmConfig(128, 2048, 512),
     GroupGemmConfig(128, 1024, 1024),
     GroupGemmConfig(128, 512, 512),
+    GroupGemmConfig(128, 1024, 512, ragged=True),
 )
 
 moe_reduce_rs_op = contextual_autotune(MOE_RS_TUNE_SPACE, name="moe_reduce_rs")(

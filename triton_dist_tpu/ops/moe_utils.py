@@ -57,6 +57,13 @@ class MoEAlignment:
     sorted_token_ids: jax.Array
     expert_ids: jax.Array
     num_tokens_post_pad: jax.Array
+    # Ragged mode (ISSUE 5): live rows per block — ``[t_pad // block_m]``
+    # int32 in (0, block_m] for blocks inside an expert's segment, 0 for the
+    # trailing worst-case blocks past every segment. Together with
+    # expert_ids this is the scalar-prefetched per-block map
+    # ``block → (expert_id, valid_rows)`` the ragged grouped-GEMM kernels
+    # consume; None under the legacy (padded) contract.
+    valid_rows: jax.Array | None = None
 
     @property
     def block_m(self) -> int:
@@ -64,13 +71,21 @@ class MoEAlignment:
 
 
 def moe_align_block_size(
-    topk_ids: jax.Array, n_experts: int, block_m: int
+    topk_ids: jax.Array, n_experts: int, block_m: int, *, ragged: bool = False
 ) -> MoEAlignment:
     """Sort token-expert assignments by expert and pad each expert segment
     to a multiple of `block_m` (≙ ``moe_ag_scatter_align_block_size``,
     csrc/lib/moe_utils.cu:36-356).
 
     topk_ids: ``[T]`` int32 flattened assignments (T = tokens * topk).
+
+    ``ragged=True`` additionally emits the per-block ``valid_rows`` map
+    (true live rows of each block — a tail block carries its real count
+    instead of claiming the full ``block_m``), so a ragged-aware consumer
+    can skip the pad rows' MXU work entirely. Layout and every other field
+    are IDENTICAL to the legacy form: ragged changes what is computed, not
+    where rows live, which is what lets every downstream consumer (gather,
+    scatter, backward, the rank-major overlap layout) work unchanged.
     """
     t = topk_ids.shape[0]
     t_pad = round_up(t + n_experts * (block_m - 1), block_m)
@@ -96,11 +111,37 @@ def moe_align_block_size(
     ).astype(jnp.int32)
     # blocks past all experts' segments keep a valid (clamped) expert id
     expert_ids = jnp.minimum(expert_ids, n_experts - 1)
+    valid_rows = None
+    if ragged:
+        # live rows of block b: how far expert e's REAL rows reach into it
+        # (0 for the worst-case trailing blocks — their clamped expert id
+        # never owns them, so the whole block is dead)
+        offs = block_starts.astype(jnp.int32) - seg_starts.astype(jnp.int32)[
+            expert_ids
+        ]
+        valid_rows = jnp.clip(
+            counts.astype(jnp.int32)[expert_ids] - offs, 0, block_m
+        ).astype(jnp.int32)
     return MoEAlignment(
         sorted_token_ids=sorted_token_ids,
         expert_ids=expert_ids,
         num_tokens_post_pad=jnp.sum(padded_counts).astype(jnp.int32),
+        valid_rows=valid_rows,
     )
+
+
+def valid_rows_from_sorted(
+    sorted_token_ids: jax.Array, block_m: int, sentinel: int
+) -> jax.Array:
+    """Reconstruct the ragged per-block ``valid_rows`` map from a sorted-id
+    array whose pad rows carry ``sentinel`` (every in-repo alignment
+    builder's convention). Valid rows are a prefix of each block by
+    construction — real rows pack from the segment start, pad rows trail —
+    so the per-block count IS the map. For externally-provided alignments
+    (``moe_reduce_rs_op``) where the builder's map isn't in hand."""
+    return jnp.sum(
+        (sorted_token_ids.reshape(-1, block_m) < sentinel), axis=1
+    ).astype(jnp.int32)
 
 
 @jax.tree_util.register_dataclass
@@ -132,6 +173,9 @@ class RankedAlignment:
     local_ids: jax.Array
     src_rows: jax.Array
     expert_ids: jax.Array
+    # ragged mode (ISSUE 5): ``[n, nb]`` live rows per (rank, block); None
+    # under the legacy padded contract (see MoEAlignment.valid_rows)
+    valid_rows: jax.Array | None = None
 
     @property
     def n_ranks(self) -> int:
@@ -175,21 +219,27 @@ def ranked_global_view(al: RankedAlignment, m_loc: int, topk: int) -> MoEAlignme
         sorted_token_ids=sorted_token_ids,
         expert_ids=al.expert_ids.reshape(-1),
         num_tokens_post_pad=jnp.int32(n * t_pad_loc),
+        valid_rows=(
+            None if al.valid_rows is None else al.valid_rows.reshape(-1)
+        ),
     )
 
 
 def moe_align_ranked(
-    ids_full: jax.Array, n_experts: int, block_m: int, m_loc: int
+    ids_full: jax.Array, n_experts: int, block_m: int, m_loc: int,
+    *, ragged: bool = False,
 ) -> RankedAlignment:
     """Align each rank's routing independently (see
     :class:`RankedAlignment`). ids_full: ``[n, m_loc*topk]`` int32 — the
     allgathered flattened top-k ids (tiny payload; ≙ the reference
     allgathering routing metadata ahead of the token data,
-    allgather_group_gemm.py:272-330)."""
+    allgather_group_gemm.py:272-330). ``ragged=True`` carries the
+    per-(rank, block) ``valid_rows`` map through (see
+    :func:`moe_align_block_size`)."""
     n, t_loc = ids_full.shape
     topk = t_loc // m_loc
     al = jax.vmap(
-        lambda ids: moe_align_block_size(ids, n_experts, block_m)
+        lambda ids: moe_align_block_size(ids, n_experts, block_m, ragged=ragged)
     )(ids_full)
     token_of = jnp.clip(al.sorted_token_ids // topk, 0, m_loc - 1)
     valid = al.sorted_token_ids < t_loc
@@ -199,6 +249,10 @@ def moe_align_ranked(
         local_ids=al.sorted_token_ids.astype(jnp.int32),
         src_rows=src_rows.astype(jnp.int32),
         expert_ids=al.expert_ids.astype(jnp.int32),
+        valid_rows=(
+            None if al.valid_rows is None
+            else al.valid_rows.astype(jnp.int32)
+        ),
     )
 
 

@@ -337,6 +337,66 @@ def prune_chunk_candidates(
     )
 
 
+def estimate_group_gemm_pad_tax(
+    t_rows: int,
+    n_experts: int,
+    block_m: int,
+    panel_rows: int = 128,
+    counts=None,
+) -> float:
+    """Fraction of the padded grouped-GEMM's MXU work a ragged schedule
+    recovers (ISSUE 5).
+
+    The padded grid computes the alignment's STATIC worst case —
+    ``round_up(t + E·(block_m−1), block_m)`` rows, every block a full
+    ``block_m``-row tile whatever its live count (that static slack, not
+    the expected per-expert padding, is the measured ~25% MoE tax at the
+    bench shape: 20480 computed rows for 16384 real ones at block_m=512).
+    The ragged schedule computes each expert's rows quantized UP to the
+    MXU row panel (``min(panel_rows, block_m)``) plus nothing else.
+    Returns ``(padded_rows − ragged_rows) / padded_rows`` — the share of
+    MXU time that is pure pad; the predicted throughput recovery is
+    ``1 / (1 − tax)``.
+
+    `counts` (per-expert row counts, any array-like) makes the ragged term
+    exact; without it the expected ``E·(panel−1)/2`` padding is used.
+    Divisible shapes (every count a block_m multiple AND t_rows absorbing
+    the worst-case slack) drive the tax toward zero — the precondition
+    :func:`suggest_ragged` exists to detect."""
+    from triton_dist_tpu.utils import round_up
+
+    if t_rows <= 0 or n_experts <= 0 or block_m <= 0:
+        return 0.0
+    panel = max(1, min(panel_rows, block_m))
+    padded_rows = round_up(t_rows + n_experts * (block_m - 1), block_m)
+    if counts is not None:
+        ragged_rows = int(sum(round_up(int(c), panel) for c in counts))
+    else:
+        ragged_rows = t_rows + (n_experts * (panel - 1)) // 2
+    ragged_rows = min(ragged_rows, padded_rows)
+    return max(0.0, (padded_rows - ragged_rows) / padded_rows)
+
+
+def suggest_ragged(
+    t_rows: int,
+    n_experts: int,
+    block_m: int,
+    panel_rows: int = 128,
+    counts=None,
+    threshold: float = 0.02,
+) -> bool:
+    """Model-driven precondition for the ragged tune axis (ISSUE 5): True
+    when the padding tax :func:`estimate_group_gemm_pad_tax` would recover
+    exceeds `threshold` — i.e. when ragged can actually help. Divisible
+    shapes, or huge-t problems whose worst-case slack is a rounding error,
+    return False so the sweep-free walks never pay the (tiny but nonzero)
+    panel-loop overhead for nothing. Padded candidates are never subject
+    to this hook — pruning can only remove ragged candidates."""
+    return estimate_group_gemm_pad_tax(
+        t_rows, n_experts, block_m, panel_rows, counts
+    ) > threshold
+
+
 def _mean_ring_distance(n_pes: int) -> float:
     """Exact mean shortest-path hops to the n-1 peers on a wrapped 1-D
     axis: mean over d in 1..n-1 of min(d, n-d)."""

@@ -436,7 +436,9 @@ def getmem_nbi_block(*_args, **_kwargs):
         "TPU has no one-sided remote *loads* (no nvshmem_ptr/symm_at "
         "dereference). Restructure the algorithm as a push from the data "
         "owner — see SURVEY.md §7 'Hard parts' and e.g. the push-based "
-        "EP combine in triton_dist_tpu/ops/ep_a2a.py."
+        "EP combine: triton_dist_tpu/ops/all_to_all.py (the slab "
+        "transport) and triton_dist_tpu/layers/ep_a2a_layer.py (the "
+        "push-based combine)."
     )
 
 
