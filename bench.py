@@ -803,6 +803,54 @@ def _run_shapes() -> None:
                 )
 
 
+def _run_serving(argv) -> None:
+    """``bench.py bench_serving [λ ...]`` (ISSUE 6): sweep offered load
+    over the serving engine and emit the p50/p99-latency-vs-λ curve plus
+    tokens/s, queue-depth, and SLO-attainment lines.
+
+    Deterministic by construction: each λ runs on a fresh FakeClock with
+    each decode step charged a fixed virtual time, and the traffic seed is
+    pinned — two runs emit identical lines (pinned in tests/test_serving).
+    Every line goes through ``emit_info`` (no vs_baseline key), so
+    ``scripts/perf_gate.sh`` can never gate them; the rows are the
+    structural/virtual-clock tier of docs/serving_trends.md — absolute
+    tokens/s stays a chip-session number. Not in _METRICS/_EXEC_ORDER on
+    purpose: the driver's metric pass never pays for this mode."""
+    from triton_dist_tpu.models import init_params
+    from triton_dist_tpu.models.tp_transformer import TransformerConfig
+    from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig
+    from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig
+    from triton_dist_tpu.serving import SLOTargets
+    from triton_dist_tpu.serving import bench as sbench
+
+    rates = tuple(float(a) for a in argv) or (2.0, 5.0, 10.0, 20.0)
+    if os.environ.get("TDT_BENCH_SERVING_TPU") != "1":
+        # host tier by default: the curve is about SCHEDULING, not device
+        # speed, and even probing the backend (jax.default_backend())
+        # would initialize it — a half-up tunnel could wedge the sweep
+        # before any guard ran. Force CPU BEFORE the first jax call; a
+        # chip session opts in explicitly with TDT_BENCH_SERVING_TPU=1.
+        jax.config.update("jax_platforms", "cpu")
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    # a deliberately tiny single-block model: the virtual clock prices the
+    # steps, so the model only needs to exercise the real batcher/engine
+    # machinery (admission, ragged slots, EOS, drain)
+    cfg = TransformerConfig(
+        vocab=64, hidden=32, ffn=64, n_layers=1, n_q_heads=4, n_kv_heads=2,
+        head_dim=8, batch=4, seq=8,
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rows = sbench.sweep_offered_load(
+        cfg, params, mesh, s_max=32, rates=rates, n_requests=32,
+        prompt_len=("uniform", 2, 6), output_len=("uniform", 2, 8),
+        seed=0, virtual_step_s=0.05,
+        slo=SLOTargets(ttft_ms=500.0, e2e_ms=3000.0),
+    )
+    for name, value, unit in sbench.info_lines(rows):
+        emit_info(name, value, unit)
+
+
 def _wait_for_backend(budget_s: float | None = None) -> int | None:
     """Block until the accelerator backend is reachable — returning its
     device count — or return None once ``budget_s`` (default
@@ -956,6 +1004,12 @@ def main() -> None:
         os.environ.pop("TDT_AUTOTUNE_POLICY", None)
     else:
         os.environ.setdefault("TDT_AUTOTUNE_POLICY", "cached_or_first")
+
+    if len(sys.argv) > 1 and sys.argv[1] == "bench_serving":
+        # serving-engine offered-load sweep: host-level virtual-clock
+        # mode, no backend probe (a dead tunnel must not block it)
+        _run_serving(sys.argv[2:])
+        return
 
     if len(sys.argv) > 2 and sys.argv[1] == "--metric":
         _run_one(sys.argv[2])

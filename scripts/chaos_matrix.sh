@@ -2,13 +2,22 @@
 # Run the FULL resilience fault-injection matrix standalone
 # (tests/test_chaos.py + tests/test_elastic.py + the chunk-signal cells
 # of tests/test_chunked.py and tests/test_chunked_a2a.py + the ragged
-# chunk-fault cells of tests/test_ragged.py, docs/resilience.md): every
-# kernel family × drop/dup/delay signal + straggler PE, the ring and
-# a2a/MoE chunk-fault cells (ISSUE 3/4), the ragged-pipeline cells
-# (ISSUE 5: ragged tail blocks must add no droppable signal edge), the
-# forced-compile-failure degradation cases, and the elastic arcs
-# (retry/quarantine/shrink/readmit), including the cells marked `slow`
-# that tier-1 skips.
+# chunk-fault cells of tests/test_ragged.py + the serving-engine cells
+# of tests/test_serving.py, docs/resilience.md): every kernel family ×
+# drop/dup/delay signal + straggler PE, the ring and a2a/MoE chunk-fault
+# cells (ISSUE 3/4), the ragged-pipeline cells (ISSUE 5: ragged tail
+# blocks must add no droppable signal edge), the forced-compile-failure
+# degradation cases, the elastic arcs
+# (retry/quarantine/shrink/readmit), and the elastic SERVING arcs
+# (ISSUE 6: persistent straggler mid-serving → quarantine → the engine
+# shrinks to the serviceable world and keeps serving with prefix replay
+# → probation re-admit regrows it — zero lost requests, tokens
+# byte-identical to the uninterrupted run), including the cells marked
+# `slow` that tier-1 skips.
+#
+# The serving arc is HOST-LEVEL (FakeClock + fabricated watchdog records
+# through the production engine paths) and runs everywhere; live-fault
+# arcs remain interpreter-gated as before.
 #
 # The live injection cells need the Mosaic TPU interpreter (jax >= 0.6);
 # on older jax lines they skip and the degradation + host-arc tiers
@@ -30,6 +39,7 @@ trap 'rm -f "$log"' EXIT
 set +e
 env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_elastic.py \
     tests/test_chunked.py tests/test_chunked_a2a.py tests/test_ragged.py \
+    tests/test_serving.py \
     -m chaos -v -rs -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
     2>&1 | tee "$log"
 rc=${PIPESTATUS[0]}

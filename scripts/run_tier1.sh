@@ -5,9 +5,12 @@
 #
 # Two phases:
 #   1. the full tier-1 suite (everything not marked `slow`, 870 s budget,
-#      CPU backend, 8 virtual devices via tests/conftest.py);
-#   2. a fast `chaos`-marker smoke subset (resilience + elastic layers) —
-#      a focused re-run of the cells most likely to regress silently,
+#      CPU backend, 8 virtual devices via tests/conftest.py — the tests/
+#      glob picks up tests/test_serving.py, the serving-engine suite,
+#      automatically);
+#   2. a fast `chaos`-marker smoke subset (resilience + elastic layers,
+#      incl. the elastic SERVING arcs of tests/test_serving.py) — a
+#      focused re-run of the cells most likely to regress silently,
 #      cheap enough to eyeball on every PR.
 #
 # Prints PASSED/FAILED counts per phase (record them in CHANGES.md) and

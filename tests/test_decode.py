@@ -159,6 +159,40 @@ def test_continuous_batcher_eos_and_reuse(mesh4):
     assert len(done["b"]) == 2       # queued request ran after re-admission
 
 
+def test_run_exhaustion_preserves_finished_work(mesh4):
+    """ISSUE 6 satellite bugfix: max_steps exhaustion with a straggler
+    request in flight must not lose already-finished generations — the
+    error names both rosters and drain_finished() hands the completed
+    work over."""
+    from triton_dist_tpu.models.decode import (
+        ContinuousBatcher, Request, StepsExhaustedError,
+    )
+
+    cfg = TransformerConfig(
+        vocab=16, hidden=32, ffn=64, n_layers=1, n_q_heads=4, n_kv_heads=4,
+        head_dim=8, batch=1, seq=8,
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+    )
+    params = init_params(jax.random.PRNGKey(2), cfg)
+    batcher = ContinuousBatcher(cfg, params, mesh4, s_max=8)
+    batcher.submit(Request([1, 2], max_new_tokens=1, uid="quick"))
+    batcher.submit(Request([3], max_new_tokens=6, uid="straggler"))
+    with pytest.raises(StepsExhaustedError) as ei:
+        # enough steps to finish "quick" (prompt feed + 1 token), not the
+        # straggler queued behind it on the single slot
+        batcher.run(max_steps=3)
+    err = ei.value
+    assert isinstance(err, RuntimeError), "existing except clauses keep working"
+    assert err.finished_uids == ("quick",)
+    assert err.pending_uids == ("straggler",)
+    drained = dict(batcher.drain_finished())
+    assert set(drained) == {"quick"} and len(drained["quick"]) == 1
+    assert batcher.drain_finished() == [], "drain is a handover, not a peek"
+    # the straggler is still serviceable afterwards — nothing was torn down
+    done = dict(batcher.run(max_steps=100))
+    assert set(done) == {"straggler"} and len(done["straggler"]) == 6
+
+
 def test_generate_prefill_matches_token_by_token(mesh4):
     """prefill=True (one full-forward prompt pass writing every KV
     position at once) must reproduce the token-by-token warmup exactly —

@@ -19,6 +19,7 @@ Layer map (mirrors SURVEY.md §1 of the reference):
   ops/      — L6:   the kernel zoo (the product)
   layers/   — L7:   module-level wrappers
   models/   —       flagship TP/SP/EP transformer models (beyond reference)
+  serving/  —       SLO-metered elastic serving engine over the batcher
   parallel/ —       mesh/bootstrap/topology (≙ reference utils.py bootstrap)
   autotuner —  L8, profiler/aot — aux subsystems
 """

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from triton_dist_tpu.ops.all_to_all import A2AConfig, fast_all_to_all
 from triton_dist_tpu.ops.grads import fast_all_to_all_grad
 from triton_dist_tpu.ops.moe_utils import MoEAlignment, moe_align_block_size
+from triton_dist_tpu.utils import axis_size as _axis_size
 
 
 # Quantized-dispatch wire formats (≙ the reference's fp8 LL dispatch — its
@@ -142,7 +143,7 @@ class EPAll2AllLayer:
     interpret: Any = None
 
     def _world(self) -> int:
-        return int(jax.lax.axis_size(self.axis))
+        return _axis_size(self.axis)
 
     def dispatch(
         self, tokens: jax.Array, topk_ids: jax.Array
@@ -349,7 +350,7 @@ class HierEPAll2AllLayer:
     interpret: Any = None
 
     def _dims(self) -> tuple[int, int]:
-        return int(jax.lax.axis_size(self.outer)), int(jax.lax.axis_size(self.inner))
+        return _axis_size(self.outer), _axis_size(self.inner)
 
     def dispatch(
         self,

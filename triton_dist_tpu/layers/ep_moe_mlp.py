@@ -29,6 +29,7 @@ from triton_dist_tpu import config as tdt_config
 from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer, HierEPAll2AllLayer
 from triton_dist_tpu.ops.grads import group_gemm_grad
 from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
+from triton_dist_tpu.utils import axis_size as _axis_size
 
 
 def _overflow_message(ov: int) -> str:
@@ -94,7 +95,7 @@ class EPMoEMLP:
         if (self.outer is None) != (self.inner is None):
             raise ValueError("set both outer= and inner=, or neither")
         if self.outer is not None:
-            n_o = int(jax.lax.axis_size(self.outer))
+            n_o = _axis_size(self.outer)
             return HierEPAll2AllLayer(
                 n_experts=self.n_experts, topk=self.topk,
                 max_m1=self.max_m,

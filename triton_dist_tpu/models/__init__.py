@@ -10,8 +10,11 @@ kernels' custom VJPs.
 """
 
 from triton_dist_tpu.models.decode import (
+    ContinuousBatcher,
     KVCacheSpec,
     PagedKVCacheSpec,
+    Request,
+    StepsExhaustedError,
     decode_step,
     generate,
 )
@@ -47,8 +50,11 @@ from triton_dist_tpu.models.tp_transformer import (
 )
 
 __all__ = [
+    "ContinuousBatcher",
     "KVCacheSpec",
     "PagedKVCacheSpec",
+    "Request",
+    "StepsExhaustedError",
     "presets",
     "pipeline_apply",
     "stage_slice",

@@ -41,6 +41,7 @@ from triton_dist_tpu.ops.flash_decode import (
     flash_decode_distributed,
     paged_flash_decode_distributed,
 )
+from triton_dist_tpu.utils import axis_size as _axis_size
 
 
 # Serving param specs are the model family's own (`specs_for`): dense,
@@ -66,7 +67,7 @@ def _outer_dims(cfg) -> tuple[int, int]:
     o = _outer_of(cfg)
     if o is None:
         return 1, 0
-    return int(jax.lax.axis_size(o)), jax.lax.axis_index(o)
+    return _axis_size(o), jax.lax.axis_index(o)
 
 
 def _mesh_outer(cfg, mesh: Mesh) -> int:
@@ -510,7 +511,7 @@ def decode_step(
     # everything below this line is per-outer-group: c.batch is the
     # group's batch slice (identical to cfg on the flat deployment)
     c = dataclasses.replace(cfg, batch=b_att) if n_o > 1 else cfg
-    n = int(jax.lax.axis_size(c.axis))
+    n = _axis_size(c.axis)
     me = jax.lax.axis_index(c.axis)
     g = c.n_q_heads // c.n_kv_heads
     d = c.head_dim
@@ -718,7 +719,13 @@ class Request:
     softmax (optionally truncated to the ``top_k`` most likely tokens),
     reproducibly per request via ``seed`` — each slot owns an
     independent RNG, so a request's tokens do not depend on what shares
-    the batch with it."""
+    the batch with it.
+
+    ``rng`` (serving-engine internal) overrides the seed-derived RNG with
+    a LIVE ``np.random.Generator``: a prefix-replayed request (serving
+    engine rebuild, docs/serving.md) continues sampling exactly where the
+    interrupted generation stopped instead of replaying draws its
+    already-generated prompt suffix consumed."""
 
     prompt: list            # token ids, len >= 1
     max_new_tokens: int
@@ -727,6 +734,7 @@ class Request:
     top_k: int | None = None
     seed: int | None = None
     uid: Any = None
+    rng: Any = None
 
     def sample(self, logits, rng) -> int:
         """Pick the next token from a [vocab] f32 logit row."""
@@ -743,6 +751,26 @@ class Request:
         probs = np.exp(z)
         probs /= probs.sum()
         return int(rng.choice(len(probs), p=probs))
+
+
+class StepsExhaustedError(RuntimeError):
+    """``ContinuousBatcher.run`` spent its step budget with work still in
+    flight. Completed generations are NOT lost (ISSUE 6 satellite): the
+    error names both rosters, and the finished results stay drainable via
+    :meth:`ContinuousBatcher.drain_finished` — a wedged straggler request
+    can never take already-finished neighbors down with it."""
+
+    def __init__(self, max_steps: int, pending_uids, finished_uids):
+        self.max_steps = int(max_steps)
+        self.pending_uids = tuple(pending_uids)
+        self.finished_uids = tuple(finished_uids)
+        super().__init__(
+            f"run(max_steps={max_steps}) exhausted with requests still "
+            f"in flight: {list(self.pending_uids)}; "
+            f"{len(self.finished_uids)} finished generation(s) "
+            f"{list(self.finished_uids)} are retained — collect them with "
+            f"drain_finished()"
+        )
 
 
 class ContinuousBatcher:
@@ -835,7 +863,10 @@ class ContinuousBatcher:
         self.queue: list[Request] = []
         self.finished: list[tuple[Any, list]] = []
 
-    def submit(self, req: Request) -> None:
+    def validate_request(self, req: Request) -> None:
+        """Admissibility checks (shared with the serving engine, which
+        validates at ENQUEUE time so a bad request is rejected loudly
+        instead of failing deep inside a serve loop)."""
         if not req.prompt:
             raise ValueError("empty prompt (need at least one token)")
         if req.max_new_tokens < 1:
@@ -847,6 +878,9 @@ class ContinuousBatcher:
                 f"prompt {len(req.prompt)} + max_new {req.max_new_tokens} "
                 f"exceeds s_max={self.s_max}"
             )
+
+    def submit(self, req: Request) -> None:
+        self.validate_request(req)
         self.queue.append(req)
 
     def _prefill_prog(self, bucket: int):
@@ -940,7 +974,14 @@ class ContinuousBatcher:
                     admitted = True
                     self.slot_req[i] = req
                     self.slot_out[i] = []
-                    self.slot_rng[i] = np.random.default_rng(req.seed)
+                    # a live generator (prefix replay) continues sampling
+                    # mid-stream; otherwise each admission re-derives the
+                    # slot RNG from the request seed (the documented
+                    # neighbor-independent sampling guarantee)
+                    self.slot_rng[i] = (
+                        req.rng if req.rng is not None
+                        else np.random.default_rng(req.seed)
+                    )
                     if self.prefill and len(req.prompt) > 1:
                         self._admit_prefill(i, req)
                     else:
@@ -951,6 +992,48 @@ class ContinuousBatcher:
     @property
     def idle(self) -> bool:
         return not self.queue and all(r is None for r in self.slot_req)
+
+    @property
+    def n_free_slots(self) -> int:
+        """Slots a new submission could claim without evicting anything:
+        idle slots minus what the admission queue will absorb first."""
+        free = sum(r is None for r in self.slot_req)
+        return max(0, free - len(self.queue))
+
+    @property
+    def n_active(self) -> int:
+        return sum(r is not None for r in self.slot_req)
+
+    @property
+    def prefill_bucket_count(self) -> int:
+        """Compiled masked-prefill programs held by the power-of-two
+        bucket cache — the recompilation-storm observability gauge
+        (ISSUE 6 satellite): a mixed-length workload must keep this within
+        the log2 bucket bound, never one program per distinct length."""
+        return len(self._prefill_progs)
+
+    def drain_finished(self) -> list[tuple[Any, list]]:
+        """Hand over (and clear) every finished ``(uid, tokens)`` — the
+        public drain the serving engine uses between steps, and the reason
+        a wedged straggler (``StepsExhaustedError``) can never lose
+        completed neighbors."""
+        out, self.finished = self.finished, []
+        return out
+
+    def export_in_flight(self) -> tuple[list[tuple[Request, list, Any]],
+                                        list[Request]]:
+        """Non-destructive snapshot for prefix replay (serving-engine
+        rebuild on a shrunk/regrown mesh): ``(active, queued)`` where
+        ``active`` is ``[(request, tokens_generated_so_far, live_rng)]``
+        per occupied slot in slot order and ``queued`` is the untouched
+        admission queue. The live RNG rides along so a sampled request's
+        continuation draws stay byte-identical after replay."""
+        active = [
+            (r, list(self.slot_out[i]), self.slot_rng[i])
+            for i, r in enumerate(self.slot_req)
+            if r is not None
+        ]
+        return active, list(self.queue)
 
     def step(self) -> None:
         """One ragged decode step for every slot + host scheduling."""
@@ -1000,9 +1083,11 @@ class ContinuousBatcher:
 
     def run(self, max_steps: int = 100000) -> list[tuple[Any, list]]:
         """Drive until every queued request finishes; returns
-        ``[(uid, generated_tokens), ...]`` in completion order. Raises if
-        `max_steps` elapse with work still in flight — a partial return
-        would be indistinguishable from completion."""
+        ``[(uid, generated_tokens), ...]`` in completion order. Raises
+        :class:`StepsExhaustedError` if `max_steps` elapse with work still
+        in flight — a partial return would be indistinguishable from
+        completion, but the finished generations stay drainable
+        (``drain_finished``) and the error carries both uid rosters."""
         for _ in range(max_steps):
             if self.idle:
                 break
@@ -1011,12 +1096,10 @@ class ContinuousBatcher:
             pending = [r.uid for r in self.slot_req if r is not None] + [
                 r.uid for r in self.queue
             ]
-            raise RuntimeError(
-                f"run(max_steps={max_steps}) exhausted with requests still "
-                f"in flight: {pending}"
+            raise StepsExhaustedError(
+                max_steps, pending, [uid for uid, _ in self.finished]
             )
-        out, self.finished = self.finished, []
-        return out
+        return self.drain_finished()
 
 
 def _prompt_shard(prompt, b, length, cfg):
@@ -1025,7 +1108,7 @@ def _prompt_shard(prompt, b, length, cfg):
     batcher's admission program). Hierarchical deployments shard over
     BOTH axes outer-major: outer group ``o``'s PEs cover exactly
     sequences ``[o*b_att, (o+1)*b_att)`` — the group's own slots."""
-    n = int(jax.lax.axis_size(cfg.axis))
+    n = _axis_size(cfg.axis)
     me = jax.lax.axis_index(cfg.axis)
     n_o, my_o = _outer_dims(cfg)
     m_loc = b * length // (n * n_o)
@@ -1077,7 +1160,7 @@ def prefill_cache(
             "time and cannot batch-claim a whole prompt's worth"
         )
     c = cfg
-    n = int(jax.lax.axis_size(c.axis))
+    n = _axis_size(c.axis)
     me = jax.lax.axis_index(c.axis)
     b, L = c.batch, c.seq
     s_shard = _shard_of(s_max, n)

@@ -60,6 +60,7 @@ from triton_dist_tpu.models.tp_transformer import (
     rope,
 )
 from triton_dist_tpu.ops.flash_decode import FlashDecodeConfig
+from triton_dist_tpu.utils import axis_size as _axis_size
 
 
 def verify_step(
@@ -93,7 +94,7 @@ def verify_step(
         )
     b_att = cfg.batch // n_o
     c = dataclasses.replace(cfg, batch=b_att) if n_o > 1 else cfg
-    n = int(jax.lax.axis_size(c.axis))
+    n = _axis_size(c.axis)
     me = jax.lax.axis_index(c.axis)
     g = c.n_q_heads // c.n_kv_heads
     d = c.head_dim

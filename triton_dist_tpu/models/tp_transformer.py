@@ -37,6 +37,7 @@ from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig
 from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig
 from triton_dist_tpu.ops.grads import ag_gemm_grad, gemm_rs_grad
 from jax.sharding import PartitionSpec as P
+from triton_dist_tpu.utils import axis_size as _axis_size
 
 
 @dataclasses.dataclass(frozen=True)
@@ -188,7 +189,7 @@ class TPTransformer:
 
     def block(self, x: jax.Array, p: dict) -> jax.Array:
         c = self.cfg
-        n = int(jax.lax.axis_size(c.axis))
+        n = _axis_size(c.axis)
         b, s = c.batch, c.seq
         hq_loc = c.n_q_heads // n
         hkv_loc = c.n_kv_heads // n
@@ -241,7 +242,7 @@ class TPTransformer:
         ``lse`` and the target logit are assembled with psum/pmax over the
         vocab shards. targets: ``[m_tot]`` int32 (full, replicated)."""
         c = self.cfg
-        n = int(jax.lax.axis_size(c.axis))
+        n = _axis_size(c.axis)
         me = jax.lax.axis_index(c.axis)
         v_loc = c.vocab // n
         logits = self(tokens_loc, params).astype(jnp.float32)  # [m, V/n]
@@ -547,7 +548,7 @@ def train_step(
             "inference-only (it cuts the router gradient). Train with "
             "ep_quant=None and quantize for serving."
         )
-    tp = int(jax.lax.axis_size(c.axis))
+    tp = _axis_size(c.axis)
     loss, grads = jax.value_and_grad(
         lambda p: model.loss(tokens_loc, targets, p)
     )(params)
@@ -569,7 +570,7 @@ def train_step(
                 # gradient already sums every dp group's contribution via
                 # the a2a transports — a pmean would average in a DIFFERENT
                 # expert's gradient from the peer dp rank. Just normalize.
-                g = g / jax.lax.axis_size(dp_axis)
+                g = g / _axis_size(dp_axis)
             else:
                 g = jax.lax.pmean(g, dp_axis)
         return g / tp

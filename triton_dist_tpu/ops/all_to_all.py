@@ -45,6 +45,7 @@ from triton_dist_tpu import resilience
 from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
 from triton_dist_tpu.shmem import device as shmem
+from triton_dist_tpu.utils import axis_size as _axis_size
 
 
 def _fast_all_to_all_xla(
@@ -233,7 +234,7 @@ def _fast_all_to_all_fused(
     interpret: Any = None,
 ):
     cfg = config or A2AConfig()
-    n = int(jax.lax.axis_size(axis))
+    n = _axis_size((axis))
     n_slabs, max_m, hidden = tokens.shape
     assert n_slabs == n, (n_slabs, n)
     chunks = max(1, min(cfg.puts_per_slab, max_m))

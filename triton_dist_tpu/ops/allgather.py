@@ -34,6 +34,7 @@ from triton_dist_tpu import resilience
 from triton_dist_tpu.ops.common import chunk_schedule, dist_pallas_call, jit_shard_map
 from triton_dist_tpu.parallel import topology
 from triton_dist_tpu.shmem import device as shmem
+from triton_dist_tpu.utils import axis_size as _axis_size
 
 
 def _all_gather_xla(x: jax.Array, *, axis="tp", **_) -> jax.Array:
@@ -379,8 +380,8 @@ def _all_gather_2d_fused(
     mesh): the inner ring then carries n_i-1 small hops while outer hops
     stream concurrently."""
     outer, inner = axes
-    n_o = int(jax.lax.axis_size(outer))
-    n_i = int(jax.lax.axis_size(inner))
+    n_o = _axis_size((outer))
+    n_i = _axis_size((inner))
     if n_o == 1:
         return all_gather(x, axis=inner, interpret=interpret)
     if n_i == 1:
@@ -476,7 +477,7 @@ def _all_gather_fused(x: jax.Array, *, axis: str = "tp", method: str = "auto", i
                     chunks_per_shard=chunks_per_shard,
                 )
             return out
-    n = int(jax.lax.axis_size(axis))
+    n = _axis_size((axis))
     if n == 1:
         return x
     if _is_dcn(axis):

@@ -43,6 +43,7 @@ from triton_dist_tpu.ops.common import (
 )
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block as _pick_block
+from triton_dist_tpu.utils import axis_size as _axis_size
 
 
 @dataclasses.dataclass(frozen=True)
@@ -278,6 +279,19 @@ def _ag_gemm_2d(a, b, *, axes, cfg, gather_output, out_dtype, interpret):
     return (out, ag) if gather_output else out
 
 
+def _ag_gemm_xla(
+    a: jax.Array, b: jax.Array, *, axis="tp", gather_output=False,
+    out_dtype=None, **_
+):
+    """The golden slow path (the program every fused method is tested
+    against): XLA's all-gather + dot, single- or multi-axis."""
+    axes = tuple(axis) if isinstance(axis, (tuple, list)) else axis
+    out_dtype = out_dtype or a.dtype
+    ag = jax.lax.all_gather(a, axes, axis=0, tiled=True)
+    out = jnp.dot(ag, b, preferred_element_type=out_dtype)
+    return (out, ag) if gather_output else out
+
+
 def ag_gemm(
     a: jax.Array,
     b: jax.Array,
@@ -294,8 +308,35 @@ def ag_gemm(
     b: ``[K, n_loc]`` — N-shard of the weight (column-parallel).
     Returns ``[n*m_loc, n_loc]`` (plus the gathered ``[n*m_loc, K]`` A if
     `gather_output`, ≙ the reference returning its AG workspace for reuse).
-    Golden: ``jax.lax.all_gather(a, axis, tiled=True) @ b``.
+    Golden: ``jax.lax.all_gather(a, axis, tiled=True) @ b`` — served
+    automatically when the fused kernel cannot run in this environment
+    (resilience layer, docs/resilience.md; the same guard every other op
+    family carries — its absence here was why a jax line without the
+    CompilerParams surface could not trace the TP transformer forward, so
+    prefill admission and the serving engine's MXU-rate path failed
+    instead of degrading).
     """
+    from triton_dist_tpu import resilience
+
+    return resilience.guarded_call(
+        "ag_gemm",
+        _ag_gemm_fused,
+        _ag_gemm_xla,
+        a, b, axis=axis, config=config, gather_output=gather_output,
+        out_dtype=out_dtype, interpret=interpret,
+    )
+
+
+def _ag_gemm_fused(
+    a: jax.Array,
+    b: jax.Array,
+    *,
+    axis: str = "tp",
+    config: AGGemmConfig | None = None,
+    gather_output: bool = False,
+    out_dtype: Any = None,
+    interpret: Any = None,
+):
     cfg = config or AGGemmConfig()
     out_dtype = out_dtype or a.dtype
     if cfg.block_m == 0:
@@ -369,7 +410,7 @@ def ag_gemm(
                 a, b, axes=tuple(axis), cfg=cfg, gather_output=gather_output,
                 out_dtype=out_dtype, interpret=interpret,
             )
-    n = int(jax.lax.axis_size(axis))
+    n = _axis_size(axis)
     m_loc, k_dim = a.shape
     n_loc = b.shape[1]
     if n > 1 and _is_dcn(axis):

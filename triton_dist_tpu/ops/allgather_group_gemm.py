@@ -57,6 +57,7 @@ from triton_dist_tpu.ops.moe_utils import (
 )
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block
+from triton_dist_tpu.utils import axis_size as _axis_size
 
 
 def ag_group_gemm(
@@ -653,7 +654,7 @@ def ag_group_gemm_overlap(
     ``ag_gemm``/``gemm_rs``)."""
     cfg = config or GroupGemmConfig()
     out_dtype = out_dtype or a.dtype
-    n = int(jax.lax.axis_size(axis))
+    n = _axis_size((axis))
     m_loc, k_dim = a.shape
     n_loc = b.shape[2]
     nb = ral.blocks_per_rank

@@ -39,6 +39,8 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+
+from triton_dist_tpu.utils import axis_size as _axis_size
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
@@ -1508,7 +1510,7 @@ def _sp_allgather_combine(out, lse, axis, ag_method, interpret) -> jax.Array:
     sp_flash_decode_layer.py:134-137): [b*hq, d] out rows, then the b*hq
     lse scalars packed densely into ceil(b*hq/d) extra rows.
     """
-    n = int(jax.lax.axis_size(axis))
+    n = _axis_size(axis)
     if n == 1:
         return out
     b, hq, d = out.shape

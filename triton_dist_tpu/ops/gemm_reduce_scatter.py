@@ -55,6 +55,7 @@ from triton_dist_tpu.ops.common import (
 from triton_dist_tpu.ops.reduce_scatter import get_auto_reduce_scatter_method
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block
+from triton_dist_tpu.utils import axis_size as _axis_size
 
 
 def _gemm_rs_xla(
@@ -383,7 +384,7 @@ def _gemm_rs_fused(
                 a, b, axes=tuple(axis), method=method, cfg=cfg,
                 out_dtype=out_dtype, interpret=interpret,
             )
-    n = int(jax.lax.axis_size(axis))
+    n = _axis_size(axis)
     m_tot, k_loc = a.shape
     n_dim = b.shape[1]
     if n > 1 and _is_dcn(axis):

@@ -31,6 +31,7 @@ from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig, ag_gemm
 from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig, gemm_rs
 from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+from triton_dist_tpu.utils import axis_size as _axis_size
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
@@ -88,7 +89,7 @@ def _gemm_rs_fwd(a, b, axis, rs_config, ag_config, interpret):
 
 def _gemm_rs_bwd(axis, rs_config, ag_config, interpret, res, dc):
     a, b = res
-    n = int(jax.lax.axis_size(axis))
+    n = _axis_size(axis)
     if n == 1:
         dc_full = dc
         da = jnp.dot(dc, b.T, preferred_element_type=jnp.float32).astype(a.dtype)
@@ -154,7 +155,7 @@ def _ring_attn_bwd(axis, causal, config, interpret, layout, res, dout):
     q, k, v, out, lse = res
     b, h, s_loc, d = q.shape
     bh = b * h
-    n = int(jax.lax.axis_size(axis))
+    n = _axis_size(axis)
     me = jax.lax.axis_index(axis)
     scale = 1.0 / math.sqrt(d)
     f32 = jnp.float32
@@ -256,7 +257,7 @@ def _tp_moe_forward_impl(x, w_up, w_down, topk_ids, topk_weights, axis,
         ranked_scatter_meta,
     )
 
-    n = int(jax.lax.axis_size(axis))
+    n = _axis_size(axis)
     m_loc = x.shape[0]
     n_exp = w_up.shape[0]
     topk = topk_ids.shape[1]

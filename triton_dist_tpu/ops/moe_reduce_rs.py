@@ -42,6 +42,7 @@ from triton_dist_tpu.ops.moe_utils import (
 from triton_dist_tpu.ops.reduce_scatter import ReduceScatterConfig, reduce_scatter
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block
+from triton_dist_tpu.utils import axis_size as _axis_size
 
 
 def moe_reduce_rs(
@@ -581,7 +582,7 @@ def moe_reduce_rs_overlap(
     ``[m_out, H]`` — this PE's fully-reduced token chunk."""
     cfg = config or GroupGemmConfig()
     out_dtype = out_dtype or h_sorted.dtype
-    n = int(jax.lax.axis_size(axis))
+    n = _axis_size((axis))
     t_pad_tot, f_loc = h_sorted.shape
     t_pad_loc = t_pad_tot // n
     nb = expert_ids.shape[1]

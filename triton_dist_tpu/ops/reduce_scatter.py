@@ -39,6 +39,7 @@ from triton_dist_tpu.ops.common import chunk_schedule, dist_pallas_call, jit_sha
 from triton_dist_tpu.parallel import topology
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block
+from triton_dist_tpu.utils import axis_size as _axis_size
 
 
 def _reduce_scatter_xla(x: jax.Array, *, axis="tp", **_) -> jax.Array:
@@ -314,8 +315,8 @@ def _reduce_scatter_fused(
             # fold reduced. Ordering matches
             # ``jax.lax.psum_scatter(x, axes, tiled=True)``.
             a0, rest = axis[0], tuple(axis[1:])
-            n0 = int(jax.lax.axis_size(a0))
-            nr = math.prod(int(jax.lax.axis_size(a)) for a in rest)
+            n0 = _axis_size((a0))
+            nr = math.prod(_axis_size((a)) for a in rest)
             orig_ndim0 = x.ndim
             if x.ndim == 1:
                 x = x.reshape(x.shape[0], 1)
@@ -338,7 +339,7 @@ def _reduce_scatter_fused(
                 out = out.reshape(m0)
             return out
     cfg = config or ReduceScatterConfig()
-    n = int(jax.lax.axis_size(axis))
+    n = _axis_size((axis))
     if n == 1:
         return x
     from triton_dist_tpu.parallel.topology import is_dcn_axis_name as _is_dcn

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block
+from triton_dist_tpu.utils import axis_size as _axis_size
 
 NEG_INF = float("-inf")
 
@@ -270,7 +271,7 @@ def ring_attention(
     large n for causal prefill.
     """
     cfg = config or RingAttentionConfig()
-    n = int(jax.lax.axis_size(axis))
+    n = _axis_size((axis))
     b, h, s_loc, d = q.shape
     if layout not in ("contig", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")

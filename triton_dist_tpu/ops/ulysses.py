@@ -24,6 +24,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+from triton_dist_tpu.utils import axis_size as _axis_size
 
 def _exchange(x: jax.Array, axis: str, n: int, interpret: Any):
     """[n, rows, d] slab exchange (slab j → PE j); returns same shape with
@@ -121,7 +122,7 @@ def ulysses_attention(
     ``jax.shard_map``). q, k, v: ``[b, h, s_loc, d]`` sequence shards with
     ``h % axis_size == 0``; returns the same layout. Golden: full (causal)
     attention over the gathered sequence."""
-    n = int(jax.lax.axis_size(axis))
+    n = _axis_size((axis))
     if n == 1:
         return _local_attention(q, k, v, causal)
     qh, kh, vh = _seq_to_heads((q, k, v), axis, n, interpret)
@@ -130,7 +131,7 @@ def ulysses_attention(
 
 
 def _ulysses_fwd(q, k, v, axis, causal, interpret):
-    n = int(jax.lax.axis_size(axis))
+    n = _axis_size((axis))
     if n == 1:
         return _local_attention(q, k, v, causal), (q, k, v)
     qh, kh, vh = _seq_to_heads((q, k, v), axis, n, interpret)
@@ -143,7 +144,7 @@ def _ulysses_fwd(q, k, v, axis, causal, interpret):
 
 def _ulysses_bwd(axis, causal, interpret, res, dout):
     qh, kh, vh = res
-    n = int(jax.lax.axis_size(axis))
+    n = _axis_size((axis))
     _, vjp = jax.vjp(lambda *a: _local_attention(*a, causal), qh, kh, vh)
     if n == 1:
         return vjp(dout)
@@ -183,7 +184,7 @@ def usp_attention(
     """
     from triton_dist_tpu.ops.grads import ring_attention_grad
 
-    n_i = int(jax.lax.axis_size(inner))
+    n_i = _axis_size((inner))
     if n_i == 1:
         return ring_attention_grad(
             q, k, v, outer, causal, ring_config, interpret, layout

@@ -297,6 +297,40 @@ def effective_mesh(mesh, axis: str = "tp"):
     return hit
 
 
+def serviceable_mesh(mesh, axis: str = "tp", validate: Callable[[int], bool] | None = None):
+    """:func:`effective_mesh`, then — when the caller's model cannot run at
+    the survivor count — shrink further to the largest world size
+    ``validate`` accepts (dropping trailing survivors).
+
+    Sharded models constrain their world size (kv heads, ffn columns, the
+    sequence shard of a serving KV cache must all divide), so excising one
+    quarantined PE can land on a count the model cannot use: 4 → 3
+    survivors with 4 kv heads. A serving loop would rather run 2-wide and
+    degraded than refuse to serve (ISSUE 6 elastic wiring) — ``validate``
+    is its divisibility predicate, and healthy PEs beyond the chosen
+    prefix sit out until probation re-admits the quarantined one and the
+    full world returns. Identity semantics match ``effective_mesh``:
+    disabled or whole worlds come back unchanged."""
+    eff = effective_mesh(mesh, axis=axis)
+    if validate is None or eff.devices.ndim != 1:
+        return eff
+    devs = list(eff.devices.flat)
+    for k in range(len(devs), 0, -1):
+        if not validate(k):
+            continue
+        if k == len(devs):
+            return eff
+        import numpy as np
+        from jax.sharding import Mesh
+
+        return Mesh(np.array(devs[:k]), (axis,))
+    raise ValueError(
+        f"no serviceable world size <= {len(devs)} survivors: the "
+        f"validate predicate rejected every candidate (model constraints "
+        f"cannot be met at any degraded world size)"
+    )
+
+
 def _probe_fused(mesh, axis: str):
     """Watchdogged device barrier over the whole world — the cheap probe.
     Times out (DistTimeoutError) if any PE, including the quarantined one,

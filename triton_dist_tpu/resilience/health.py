@@ -28,6 +28,8 @@ RETRY = "retry"               # a transient failure was retried with backoff
 RECOVERY = "recovery"         # an op entry succeeded after >= 1 retry
 PE_QUARANTINE = "pe_quarantine"   # elastic: a peer left the world
 PE_READMIT = "pe_readmit"         # elastic: a peer rejoined after probation
+SERVING_REBUILD = "serving_rebuild"  # serving engine rebuilt its batcher
+                                     # on a new world (shrink or regrow)
 
 # short-circuit pin kinds (why a family is pinned to its golden path)
 PIN_ENV = "env"               # process-global environment failure
@@ -119,6 +121,19 @@ def record_pe_readmission(pe: int) -> None:
         kind=PE_READMIT, family=f"pe{int(pe)}",
         reason="clean probation probe(s); re-admitted",
         walltime=time.time(),
+    ))
+
+
+def record_serving_rebuild(family: str, world: int, reason: str) -> None:
+    """The serving engine rebuilt its batcher on a ``world``-PE mesh
+    (serving/engine.py: elastic shrink or probation regrow, with every
+    in-flight request prefix-replayed). Informational — a rebuild is the
+    degraded-mode machinery WORKING, so it does not flip
+    :func:`is_healthy` (the quarantine that caused a shrink already
+    did)."""
+    _record(HealthEvent(
+        kind=SERVING_REBUILD, family=family,
+        reason=f"world={int(world)}: {reason}", walltime=time.time(),
     ))
 
 

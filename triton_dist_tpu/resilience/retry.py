@@ -29,6 +29,7 @@ never consulted: op entries keep their pre-existing single-attempt path.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import random
 import time
@@ -157,6 +158,20 @@ def set_clock(clock: Any) -> Any:
 
 def get_clock() -> Any:
     return _clock
+
+
+@contextlib.contextmanager
+def clock_scope(clock: Any):
+    """Context manager: install ``clock`` for the scope, restore on exit.
+    The serving engine resolves its default clock from this module
+    (serving/engine.py), so wrapping a serve loop or a bench sweep in
+    ``clock_scope(FakeClock())`` puts backoffs AND serving timestamps on
+    one deterministic timeline."""
+    prev = set_clock(clock)
+    try:
+        yield clock
+    finally:
+        set_clock(prev)
 
 
 # ---------------------------------------------------------------------------

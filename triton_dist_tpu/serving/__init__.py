@@ -1,0 +1,61 @@
+"""The serving subsystem (ISSUE 6): an SLO-metered, traffic-driven,
+elastic serving loop layered over the kernel-level scheduler
+(``models/decode.ContinuousBatcher``).
+
+Four parts (docs/serving.md "Serving engine" is the full contract):
+
+- :mod:`engine` — :class:`ServingEngine`: lifecycle timestamps at the
+  host scheduling boundary (enqueue → admitted → first token →
+  finished), a bounded arrival queue with reject/block backpressure,
+  pluggable admission (FCFS / shortest-prompt-first), graceful drain,
+  and the elastic arc: on a step timeout the batcher is rebuilt on the
+  serviceable survivor mesh with every in-flight request prefix-replayed
+  (prompt + tokens-so-far; no generation lost), and probation
+  re-admission grows the world back mid-serving.
+- :mod:`traffic` — seeded, replayable synthetic workloads (Poisson /
+  deterministic arrivals, length mixtures incl. preset-derived ones);
+  same seed ⇒ byte-identical trace.
+- :mod:`metrics` — streaming log-binned histograms (TTFT,
+  per-output-token, e2e), load gauges, SLO attainment, and a
+  ``snapshot()`` mirroring ``resilience/health.py``.
+- :mod:`bench` — the ``bench.py bench_serving`` offered-load sweep
+  (virtual clock; ``emit_info`` lines only, never perf-gated).
+
+Everything runs on an injectable clock (``resilience/retry.py``'s module
+clock by default), so whole serve runs — latency percentiles included —
+are deterministic under a :class:`~triton_dist_tpu.resilience.FakeClock`.
+"""
+
+from triton_dist_tpu.serving.engine import (
+    Finished,
+    Rejected,
+    ServingConfig,
+    ServingEngine,
+)
+from triton_dist_tpu.serving.metrics import (
+    ServingMetrics,
+    SLOTargets,
+    StreamingHistogram,
+)
+from triton_dist_tpu.serving.traffic import (
+    Arrival,
+    TrafficSpec,
+    generate_trace,
+    preset_mix,
+    trace_fingerprint,
+)
+
+__all__ = [
+    "Arrival",
+    "Finished",
+    "Rejected",
+    "ServingConfig",
+    "ServingEngine",
+    "ServingMetrics",
+    "SLOTargets",
+    "StreamingHistogram",
+    "TrafficSpec",
+    "generate_trace",
+    "preset_mix",
+    "trace_fingerprint",
+]

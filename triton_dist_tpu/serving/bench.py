@@ -1,0 +1,92 @@
+"""Offered-load sweep for ``bench.py bench_serving``: p50/p99 latency vs
+arrival rate λ over the serving engine, on a deterministic virtual clock.
+
+Every row is produced with a fresh :class:`FakeClock` and a fixed traffic
+seed, and each decode step is charged ``virtual_step_s`` on that clock —
+so the latency-vs-load CURVE (queueing delay, TTFT inflation past the
+saturation knee, SLO attainment collapse) is exact and replayable on any
+host, while ABSOLUTE times are only meaningful when ``virtual_step_s`` is
+calibrated from a chip measurement (``docs/serving_trends.md`` keeps the
+two tiers separate). Two sweeps with the same seed produce identical
+snapshots — pinned in ``tests/test_serving.py``.
+
+Emission is ``emit_info``-style only (no ``vs_baseline`` key anywhere),
+so ``scripts/perf_gate.sh`` structurally cannot gate these lines.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from triton_dist_tpu.resilience.retry import FakeClock
+from triton_dist_tpu.serving.engine import ServingConfig, ServingEngine
+from triton_dist_tpu.serving.metrics import SLOTargets
+from triton_dist_tpu.serving.traffic import TrafficSpec, generate_trace
+
+
+def sweep_offered_load(
+    cfg,
+    params,
+    mesh,
+    *,
+    s_max: int,
+    rates: tuple,
+    n_requests: int = 32,
+    prompt_len: tuple = ("fixed", 4),
+    output_len: tuple = ("fixed", 8),
+    seed: int = 0,
+    virtual_step_s: float = 0.05,
+    slo: SLOTargets | None = None,
+    serving_kw: dict | None = None,
+    batcher_kw: dict | None = None,
+) -> list[dict]:
+    """One engine + trace per λ; returns
+    ``[{"rate_rps", "snapshot", "n_finished"}, ...]`` in rate order."""
+    rows = []
+    for lam in rates:
+        clock = FakeClock()
+        spec = TrafficSpec(
+            rate_rps=float(lam), n_requests=n_requests,
+            prompt_len=prompt_len, output_len=output_len,
+            vocab=cfg.vocab, seed=seed,
+        )
+        eng = ServingEngine(
+            cfg, params, mesh, s_max=s_max, clock=clock,
+            serving=ServingConfig(
+                virtual_step_s=virtual_step_s, slo=slo,
+                **(serving_kw or {}),
+            ),
+            **(batcher_kw or {}),
+        )
+        done = eng.serve(generate_trace(spec))
+        rows.append({
+            "rate_rps": float(lam),
+            "snapshot": eng.snapshot(),
+            "n_finished": len(done),
+        })
+    return rows
+
+
+def info_lines(rows: list[dict], tag: str = "") -> list[tuple[str, Any, str]]:
+    """Flatten sweep rows into ``(metric, value, unit)`` triples for
+    ``bench.emit_info`` — the p50/p99-vs-load curve plus tokens/s, queue
+    depth, and SLO attainment. Names never carry ``vs_baseline``
+    semantics; the perf gate ignores every one of them by construction."""
+    out: list[tuple[str, Any, str]] = []
+    for row in rows:
+        lam = row["rate_rps"]
+        snap = row["snapshot"]
+        lat, load = snap["latency_ms"], snap["load"]
+        key = f"lam{lam:g}{tag}"
+        out.append((f"serving_ttft_p50_ms_{key}", lat["ttft"]["p50"], "ms"))
+        out.append((f"serving_ttft_p99_ms_{key}", lat["ttft"]["p99"], "ms"))
+        out.append((f"serving_e2e_p50_ms_{key}", lat["e2e"]["p50"], "ms"))
+        out.append((f"serving_e2e_p99_ms_{key}", lat["e2e"]["p99"], "ms"))
+        out.append((f"serving_tokens_per_s_{key}",
+                    snap["tokens"]["per_s"], "tok/s"))
+        out.append((f"serving_queue_depth_p99_{key}",
+                    load["queue_depth"]["p99"], "requests"))
+        if snap["slo"] is not None:
+            out.append((f"serving_slo_attainment_{key}",
+                        snap["slo"]["attained"], "fraction"))
+    return out

@@ -1,0 +1,530 @@
+"""The serving engine: an SLO-metered, traffic-driven, elastic loop over
+:class:`~triton_dist_tpu.models.decode.ContinuousBatcher` (ISSUE 6
+tentpole — the subsystem ABOVE the kernel-level scheduler: arrivals,
+lifecycle timestamps, backpressure, and fault-tolerant mesh shrink while
+serving live traffic).
+
+Request lifecycle (every timestamp captured at the host scheduling
+boundary, on the INJECTABLE clock — ``resilience/retry.py``'s module
+clock by default, so a ``FakeClock`` makes whole serve runs, latency
+percentiles included, deterministic)::
+
+    submit ──► [bounded queue] ──► admitted ──► first token ──► finished
+       │            │ backpressure                  │
+       └ Rejected ◄─┘ (reject-on-full | block)      └ resumed (replay)
+
+Elastic wiring (engine + ``resilience/elastic.py``): a
+``DistTimeoutError`` escaping the jitted step has already been through
+the op-entry retry/attribution machinery (``ops/common.jit_shard_map``
+retries transient trips, strikes the straggler by absence, quarantines at
+threshold, and — because the step DONATES its cache — escalates rather
+than relaunching over freed buffers). The engine is the host-level
+re-materialization layer those semantics require: it offers the failure
+to peer attribution once more (the ``retry.call_with_retry`` convention),
+rebuilds the batcher on the serviceable survivor mesh
+(``elastic.serviceable_mesh`` — possibly smaller than the survivor count
+when model divisibility demands it), and **prefix-replays** every
+in-flight request: prompt + tokens-generated-so-far re-enter as a new
+prompt, so no generated token is ever lost and greedy continuations are
+byte-identical to an uninterrupted run; sampled continuations carry their
+live RNG (``Request.rng``). TTFT is re-measured as a ``resumed`` event.
+Probation re-admission (periodic ``elastic.probe_quarantined``) grows the
+mesh back mid-serving through the same replay path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any
+
+from triton_dist_tpu.models.decode import ContinuousBatcher, Request
+from triton_dist_tpu.resilience import elastic, health
+from triton_dist_tpu.resilience import retry as _retry
+from triton_dist_tpu.serving.metrics import ServingMetrics, SLOTargets
+from triton_dist_tpu.serving.traffic import Arrival
+
+BACKPRESSURE = ("reject", "block")
+ADMISSION = ("fcfs", "spf")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Host-side serving policy.
+
+    max_queue:        bound on the arrival queue (backpressure trips past it).
+    backpressure:     "reject" returns a typed :class:`Rejected`;
+                      "block" serves (steps the engine) until space frees.
+    admission:        "fcfs" or "spf" (shortest-prompt-first).
+    virtual_step_s:   charge each decode step this much time on the
+                      engine clock — pair with a ``FakeClock`` for
+                      deterministic latency tests and the
+                      ``bench_serving`` virtual-clock rows. None (default)
+                      = real time only.
+    probe_interval_steps: steps between probation probes while any PE is
+                      quarantined (the regrow cadence).
+    max_step_failures: consecutive step timeouts tolerated (each one
+                      rebuilds + replays) before the engine re-raises.
+    slo:              latency targets scored per finished request.
+    world_ok:         optional override for the degraded-world
+                      divisibility predicate (``n -> bool``).
+    """
+
+    max_queue: int = 256
+    backpressure: str = "reject"
+    admission: str = "fcfs"
+    virtual_step_s: float | None = None
+    probe_interval_steps: int = 32
+    max_step_failures: int = 8
+    slo: SLOTargets | None = None
+    world_ok: Any = None
+
+    def validate(self) -> "ServingConfig":
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.backpressure not in BACKPRESSURE:
+            raise ValueError(
+                f"backpressure must be one of {BACKPRESSURE}, "
+                f"got {self.backpressure!r}"
+            )
+        if self.admission not in ADMISSION:
+            raise ValueError(
+                f"admission must be one of {ADMISSION}, "
+                f"got {self.admission!r}"
+            )
+        if self.probe_interval_steps < 1:
+            raise ValueError("probe_interval_steps must be >= 1")
+        if self.max_step_failures < 1:
+            raise ValueError("max_step_failures must be >= 1")
+        if self.virtual_step_s is not None and self.virtual_step_s < 0:
+            raise ValueError("virtual_step_s must be >= 0")
+        return self
+
+
+@dataclasses.dataclass(frozen=True)
+class Rejected:
+    """Typed backpressure result: the queue was full under the "reject"
+    policy. The request was NOT enqueued (it is not counted anywhere but
+    the rejection counter) — resubmit later or switch to "block"."""
+
+    uid: Any
+    reason: str
+    queue_depth: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Finished:
+    """One completed request with its lifecycle timestamps (engine-clock
+    seconds) and the full generated token list (replay prefixes
+    included)."""
+
+    uid: Any
+    tokens: list
+    t_enqueue: float
+    t_admitted: float | None
+    t_first_token: float | None
+    t_finished: float
+    resumed: int
+
+    @property
+    def ttft_ms(self) -> float:
+        return (self.t_first_token - self.t_enqueue) * 1e3
+
+    @property
+    def e2e_ms(self) -> float:
+        return (self.t_finished - self.t_enqueue) * 1e3
+
+
+@dataclasses.dataclass
+class _ReqState:
+    req: Request                     # the ORIGINAL request as submitted
+    t_enqueue: float
+    t_admitted: float | None = None
+    t_first: float | None = None
+    first_recorded: bool = False     # original-TTFT sample already taken
+    awaiting_first: bool = True      # no token seen since (re)admission
+    tokens: list = dataclasses.field(default_factory=list)  # replay prefix
+    resumed: int = 0
+
+
+class ServingEngine:
+    """See module docstring. Construction mirrors ``ContinuousBatcher``
+    (cfg/params/mesh/s_max plus its keyword surface: ``page_size``,
+    ``fd_config``, ``prefill``, ``interpret``), because the engine must be
+    able to REBUILD the batcher on a different mesh mid-serving::
+
+        eng = ServingEngine(cfg, params, mesh, s_max=256,
+                            serving=ServingConfig(max_queue=64))
+        eng.submit(Request([1, 2, 3], max_new_tokens=8))
+        eng.run_until_idle()
+        eng.results["r0"].tokens, eng.snapshot()
+
+    or traffic-driven: ``eng.serve(generate_trace(spec))``.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        params,
+        mesh,
+        *,
+        s_max: int,
+        serving: ServingConfig | None = None,
+        metrics: ServingMetrics | None = None,
+        clock: Any = None,
+        **batcher_kw: Any,
+    ):
+        self.cfg, self.params = cfg, params
+        self.full_mesh = mesh
+        self.s_max = int(s_max)
+        self.batcher_kw = dict(batcher_kw)
+        self.serving = (serving or ServingConfig()).validate()
+        # default clock = the resilience module clock, so one
+        # retry.set_clock(FakeClock()) / retry.clock_scope(...) puts
+        # backoffs and serving timestamps on the same timeline
+        self.clock = clock if clock is not None else _retry.get_clock()
+        self.metrics = metrics or ServingMetrics(slo=self.serving.slo)
+        self.family = "serving_engine"
+        self._pending: deque[_ReqState] = deque()
+        self._states: dict[Any, _ReqState] = {}
+        self.results: dict[Any, Finished] = {}
+        self.rebuilds = 0
+        self._failures = 0
+        self._steps_since_probe = 0
+        self._uid_counter = 0
+        self._stopping = False
+        self.mesh = self._target_mesh()
+        self._batcher = self._build(self.mesh)
+        self._t0 = self.clock.monotonic()
+
+    # -- world management ----------------------------------------------
+
+    @property
+    def world_size(self) -> int:
+        return int(self.mesh.devices.size)
+
+    def _world_ok(self, n: int) -> bool:
+        """Can the model + cache geometry run at world size ``n``? (The
+        serviceable-mesh predicate; override via ServingConfig.world_ok.)"""
+        if self.serving.world_ok is not None:
+            return bool(self.serving.world_ok(n))
+        c = self.cfg
+        if n < 1:
+            return False
+        if c.n_kv_heads % n or c.n_q_heads % n or c.ffn % n or c.vocab % n:
+            return False
+        if self.s_max % n:
+            return False
+        # s_max % n == 0 also covers prefill bucketing: _bucket's terminal
+        # bucket is s_max (batch * s_max then divides n too), so admission
+        # can never fail to find a bucket on an approved world — at worst
+        # an awkward n makes every prompt pay the full-s_max masked
+        # prefill (slow, never wrong)
+        page = self.batcher_kw.get("page_size")
+        if page and (self.s_max // n) % page:
+            return False
+        # EP decode shards the per-group batch rows over the axis
+        if getattr(c, "ep_max_m", None) is not None and c.batch % n:
+            return False
+        return True
+
+    def _target_mesh(self):
+        """The mesh serving should run on right now: the full mesh while
+        every PE is serviceable, else the largest model-valid survivor
+        prefix. Elastic shrink only governs 1-D worlds (elastic.py); a
+        hierarchical mesh serves un-shrunk."""
+        if self.full_mesh.devices.ndim != 1 or not elastic.enabled():
+            return self.full_mesh
+        return elastic.serviceable_mesh(
+            self.full_mesh, axis=self.cfg.axis, validate=self._world_ok
+        )
+
+    def _build(self, mesh) -> ContinuousBatcher:
+        return ContinuousBatcher(
+            self.cfg, self.params, mesh, s_max=self.s_max, **self.batcher_kw
+        )
+
+    # -- submission / admission ----------------------------------------
+
+    def submit(self, req: Request, *, arrival_t: float | None = None):
+        """Enqueue one request. Returns its uid, or a typed
+        :class:`Rejected` when the bounded queue is full under the
+        "reject" policy ("block" steps the engine until space frees).
+        ``arrival_t`` backdates the enqueue timestamp to the offered
+        arrival time (the serve loop passes it so queueing delay accrued
+        while the host was mid-step still counts toward TTFT)."""
+        now = self.clock.monotonic() if arrival_t is None else float(arrival_t)
+        if req.uid is None:
+            req = dataclasses.replace(req, uid=f"r{self._uid_counter}")
+            self._uid_counter += 1
+        if req.uid in self._states or req.uid in self.results:
+            raise ValueError(f"duplicate request uid {req.uid!r}")
+        self._batcher.validate_request(req)
+        self.metrics.count("submitted")
+        if len(self._pending) >= self.serving.max_queue:
+            if self.serving.backpressure == "reject":
+                self.metrics.count("rejected")
+                return Rejected(
+                    req.uid,
+                    f"arrival queue full ({self.serving.max_queue})",
+                    len(self._pending),
+                )
+            while len(self._pending) >= self.serving.max_queue:
+                if not self._step_once():
+                    raise RuntimeError(
+                        "blocking submit cannot make progress: the arrival "
+                        "queue is full but the engine is idle (max_queue "
+                        "smaller than the batch can absorb?)"
+                    )
+        st = _ReqState(req=req, t_enqueue=now)
+        self._states[req.uid] = st
+        self._pending.append(st)
+        self._admit(self.clock.monotonic())
+        return req.uid
+
+    def _pop_admission(self) -> _ReqState:
+        if self.serving.admission == "fcfs":
+            return self._pending.popleft()
+        # shortest-prompt-first (stable: earliest among equals)
+        best = min(range(len(self._pending)),
+                   key=lambda i: (len(self._pending[i].req.prompt), i))
+        st = self._pending[best]
+        del self._pending[best]
+        return st
+
+    def _admit(self, now: float) -> None:
+        while self._batcher.n_free_slots > 0 and self._pending:
+            st = self._pop_admission()
+            st.t_admitted = now
+            self.metrics.count("admitted")
+            self._batcher.submit(st.req)
+
+    # -- the step loop --------------------------------------------------
+
+    def _step_once(self) -> bool:
+        """Admit + one batcher step. False when there is nothing to do."""
+        self._admit(self.clock.monotonic())
+        if self._batcher.idle:
+            return False
+        try:
+            self._batcher.step()
+        except Exception as exc:  # noqa: BLE001 — classified below
+            if _retry.timeout_in_chain(exc) is None:
+                raise
+            self._on_step_timeout(exc)
+            return True
+        self._failures = 0
+        if self.serving.virtual_step_s:
+            self.clock.sleep(self.serving.virtual_step_s)
+        self._observe(self.clock.monotonic())
+        self._maybe_probe()
+        return True
+
+    def _observe(self, now: float) -> None:
+        b = self._batcher
+        self.metrics.observe_step(
+            queue_depth=len(self._pending) + len(b.queue),
+            occupied=b.n_active, slots=self.cfg.batch,
+        )
+        for i, r in enumerate(b.slot_req):
+            if r is None:
+                continue
+            st = self._states[r.uid]
+            if st.awaiting_first and b.slot_out[i]:
+                self._record_first(st, now)
+        for uid, toks in b.drain_finished():
+            self._finalize(uid, toks, now)
+
+    def _record_first(self, st: _ReqState, now: float) -> None:
+        st.awaiting_first = False
+        st.t_first = now
+        ttft_ms = (now - st.t_enqueue) * 1e3
+        if st.resumed:
+            # the replay contract: TTFT after a disruption is re-measured
+            # and reported as a RESUMED event, never mixed into the clean
+            # TTFT distribution
+            self.metrics.observe_first_token(ttft_ms, resumed=True)
+        elif not st.first_recorded:
+            st.first_recorded = True
+            self.metrics.observe_first_token(ttft_ms, resumed=False)
+
+    def _finalize(self, uid: Any, toks: list, now: float) -> None:
+        st = self._states.pop(uid)
+        if st.awaiting_first and toks:
+            # finished within its admission step (instant EOS / prefill
+            # one-shot): the first token was never observed mid-slot
+            self._record_first(st, now)
+        tokens = st.tokens + list(toks)
+        ttft_ms = (st.t_first - st.t_enqueue) * 1e3
+        e2e_ms = (now - st.t_enqueue) * 1e3
+        # per-output-token latency over the FINAL uninterrupted segment
+        # only: after a replay, st.t_first is the post-resume first token,
+        # so dividing by the TOTAL count would average the replay prefix's
+        # tokens into a span that never generated them and understate tpot
+        # exactly in the elastic-arc runs this metric exists to judge
+        tpot_ms = (
+            (now - st.t_first) / (len(toks) - 1) * 1e3
+            if len(toks) > 1 else None
+        )
+        self.metrics.observe_finished(
+            ttft_ms=ttft_ms, e2e_ms=e2e_ms, tpot_ms=tpot_ms,
+            n_tokens=len(tokens),
+        )
+        if uid in self.results:
+            raise RuntimeError(
+                f"request {uid!r} finished twice — replay bookkeeping bug"
+            )
+        self.results[uid] = Finished(
+            uid=uid, tokens=tokens, t_enqueue=st.t_enqueue,
+            t_admitted=st.t_admitted, t_first_token=st.t_first,
+            t_finished=now, resumed=st.resumed,
+        )
+
+    # -- elastic shrink / regrow ---------------------------------------
+
+    def _on_step_timeout(self, exc: BaseException) -> None:
+        # offer the failure to peer attribution (the call_with_retry
+        # convention; a no-op unless config.elastic) — by quarantine
+        # threshold the straggler is out and _target_mesh shrinks
+        elastic.note_timeout_exc(exc, family=self.family)
+        self.metrics.count("step_timeouts")
+        self._failures += 1
+        if self._failures > self.serving.max_step_failures:
+            raise RuntimeError(
+                f"serving engine: {self._failures} consecutive step "
+                f"timeouts without recovering — rebuild/replay cannot make "
+                f"progress (see resilience.health.snapshot())"
+            ) from exc
+        self._rebuild("step timeout")
+
+    def _rebuild(self, reason: str) -> None:
+        """Rebuild the batcher on the current target mesh and prefix-replay
+        every in-flight request. The old step's donated cache is dead
+        either way (a timed-out donating step consumed it), so replay —
+        prompt + tokens-so-far re-entering as a fresh prompt — is the
+        re-materialization path; no generated token is lost."""
+        old = self._batcher
+        now = self.clock.monotonic()
+        # completed work survives first (the drain_finished contract)
+        for uid, toks in old.drain_finished():
+            self._finalize(uid, toks, now)
+        active, queued = old.export_in_flight()
+        target = self._target_mesh()
+        self.rebuilds += 1
+        self.metrics.count("rebuilds")
+        health.record_serving_rebuild(
+            self.family, world=int(target.devices.size),
+            reason=f"{reason}; {len(active)} in-flight replayed, "
+                   f"{len(queued)} re-queued",
+        )
+        self.mesh = target
+        self._batcher = self._build(target)
+        for req, toks, rng in active:
+            st = self._states[req.uid]
+            st.tokens.extend(toks)
+            st.resumed += 1
+            st.awaiting_first = True
+            st.t_first = st.t_first if st.first_recorded else None
+            self.metrics.count("resumed")
+            # prefix replay: everything generated so far becomes prompt;
+            # the live RNG continues a sampled stream mid-draw
+            self._batcher.submit(dataclasses.replace(
+                st.req,
+                prompt=list(st.req.prompt) + st.tokens,
+                max_new_tokens=st.req.max_new_tokens - len(st.tokens),
+                rng=rng,
+            ))
+        for req in queued:
+            # admitted but never started (possibly already a replay):
+            # resubmit verbatim
+            self._batcher.submit(req)
+
+    def _maybe_probe(self) -> None:
+        if self.full_mesh.devices.ndim != 1 or not elastic.enabled():
+            return
+        if not elastic.quarantined_pes():
+            self._steps_since_probe = 0
+            return
+        self._steps_since_probe += 1
+        if self._steps_since_probe < self.serving.probe_interval_steps:
+            return
+        self._steps_since_probe = 0
+        elastic.probe_quarantined(self.full_mesh, axis=self.cfg.axis)
+        target = self._target_mesh()
+        if list(target.devices.flat) != list(self.mesh.devices.flat):
+            self._rebuild("probation re-admission regrew the world")
+
+    # -- driving --------------------------------------------------------
+
+    def serve(self, traffic=(), *, max_steps: int = 1_000_000) -> dict:
+        """Drive a (time-sorted or not) iterable of :class:`Arrival`
+        through the engine until all offered traffic is ingested and —
+        unless :meth:`stop` said otherwise — every request finished.
+        Between work, the loop sleeps the (injectable) clock to the next
+        arrival. Returns ``dict(self.results)``."""
+        arrivals = deque(sorted(traffic, key=lambda a: a.t_s))
+        steps = 0
+        while True:
+            now = self.clock.monotonic()
+            if self._stopping and arrivals:
+                self.metrics.count("cancelled", len(arrivals))
+                arrivals.clear()
+            while arrivals and arrivals[0].t_s <= now:
+                a = arrivals.popleft()
+                self.submit(a.request, arrival_t=a.t_s)
+            if self._step_once():
+                steps += 1
+                if steps >= max_steps:
+                    raise RuntimeError(
+                        f"serve(max_steps={max_steps}) exhausted with work "
+                        f"still in flight; finished results are intact in "
+                        f"self.results"
+                    )
+                continue
+            if arrivals:
+                dt = arrivals[0].t_s - self.clock.monotonic()
+                if dt > 0:
+                    self.clock.sleep(dt)
+                continue
+            return dict(self.results)
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> dict:
+        """Serve what is already queued/in flight (no new traffic)."""
+        return self.serve((), max_steps=max_steps)
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop ingesting new traffic. ``drain=True`` (graceful): every
+        already-enqueued request still runs to completion on the next
+        ``serve``/``run_until_idle``. ``drain=False``: the arrival queue
+        is cancelled (counted, never silently dropped); in-flight slots
+        still finish — abandoning them mid-device would lose work for no
+        capacity gain."""
+        self._stopping = True
+        if not drain:
+            while self._pending:
+                st = self._pending.popleft()
+                del self._states[st.req.uid]
+                self.metrics.count("cancelled")
+
+    # -- readout --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The engine's health.snapshot() analogue: serving metrics plus
+        world/queue/compile-cache facts. Deterministic under a FakeClock
+        (nothing here reads wall time)."""
+        now = self.clock.monotonic()
+        snap = self.metrics.snapshot()
+        elapsed = max(now - self._t0, 1e-9)
+        snap["tokens"]["per_s"] = round(
+            self.metrics.tokens_generated / elapsed, 6
+        )
+        snap["engine"] = {
+            "world_size": self.world_size,
+            "full_world_size": int(self.full_mesh.devices.size),
+            "rebuilds": self.rebuilds,
+            "queue_depth": len(self._pending),
+            "in_flight": len(self._states) - len(self._pending),
+            "prefill_bucket_programs": self._batcher.prefill_bucket_count,
+            "clock_s": round(now - self._t0, 9),
+        }
+        return snap

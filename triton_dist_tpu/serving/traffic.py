@@ -1,0 +1,220 @@
+"""Seeded, replayable synthetic workloads for the serving engine.
+
+A :class:`TrafficSpec` describes an arrival process (Poisson or
+deterministic-interval), prompt/output length distributions, and sampling
+parameters; :func:`generate_trace` expands it into a tuple of
+:class:`Arrival` (time-sorted ``(t_s, Request)`` pairs). Everything is
+derived from one ``numpy`` PRNG seeded by ``spec.seed``, so the same spec
+produces a byte-identical trace — :func:`trace_fingerprint` hashes the
+full trace and tests pin the replay guarantee on it.
+
+Length distributions are small tagged tuples (JSON-able, hashable):
+
+- ``("fixed", n)``
+- ``("uniform", lo, hi)``          — inclusive integer range
+- ``("mix", ((w, lo, hi), ...))``  — weighted mixture of uniform ranges
+
+:func:`preset_mix` derives a multi-tenant-looking mixture from a
+``models/presets.py`` shape: the preset supplies the vocabulary and its
+context length sets the scale, clamped into the serving cache budget
+(``s_max``) so every generated request is admissible by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Any
+
+import numpy as np
+
+from triton_dist_tpu.models.decode import Request
+
+PROCESSES = ("poisson", "deterministic")
+
+
+def sample_length(dist: tuple, rng: np.random.Generator) -> int:
+    """Draw one integer length from a tagged length distribution."""
+    kind = dist[0]
+    if kind == "fixed":
+        return int(dist[1])
+    if kind == "uniform":
+        lo, hi = int(dist[1]), int(dist[2])
+        return int(rng.integers(lo, hi + 1))
+    if kind == "mix":
+        arms = dist[1]
+        w = np.array([a[0] for a in arms], np.float64)
+        arm = arms[int(rng.choice(len(arms), p=w / w.sum()))]
+        return int(rng.integers(int(arm[1]), int(arm[2]) + 1))
+    raise ValueError(f"unknown length distribution {dist!r}")
+
+
+def _validate_dist(name: str, dist: tuple) -> None:
+    try:
+        kind = dist[0]
+        if kind == "fixed":
+            ok = int(dist[1]) >= 1
+        elif kind == "uniform":
+            ok = 1 <= int(dist[1]) <= int(dist[2])
+        elif kind == "mix":
+            ok = len(dist[1]) >= 1 and all(
+                float(w) > 0 and 1 <= int(lo) <= int(hi)
+                for (w, lo, hi) in dist[1]
+            )
+        else:
+            ok = False
+    except (TypeError, IndexError, ValueError):
+        ok = False
+    if not ok:
+        raise ValueError(
+            f"{name} must be ('fixed', n), ('uniform', lo, hi) or "
+            f"('mix', ((w, lo, hi), ...)) with positive sane values; "
+            f"got {dist!r}"
+        )
+
+
+def max_length(dist: tuple) -> int:
+    """The largest value a length distribution can produce (admissibility
+    checks: prompt_max + output_max must fit the cache)."""
+    kind = dist[0]
+    if kind == "fixed":
+        return int(dist[1])
+    if kind == "uniform":
+        return int(dist[2])
+    return max(int(hi) for (_, _, hi) in dist[1])
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    """One scheduled request: ``t_s`` is the offered arrival time on the
+    engine's (injectable) clock."""
+
+    t_s: float
+    request: Request
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficSpec:
+    """A replayable workload description (see module docstring).
+
+    ``rate_rps`` is the offered load λ (mean arrivals/second); under
+    ``process="deterministic"`` arrivals land exactly ``1/λ`` apart.
+    Per-request sampling seeds are derived from ``seed`` and the request
+    index, so a request's tokens are reproducible independently of the
+    trace position it was drawn at."""
+
+    rate_rps: float
+    n_requests: int
+    process: str = "poisson"
+    prompt_len: tuple = ("fixed", 8)
+    output_len: tuple = ("fixed", 16)
+    vocab: int = 256
+    temperature: float = 0.0
+    top_k: int | None = None
+    eos_id: int | None = None
+    seed: int = 0
+    start_s: float = 0.0
+    uid_prefix: str = "req"
+
+    def validate(self) -> "TrafficSpec":
+        if self.rate_rps <= 0:
+            raise ValueError(f"rate_rps must be > 0, got {self.rate_rps}")
+        if self.n_requests < 1:
+            raise ValueError(f"n_requests must be >= 1, got {self.n_requests}")
+        if self.process not in PROCESSES:
+            raise ValueError(
+                f"process must be one of {PROCESSES}, got {self.process!r}"
+            )
+        if self.vocab < 2:
+            raise ValueError(f"vocab must be >= 2, got {self.vocab}")
+        _validate_dist("prompt_len", self.prompt_len)
+        _validate_dist("output_len", self.output_len)
+        return self
+
+
+def generate_trace(spec: TrafficSpec) -> tuple[Arrival, ...]:
+    """Expand a spec into its (time-sorted) arrival trace. Same spec ⇒
+    byte-identical trace (one PRNG, fixed draw order)."""
+    spec.validate()
+    rng = np.random.default_rng(spec.seed)
+    out = []
+    t = float(spec.start_s)
+    for i in range(spec.n_requests):
+        if spec.process == "poisson":
+            t += float(rng.exponential(1.0 / spec.rate_rps))
+        else:
+            t += 1.0 / spec.rate_rps
+        p_len = sample_length(spec.prompt_len, rng)
+        o_len = sample_length(spec.output_len, rng)
+        prompt = [int(x) for x in rng.integers(0, spec.vocab, p_len)]
+        out.append(Arrival(
+            t_s=t,
+            request=Request(
+                prompt=prompt,
+                max_new_tokens=o_len,
+                eos_id=spec.eos_id,
+                temperature=spec.temperature,
+                top_k=spec.top_k,
+                # derived per-request seed: reproducible independent of
+                # neighbors (the documented sampling guarantee)
+                seed=int(spec.seed) * 1_000_003 + i,
+                uid=f"{spec.uid_prefix}{i}",
+            ),
+        ))
+    return tuple(out)
+
+
+def trace_fingerprint(trace: tuple[Arrival, ...]) -> str:
+    """Stable content hash of a trace — the byte-identical-replay pin."""
+    h = hashlib.sha256()
+    for a in trace:
+        h.update(repr((
+            round(a.t_s, 12), a.request.prompt, a.request.max_new_tokens,
+            a.request.eos_id, a.request.temperature, a.request.top_k,
+            a.request.seed, a.request.uid,
+        )).encode())
+    return h.hexdigest()
+
+
+def preset_mix(
+    name: str,
+    *,
+    s_max: int,
+    rate_rps: float,
+    n_requests: int,
+    seed: int = 0,
+    vocab: int | None = None,
+    **overrides: Any,
+) -> TrafficSpec:
+    """A multi-tenant length mixture derived from a ``models/presets.py``
+    shape: short-chat / medium / long-document prompt arms scaled off the
+    preset's context length and clamped into ``s_max`` so the worst-case
+    ``prompt + output`` always fits the serving cache. The preset supplies
+    the vocabulary (override for shrunk test/serving configs whose logit
+    head is smaller than the open-weight model's)."""
+    from triton_dist_tpu.models import presets
+
+    cfg = presets.preset(name)
+    if s_max < 8:
+        raise ValueError(f"preset_mix needs s_max >= 8, got {s_max}")
+    # preset seq sets the aspiration; s_max is the budget actually served
+    scale = min(int(cfg.seq), int(s_max))
+    short_hi = max(2, scale // 32)
+    med_hi = max(short_hi + 1, scale // 8)
+    long_hi = max(med_hi + 1, scale // 2)
+    prompt = ("mix", (
+        (0.6, 2, short_hi),
+        (0.3, min(short_hi + 1, med_hi), med_hi),
+        (0.1, min(med_hi + 1, long_hi), long_hi),
+    ))
+    out_hi = max(1, min(scale // 4, s_max - max_length(prompt)))
+    output = ("uniform", 1, out_hi)
+    return TrafficSpec(
+        rate_rps=rate_rps,
+        n_requests=n_requests,
+        prompt_len=prompt,
+        output_len=output,
+        vocab=int(vocab if vocab is not None else cfg.vocab),
+        seed=seed,
+        **overrides,
+    ).validate()

@@ -20,6 +20,23 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def axis_size(axis: str) -> int:
+    """Static mesh-axis size inside ``shard_map``, portable across jax
+    lines: ``jax.lax.axis_size`` where it exists (jax >= 0.5), else the
+    documented psum-of-the-static-unit idiom — ``lax.psum(1, axis)`` of a
+    concrete Python int resolves to a plain int at TRACE time, so either
+    branch is free at runtime. The serving/model host paths use this so a
+    jax line without ``axis_size`` serves through the golden-collective
+    fallbacks instead of dying on the AttributeError before any op entry
+    can degrade. (Deliberately NOT monkeypatched onto ``jax.lax``: tests
+    gate fused-kernel tiers on ``hasattr(jax.lax, "axis_size")`` as a
+    jax-line proxy, and faking the attribute would un-skip them.)"""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return int(fn(axis))
+    return int(jax.lax.psum(1, axis))
+
+
 def cdiv(a: int, b: int) -> int:
     return -(-a // b)
 
