@@ -600,6 +600,66 @@ def bench_moe_w8(mesh, n):
         t_f, "ms", ratio,
     )
 
+    # ---- fused-overlap w8 A/B (ISSUE 7, informational) ----
+    # The w8 axis now rides the OVERLAPPED pipeline (GroupGemmConfig.w8 —
+    # both fused kernels stream int8 weight slabs): pair the fused MoE
+    # pipeline under w8 against its bf16 twin at the same decode shape.
+    # emit_info only — no vs_baseline key, so perf_gate.sh structurally
+    # cannot gate it (the gating story lives in BASELINE.json's
+    # _moe_w8_floor_pending note: land >= 1.7 on the main metric first).
+    # Best-effort: a failure here must not discard the main line above.
+    if n > 1:
+        try:
+            _bench_moe_w8_fused(mesh, n, m_tok, h_dim, f_dim, n_exp, topk)
+        except Exception as e:  # noqa: BLE001 — attribution is optional
+            import sys
+
+            print(f"[bench moe_w8] fused-overlap A/B skipped: {e!r:.200}",
+                  file=sys.stderr, flush=True)
+
+
+def _bench_moe_w8_fused(mesh, n, m_tok, h_dim, f_dim, n_exp, topk):
+    import dataclasses as dc
+
+    from triton_dist_tpu.ops.grads import tp_moe_mlp_op
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+    from triton_dist_tpu.ops.moe_utils import select_experts
+
+    f_dim = (f_dim // n) * n
+    kx, kw, kl = jax.random.split(jax.random.PRNGKey(9), 3)
+    tw, ids = select_experts(
+        jax.random.normal(kl, (m_tok, n_exp), jnp.float32), topk
+    )
+    x = jax.device_put(
+        jax.random.normal(kx, (m_tok, h_dim), jnp.bfloat16),
+        NamedSharding(mesh, P("tp", None)),
+    )
+    ku, kd = jax.random.split(kw)
+    w_up = jax.random.normal(ku, (n_exp, h_dim, f_dim), jnp.bfloat16) / 16
+    w_down = jax.random.normal(kd, (n_exp, f_dim, h_dim), jnp.bfloat16) / 16
+    base_cfg = (
+        GroupGemmConfig(8, 32, 32) if _CPU_FALLBACK
+        else GroupGemmConfig(128, 1024, 512)
+    )
+    w8_cfg = dc.replace(base_cfg, w8=True)
+    fused_w8 = lambda x, wu, wd, i, t: tp_moe_mlp_op(  # noqa: E731
+        x, wu, wd, i, t, mesh, overlap=True, config=w8_cfg
+    )
+    fused_bf = lambda x, wu, wd, i, t: tp_moe_mlp_op(  # noqa: E731
+        x, wu, wd, i, t, mesh, overlap=True, config=base_cfg
+    )
+    args = (x, w_up, w_down, ids, tw)
+    out8 = fused_w8(*args)
+    outb = fused_bf(*args)
+    np.testing.assert_allclose(
+        np.asarray(out8[:32], np.float32), np.asarray(outb[:32], np.float32),
+        atol=0.5, rtol=6e-2,
+    )
+    t8, tb, ratio = bench_pair(fused_w8, fused_bf, args, iters=_it(64))
+    tag = f"tp{n}_m{m_tok}e{n_exp}k{topk}h{h_dim}f{f_dim}"
+    emit_info(f"moe_w8_fused_pipeline_ms_{tag}", t8, "ms")
+    emit_info(f"moe_w8_fused_vs_bf16_{tag}", ratio, "x")
+
 
 def bench_ag_gemm(mesh, n):
     """Flagship: column-parallel up-proj, M=8192 LLaMA-3.1-8B (K=4096,

@@ -2,11 +2,15 @@
 # Run the FULL resilience fault-injection matrix standalone
 # (tests/test_chaos.py + tests/test_elastic.py + the chunk-signal cells
 # of tests/test_chunked.py and tests/test_chunked_a2a.py + the ragged
-# chunk-fault cells of tests/test_ragged.py + the serving-engine cells
+# chunk-fault cells of tests/test_ragged.py + the emitter cells of
+# tests/test_emitter.py + the serving-engine cells
 # of tests/test_serving.py, docs/resilience.md): every kernel family ×
 # drop/dup/delay signal + straggler PE, the ring and a2a/MoE chunk-fault
 # cells (ISSUE 3/4), the ragged-pipeline cells (ISSUE 5: ragged tail
-# blocks must add no droppable signal edge), the forced-compile-failure
+# blocks must add no droppable signal edge), the emitter cells (ISSUE 7:
+# a dropped/dup'd chunk signal under the w8 ragged chunked pipeline must
+# name only pre-existing diagnostic kinds or stay exact — the w8 scale
+# DMAs add no signal edges), the forced-compile-failure
 # degradation cases, the elastic arcs
 # (retry/quarantine/shrink/readmit), and the elastic SERVING arcs
 # (ISSUE 6: persistent straggler mid-serving → quarantine → the engine
@@ -39,7 +43,7 @@ trap 'rm -f "$log"' EXIT
 set +e
 env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_elastic.py \
     tests/test_chunked.py tests/test_chunked_a2a.py tests/test_ragged.py \
-    tests/test_serving.py \
+    tests/test_emitter.py tests/test_serving.py \
     -m chaos -v -rs -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
     2>&1 | tee "$log"
 rc=${PIPESTATUS[0]}

@@ -305,11 +305,15 @@ def test_generate_moe_matches_full_forward(mesh4):
         )
         cfg_s = dc.replace(cfg, seq=seq_p, batch=b)
         model = TPMoETransformer(cfg_s)
+        # the repo's shard_map compat shim (ops.common): the golden full
+        # forward must run on every supported jax line, like the ops do
+        from triton_dist_tpu.ops.common import _shard_map
+
         logits = jax.jit(
-            jax.shard_map(
-                lambda t, p: model(t, p), mesh=mesh4,
-                in_specs=(P2("tp"), moe_param_specs(cfg_s)),
-                out_specs=P2(None, "tp"), check_vma=False,
+            _shard_map(
+                lambda t, p: model(t, p), mesh4,
+                (P2("tp"), moe_param_specs(cfg_s)),
+                P2(None, "tp"),
             )
         )(jnp.asarray(toks_p.reshape(-1)), params)
         logits = np.asarray(logits).reshape(b, seq_p, cfg.vocab)
@@ -402,12 +406,14 @@ def test_generate_moe_quantized_experts(mesh4):
         [prompt, jnp.zeros((b, n_steps), jnp.int32)], axis=1
     ).reshape(-1)  # [b * cfg.seq] (cfg.seq = prompt_len + n_steps)
 
+    from triton_dist_tpu.ops.common import _shard_map
+
     def logits_of(p):
         return jax.jit(
-            jax.shard_map(
-                lambda t, pp: model(t, pp), mesh=mesh4,
-                in_specs=(P("tp"), specs_for(cfg, p)),
-                out_specs=P(None, "tp"), check_vma=False,
+            _shard_map(
+                lambda t, pp: model(t, pp), mesh4,
+                (P("tp"), specs_for(cfg, p)),
+                P(None, "tp"),
             )
         )(toks, jax.tree.map(
             lambda x, s: jax.device_put(

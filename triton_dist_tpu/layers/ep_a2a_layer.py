@@ -128,6 +128,12 @@ class EPAll2AllLayer:
     has no gradient, so quantized dispatch does not differentiate (the
     combine return path stays full-precision either way, as in the
     reference).
+
+    The wire axis composes with the WEIGHT-side ``GroupGemmConfig.w8``
+    axis (ISSUE 7): ``quant`` halves what the a2a moves (ICI), ``w8``
+    halves what the local grouped GEMMs stream (HBM) — orthogonal
+    resources, so the full serving posture sets both
+    (``EPMoEMLP(quant="int8", gg_config=GroupGemmConfig(w8=True))``).
     """
 
     n_experts: int

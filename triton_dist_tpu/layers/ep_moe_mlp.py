@@ -28,7 +28,11 @@ import jax.numpy as jnp
 from triton_dist_tpu import config as tdt_config
 from triton_dist_tpu.layers.ep_a2a_layer import EPAll2AllLayer, HierEPAll2AllLayer
 from triton_dist_tpu.ops.grads import group_gemm_grad
-from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
+from triton_dist_tpu.ops.group_gemm import (
+    GroupGemmConfig,
+    group_gemm,
+    quantize_expert_weights,
+)
 from triton_dist_tpu.utils import axis_size as _axis_size
 
 
@@ -131,14 +135,33 @@ class EPMoEMLP:
         ``ops.quantize_expert_weights``) mark the expert banks as int8:
         the local grouped GEMMs stream half the weight bytes (the
         resource decode-shaped expert compute is bound by) via the
-        scale-folding kernel. INFERENCE only — the int8 path takes the
-        non-VJP grouped GEMM."""
+        scale-folding kernel. ``gg_config.w8`` (ISSUE 7) quantizes float
+        banks on the fly instead — the same config axis the TP pipeline
+        sweeps, so one knob covers both MoE parallelisms. INFERENCE only
+        — the int8 path takes the non-VJP grouped GEMM."""
         cfg = self.gg_config or GroupGemmConfig()
         layer = self._transport()
         hier = self.outer is not None
         m_loc = x.shape[0]
         if (w_up_scale is None) != (w_down_scale is None):
             raise ValueError("pass both expert-weight scales, or neither")
+        if cfg.w8 and w_up_scale is None:
+            # the GroupGemmConfig w8 axis: quantize the local banks here
+            # (whole experts — per-(expert, out-column) scales as always).
+            # An int8 bank without scales must fail loudly, exactly as
+            # ops-level resolve_w8 does — re-quantizing quantized values
+            # would silently discard the original scales.
+            if not (
+                jnp.issubdtype(w_up.dtype, jnp.floating)
+                and jnp.issubdtype(w_down.dtype, jnp.floating)
+            ):
+                raise ValueError(
+                    "GroupGemmConfig.w8 with integer expert banks needs "
+                    "the matching scales (pass w_up_scale/w_down_scale "
+                    "from quantize_expert_weights)"
+                )
+            w_up, w_up_scale = quantize_expert_weights(w_up)
+            w_down, w_down_scale = quantize_expert_weights(w_down)
         w8 = w_up_scale is not None
 
         if hier:

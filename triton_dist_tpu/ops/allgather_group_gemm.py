@@ -27,6 +27,12 @@ TPU-native composition (two entries):
   costs ~topk× ICI payload (token rows duplicate per assignment, exactly
   as EP dispatch duplicates them over the network) but the ring rides
   under the grouped GEMM, whose arithmetic intensity dwarfs it.
+
+The overlap kernel body comes from the pipeline emitter
+(:func:`triton_dist_tpu.ops.gg_pipeline.make_ag_overlap_kernel`, ISSUE 7);
+this entry only builds specs/scratch for the chosen policy tuple, and
+``GroupGemmConfig.w8`` streams int8 weight slabs at HALF the HBM bytes —
+the decode regime's weight-traffic win, now inside the overlapped path.
 """
 
 from __future__ import annotations
@@ -43,19 +49,19 @@ from jax.sharding import Mesh, PartitionSpec as P
 from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.allgather import all_gather
 from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
+from triton_dist_tpu.ops.gg_pipeline import OperandFormat, make_ag_overlap_kernel
 from triton_dist_tpu.ops.group_gemm import (
     GroupGemmConfig,
+    _group_gemm_xla,
     _panel_for,
     group_gemm,
+    resolve_w8,
 )
 from triton_dist_tpu.ops.moe_utils import (
-    MoEAlignment,
     RankedAlignment,
     gather_sorted_rows,
     moe_align_block_size,
-    moe_align_ranked,
 )
-from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block
 from triton_dist_tpu.utils import axis_size as _axis_size
 
@@ -112,506 +118,6 @@ def gather_group_blocks_for(
     return max(1, min(nb, budget // (2 * bm * k_dim * itemsize)))
 
 
-def _ragged_block_emit(
-    a_rows, b_tile, out_stage, oslot_base, v, bm, bn, panel, out_dtype,
-):
-    """Ragged compute+stage for one row block of an overlapped kernel
-    (ISSUE 5): MXU dots run only for the block's live ``panel``-row panels
-    (``pl.when``-guarded), the tail panel's dead rows are zero-masked, and
-    dead panels stage exact zeros — so the out buffer is fully defined and
-    a downstream 0-weight combine can never meet NaN junk. ``a_rows`` maps
-    a panel's row span to its A rows; ``oslot_base`` is the block's first
-    staged row."""
-    for p in range(bm // panel):
-        live = p * panel < v
-
-        @pl.when(live)
-        def _(p=p):
-            yp = jnp.dot(
-                a_rows(p * panel, panel), b_tile,
-                preferred_element_type=jnp.float32,
-            )
-            rows = (
-                jax.lax.broadcasted_iota(jnp.int32, (panel, bn), 0)
-                + p * panel
-            )
-            out_stage[pl.ds(oslot_base + p * panel, panel), :] = jnp.where(
-                rows < v, yp, 0.0
-            ).astype(out_dtype)
-
-        @pl.when(jnp.logical_not(live))
-        def _(p=p):
-            out_stage[pl.ds(oslot_base + p * panel, panel), :] = jnp.zeros(
-                (panel, bn), out_dtype
-            )
-
-
-def _ag_group_gemm_overlap_kernel(
-    eid_ref, a_ref, b_ref,
-    out_ref, ag_ref,
-    a_all, b_buf, out_stage,
-    copy_sem, send_sems, recv_sems, gsems, bsem, outsem,
-    *, axis: str, n: int, nb: int, n_jn: int, bn: int, bpg: int, bm: int,
-    out_dtype, vid_ref=None, panel: int = 0,
-):
-    """Fused ring-AG + grouped GEMM over PRE-SORTED slabs: the ring
-    delivers each rank's block-aligned [t_pad_loc, K] slab; arriving chunks
-    are streamed into VMEM in double-buffered groups of ``bpg`` blocks (one
-    bulk aligned DMA per group — no per-row traffic) and consumed by a
-    jn-outer / block-inner MXU loop that re-fetches an expert's weight slab
-    only when the expert changes (the consecutive-block reuse the grid-based
-    ``group_gemm`` gets from Pallas's index-map equality).
-
-    ``vid_ref`` (ragged mode, ISSUE 5 — fed by the
-    ``_ag_group_gemm_overlap_ragged_kernel`` entry) carries the per-(rank,
-    block) live-row map: each block's dot runs as ``pl.when``-guarded
-    ``panel``-row panels so alignment pad rows cost no MXU time, and dead
-    rows stage exact zeros. ``vid_ref=None`` (the legacy entry) traces the
-    original schedule unchanged — ring, DMA, and semaphore structure are
-    identical in both modes (ragged adds NO signal edges)."""
-    me = shmem.my_pe(axis)
-    t_pad_loc = nb * bm
-    it_counter = [0]  # trace-time global (block, jn) iteration count
-
-    # n >= 2 always: the host entry dispatches world-1 to the grid
-    # group_gemm before building this kernel
-    local = pltpu.make_async_copy(
-        a_ref, ag_ref.at[pl.ds(me * t_pad_loc, t_pad_loc)], copy_sem
-    )
-    local.start()
-    local.wait()
-    shmem.barrier_all(axis)
-    right = jax.lax.rem(me + 1, n)
-
-    # Weight-slab prefetch chain (VERDICT r5 `moe` gap): the FIRST slab of
-    # every gather group used to be fetched in the group preamble and
-    # waited immediately — a full [K, bn] HBM stall per group/step
-    # boundary. Now the double-buffer slot carries across groups AND ring
-    # steps, and each boundary's first slab is prefetched from inside the
-    # previous group's compute loop (the `_iter` boundary arm below) — so
-    # a step boundary's weight fetch also rides under the ring-chunk wait.
-    # Only the very first slab of the whole schedule is fetched here.
-    pltpu.make_async_copy(
-        b_ref.at[eid_ref[me, 0], :, pl.ds(0, bn)], b_buf.at[0], bsem.at[0]
-    ).start()
-    slot_carry = [jnp.int32(1)]  # traced carry: _iter's weight buffer slot
-
-    descs = []
-    for s in range(n):
-        c = jax.lax.rem(me - s + 2 * n, n)
-        if s > 0:
-            descs[s - 1].wait_recv()  # chunk c landed during step s-1
-        sl = pl.ds(c * t_pad_loc, t_pad_loc)
-        if s < n - 1:
-            # forward chunk c before computing on it: ICI overlaps MXU
-            descs.append(
-                shmem.putmem_nbi_block(
-                    ag_ref.at[sl], ag_ref.at[sl], right, axis,
-                    send_sems.at[s], recv_sems.at[s],
-                )
-            )
-
-        n_groups = (nb + bpg - 1) // bpg
-
-        def _group_desc(g, slot, c=c):
-            base = g * bpg * bm
-            cnt = min(bpg * bm, t_pad_loc - base)
-            return pltpu.make_async_copy(
-                ag_ref.at[pl.ds(c * t_pad_loc + base, cnt), :],
-                a_all.at[slot, pl.ds(0, cnt), :],
-                gsems.at[slot],
-            )
-
-        _group_desc(0, 0).start()
-        for g in range(n_groups):          # python: group sizes are static
-            gslot = g % 2
-            if g + 1 < n_groups:
-                _group_desc(g + 1, 1 - gslot).start()
-            _group_desc(g, gslot).wait()
-            nb_g = min(bpg, nb - g * bpg)  # blocks in this group
-
-            # first slab of the NEXT group/step: prefetched by this group's
-            # last iteration (the `_iter` boundary arm), so the boundary
-            # never stalls on a cold weight fetch. None = end of schedule.
-            if g + 1 < n_groups:
-                e_next = eid_ref[c, (g + 1) * bpg]
-            elif s + 1 < n:
-                c_next = jax.lax.rem(me - (s + 1) + 2 * n, n)
-                e_next = eid_ref[c_next, 0]
-            else:
-                e_next = None
-            it_base = it_counter[0]
-
-            def _iter(i, slot, g=g, gslot=gslot, nb_g=nb_g, it_base=it_base,
-                      e_next=e_next):
-                jn = i // nb_g
-                b_rel = jax.lax.rem(i, nb_g)
-                b = g * bpg + b_rel
-                e = eid_ref[c, b]
-                prev_rel = jax.lax.rem(jax.lax.max(i - 1, 0), nb_g)
-                fresh = jnp.logical_or(
-                    i == 0,
-                    jnp.logical_or(
-                        jn != jax.lax.max(i - 1, 0) // nb_g,
-                        e != eid_ref[c, g * bpg + prev_rel],
-                    ),
-                )
-                slot = jnp.where(fresh, 1 - slot, slot)
-
-                # DMA semaphores are waited through a descriptor of matching
-                # byte count (both Mosaic and the interpreter count bytes)
-                @pl.when(fresh)
-                def _():
-                    pltpu.make_async_copy(
-                        b_ref.at[e, :, pl.ds(jn * bn, bn)],
-                        b_buf.at[slot],
-                        bsem.at[slot],
-                    ).wait()
-
-                # prefetch the NEXT distinct weight slab while this dot runs
-                nxt = i + 1
-                jn2 = nxt // nb_g
-                b2 = jax.lax.rem(nxt, nb_g)
-                e2 = eid_ref[c, g * bpg + jax.lax.min(b2, nb_g - 1)]
-                fresh2 = jnp.logical_and(
-                    nxt < nb_g * n_jn,
-                    jnp.logical_or(jn2 != jn, e2 != e),
-                )
-                jn2v = jn2
-                if e_next is not None:
-                    # boundary arm: the loop's last iteration prefetches the
-                    # next group's/step's first slab into the buffer the
-                    # boundary's i=0 `fresh` wait will target (slot carries
-                    # across loops, so 1-slot here IS that buffer)
-                    boundary = nxt >= nb_g * n_jn
-                    e2 = jnp.where(boundary, e_next, e2)
-                    jn2v = jnp.where(boundary, 0, jn2)
-                    fresh2 = jnp.logical_or(fresh2, boundary)
-
-                @pl.when(fresh2)
-                def _():
-                    pltpu.make_async_copy(
-                        b_ref.at[e2, :, pl.ds(jn2v * bn, bn)],
-                        b_buf.at[1 - slot],
-                        bsem.at[1 - slot],
-                    ).start()
-
-                if vid_ref is None:
-                    y = jnp.dot(
-                        a_all[gslot, pl.ds(b_rel * bm, bm), :],
-                        b_buf[slot],
-                        preferred_element_type=jnp.float32,
-                    )
-                # out_stage slots alternate on the GLOBAL iteration count
-                # (group iteration counts may be odd); a slot's first-ever
-                # use has no pending store to wait for
-                gi = it_base + i
-                oslot = jax.lax.rem(gi, 2)
-
-                @pl.when(gi >= 2)
-                def _():
-                    pltpu.make_async_copy(
-                        out_stage.at[pl.ds(oslot * bm, bm), :],
-                        out_ref.at[
-                            pl.ds(c * t_pad_loc + b * bm, bm), pl.ds(jn * bn, bn)
-                        ],
-                        outsem.at[oslot],
-                    ).wait()
-
-                if vid_ref is None:
-                    out_stage[pl.ds(oslot * bm, bm), :] = y.astype(out_dtype)
-                else:
-                    # ragged (ISSUE 5): panel-guarded dots write the staged
-                    # tile directly — dead panels stage zeros, so they ride
-                    # AFTER the slot-reuse wait like the legacy store
-                    _ragged_block_emit(
-                        lambda off, rows: a_all[
-                            gslot, pl.ds(b_rel * bm + off, rows), :
-                        ],
-                        b_buf[slot], out_stage, oslot * bm, vid_ref[c, b],
-                        bm, bn, panel, out_dtype,
-                    )
-                pltpu.make_async_copy(
-                    out_stage.at[pl.ds(oslot * bm, bm), :],
-                    out_ref.at[
-                        pl.ds(c * t_pad_loc + b * bm, bm), pl.ds(jn * bn, bn)
-                    ],
-                    outsem.at[oslot],
-                ).start()
-                return slot
-
-            slot_carry[0] = jax.lax.fori_loop(
-                0, nb_g * n_jn, _iter, slot_carry[0]
-            )
-            it_counter[0] += nb_g * n_jn
-    # Drain the final pending output store per used slot, then wait local
-    # send completion of the ring puts.
-    total_iters = n * nb * n_jn
-
-    def _drain(oslot):
-        pltpu.make_async_copy(
-            out_stage.at[pl.ds(oslot * bm, bm), :],
-            out_ref.at[pl.ds(0, bm), pl.ds(0, bn)],
-            outsem.at[oslot],
-        ).wait()
-
-    if total_iters >= 1:
-        _drain((total_iters - 1) % 2)
-    if total_iters >= 2:
-        _drain(total_iters % 2)
-    shmem.quiet(*descs)
-
-
-def _ag_group_gemm_overlap_chunked_kernel(
-    eid_ref, a_ref, b_ref,
-    out_ref, ag_ref,
-    a_all, b_buf, out_stage,
-    copy_sem, send_sems, recv_sems, sig_sems, gsems, bsem, outsem,
-    *, axis: str, n: int, nb: int, n_jn: int, bn: int, bpg: int, bm: int,
-    out_dtype, spans, vid_ref=None, panel: int = 0,
-):
-    """Chunk-granular fused ring-AG + grouped GEMM (ISSUE 4 tentpole): the
-    schedule of :func:`_ag_group_gemm_overlap_kernel` with each ring-step
-    shard split into the ``spans`` (quantized to the gather-group size, so
-    every chunk holds whole groups). Step ``s`` waits chunk ``j`` of the
-    previous step, forwards it to the right neighbor immediately, and
-    starts group-GEMM work on ITS expert rows while chunk ``j+1`` is still
-    crossing the ICI — the group-GEMM no longer stalls until the full peer
-    shard arrives, which is the dispatch→GEMM leg of the three-stage MoE
-    pipeline (dispatch of chunk j+1, GEMM of chunk j, combine of j−1
-    concurrently in flight). The only schedule difference vs legacy is
-    that a gather-group DMA is never prefetched across a chunk boundary
-    (its rows may not have landed); the weight-slab prefetch chain is
-    chunk-independent (weights are local) and carries across chunk, group
-    AND step boundaries exactly as in the legacy kernel. ``chunks=1``
-    dispatches to the unchanged legacy kernel."""
-    me = shmem.my_pe(axis)
-    t_pad_loc = nb * bm
-    gq = bpg * bm                       # group quantum: spans align to it
-    n_groups = (nb + bpg - 1) // bpg
-    it_counter = [0]
-
-    local = pltpu.make_async_copy(
-        a_ref, ag_ref.at[pl.ds(me * t_pad_loc, t_pad_loc)], copy_sem
-    )
-    local.start()
-    local.wait()
-    shmem.barrier_all(axis)
-    right = jax.lax.rem(me + 1, n)
-
-    pltpu.make_async_copy(
-        b_ref.at[eid_ref[me, 0], :, pl.ds(0, bn)], b_buf.at[0], bsem.at[0]
-    ).start()
-    slot_carry = [jnp.int32(1)]  # traced carry: _iter's weight buffer slot
-
-    descs = []
-    for s in range(n):
-        c = jax.lax.rem(me - s + 2 * n, n)
-
-        def _group_desc(g, slot, c=c):
-            base = g * bpg * bm
-            cnt = min(bpg * bm, t_pad_loc - base)
-            return pltpu.make_async_copy(
-                ag_ref.at[pl.ds(c * t_pad_loc + base, cnt), :],
-                a_all.at[slot, pl.ds(0, cnt), :],
-                gsems.at[slot],
-            )
-
-        chunk_handles = []
-        for j, (off, rows) in enumerate(spans):
-            if s > 0:
-                descs[s - 1].wait_recv_chunk(j)  # landed during step s-1
-            if s < n - 1:
-                # forward chunk j before computing on it (wormhole
-                # pipelining across hops, as _ring_1d_chunked_kernel)
-                sl = pl.ds(c * t_pad_loc + off, rows)
-                chunk_handles.append(
-                    shmem.putmem_signal2_nbi_block(
-                        ag_ref.at[sl], ag_ref.at[sl], right, axis,
-                        send_sems.at[s, j], recv_sems.at[s, j],
-                        sig_sems.at[s, j],
-                    )
-                )
-            g_lo = off // gq
-            g_hi = n_groups if j == len(spans) - 1 else (off + rows) // gq
-            _group_desc(g_lo, g_lo % 2).start()
-            for g in range(g_lo, g_hi):  # python: group sizes are static
-                gslot = g % 2
-                if g + 1 < g_hi:
-                    # within-chunk prefetch only: a cross-chunk group's
-                    # rows are not guaranteed landed yet
-                    _group_desc(g + 1, 1 - gslot).start()
-                _group_desc(g, gslot).wait()
-                nb_g = min(bpg, nb - g * bpg)
-
-                # boundary weight prefetch target (chunk-independent — the
-                # weight bank is local HBM), exactly as legacy
-                if g + 1 < n_groups:
-                    e_next = eid_ref[c, (g + 1) * bpg]
-                elif s + 1 < n:
-                    c_next = jax.lax.rem(me - (s + 1) + 2 * n, n)
-                    e_next = eid_ref[c_next, 0]
-                else:
-                    e_next = None
-                it_base = it_counter[0]
-
-                def _iter(i, slot, g=g, gslot=gslot, nb_g=nb_g,
-                          it_base=it_base, e_next=e_next, c=c):
-                    jn = i // nb_g
-                    b_rel = jax.lax.rem(i, nb_g)
-                    b = g * bpg + b_rel
-                    e = eid_ref[c, b]
-                    prev_rel = jax.lax.rem(jax.lax.max(i - 1, 0), nb_g)
-                    fresh = jnp.logical_or(
-                        i == 0,
-                        jnp.logical_or(
-                            jn != jax.lax.max(i - 1, 0) // nb_g,
-                            e != eid_ref[c, g * bpg + prev_rel],
-                        ),
-                    )
-                    slot = jnp.where(fresh, 1 - slot, slot)
-
-                    @pl.when(fresh)
-                    def _():
-                        pltpu.make_async_copy(
-                            b_ref.at[e, :, pl.ds(jn * bn, bn)],
-                            b_buf.at[slot],
-                            bsem.at[slot],
-                        ).wait()
-
-                    # prefetch the NEXT distinct weight slab while this
-                    # dot runs (carries across chunk/group/step bounds)
-                    nxt = i + 1
-                    jn2 = nxt // nb_g
-                    b2 = jax.lax.rem(nxt, nb_g)
-                    e2 = eid_ref[c, g * bpg + jax.lax.min(b2, nb_g - 1)]
-                    fresh2 = jnp.logical_and(
-                        nxt < nb_g * n_jn,
-                        jnp.logical_or(jn2 != jn, e2 != e),
-                    )
-                    jn2v = jn2
-                    if e_next is not None:
-                        boundary = nxt >= nb_g * n_jn
-                        e2 = jnp.where(boundary, e_next, e2)
-                        jn2v = jnp.where(boundary, 0, jn2)
-                        fresh2 = jnp.logical_or(fresh2, boundary)
-
-                    @pl.when(fresh2)
-                    def _():
-                        pltpu.make_async_copy(
-                            b_ref.at[e2, :, pl.ds(jn2v * bn, bn)],
-                            b_buf.at[1 - slot],
-                            bsem.at[1 - slot],
-                        ).start()
-
-                    if vid_ref is None:
-                        y = jnp.dot(
-                            a_all[gslot, pl.ds(b_rel * bm, bm), :],
-                            b_buf[slot],
-                            preferred_element_type=jnp.float32,
-                        )
-                    gi = it_base + i
-                    oslot = jax.lax.rem(gi, 2)
-
-                    @pl.when(gi >= 2)
-                    def _():
-                        pltpu.make_async_copy(
-                            out_stage.at[pl.ds(oslot * bm, bm), :],
-                            out_ref.at[
-                                pl.ds(c * t_pad_loc + b * bm, bm),
-                                pl.ds(jn * bn, bn),
-                            ],
-                            outsem.at[oslot],
-                        ).wait()
-
-                    if vid_ref is None:
-                        out_stage[pl.ds(oslot * bm, bm), :] = y.astype(
-                            out_dtype
-                        )
-                    else:
-                        # ragged × chunked (ISSUE 5): identical panel rule;
-                        # the chunk schedule is row-layout-driven and never
-                        # consults valid_rows, so ragged adds no signal
-                        # edges to the chunk protocol
-                        _ragged_block_emit(
-                            lambda off, rows: a_all[
-                                gslot, pl.ds(b_rel * bm + off, rows), :
-                            ],
-                            b_buf[slot], out_stage, oslot * bm,
-                            vid_ref[c, b], bm, bn, panel, out_dtype,
-                        )
-                    pltpu.make_async_copy(
-                        out_stage.at[pl.ds(oslot * bm, bm), :],
-                        out_ref.at[
-                            pl.ds(c * t_pad_loc + b * bm, bm),
-                            pl.ds(jn * bn, bn),
-                        ],
-                        outsem.at[oslot],
-                    ).start()
-                    return slot
-
-                slot_carry[0] = jax.lax.fori_loop(
-                    0, nb_g * n_jn, _iter, slot_carry[0]
-                )
-                it_counter[0] += nb_g * n_jn
-        if s < n - 1:
-            descs.append(shmem.ChunkedPutHandle(chunk_handles))
-
-    total_iters = n * nb * n_jn
-
-    def _drain(oslot):
-        pltpu.make_async_copy(
-            out_stage.at[pl.ds(oslot * bm, bm), :],
-            out_ref.at[pl.ds(0, bm), pl.ds(0, bn)],
-            outsem.at[oslot],
-        ).wait()
-
-    if total_iters >= 1:
-        _drain((total_iters - 1) % 2)
-    if total_iters >= 2:
-        _drain(total_iters % 2)
-    shmem.quiet(*descs)
-
-
-def _ag_group_gemm_overlap_ragged_kernel(
-    eid_ref, vid_ref, a_ref, b_ref,
-    out_ref, ag_ref,
-    a_all, b_buf, out_stage,
-    copy_sem, send_sems, recv_sems, gsems, bsem, outsem,
-    *, axis: str, n: int, nb: int, n_jn: int, bn: int, bpg: int, bm: int,
-    out_dtype, panel: int,
-):
-    """Ragged entry (ISSUE 5): the legacy schedule with the per-(rank,
-    block) live-row map as a second SMEM operand — see the base kernel's
-    docstring. Same ring/DMA/semaphore structure; only the MXU work and
-    the staged values differ."""
-    _ag_group_gemm_overlap_kernel(
-        eid_ref, a_ref, b_ref, out_ref, ag_ref, a_all, b_buf, out_stage,
-        copy_sem, send_sems, recv_sems, gsems, bsem, outsem,
-        axis=axis, n=n, nb=nb, n_jn=n_jn, bn=bn, bpg=bpg, bm=bm,
-        out_dtype=out_dtype, vid_ref=vid_ref, panel=panel,
-    )
-
-
-def _ag_group_gemm_overlap_chunked_ragged_kernel(
-    eid_ref, vid_ref, a_ref, b_ref,
-    out_ref, ag_ref,
-    a_all, b_buf, out_stage,
-    copy_sem, send_sems, recv_sems, sig_sems, gsems, bsem, outsem,
-    *, axis: str, n: int, nb: int, n_jn: int, bn: int, bpg: int, bm: int,
-    out_dtype, spans, panel: int,
-):
-    """Ragged × chunked entry (ISSUE 5 × ISSUE 4): chunk schedule and
-    signal protocol identical to the chunked kernel; blocks consume the
-    live-row map as above."""
-    _ag_group_gemm_overlap_chunked_kernel(
-        eid_ref, a_ref, b_ref, out_ref, ag_ref, a_all, b_buf, out_stage,
-        copy_sem, send_sems, recv_sems, sig_sems, gsems, bsem, outsem,
-        axis=axis, n=n, nb=nb, n_jn=n_jn, bn=bn, bpg=bpg, bm=bm,
-        out_dtype=out_dtype, spans=spans, vid_ref=vid_ref, panel=panel,
-    )
-
-
 def presort_local_rows(a: jax.Array, ral: RankedAlignment, axis: str) -> jax.Array:
     """This rank's block-aligned slab ``[t_pad_loc, K]``: one fused XLA
     gather (HBM-bandwidth pass). Sentinel rows clamp to row 0 of the own
@@ -624,6 +130,128 @@ def presort_local_rows(a: jax.Array, ral: RankedAlignment, axis: str) -> jax.Arr
     return jnp.take(a, rows_loc, axis=0)
 
 
+def _ag_overlap_xla(
+    a_srt, b, scale, ral, *, axis, ragged, gather_output, out_dtype,
+):
+    """Golden slow path for the overlapped up-projection: XLA all-gather of
+    the pre-sorted slabs + the expert-sorted ragged_dot over the rank-major
+    layout — the program the fused kernel is tested against."""
+    ag = jax.lax.all_gather(a_srt, axis, tiled=True)
+    out = _group_gemm_xla(
+        ag, b, ral.expert_ids.reshape(-1),
+        valid_rows=(
+            None if ral.valid_rows is None else ral.valid_rows.reshape(-1)
+        ),
+        scale=scale, ragged=ragged, bm=ral.block_m, out_dtype=out_dtype,
+        act_fn=None,
+    )
+    return (out, ag) if gather_output else out
+
+
+def _ag_overlap_fused(
+    a_srt, b, scale, ral, *, axis, ragged, gather_output, out_dtype, cfg,
+    gather_group_blocks, interpret,
+):
+    n = _axis_size((axis))
+    k_dim = a_srt.shape[1]
+    n_loc = b.shape[2]
+    nb = ral.blocks_per_rank
+    bm = ral.block_m
+    t_pad_loc = ral.t_pad_loc
+    w8 = scale is not None
+    bn = pick_block(n_loc, cfg.block_n)
+    n_jn = n_loc // bn
+    itemsize = jnp.dtype(a_srt.dtype).itemsize
+    bpg = gather_group_blocks or gather_group_blocks_for(nb, bm, k_dim, itemsize)
+    vmem_bytes = (
+        2 * bpg * bm * k_dim * itemsize       # double-buffered gather groups
+        + 2 * k_dim * bn * b.dtype.itemsize   # double-buffered weight slabs
+        + 2 * 2 * bm * bn * jnp.dtype(out_dtype).itemsize
+        + 4 * 2**20
+    )
+    from triton_dist_tpu.ops.common import chunk_schedule
+
+    # chunk-granular ring (ISSUE 4): spans quantized to the gather-group
+    # size so every chunk holds whole groups; a single-span schedule
+    # (incl. every chunks_per_shard=1 config) emits the legacy
+    # shard-granular protocol, bit for bit
+    spans = chunk_schedule(
+        t_pad_loc, max(1, int(getattr(cfg, "chunks_per_shard", 1))),
+        quantum=bpg * bm,
+    )
+    kernel = make_ag_overlap_kernel(
+        axis=axis, n=n, nb=nb, n_jn=n_jn, bn=bn, bpg=bpg, bm=bm,
+        out_dtype=out_dtype, spans=spans, ragged=ragged,
+        panel=_panel_for(bm) if ragged else 0, fmt=OperandFormat(w8),
+    )
+    if len(spans) > 1:
+        ring_scratch = [
+            pltpu.SemaphoreType.DMA((max(n - 1, 1), len(spans))),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1), len(spans))),
+            # pure chunk-signal slots (REGULAR; armed watchdog only)
+            pltpu.SemaphoreType.REGULAR((max(n - 1, 1), len(spans))),
+        ]
+    else:
+        ring_scratch = [
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+        ]
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),   # expert ids [n, nb]
+        # HBM pinned (not ANY): chunk slices at traced offsets must DMA
+        # from untiled HBM, never compiler-chosen VMEM
+        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # a_srt
+        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # b
+    ]
+    args = [ral.expert_ids, a_srt, b]
+    if ragged:
+        # the per-(rank, block) live-row map rides SMEM next to the ids
+        in_specs.insert(1, pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.insert(1, ral.valid_rows.astype(jnp.int32))
+    if w8:
+        # the per-(expert, out-column) scale bank, sliced per weight slab
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM))
+        args.append(scale.astype(jnp.float32))
+    weight_scratch = [pltpu.VMEM((2, k_dim, bn), b.dtype)]
+    bsem_scratch = [pltpu.SemaphoreType.DMA((2,))]
+    if w8:
+        weight_scratch.append(pltpu.VMEM((2, 1, bn), jnp.float32))
+        bsem_scratch.append(pltpu.SemaphoreType.DMA((2,)))
+    out, ag = dist_pallas_call(
+        kernel,
+        name="ag_group_gemm_overlap",
+        out_shape=(
+            jax.ShapeDtypeStruct((n * t_pad_loc, n_loc), out_dtype),
+            jax.ShapeDtypeStruct((n * t_pad_loc, k_dim), a_srt.dtype),
+        ),
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, bpg * bm, k_dim), a_srt.dtype),
+            *weight_scratch,
+            pltpu.VMEM((2 * bm, bn), out_dtype),
+            pltpu.SemaphoreType.DMA(()),
+            *ring_scratch,
+            pltpu.SemaphoreType.DMA((2,)),
+            *bsem_scratch,
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * t_pad_loc * k_dim * n_loc,
+            bytes_accessed=(n * t_pad_loc * k_dim + n * t_pad_loc * n_loc)
+            * itemsize + b.shape[0] * k_dim * n_loc * b.dtype.itemsize,
+            transcendentals=0,
+        ),
+        vmem_limit_bytes=min(vmem_bytes, 100 * 2**20),
+        uses_barrier=True,
+        interpret=interpret,
+    )(*args)
+    return (out, ag) if gather_output else out
+
+
 def ag_group_gemm_overlap(
     a: jax.Array,
     b: jax.Array,
@@ -631,6 +259,7 @@ def ag_group_gemm_overlap(
     *,
     axis: str = "tp",
     config: GroupGemmConfig | None = None,
+    scale: jax.Array | None = None,
     gather_output: bool = False,
     out_dtype: Any = None,
     gather_group_blocks: int | None = None,
@@ -648,18 +277,21 @@ def ag_group_gemm_overlap(
     rank-major aligned order (+ the SORTED gathered rows
     ``[n*t_pad_loc, K]`` when `gather_output` — the backward's input).
 
+    ``scale`` (``[E, 1, n_loc]``) marks `b` as an int8 pool — the w8 axis
+    (``config.w8`` quantizes a float bank on the fly instead): weight
+    slabs stream at half the HBM bytes, scale rows on the prefetch chain.
+
     World-1 degenerates to the scalar-prefetch grid ``group_gemm`` over the
     pre-sorted slab: with no ring to hide, Mosaic's automatic grid
     pipelining is the best schedule (≙ the world-1 XLA-dot sentinels of
     ``ag_gemm``/``gemm_rs``)."""
+    from triton_dist_tpu import resilience
+
     cfg = config or GroupGemmConfig()
     out_dtype = out_dtype or a.dtype
     n = _axis_size((axis))
-    m_loc, k_dim = a.shape
-    n_loc = b.shape[2]
     nb = ral.blocks_per_rank
     bm = ral.block_m
-    t_pad_loc = ral.t_pad_loc
     assert bm == cfg.block_m, (bm, cfg.block_m)
     ragged = bool(cfg.ragged) and cfg.backend == "pallas"
     if ragged and ral.valid_rows is None:
@@ -673,113 +305,30 @@ def ag_group_gemm_overlap(
             "blocks; route it through the sequential composition "
             "(tp_moe_mlp does this automatically)"
         )
+    b, scale = resolve_w8(b, scale, cfg)
+    if scale is not None:
+        assert scale.shape == (b.shape[0], 1, b.shape[2]), (scale.shape, b.shape)
 
     a_srt = presort_local_rows(a, ral, axis)
 
     if n == 1:
         h = group_gemm(
-            a_srt, b, ral.expert_ids[0],
+            a_srt, b, ral.expert_ids[0], scale=scale,
             valid_rows=None if ral.valid_rows is None else ral.valid_rows[0],
             config=cfg, out_dtype=out_dtype, interpret=interpret,
         )
         return (h, a_srt) if gather_output else h
 
-    bn = pick_block(n_loc, cfg.block_n)
-    n_jn = n_loc // bn
-    itemsize = jnp.dtype(a.dtype).itemsize
-    bpg = gather_group_blocks or gather_group_blocks_for(nb, bm, k_dim, itemsize)
-    vmem_bytes = (
-        2 * bpg * bm * k_dim * itemsize       # double-buffered gather groups
-        + 2 * k_dim * bn * itemsize           # double-buffered weight slabs
-        + 2 * 2 * bm * bn * jnp.dtype(out_dtype).itemsize
-        + 4 * 2**20
+    return resilience.guarded_call(
+        "ag_group_gemm_overlap",
+        functools.partial(
+            _ag_overlap_fused, cfg=cfg,
+            gather_group_blocks=gather_group_blocks, interpret=interpret,
+        ),
+        _ag_overlap_xla,
+        a_srt, b, scale, ral, axis=axis, ragged=ragged,
+        gather_output=gather_output, out_dtype=out_dtype,
     )
-    from triton_dist_tpu.ops.common import chunk_schedule
-
-    # chunk-granular ring (ISSUE 4): spans quantized to the gather-group
-    # size so every chunk holds whole groups (the unit the compute loop
-    # consumes); a schedule that collapses to one span — including every
-    # chunks_per_shard=1 config — dispatches to the UNCHANGED legacy
-    # kernel, bit for bit
-    spans = chunk_schedule(
-        t_pad_loc, max(1, int(getattr(cfg, "chunks_per_shard", 1))),
-        quantum=bpg * bm,
-    )
-    ragged_kw = {"panel": _panel_for(bm)} if ragged else {}
-    if len(spans) > 1:
-        kernel = functools.partial(
-            _ag_group_gemm_overlap_chunked_ragged_kernel if ragged
-            else _ag_group_gemm_overlap_chunked_kernel,
-            axis=axis, n=n, nb=nb,
-            n_jn=n_jn, bn=bn, bpg=bpg, bm=bm, out_dtype=out_dtype,
-            spans=spans, **ragged_kw,
-        )
-        ring_scratch = [
-            pltpu.SemaphoreType.DMA((max(n - 1, 1), len(spans))),
-            pltpu.SemaphoreType.DMA((max(n - 1, 1), len(spans))),
-            # pure chunk-signal slots (REGULAR; armed watchdog only)
-            pltpu.SemaphoreType.REGULAR((max(n - 1, 1), len(spans))),
-        ]
-    else:
-        kernel = functools.partial(
-            _ag_group_gemm_overlap_ragged_kernel if ragged
-            else _ag_group_gemm_overlap_kernel,
-            axis=axis, n=n, nb=nb,
-            n_jn=n_jn, bn=bn, bpg=bpg, bm=bm, out_dtype=out_dtype,
-            **ragged_kw,
-        )
-        ring_scratch = [
-            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
-            pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
-        ]
-    in_specs = [
-        pl.BlockSpec(memory_space=pltpu.SMEM),   # expert ids [n, nb]
-        # HBM pinned (not ANY): chunk slices at traced-but-aligned
-        # offsets must DMA from untiled HBM, not from VMEM the
-        # compiler might pick for small inputs
-        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # a_srt
-        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # b
-    ]
-    args = [ral.expert_ids, a_srt, b]
-    if ragged:
-        # the per-(rank, block) live-row map rides SMEM next to the ids
-        in_specs.insert(1, pl.BlockSpec(memory_space=pltpu.SMEM))
-        args.insert(1, ral.valid_rows.astype(jnp.int32))
-    out, ag = dist_pallas_call(
-        kernel,
-        name="ag_group_gemm_overlap",
-        out_shape=(
-            jax.ShapeDtypeStruct((n * t_pad_loc, n_loc), out_dtype),
-            jax.ShapeDtypeStruct((n * t_pad_loc, k_dim), a.dtype),
-        ),
-        in_specs=in_specs,
-        out_specs=(
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((2, bpg * bm, k_dim), a.dtype),
-            pltpu.VMEM((2, k_dim, bn), b.dtype),
-            pltpu.VMEM((2 * bm, bn), out_dtype),
-            pltpu.SemaphoreType.DMA(()),
-            *ring_scratch,
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
-        cost_estimate=pl.CostEstimate(
-            flops=2 * n * t_pad_loc * k_dim * n_loc,
-            bytes_accessed=(
-                n * t_pad_loc * k_dim + b.shape[0] * k_dim * n_loc
-                + n * t_pad_loc * n_loc
-            ) * itemsize,
-            transcendentals=0,
-        ),
-        vmem_limit_bytes=min(vmem_bytes, 100 * 2**20),
-        uses_barrier=True,
-        interpret=interpret,
-    )(*args)
-    return (out, ag) if gather_output else out
 
 
 def ag_group_gemm_op(
@@ -825,8 +374,9 @@ def ag_group_gemm_op(
 # alignment block, so the sweep may change padding, not just tiling.
 # FIRST entry = best-known default (applied sweep-free under
 # cached_or_first). Ragged twins (ISSUE 5) sit strictly AFTER their padded
-# originals — the no-regression ordering invariant: sweep-free walks can
-# never apply a ragged schedule untimed.
+# originals, and w8 twins (ISSUE 7) strictly AFTER their bf16 twins — the
+# no-regression ordering invariant: sweep-free walks can never apply a
+# ragged, chunked OR quantized schedule untimed.
 AG_GROUP_GEMM_TUNE_SPACE = (
     GroupGemmConfig(128, 1024, 512),
     GroupGemmConfig(128, 2048, 512),
@@ -835,6 +385,11 @@ AG_GROUP_GEMM_TUNE_SPACE = (
     GroupGemmConfig(256, 1024, 512),
     GroupGemmConfig(128, 1024, 512, ragged=True),
     GroupGemmConfig(256, 1024, 512, ragged=True),
+    # w8 axis (ISSUE 7): int8 weight slabs at half the HBM bytes through
+    # the same schedules — a serving knob (quantization error ~0.2-0.5%
+    # RMS), so only a timed sweep may crown it
+    GroupGemmConfig(128, 1024, 512, w8=True),
+    GroupGemmConfig(128, 1024, 512, ragged=True, w8=True),
 )
 
 ag_group_gemm_op = contextual_autotune(

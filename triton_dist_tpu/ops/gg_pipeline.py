@@ -1,0 +1,833 @@
+"""One pipeline emitter for the fused grouped-GEMM family (ISSUE 7;
+architecture note: docs/moe_overlap.md "One pipeline emitter").
+
+ONE generator per family weaves three composable trace-time policies into
+the kernel body, retiring PR 3-5's legacy x chunked x ragged twin matrix;
+the host entries in ``ops/{group_gemm,allgather_group_gemm,
+moe_reduce_rs}.py`` are thin spec builders over it:
+
+- **schedule** — the ``spans`` chunk schedule: one span emits the legacy
+  shard-granular ring/push protocol, several emit PR 3/4's per-(step,
+  chunk) signal-slot protocol (armed-watchdog ``chunk_wait`` path);
+- **tile validity** — ``vid_ref`` absent (padded full tiles) vs present
+  (PR 5's ``pl.when``-guarded ``panel``-row dots, dead rows exact zeros);
+- **operand format** — :class:`OperandFormat`: bf16 (identity) vs w8
+  (int8 B stream at half the bytes + per-(expert, out-column) f32 scale
+  fold BEFORE any ragged mask, the legacy w8-kernel ordering).
+
+Migration contract: at chunk=1 / ragged=False / bf16 every generated body
+traces the SAME statement sequence as the retired legacy kernels, so
+outputs are bit-identical — pinned by ``tests/test_emitter.py`` against
+verbatim copies of the legacy bodies. w8 adds weight-scale DMAs (local
+HBM) and NO signal edges.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_dist_tpu.shmem import device as shmem
+from triton_dist_tpu.utils import pick_block
+
+
+@dataclasses.dataclass(frozen=True)
+class OperandFormat:
+    """Weight operand-format policy. ``w8=False`` is the identity (the
+    legacy trace, bit for bit); ``w8=True`` upcasts the int8 B tile to the
+    activation dtype on the VPU under the halved DMA time and folds the
+    per-(expert, out-column) scale into the f32 accumulator BEFORE any
+    ragged dead-row mask (live rows match the grid w8 kernel bit for
+    bit)."""
+
+    w8: bool = False
+
+    def mxu_b(self, b_tile, a_dtype):
+        """The B tile as the MXU consumes it."""
+        return b_tile.astype(a_dtype) if self.w8 else b_tile
+
+    def fold(self, acc, s_row):
+        """Finalize an f32 accumulator/tile: fold the scale row (shape
+        broadcastable over rows) under w8; identity otherwise."""
+        return acc * s_row if self.w8 else acc
+
+
+BF16 = OperandFormat(False)
+
+
+# ---------------------------------------------------------------------------
+# Grid kernels (ops/group_gemm.py): forward (+w8, +ragged) and dW (+ragged)
+# ---------------------------------------------------------------------------
+
+def make_group_gemm_kernel(*, n_k: int, out_dtype, act_fn=None,
+                           fmt: OperandFormat = BF16, ragged: bool = False,
+                           panel: int = 0):
+    """The scalar-prefetch grid grouped-GEMM kernel for one
+    (format, validity) choice — replaces the four hand-written twins
+    ``_group_gemm{,_w8}{,_ragged}_kernel``.
+
+    Ref layout (Pallas passes positionally): ``e_ref, [v_ref], a_ref,
+    b_ref, [s_ref], o_ref, acc_ref`` — ``v_ref`` present iff ragged,
+    ``s_ref`` iff w8."""
+
+    def kernel(*refs):
+        if ragged:
+            e_ref, v_ref, a_ref, b_ref, *rest = refs
+        else:
+            e_ref, a_ref, b_ref, *rest = refs
+            v_ref = None
+        if fmt.w8:
+            s_ref, o_ref, acc_ref = rest
+        else:
+            (o_ref, acc_ref), s_ref = rest, None
+        del e_ref  # consumed by the index maps
+        kk = pl.program_id(2)
+        if ragged:
+            i = pl.program_id(1)
+            valid = v_ref[i]
+
+        @pl.when(kk == 0)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        def _a(tile):
+            if act_fn is not None:
+                # fused producer activation on the A tile: VPU work under
+                # the B-operand DMA (f32, cast back — exact standalone math)
+                return act_fn(tile.astype(jnp.float32)).astype(a_ref.dtype)
+            return tile
+
+        if not ragged:
+            acc_ref[:] += jnp.dot(
+                _a(a_ref[:]), fmt.mxu_b(b_ref[0], a_ref.dtype),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            # panel-guarded dots: a panel wholly past valid_rows costs
+            # zero MXU time; the output store zero-masks the tail's dead rows
+            bm = acc_ref.shape[0]
+            for p in range(bm // panel):
+                @pl.when(p * panel < valid)
+                def _(p=p):
+                    acc_ref[pl.ds(p * panel, panel), :] += jnp.dot(
+                        _a(a_ref[pl.ds(p * panel, panel), :]),
+                        fmt.mxu_b(b_ref[0], a_ref.dtype),
+                        preferred_element_type=jnp.float32,
+                    )
+
+        @pl.when(kk == n_k - 1)
+        def _():
+            res = fmt.fold(
+                acc_ref[:], s_ref[0] if s_ref is not None else None
+            )
+            if not ragged:
+                o_ref[:] = res.astype(out_dtype)
+            else:
+                # dead rows exact zeros (0·junk is fine, 0·NaN is not);
+                # the scale fold happened above, BEFORE this mask
+                rows = jax.lax.broadcasted_iota(jnp.int32, acc_ref.shape, 0)
+                o_ref[:] = jnp.where(rows < valid, res, 0.0).astype(out_dtype)
+
+    return kernel
+
+
+def make_group_gemm_dw_kernel(*, ragged: bool = False, panel: int = 0):
+    """The transpose grouped GEMM (``dW[e] += A_iᵀ @ G_i`` over each
+    expert's consecutive row-block run) — replaces
+    ``_group_gemm_dw{,_ragged}_kernel``. Ref layout: ``e_ref, [v_ref],
+    a_ref, g_ref, o_ref, acc_ref``. No w8 axis: weight gradients are
+    computed against the full-precision bank (w8 is a forward/serving
+    format — ``ops.grads`` strips it from every backward config)."""
+
+    def kernel(*refs):
+        if ragged:
+            e_ref, v_ref, a_ref, g_ref, o_ref, acc_ref = refs
+        else:
+            e_ref, a_ref, g_ref, o_ref, acc_ref = refs
+            v_ref = None
+        i = pl.program_id(2)
+        if ragged:
+            valid = v_ref[i]
+        first_of_run = jnp.logical_or(
+            i == 0, e_ref[jnp.maximum(i - 1, 0)] != e_ref[i]
+        )
+
+        @pl.when(first_of_run)
+        def _():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        if not ragged:
+            acc_ref[:] += jax.lax.dot_general(
+                a_ref[:].astype(jnp.float32), g_ref[:].astype(jnp.float32),
+                (((0,), (0,)), ((), ())),       # contract the bm rows: AᵀG
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            # dead panels skip the contraction; tail masked rows ZEROED on
+            # A before AᵀG (a pad row's a·g has no downstream mask)
+            bm = a_ref.shape[0]
+            for p in range(bm // panel):
+                @pl.when(p * panel < valid)
+                def _(p=p):
+                    a = a_ref[pl.ds(p * panel, panel), :].astype(jnp.float32)
+                    rows = (
+                        jax.lax.broadcasted_iota(jnp.int32, a.shape, 0)
+                        + p * panel
+                    )
+                    a = jnp.where(rows < valid, a, 0.0)
+                    acc_ref[:] += jax.lax.dot_general(
+                        a,
+                        g_ref[pl.ds(p * panel, panel), :].astype(jnp.float32),
+                        (((0,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+        o_ref[0] = acc_ref[:]
+
+    return kernel
+
+
+# ---------------------------------------------------------------------------
+# Shared ragged block emitters (PR 5's panel rule, now format-aware)
+# ---------------------------------------------------------------------------
+
+def _ragged_block_emit(
+    a_rows, b_tile, out_stage, oslot_base, v, bm, bn, panel, out_dtype,
+    fmt: OperandFormat = BF16, s_row=None,
+):
+    """Ragged compute+stage for one AG-overlap row block: ``pl.when``-
+    guarded live ``panel``-row dots, dead rows/panels staged as exact
+    zeros (a downstream 0-weight combine can never meet NaN junk);
+    ``a_rows`` maps a panel's row span to its A rows, ``oslot_base`` is
+    the block's first staged row. Under w8 the scale row folds into each
+    live panel BEFORE its mask (grid-kernel ordering)."""
+    for p in range(bm // panel):
+        live = p * panel < v
+
+        @pl.when(live)
+        def _(p=p):
+            yp = jnp.dot(
+                a_rows(p * panel, panel), b_tile,
+                preferred_element_type=jnp.float32,
+            )
+            yp = fmt.fold(yp, s_row)
+            rows = (
+                jax.lax.broadcasted_iota(jnp.int32, (panel, bn), 0)
+                + p * panel
+            )
+            out_stage[pl.ds(oslot_base + p * panel, panel), :] = jnp.where(
+                rows < v, yp, 0.0
+            ).astype(out_dtype)
+
+        @pl.when(jnp.logical_not(live))
+        def _(p=p):
+            out_stage[pl.ds(oslot_base + p * panel, panel), :] = jnp.zeros(
+                (panel, bn), out_dtype
+            )
+
+
+def _moe_ragged_blk(
+    h_buf, w_buf, ids_v, w_v, partial_ref, hslot, slot, b, v, m_out, bm,
+    panel, cdt, fmt: OperandFormat = BF16, s_row=None,
+):
+    """Ragged block step of the fused down-projection: the dot AND the
+    one-hot combine run only for the block's live ``panel``-row panels
+    (the combine's contraction dim IS the block rows); partial_ref is
+    accumulative so skipping is exact. Under w8 the scale row folds into
+    each live panel's f32 dot before the combine consumes it."""
+    d = ids_v[b]
+    w_r = w_v[b]
+    for p in range(bm // panel):
+        @pl.when(p * panel < v)
+        def _(p=p):
+            yp = jnp.dot(
+                h_buf[hslot, pl.ds(p * panel, panel), :],
+                fmt.mxu_b(w_buf[slot], cdt),
+                preferred_element_type=jnp.float32,
+            )
+            yp = fmt.fold(yp, s_row)
+            dp = d[p * panel:(p + 1) * panel]
+            wp = w_r[p * panel:(p + 1) * panel]
+            sel = jax.lax.broadcasted_iota(
+                jnp.int32, (m_out, panel), 0
+            ) == dp[None, :]
+            scat = jnp.where(sel, wp[None, :], 0.0).astype(cdt)
+            partial_ref[:] += jnp.dot(
+                scat, yp.astype(cdt), preferred_element_type=jnp.float32
+            )
+
+
+# ---------------------------------------------------------------------------
+# Fused AG-GroupGEMM overlap (ops/allgather_group_gemm.py)
+# ---------------------------------------------------------------------------
+
+def make_ag_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int, bn: int,
+                           bpg: int, bm: int, out_dtype, spans,
+                           ragged: bool = False, panel: int = 0,
+                           fmt: OperandFormat = BF16):
+    """Fused ring-AG + grouped GEMM over pre-sorted slabs — replaces the
+    four twins ``_ag_group_gemm_overlap{,_chunked}{,_ragged}_kernel``
+    (schedule walkthrough: docs/moe_overlap.md). Single span = the legacy
+    shard-granular ring bit for bit; several = the PR 4 chunk protocol (a
+    gather-group DMA never prefetches across a chunk boundary); ragged =
+    panel-guarded dots (no new signal edges); ``fmt.w8`` = int8 weight
+    slabs at half the bytes + a per-(expert, bn-slab) scale row on the
+    SAME double-buffered prefetch chain, folded before staging.
+
+    Ref layout: inputs ``eid, [vid], a, b, [s]``; outputs ``out, ag``;
+    scratch ``a_all, b_buf, [s_buf], out_stage, copy_sem, send_sems,
+    recv_sems, [sig_sems], gsems, bsem, [ssem], outsem`` (``[...]``
+    present iff the policy needs it)."""
+    chunked = len(spans) > 1
+
+    def kernel(*refs):
+        it = list(refs)
+        eid_ref = it.pop(0)
+        vid_ref = it.pop(0) if ragged else None
+        a_ref = it.pop(0)
+        b_ref = it.pop(0)
+        s_ref = it.pop(0) if fmt.w8 else None
+        out_ref = it.pop(0)
+        ag_ref = it.pop(0)
+        a_all = it.pop(0)
+        b_buf = it.pop(0)
+        s_buf = it.pop(0) if fmt.w8 else None
+        out_stage = it.pop(0)
+        copy_sem = it.pop(0)
+        send_sems = it.pop(0)
+        recv_sems = it.pop(0)
+        sig_sems = it.pop(0) if chunked else None
+        gsems = it.pop(0)
+        bsem = it.pop(0)
+        ssem = it.pop(0) if fmt.w8 else None
+        (outsem,) = it
+
+        me = shmem.my_pe(axis)
+        t_pad_loc = nb * bm
+        gq = bpg * bm                    # group quantum: spans align to it
+        n_groups = (nb + bpg - 1) // bpg
+        it_counter = [0]  # trace-time global (block, jn) iteration count
+
+        def _b_start(e, jn_v, slot):
+            """Weight-slab fetch: the [K, bn] B slab and (w8) its [1, bn]
+            scale row ride the same prefetch chain and buffer slot."""
+            pltpu.make_async_copy(
+                b_ref.at[e, :, pl.ds(jn_v * bn, bn)], b_buf.at[slot],
+                bsem.at[slot],
+            ).start()
+            if fmt.w8:
+                pltpu.make_async_copy(
+                    s_ref.at[e, :, pl.ds(jn_v * bn, bn)], s_buf.at[slot],
+                    ssem.at[slot],
+                ).start()
+
+        def _b_wait(e, jn_v, slot):
+            # DMA sems are waited via a matching-byte-count descriptor
+            pltpu.make_async_copy(
+                b_ref.at[e, :, pl.ds(jn_v * bn, bn)], b_buf.at[slot],
+                bsem.at[slot],
+            ).wait()
+            if fmt.w8:
+                pltpu.make_async_copy(
+                    s_ref.at[e, :, pl.ds(jn_v * bn, bn)], s_buf.at[slot],
+                    ssem.at[slot],
+                ).wait()
+
+        # n >= 2 always: the host entry dispatches world-1 to group_gemm
+        local = pltpu.make_async_copy(
+            a_ref, ag_ref.at[pl.ds(me * t_pad_loc, t_pad_loc)], copy_sem
+        )
+        local.start()
+        local.wait()
+        shmem.barrier_all(axis)
+        right = jax.lax.rem(me + 1, n)
+
+        # Weight-slab prefetch chain: the double-buffer slot carries across
+        # chunks, groups AND ring steps (each boundary's first slab is
+        # prefetched by the previous loop's `_iter` boundary arm, riding
+        # under the ring-chunk wait); only the very first slab is cold.
+        _b_start(eid_ref[me, 0], 0, 0)
+        slot_carry = [jnp.int32(1)]  # traced carry: _iter's weight slot
+
+        descs = []
+        for s in range(n):
+            c = jax.lax.rem(me - s + 2 * n, n)
+
+            def _group_desc(g, slot, c=c):
+                base = g * bpg * bm
+                cnt = min(bpg * bm, t_pad_loc - base)
+                return pltpu.make_async_copy(
+                    ag_ref.at[pl.ds(c * t_pad_loc + base, cnt), :],
+                    a_all.at[slot, pl.ds(0, cnt), :],
+                    gsems.at[slot],
+                )
+
+            chunk_handles = []
+            for j, (off, rows) in enumerate(spans):
+                if s > 0:
+                    # chunk/shard j landed during step s-1's compute
+                    if chunked:
+                        descs[s - 1].wait_recv_chunk(j)
+                    else:
+                        descs[s - 1].wait_recv()
+                if s < n - 1:
+                    # forward before computing on it: ICI overlaps MXU
+                    sl = pl.ds(c * t_pad_loc + off, rows)
+                    if chunked:
+                        chunk_handles.append(
+                            shmem.putmem_signal2_nbi_block(
+                                ag_ref.at[sl], ag_ref.at[sl], right, axis,
+                                send_sems.at[s, j], recv_sems.at[s, j],
+                                sig_sems.at[s, j],
+                            )
+                        )
+                    else:
+                        descs.append(
+                            shmem.putmem_nbi_block(
+                                ag_ref.at[sl], ag_ref.at[sl], right, axis,
+                                send_sems.at[s], recv_sems.at[s],
+                            )
+                        )
+                g_lo = off // gq
+                g_hi = n_groups if j == len(spans) - 1 else (off + rows) // gq
+                _group_desc(g_lo, g_lo % 2).start()
+                for g in range(g_lo, g_hi):  # python: group sizes static
+                    gslot = g % 2
+                    if g + 1 < g_hi:
+                        # within-chunk prefetch only: a cross-chunk
+                        # group's rows may not have landed yet
+                        _group_desc(g + 1, 1 - gslot).start()
+                    _group_desc(g, gslot).wait()
+                    nb_g = min(bpg, nb - g * bpg)  # blocks in this group
+
+                    # boundary weight-prefetch target (weights are local
+                    # HBM, chunk-independent); None = end of schedule
+                    if g + 1 < n_groups:
+                        e_next = eid_ref[c, (g + 1) * bpg]
+                    elif s + 1 < n:
+                        c_next = jax.lax.rem(me - (s + 1) + 2 * n, n)
+                        e_next = eid_ref[c_next, 0]
+                    else:
+                        e_next = None
+                    it_base = it_counter[0]
+
+                    def _iter(i, slot, g=g, gslot=gslot, nb_g=nb_g,
+                              it_base=it_base, e_next=e_next, c=c):
+                        jn = i // nb_g
+                        b_rel = jax.lax.rem(i, nb_g)
+                        b = g * bpg + b_rel
+                        e = eid_ref[c, b]
+                        prev_rel = jax.lax.rem(jax.lax.max(i - 1, 0), nb_g)
+                        fresh = jnp.logical_or(
+                            i == 0,
+                            jnp.logical_or(
+                                jn != jax.lax.max(i - 1, 0) // nb_g,
+                                e != eid_ref[c, g * bpg + prev_rel],
+                            ),
+                        )
+                        slot = jnp.where(fresh, 1 - slot, slot)
+
+                        @pl.when(fresh)
+                        def _():
+                            _b_wait(e, jn, slot)
+
+                        # prefetch the NEXT distinct weight slab while this
+                        # dot runs (carries across chunk/group/step bounds)
+                        nxt = i + 1
+                        jn2 = nxt // nb_g
+                        b2 = jax.lax.rem(nxt, nb_g)
+                        e2 = eid_ref[c, g * bpg + jax.lax.min(b2, nb_g - 1)]
+                        fresh2 = jnp.logical_and(
+                            nxt < nb_g * n_jn,
+                            jnp.logical_or(jn2 != jn, e2 != e),
+                        )
+                        jn2v = jn2
+                        if e_next is not None:
+                            # boundary arm: the last iteration prefetches
+                            # the next group's/step's first slab into the
+                            # buffer the boundary's i=0 `fresh` wait targets
+                            boundary = nxt >= nb_g * n_jn
+                            e2 = jnp.where(boundary, e_next, e2)
+                            jn2v = jnp.where(boundary, 0, jn2)
+                            fresh2 = jnp.logical_or(fresh2, boundary)
+
+                        @pl.when(fresh2)
+                        def _():
+                            _b_start(e2, jn2v, 1 - slot)
+
+                        if ragged:
+                            s_row = s_buf[slot][0] if fmt.w8 else None
+                        else:
+                            y = jnp.dot(
+                                a_all[gslot, pl.ds(b_rel * bm, bm), :],
+                                fmt.mxu_b(b_buf[slot], a_ref.dtype),
+                                preferred_element_type=jnp.float32,
+                            )
+                            y = fmt.fold(
+                                y, s_buf[slot][0] if fmt.w8 else None
+                            )
+                        # out_stage slots alternate on the GLOBAL iter
+                        # count (group counts may be odd); a slot's
+                        # first-ever use has no pending store
+                        gi = it_base + i
+                        oslot = jax.lax.rem(gi, 2)
+
+                        @pl.when(gi >= 2)
+                        def _():
+                            pltpu.make_async_copy(
+                                out_stage.at[pl.ds(oslot * bm, bm), :],
+                                out_ref.at[
+                                    pl.ds(c * t_pad_loc + b * bm, bm),
+                                    pl.ds(jn * bn, bn),
+                                ],
+                                outsem.at[oslot],
+                            ).wait()
+
+                        if not ragged:
+                            out_stage[pl.ds(oslot * bm, bm), :] = y.astype(
+                                out_dtype
+                            )
+                        else:
+                            # panel-guarded dots write the staged tile;
+                            # dead panels stage zeros AFTER the slot wait
+                            _ragged_block_emit(
+                                lambda off_, rows_: a_all[
+                                    gslot, pl.ds(b_rel * bm + off_, rows_), :
+                                ],
+                                fmt.mxu_b(b_buf[slot], a_ref.dtype),
+                                out_stage, oslot * bm, vid_ref[c, b],
+                                bm, bn, panel, out_dtype, fmt, s_row,
+                            )
+                        pltpu.make_async_copy(
+                            out_stage.at[pl.ds(oslot * bm, bm), :],
+                            out_ref.at[
+                                pl.ds(c * t_pad_loc + b * bm, bm),
+                                pl.ds(jn * bn, bn),
+                            ],
+                            outsem.at[oslot],
+                        ).start()
+                        return slot
+
+                    slot_carry[0] = jax.lax.fori_loop(
+                        0, nb_g * n_jn, _iter, slot_carry[0]
+                    )
+                    it_counter[0] += nb_g * n_jn
+            if chunked and s < n - 1:
+                descs.append(shmem.ChunkedPutHandle(chunk_handles))
+
+        # drain final pending output stores, then local ring-put completion
+        total_iters = n * nb * n_jn
+
+        def _drain(oslot):
+            pltpu.make_async_copy(
+                out_stage.at[pl.ds(oslot * bm, bm), :],
+                out_ref.at[pl.ds(0, bm), pl.ds(0, bn)],
+                outsem.at[oslot],
+            ).wait()
+
+        if total_iters >= 1:
+            _drain((total_iters - 1) % 2)
+        if total_iters >= 2:
+            _drain(total_iters % 2)
+        shmem.quiet(*descs)
+
+    return kernel
+
+
+# ---------------------------------------------------------------------------
+# Fused MoE-Reduce-RS overlap (ops/moe_reduce_rs.py)
+# ---------------------------------------------------------------------------
+
+def make_moe_rs_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int,
+                               bn: int, m_out: int, out_dtype, spans,
+                               ragged: bool = False, panel: int = 0,
+                               fmt: OperandFormat = BF16):
+    """Fused grouped-GEMM → weighted combine → reduce-scatter — replaces
+    the four twins ``_moe_reduce_rs_overlap{,_chunked}{,_ragged}_kernel``
+    (schedule walkthrough: docs/moe_overlap.md). Destination rank c's
+    chunk is computed from ITS aligned rows, combined in VMEM (one-hot
+    matmul), and pushed the moment its slab retires. Single span = the
+    legacy whole-slab push bit for bit; several = the PR 4 chunked push on
+    per-(step, slab, chunk) slots, consumed chunk by chunk; ragged = the
+    panel rule on GEMM and combine both (the push schedule never consults
+    valid_rows); ``fmt.w8`` = int8 W_down slabs + scale rows on the same
+    prefetch chain, folded before the combine consumes each tile.
+
+    Ref layout: inputs ``eid, [vid], h, w, [s], dst, wrow``; outputs
+    ``out, own_buf, landing``; scratch ``h_buf, w_buf, [s_buf],
+    push_stage, ids_v, w_v, partial, hsem, wsem, [ssem], metasem`` then
+    ``stage_sem, recv_sems`` (single span) or ``stage_sems, local_sem,
+    recv_sems, sig_sems`` (chunked)."""
+    chunked = len(spans) > 1
+
+    def kernel(*refs):
+        it = list(refs)
+        eid_ref = it.pop(0)
+        vid_ref = it.pop(0) if ragged else None
+        h_ref = it.pop(0)
+        w_ref = it.pop(0)
+        s_ref = it.pop(0) if fmt.w8 else None
+        dst_ref = it.pop(0)
+        wrow_ref = it.pop(0)
+        out_ref = it.pop(0)
+        own_buf = it.pop(0)
+        landing = it.pop(0)
+        h_buf = it.pop(0)
+        w_buf = it.pop(0)
+        s_buf = it.pop(0) if fmt.w8 else None
+        push_stage = it.pop(0)
+        ids_v = it.pop(0)
+        w_v = it.pop(0)
+        partial_ref = it.pop(0)
+        hsem = it.pop(0)
+        wsem = it.pop(0)
+        ssem = it.pop(0) if fmt.w8 else None
+        metasem = it.pop(0)
+        if chunked:
+            stage_sems, local_sem, recv_sems, sig_sems = it
+            stage_sem = None
+        else:
+            stage_sem, recv_sems = it
+            stage_sems = local_sem = sig_sems = None
+
+        me = shmem.my_pe(axis)
+        t_pad_tot, f_loc = h_ref.shape
+        t_pad_loc = t_pad_tot // n
+        bm = t_pad_loc // nb
+        cdt = h_ref.dtype
+        if n > 1:
+            shmem.barrier_all(axis)
+
+        def _w_start(e, jn_v, slot):
+            pltpu.make_async_copy(
+                w_ref.at[e, :, pl.ds(jn_v * bn, bn)], w_buf.at[slot],
+                wsem.at[slot],
+            ).start()
+            if fmt.w8:
+                pltpu.make_async_copy(
+                    s_ref.at[e, :, pl.ds(jn_v * bn, bn)], s_buf.at[slot],
+                    ssem.at[slot],
+                ).start()
+
+        def _w_wait(e, jn_v, slot):
+            pltpu.make_async_copy(
+                w_ref.at[e, :, pl.ds(jn_v * bn, bn)], w_buf.at[slot],
+                wsem.at[slot],
+            ).wait()
+            if fmt.w8:
+                pltpu.make_async_copy(
+                    s_ref.at[e, :, pl.ds(jn_v * bn, bn)], s_buf.at[slot],
+                    ssem.at[slot],
+                ).wait()
+
+        def _issue_h(c, b, slot):
+            pltpu.make_async_copy(
+                h_ref.at[pl.ds(c * t_pad_loc + b * bm, bm), :],
+                h_buf.at[slot],
+                hsem.at[slot],
+            ).start()
+
+        pending = {}       # chunked: pslot -> send-side drain closure
+        push_handles = {}  # chunked: step s -> [ChunkedPutHandle per jn]
+        for s in range(n):
+            # own chunk LAST: remote pushes get the whole kernel to land
+            c = jax.lax.rem(me + 1 + s, n) if n > 1 else jnp.int32(0)
+            ids_cp = pltpu.make_async_copy(dst_ref.at[c], ids_v, metasem)
+            ids_cp.start()
+            w_cp = pltpu.make_async_copy(wrow_ref.at[c], w_v, metasem)
+            w_cp.start()
+            ids_cp.wait()
+            w_cp.wait()
+
+            for jn in range(n_jn):
+                partial_ref[:] = jnp.zeros_like(partial_ref)
+                e0 = eid_ref[c, 0]
+                _w_start(e0, jn, 0)
+                _issue_h(c, 0, 0)  # h rows stream per block, double-buffered
+
+                def _blk(b, slot, c=c, jn=jn):
+                    e = eid_ref[c, b]
+                    e_prev = eid_ref[c, jax.lax.max(b - 1, 0)]
+                    fresh = jnp.logical_or(b == 0, e != e_prev)
+                    slot = jnp.where(fresh, 1 - slot, slot)
+
+                    @pl.when(fresh)
+                    def _():
+                        _w_wait(e, jn, slot)
+
+                    e2 = eid_ref[c, jax.lax.min(b + 1, nb - 1)]
+
+                    @pl.when(jnp.logical_and(b + 1 < nb, e2 != e))
+                    def _():
+                        _w_start(e2, jn, 1 - slot)
+
+                    hslot = jax.lax.rem(b, 2)
+                    pltpu.make_async_copy(
+                        h_ref.at[pl.ds(0, bm), :], h_buf.at[hslot],
+                        hsem.at[hslot],
+                    ).wait()
+
+                    @pl.when(b + 1 < nb)
+                    def _():
+                        pltpu.make_async_copy(
+                            h_ref.at[
+                                pl.ds(c * t_pad_loc + (b + 1) * bm, bm), :
+                            ],
+                            h_buf.at[1 - hslot],
+                            hsem.at[1 - hslot],
+                        ).start()
+
+                    if not ragged:
+                        y = jnp.dot(
+                            h_buf[hslot],
+                            fmt.mxu_b(w_buf[slot], cdt),
+                            preferred_element_type=jnp.float32,
+                        )
+                        y = fmt.fold(y, s_buf[slot][0] if fmt.w8 else None)
+                        d = ids_v[b]               # [bm] destination tokens
+                        w_r = w_v[b]               # [bm] routing weights
+                        sel = jax.lax.broadcasted_iota(
+                            jnp.int32, (m_out, bm), 0
+                        ) == d[None, :]
+                        scat = jnp.where(sel, w_r[None, :], 0.0).astype(cdt)
+                        partial_ref[:] += jnp.dot(
+                            scat, y.astype(cdt),
+                            preferred_element_type=jnp.float32,
+                        )
+                    else:
+                        # down-GEMM and combine shrink to live panels;
+                        # tail sentinel rows keep their 0 routing weight
+                        _moe_ragged_blk(
+                            h_buf, w_buf, ids_v, w_v, partial_ref, hslot,
+                            slot, b, vid_ref[c, b], m_out, bm, panel, cdt,
+                            fmt, s_buf[slot][0] if fmt.w8 else None,
+                        )
+                    return slot
+
+                jax.lax.fori_loop(0, nb, _blk, jnp.int32(1))
+
+                pc = s * n_jn + jn
+                pslot = pc % 2
+                if not chunked:
+                    def _stage_wait(sl):
+                        pltpu.make_async_copy(
+                            push_stage.at[sl], own_buf.at[:, pl.ds(0, bn)],
+                            stage_sem.at[sl],
+                        ).wait()
+
+                    if pc >= 2:
+                        _stage_wait(pslot)
+                    push_stage[pslot] = partial_ref[:].astype(out_dtype)
+                    if s < n - 1:
+                        # landing slot index s is the sender-distance
+                        # convention of _scatter_reduce_kernel: distinct
+                        # per sender by symmetry. Send completion is
+                        # accounted on stage_sem by the slot-reuse waits
+                        # (and the end-of-kernel drain).
+                        shmem.putmem_nbi_block(
+                            landing.at[s, :, pl.ds(jn * bn, bn)],
+                            push_stage.at[pslot],
+                            c, axis, stage_sem.at[pslot],
+                            recv_sems.at[s, jn],
+                        )
+                    else:
+                        pltpu.make_async_copy(
+                            push_stage.at[pslot],
+                            (out_ref if n == 1 else own_buf).at[
+                                :, pl.ds(jn * bn, bn)
+                            ],
+                            stage_sem.at[pslot],
+                        ).start()
+                else:
+                    if pc >= 2:
+                        pending.pop(pslot)()  # send-side completion first
+                    push_stage[pslot] = partial_ref[:].astype(out_dtype)
+                    if s < n - 1:
+                        # the retired slab ships as per-(s, jn, chunk)
+                        # DMAs; landing slot s = sender-distance convention
+                        handle = shmem.putmem_signal_chunked_nbi_block(
+                            lambda off, rows, s=s, jn=jn: landing.at[
+                                s, pl.ds(off, rows), pl.ds(jn * bn, bn)
+                            ],
+                            lambda off, rows, pslot=pslot: push_stage.at[
+                                pslot, pl.ds(off, rows)
+                            ],
+                            c, axis,
+                            lambda j, pslot=pslot: stage_sems.at[pslot, j],
+                            lambda j, s=s, jn=jn: recv_sems.at[s, jn, j],
+                            lambda j, s=s, jn=jn: sig_sems.at[s, jn, j],
+                            spans,
+                        )
+                        push_handles.setdefault(s, []).append(handle)
+                        pending[pslot] = handle.wait_send
+                    else:
+                        cp = pltpu.make_async_copy(
+                            push_stage.at[pslot],
+                            own_buf.at[:, pl.ds(jn * bn, bn)],
+                            local_sem.at[pslot],
+                        )
+                        cp.start()
+                        pending[pslot] = cp.wait
+
+        if not chunked:
+            # drain the last two staged pushes
+            total_push = n * n_jn
+            if total_push >= 1:
+                pltpu.make_async_copy(
+                    push_stage.at[(total_push - 1) % 2],
+                    own_buf.at[:, pl.ds(0, bn)],
+                    stage_sem.at[(total_push - 1) % 2],
+                ).wait()
+            if total_push >= 2:
+                pltpu.make_async_copy(
+                    push_stage.at[total_push % 2],
+                    own_buf.at[:, pl.ds(0, bn)],
+                    stage_sem.at[total_push % 2],
+                ).wait()
+            if n == 1:
+                return
+            # wait every incoming slab, then the n-way reduction below
+            for d in range(n - 1):
+                for jn in range(n_jn):
+                    pltpu.make_async_copy(
+                        landing.at[d, :, pl.ds(jn * bn, bn)],
+                        own_buf.at[:, pl.ds(jn * bn, bn)],
+                        recv_sems.at[d, jn],
+                    ).wait()
+        else:
+            for drain in pending.values():
+                drain()
+            # consume every incoming slab chunk by chunk (SPMD-mirrored
+            # chunks; sig slots route through the armed chunk_wait path)
+            for d in range(n - 1):
+                for jn in range(n_jn):
+                    for j in range(len(spans)):
+                        push_handles[d][jn].wait_recv_chunk(j)
+
+        h_dim = out_ref.shape[1]
+        bmo = pick_block(m_out, 256)
+        bno = pick_block(h_dim, 1024)
+
+        def reduce_body(*blks):
+            o_blk = blks[-1]
+            acc = blks[0][:].astype(jnp.float32)
+            for r in blks[1:-1]:
+                acc = acc + r[:].astype(jnp.float32)
+            o_blk[:] = acc.astype(out_dtype)
+
+        blk = lambda i, j: (i, j)  # noqa: E731
+        pltpu.emit_pipeline(
+            reduce_body,
+            grid=(m_out // bmo, h_dim // bno),
+            in_specs=[pl.BlockSpec((bmo, bno), blk)] * n,
+            out_specs=[pl.BlockSpec((bmo, bno), blk)],
+        )(
+            own_buf,
+            *(landing.at[d] for d in range(n - 1)),
+            out_ref,
+        )
+
+    return kernel

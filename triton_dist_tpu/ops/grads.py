@@ -20,6 +20,7 @@ training step.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any
 
@@ -350,6 +351,10 @@ def tp_moe_mlp_grad(
     x: ``[m_loc, H]``; w_up: ``[E, H, F/n]``; w_down: ``[E, F/n, H]``;
     topk_ids/topk_weights: ``[m_loc, topk]`` (ids carry a zero cotangent).
     Returns ``[m_loc, H]``.
+
+    ``gg_config.w8`` (ISSUE 7) streams int8 weight slabs through every
+    grouped GEMM of the forward — including both fused overlap kernels;
+    the backward strips the axis (straight-through, full-precision banks).
     """
     out, _ = _tp_moe_forward_impl(
         x, w_up, w_down, topk_ids, topk_weights, axis, activation,
@@ -372,6 +377,12 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, overlap, res, dout):
 
     a_sorted, h_sorted, tw_full, al, w_up, w_down, m_loc = res
     cfg = gg_config or GroupGemmConfig()
+    # w8 (ISSUE 7) is a forward/serving format: every backward grouped
+    # GEMM, the dw accumulation AND the y_sorted remat run with the axis
+    # stripped, differentiating against the FULL-PRECISION residual banks
+    # (straight-through — quantization's own derivative is zero a.e.).
+    if getattr(cfg, "w8", False):
+        cfg = dataclasses.replace(cfg, w8=False)
     n_exp = w_up.shape[0]
     f32 = jnp.float32
     m_tot, h_dim = tw_full.shape[0], a_sorted.shape[1]
@@ -552,6 +563,9 @@ def _gg_bwd(config, out_dtype, interpret, assume_sorted, res, dout):
 
     a_sorted, b, expert_ids, valid_rows = res
     cfg = config or GroupGemmConfig()
+    # straight-through w8: grads flow through the full-precision bank
+    if getattr(cfg, "w8", False):
+        cfg = dataclasses.replace(cfg, w8=False)
     da = group_gemm(
         dout.astype(a_sorted.dtype), b.transpose(0, 2, 1), expert_ids,
         valid_rows=valid_rows, config=cfg, out_dtype=jnp.float32,
@@ -658,6 +672,17 @@ TP_MOE_TUNE_SPACE = (
     GroupGemmConfig(512, 1024, 1024, ragged=True),
     GroupGemmConfig(256, 1024, 1024, ragged=True),
     GroupGemmConfig(128, 1024, 512, ragged=True),
+    # w8 axis (ISSUE 7): int8 expert weights through the WHOLE fused
+    # pipeline — both overlapped kernels stream half the weight bytes,
+    # the decode regime's bound resource (the unfused moe_w8 metric
+    # measured 1.404× of its ~2× ceiling). Strictly AFTER the bf16 twins
+    # (quantization is a serving knob — only a timed sweep may crown it);
+    # `suggest_w8_overlap` prunes it from compute-bound problems.
+    GroupGemmConfig(512, 1024, 512, w8=True),
+    GroupGemmConfig(256, 1024, 1024, w8=True),
+    GroupGemmConfig(128, 1024, 512, w8=True),
+    GroupGemmConfig(512, 1024, 512, ragged=True, w8=True),
+    GroupGemmConfig(128, 1024, 512, ragged=True, w8=True),
     # the XLA sentinel (VERDICT r5 #1): the whole pipeline with both
     # grouped GEMMs lowered to jax.lax.ragged_dot over the same layout
     # (sequential composition — rank-major blocks aren't globally
@@ -678,6 +703,9 @@ TP_MOE_TUNE_SPACE = (
     # (after their padded chunked twins, preserving both orderings)
     GroupGemmConfig(512, 1024, 512, chunks_per_shard=2, ragged=True),
     GroupGemmConfig(512, 1024, 512, chunks_per_shard=4, ragged=True),
+    # w8 × chunked (× ragged): strictly after the bf16 chunked twins
+    GroupGemmConfig(512, 1024, 512, chunks_per_shard=2, w8=True),
+    GroupGemmConfig(512, 1024, 512, chunks_per_shard=2, ragged=True, w8=True),
 )
 
 def _moe_block_sensible(cfg, x, w_up, w_down, topk_ids, topk_weights,
@@ -699,10 +727,24 @@ def _moe_block_sensible(cfg, x, w_up, w_down, topk_ids, topk_weights,
     padded grid would compute for THIS problem — when the tax is already
     negligible (counts divisible by the block, or the block no bigger than
     the MXU row panel) ragged cannot help and is never timed nor applied;
-    padded candidates always survive."""
+    padded candidates always survive.
+
+    w8 candidates (ISSUE 7) pass ``perf_model.suggest_w8_overlap``: the
+    weight-bound predicate (bf16 weight stream time vs MXU time — purely a
+    function of t and E, the K·N factors cancel). bf16 candidates are
+    never subject to it, so pruning can never remove a bf16 chunk=1
+    candidate."""
     t = topk_ids.shape[0] * topk_ids.shape[1]
     if cfg.block_m > 128 and w_up.shape[0] * cfg.block_m > t // 2:
         return False
+    if getattr(cfg, "w8", False):
+        # weight-bound hook (ISSUE 7): bf16 candidates are NEVER subject
+        # to it — pruning can only remove w8 candidates, so the bf16
+        # chunk=1 leaders always survive.
+        from triton_dist_tpu import perf_model
+
+        if not perf_model.suggest_w8_overlap(t, w_up.shape[0]):
+            return False
     if getattr(cfg, "ragged", False) or (
         getattr(cfg, "backend", "pallas") != "pallas"
     ):

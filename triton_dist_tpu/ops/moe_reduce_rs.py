@@ -13,6 +13,12 @@ scatter-add (moe_utils.scatter_add_unsorted — the notify/counter machinery
 has no role when kernels chain in-order on one core), and the result feeds
 the fused reduce-scatter kernel, whose one-sided pushes overlap the next
 layer's work in the XLA schedule.
+
+The fused overlap kernel body comes from the pipeline emitter
+(:func:`triton_dist_tpu.ops.gg_pipeline.make_moe_rs_overlap_kernel`,
+ISSUE 7); this entry builds specs/scratch for the chosen policy tuple,
+and ``GroupGemmConfig.w8`` streams int8 ``W_down`` slabs at half the HBM
+bytes (scale rows on the weight prefetch chain).
 """
 
 from __future__ import annotations
@@ -29,10 +35,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_dist_tpu.autotuner import contextual_autotune
 from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
+from triton_dist_tpu.ops.gg_pipeline import (
+    OperandFormat,
+    make_moe_rs_overlap_kernel,
+)
 from triton_dist_tpu.ops.group_gemm import (
     GroupGemmConfig,
+    _group_gemm_xla,
     _panel_for,
     group_gemm,
+    resolve_w8,
 )
 from triton_dist_tpu.ops.moe_utils import (
     MoEAlignment,
@@ -40,7 +52,6 @@ from triton_dist_tpu.ops.moe_utils import (
     valid_rows_from_sorted,
 )
 from triton_dist_tpu.ops.reduce_scatter import ReduceScatterConfig, reduce_scatter
-from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import pick_block
 from triton_dist_tpu.utils import axis_size as _axis_size
 
@@ -104,460 +115,153 @@ def rs_block_n_for(
     return pick_block(h_dim, min(want_bn, cap))
 
 
-def _moe_ragged_blk(
-    h_buf, w_buf, ids_v, w_v, partial_ref, hslot, slot, b, v, m_out, bm,
-    panel, cdt,
+def _moe_rs_overlap_xla(
+    h_sorted, w_down, scale, expert_ids, dst_ids, w_rows, *, axis, ragged,
+    valid_rows, m_out, out_dtype,
 ):
-    """Ragged block step of the fused down-projection (ISSUE 5): the
-    ``h_block @ W_down`` dot AND the one-hot combine run only for the
-    block's live ``panel``-row panels (``pl.when``-guarded) — the combine's
-    FLOPs scale with live rows too, since its contraction dim IS the block
-    rows. Dead panels contribute nothing; partial_ref is accumulative so
-    skipping is exact."""
-    d = ids_v[b]
-    w_r = w_v[b]
-    for p in range(bm // panel):
-        @pl.when(p * panel < v)
-        def _(p=p):
-            yp = jnp.dot(
-                h_buf[hslot, pl.ds(p * panel, panel), :],
-                w_buf[slot],
-                preferred_element_type=jnp.float32,
-            )
-            dp = d[p * panel:(p + 1) * panel]
-            wp = w_r[p * panel:(p + 1) * panel]
-            sel = jax.lax.broadcasted_iota(
-                jnp.int32, (m_out, panel), 0
-            ) == dp[None, :]
-            scat = jnp.where(sel, wp[None, :], 0.0).astype(cdt)
-            partial_ref[:] += jnp.dot(
-                scat, yp.astype(cdt), preferred_element_type=jnp.float32
-            )
+    """Golden slow path for the fused down-projection: block-gathered
+    einsum + scatter-add combine per destination rank + one psum-scatter —
+    the program the fused kernel is tested against."""
+    n, nb, bm = dst_ids.shape
+    h_dim = w_down.shape[2]
+    y = _group_gemm_xla(
+        h_sorted, w_down, expert_ids.reshape(-1),
+        valid_rows=None if valid_rows is None else valid_rows.reshape(-1),
+        scale=scale, ragged=ragged, bm=bm, out_dtype=jnp.float32,
+        act_fn=None,
+    ).reshape(n, nb * bm, h_dim)
+    w = w_rows.reshape(n, nb * bm).astype(jnp.float32)
+    d = dst_ids.reshape(n, nb * bm)
+    c_idx = jnp.repeat(jnp.arange(n, dtype=jnp.int32)[:, None], nb * bm, 1)
+    partial = (
+        jnp.zeros((n, m_out, h_dim), jnp.float32)
+        .at[c_idx, d]
+        .add(y * w[..., None])
+    )
+    # each rank holds the f_loc-shard partial for EVERY destination chunk;
+    # destination c's output is the sum over ranks of partial[c]
+    return jax.lax.psum_scatter(
+        partial.reshape(n * m_out, h_dim), axis, scatter_dimension=0,
+        tiled=True,
+    ).astype(out_dtype)
 
 
-def _moe_reduce_rs_overlap_kernel(
-    eid_ref, h_ref, w_ref, dst_ref, wrow_ref,
-    out_ref, own_buf, landing,
-    h_buf, w_buf, push_stage, ids_v, w_v, partial_ref,
-    hsem, wsem, metasem, stage_sem, recv_sems,
-    *, axis: str, n: int, nb: int, n_jn: int, bn: int, m_out: int, out_dtype,
-    vid_ref=None, panel: int = 0,
+def _moe_rs_overlap_fused(
+    h_sorted, w_down, scale, expert_ids, dst_ids, w_rows, *, axis, ragged,
+    valid_rows, m_out, out_dtype, cfg, interpret,
 ):
-    """Fused grouped-GEMM → weighted combine → reduce-scatter: destination
-    rank c's chunk is computed from ITS aligned rows (rank-major layout:
-    chunk c's blocks are contiguous), combined in VMEM, and pushed to c the
-    moment its slab is done — while the next chunk's expert GEMMs already
-    run (≙ the reference's producer GEMM on side streams feeding the RS
-    consumer through per-rank notify counters, moe_reduce_rs.py:362,
-    817,882-1020). The top-k weighted scatter is a one-hot-weights matmul
-    riding the MXU in the shadow of the weight-slab DMAs instead of a
-    per-row scatter pass over HBM."""
-    me = shmem.my_pe(axis)
-    t_pad_tot, f_loc = h_ref.shape
+    n = _axis_size((axis))
+    t_pad_tot, f_loc = h_sorted.shape
     t_pad_loc = t_pad_tot // n
+    nb = expert_ids.shape[1]
     bm = t_pad_loc // nb
-    cdt = h_ref.dtype
-    if n > 1:
-        shmem.barrier_all(axis)
-
-    def _issue_h(c, b, slot):
-        pltpu.make_async_copy(
-            h_ref.at[pl.ds(c * t_pad_loc + b * bm, bm), :],
-            h_buf.at[slot],
-            hsem.at[slot],
-        ).start()
-
-    for s in range(n):
-        # own chunk LAST: remote pushes get the whole kernel to land
-        c = jax.lax.rem(me + 1 + s, n) if n > 1 else jnp.int32(0)
-        ids_cp = pltpu.make_async_copy(dst_ref.at[c], ids_v, metasem)
-        ids_cp.start()
-        w_cp = pltpu.make_async_copy(wrow_ref.at[c], w_v, metasem)
-        w_cp.start()
-        ids_cp.wait()
-        w_cp.wait()
-
-        for jn in range(n_jn):
-            partial_ref[:] = jnp.zeros_like(partial_ref)
-            e0 = eid_ref[c, 0]
-            pltpu.make_async_copy(
-                w_ref.at[e0, :, pl.ds(jn * bn, bn)], w_buf.at[0], wsem.at[0]
-            ).start()
-            _issue_h(c, 0, 0)   # h rows stream per block, double-buffered
-
-            def _blk(b, slot):
-                e = eid_ref[c, b]
-                e_prev = eid_ref[c, jax.lax.max(b - 1, 0)]
-                fresh = jnp.logical_or(b == 0, e != e_prev)
-                slot = jnp.where(fresh, 1 - slot, slot)
-
-                @pl.when(fresh)
-                def _():
-                    pltpu.make_async_copy(
-                        w_ref.at[e, :, pl.ds(jn * bn, bn)],
-                        w_buf.at[slot],
-                        wsem.at[slot],
-                    ).wait()
-
-                e2 = eid_ref[c, jax.lax.min(b + 1, nb - 1)]
-
-                @pl.when(jnp.logical_and(b + 1 < nb, e2 != e))
-                def _():
-                    pltpu.make_async_copy(
-                        w_ref.at[e2, :, pl.ds(jn * bn, bn)],
-                        w_buf.at[1 - slot],
-                        wsem.at[1 - slot],
-                    ).start()
-
-                hslot = jax.lax.rem(b, 2)
-                pltpu.make_async_copy(
-                    h_ref.at[pl.ds(0, bm), :], h_buf.at[hslot], hsem.at[hslot]
-                ).wait()
-
-                @pl.when(b + 1 < nb)
-                def _():
-                    pltpu.make_async_copy(
-                        h_ref.at[
-                            pl.ds(c * t_pad_loc + (b + 1) * bm, bm), :
-                        ],
-                        h_buf.at[1 - hslot],
-                        hsem.at[1 - hslot],
-                    ).start()
-
-                if vid_ref is None:
-                    y = jnp.dot(
-                        h_buf[hslot],
-                        w_buf[slot],
-                        preferred_element_type=jnp.float32,
-                    )
-                    d = ids_v[b]                   # [bm] destination tokens
-                    w_r = w_v[b]                   # [bm] routing weights
-                    sel = jax.lax.broadcasted_iota(
-                        jnp.int32, (m_out, bm), 0
-                    ) == d[None, :]
-                    scat = jnp.where(sel, w_r[None, :], 0.0).astype(cdt)
-                    partial_ref[:] += jnp.dot(
-                        scat, y.astype(cdt), preferred_element_type=jnp.float32
-                    )
-                else:
-                    # ragged (ISSUE 5): both the down-GEMM and the one-hot
-                    # combine shrink to the block's live panels. Sentinel
-                    # rows inside the tail panel keep their 0 routing
-                    # weight (ranked_scatter_meta), so their computed rows
-                    # contribute exact zeros.
-                    _moe_ragged_blk(
-                        h_buf, w_buf, ids_v, w_v, partial_ref, hslot, slot,
-                        b, vid_ref[c, b], m_out, bm, panel, cdt,
-                    )
-                return slot
-
-            jax.lax.fori_loop(0, nb, _blk, jnp.int32(1))
-
-            pc = s * n_jn + jn
-            pslot = pc % 2
-
-            def _stage_wait(sl):
-                pltpu.make_async_copy(
-                    push_stage.at[sl], own_buf.at[:, pl.ds(0, bn)],
-                    stage_sem.at[sl],
-                ).wait()
-
-            if pc >= 2:
-                _stage_wait(pslot)
-            push_stage[pslot] = partial_ref[:].astype(out_dtype)
-            if s < n - 1:
-                # landing slot index s is the sender-distance convention of
-                # _scatter_reduce_kernel: distinct per sender by symmetry.
-                # Send completion is accounted on stage_sem by the slot-reuse
-                # waits (and the end-of-kernel drain), so the handle is not
-                # kept.
-                shmem.putmem_nbi_block(
-                    landing.at[s, :, pl.ds(jn * bn, bn)],
-                    push_stage.at[pslot],
-                    c, axis, stage_sem.at[pslot], recv_sems.at[s, jn],
-                )
-            else:
-                pltpu.make_async_copy(
-                    push_stage.at[pslot],
-                    (out_ref if n == 1 else own_buf).at[:, pl.ds(jn * bn, bn)],
-                    stage_sem.at[pslot],
-                ).start()
-
-    # drain the last two staged pushes
-    total_push = n * n_jn
-    if total_push >= 1:
-        pltpu.make_async_copy(
-            push_stage.at[(total_push - 1) % 2], own_buf.at[:, pl.ds(0, bn)],
-            stage_sem.at[(total_push - 1) % 2],
-        ).wait()
-    if total_push >= 2:
-        pltpu.make_async_copy(
-            push_stage.at[total_push % 2], own_buf.at[:, pl.ds(0, bn)],
-            stage_sem.at[total_push % 2],
-        ).wait()
-    if n == 1:
-        return
-
-    # wait every incoming slab, then one n-way f32 reduction pass
-    for d in range(n - 1):
-        for jn in range(n_jn):
-            pltpu.make_async_copy(
-                landing.at[d, :, pl.ds(jn * bn, bn)],
-                own_buf.at[:, pl.ds(jn * bn, bn)],
-                recv_sems.at[d, jn],
-            ).wait()
-
-    h_dim = out_ref.shape[1]
-    bmo = pick_block(m_out, 256)
-    bno = pick_block(h_dim, 1024)
-
-    def reduce_body(*blks):
-        o_blk = blks[-1]
-        acc = blks[0][:].astype(jnp.float32)
-        for r in blks[1:-1]:
-            acc = acc + r[:].astype(jnp.float32)
-        o_blk[:] = acc.astype(out_dtype)
-
-    blk = lambda i, j: (i, j)  # noqa: E731
-    pltpu.emit_pipeline(
-        reduce_body,
-        grid=(m_out // bmo, h_dim // bno),
-        in_specs=[pl.BlockSpec((bmo, bno), blk)] * n,
-        out_specs=[pl.BlockSpec((bmo, bno), blk)],
-    )(
-        own_buf,
-        *(landing.at[d] for d in range(n - 1)),
-        out_ref,
+    w8 = scale is not None
+    h_dim = w_down.shape[2]
+    itemsize = jnp.dtype(h_sorted.dtype).itemsize
+    bn = rs_block_n_for(
+        h_dim, cfg.block_n, m_out, f_loc,
+        jnp.dtype(out_dtype).itemsize, jnp.dtype(w_down.dtype).itemsize,
     )
+    n_jn = h_dim // bn
+    workspace = [
+        jax.ShapeDtypeStruct((m_out, h_dim), out_dtype),            # own_buf
+        jax.ShapeDtypeStruct((max(n - 1, 1), m_out, h_dim), out_dtype),
+    ]
+    from triton_dist_tpu.ops.common import chunk_schedule
 
-
-def _moe_reduce_rs_overlap_chunked_kernel(
-    eid_ref, h_ref, w_ref, dst_ref, wrow_ref,
-    out_ref, own_buf, landing,
-    h_buf, w_buf, push_stage, ids_v, w_v, partial_ref,
-    hsem, wsem, metasem, stage_sems, local_sem, recv_sems, sig_sems,
-    *, axis: str, n: int, nb: int, n_jn: int, bn: int, m_out: int,
-    out_dtype, spans, vid_ref=None, panel: int = 0,
-):
-    """Chunk-granular combine side of the fused MoE down-projection
-    (ISSUE 4 tentpole): the schedule of :func:`_moe_reduce_rs_overlap_kernel`
-    with every retired (destination, H-slab) output block pushed as the
-    ``spans`` chunk DMAs (``shmem.putmem_signal_chunked_nbi_block``) on
-    per-(step, slab, chunk) semaphore slots — the first bytes of a
-    finished slab are on the wire while the accumulator's copy of the
-    later rows still drains, the chunks ride distinct routes, and the
-    receiver's final reduction consumes each landing chunk by chunk
-    through ``wait_chunk`` (so a dropped chunk signal surfaces as a
-    ``chunk_wait`` diagnostic, never corruption). Compute schedule —
-    GEMMs, one-hot combine, slab retirement order — is identical to
-    legacy; ``chunks=1`` (or world-1) dispatches there."""
-    me = shmem.my_pe(axis)
-    t_pad_tot, f_loc = h_ref.shape
-    t_pad_loc = t_pad_tot // n
-    bm = t_pad_loc // nb
-    cdt = h_ref.dtype
-    shmem.barrier_all(axis)  # n >= 2: the host entry dispatches chunked
-    # schedules only on multi-PE worlds
-
-    def _issue_h(c, b, slot):
-        pltpu.make_async_copy(
-            h_ref.at[pl.ds(c * t_pad_loc + b * bm, bm), :],
-            h_buf.at[slot],
-            hsem.at[slot],
-        ).start()
-
-    pending = {}       # pslot -> send-side drain closure (slot reuse)
-    push_handles = {}  # step s -> [ChunkedPutHandle per jn]
-    for s in range(n):
-        # own chunk LAST: remote pushes get the whole kernel to land
-        c = jax.lax.rem(me + 1 + s, n)
-        ids_cp = pltpu.make_async_copy(dst_ref.at[c], ids_v, metasem)
-        ids_cp.start()
-        w_cp = pltpu.make_async_copy(wrow_ref.at[c], w_v, metasem)
-        w_cp.start()
-        ids_cp.wait()
-        w_cp.wait()
-
-        for jn in range(n_jn):
-            partial_ref[:] = jnp.zeros_like(partial_ref)
-            e0 = eid_ref[c, 0]
-            pltpu.make_async_copy(
-                w_ref.at[e0, :, pl.ds(jn * bn, bn)], w_buf.at[0], wsem.at[0]
-            ).start()
-            _issue_h(c, 0, 0)
-
-            def _blk(b, slot):
-                e = eid_ref[c, b]
-                e_prev = eid_ref[c, jax.lax.max(b - 1, 0)]
-                fresh = jnp.logical_or(b == 0, e != e_prev)
-                slot = jnp.where(fresh, 1 - slot, slot)
-
-                @pl.when(fresh)
-                def _():
-                    pltpu.make_async_copy(
-                        w_ref.at[e, :, pl.ds(jn * bn, bn)],
-                        w_buf.at[slot],
-                        wsem.at[slot],
-                    ).wait()
-
-                e2 = eid_ref[c, jax.lax.min(b + 1, nb - 1)]
-
-                @pl.when(jnp.logical_and(b + 1 < nb, e2 != e))
-                def _():
-                    pltpu.make_async_copy(
-                        w_ref.at[e2, :, pl.ds(jn * bn, bn)],
-                        w_buf.at[1 - slot],
-                        wsem.at[1 - slot],
-                    ).start()
-
-                hslot = jax.lax.rem(b, 2)
-                pltpu.make_async_copy(
-                    h_ref.at[pl.ds(0, bm), :], h_buf.at[hslot], hsem.at[hslot]
-                ).wait()
-
-                @pl.when(b + 1 < nb)
-                def _():
-                    pltpu.make_async_copy(
-                        h_ref.at[
-                            pl.ds(c * t_pad_loc + (b + 1) * bm, bm), :
-                        ],
-                        h_buf.at[1 - hslot],
-                        hsem.at[1 - hslot],
-                    ).start()
-
-                if vid_ref is None:
-                    y = jnp.dot(
-                        h_buf[hslot],
-                        w_buf[slot],
-                        preferred_element_type=jnp.float32,
-                    )
-                    d = ids_v[b]
-                    w_r = w_v[b]
-                    sel = jax.lax.broadcasted_iota(
-                        jnp.int32, (m_out, bm), 0
-                    ) == d[None, :]
-                    scat = jnp.where(sel, w_r[None, :], 0.0).astype(cdt)
-                    partial_ref[:] += jnp.dot(
-                        scat, y.astype(cdt), preferred_element_type=jnp.float32
-                    )
-                else:
-                    # ragged × chunked (ISSUE 5): the combine-push chunk
-                    # schedule spans m_out rows and never consults
-                    # valid_rows — ragged adds no signal edges here either
-                    _moe_ragged_blk(
-                        h_buf, w_buf, ids_v, w_v, partial_ref, hslot, slot,
-                        b, vid_ref[c, b], m_out, bm, panel, cdt,
-                    )
-                return slot
-
-            jax.lax.fori_loop(0, nb, _blk, jnp.int32(1))
-
-            pc = s * n_jn + jn
-            pslot = pc % 2
-            if pc >= 2:
-                pending.pop(pslot)()  # send-side completion before reuse
-            push_stage[pslot] = partial_ref[:].astype(out_dtype)
-            if s < n - 1:
-                # combine-side chunked put: the retired slab ships as
-                # per-chunk DMAs on per-(s, jn, chunk) slots; landing slot
-                # s is the sender-distance convention of the legacy kernel
-                handle = shmem.putmem_signal_chunked_nbi_block(
-                    lambda off, rows, s=s, jn=jn: landing.at[
-                        s, pl.ds(off, rows), pl.ds(jn * bn, bn)
-                    ],
-                    lambda off, rows, pslot=pslot: push_stage.at[
-                        pslot, pl.ds(off, rows)
-                    ],
-                    c, axis,
-                    lambda j, pslot=pslot: stage_sems.at[pslot, j],
-                    lambda j, s=s, jn=jn: recv_sems.at[s, jn, j],
-                    lambda j, s=s, jn=jn: sig_sems.at[s, jn, j],
-                    spans,
-                )
-                push_handles.setdefault(s, []).append(handle)
-                pending[pslot] = handle.wait_send
-            else:
-                cp = pltpu.make_async_copy(
-                    push_stage.at[pslot],
-                    own_buf.at[:, pl.ds(jn * bn, bn)],
-                    local_sem.at[pslot],
-                )
-                cp.start()
-                pending[pslot] = cp.wait
-
-    for drain in pending.values():
-        drain()
-
-    # consume every incoming slab chunk by chunk (the handle's recv side
-    # observes the equal-shaped chunks from the mirror sender, SPMD
-    # symmetry — and its sig slot routes through the watchdogged
-    # chunk_wait path when armed), then one n-way f32 reduction pass
-    for d in range(n - 1):
-        for jn in range(n_jn):
-            for j in range(len(spans)):
-                push_handles[d][jn].wait_recv_chunk(j)
-
-    h_dim = out_ref.shape[1]
-    bmo = pick_block(m_out, 256)
-    bno = pick_block(h_dim, 1024)
-
-    def reduce_body(*blks):
-        o_blk = blks[-1]
-        acc = blks[0][:].astype(jnp.float32)
-        for r in blks[1:-1]:
-            acc = acc + r[:].astype(jnp.float32)
-        o_blk[:] = acc.astype(out_dtype)
-
-    blk = lambda i, j: (i, j)  # noqa: E731
-    pltpu.emit_pipeline(
-        reduce_body,
-        grid=(m_out // bmo, h_dim // bno),
-        in_specs=[pl.BlockSpec((bmo, bno), blk)] * n,
-        out_specs=[pl.BlockSpec((bmo, bno), blk)],
-    )(
-        own_buf,
-        *(landing.at[d] for d in range(n - 1)),
-        out_ref,
+    # combine-side chunk schedule (ISSUE 4): spans over the pushed slab's
+    # m_out rows, quantized to 128 so chunk boundaries stay tile-aligned;
+    # a single-span schedule (incl. chunk=1 and world-1) emits the legacy
+    # whole-slab push protocol, bit for bit
+    spans = chunk_schedule(
+        m_out, max(1, int(getattr(cfg, "chunks_per_shard", 1))) if n > 1 else 1,
+        quantum=128,
     )
-
-
-def _moe_reduce_rs_overlap_ragged_kernel(
-    eid_ref, vid_ref, h_ref, w_ref, dst_ref, wrow_ref,
-    out_ref, own_buf, landing,
-    h_buf, w_buf, push_stage, ids_v, w_v, partial_ref,
-    hsem, wsem, metasem, stage_sem, recv_sems,
-    *, axis: str, n: int, nb: int, n_jn: int, bn: int, m_out: int,
-    out_dtype, panel: int,
-):
-    """Ragged entry (ISSUE 5): the legacy schedule with the per-(rank,
-    block) live-row map as a second SMEM operand — push/landing/semaphore
-    structure identical; only each block's MXU work shrinks."""
-    _moe_reduce_rs_overlap_kernel(
-        eid_ref, h_ref, w_ref, dst_ref, wrow_ref, out_ref, own_buf, landing,
-        h_buf, w_buf, push_stage, ids_v, w_v, partial_ref,
-        hsem, wsem, metasem, stage_sem, recv_sems,
+    kernel = make_moe_rs_overlap_kernel(
         axis=axis, n=n, nb=nb, n_jn=n_jn, bn=bn, m_out=m_out,
-        out_dtype=out_dtype, vid_ref=vid_ref, panel=panel,
+        out_dtype=out_dtype, spans=spans, ragged=ragged,
+        panel=_panel_for(bm) if ragged else 0, fmt=OperandFormat(w8),
     )
-
-
-def _moe_reduce_rs_overlap_chunked_ragged_kernel(
-    eid_ref, vid_ref, h_ref, w_ref, dst_ref, wrow_ref,
-    out_ref, own_buf, landing,
-    h_buf, w_buf, push_stage, ids_v, w_v, partial_ref,
-    hsem, wsem, metasem, stage_sems, local_sem, recv_sems, sig_sems,
-    *, axis: str, n: int, nb: int, n_jn: int, bn: int, m_out: int,
-    out_dtype, spans, panel: int,
-):
-    """Ragged × chunked entry (ISSUE 5 × ISSUE 4): chunked combine pushes
-    with ragged per-block compute; the chunk protocol is untouched."""
-    _moe_reduce_rs_overlap_chunked_kernel(
-        eid_ref, h_ref, w_ref, dst_ref, wrow_ref, out_ref, own_buf, landing,
-        h_buf, w_buf, push_stage, ids_v, w_v, partial_ref,
-        hsem, wsem, metasem, stage_sems, local_sem, recv_sems, sig_sems,
-        axis=axis, n=n, nb=nb, n_jn=n_jn, bn=bn, m_out=m_out,
-        out_dtype=out_dtype, spans=spans, vid_ref=vid_ref, panel=panel,
-    )
+    if len(spans) > 1:
+        push_scratch = [
+            pltpu.SemaphoreType.DMA((2, len(spans))),   # stage_sems
+            pltpu.SemaphoreType.DMA((2,)),              # local_sem
+            pltpu.SemaphoreType.DMA((max(n - 1, 1), n_jn, len(spans))),
+            # pure chunk-signal slots (REGULAR; armed watchdog only)
+            pltpu.SemaphoreType.REGULAR((max(n - 1, 1), n_jn, len(spans))),
+        ]
+    else:
+        push_scratch = [
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((max(n - 1, 1), n_jn)),
+        ]
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),   # expert ids [n, nb]
+        # HBM pinned: dynamic-offset slices must DMA from untiled HBM,
+        # never compiler-chosen VMEM (see ag_group_gemm_overlap)
+        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # h_sorted
+        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # w_down
+        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # dst_ids
+        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # w_rows
+    ]
+    args = [expert_ids, h_sorted, w_down, dst_ids, w_rows]
+    if ragged:
+        # the per-(rank, block) live-row map rides SMEM next to the ids
+        in_specs.insert(1, pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.insert(1, valid_rows.astype(jnp.int32))
+    if w8:
+        # the scale bank rides HBM right after the int8 weight pool
+        idx = 3 + (1 if ragged else 0)
+        in_specs.insert(idx, pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM))
+        args.insert(idx, scale.astype(jnp.float32))
+    weight_scratch = [pltpu.VMEM((2, f_loc, bn), w_down.dtype)]
+    wsem_scratch = [pltpu.SemaphoreType.DMA((2,))]
+    if w8:
+        weight_scratch.append(pltpu.VMEM((2, 1, bn), jnp.float32))
+        wsem_scratch.append(pltpu.SemaphoreType.DMA((2,)))
+    outs = dist_pallas_call(
+        kernel,
+        name="moe_reduce_rs_overlap",
+        out_shape=(
+            jax.ShapeDtypeStruct((m_out, h_dim), out_dtype),
+            *workspace,
+        ),
+        in_specs=in_specs,
+        out_specs=tuple(
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM) for _ in range(3)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, bm, f_loc), h_sorted.dtype),
+            *weight_scratch,
+            pltpu.VMEM((2, m_out, bn), out_dtype),
+            pltpu.VMEM((nb, bm), jnp.int32),
+            pltpu.VMEM((nb, bm), jnp.float32),
+            pltpu.VMEM((m_out, bn), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            *wsem_scratch,
+            pltpu.SemaphoreType.DMA(()),
+            *push_scratch,
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * t_pad_tot * f_loc * h_dim
+            + 2 * n * n_jn * nb * m_out * bm * bn,
+            bytes_accessed=(
+                t_pad_tot * f_loc + (2 * n) * m_out * h_dim
+            ) * itemsize
+            + w_down.shape[0] * f_loc * h_dim * w_down.dtype.itemsize,
+            transcendentals=0,
+        ),
+        vmem_limit_bytes=min(
+            2 * bm * f_loc * itemsize
+            + 2 * f_loc * bn * jnp.dtype(w_down.dtype).itemsize
+            + (2 * jnp.dtype(out_dtype).itemsize + 4) * m_out * bn
+            + 8 * 2**20,
+            100 * 2**20,
+        ),
+        uses_barrier=n > 1,
+        interpret=interpret,
+    )(*args)
+    return outs[0]
 
 
 def moe_reduce_rs_overlap(
@@ -570,6 +274,7 @@ def moe_reduce_rs_overlap(
     axis: str = "tp",
     m_out: int,
     valid_rows: jax.Array | None = None,
+    scale: jax.Array | None = None,
     config: GroupGemmConfig | None = None,
     out_dtype: Any = None,
     interpret: Any = None,
@@ -578,12 +283,16 @@ def moe_reduce_rs_overlap(
     inside shard_map). h_sorted: ``[n*t_pad_loc, f_loc]`` rank-major aligned
     rows (the fused up-projection's output); w_down: ``[E, f_loc, H]``;
     expert_ids ``[n, nb]``, and ``(dst_ids, w_rows)`` ``[n, nb, bm]`` from
-    :func:`~triton_dist_tpu.ops.moe_utils.ranked_scatter_meta`. Returns
-    ``[m_out, H]`` — this PE's fully-reduced token chunk."""
+    :func:`~triton_dist_tpu.ops.moe_utils.ranked_scatter_meta`. ``scale``
+    (or ``config.w8`` for on-the-fly quantization) streams int8 ``W_down``
+    slabs at half the HBM bytes. Returns ``[m_out, H]`` — this PE's
+    fully-reduced token chunk."""
+    from triton_dist_tpu import resilience
+
     cfg = config or GroupGemmConfig()
     out_dtype = out_dtype or h_sorted.dtype
     n = _axis_size((axis))
-    t_pad_tot, f_loc = h_sorted.shape
+    t_pad_tot = h_sorted.shape[0]
     t_pad_loc = t_pad_tot // n
     nb = expert_ids.shape[1]
     bm = t_pad_loc // nb
@@ -601,114 +310,19 @@ def moe_reduce_rs_overlap(
             "GroupGemmConfig.ragged needs the ranked alignment's "
             "valid_rows map (moe_align_ranked(..., ragged=True))"
         )
-    h_dim = w_down.shape[2]
-    itemsize = jnp.dtype(h_sorted.dtype).itemsize
-    bn = rs_block_n_for(
-        h_dim, cfg.block_n, m_out, f_loc,
-        jnp.dtype(out_dtype).itemsize, jnp.dtype(w_down.dtype).itemsize,
-    )
-    n_jn = h_dim // bn
-    workspace = [
-        jax.ShapeDtypeStruct((m_out, h_dim), out_dtype),            # own_buf
-        jax.ShapeDtypeStruct((max(n - 1, 1), m_out, h_dim), out_dtype),
-    ]
-    from triton_dist_tpu.ops.common import chunk_schedule
-
-    # combine-side chunk schedule (ISSUE 4): spans over the pushed slab's
-    # m_out rows, quantized to 128 so every chunk boundary stays
-    # tile-aligned in VMEM/HBM for any dtype; a single-span schedule —
-    # including every chunks_per_shard=1 config and world-1 — dispatches
-    # to the UNCHANGED legacy kernel, bit for bit
-    spans = chunk_schedule(
-        m_out, max(1, int(getattr(cfg, "chunks_per_shard", 1))) if n > 1 else 1,
-        quantum=128,
-    )
-    ragged_kw = {"panel": _panel_for(bm)} if ragged else {}
-    if len(spans) > 1:
-        kernel = functools.partial(
-            _moe_reduce_rs_overlap_chunked_ragged_kernel if ragged
-            else _moe_reduce_rs_overlap_chunked_kernel,
-            axis=axis, n=n, nb=nb,
-            n_jn=n_jn, bn=bn, m_out=m_out, out_dtype=out_dtype, spans=spans,
-            **ragged_kw,
+    w_down, scale = resolve_w8(w_down, scale, cfg)
+    if scale is not None:
+        assert scale.shape == (w_down.shape[0], 1, w_down.shape[2]), (
+            scale.shape, w_down.shape,
         )
-        push_scratch = [
-            pltpu.SemaphoreType.DMA((2, len(spans))),   # stage_sems
-            pltpu.SemaphoreType.DMA((2,)),              # local_sem
-            pltpu.SemaphoreType.DMA((max(n - 1, 1), n_jn, len(spans))),
-            # pure chunk-signal slots (REGULAR; armed watchdog only)
-            pltpu.SemaphoreType.REGULAR((max(n - 1, 1), n_jn, len(spans))),
-        ]
-    else:
-        kernel = functools.partial(
-            _moe_reduce_rs_overlap_ragged_kernel if ragged
-            else _moe_reduce_rs_overlap_kernel,
-            axis=axis, n=n, nb=nb,
-            n_jn=n_jn, bn=bn, m_out=m_out, out_dtype=out_dtype,
-            **ragged_kw,
-        )
-        push_scratch = [
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((max(n - 1, 1), n_jn)),
-        ]
-    in_specs = [
-        pl.BlockSpec(memory_space=pltpu.SMEM),   # expert ids [n, nb]
-        # HBM pinned: block/meta slices at dynamic offsets must DMA
-        # from untiled HBM, not from VMEM the compiler might choose
-        # for small inputs (see ag_group_gemm_overlap)
-        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # h_sorted
-        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # w_down
-        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # dst_ids
-        pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),  # w_rows
-    ]
-    args = [expert_ids, h_sorted, w_down, dst_ids, w_rows]
-    if ragged:
-        # the per-(rank, block) live-row map rides SMEM next to the ids
-        in_specs.insert(1, pl.BlockSpec(memory_space=pltpu.SMEM))
-        args.insert(1, valid_rows.astype(jnp.int32))
-    outs = dist_pallas_call(
-        kernel,
-        name="moe_reduce_rs_overlap",
-        out_shape=(
-            jax.ShapeDtypeStruct((m_out, h_dim), out_dtype),
-            *workspace,
-        ),
-        in_specs=in_specs,
-        out_specs=tuple(
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM) for _ in range(3)
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((2, bm, f_loc), h_sorted.dtype),
-            pltpu.VMEM((2, f_loc, bn), w_down.dtype),
-            pltpu.VMEM((2, m_out, bn), out_dtype),
-            pltpu.VMEM((nb, bm), jnp.int32),
-            pltpu.VMEM((nb, bm), jnp.float32),
-            pltpu.VMEM((m_out, bn), jnp.float32),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA(()),
-            *push_scratch,
-        ],
-        cost_estimate=pl.CostEstimate(
-            flops=2 * t_pad_tot * f_loc * h_dim
-            + 2 * n * n_jn * nb * m_out * bm * bn,
-            bytes_accessed=(
-                t_pad_tot * f_loc + w_down.shape[0] * f_loc * h_dim
-                + (2 * n) * m_out * h_dim
-            ) * itemsize,
-            transcendentals=0,
-        ),
-        vmem_limit_bytes=min(
-            2 * bm * f_loc * itemsize
-            + 2 * f_loc * bn * jnp.dtype(w_down.dtype).itemsize
-            + (2 * jnp.dtype(out_dtype).itemsize + 4) * m_out * bn
-            + 8 * 2**20,
-            100 * 2**20,
-        ),
-        uses_barrier=n > 1,
-        interpret=interpret,
-    )(*args)
-    return outs[0]
+    return resilience.guarded_call(
+        "moe_reduce_rs_overlap",
+        functools.partial(_moe_rs_overlap_fused, cfg=cfg, interpret=interpret),
+        _moe_rs_overlap_xla,
+        h_sorted, w_down, scale, expert_ids, dst_ids, w_rows, axis=axis,
+        ragged=ragged, valid_rows=valid_rows, m_out=m_out,
+        out_dtype=out_dtype,
+    )
 
 
 def moe_reduce_rs_op(
@@ -784,14 +398,17 @@ def moe_reduce_rs_op(
 # block_m is pinned by the caller-provided alignment (128 = moe_align
 # default); the sweep covers the N/K tiling of the grouped GEMM. FIRST
 # entry = best-known default (applied sweep-free under cached_or_first).
-# Ragged twins (ISSUE 5) strictly after their padded originals (the
-# no-regression ordering invariant).
+# Ragged twins (ISSUE 5) strictly after their padded originals, w8 twins
+# (ISSUE 7) strictly after their bf16 twins (the no-regression ordering
+# invariant).
 MOE_RS_TUNE_SPACE = (
     GroupGemmConfig(128, 1024, 512),
     GroupGemmConfig(128, 2048, 512),
     GroupGemmConfig(128, 1024, 1024),
     GroupGemmConfig(128, 512, 512),
     GroupGemmConfig(128, 1024, 512, ragged=True),
+    GroupGemmConfig(128, 1024, 512, w8=True),
+    GroupGemmConfig(128, 1024, 512, ragged=True, w8=True),
 )
 
 moe_reduce_rs_op = contextual_autotune(MOE_RS_TUNE_SPACE, name="moe_reduce_rs")(

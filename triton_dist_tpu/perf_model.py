@@ -337,6 +337,61 @@ def prune_chunk_candidates(
     )
 
 
+def estimate_w8_overlap_time_ms(
+    shard_bytes: int,
+    n_pes: int,
+    weight_bytes: int = 0,
+    chunks_per_shard: int = 1,
+    w8: bool = False,
+    spec: ChipSpec | None = None,
+) -> float:
+    """Fused AG-GroupGEMM / MoE-Reduce-RS overlap time model with the
+    weight-traffic term (ISSUE 7): the chunked ring term
+    (:func:`estimate_ring_chunked_time_ms` — the activation slabs ride the
+    ICI) plus the weight-side HBM stream (``weight_bytes`` — the bf16 bank
+    bytes, read once per pipeline pass regardless of how few rows route:
+    the decode regime's bound resource). ``w8=True`` HALVES the weight
+    term (int8 weights; the f32 scale rows are ``1/K`` of the bank —
+    noise) and touches nothing else: weights are local, so w8 adds no
+    ring/chunk edges.
+
+    ``w8=False`` reduces EXACTLY to the existing chunked ring model plus
+    the full-rate weight term (and with ``weight_bytes=0`` to the ring
+    model alone) — the honesty contract the unit tests pin. A deliberate
+    sum (upper bound): on chip the weight stream partially hides under the
+    ring chunks; the model exists to rank chunk/w8 candidates, not to
+    predict absolutes."""
+    spec = spec or detect_chip()
+    t_ring = estimate_ring_chunked_time_ms(
+        shard_bytes, n_pes, chunks_per_shard, spec
+    )
+    wb = weight_bytes / 2.0 if w8 else float(weight_bytes)
+    return t_ring + wb / (spec.hbm_gbps * 1e9) * 1e3
+
+
+def suggest_w8_overlap(
+    t_rows: int,
+    n_experts: int,
+    spec: ChipSpec | None = None,
+    threshold: float = 1.0,
+) -> bool:
+    """Model-driven precondition for the w8 tune axis (ISSUE 7): True when
+    the grouped GEMM is WEIGHT-BOUND — the bf16 weight stream
+    (``E·K·N·2`` bytes, read whatever the routing) takes longer than the
+    MXU work (``2·t·K·N`` flops). The K·N factors cancel, so the predicate
+    is purely ``n_experts · (peak_flops / hbm_Bps) > threshold · t_rows``
+    — decode-shaped problems (few hundred rows) qualify, prefill/training
+    shapes (tens of thousands) never do: there the upcast VPU cost buys
+    nothing, and the pruning hook keeps the sweep-free walks off it. bf16
+    candidates are never subject to this hook — pruning can only remove
+    w8 candidates."""
+    spec = spec or detect_chip()
+    if t_rows <= 0:
+        return True
+    balance = spec.bf16_tflops * 1e12 / (spec.hbm_gbps * 1e9)
+    return n_experts * balance > threshold * t_rows
+
+
 def estimate_group_gemm_pad_tax(
     t_rows: int,
     n_experts: int,
