@@ -129,6 +129,33 @@ def emit_info(metric, value, unit):
     )
 
 
+def _append_health_json(path, name, snap):
+    """Merge one metric's end-of-run ``resilience.health.snapshot()``
+    (incl. the ISSUE 8 integrity / skip-step / poisoned counters) into the
+    ``--health-json`` artifact: a ``{metric_name: snapshot}`` JSON map the
+    driver leaves next to ``BENCH_*.json``. Tolerates a missing or
+    corrupt existing file (a dead artifact must never take a metric
+    down); written whole-file so a killed run leaves valid JSON."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            data = {}
+    except (FileNotFoundError, ValueError):
+        data = {}
+    data[name] = snap
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError as e:
+        import sys
+
+        print(f"bench: --health-json write failed: {e}", file=sys.stderr,
+              flush=True)
+
+
 def bench_gemm_rs(mesh, n):
     """Row-parallel down-proj shape: A [M, K_ffn/n], B [K_ffn/n, N=hidden]."""
     from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs_op
@@ -1042,7 +1069,10 @@ def _run_one(name: str) -> None:
             not snap["healthy"]
             or snap["short_circuited"]
             or snap["elastic"]["degraded"]
-            or any(k.endswith((":retry", ":recovery"))
+            or health.corrupt_families()
+            or any(k.endswith((":retry", ":recovery", ":integrity",
+                               ":integrity_retry", ":skip_step",
+                               ":poisoned"))
                    for k in snap["counters"])
         )
         if degraded:
@@ -1052,6 +1082,13 @@ def _run_one(name: str) -> None:
                 f"[bench {name}] resilience health: " + json.dumps(snap),
                 file=sys.stderr, flush=True,
             )
+        # --health-json (ISSUE 8 satellite): machine-readable end-of-run
+        # health artifact next to BENCH_*.json — one entry per metric
+        # (each metric runs in its own subprocess; sequential, so the
+        # read-merge-write below cannot race)
+        path = os.environ.get("TDT_BENCH_HEALTH_JSON")
+        if path:
+            _append_health_json(path, name, snap)
 
 
 def main() -> None:
@@ -1092,8 +1129,28 @@ def main() -> None:
             world = int(sys.argv[i + 1])
         elif arg.startswith("--world="):
             world = int(arg.split("=", 1)[1])
+        elif arg == "--health-json":
+            if i + 1 >= len(sys.argv):
+                raise SystemExit(
+                    "bench: --health-json needs a path (e.g. "
+                    "--health-json BENCH_health.json)"
+                )
+            os.environ["TDT_BENCH_HEALTH_JSON"] = os.path.abspath(
+                sys.argv[i + 1]
+            )
+        elif arg.startswith("--health-json="):
+            os.environ["TDT_BENCH_HEALTH_JSON"] = os.path.abspath(
+                arg.split("=", 1)[1]
+            )
     if world is not None:
         os.environ["TDT_BENCH_WORLD"] = str(world)
+    if os.environ.get("TDT_BENCH_HEALTH_JSON"):
+        # fresh artifact per driver run: each metric subprocess merges its
+        # own end-of-run snapshot in (metrics run sequentially)
+        try:
+            os.remove(os.environ["TDT_BENCH_HEALTH_JSON"])
+        except FileNotFoundError:
+            pass
 
     count = _wait_for_backend()
     if world is not None and (count is None or count < world):

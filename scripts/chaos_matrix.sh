@@ -27,24 +27,49 @@
 # on older jax lines they skip and the degradation + host-arc tiers
 # still run.
 #
+# Since ISSUE 8 the matrix also covers the DATA-INTEGRITY cells
+# (tests/test_integrity.py): payload-corruption kinds
+# (bitflip/torn_chunk/stale_read/nan_inject) × detection tier (per-chunk
+# canary, host output guards), the detect → retry → golden-fallback →
+# quarantine ladder with bit-exact fallback output, the train-step
+# skip-step containment, and the serving poison-quarantine cell (one
+# NaN-logit request typed-rejected, survivors byte-identical) plus the
+# stop(drain=True)-vs-persistent-straggler drain race. The host-tier
+# integrity cells run everywhere; live payload injection is
+# interpreter-gated like every other injection cell.
+#
 # Per-cell failures propagate into the exit code (CI gates on it), and a
 # pass/fail summary table is printed after the run.
 #
-# Usage: scripts/chaos_matrix.sh [extra pytest args]
+# Usage: scripts/chaos_matrix.sh [--quick] [extra pytest args]
+#
+# --quick: the bounded tier-1 subset — chaos cells not marked slow, over
+# the corruption + serving + elastic files only (the cells most likely to
+# regress silently; run_tier1.sh's chaos smoke covers the same marker
+# over all of tests/, this flag is the focused standalone form).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 log="$(mktemp /tmp/chaos_matrix.XXXXXX.log)"
 trap 'rm -f "$log"' EXIT
 
+files="tests/test_chaos.py tests/test_elastic.py \
+    tests/test_chunked.py tests/test_chunked_a2a.py tests/test_ragged.py \
+    tests/test_emitter.py tests/test_serving.py tests/test_integrity.py"
+marker="chaos"
+if [ "${1:-}" = "--quick" ]; then
+    shift
+    files="tests/test_integrity.py tests/test_serving.py tests/test_elastic.py"
+    marker="chaos and not slow"
+fi
+
 # -v so every cell prints its own PASSED/FAILED/SKIPPED line for the
 # summary; the pytest exit code is captured, not exec'd away, so the
 # table still prints when cells fail.
 set +e
-env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_elastic.py \
-    tests/test_chunked.py tests/test_chunked_a2a.py tests/test_ragged.py \
-    tests/test_emitter.py tests/test_serving.py \
-    -m chaos -v -rs -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
+# shellcheck disable=SC2086 — $files is a deliberate word-split list
+env JAX_PLATFORMS=cpu python -m pytest $files \
+    -m "$marker" -v -rs -p no:cacheprovider -p no:xdist -p no:randomly "$@" \
     2>&1 | tee "$log"
 rc=${PIPESTATUS[0]}
 set -e

@@ -21,7 +21,12 @@
 # without the Mosaic interpreter — see CHANGES.md baselines), the
 # acceptance bar is "no worse than seed": set TDT_TIER1_MIN_PASS=<N> /
 # TDT_TIER1_MAX_FAIL=<M> to gate on counts instead of the raw exit code
-# (the chaos smoke must always exit 0 either way).
+# (the chaos smoke must always exit 0 either way). Independent of the
+# count floors, the failure SET must be a subset of the committed
+# tests/known_failures.txt manifest (scripts/diff_failures.py): counts
+# can mask a one-fixed-one-broken swap, the subset check cannot. Skip it
+# (e.g. when running a filtered subset via extra pytest args) with
+# TDT_SKIP_FAILURE_DIFF=1.
 #
 # Usage: scripts/run_tier1.sh [extra pytest args for the tier-1 phase]
 set -uo pipefail
@@ -38,6 +43,16 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@" 2>&1 | tee /tmp/_t1.log
 t1_rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+
+# failure-set strict-subset gate (ISSUE 8 satellite): any NEW tier-1
+# failure fails the gate even when the count floors still pass
+diff_rc=0
+if [ "${TDT_SKIP_FAILURE_DIFF:-0}" != "1" ] && [ "$#" -eq 0 ]; then
+    echo
+    echo "== failure-set diff (tests/known_failures.txt) =="
+    python scripts/diff_failures.py /tmp/_t1.log
+    diff_rc=$?
+fi
 
 echo
 echo "== chaos smoke (resilience + elastic) =="
@@ -82,7 +97,8 @@ if [ "$t1_rc" -ne 0 ]; then
         fi
     fi
 fi
-if [ "$t1_ok" -ne 0 ] || [ "$chaos_rc" -ne 0 ] || [ "$perf_rc" -ne 0 ]; then
+if [ "$t1_ok" -ne 0 ] || [ "$chaos_rc" -ne 0 ] || [ "$perf_rc" -ne 0 ] \
+    || [ "$diff_rc" -ne 0 ]; then
     echo "tier-1 gate: FAIL"
     exit 1
 fi

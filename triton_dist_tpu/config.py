@@ -105,6 +105,17 @@ class Config:
     suspect_threshold: int = 2
     # Clean world-barrier probes required to re-admit a quarantined PE.
     probation_probes: int = 1
+    # --- data-integrity layer (ISSUE 8, docs/resilience.md) ------------
+    # Armed resilience.IntegrityConfig: host-tier output guards (finite
+    # check + optional magnitude envelope) at every guarded op entry, the
+    # serving engine's per-request NaN-logit quarantine, and — with
+    # canary=True on top of an armed watchdog — per-chunk payload
+    # checksums riding the chunked puts' existing signal slots. Detection
+    # is observation-only on the happy path (clean runs stay bit-exact);
+    # a tripped check raises resilience.IntegrityError and runs the
+    # recovery ladder (retry → golden fallback → PE strikes). None
+    # (default) = no checks, zero added work anywhere.
+    integrity: object = None
 
 
 _config = Config()
@@ -130,6 +141,15 @@ def update(**kwargs: Any) -> None:
                 v.validate()
             # a (re)armed plan starts with a full trigger budget
             _faults.reset_triggers()
+        if k == "integrity" and v is not None:
+            from triton_dist_tpu.resilience.integrity import IntegrityConfig
+
+            if not isinstance(v, IntegrityConfig):
+                raise ValueError(
+                    f"integrity must be a resilience.IntegrityConfig (or "
+                    f"None), got {type(v).__name__}"
+                )
+            v.validate()
         if k == "retry_policy" and v is not None:
             from triton_dist_tpu.resilience.retry import RetryPolicy
 

@@ -862,6 +862,10 @@ class ContinuousBatcher:
         self.slot_out: list[list] = [[] for _ in range(b)]
         self.queue: list[Request] = []
         self.finished: list[tuple[Any, list]] = []
+        # poisoned requests (ISSUE 8): slots whose logit row went
+        # non-finite under an armed config.integrity — evicted, never
+        # finished; drained by the serving engine for typed rejection
+        self.poisoned: list[tuple[Any, list, str]] = []
 
     def validate_request(self, req: Request) -> None:
         """Admissibility checks (shared with the serving engine, which
@@ -949,7 +953,14 @@ class ContinuousBatcher:
             jnp.asarray(np.arange(self.cfg.batch) == i),
             jnp.asarray(pick),
         )
-        t0 = req.sample(np.asarray(last[i], np.float32), self.slot_rng[i])
+        from triton_dist_tpu.resilience import integrity as _integrity
+
+        last_i = np.asarray(last[i], np.float32)
+        if _integrity.output_checks_enabled() and not np.isfinite(last_i).all():
+            # poisoned at admission: quarantine before a token exists
+            self._poison_slot(i, "non-finite prefill logits")
+            return
+        t0 = req.sample(last_i, self.slot_rng[i])
         self.slot_fed[i] = L
         self.slot_out[i] = [t0]
         self.tok[i] = t0
@@ -1020,6 +1031,30 @@ class ContinuousBatcher:
         out, self.finished = self.finished, []
         return out
 
+    def drain_poisoned(self) -> list[tuple[Any, list, str]]:
+        """Hand over (and clear) every poisoned ``(uid, tokens_before,
+        reason)`` (ISSUE 8 per-request quarantine): requests whose logit
+        row went non-finite under an armed ``config.integrity``. They were
+        EVICTED, not finished — the serving engine typed-rejects them; a
+        direct batcher user collects them here."""
+        out, self.poisoned = self.poisoned, []
+        return out
+
+    def _poison_slot(self, i: int, reason: str) -> None:
+        """Evict slot ``i``'s request as poisoned. Containment argument:
+        decode rows never mix across the batch dim (attention is
+        per-sequence, MLPs row-wise, collectives reduce feature/shard
+        dims), so a NaN row is that request's alone; its garbage cache
+        rows are masked by per-sequence ``kv_lens`` on eviction and fully
+        overwritten on the slot's next admission — the documented
+        eviction semantics, nothing new to clean."""
+        from triton_dist_tpu.resilience import health
+
+        req = self.slot_req[i]
+        self.poisoned.append((req.uid, list(self.slot_out[i]), reason))
+        self.slot_req[i] = None
+        health.record_poisoned_request("continuous_batcher", req.uid, reason)
+
     def export_in_flight(self) -> tuple[list[tuple[Request, list, Any]],
                                         list[Request]]:
         """Non-destructive snapshot for prefix replay (serving-engine
@@ -1044,6 +1079,15 @@ class ContinuousBatcher:
             self.params, self.cache,
             jnp.asarray(self.tok), jnp.asarray(self.pos),
         )
+        # per-request poison detection (ISSUE 8): one [b]-bool transfer
+        # when config.integrity arms the output checks — a non-finite
+        # logit row quarantines exactly that slot's request below
+        from triton_dist_tpu.resilience import integrity as _integrity
+
+        row_ok = (
+            np.asarray(jnp.all(jnp.isfinite(logits), axis=-1))
+            if _integrity.output_checks_enabled() else None
+        )
         # greedy slots need only the [b]-int argmax; the full [b, vocab]
         # row transfer (~vocab x 4 bytes/slot over a possibly-remote link)
         # is paid only when some active request actually samples
@@ -1060,6 +1104,12 @@ class ContinuousBatcher:
         for i, req in enumerate(self.slot_req):
             if req is None:
                 continue  # idle slot decoded a dummy token; ignore
+            if row_ok is not None and not row_ok[i]:
+                # poison quarantine: THIS request is evicted and typed-
+                # rejected; its neighbors' rows are untouched (see
+                # _poison_slot) and keep streaming byte-identically
+                self._poison_slot(i, "non-finite logits")
+                continue
             if self.slot_fed[i] < len(req.prompt):
                 # still feeding the prompt: the model's prediction is
                 # ignored, the next input is the given token

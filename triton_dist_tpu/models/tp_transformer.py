@@ -353,18 +353,32 @@ class TPMoETransformer(TPTransformer):
         logits = h.astype(jnp.float32) @ p["router"].astype(jnp.float32)
         tw, ids = select_experts(logits, c.topk)
         w_up, w_down = p["w_up"], p["w_down"]
+        w_up_scale = w_down_scale = None
         if "w_up_scale" in p:
-            # serving-quantized experts on the prefill/full-forward path:
-            # explicit dequant — this path is MXU-compute-bound over the
-            # whole sequence, so the bf16 materialization amortizes (the
-            # decode einsums keep the int8 stream; models/decode.py)
-            w_up = (w_up.astype(jnp.float32) * p["w_up_scale"]).astype(x.dtype)
-            w_down = (
-                w_down.astype(jnp.float32) * p["w_down_scale"]
-            ).astype(x.dtype)
+            if getattr(c.gg_config, "w8", False):
+                # w8 single-pass serving (ISSUE 8 satellite — the PR 7
+                # noted follow-up): feed the pre-quantized int8 pools +
+                # scales straight through the fused pipeline's scale=
+                # operands, skipping BOTH the bf16 materialization below
+                # AND resolve_w8's per-call quantize bank read+write
+                w_up_scale = p["w_up_scale"]
+                w_down_scale = p["w_down_scale"]
+            else:
+                # serving-quantized experts on the prefill/full-forward
+                # path without w8 kernels: explicit dequant — this path is
+                # MXU-compute-bound over the whole sequence, so the bf16
+                # materialization amortizes (the decode einsums keep the
+                # int8 stream; models/decode.py)
+                w_up = (
+                    w_up.astype(jnp.float32) * p["w_up_scale"]
+                ).astype(x.dtype)
+                w_down = (
+                    w_down.astype(jnp.float32) * p["w_down_scale"]
+                ).astype(x.dtype)
         return tp_moe_mlp_grad(
             h, w_up, w_down, ids, tw.astype(jnp.float32),
-            c.axis, jax.nn.gelu, c.gg_config, c.interpret,
+            c.axis, jax.nn.gelu, c.gg_config, c.interpret, True,
+            w_up_scale, w_down_scale,
         ).astype(x.dtype)
 
 
@@ -520,6 +534,7 @@ def opt_state_specs(opt, params, specs):
 def train_step(
     model: TPTransformer, params, tokens_loc, targets, lr=1e-2,
     dp_axis: str | None = "dp", opt=None, opt_state=None,
+    skip_nonfinite: bool = False,
 ):
     """One optimizer step (call inside shard_map over a ``(dp, tp)`` mesh).
     Default is SGD at `lr`; pass ``opt=`` (any optax transform) and
@@ -527,6 +542,18 @@ def train_step(
     transform carries its own schedule) and the return becomes
     ``(params, opt_state, loss)``. Pass
     ``dp_axis=None`` on a pure-TP mesh, or the data axis's actual name).
+
+    ``skip_nonfinite=True`` (ISSUE 8 containment): gate the update on a
+    GLOBAL gradient finiteness check (``ops.grads.grads_all_finite`` over
+    the tp and dp axes) — a poisoned step (NaN-storm activations, a
+    corrupt collective that slipped past the kernel tiers, a
+    NaN-poisoned timed-out op under ``raise_on_timeout=False``) is
+    DROPPED whole: params come back bit-identical, optimizer state
+    untouched, and one extra traced ``skipped`` int32 flag (1 = dropped)
+    is appended to the return for the host loop to count
+    (``resilience.integrity.record_skip_step``). A clean step under the
+    flag applies exactly the same update as without it — ``jnp.where``
+    on an all-true predicate is the identity, bit for bit.
 
     Gradient accounting (verified against the unsharded reference in
     tests/test_models.py): the per-PE loss is tp-replicated, so
@@ -576,13 +603,45 @@ def train_step(
         return g / tp
 
     grads = jax.tree.map(fix, grads, specs)
+    ok = None
+    if skip_nonfinite:
+        from triton_dist_tpu.ops.grads import grads_all_finite
+
+        # the loss rides the check too: a NaN loss with (somehow) finite
+        # grads is still not a step anyone wants applied
+        ok = grads_all_finite((grads, loss), c.axis, dp_axis)
+
+    def gate(new, old):
+        # ok=True is the bitwise identity on `new`; ok=False keeps `old`
+        # (params AND optimizer state — a dropped step must be invisible)
+        if ok is None:
+            return new
+        return jax.tree.map(
+            lambda a, b: a if getattr(a, "dtype", None) is None
+            else jnp.where(ok, a, b),
+            new, old,
+        )
+
+    skipped = (
+        None if ok is None
+        else jnp.logical_not(ok).astype(jnp.int32)
+    )
     if opt is not None:
         # any optax transform; state sharding via opt_state_specs. Returns
-        # (params, opt_state, loss) in this mode.
+        # (params, opt_state, loss) in this mode (+ skipped when gated).
         import optax
 
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-    params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
-    return params, loss
+        updates, new_opt_state = opt.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        params = gate(new_params, params)
+        opt_state = gate(new_opt_state, opt_state)
+        if skipped is None:
+            return params, opt_state, loss
+        return params, opt_state, loss, skipped
+    new_params = jax.tree.map(
+        lambda p, g: p - lr * g.astype(p.dtype), params, grads
+    )
+    params = gate(new_params, params)
+    if skipped is None:
+        return params, loss
+    return params, loss, skipped

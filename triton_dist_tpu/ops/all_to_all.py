@@ -172,6 +172,12 @@ def _a2a_chunked_kernel(
         lambda i, j: data_recv.at[i, j],
         lambda i, j: data_sig.at[i, j],
         spans,
+        # handle i's incoming chunks are peer (me-1-i)'s payload, landing
+        # in its slab of OUR recv buffer — the payload-integrity landing
+        # view (canary + fault injection, ISSUE 8)
+        recv_view=lambda i, off, rows, me=me: recv_ref.at[
+            jax.lax.rem(me - 1 - i + 2 * n, n), pl.ds(off, rows)
+        ],
     )
     c1.wait()
     c2.wait()

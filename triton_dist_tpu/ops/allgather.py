@@ -162,6 +162,10 @@ def _ring_1d_chunked_kernel(
     for s in range(n - 1):
         c = jax.lax.rem(me - s + n, n)
         base = c * m
+        # step s's INCOMING chunk is the left neighbor's send: shard
+        # (me-1-s) mod n — the landing view for payload integrity
+        # (canary checksums + payload-fault injection, ISSUE 8)
+        base_in = jax.lax.rem(me - 1 - s + 2 * n, n) * m
         ready = None
         if s > 0:
             prev = descs[s - 1]
@@ -175,6 +179,9 @@ def _ring_1d_chunked_kernel(
                 lambda j, s=s: recv_sems.at[s, j],
                 lambda j, s=s: sig_sems.at[s, j],
                 spans, ready=ready,
+                recv_view=lambda off, rows, b=base_in: out_ref.at[
+                    pl.ds(b + off, rows)
+                ],
             )
         )
     descs[-1].wait_recv()
@@ -203,6 +210,9 @@ def _ring_bidir_chunked_kernel(
         if s < steps_r:
             c = jax.lax.rem(me - s + n, n)
             base = c * m
+            # incoming right-moving chunk: the left neighbor's step-s
+            # send, shard (me-1-s) mod n (landing view, ISSUE 8)
+            base_in = jax.lax.rem(me - 1 - s + 2 * n, n) * m
             ready = descs_r[s - 1].wait_recv_chunk if s > 0 else None
             descs_r.append(
                 shmem.putmem_signal_chunked_nbi_block(
@@ -213,11 +223,17 @@ def _ring_bidir_chunked_kernel(
                     lambda j, s=s: recv_r.at[s, j],
                     lambda j, s=s: sig_r.at[s, j],
                     spans, ready=ready,
+                    recv_view=lambda off, rows, b=base_in: out_ref.at[
+                        pl.ds(b + off, rows)
+                    ],
                 )
             )
         if s < steps_l:
             c = jax.lax.rem(me + s, n)
             base = c * m
+            # incoming left-moving chunk: the right neighbor's step-s
+            # send, shard (me+1+s) mod n (landing view, ISSUE 8)
+            base_in = jax.lax.rem(me + 1 + s, n) * m
             ready = descs_l[s - 1].wait_recv_chunk if s > 0 else None
             descs_l.append(
                 shmem.putmem_signal_chunked_nbi_block(
@@ -228,6 +244,9 @@ def _ring_bidir_chunked_kernel(
                     lambda j, s=s: recv_l.at[s, j],
                     lambda j, s=s: sig_l.at[s, j],
                     spans, ready=ready,
+                    recv_view=lambda off, rows, b=base_in: out_ref.at[
+                        pl.ds(b + off, rows)
+                    ],
                 )
             )
     descs_r[-1].wait_recv()

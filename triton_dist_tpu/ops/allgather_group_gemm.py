@@ -75,6 +75,7 @@ def ag_group_gemm(
     config: GroupGemmConfig | None = None,
     ag_method: str = "auto",
     gather_output: bool = False,
+    scale: jax.Array | None = None,
     interpret: Any = None,
 ):
     """Sequential MoE up-projection (call inside ``jax.shard_map``;
@@ -99,9 +100,11 @@ def ag_group_gemm(
         ids_full.reshape(-1), n_exp, cfg.block_m, ragged=cfg.ragged
     )
     a_sorted = gather_sorted_rows(a_full, alignment, topk)
+    # pre-quantized w8 path (ISSUE 8 satellite): an explicit scale marks
+    # `b` as an int8 pool, exactly as in group_gemm / the overlap entry
     h_sorted = group_gemm(
         a_sorted, b, alignment.expert_ids, valid_rows=alignment.valid_rows,
-        config=cfg, interpret=interpret,
+        scale=scale, config=cfg, interpret=interpret,
     )
     if gather_output:
         return h_sorted, alignment, a_sorted

@@ -454,6 +454,30 @@ def jit_shard_map(
             f"docs/resilience.md."
         )
 
+    def _raise_integrity(recs, noted=False):
+        # per-chunk canary mismatches (ISSUE 8): corrupt data was
+        # DETECTED — outputs arrive NaN-poisoned (the diag status gates
+        # the same in-program poison as timeouts), the named PEs are
+        # struck directly (victim == culprit under the landing-site
+        # model), and the op raises IntegrityError REGARDLESS of
+        # raise_on_timeout: poison-and-continue is a timeout posture;
+        # silently continuing past known-corrupt data is what this layer
+        # exists to prevent. No family pin either — the canary drains its
+        # own credits, so there is no semaphore residue to protect.
+        from triton_dist_tpu.resilience import elastic as _elastic
+        from triton_dist_tpu.resilience import health
+        from triton_dist_tpu.resilience import integrity as _integrity
+
+        if not noted:  # mixed-launch callers recorded/struck these already
+            health.record_integrity(family, records=recs)
+            if _tdt_config.get_config().elastic and single_axis:
+                _elastic.note_integrity_records(recs, n_world, family=family)
+        err = _integrity.IntegrityError(
+            family, _integrity.DET_CANARY, records=recs, world_size=n_world
+        )
+        err._tdt_recorded = True
+        raise err
+
     def call(*args):
         from triton_dist_tpu.resilience import health
 
@@ -472,11 +496,27 @@ def jit_shard_map(
                 _faults.note_launch()
             recs = _records.decode_diag(diag)  # forces the device sync
             if recs:
-                health.record_timeout(family, recs)
+                to_recs = [r for r in recs if r["status"] != "integrity"]
+                if not to_recs:
+                    _raise_integrity(recs)
+                int_recs = [r for r in recs if r["status"] == "integrity"]
+                if int_recs:
+                    # mixed launch: the timeout arc below is the louder
+                    # event, but the corruption detections must still land
+                    # in the registry (attribution strikes need the
+                    # elastic path — not this branch, which runs with
+                    # elastic disabled)
+                    health.record_integrity(family, records=int_recs)
+                health.record_timeout(family, to_recs)
                 if _tdt_config.get_config().raise_on_timeout:
                     raise _records.DistTimeoutError(
-                        family, recs, world_size=n_world
+                        family, to_recs, world_size=n_world
                     )
+                if int_recs:
+                    # poison-and-continue is a TIMEOUT posture only:
+                    # detected corruption raises regardless, even when it
+                    # co-occurred with a silent timeout
+                    _raise_integrity(int_recs, noted=True)
             return out
 
         # elastic degraded-mode path: transient timeouts are retried with
@@ -501,6 +541,47 @@ def jit_shard_map(
                 if cfg.elastic:
                     _elastic.note_clean_step(n_world)
                 return out
+            int_recs = [r for r in recs if r["status"] == "integrity"]
+            if int_recs and len(int_recs) == len(recs):
+                # pure canary corruption (no timeouts): retried in place
+                # under the policy — sound even on compiled TPU, a canary
+                # drains its own credits so no semaphore residue exists —
+                # counted as integrity_retry (separate from the timeout
+                # counters) with the named PEs struck per failed attempt;
+                # exhaustion (or a donating entry, whose buffers died with
+                # the first attempt) raises IntegrityError
+                delay = (
+                    delays[attempt] if attempt < len(delays) else 0.0
+                )
+                over_budget = (
+                    policy is not None
+                    and policy.total_delay_budget_s is not None
+                    and slept + delay > policy.total_delay_budget_s
+                )
+                if (
+                    attempt == attempts - 1 or donate_argnums or over_budget
+                ):
+                    _raise_integrity(int_recs)  # strikes the named PEs
+                if cfg.elastic and single_axis:
+                    _elastic.note_integrity_records(
+                        int_recs, n_world, family=family
+                    )
+                health.record_integrity_retry(family, attempt + 1, delay)
+                _retry.get_clock().sleep(delay)
+                slept += delay
+                continue
+            # mixed records: the timeout arc below handles the louder
+            # event over the timeout records only — but the corruption
+            # detections still land in the registry and still strike
+            # their named PEs (a persistently corrupt PE that co-occurs
+            # with timeouts must not escape attribution)
+            if int_recs:
+                health.record_integrity(family, records=int_recs)
+                if cfg.elastic and single_axis:
+                    _elastic.note_integrity_records(
+                        int_recs, n_world, family=family
+                    )
+            recs = [r for r in recs if r["status"] != "integrity"]
             if cfg.elastic and single_axis:
                 _elastic.note_timeout_records(recs, n_world, family=family)
             last = attempt == attempts - 1
@@ -540,6 +621,10 @@ def jit_shard_map(
             _elastic.maybe_release_family_pins()
             if cfg.raise_on_timeout:
                 raise _records.DistTimeoutError(family, recs, world_size=n_world)
+            if int_recs:
+                # corruption raises regardless of the timeout posture —
+                # these records were recorded/struck in the mixed handling
+                _raise_integrity(int_recs, noted=True)
             return out
 
     return call

@@ -236,13 +236,33 @@ def _block_outer_accumulate(
 
 
 def _tp_moe_forward_impl(x, w_up, w_down, topk_ids, topk_weights, axis,
-                         activation, gg_config, interpret, overlap):
+                         activation, gg_config, interpret, overlap,
+                         w_up_scale=None, w_down_scale=None):
     """Shared forward of the MoE TP MLP. ``overlap=True`` runs the two
     single-kernel overlapped ops over the rank-major alignment (comm rides
     under the grouped GEMMs); ``overlap=False`` is the sequential
     composition (the A/B baseline and the fallback). Both return
     ``(out, res)`` with the SAME residual structure — the backward is
-    layout-agnostic through the global-view alignment."""
+    layout-agnostic through the global-view alignment.
+
+    ``w_up_scale`` / ``w_down_scale`` (ISSUE 8 satellite — the PR 7 noted
+    follow-up) mark the banks as PRE-QUANTIZED int8 pools with explicit
+    per-(expert, out-column) scales: every grouped GEMM receives the
+    ``scale=`` operand directly, so single-pass serving callers stop
+    paying ``resolve_w8``'s on-the-fly quantize bank read+write."""
+    if (w_up_scale is None) != (w_down_scale is None):
+        raise ValueError(
+            "pass both w_up_scale and w_down_scale (pre-quantized serving "
+            "banks), or neither"
+        )
+    if w_up_scale is not None and (
+        w_up.dtype != jnp.int8 or w_down.dtype != jnp.int8
+    ):
+        raise ValueError(
+            f"explicit scales mark the banks as int8 pools; got "
+            f"w_up {w_up.dtype}, w_down {w_down.dtype} — quantize with "
+            f"ops.quantize_expert_weights first"
+        )
     from triton_dist_tpu.ops.allgather_group_gemm import (
         ag_group_gemm,
         ag_group_gemm_overlap,
@@ -287,7 +307,7 @@ def _tp_moe_forward_impl(x, w_up, w_down, topk_ids, topk_weights, axis,
         )
         h_sorted, a_sorted = ag_group_gemm_overlap(
             x, w_up, ral, axis=axis, config=cfg, gather_output=True,
-            interpret=interpret,
+            scale=w_up_scale, interpret=interpret,
         )
         act = activation(h_sorted.astype(jnp.float32)).astype(x.dtype)
         alignment = ranked_global_view(ral, m_loc, topk)
@@ -296,12 +316,12 @@ def _tp_moe_forward_impl(x, w_up, w_down, topk_ids, topk_weights, axis,
         out = moe_reduce_rs_overlap(
             act, w_down, ral.expert_ids, dst_ids, w_rows, axis=axis,
             m_out=m_loc, valid_rows=ral.valid_rows, config=cfg,
-            out_dtype=x.dtype, interpret=interpret,
+            scale=w_down_scale, out_dtype=x.dtype, interpret=interpret,
         ).astype(x.dtype)
     else:
         h_sorted, alignment, a_sorted = ag_group_gemm(
             x, w_up, topk_ids, axis=axis, config=gg_config,
-            gather_output=True, interpret=interpret,
+            gather_output=True, scale=w_up_scale, interpret=interpret,
         )
         # no standalone activation pass: it rides the down-GEMM's A-tile
         # load (group_gemm act_fn) — h_sorted stays pre-activation, which
@@ -309,12 +329,14 @@ def _tp_moe_forward_impl(x, w_up, w_down, topk_ids, topk_weights, axis,
         out = moe_reduce_rs(
             h_sorted, w_down, alignment, tw_full, axis=axis,
             n_tokens=n * m_loc, config=gg_config, out_dtype=x.dtype,
-            act_fn=activation, interpret=interpret,
+            act_fn=activation, scale=w_down_scale, interpret=interpret,
         ).astype(x.dtype)
     # a_sorted: block-aligned gathered rows [t_pad, H] — BOTH paths return
     # the sorted slab (the backward's direct input; raw gathered tokens are
-    # never needed again)
-    res = (a_sorted, h_sorted, tw_full, alignment, w_up, w_down, m_loc)
+    # never needed again). Scales ride the residual so the backward can
+    # dequantize int8 banks for its straight-through grouped GEMMs.
+    res = (a_sorted, h_sorted, tw_full, alignment, w_up, w_down, m_loc,
+           w_up_scale, w_down_scale)
     return out, res
 
 
@@ -330,6 +352,8 @@ def tp_moe_mlp_grad(
     gg_config: Any = None,
     interpret: Any = None,
     overlap: bool = True,
+    w_up_scale: jax.Array | None = None,
+    w_down_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Differentiable fused MoE TP MLP (call inside shard_map) — the
     training path the reference lacks for its MoE ops.
@@ -355,27 +379,45 @@ def tp_moe_mlp_grad(
     ``gg_config.w8`` (ISSUE 7) streams int8 weight slabs through every
     grouped GEMM of the forward — including both fused overlap kernels;
     the backward strips the axis (straight-through, full-precision banks).
+
+    ``w_up_scale`` / ``w_down_scale`` (ISSUE 8 satellite): explicit
+    per-(expert, out-column) f32 scales marking the banks as
+    PRE-QUANTIZED int8 pools — the single-pass serving path that skips
+    ``resolve_w8``'s on-the-fly quantize (one bank read+write per call).
+    The backward stays straight-through: it dequantizes the residual int8
+    banks once and differentiates against them; the scales themselves get
+    zero cotangents (they are serving constants, not parameters).
     """
     out, _ = _tp_moe_forward_impl(
         x, w_up, w_down, topk_ids, topk_weights, axis, activation,
-        gg_config, interpret, overlap,
+        gg_config, interpret, overlap, w_up_scale, w_down_scale,
     )
     return out
 
 
 def _tp_moe_fwd(x, w_up, w_down, topk_ids, topk_weights, axis, activation,
-                gg_config, interpret, overlap):
+                gg_config, interpret, overlap,
+                w_up_scale=None, w_down_scale=None):
     return _tp_moe_forward_impl(
         x, w_up, w_down, topk_ids, topk_weights, axis, activation,
-        gg_config, interpret, overlap,
+        gg_config, interpret, overlap, w_up_scale, w_down_scale,
     )
+
+
+def _zero_cotangent(arr):
+    """A type-correct zero cotangent: float0 for integer primals (jax's
+    convention, as for topk_ids), zeros for float ones."""
+    if jnp.issubdtype(jnp.asarray(arr).dtype, jnp.inexact):
+        return jnp.zeros_like(arr)
+    return np.zeros(jnp.asarray(arr).shape, jax.dtypes.float0)
 
 
 def _tp_moe_bwd(axis, activation, gg_config, interpret, overlap, res, dout):
     from triton_dist_tpu.ops.group_gemm import GroupGemmConfig, group_gemm
     from triton_dist_tpu.ops.reduce_scatter import reduce_scatter
 
-    a_sorted, h_sorted, tw_full, al, w_up, w_down, m_loc = res
+    (a_sorted, h_sorted, tw_full, al, w_up, w_down, m_loc,
+     w_up_scale, w_down_scale) = res
     cfg = gg_config or GroupGemmConfig()
     # w8 (ISSUE 7) is a forward/serving format: every backward grouped
     # GEMM, the dw accumulation AND the y_sorted remat run with the axis
@@ -383,6 +425,16 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, overlap, res, dout):
     # (straight-through — quantization's own derivative is zero a.e.).
     if getattr(cfg, "w8", False):
         cfg = dataclasses.replace(cfg, w8=False)
+    # pre-quantized serving banks (ISSUE 8 satellite): dequantize ONCE for
+    # the straight-through backward — the int8 pools are the only residual
+    # there is, and the scales are constants (zero cotangents below)
+    quantized = w_up_scale is not None
+    w_up_q, w_down_q = w_up, w_down
+    if quantized:
+        w_up = (w_up.astype(jnp.float32) * w_up_scale).astype(a_sorted.dtype)
+        w_down = (
+            w_down.astype(jnp.float32) * w_down_scale
+        ).astype(a_sorted.dtype)
     n_exp = w_up.shape[0]
     f32 = jnp.float32
     m_tot, h_dim = tw_full.shape[0], a_sorted.shape[1]
@@ -433,10 +485,15 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, overlap, res, dout):
     )
     # global alignment is expert-sorted by construction; the rank-major
     # (overlap) layout sorts only within each rank segment
-    dw_down = _block_outer_accumulate(
-        act, dy_sorted, al.expert_ids, n_exp, cfg, interpret,
-        assume_sorted=not overlap, valid_rows=al.valid_rows,
-    ).astype(w_down.dtype)
+    # pre-quantized int8 banks get zero cotangents (no master copy in
+    # this graph) — skip the expensive block-outer accumulations outright
+    # instead of computing and discarding them
+    dw_down = None
+    if not quantized:
+        dw_down = _block_outer_accumulate(
+            act, dy_sorted, al.expert_ids, n_exp, cfg, interpret,
+            assume_sorted=not overlap, valid_rows=al.valid_rows,
+        ).astype(w_down.dtype)
     # through the activation
     (dh_sorted,) = act_vjp(dact)
     dh_sorted = dh_sorted.astype(a_sorted.dtype)
@@ -448,10 +505,12 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, overlap, res, dout):
         valid_rows=al.valid_rows, config=cfg,
         out_dtype=f32, interpret=interpret,
     )
-    dw_up = _block_outer_accumulate(
-        a_sorted, dh_sorted, al.expert_ids, n_exp, cfg, interpret,
-        assume_sorted=not overlap, valid_rows=al.valid_rows,
-    ).astype(w_up.dtype)
+    dw_up = None
+    if not quantized:
+        dw_up = _block_outer_accumulate(
+            a_sorted, dh_sorted, al.expert_ids, n_exp, cfg, interpret,
+            assume_sorted=not overlap, valid_rows=al.valid_rows,
+        ).astype(w_up.dtype)
     # unsorted scatter-add back to tokens, then the all-gather's transpose
     da_full = (
         jnp.zeros((m_tot, h_dim), f32)
@@ -463,7 +522,14 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, overlap, res, dout):
     ).astype(a_sorted.dtype)                        # [m_loc, H]
 
     dids = np.zeros((m_loc, topk), jax.dtypes.float0)
-    return dx, dw_up, dw_down, dids, dtw
+    if quantized:
+        # int8 primal banks cannot receive the float grads (there is no
+        # master copy in this graph) — type-correct zeros, and zeros for
+        # the constant scales
+        return (dx, _zero_cotangent(w_up_q), _zero_cotangent(w_down_q),
+                dids, dtw, jnp.zeros_like(w_up_scale),
+                jnp.zeros_like(w_down_scale))
+    return dx, dw_up, dw_down, dids, dtw, None, None
 
 
 tp_moe_mlp_grad.defvjp(_tp_moe_fwd, _tp_moe_bwd)
@@ -598,6 +664,8 @@ def tp_moe_mlp_op(
     config: Any = None,
     overlap: bool = True,
     activation=jax.nn.gelu,
+    w_up_scale: jax.Array | None = None,
+    w_down_scale: jax.Array | None = None,
     interpret: Any = None,
 ) -> jax.Array:
     """Host-level entry for the full MoE TP MLP (≙ the reference's
@@ -607,24 +675,64 @@ def tp_moe_mlp_op(
     ``[m_tot, H]`` token-sharded. Autotuned over the grouped-GEMM tiling
     (block_m is also the alignment block, so the sweep trades padding
     against tile shape — the whole two-kernel pipeline is timed per
-    config, the reference's contextual-autotune discipline)."""
+    config, the reference's contextual-autotune discipline).
+
+    ``w_up_scale`` / ``w_down_scale`` (ISSUE 8 satellite): pre-quantized
+    int8 banks with explicit per-(expert, out-column) scales — the
+    single-pass serving path that skips the on-the-fly quantize. Scales
+    shard with their bank's OUT dimension (w_up's F over the axis,
+    w_down's H replicated — the ``moe_quantized_param_specs`` layout)."""
     from jax.sharding import PartitionSpec as P
 
     from triton_dist_tpu.ops.common import jit_shard_map
 
-    def fn(x, wu, wd, ids, tw):
+    if (w_up_scale is None) != (w_down_scale is None):
+        raise ValueError(
+            "pass both w_up_scale and w_down_scale (pre-quantized serving "
+            "banks), or neither"
+        )
+    has_scales = w_up_scale is not None
+
+    def fn(x, wu, wd, ids, tw, *scales):
+        us, ds_ = scales if scales else (None, None)
         return tp_moe_mlp_grad(
             x, wu, wd, ids, tw.astype(jnp.float32), axis, activation,
-            config, interpret, overlap,
+            config, interpret, overlap, us, ds_,
         )
 
+    in_specs = [P(axis, None), P(None, None, axis), P(None, axis, None),
+                P(axis, None), P(axis, None)]
+    args = [x, w_up, w_down, topk_ids.astype(jnp.int32), topk_weights]
+    if has_scales:
+        in_specs += [P(None, None, axis), P(None, None, None)]
+        args += [w_up_scale, w_down_scale]
     return jit_shard_map(
-        fn, mesh,
-        (P(axis, None), P(None, None, axis), P(None, axis, None),
-         P(axis, None), P(axis, None)),
-        P(axis, None),
-        key=("tp_moe_mlp", axis, config, overlap, activation, str(interpret)),
-    )(x, w_up, w_down, topk_ids.astype(jnp.int32), topk_weights)
+        fn, mesh, tuple(in_specs), P(axis, None),
+        key=("tp_moe_mlp", axis, config, overlap, activation, has_scales,
+             str(interpret)),
+    )(*args)
+
+
+def grads_all_finite(grads, *axes):
+    """Traced GLOBAL finiteness predicate over a gradient pytree (call
+    inside ``shard_map``) — the skip-step gate of
+    ``models.tp_transformer.train_step`` (ISSUE 8 containment): a single
+    non-finite element in any inexact leaf on ANY PE of the given mesh
+    axes makes the whole step bad, because the collective-coupled update
+    would smear the poison across every shard. Returns a traced scalar
+    bool (True = safe to apply)."""
+    bad = jnp.int32(0)
+    for g in jax.tree_util.tree_leaves(grads):
+        dt = getattr(g, "dtype", None)
+        if dt is None or not jnp.issubdtype(dt, jnp.inexact):
+            continue  # int bookkeeping / float0 zeros cannot be poisoned
+        bad = bad + jnp.logical_not(jnp.all(jnp.isfinite(g))).astype(
+            jnp.int32
+        )
+    for ax in axes:
+        if ax is not None:
+            bad = jax.lax.psum(bad, ax)
+    return bad == 0
 
 
 # Whole-pipeline sweep: both fused kernels (or both halves of the

@@ -70,6 +70,7 @@ def moe_reduce_rs(
     out_dtype: Any = None,
     act_fn: Any = None,
     assume_bijective: bool = True,
+    scale: jax.Array | None = None,
     interpret: Any = None,
 ) -> jax.Array:
     """MoE second GEMM + weighted combine + reduce-scatter (call inside
@@ -85,9 +86,11 @@ def moe_reduce_rs(
     H]`` — this PE's token chunk of the fully-reduced MoE output.
     """
     out_dtype = out_dtype or h_sorted.dtype
+    # an explicit `scale` marks w_down as a pre-quantized int8 pool
+    # (ISSUE 8 satellite), same contract as group_gemm / the overlap entry
     y_sorted = group_gemm(
         h_sorted, w_down, alignment.expert_ids,
-        valid_rows=alignment.valid_rows, config=config,
+        valid_rows=alignment.valid_rows, config=config, scale=scale,
         out_dtype=jnp.float32, act_fn=act_fn, interpret=interpret,
     )
     partial = scatter_add_unsorted(

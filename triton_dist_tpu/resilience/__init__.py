@@ -1,7 +1,7 @@
 """Resilience subsystem: watchdogged waits, fault injection, graceful
 fallback to XLA collectives, and elastic degraded-mode execution.
 
-Five parts (see docs/resilience.md for the full contract):
+Six parts (see docs/resilience.md for the full contract):
 
 - :mod:`watchdog` / :mod:`records` — bounded distributed waits that write a
   structured diagnostic record and NaN-poison outputs instead of spinning
@@ -24,13 +24,29 @@ Five parts (see docs/resilience.md for the full contract):
   topology is rebuilt over the survivors (``elastic.effective_mesh``),
   and recovered PEs are probed back in.
   Arm with ``config.update(elastic=True)``.
+- :mod:`integrity` — the data-integrity layer (ISSUE 8): payload
+  corruption detection (per-chunk canaries on the chunked puts, output
+  guards at every guarded op entry), the detect → retry → golden-fallback
+  recovery ladder with corruption counted separately from timeouts, and
+  the containment hooks above the ops (train-step skip, serving
+  per-request poison quarantine).
+  Arm with ``config.update(integrity=IntegrityConfig(...))``.
 """
 
 from triton_dist_tpu.resilience import elastic as elastic
 from triton_dist_tpu.resilience import health as health
+from triton_dist_tpu.resilience import integrity as integrity
 from triton_dist_tpu.resilience import retry as retry
 from triton_dist_tpu.resilience import watchdog as watchdog
-from triton_dist_tpu.resilience.faults import KINDS as FAULT_KINDS, FaultPlan
+from triton_dist_tpu.resilience.faults import (
+    KINDS as FAULT_KINDS,
+    PAYLOAD_KINDS as PAYLOAD_FAULT_KINDS,
+    FaultPlan,
+)
+from triton_dist_tpu.resilience.integrity import (
+    IntegrityConfig,
+    IntegrityError,
+)
 from triton_dist_tpu.resilience.guard import (
     UnsupportedTopologyError,
     fallbackable,
@@ -73,6 +89,9 @@ __all__ = [
     "FAULT_KINDS",
     "FakeClock",
     "FaultPlan",
+    "IntegrityConfig",
+    "IntegrityError",
+    "PAYLOAD_FAULT_KINDS",
     "RetryPolicy",
     "UnsupportedTopologyError",
     "call_with_retry",
@@ -86,6 +105,7 @@ __all__ = [
     "guard_op",
     "guarded_call",
     "health",
+    "integrity",
     "reset",
     "retry",
     "watchdog",

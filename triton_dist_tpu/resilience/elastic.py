@@ -141,6 +141,18 @@ def report_timeout(pe: int, family: str | None = None) -> str:
     """One timeout attributed to ``pe``: healthy→suspect, suspect strikes
     accumulate to quarantine at ``config.suspect_threshold``, and a strike
     during probation re-quarantines immediately. Returns the new state."""
+    return _strike(pe, family, "timeout")
+
+
+def report_corruption(pe: int, family: str | None = None) -> str:
+    """One detected data corruption attributed to ``pe`` (integrity.py):
+    the SAME strike machinery as timeouts — corruption and absence share
+    one ladder into quarantine — with the quarantine reason naming data
+    corruption so the health registry can tell the two apart."""
+    return _strike(pe, family, "corruption")
+
+
+def _strike(pe: int, family: str | None, what: str) -> str:
     from triton_dist_tpu import config as tdt_config
 
     threshold = max(1, int(tdt_config.get_config().suspect_threshold))
@@ -151,7 +163,7 @@ def report_timeout(pe: int, family: str | None = None) -> str:
         p.strikes += 1
         p.clean_probes = 0
         if p.state == PROBATION or p.strikes >= threshold:
-            _quarantine_locked(p, family)
+            _quarantine_locked(p, family, what)
         else:
             p.state = SUSPECT
         return p.state
@@ -212,13 +224,57 @@ def note_timeout_exc(exc: BaseException, family: str | None = None) -> int | Non
     )
 
 
-def _quarantine_locked(p: PeerHealth, family: str | None) -> None:
+def note_integrity_records(
+    records: list[dict], world_size: int | None = None,
+    family: str | None = None,
+) -> int | None:
+    """Strike the PE each integrity record names, DIRECTLY — no
+    by-absence inference. A canary record's PE field is the consumer that
+    observed a corrupt landing, and the payload-fault model (faults.py)
+    makes landing-site corruption the corrupt PE's own memory: victim ==
+    culprit, so the record IS the attribution. Returns the last struck PE
+    (None: disabled / no named PEs)."""
+    if not enabled():
+        return None
+    struck: int | None = None
+    for r in records:
+        pe = int(r.get("pe", -1))
+        if pe < 0 or (world_size is not None and pe >= world_size):
+            continue
+        report_corruption(pe, family=family)
+        struck = pe
+    return struck
+
+
+def note_integrity_exc(exc: BaseException, family: str | None = None) -> int | None:
+    """Exception-path corruption attribution (the ``note_timeout_exc``
+    convention extended to :class:`IntegrityError`, ISSUE 8): pull the
+    IntegrityError out of the cause chain and strike the PEs its records
+    name. Host-tier detections (output guards) carry no records and
+    attribute nothing — blaming a peer without evidence is strictly worse
+    than staying degraded-but-correct."""
+    if not enabled():
+        return None
+    from triton_dist_tpu.resilience.integrity import integrity_in_chain
+
+    err = integrity_in_chain(exc)
+    if err is None or not err.records:
+        return None
+    return note_integrity_records(
+        err.records, getattr(err, "world_size", None),
+        family=family or err.family,
+    )
+
+
+def _quarantine_locked(
+    p: PeerHealth, family: str | None, what: str = "timeout"
+) -> None:
     p.state = QUARANTINED
     p.clean_probes = 0
     health.record_pe_quarantine(
         p.pe,
-        reason=f"{p.strikes} timeout(s) attributed"
-        + (f" (last family {family!r})" if family else ""),
+        reason=f"{p.strikes} strike(s), last a {what}"
+        + (f" (family {family!r})" if family else ""),
     )
     _maybe_release_family_pins()
 

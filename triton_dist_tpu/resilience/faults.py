@@ -11,6 +11,27 @@ or MISCOUNTED signal. This injector can, deterministically:
 - ``straggler``        — a chosen PE busy-spins on entering ``barrier_all``
                          (skewing its whole issue schedule)
 
+and, since ISSUE 8, the PAYLOAD corruption kinds — *wrong data* instead of
+*absent signals*, the failure mode the data-coupled semaphore structurally
+cannot detect (the DMA completed; its bytes are just wrong):
+
+- ``bitflip``          — one high exponent bit of one element of a landed
+                         chunk flips (the classic silent DMA/HBM upset)
+- ``torn_chunk``       — only the first half of a landed chunk holds real
+                         data; the tail still holds the stale buffer
+- ``stale_read``       — the consumer observes the whole pre-put buffer
+                         (reads raced ahead of the landing)
+- ``nan_inject``       — a landed element becomes NaN (the NaN-storm seed)
+
+Payload kinds afflict what LANDS IN PE ``pe``'s memory (victim == culprit:
+they model a PE whose DMA engine / HBM corrupts its own landings, so the
+diagnostic record's PE field names the sick peer DIRECTLY — the integrity
+layer's attribution convention, resilience/integrity.py). They are applied
+at the chunk-consumption sites of ``shmem.wait_chunk`` on kernels that
+declare their landing views (``recv_view=``), and compose with the signal
+kinds and the chunked protocol's per-(step, chunk) slots: a dropped chunk
+signal still times out, a corrupted landing now *also* fails its canary.
+
 Configured host-side via ``config.update(fault_plan=FaultPlan(...))`` and
 applied at TRACE time inside the SHMEM signal/barrier primitives: the
 injected alteration is a data-dependent ``jnp.where`` on ``my_pe``, so one
@@ -35,7 +56,11 @@ import threading
 
 from triton_dist_tpu.resilience import watchdog
 
-KINDS = ("drop_signal", "dup_signal", "delay_signal", "straggler")
+SIGNAL_KINDS = ("drop_signal", "dup_signal", "delay_signal", "straggler")
+# payload-corruption kinds (ISSUE 8): mutate interpret-mode DMA payloads
+# at their landing site instead of miscounting signals
+PAYLOAD_KINDS = ("bitflip", "torn_chunk", "stale_read", "nan_inject")
+KINDS = SIGNAL_KINDS + PAYLOAD_KINDS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -226,7 +251,7 @@ def apply_signal_fault(inc, me):
     if scope is None:
         return inc
     plan = active_plan(scope.family)
-    if plan is None or plan.kind == "straggler":
+    if plan is None or plan.kind == "straggler" or plan.kind in PAYLOAD_KINDS:
         return inc
     site = scope.next_signal_site()
     if plan.site is not None and plan.site != site:
@@ -246,6 +271,83 @@ def apply_signal_fault(inc, me):
         spins = jnp.where(hit, jnp.int32(plan.delay_iters), 0)
         alt = inc + _busy_zero(spins, me)
     return jnp.where(hit, alt, inc)
+
+
+def _corrupt_payload(x, kind: str):
+    """The traced corruption of one landed chunk payload per PAYLOAD kind.
+    Deterministic (no RNG — chaos cells must replay bit-exactly)."""
+    import jax
+    import jax.numpy as jnp
+
+    if kind == "stale_read":
+        # the consumer observed the whole pre-put buffer (interpret-mode
+        # buffers zero-init, matching uninitialized_memory="zero")
+        return jnp.zeros_like(x)
+    if kind == "torn_chunk":
+        # first half landed, the tail still holds the stale buffer
+        rows = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+        return jnp.where(rows < x.shape[0] // 2, x, jnp.zeros_like(x))
+    first = None
+    for d in range(x.ndim):
+        i = jax.lax.broadcasted_iota(jnp.int32, x.shape, d) == 0
+        first = i if first is None else jnp.logical_and(first, i)
+    if first is None:  # 0-d payload
+        first = jnp.bool_(True)
+    if kind == "nan_inject":
+        if jnp.issubdtype(x.dtype, jnp.inexact):
+            return jnp.where(first, jnp.asarray(jnp.nan, x.dtype), x)
+        return jnp.where(
+            first, jnp.asarray(jnp.iinfo(x.dtype).min, x.dtype), x
+        )
+    assert kind == "bitflip", kind
+    if jnp.issubdtype(x.dtype, jnp.inexact):
+        # flip a high exponent bit of element (0, …, 0) through an exact
+        # f32 widening (bit 30 lives in the top 16 bits, so it survives
+        # the round-trip for bf16 payloads too)
+        bits = jax.lax.bitcast_convert_type(
+            x.astype(jnp.float32), jnp.uint32
+        )
+        bits = jnp.where(first, bits ^ jnp.uint32(1 << 30), bits)
+        return jax.lax.bitcast_convert_type(bits, jnp.float32).astype(x.dtype)
+    nbits = jnp.iinfo(x.dtype).bits
+    return jnp.where(first, x ^ x.dtype.type(1 << (nbits - 2)), x)
+
+
+def apply_payload_fault(view_ref, me, site=None):
+    """Corrupt the landed chunk in ``view_ref`` per the armed PAYLOAD
+    plan, iff this PE is the afflicted one (``me == plan.pe``; -1 afflicts
+    every PE). Called by ``shmem.wait_chunk`` AFTER the data-coupled
+    arrival wait, on kernels that declare their landing views — the
+    landing-site model: the put completed, the bytes in THIS PE's memory
+    are wrong. Interpret-mode only by the usual ``active_plan`` gate; a
+    no-op without a payload plan, outside a diag scope, at a filtered
+    site, or when the scope has no PE hint.
+
+    ``site`` is the chunk-landing ordinal — ``wait_chunk`` allocates ONE
+    per consumed chunk (``scope.next_payload_site()``) and shares it with
+    the canary's diagnostic record, so an injected ``FaultPlan.site``
+    matches the record's site field exactly; ``None`` (direct callers)
+    allocates here."""
+    import jax.numpy as jnp
+
+    scope = watchdog.active()
+    if scope is None:
+        return
+    plan = active_plan(scope.family)
+    if plan is None or plan.kind not in PAYLOAD_KINDS:
+        return
+    if site is None:
+        site = scope.next_payload_site()
+    if plan.site is not None and plan.site != site:
+        return
+    if me is None:
+        return
+    x = view_ref[...]
+    hit = (
+        jnp.asarray(me, jnp.int32) == plan.pe if plan.pe >= 0
+        else jnp.bool_(True)
+    )
+    view_ref[...] = jnp.where(hit, _corrupt_payload(x, plan.kind), x)
 
 
 def straggler_entry_delay(me):

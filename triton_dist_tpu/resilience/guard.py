@@ -73,14 +73,9 @@ _API_MARKERS = re.compile(
 def _timeout_in_chain(exc: BaseException) -> bool:
     """A DistTimeoutError anywhere in the cause chain (e.g. wrapped by the
     autotuner's terminal RuntimeError)."""
-    seen: set[int] = set()
-    cause: BaseException | None = exc
-    while cause is not None and id(cause) not in seen:
-        if isinstance(cause, DistTimeoutError):
-            return True
-        seen.add(id(cause))
-        cause = cause.__cause__ or cause.__context__
-    return False
+    from triton_dist_tpu.resilience.records import exc_in_chain
+
+    return exc_in_chain(exc, DistTimeoutError) is not None
 
 
 def fallbackable(exc: BaseException) -> bool:
@@ -144,9 +139,20 @@ def guarded_call(
 
 def _guarded(family, primary, fallback, args, kwargs, *, pin_global):
     from triton_dist_tpu import config as tdt_config
+    from triton_dist_tpu.resilience import integrity as _integrity
+
+    # output-integrity guards (ISSUE 8): finite check + magnitude envelope
+    # on every outermost guarded entry when config.integrity arms them —
+    # read-only, so the happy path stays bit-exact. Canary IntegrityErrors
+    # raised inside the fused path (jit_shard_map) take the same ladder.
+    checking = _guard_depth() == 0 and _integrity.output_checks_enabled()
 
     if fallback is None or not tdt_config.get_config().fallback_to_xla:
-        return primary(*args, **kwargs)
+        # no golden rung / loud CI posture: detection still runs, loudly
+        out = primary(*args, **kwargs)
+        if checking:
+            _integrity.check_result(family, out)
+        return out
     if _guard_depth() > 0:
         return primary(*args, **kwargs)
     if health.short_circuited(family) is not None:
@@ -156,14 +162,51 @@ def _guarded(family, primary, fallback, args, kwargs, *, pin_global):
         # semaphore state undefined (quarantine; see docs/resilience.md).
         # Recorded once at pin time — not per call, to keep the event deque
         # and counters meaningful.
-        return fallback(*args, **kwargs)
-    try:
+        out = fallback(*args, **kwargs)
+        if checking:
+            _integrity.check_result(family, out, source="golden")
+        return out
+
+    def run_primary():
         _tls.depth = _guard_depth() + 1
         try:
-            return primary(*args, **kwargs)
+            out = primary(*args, **kwargs)
         finally:
             _tls.depth -= 1
+        if checking:
+            _integrity.check_result(family, out)
+        return out
+
+    try:
+        return run_primary()
     except Exception as exc:  # noqa: BLE001 — filtered by fallbackable()
+        if _integrity.integrity_in_chain(exc) is not None:
+            # the corruption ladder (resilience/integrity.py): detect →
+            # bounded retry (counted separately from timeouts) → golden
+            # fallback (checked too) — while every detection's records
+            # strike the named PE toward quarantine. No family pin: a
+            # canary drains its own credits, so unlike a watchdog trip a
+            # corruption leaves no semaphore residue to protect against.
+            try:
+                return _integrity.recover(
+                    family, run_primary, lambda: fallback(*args, **kwargs),
+                    exc, fallback_allowed=True,
+                )
+            except Exception as ladder_exc:  # noqa: BLE001 — see below
+                # timeout precedence (retry.classify's rule): anything
+                # raised inside the ladder implicitly chains the original
+                # IntegrityError as __context__, so "integrity in chain"
+                # alone cannot distinguish a mid-ladder watchdog trip
+                if (not _timeout_in_chain(ladder_exc)
+                        and _integrity.integrity_in_chain(ladder_exc)
+                        is not None):
+                    raise
+                # a NON-integrity failure surfaced mid-ladder (e.g. a
+                # watchdog trip on a retry attempt): hand it to the SAME
+                # taxonomy a first-attempt failure gets — timeouts
+                # quarantine-pin the family and stay loud, environmental
+                # failures degrade to the golden path
+                exc = ladder_exc
         if not fallbackable(exc):
             if _timeout_in_chain(exc):
                 # the trip itself stays loud (this raise); LATER calls of
@@ -180,7 +223,11 @@ def _guarded(family, primary, fallback, args, kwargs, *, pin_global):
                 from triton_dist_tpu.resilience import elastic
 
                 elastic.maybe_release_family_pins()
-            raise
+            # explicit `raise exc`, not bare raise: after the mid-ladder
+            # fall-through above, `exc` is the ladder's failure while the
+            # exception "currently being handled" is still the original
+            # IntegrityError — a bare raise would resurrect the wrong one
+            raise exc
         if pin_global and _process_global(exc):
             # memoize ONLY at the op-entry level (the serving/bench surface,
             # where re-paying a failing trace per step is real cost) and

@@ -30,6 +30,20 @@ PE_QUARANTINE = "pe_quarantine"   # elastic: a peer left the world
 PE_READMIT = "pe_readmit"         # elastic: a peer rejoined after probation
 SERVING_REBUILD = "serving_rebuild"  # serving engine rebuilt its batcher
                                      # on a new world (shrink or regrow)
+INTEGRITY = "integrity"             # corrupt data detected (canary or
+                                    # output guard — integrity.py); never
+                                    # silently consumed
+INTEGRITY_RETRY = "integrity_retry"  # a corruption was retried in place —
+                                     # counted SEPARATELY from the timeout
+                                     # RETRY events so dashboards can tell
+                                     # comm jitter from data rot
+SKIP_STEP = "skip_step"             # a non-finite grad step was dropped
+                                    # (train_step skip-step containment);
+                                    # optimizer state untouched
+POISONED = "poisoned"               # serving: one request's logits went
+                                    # non-finite; that request was evicted
+                                    # and typed-rejected, survivors kept
+                                    # streaming (serving/engine.py)
 
 # short-circuit pin kinds (why a family is pinned to its golden path)
 PIN_ENV = "env"               # process-global environment failure
@@ -107,6 +121,55 @@ def record_recovery(family: str, retries: int) -> None:
     ))
 
 
+def record_integrity(family: str, exc: BaseException | None = None,
+                     records: Any = None, reason: str | None = None) -> None:
+    """Corrupt data detected by the integrity layer (integrity.py): a
+    canary mismatch, a non-finite output, or an envelope violation."""
+    _record(HealthEvent(
+        kind=INTEGRITY, family=family,
+        reason=reason or (
+            f"{getattr(exc, 'detector', 'corruption')} check tripped"
+            if exc is not None else "corruption detected"
+        ),
+        detail=records if records is not None
+        else (None if exc is None else f"{type(exc).__name__}: {exc}"),
+        walltime=time.time(),
+    ))
+
+
+def record_integrity_retry(
+    family: str, attempt: int, delay_s: float,
+    exc: BaseException | None = None,
+) -> None:
+    """One corruption absorbed by the bounded integrity-retry rung —
+    a separate counter from the timeout retries (integrity.py ladder)."""
+    _record(HealthEvent(
+        kind=INTEGRITY_RETRY, family=family,
+        reason=f"corrupt output; retry {attempt} after {delay_s:.3g}s",
+        detail=None if exc is None else f"{type(exc).__name__}: {exc}",
+        walltime=time.time(),
+    ))
+
+
+def record_skip_step(family: str) -> None:
+    """A non-finite gradient step was dropped (optimizer state untouched)
+    — train_step's skip-step containment (integrity.py)."""
+    _record(HealthEvent(
+        kind=SKIP_STEP, family=family,
+        reason="non-finite grads; step dropped, optimizer state untouched",
+        walltime=time.time(),
+    ))
+
+
+def record_poisoned_request(family: str, uid: Any, reason: str) -> None:
+    """The serving engine evicted + typed-rejected one poisoned request
+    (serving/engine.py per-request quarantine)."""
+    _record(HealthEvent(
+        kind=POISONED, family=family,
+        reason=f"request {uid!r}: {reason}", walltime=time.time(),
+    ))
+
+
 def record_pe_quarantine(pe: int, reason: str) -> None:
     """The elastic layer quarantined peer ``pe`` (elastic.py)."""
     _record(HealthEvent(
@@ -175,14 +238,25 @@ def retried_families() -> set[str]:
 
 
 def is_healthy() -> bool:
-    """True iff no downgrade or timeout has been recorded since reset().
-    Retries/recoveries alone don't flip this — an absorbed transient is
-    the system working — but quarantines and unrecovered timeouts do."""
+    """True iff no downgrade, timeout, or corruption has been recorded
+    since reset(). Retries/recoveries alone don't flip this — an absorbed
+    transient is the system working — but quarantines, unrecovered
+    timeouts, detected corruption, dropped train steps, and poisoned
+    serving requests do: they all mean some work was NOT done on the fast
+    clean path."""
     with _lock:
         return not any(
-            k in (DOWNGRADE, TIMEOUT, PE_QUARANTINE)
+            k in (DOWNGRADE, TIMEOUT, PE_QUARANTINE, INTEGRITY, SKIP_STEP,
+                  POISONED)
             for (_, k), n in _counters.items() if n > 0
         )
+
+
+def corrupt_families() -> set[str]:
+    """Families with at least one detected-corruption event."""
+    with _lock:
+        return {f for (f, k), n in _counters.items()
+                if k == INTEGRITY and n > 0}
 
 
 def snapshot() -> dict:

@@ -1,0 +1,364 @@
+"""Data-integrity layer: silent-corruption detection, poison containment,
+and attributed recovery (ISSUE 8).
+
+The rest of the resilience subsystem detects *absence* — a dropped signal,
+a straggling peer, a timeout (PRs 1–2). This module is about *wrong data*:
+a bit-flipped DMA payload, a torn chunk, a stale read, a NaN storm. At
+fleet scale silently wrong arithmetic is the dominant failure mode ("Cores
+that don't count", Hochschild et al., HotOS '21), and the contract here is
+the MegaScale-style one: one corrupt PE degrades one request or one step —
+never the engine, never the run.
+
+Three tiers, all opt-in via ``config.update(integrity=IntegrityConfig())``
+(``None``, the default, keeps every pre-existing code path byte-identical
+with zero added work):
+
+- **per-chunk payload canary** (kernel tier, ``canary=True``): chunked
+  puts fold a cheap payload checksum into their EXISTING per-chunk signal
+  increment (no new signal edges — the chaos-pinned discipline of the w8
+  scale DMAs in PR 7), and canary-aware consumers recompute it over the
+  landed chunk. A mismatch writes a ``KIND_INTEGRITY`` diagnostic record
+  into the watchdog buffer; host-side it surfaces as
+  :class:`IntegrityError` with the corrupt PE named DIRECTLY (the victim
+  of a landing-site corruption IS the sick PE — see
+  ``faults.apply_payload_fault``). Requires the armed watchdog (the canary
+  rides the watchdog's per-chunk signal slots and diag buffer).
+- **output guards** (host tier, ``check_outputs=True``): every guarded op
+  entry (``guard_op`` / ``guarded_call`` — i.e. every op family) checks
+  its result for non-finite values and, optionally, a magnitude envelope
+  (``max_abs``). Detection is observation-only on the happy path: the
+  checks read, never rewrite, so clean runs stay bit-exact.
+- **containment above the ops**: ``models.tp_transformer.train_step``
+  gains skip-step semantics (a non-finite grad step is dropped and
+  counted, optimizer state untouched) and the serving engine gains
+  per-request poison quarantine (a NaN logit evicts and typed-rejects
+  exactly that slot's request; survivors keep streaming byte-identically).
+
+Recovery is a LADDER, run by the guard layer (guard.py) when a check
+trips: detect → bounded retry (``retries``; corruption counted separately
+from timeouts in the health registry, event kind ``integrity_retry``) →
+golden-XLA fallback (checked too — corrupt golden output means the DATA is
+bad and must stay loud) → PE quarantine through the PR 2 state machine
+(every detection with attributable records strikes the named peer via
+``elastic.note_integrity_records``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from triton_dist_tpu.resilience import health
+
+# the canary checksum is folded modulo this into the chunk signal
+# increment (producer signals 1 + csum, consumer re-derives and drains) —
+# small enough that a semaphore credit can never overflow int32 even with
+# dup_signal chaos doubling it
+CANARY_MOD = 1 << 16
+
+# detector names carried by IntegrityError.detector
+DET_NONFINITE = "nonfinite"     # output guard: NaN/Inf in an inexact leaf
+DET_ENVELOPE = "envelope"       # output guard: |x| above max_abs
+DET_CANARY = "canary"           # in-kernel per-chunk checksum mismatch
+
+
+@dataclasses.dataclass(frozen=True)
+class IntegrityConfig:
+    """Arm via ``config.update(integrity=IntegrityConfig(...))``.
+
+    check_outputs: host-tier output guards at every guarded op entry
+        (finite check always; magnitude envelope when ``max_abs`` is set)
+        plus the serving engine's per-request NaN-logit quarantine.
+    canary:       kernel-tier per-chunk payload checksums on the chunked
+        put protocol (needs ``config.timeout_iters > 0`` — the canary
+        rides the watchdog's per-chunk signal slots; silently inert
+        without it, exactly like the chunk signals themselves).
+    max_abs:      magnitude envelope for the output guards; ``None``
+        disables the envelope (finite check remains). Calibrate per
+        model — activations legitimately reach 1e4-ish, bf16 overflows at
+        ~3.4e38; the default catches exponent-bit flips, not outliers.
+    retries:      bounded in-place re-attempts of the fused path before
+        the golden fallback rung (0 = straight to fallback). Counted as
+        ``integrity_retry`` health events — separate from the timeout
+        retry counters, so a fleet dashboard can tell jitter from rot.
+    """
+
+    check_outputs: bool = True
+    canary: bool = False
+    max_abs: float | None = None
+    retries: int = 1
+
+    def validate(self) -> "IntegrityConfig":
+        if self.retries < 0:
+            raise ValueError(
+                f"IntegrityConfig.retries must be >= 0, got {self.retries}"
+            )
+        if self.max_abs is not None and not self.max_abs > 0:
+            raise ValueError(
+                f"IntegrityConfig.max_abs must be > 0 (or None), got "
+                f"{self.max_abs}"
+            )
+        return self
+
+
+class IntegrityError(RuntimeError):
+    """Corrupt data was DETECTED (never silently consumed).
+
+    detector: one of :data:`DET_NONFINITE` / :data:`DET_ENVELOPE` /
+        :data:`DET_CANARY`.
+    records:  decoded ``KIND_INTEGRITY`` diagnostic dicts for the canary
+        path (empty for host-tier detections) — same shape as
+        ``DistTimeoutError.records``, the ``note_timeout_exc`` convention
+        extended: ``elastic.note_integrity_exc`` strikes ``records[i]
+        ["pe"]`` directly (landing-site corruption makes the victim the
+        culprit; see faults.py).
+    world_size: PE count of the collective, when the raising entry knows
+        it (attribution bookkeeping parity with DistTimeoutError).
+    """
+
+    def __init__(
+        self,
+        family: str,
+        detector: str,
+        detail: str = "",
+        records: list[dict] | None = None,
+        world_size: int | None = None,
+    ):
+        self.family = family
+        self.detector = detector
+        self.records = list(records or [])
+        self.world_size = world_size
+        where = "; ".join(
+            f"pe {r['pe']}: site {r['site']} expected {r['expected']} "
+            f"observed {r['observed']}"
+            for r in self.records
+        )
+        super().__init__(
+            f"integrity check ({detector}) tripped on op family "
+            f"{family!r}{': ' + detail if detail else ''}"
+            f"{' [' + where + ']' if where else ''}. Corrupt data was "
+            f"detected, not consumed; see docs/resilience.md "
+            f"('Data integrity')."
+        )
+
+
+def get_integrity_config() -> IntegrityConfig | None:
+    from triton_dist_tpu import config as tdt_config
+
+    cfg = tdt_config.get_config().integrity
+    return cfg
+
+
+def output_checks_enabled() -> bool:
+    cfg = get_integrity_config()
+    return cfg is not None and cfg.check_outputs
+
+
+def canary_enabled() -> bool:
+    cfg = get_integrity_config()
+    return cfg is not None and cfg.canary
+
+
+def integrity_in_chain(exc: BaseException) -> "IntegrityError | None":
+    """The first :class:`IntegrityError` in the cause chain, or None."""
+    from triton_dist_tpu.resilience.records import exc_in_chain
+
+    return exc_in_chain(exc, IntegrityError)
+
+
+# ---------------------------------------------------------------------------
+# The payload checksum (shared by the in-kernel canary and host-side tests:
+# identical bytes must fold to identical values on both sides)
+# ---------------------------------------------------------------------------
+
+def payload_checksum(x) -> Any:
+    """Cheap traced checksum of a payload array: bitcast to uint32 via an
+    exact f32 widening, fold each word mod :data:`CANARY_MOD`, wrap-sum.
+    Deterministic for identical bytes on producer and consumer — wrapping
+    arithmetic is fine for a checksum as long as both sides run the same
+    fold. Works on float (bf16/f32 widen exactly) and small-int payloads;
+    any single-bit flip of the underlying value moves the fold with
+    overwhelming probability (an all-zero payload checksums to 0, so
+    zero-for-zero corruption is undetectable — as for any checksum)."""
+    import jax
+    import jax.numpy as jnp
+
+    xf = jnp.asarray(x).astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(xf, jnp.uint32)
+    # XOR-fold the halves BEFORE the modular sum: every one of the 32 bits
+    # reaches the fold (a plain mod would discard exactly the exponent
+    # bits a bit-flip upsets)
+    folded = jnp.bitwise_xor(
+        jnp.right_shift(bits, jnp.uint32(16)),
+        jnp.bitwise_and(bits, jnp.uint32(0xFFFF)),
+    )
+    total = jnp.sum(folded.astype(jnp.uint32))
+    return jnp.remainder(total, jnp.uint32(CANARY_MOD)).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Host-tier output guards (called by guard.py at the op-entry boundary)
+# ---------------------------------------------------------------------------
+
+def check_result(family: str, out: Any, *, source: str = "fused") -> Any:
+    """Validate an op entry's output tree against the armed
+    :class:`IntegrityConfig` (no-op when integrity is disarmed or
+    ``check_outputs=False``). Read-only — the happy path returns ``out``
+    untouched, bit for bit. Raises :class:`IntegrityError` naming the
+    detector on the first violating leaf."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    cfg = get_integrity_config()
+    if cfg is None or not cfg.check_outputs:
+        return out
+
+    def trip(detector: str, detail: str):
+        # the detection lands in the health registry HERE, at the raise
+        # site, so every posture sees it — the loud-CI (no-fallback)
+        # branch and the pinned-golden branch raise without ever reaching
+        # the recovery ladder; the ladder's own bookkeeping dedups on the
+        # _tdt_recorded flag
+        err = IntegrityError(family, detector, detail=detail)
+        health.record_integrity(family, err)
+        err._tdt_recorded = True
+        raise err
+
+    leaves = [
+        leaf for leaf in jax.tree_util.tree_leaves(out)
+        if getattr(leaf, "dtype", None) is not None
+        and jnp.issubdtype(leaf.dtype, jnp.inexact)
+    ]
+    if not leaves:
+        return out
+    # ONE host sync for the whole tree (the decode hot path runs this per
+    # guarded call): fold every leaf into a traced (finite_ok, peak) pair
+    # and transfer once
+    finite_ok = jnp.bool_(True)
+    peak = jnp.float32(0.0)
+    for leaf in leaves:
+        finite_ok = jnp.logical_and(finite_ok, jnp.all(jnp.isfinite(leaf)))
+        if cfg.max_abs is not None:
+            peak = jnp.maximum(
+                peak, jnp.max(jnp.abs(leaf)).astype(jnp.float32)
+            )
+    verdict = np.asarray(jnp.stack(
+        [finite_ok.astype(jnp.float32), peak]
+    ))
+    if not bool(verdict[0]):
+        trip(
+            DET_NONFINITE,
+            f"non-finite values in a {source} output "
+            f"({len(leaves)} inexact leaf/leaves checked)",
+        )
+    if cfg.max_abs is not None and float(verdict[1]) > cfg.max_abs:
+        trip(
+            DET_ENVELOPE,
+            f"|out| peak {float(verdict[1]):.4g} exceeds the magnitude "
+            f"envelope max_abs={cfg.max_abs:.4g}",
+        )
+    return out
+
+
+def note_detection(exc: BaseException, *, family: str) -> None:
+    """Record one corruption detection in the health registry and offer
+    its records to PE attribution — EXACTLY ONCE per detection: the
+    ``_tdt_recorded`` flag marks an error whose raise site already did
+    both (``jit_shard_map._raise_integrity`` records AND strikes;
+    ``check_result`` records — its host-tier detections carry no records,
+    so there is nothing to strike). One detection therefore costs one
+    strike, preserving the healthy → suspect → quarantined ladder for
+    corruption. Shared by the guard's recovery ladder and
+    ``retry.call_with_retry``'s CORRUPT arc."""
+    from triton_dist_tpu.resilience import elastic
+
+    err = integrity_in_chain(exc)
+    if err is None or getattr(err, "_tdt_recorded", False):
+        return
+    health.record_integrity(family, err)
+    err._tdt_recorded = True
+    elastic.note_integrity_exc(exc, family=family)
+
+
+# ---------------------------------------------------------------------------
+# The recovery ladder (invoked by guard._guarded when a check trips)
+# ---------------------------------------------------------------------------
+
+def recover(
+    family: str,
+    run_primary,
+    run_fallback,
+    first_exc: BaseException,
+    *,
+    fallback_allowed: bool,
+):
+    """detect → bounded retry → golden fallback → (strikes already feeding
+    PE quarantine). ``run_primary`` must re-run the fused path INCLUDING
+    its post-check; ``run_fallback`` the golden path or ``None``.
+
+    Every detection (the first and each failed retry) is recorded in the
+    health registry and offered to peer attribution — so a persistently
+    corrupt PE accumulates strikes across the ladder and exhaustion lands
+    on an already-quarantined peer, exactly the timeout arc's shape.
+    Corruption retries are recorded as ``integrity_retry`` events, never
+    mixed into the timeout ``retry`` counters."""
+    from triton_dist_tpu.resilience import retry as _retry
+
+    cfg = get_integrity_config()
+    retries = cfg.retries if cfg is not None else 0
+    note_detection(first_exc, family=family)
+    last = first_exc
+    # bounded in-place retry: a transiently corrupt payload (one cosmic
+    # ray, a healing fault plan) re-runs clean; integrity mismatches leave
+    # no semaphore residue (the canary drains its own credits), so unlike
+    # timeouts the in-place relaunch is sound on compiled TPU too
+    from triton_dist_tpu import config as tdt_config
+
+    policy = tdt_config.get_config().retry_policy
+    delays = (
+        policy.delays(key=f"integrity:{family}") if policy is not None else ()
+    )
+    for attempt in range(retries):
+        delay = delays[attempt] if attempt < len(delays) else 0.0
+        health.record_integrity_retry(family, attempt + 1, delay, exc=last)
+        if delay:
+            _retry.get_clock().sleep(delay)
+        try:
+            out = run_primary()
+            health.record_recovery(family, attempt + 1)
+            return out
+        except Exception as exc:  # noqa: BLE001 — integrity-only retry
+            # timeout precedence, as in retry.classify: an exception
+            # raised INSIDE this ladder implicitly chains the original
+            # IntegrityError as __context__, so "integrity in chain"
+            # alone would swallow a mid-ladder watchdog trip
+            if (_retry.timeout_in_chain(exc) is not None
+                    or integrity_in_chain(exc) is None):
+                raise
+            note_detection(exc, family=family)
+            last = exc
+    if run_fallback is None or not fallback_allowed:
+        raise last
+    health.record_downgrade(
+        family,
+        reason="integrity: fused output failed its check; served golden "
+               "XLA collective path",
+        exc=last,
+    )
+    out = run_fallback()
+    # a corrupt GOLDEN result means the inputs themselves are poisoned —
+    # there is no lower rung; stay loud rather than propagate
+    return check_result(family, out, source="golden")
+
+
+# ---------------------------------------------------------------------------
+# Skip-step bookkeeping (models/tp_transformer.train_step containment)
+# ---------------------------------------------------------------------------
+
+def record_skip_step(family: str = "train_step", n: int = 1) -> None:
+    """Host-side counter for dropped non-finite grad steps
+    (``train_step(skip_nonfinite=True)`` returns the traced ``skipped``
+    flag; the training loop calls this when it comes back nonzero)."""
+    for _ in range(int(n)):
+        health.record_skip_step(family)

@@ -36,6 +36,7 @@ F_BUDGET = 7      # timeout_iters budget that was exhausted
 
 STATUS_OK = 0
 STATUS_TIMEOUT = 1
+STATUS_INTEGRITY = 2  # a payload canary mismatch, not an expired wait
 
 # wait kinds
 KIND_SIGNAL = 1   # shmem.signal_wait_until
@@ -43,12 +44,18 @@ KIND_WAIT = 2     # shmem.wait (dl.wait parity)
 KIND_BARRIER = 3  # a dissemination-barrier round in shmem.barrier_all
 KIND_CHUNK = 4    # shmem.wait_chunk: a per-chunk arrival wait of a chunked
                   # put (the sub-shard granularity of the ring pipelines)
+KIND_INTEGRITY = 5  # shmem.wait_chunk canary: the landed chunk's payload
+                    # checksum disagreed with the one the producer folded
+                    # into the chunk signal (resilience/integrity.py) —
+                    # F_EXPECTED is the locally recomputed checksum,
+                    # F_OBSERVED the producer's signalled one
 
 _KIND_NAMES = {
     KIND_SIGNAL: "signal_wait_until",
     KIND_WAIT: "wait",
     KIND_BARRIER: "barrier_all",
     KIND_CHUNK: "chunk_wait",
+    KIND_INTEGRITY: "integrity_check",
 }
 
 
@@ -82,8 +89,13 @@ def family_name_for(code: int) -> str:
 def decode_record(row) -> dict:
     """Decode one int32[DIAG_LEN] diagnostic row into a readable dict."""
     row = [int(v) for v in row]
+    status = {
+        STATUS_OK: "ok",
+        STATUS_TIMEOUT: "timeout",
+        STATUS_INTEGRITY: "integrity",
+    }.get(row[F_STATUS], "timeout")
     return {
-        "status": "timeout" if row[F_STATUS] == STATUS_TIMEOUT else "ok",
+        "status": status,
         "family": family_name_for(row[F_FAMILY]),
         "pe": row[F_PE],
         "site": row[F_SITE],
@@ -103,6 +115,22 @@ def decode_diag(diag) -> list[dict]:
     return [
         decode_record(row) for row in arr if int(row[F_STATUS]) != STATUS_OK
     ]
+
+
+def exc_in_chain(exc: BaseException, cls: type) -> "BaseException | None":
+    """The first instance of ``cls`` anywhere in ``exc``'s cause chain
+    (``__cause__``/``__context__``, cycle-safe), or None — THE chain
+    walker behind ``retry.timeout_in_chain``, ``guard``'s timeout check,
+    and ``integrity.integrity_in_chain`` (one implementation, three
+    projections)."""
+    seen: set[int] = set()
+    cause: BaseException | None = exc
+    while cause is not None and id(cause) not in seen:
+        if isinstance(cause, cls):
+            return cause
+        seen.add(id(cause))
+        cause = cause.__cause__ or cause.__context__
+    return None
 
 
 class DistTimeoutError(RuntimeError):

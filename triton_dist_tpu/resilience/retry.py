@@ -41,26 +41,35 @@ from triton_dist_tpu.resilience.records import DistTimeoutError
 # failure classes (the retry-relevant projection of the guard taxonomy)
 TRANSIENT = "transient"
 DETERMINISTIC = "deterministic"
+# detected data corruption (IntegrityError in the chain, ISSUE 8):
+# retryable like a transient, but counted SEPARATELY (integrity_retry
+# health events) and attributed through note_integrity_exc — a fleet must
+# be able to tell comm jitter from data rot
+CORRUPT = "corrupt"
 
 
 def timeout_in_chain(exc: BaseException) -> DistTimeoutError | None:
     """The first :class:`DistTimeoutError` in the cause chain, or None."""
-    seen: set[int] = set()
-    cause: BaseException | None = exc
-    while cause is not None and id(cause) not in seen:
-        if isinstance(cause, DistTimeoutError):
-            return cause
-        seen.add(id(cause))
-        cause = cause.__cause__ or cause.__context__
-    return None
+    from triton_dist_tpu.resilience.records import exc_in_chain
+
+    return exc_in_chain(exc, DistTimeoutError)
 
 
 def classify(exc: BaseException) -> str:
     """TRANSIENT iff a watchdog trip is anywhere in the cause chain (incl.
-    wrapped by the autotuner's terminal RuntimeError); everything else —
-    compile failures, shape errors, missing APIs, device faults — is
-    DETERMINISTIC and belongs to the golden-path guard, not a retry loop."""
-    return TRANSIENT if timeout_in_chain(exc) is not None else DETERMINISTIC
+    wrapped by the autotuner's terminal RuntimeError); CORRUPT iff an
+    :class:`~triton_dist_tpu.resilience.integrity.IntegrityError` is (a
+    detected corruption — retried under the same policy but counted
+    separately); everything else — compile failures, shape errors, missing
+    APIs, device faults — is DETERMINISTIC and belongs to the golden-path
+    guard, not a retry loop."""
+    if timeout_in_chain(exc) is not None:
+        return TRANSIENT
+    from triton_dist_tpu.resilience.integrity import integrity_in_chain
+
+    if integrity_in_chain(exc) is not None:
+        return CORRUPT
+    return DETERMINISTIC
 
 
 @dataclasses.dataclass(frozen=True)
@@ -215,11 +224,22 @@ def call_with_retry(
         try:
             out = fn(*args, **kwargs)
         except Exception as exc:  # noqa: BLE001 — classified below
-            if classify(exc) is not TRANSIENT:
+            cls = classify(exc)
+            if cls is DETERMINISTIC:
                 raise
             from triton_dist_tpu.resilience import elastic
 
-            elastic.note_timeout_exc(exc, family=family)
+            if cls is TRANSIENT:
+                elastic.note_timeout_exc(exc, family=family)
+            else:
+                # CORRUPT: record + strike the PEs the integrity records
+                # name — once per detection (the raise site may already
+                # have; integrity.note_detection dedups on the flag)
+                from triton_dist_tpu.resilience.integrity import (
+                    note_detection,
+                )
+
+                note_detection(exc, family=family)
             last = attempt == policy.max_attempts - 1
             delay = 0.0 if last else delays[attempt]
             over_budget = (
@@ -228,7 +248,13 @@ def call_with_retry(
             )
             if last or over_budget:
                 raise
-            health.record_retry(family, attempt + 1, delay, exc=exc)
+            if cls is TRANSIENT:
+                health.record_retry(family, attempt + 1, delay, exc=exc)
+            else:
+                # corruption counted separately from timeouts (ISSUE 8)
+                health.record_integrity_retry(
+                    family, attempt + 1, delay, exc=exc
+                )
             clock.sleep(delay)
             slept += delay
             continue

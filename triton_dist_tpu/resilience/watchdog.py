@@ -38,7 +38,7 @@ class KernelDiagScope:
     wait/signal site counters, and the PE hint ``shmem.my_pe`` registers."""
 
     __slots__ = ("diag_ref", "family", "family_code", "pe", "_wait_sites",
-                 "_signal_sites")
+                 "_signal_sites", "_payload_sites")
 
     def __init__(self, diag_ref, family: str):
         self.diag_ref = diag_ref
@@ -47,6 +47,7 @@ class KernelDiagScope:
         self.pe = None  # traced my_pe, registered by shmem.my_pe
         self._wait_sites = 0
         self._signal_sites = 0
+        self._payload_sites = 0
 
     def next_wait_site(self) -> int:
         s = self._wait_sites
@@ -56,6 +57,13 @@ class KernelDiagScope:
     def next_signal_site(self) -> int:
         s = self._signal_sites
         self._signal_sites += 1
+        return s
+
+    def next_payload_site(self) -> int:
+        """Trace-time ordinal of a chunk-landing site (the payload-fault
+        injector's and the canary's shared site numbering, ISSUE 8)."""
+        s = self._payload_sites
+        self._payload_sites += 1
         return s
 
 
@@ -166,6 +174,36 @@ def bounded_wait(sem, value, *, kind: int):
         diag[R.F_BUDGET] = budget
 
     return ok
+
+
+def record_integrity_mismatch(sem_value, local_checksum, mismatch, site):
+    """Write a ``KIND_INTEGRITY`` diagnostic record (first record wins —
+    the timeout protocol's slot discipline) when the traced ``mismatch``
+    bool is set: the producer's signalled payload checksum (``sem_value``)
+    disagreed with the one recomputed over the landed chunk
+    (``local_checksum``). Called by ``shmem.wait_chunk`` on canary-aware
+    chunk consumption (resilience/integrity.py); must run inside a
+    :func:`kernel_scope`."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    scope = active()
+    assert scope is not None, "record_integrity_mismatch outside kernel_scope"
+    diag = scope.diag_ref
+    if diag is None:
+        return
+
+    @pl.when(jnp.logical_and(mismatch, diag[R.F_STATUS] == R.STATUS_OK))
+    def _record():
+        pe = scope.pe if scope.pe is not None else jnp.int32(-1)
+        diag[R.F_STATUS] = jnp.int32(R.STATUS_INTEGRITY)
+        diag[R.F_FAMILY] = jnp.int32(scope.family_code)
+        diag[R.F_PE] = jnp.asarray(pe, jnp.int32)
+        diag[R.F_SITE] = jnp.int32(site)
+        diag[R.F_KIND] = jnp.int32(R.KIND_INTEGRITY)
+        diag[R.F_EXPECTED] = jnp.asarray(local_checksum, jnp.int32)
+        diag[R.F_OBSERVED] = jnp.asarray(sem_value, jnp.int32)
+        diag[R.F_BUDGET] = jnp.int32(0)
 
 
 # ---------------------------------------------------------------------------

@@ -28,6 +28,7 @@ are deterministic under a :class:`~triton_dist_tpu.resilience.FakeClock`.
 
 from triton_dist_tpu.serving.engine import (
     Finished,
+    Poisoned,
     Rejected,
     ServingConfig,
     ServingEngine,
@@ -48,6 +49,7 @@ from triton_dist_tpu.serving.traffic import (
 __all__ = [
     "Arrival",
     "Finished",
+    "Poisoned",
     "Rejected",
     "ServingConfig",
     "ServingEngine",

@@ -113,6 +113,24 @@ class Rejected:
 
 
 @dataclasses.dataclass(frozen=True)
+class Poisoned:
+    """Typed per-request poison rejection (ISSUE 8): this request's logit
+    row went non-finite under an armed ``config.integrity``, so it was
+    EVICTED from its slot and rejected — the engine kept serving and its
+    batch neighbors' token streams are untouched (byte-identical to a run
+    without the poison; chaos-asserted). ``tokens`` holds whatever was
+    generated before the poison (diagnostic only — do NOT serve them as a
+    completion)."""
+
+    uid: Any
+    tokens: list
+    reason: str
+    t_enqueue: float
+    t_poisoned: float
+    resumed: int
+
+
+@dataclasses.dataclass(frozen=True)
 class Finished:
     """One completed request with its lifecycle timestamps (engine-clock
     seconds) and the full generated token list (replay prefixes
@@ -309,10 +327,21 @@ class ServingEngine:
         try:
             self._batcher.step()
         except Exception as exc:  # noqa: BLE001 — classified below
-            if _retry.timeout_in_chain(exc) is None:
-                raise
-            self._on_step_timeout(exc)
-            return True
+            from triton_dist_tpu.resilience import integrity as _integrity
+
+            if _retry.timeout_in_chain(exc) is not None:
+                self._on_step_timeout(exc)
+                return True
+            if _integrity.integrity_in_chain(exc) is not None:
+                # whole-step corruption detected BELOW the logits (a
+                # canary / output guard tripped inside the jitted step):
+                # same containment as a timeout — attribute, rebuild, and
+                # prefix-replay every in-flight request (no token of the
+                # poisoned step was ever consumed); the per-REQUEST
+                # quarantine path is the batcher's logit check, not this
+                self._on_step_integrity(exc)
+                return True
+            raise
         self._failures = 0
         if self.serving.virtual_step_s:
             self.clock.sleep(self.serving.virtual_step_s)
@@ -332,6 +361,8 @@ class ServingEngine:
             st = self._states[r.uid]
             if st.awaiting_first and b.slot_out[i]:
                 self._record_first(st, now)
+        for uid, toks, reason in b.drain_poisoned():
+            self._finalize_poisoned(uid, toks, reason, now)
         for uid, toks in b.drain_finished():
             self._finalize(uid, toks, now)
 
@@ -380,6 +411,24 @@ class ServingEngine:
             t_finished=now, resumed=st.resumed,
         )
 
+    def _finalize_poisoned(self, uid: Any, toks: list, reason: str,
+                           now: float) -> None:
+        """Per-request poison quarantine (ISSUE 8): the batcher evicted
+        this request on a non-finite logit row — typed-reject it (the
+        result becomes a :class:`Poisoned`, never a Finished) and keep
+        serving everyone else. The poisoned request costs exactly one
+        slot eviction; survivors' streams are untouched."""
+        st = self._states.pop(uid)
+        self.metrics.count("poisoned")
+        if uid in self.results:
+            raise RuntimeError(
+                f"request {uid!r} finished twice — poison bookkeeping bug"
+            )
+        self.results[uid] = Poisoned(
+            uid=uid, tokens=st.tokens + list(toks), reason=reason,
+            t_enqueue=st.t_enqueue, t_poisoned=now, resumed=st.resumed,
+        )
+
     # -- elastic shrink / regrow ---------------------------------------
 
     def _on_step_timeout(self, exc: BaseException) -> None:
@@ -397,6 +446,23 @@ class ServingEngine:
             ) from exc
         self._rebuild("step timeout")
 
+    def _on_step_integrity(self, exc: BaseException) -> None:
+        # the corruption twin of _on_step_timeout: strike the PEs the
+        # integrity records name (note_integrity_exc — the extended
+        # note_timeout_exc convention), then rebuild + prefix-replay; a
+        # persistently corrupt PE accumulates strikes to quarantine and
+        # _target_mesh shrinks around it, exactly the straggler arc
+        elastic.note_integrity_exc(exc, family=self.family)
+        self.metrics.count("step_integrity")
+        self._failures += 1
+        if self._failures > self.serving.max_step_failures:
+            raise RuntimeError(
+                f"serving engine: {self._failures} consecutive corrupt "
+                f"steps without recovering — rebuild/replay cannot make "
+                f"progress (see resilience.health.snapshot())"
+            ) from exc
+        self._rebuild("step integrity failure")
+
     def _rebuild(self, reason: str) -> None:
         """Rebuild the batcher on the current target mesh and prefix-replay
         every in-flight request. The old step's donated cache is dead
@@ -405,7 +471,10 @@ class ServingEngine:
         re-materialization path; no generated token is lost."""
         old = self._batcher
         now = self.clock.monotonic()
-        # completed work survives first (the drain_finished contract)
+        # completed work survives first (the drain_finished contract);
+        # poisoned evictions are final too — they must not re-enter replay
+        for uid, toks, poison_reason in old.drain_poisoned():
+            self._finalize_poisoned(uid, toks, poison_reason, now)
         for uid, toks in old.drain_finished():
             self._finalize(uid, toks, now)
         active, queued = old.export_in_flight()

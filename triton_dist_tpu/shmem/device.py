@@ -253,17 +253,30 @@ class ChunkedPutHandle:
     the readiness flag granularity becomes the DMA granularity.
     """
 
-    __slots__ = ("chunks",)
+    __slots__ = ("chunks", "recv_at", "spans")
 
-    def __init__(self, chunks: "list[PutHandle]"):
+    def __init__(self, chunks: "list[PutHandle]", recv_at=None, spans=None):
         self.chunks = list(chunks)
+        # canary wiring (ISSUE 8): ``recv_at(off, rows)`` maps a span to
+        # the LOCAL view where the mirror peer's chunk lands — only the
+        # kernel knows it (the outbound dst slice is a different shard in
+        # ring protocols), so kernels that opt into payload integrity
+        # declare it via putmem_signal_chunked_nbi_block(recv_view=...)
+        self.recv_at = recv_at
+        self.spans = spans
 
     def __len__(self):
         return len(self.chunks)
 
+    def _recv_view(self, j: int):
+        if self.recv_at is None or self.spans is None:
+            return None
+        off, rows = self.spans[j]
+        return self.recv_at(off, rows)
+
     def wait_recv_chunk(self, j: int):
         """Chunk-aware arrival wait for chunk `j` (see :func:`wait_chunk`)."""
-        wait_chunk(self.chunks[j])
+        wait_chunk(self.chunks[j], recv_ref=self._recv_view(j))
 
     def wait_send_chunk(self, j: int):
         """Local completion of chunk `j`'s put: its source rows are
@@ -291,7 +304,7 @@ class ChunkedPutHandle:
 
 def putmem_signal_chunked_nbi_block(
     dst_at, src_at, pe, axis: str, send_at, recv_at, sig_at, spans,
-    ready=None,
+    ready=None, recv_view=None,
 ):
     """Chunked put + per-chunk signal (≙ one ``putmem_signal_nbi_block`` per
     sub-shard chunk, reference docs/primitives.md:40 — the producer side of
@@ -321,7 +334,24 @@ def putmem_signal_chunked_nbi_block(
     WITHOUT the watchdog must not add a droppable edge whose wait would
     then be unbounded (chunk-signal chaos requires ``timeout_iters > 0``,
     like every drop-fault scenario in tests/test_chaos.py).
+
+    ``recv_view(off, rows)``, if given, is the LOCAL view where the mirror
+    peer's chunk lands (ring kernels receive a *different* shard than they
+    send, so only the kernel can name it). Declaring it opts this put
+    family into payload integrity (ISSUE 8): with the canary armed
+    (``config.integrity.canary`` + the watchdog) each chunk's signal
+    increment becomes ``1 + payload_checksum(chunk)`` — the SAME signal
+    edge with a bigger increment, no new droppable edges, the chaos-pinned
+    discipline of the w8 scale DMAs — and ``wait_recv_chunk`` recomputes
+    the checksum over the landed view, recording a ``KIND_INTEGRITY``
+    diagnostic on mismatch; the landing view is also where the payload
+    fault kinds (bitflip / torn_chunk / stale_read / nan_inject) mutate
+    interpret-mode landings (resilience/faults.py).
     """
+    # the canary kwarg rides only when a landing view opted in (also
+    # keeps the kwarg invisible to callers/monkeypatches of the plain
+    # chunked protocol)
+    kw = {"canary": True} if recv_view is not None else {}
     handles = []
     for j, (off, rows) in enumerate(spans):
         if ready is not None:
@@ -330,14 +360,15 @@ def putmem_signal_chunked_nbi_block(
             putmem_signal2_nbi_block(
                 dst_at(off, rows), src_at(off, rows), pe, axis,
                 send_at(j), recv_at(j),
-                sig_at(j) if sig_at is not None else None,
+                sig_at(j) if sig_at is not None else None, **kw,
             )
         )
-    return ChunkedPutHandle(handles)
+    return ChunkedPutHandle(handles, recv_at=recv_view, spans=spans)
 
 
 def putmem_signal_chunked_a2a_nbi_block(
-    dst_at, src_at, peers, axis: str, send_at, recv_at, sig_at, spans
+    dst_at, src_at, peers, axis: str, send_at, recv_at, sig_at, spans,
+    recv_view=None,
 ):
     """Peer-direct chunked all-to-all put (≙ the per-peer
     ``putmem_signal_nbi_block`` loop of the reference's LL dispatch,
@@ -368,7 +399,12 @@ def putmem_signal_chunked_a2a_nbi_block(
     SPMD symmetry handle ``i``'s recv side observes the equal-shaped
     incoming chunks from the mirror peer, so receivers consume per-peer
     payloads chunk by chunk through ``wait_recv_chunk``.
+
+    ``recv_view(i, off, rows)``, if given, names the LOCAL view where the
+    chunk incoming from peer ``i`` lands — the payload-integrity opt-in of
+    :func:`putmem_signal_chunked_nbi_block`, per peer.
     """
+    kw = {"canary": True} if recv_view is not None else {}
     handles: list[list[PutHandle]] = [[] for _ in peers]
     for j, (off, rows) in enumerate(spans):
         for i, pe in enumerate(peers):
@@ -376,14 +412,25 @@ def putmem_signal_chunked_a2a_nbi_block(
                 putmem_signal2_nbi_block(
                     dst_at(i, off, rows), src_at(i, off, rows), pe, axis,
                     send_at(i, j), recv_at(i, j),
-                    sig_at(i, j) if sig_at is not None else None,
+                    sig_at(i, j) if sig_at is not None else None, **kw,
                 )
             )
-    return [ChunkedPutHandle(hs) for hs in handles]
+    return [
+        ChunkedPutHandle(
+            hs,
+            recv_at=(
+                None if recv_view is None
+                else (lambda off, rows, i=i: recv_view(i, off, rows))
+            ),
+            spans=spans,
+        )
+        for i, hs in enumerate(handles)
+    ]
 
 
 def putmem_signal2_nbi_block(
-    dst_ref, src_ref, pe, axis: str, send_sem, recv_sem, sig_sem=None
+    dst_ref, src_ref, pe, axis: str, send_sem, recv_sem, sig_sem=None,
+    canary: bool = False,
 ):
     """Single-chunk building block of the chunked put family: a
     ``putmem_nbi_block`` that, inside an armed WATCHDOG scope, also issues
@@ -391,13 +438,28 @@ def putmem_signal2_nbi_block(
     :func:`wait_chunk` consumes; never issued without the watchdog — see
     :func:`putmem_signal_chunked_nbi_block`). Fused kernels that interleave
     compute between chunk puts call this directly and aggregate the
-    handles in a :class:`ChunkedPutHandle`."""
-    from triton_dist_tpu.resilience import watchdog as _watchdog
+    handles in a :class:`ChunkedPutHandle`.
 
+    ``canary=True`` (set by the chunked put families when the kernel
+    declared a ``recv_view``) folds the payload checksum into the chunk
+    signal when the integrity canary is armed: the increment becomes
+    ``1 + payload_checksum(src)`` on the SAME signal edge —
+    :func:`wait_chunk` consumes the arrival unit, reads the residual
+    checksum, and drains it after comparing against the landed data.
+    Producer and consumer agreement is trace-time (both gate on
+    :func:`chunk_canary_armed`), so no credit can leak across launches."""
     h = putmem_nbi_block(dst_ref, src_ref, pe, axis, send_sem, recv_sem)
     if sig_sem is not None and chunk_signals_armed():
         h.sig_sem = sig_sem
-        signal_op(sig_sem, 1, pe, axis)
+        inc = 1
+        if canary and chunk_canary_armed():
+            from triton_dist_tpu.resilience import integrity as _integrity
+
+            # checksum over the SOURCE payload (clean by construction:
+            # payload faults model landing-site corruption, faults.py), so
+            # a corrupted landing disagrees with this increment
+            inc = 1 + _integrity.payload_checksum(src_ref[...])
+        signal_op(sig_sem, inc, pe, axis)
     return h
 
 
@@ -411,7 +473,19 @@ def chunk_signals_armed() -> bool:
     return _watchdog.active() is not None and _watchdog.enabled()
 
 
-def wait_chunk(handle: "PutHandle"):
+def chunk_canary_armed() -> bool:
+    """Whether chunk signals carry payload checksums in this trace: the
+    integrity canary (``config.integrity.canary``) on top of an armed
+    watchdog scope (the canary rides the watchdog's signal slots and diag
+    buffer — without the watchdog it is silently inert, exactly like the
+    chunk signals themselves). Trace-time, so the producer's increment and
+    the consumer's drain agree by construction."""
+    from triton_dist_tpu.resilience import integrity as _integrity
+
+    return chunk_signals_armed() and _integrity.canary_enabled()
+
+
+def wait_chunk(handle: "PutHandle", recv_ref=None):
     """Chunk-aware arrival wait (≙ the reference's per-tile ``dl.wait`` +
     ``dl.consume_token``, allgather_gemm.py:226-227): block until this
     chunk's data has landed on this PE.
@@ -423,12 +497,65 @@ def wait_chunk(handle: "PutHandle"):
     then the data-coupled recv semaphore is waited, which is authoritative:
     data puts cannot be dropped (faults.py), so a lost/duped chunk *signal*
     either trips the watchdog with a chunk-site record or leaves the result
-    untouched, never corrupts it."""
+    untouched, never corrupts it.
+
+    ``recv_ref`` (the LOCAL landed-chunk view, from the kernel's
+    ``recv_view`` declaration) adds the payload tier (ISSUE 8), in order:
+
+    1. an armed PAYLOAD fault plan mutates the landing here — after the
+       data wait, modeling a PE whose memory corrupts what lands in it
+       (``faults.apply_payload_fault``; interpret-mode only, like all
+       injection);
+    2. with the canary armed, the signal's residual credits are the
+       producer's payload checksum: recompute over the landed view,
+       record a ``KIND_INTEGRITY`` diagnostic on mismatch (first record
+       wins, named PE = this PE = the corrupt one), and DRAIN the
+       residual either way so the slot carries no credit into the next
+       launch.
+
+    Composition limit (by design of "no new signal edges"): the canary
+    RIDES the chunk signal, so a MISCOUNTED chunk signal (``dup_signal``
+    chaos, a real protocol bug) under an armed canary reads as a
+    checksum mismatch on the receiving PE even when the landed bytes are
+    perfect — signal-layer anomalies alias into the payload tier on the
+    shared edge, and the in-kernel observer cannot tell them apart (the
+    residual IS its only reference). The signal-kind chaos cells
+    therefore pin the canary-off posture; treat an integrity record
+    under signal chaos as "the chunk protocol was violated", not as
+    proof of data rot."""
+    from triton_dist_tpu.resilience import faults as _faults
     from triton_dist_tpu.resilience import records as _records
+    from triton_dist_tpu.resilience import watchdog as _watchdog
 
     if handle.sig_sem is not None:
         _wait_or_watchdog(handle.sig_sem, 1, _records.KIND_CHUNK)
     handle.wait_recv()
+    if recv_ref is None:
+        return
+    scope = _watchdog.active()
+    if scope is None:
+        return
+    # ONE payload-site ordinal per consumed chunk, shared by the fault
+    # injector and the canary record — FaultPlan.site targets exactly the
+    # ordinal the diagnostic will name, and arming the canary never
+    # shifts the wait-site numbering of the timeout records
+    site = scope.next_payload_site()
+    _faults.apply_payload_fault(recv_ref, scope.pe, site=site)
+    if handle.sig_sem is not None and chunk_canary_armed():
+        from triton_dist_tpu.resilience import integrity as _integrity
+
+        sent = signal_read(handle.sig_sem)          # producer's checksum
+        local = _integrity.payload_checksum(recv_ref[...])
+        _watchdog.record_integrity_mismatch(
+            sent, local, jnp.not_equal(sent, local), site
+        )
+
+        @pl.when(sent > 0)
+        def _drain():
+            # consume the residual credits whatever the verdict — a
+            # mismatch must not leave the slot pre-satisfied for the next
+            # launch (the bounded-wait drain discipline)
+            pltpu.semaphore_wait(handle.sig_sem, sent)
 
 
 def getmem_nbi_block(*_args, **_kwargs):
