@@ -156,6 +156,38 @@ def _append_health_json(path, name, snap):
               flush=True)
 
 
+def _maybe_arm_obs():
+    """Arm the observability layer (ISSUE 9) when ``--obs-trace`` asked
+    for an artifact: spans + device wait telemetry (the telemetry tier
+    additionally needs an armed watchdog — arm ``TDT_TIMEOUT_ITERS`` for
+    spin histograms; spans and the merged artifact work either way)."""
+    if not os.environ.get("TDT_BENCH_OBS_TRACE"):
+        return
+    from triton_dist_tpu import config as tdt_config
+    from triton_dist_tpu import obs
+
+    tdt_config.update(obs=obs.ObsConfig(wait_stats=True))
+
+
+def _maybe_export_obs(name):
+    """Merge this metric's spans + wait-spin histograms into the shared
+    ``--obs-trace`` artifact (each metric runs in its own subprocess;
+    sequential, so read-merge-write cannot race — the _append_health_json
+    discipline)."""
+    path = os.environ.get("TDT_BENCH_OBS_TRACE")
+    if not path:
+        return
+    from triton_dist_tpu import obs
+
+    try:
+        obs.export_chrome_trace(path, merge=True, label=name)
+    except OSError as e:
+        import sys
+
+        print(f"bench: --obs-trace write failed: {e}", file=sys.stderr,
+              flush=True)
+
+
 def bench_gemm_rs(mesh, n):
     """Row-parallel down-proj shape: A [M, K_ffn/n], B [K_ffn/n, N=hidden]."""
     from triton_dist_tpu.ops.gemm_reduce_scatter import gemm_rs_op
@@ -910,6 +942,24 @@ def _run_serving(argv) -> None:
     from triton_dist_tpu.serving import SLOTargets
     from triton_dist_tpu.serving import bench as sbench
 
+    # --obs-trace rides in bench_serving mode too (runs in-process here)
+    argv = list(argv)
+    obs_path = None
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--obs-trace":
+            if i + 1 >= len(argv):
+                raise SystemExit(
+                    "bench: --obs-trace needs a path (e.g. "
+                    "--obs-trace BENCH_obs_trace.json)"
+                )
+            obs_path = os.path.abspath(argv[i + 1])
+            del argv[i:i + 2]
+        elif argv[i].startswith("--obs-trace="):
+            obs_path = os.path.abspath(argv[i].split("=", 1)[1])
+            del argv[i]
+        else:
+            i += 1
     rates = tuple(float(a) for a in argv) or (2.0, 5.0, 10.0, 20.0)
     if os.environ.get("TDT_BENCH_SERVING_TPU") != "1":
         # host tier by default: the curve is about SCHEDULING, not device
@@ -927,6 +977,14 @@ def _run_serving(argv) -> None:
         head_dim=8, batch=4, seq=8,
         ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
     )
+    from triton_dist_tpu import config as tdt_config
+    from triton_dist_tpu import obs
+
+    # span tracing on for the sweep: the λ rows then carry the per-phase
+    # (queued/prefill/decode) p50/p99 breakdown next to the end-to-end
+    # percentiles (ISSUE 9 satellite). FakeClock-driven, so the emitted
+    # lines stay byte-identical across invocations as before.
+    tdt_config.update(obs=obs.ObsConfig())
     params = init_params(jax.random.PRNGKey(0), cfg)
     rows = sbench.sweep_offered_load(
         cfg, params, mesh, s_max=32, rates=rates, n_requests=32,
@@ -936,6 +994,8 @@ def _run_serving(argv) -> None:
     )
     for name, value, unit in sbench.info_lines(rows):
         emit_info(name, value, unit)
+    if obs_path is not None:
+        obs.export_chrome_trace(obs_path, label="bench_serving")
 
 
 def _wait_for_backend(budget_s: float | None = None) -> int | None:
@@ -1054,9 +1114,11 @@ def _run_one(name: str) -> None:
     # across metrics, and pinned families serve golden silently (no fresh
     # counter), so the snapshot below must still name them
     health.reset(keep_short_circuit=True)
+    _maybe_arm_obs()
     try:
         _METRICS[name](mesh, n)
     finally:
+        _maybe_export_obs(name)
         # resilience surface (docs/resilience.md): a metric that quietly
         # served golden XLA fallbacks is CORRECT but not evidence about
         # the fused kernels — say so next to the numbers. The same goes
@@ -1142,15 +1204,30 @@ def main() -> None:
             os.environ["TDT_BENCH_HEALTH_JSON"] = os.path.abspath(
                 arg.split("=", 1)[1]
             )
+        elif arg == "--obs-trace":
+            if i + 1 >= len(sys.argv):
+                raise SystemExit(
+                    "bench: --obs-trace needs a path (e.g. "
+                    "--obs-trace BENCH_obs_trace.json)"
+                )
+            os.environ["TDT_BENCH_OBS_TRACE"] = os.path.abspath(
+                sys.argv[i + 1]
+            )
+        elif arg.startswith("--obs-trace="):
+            os.environ["TDT_BENCH_OBS_TRACE"] = os.path.abspath(
+                arg.split("=", 1)[1]
+            )
     if world is not None:
         os.environ["TDT_BENCH_WORLD"] = str(world)
-    if os.environ.get("TDT_BENCH_HEALTH_JSON"):
-        # fresh artifact per driver run: each metric subprocess merges its
-        # own end-of-run snapshot in (metrics run sequentially)
-        try:
-            os.remove(os.environ["TDT_BENCH_HEALTH_JSON"])
-        except FileNotFoundError:
-            pass
+    for env_key in ("TDT_BENCH_HEALTH_JSON", "TDT_BENCH_OBS_TRACE"):
+        if os.environ.get(env_key):
+            # fresh artifact per driver run: each metric subprocess merges
+            # its own end-of-run snapshot/events in (metrics run
+            # sequentially)
+            try:
+                os.remove(os.environ[env_key])
+            except FileNotFoundError:
+                pass
 
     count = _wait_for_backend()
     if world is not None and (count is None or count < world):

@@ -38,6 +38,14 @@
 # integrity cells run everywhere; live payload injection is
 # interpreter-gated like every other injection cell.
 #
+# Since ISSUE 9 the matrix also covers the OBSERVABILITY cells
+# (tests/test_obs.py): an armed obs layer (spans + device wait
+# telemetry) must be observation-only — clean armed runs bit-exact to
+# disarmed ones, chaos under an armed obs layer names only pre-existing
+# diagnostic kinds, and the interpreter-gated straggler cell proves
+# end-to-end attribution (an injected straggler shifts the victim wait
+# site's spin histogram on the chunked ring pipeline).
+#
 # Per-cell failures propagate into the exit code (CI gates on it), and a
 # pass/fail summary table is printed after the run.
 #
@@ -55,7 +63,8 @@ trap 'rm -f "$log"' EXIT
 
 files="tests/test_chaos.py tests/test_elastic.py \
     tests/test_chunked.py tests/test_chunked_a2a.py tests/test_ragged.py \
-    tests/test_emitter.py tests/test_serving.py tests/test_integrity.py"
+    tests/test_emitter.py tests/test_serving.py tests/test_integrity.py \
+    tests/test_obs.py"
 marker="chaos"
 if [ "${1:-}" = "--quick" ]; then
     shift

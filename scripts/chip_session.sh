@@ -38,6 +38,22 @@ timeout 1800 python bench.py > "docs/chip_logs/${stamp}_bench_driver_mode.log" 2
 driver_rc=$?
 echo "driver rc=$driver_rc" >> "docs/chip_logs/${stamp}_bench_driver_mode.log"
 
+echo "=== [2b] observability capture (ISSUE 9): span + wait-telemetry trace"
+# A SEPARATE instrumented pass so the observation cost (armed watchdog
+# diag outputs + spin telemetry) can never contaminate the driver-mode
+# numbers above; its timings are not evidence — the artifact is: the
+# per-(family, site, kind) spin histograms are the instrument the
+# moe_w8_decode_gemm stall / roofline question needs (ROADMAP 1). A
+# compiled poll iteration is tens of ns, so the 2e6 budget ≈ tens of ms.
+TDT_TIMEOUT_ITERS="${TDT_OBS_TIMEOUT_ITERS:-2000000}" timeout 1800 python bench.py \
+  --obs-trace "docs/chip_logs/${stamp}_obs_trace.json" \
+  > "docs/chip_logs/${stamp}_bench_obs.log" 2>&1
+obs_rc=$?
+echo "obs rc=$obs_rc" >> "docs/chip_logs/${stamp}_bench_obs.log"
+# paste-ready top wait-site / slowest-span tables for the chip log
+python scripts/trace_summary.py "docs/chip_logs/${stamp}_obs_trace.json" -n 15 \
+  >> "docs/chip_logs/${stamp}_bench_obs.log" 2>&1 || true
+
 echo "=== [3/7] smoke stress"
 timeout 3600 python scripts/tpu_smoke.py > "docs/chip_logs/${stamp}_smoke.log" 2>&1
 smoke_rc=$?
@@ -84,5 +100,7 @@ timeout 1800 bash scripts/native_serving_bench.sh > "docs/chip_logs/${stamp}_nat
 native_rc=$?
 echo "native serving rc=$native_rc" >> "docs/chip_logs/${stamp}_native_serving.log"
 
-echo "rc: tuned=$tuned_rc driver=$driver_rc smoke=$smoke_rc world8=$world_rc pjrt=$pjrt_rc serving=$serving_rc native=$native_rc"
+# obs_rc is reported but deliberately NOT in the exit aggregation: the
+# observability capture is a best-effort instrument, never a gate
+echo "rc: tuned=$tuned_rc driver=$driver_rc obs=$obs_rc smoke=$smoke_rc world8=$world_rc pjrt=$pjrt_rc serving=$serving_rc native=$native_rc"
 exit $(( tuned_rc || driver_rc || smoke_rc || world_rc || pjrt_rc || serving_rc || native_rc ))

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Summarize an obs chrome trace for chip logs (ISSUE 9 satellite).
+
+Reads the artifact ``bench.py --obs-trace PATH`` / ``obs.export_chrome_trace``
+writes (a Perfetto-loadable chrome trace whose span events carry op-entry
+ladder rungs and whose instant events on the ``device wait telemetry``
+process carry per-(family, site, kind) spin histograms) and prints two
+tables a chip session pastes straight into its log:
+
+- top-N wait sites by total observed spin count (where the fused
+  pipelines actually stall on the success path), and
+- top-N slowest spans (which op entries / serving phases cost the time),
+  with their ladder rung when recorded.
+
+Dependency-free stdlib CLI::
+
+    python scripts/trace_summary.py docs/chip_logs/obs_trace.json [-n 15]
+    python bench.py --obs-trace /tmp/obs.json && \\
+        python scripts/trace_summary.py /tmp/obs.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_events(path: str) -> list[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        events = doc.get("traceEvents")
+    else:
+        events = doc  # bare-array chrome traces are legal too
+    if not isinstance(events, list):
+        raise SystemExit(
+            f"trace_summary: {path!r} has no traceEvents list — not a "
+            f"chrome trace?"
+        )
+    return [e for e in events if isinstance(e, dict)]
+
+
+def wait_rows(events: list[dict]) -> list[dict]:
+    rows = []
+    for e in events:
+        args = e.get("args") or {}
+        if e.get("cat") == "wait_telemetry" and "total_spins" in args:
+            rows.append({
+                "name": e.get("name", "?"),
+                "calls": args.get("calls", 0),
+                "total_spins": args.get("total_spins", 0),
+                "max_spins": args.get("max_spins", 0),
+                "mean_spins": args.get("mean_spins", 0),
+                "label": args.get("label", ""),
+            })
+    rows.sort(key=lambda r: (-r["total_spins"], r["name"]))
+    return rows
+
+
+def span_rows(events: list[dict]) -> list[dict]:
+    rows = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        rows.append({
+            "name": e.get("name", "?"),
+            "dur_ms": float(e.get("dur", 0.0)) / 1e3,
+            "rung": args.get("rung", ""),
+            "label": args.get("label", ""),
+        })
+    rows.sort(key=lambda r: (-r["dur_ms"], r["name"]))
+    return rows
+
+
+def _table(rows: list[dict], cols: list[tuple[str, str]], n: int) -> str:
+    if not rows:
+        return "  (none)"
+    widths = {
+        key: max(len(title), *(len(str(r[key])) for r in rows[:n]))
+        for key, title in cols
+    }
+    head = "  " + "  ".join(t.ljust(widths[k]) for k, t in cols)
+    sep = "  " + "  ".join("-" * widths[k] for k, _ in cols)
+    body = [
+        "  " + "  ".join(str(r[k]).ljust(widths[k]) for k, _ in cols)
+        for r in rows[:n]
+    ]
+    return "\n".join([head, sep, *body])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="obs chrome-trace JSON path")
+    ap.add_argument("-n", type=int, default=10, help="rows per table")
+    args = ap.parse_args(argv)
+
+    events = load_events(args.trace)
+    waits = wait_rows(events)
+    spans = span_rows(events)
+
+    print(f"== top {args.n} wait sites by total spins "
+          f"({len(waits)} site(s) recorded) ==")
+    print(_table(waits, [
+        ("name", "wait site"), ("calls", "calls"),
+        ("total_spins", "total_spins"), ("mean_spins", "mean_spins"),
+        ("max_spins", "max_spins"), ("label", "label"),
+    ], args.n))
+    print()
+    print(f"== top {args.n} slowest spans ({len(spans)} span(s)) ==")
+    print(_table(spans, [
+        ("name", "span"), ("dur_ms", "dur_ms"), ("rung", "rung"),
+        ("label", "label"),
+    ], args.n))
+    overflow = [e for e in events
+                if "overflow_sites" in (e.get("args") or {})]
+    if overflow:
+        print()
+        print("!! telemetry window overflow (waits past the per-kernel "
+              "slot window — raise obs.telemetry.TELEM_SLOTS to see them):")
+        for e in overflow:
+            print(f"  {e.get('name')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

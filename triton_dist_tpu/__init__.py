@@ -20,6 +20,8 @@ Layer map (mirrors SURVEY.md §1 of the reference):
   layers/   — L7:   module-level wrappers
   models/   —       flagship TP/SP/EP transformer models (beyond reference)
   serving/  —       SLO-metered elastic serving engine over the batcher
+  obs/      —       observability: host span tracing + device wait
+                    telemetry, exported as one chrome-trace timeline
   parallel/ —       mesh/bootstrap/topology (≙ reference utils.py bootstrap)
   autotuner —  L8, profiler/aot — aux subsystems
 """
@@ -27,6 +29,7 @@ Layer map (mirrors SURVEY.md §1 of the reference):
 __version__ = "0.1.0"
 
 from triton_dist_tpu import config as config
+from triton_dist_tpu import obs as obs
 from triton_dist_tpu import resilience as resilience
 from triton_dist_tpu.parallel.mesh import (
     initialize_distributed,

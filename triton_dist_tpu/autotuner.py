@@ -245,6 +245,15 @@ def contextual_autotune(
                         )
                         continue
                     _memory_cache[mem_key] = cfg
+                    # obs (ISSUE 9): the sweep-free walks crown a config
+                    # too — record it so a timeline reader can tell an
+                    # untimed policy pick from a measured sweep winner
+                    from triton_dist_tpu import obs as _obs
+
+                    _obs.instant(
+                        f"autotune:{op_name}", cat="autotune",
+                        policy=reason, crowned=repr(cfg),
+                    )
                     return out
                 raise RuntimeError(
                     f"autotune({op_name}): every candidate config failed "
@@ -271,6 +280,10 @@ def contextual_autotune(
                 # interpreter timings are noise
                 return _first_viable("interpreter")
 
+            from triton_dist_tpu import obs as _obs
+            from triton_dist_tpu.resilience import retry as _retry
+
+            sweep_t0 = _retry.get_clock().monotonic()
             times = [float("inf")] * len(configs)
             seen: dict[Any, int] = {}
             for i, cfg in enumerate(configs):
@@ -378,6 +391,17 @@ def contextual_autotune(
                     f"[autotune {op_name}] {key} -> {configs[best_i]} "
                     f"({t_str}; all={['%.3f' % t for t in times]})"
                 )
+            # obs (ISSUE 9): the candidate sweep + crowned config as one
+            # span — who was timed, what won, and what the sweep cost
+            _obs.record_span(
+                f"autotune:{op_name}", sweep_t0,
+                _retry.get_clock().monotonic(), cat="autotune",
+                track="autotune", n_candidates=len(configs),
+                n_timed=sum(1 for t in times if t != float("inf")),
+                crowned=repr(configs[best_i]),
+                best_ms=(round(best_t, 6) if math.isfinite(best_t)
+                         else "inf"),
+            )
             _memory_cache[mem_key] = configs[best_i]
             disk[key] = {"i": best_i, "cfg": repr(configs[best_i])}
             _store_disk_cache(op_name, disk)

@@ -116,6 +116,17 @@ class Config:
     # recovery ladder (retry → golden fallback → PE strikes). None
     # (default) = no checks, zero added work anywhere.
     integrity: object = None
+    # --- observability layer (ISSUE 9, docs/observability.md) ----------
+    # Armed obs.ObsConfig: host-side span tracing (guarded op entries
+    # with their ladder rung, jit trace-vs-cached dispatch, autotune
+    # sweeps, serving lifecycle) on the injectable resilience clock, and
+    # — with wait_stats=True on top of an armed watchdog — a per-kernel
+    # wait-telemetry buffer recording every bounded wait site's observed
+    # spin count (success path included; rides the diag-output plumbing,
+    # NO new signal edges). Exported via obs.export_chrome_trace() /
+    # obs.snapshot() / bench.py --obs-trace. None (default) = no spans,
+    # zero new kernel outputs, bit-exact op results.
+    obs: object = None
 
 
 _config = Config()
@@ -148,6 +159,15 @@ def update(**kwargs: Any) -> None:
                 raise ValueError(
                     f"integrity must be a resilience.IntegrityConfig (or "
                     f"None), got {type(v).__name__}"
+                )
+            v.validate()
+        if k == "obs" and v is not None:
+            from triton_dist_tpu.obs import ObsConfig
+
+            if not isinstance(v, ObsConfig):
+                raise ValueError(
+                    f"obs must be an obs.ObsConfig (or None), got "
+                    f"{type(v).__name__}"
                 )
             v.validate()
         if k == "retry_policy" and v is not None:

@@ -1,0 +1,134 @@
+"""Unified observability layer (ISSUE 9): host span tracing + device
+wait telemetry, exported as one timeline.
+
+Three pieces (docs/observability.md for the full contract):
+
+- :mod:`tracer` — a host-side structured span tracer on the injectable
+  resilience clock: nested spans around every guarded op entry (recording
+  which ladder rung actually ran — fused / retry / golden fallback /
+  integrity), ``jit_shard_map`` dispatch (trace vs cached call), autotune
+  sweeps (candidates + crowned config), and the serving engine's
+  per-request lifecycle. Ring-buffered and dependency-free like
+  ``resilience/health.py``; a FakeClock makes exports byte-identical.
+- :mod:`telemetry` — the device tier: with
+  ``config.update(obs=ObsConfig(wait_stats=True))`` on top of an armed
+  watchdog, every bounded wait site writes its observed spin count into a
+  per-kernel telemetry buffer riding the existing diag-output plumbing
+  (``ops/common.dist_pallas_call``) — success-path wait-cost attribution
+  with NO new signal edges, decoded host-side into per-(family, site,
+  kind) spin histograms.
+- :mod:`export` — ``export_chrome_trace()`` (a Perfetto-loadable JSON
+  that drops into the same ``group_profile`` run dir as the XProf
+  planes) and ``snapshot()`` (span stats + wait telemetry +
+  ``resilience.health`` + live serving-engine metrics in one dict).
+
+Disarmed (``config.obs is None``, the default): zero new kernel outputs,
+every op result bit-exact, and each host call site pays one attribute
+read. Armed: observation-only — clean armed runs stay bit-exact
+(chaos-pinned in tests/test_obs.py, the PR 8 canary discipline).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from triton_dist_tpu.obs import export as export
+from triton_dist_tpu.obs import telemetry as telemetry
+from triton_dist_tpu.obs import tracer as tracer
+from triton_dist_tpu.obs.export import (
+    chrome_events,
+    export_chrome_trace,
+    maybe_export_into,
+    register_serving_engine,
+    snapshot,
+)
+from triton_dist_tpu.obs.tracer import (
+    NULL_SPAN,
+    annotate,
+    annotate_span,
+    dropped_spans,
+    instant,
+    record_span,
+    span,
+    span_enabled,
+    span_stats,
+    spans,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Arm via ``config.update(obs=ObsConfig(...))``.
+
+    spans:      host-side span tracing (guarded op entries, jit dispatch,
+                autotune sweeps, serving lifecycle). Host-only — never
+                changes a traced program.
+    wait_stats: device wait telemetry. Needs the armed watchdog
+                (``config.timeout_iters > 0`` — the bounded waits are
+                where a spin count exists); silently inert without it,
+                exactly like the chunk signals themselves. Adds one
+                ``int32[telemetry.TELEM_LEN]`` SMEM output per kernel and
+                ~a dozen scalar SMEM ops per wait — a diagnostic posture,
+                not a fast path (see docs/observability.md "Overhead").
+    max_spans:  span ring-buffer bound; evictions are counted and
+                surfaced as ``dropped_spans`` (streaming per-name stats
+                are unaffected — no silent caps).
+    """
+
+    spans: bool = True
+    wait_stats: bool = False
+    max_spans: int = 4096
+
+    def validate(self) -> "ObsConfig":
+        if self.max_spans < 1:
+            raise ValueError(
+                f"ObsConfig.max_spans must be >= 1, got {self.max_spans}"
+            )
+        return self
+
+
+def get_obs_config() -> "ObsConfig | None":
+    from triton_dist_tpu import config as tdt_config
+
+    return tdt_config.get_config().obs
+
+
+def wait_stats_enabled() -> bool:
+    """Whether the device wait-telemetry tier is requested (the kernel
+    side additionally requires the armed watchdog — ``ops/common``
+    checks both)."""
+    cfg = get_obs_config()
+    return cfg is not None and cfg.wait_stats
+
+
+def reset() -> None:
+    """Clear spans AND the wait-telemetry aggregation (per-test / per-λ
+    isolation; config stays untouched)."""
+    tracer.reset()
+    telemetry.reset()
+
+
+__all__ = [
+    "ObsConfig",
+    "NULL_SPAN",
+    "annotate",
+    "annotate_span",
+    "chrome_events",
+    "dropped_spans",
+    "export",
+    "export_chrome_trace",
+    "get_obs_config",
+    "instant",
+    "maybe_export_into",
+    "record_span",
+    "register_serving_engine",
+    "reset",
+    "snapshot",
+    "span",
+    "span_enabled",
+    "span_stats",
+    "spans",
+    "telemetry",
+    "tracer",
+    "wait_stats_enabled",
+]

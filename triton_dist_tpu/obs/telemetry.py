@@ -1,0 +1,192 @@
+"""Device-side wait telemetry: per-site spin-count records riding the
+watchdog's diag-output plumbing (ISSUE 9, the kernel half of the obs
+layer).
+
+The watchdog's diagnostic buffer records *failures only* (first record
+wins, ``resilience/records.py``); the question a chip session actually
+asks — "where does the fused pipeline spend its wait time when it
+SUCCEEDS?" — has no surface. NCCL-era GPU stacks answer it with
+watchdog-thread timelines; on TPU the host cannot observe device
+semaphores mid-program, so the kernel itself must report.
+
+Mechanism (mirrors the diag buffer, ``ops/common.dist_pallas_call``):
+when ``config.obs.wait_stats`` is set AND the watchdog is armed
+(``config.timeout_iters > 0`` — the bounded waits are where the spin
+count exists at all), every barrier-bearing kernel gains ONE extra
+``int32[TELEM_LEN]`` SMEM output. Each bounded wait site
+(``signal_wait_until`` / ``wait`` / ``wait_chunk`` signals / barrier
+rounds — everything that funnels through ``watchdog.bounded_wait``)
+writes its observed spin count into its trace-time slot: kind, call
+count, total/max spins, and a log4-binned spin histogram. NO new signal
+edges, no protocol changes — pure observation on the success path;
+disarmed, the kernel program is byte-identical to before this module
+existed.
+
+Buffer layout (int32 slots)::
+
+    [H_FAMILY]   records.family_code_for(kernel name)  (written at init)
+    [H_PE]       this PE's index along the comm axis   (written per wait)
+    [H_OVERFLOW] waits whose site >= TELEM_SLOTS       (never silently
+                 capped: the decode surfaces the overflow count loudly)
+    then TELEM_SLOTS records of TELEM_FIELDS each:
+    [T_KIND]  records.KIND_* of the wait at this site
+    [T_CALLS] executions of this site (grid kernels run a site per step)
+    [T_TOTAL] total poll iterations across those executions
+    [T_MAX]   worst single execution
+    [T_BINS.. +TELEM_BINS] log4 spin histogram: bin b counts executions
+              with spins in [4^(b-1), 4^b) (bin 0 = zero spins — the
+              signal had already landed; the last bin is open-ended)
+
+Site ordinals are the SAME trace-time wait-site numbering the diag
+records use (``KernelDiagScope.next_wait_site``), so a timeout record's
+``site`` field and a spin histogram's site key name the same wait.
+
+Host side, ``jit_shard_map`` decodes the gathered
+``[n_rows, TELEM_LEN]`` buffers (:func:`decode_telem`) and folds them
+into the process-wide per-``(family, site, kind)`` aggregation here
+(:func:`record_decoded` / :func:`wait_summary`) — the table
+``obs.export_chrome_trace`` and ``scripts/trace_summary.py`` render.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# --- buffer layout (int32 slots) -------------------------------------------
+
+TELEM_SLOTS = 32    # trace-time wait sites recorded per kernel launch
+TELEM_BINS = 8      # log4 spin-histogram bins per site
+TELEM_FIELDS = 4 + TELEM_BINS
+
+H_FAMILY = 0
+H_PE = 1
+H_OVERFLOW = 2
+TELEM_HEADER = 3
+
+T_KIND = 0
+T_CALLS = 1
+T_TOTAL = 2
+T_MAX = 3
+T_BINS = 4
+
+TELEM_LEN = TELEM_HEADER + TELEM_SLOTS * TELEM_FIELDS
+
+# log4 bin edges: bin b counts spins in [BIN_EDGES[b], BIN_EDGES[b+1]) —
+# (0, 1, 4, 16, 64, 256, 1024, 4096, inf): bin 0 is the zero-spin fast
+# path, the last bin is open-ended. Must match spin_bin below (pinned in
+# tests/test_obs.py) — these edges ship verbatim into every export.
+BIN_EDGES = (0,) + tuple(4**k for k in range(TELEM_BINS - 1)) + (
+    float("inf"),
+)
+
+
+def spin_bin(spins: int) -> int:
+    """Host-side twin of the in-kernel bin select (unit-test anchor)."""
+    b = 0
+    for k in range(TELEM_BINS - 1):
+        if spins >= 4**k:
+            b += 1
+    return b
+
+
+def decode_telem(arr) -> list[dict]:
+    """Decode a host-side ``[n_rows, TELEM_LEN]`` telemetry array (one row
+    per kernel launch per PE, gathered through shard_map) into per-launch
+    dicts. Rows whose family code is 0 are padding (an armed trace with no
+    dist_pallas_call launches) and are skipped."""
+    import numpy as np
+
+    from triton_dist_tpu.resilience import records as R
+
+    out = []
+    for row in np.asarray(arr).reshape(-1, TELEM_LEN):
+        fam = int(row[H_FAMILY])
+        if fam == 0:
+            continue
+        sites = []
+        for s in range(TELEM_SLOTS):
+            base = TELEM_HEADER + s * TELEM_FIELDS
+            calls = int(row[base + T_CALLS])
+            if calls == 0:
+                continue
+            sites.append({
+                "site": s,
+                "kind": R.kind_name(int(row[base + T_KIND])),
+                "calls": calls,
+                "total_spins": int(row[base + T_TOTAL]),
+                "max_spins": int(row[base + T_MAX]),
+                "bins": [int(row[base + T_BINS + b])
+                         for b in range(TELEM_BINS)],
+            })
+        out.append({
+            "family": R.family_name_for(fam),
+            "pe": int(row[H_PE]),
+            "overflow_sites": int(row[H_OVERFLOW]),
+            "sites": sites,
+        })
+    return out
+
+
+# --- process-wide aggregation ----------------------------------------------
+
+_lock = threading.Lock()
+# (family, site, kind) -> {"calls", "total_spins", "max_spins", "bins"}
+_agg: dict = {}
+_overflow: dict = {}   # family -> waits past TELEM_SLOTS (no silent caps)
+_launches = 0
+
+
+def record_decoded(decoded: list[dict]) -> None:
+    """Fold :func:`decode_telem` output into the process-wide registry."""
+    global _launches
+    with _lock:
+        for row in decoded:
+            _launches += 1
+            fam = row["family"]
+            if row["overflow_sites"]:
+                _overflow[fam] = _overflow.get(fam, 0) + row["overflow_sites"]
+            for s in row["sites"]:
+                key = (fam, s["site"], s["kind"])
+                cur = _agg.get(key)
+                if cur is None:
+                    cur = _agg[key] = {
+                        "calls": 0, "total_spins": 0, "max_spins": 0,
+                        "bins": [0] * TELEM_BINS,
+                    }
+                cur["calls"] += s["calls"]
+                cur["total_spins"] += s["total_spins"]
+                cur["max_spins"] = max(cur["max_spins"], s["max_spins"])
+                for b in range(TELEM_BINS):
+                    cur["bins"][b] += s["bins"][b]
+
+
+def wait_summary() -> dict:
+    """JSON-able per-(family, site, kind) spin stats, deterministically
+    ordered. ``overflow_sites`` reports waits that fell past the
+    TELEM_SLOTS window — counted, never silently dropped."""
+    with _lock:
+        sites = [
+            {
+                "family": fam, "site": site, "kind": kind,
+                "calls": v["calls"], "total_spins": v["total_spins"],
+                "max_spins": v["max_spins"],
+                "mean_spins": round(v["total_spins"] / max(1, v["calls"]), 3),
+                "bins": list(v["bins"]),
+            }
+            for (fam, site, kind), v in sorted(_agg.items())
+        ]
+        return {
+            "launches": _launches,
+            "bin_edges": [e if e != float("inf") else "inf"
+                          for e in BIN_EDGES],
+            "sites": sites,
+            "overflow_sites": dict(sorted(_overflow.items())),
+        }
+
+
+def reset() -> None:
+    global _launches
+    with _lock:
+        _agg.clear()
+        _overflow.clear()
+        _launches = 0

@@ -110,6 +110,8 @@ def dist_pallas_call(
             "kernels cannot be built — ops degrade to the golden XLA "
             "collective path via triton_dist_tpu.resilience.guarded_call"
         )
+    from triton_dist_tpu import obs as _obs
+    from triton_dist_tpu.obs import telemetry as _obs_telem
     from triton_dist_tpu.resilience import faults as _faults
     from triton_dist_tpu.resilience import records as _records
     from triton_dist_tpu.resilience import watchdog as _watchdog
@@ -124,6 +126,20 @@ def dist_pallas_call(
 
     cfg = tdt_config.get_config()
     arm_diag = int(cfg.timeout_iters) > 0
+    # wait-telemetry tier (ISSUE 9): one more SMEM output recording every
+    # bounded wait site's observed spin count — success path included.
+    # Requires the armed watchdog (the bounded waits are where the spin
+    # count exists); without it the obs request is silently inert, the
+    # chunk-signal discipline. Inside a jit_shard_map trace the decision
+    # FOLLOWS the collecting scope (telem_wanted — the program being
+    # built either consumes the buffer or it doesn't; reading config here
+    # could disagree with the program's cache key if obs flipped between
+    # wrap and first trace); outside one, config decides (the buffer is
+    # dropped there anyway — no host boundary, no decode).
+    wanted = _watchdog.telem_wanted()
+    arm_telem = arm_diag and (
+        _obs.wait_stats_enabled() if wanted is None else wanted
+    )
     # a spent (healed) fault plan no longer needs the injector scope
     arm_scope = arm_diag or (
         cfg.fault_plan is not None and not _faults.plan_spent()
@@ -149,11 +165,19 @@ def dist_pallas_call(
     elif grid is not None:
         grid_dims = len(grid)
 
+    n_extra = (2 if arm_telem else 1) if arm_diag else 0
     if arm_diag:
-        # the diagnostic buffer: unblocked SMEM, last output, so existing
-        # input/output aliases and ref positions stay untouched
+        # the diagnostic buffer (and, when the obs layer arms wait_stats,
+        # the telemetry buffer after it): unblocked SMEM, last outputs, so
+        # existing input/output aliases and ref positions stay untouched
         out_shapes.append(jax.ShapeDtypeStruct((_records.DIAG_LEN,), jnp.int32))
-        diag_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+        if arm_telem:
+            out_shapes.append(
+                jax.ShapeDtypeStruct((_obs_telem.TELEM_LEN,), jnp.int32)
+            )
+        extra_specs = tuple(
+            pl.BlockSpec(memory_space=pltpu.SMEM) for _ in range(n_extra)
+        )
         if grid_spec is not None:
             gs_outs = grid_spec.out_specs
             if not isinstance(gs_outs, (tuple, list)):
@@ -162,7 +186,7 @@ def dist_pallas_call(
                 num_scalar_prefetch=grid_spec.num_scalar_prefetch,
                 grid=grid_spec.grid,
                 in_specs=list(grid_spec.in_specs),
-                out_specs=(*gs_outs, diag_spec),
+                out_specs=(*gs_outs, *extra_specs),
                 scratch_shapes=list(grid_spec.scratch_shapes),
             )
         else:
@@ -172,21 +196,32 @@ def dist_pallas_call(
                 user_specs = tuple(out_specs)
             else:
                 user_specs = (out_specs,)
-            out_specs = (*user_specs, diag_spec)
+            out_specs = (*user_specs, *extra_specs)
 
     body = kernel
     if arm_scope:
         def body(*refs):  # noqa: F811 — deliberate armed override
-            diag_ref = None
+            diag_ref = telem_ref = None
             user_refs = refs
             if arm_diag:
-                i = len(refs) - n_scratch - 1
+                i = len(refs) - n_scratch - n_extra
                 diag_ref = refs[i]
-                user_refs = refs[:i] + refs[i + 1:]
+                if arm_telem:
+                    telem_ref = refs[i + 1]
+                user_refs = refs[:i] + refs[i + n_extra:]
 
                 def _zero_diag():
                     for j in range(_records.DIAG_LEN):
                         diag_ref[j] = jnp.int32(0)
+                    if telem_ref is not None:
+                        for j in range(_obs_telem.TELEM_LEN):
+                            telem_ref[j] = jnp.int32(0)
+                        # the telemetry row self-describes its kernel
+                        # family (gathered rows from different launches
+                        # share one host-side decode)
+                        telem_ref[_obs_telem.H_FAMILY] = jnp.int32(
+                            _records.family_code_for(name)
+                        )
 
                 if grid_dims == 0:
                     _zero_diag()
@@ -197,7 +232,7 @@ def dist_pallas_call(
                     for d in range(1, grid_dims):
                         first = jnp.logical_and(first, pl.program_id(d) == 0)
                     pl.when(first)(_zero_diag)
-            with _watchdog.kernel_scope(diag_ref, name):
+            with _watchdog.kernel_scope(diag_ref, name, telem_ref=telem_ref):
                 kernel(*user_refs)
 
     kwargs: dict[str, Any] = {}
@@ -227,12 +262,17 @@ def dist_pallas_call(
 
     def invoke(*args):
         outs = call(*args)
-        *user, diag = outs
-        if not _watchdog.offer(diag):
+        if arm_telem:
+            *user, diag, telem = outs
+        else:
+            *user, diag = outs
+            telem = None
+        if not _watchdog.offer(diag, telem):
             # traced inside a USER-level shard_map, not jit_shard_map: no
             # host boundary will decode this diag and raise, so poison the
             # outputs in-trace — a timed-out launch must never hand back
-            # plausible partial data
+            # plausible partial data (the telemetry is dropped for the
+            # same reason: no host boundary, no decode)
             bad = diag[_records.F_STATUS] != _records.STATUS_OK
             user = [_watchdog.poison(u, bad) for u in user]
         return user[0] if single_out else tuple(user)
@@ -360,6 +400,10 @@ def gemm_only(a, b, *, cfg, out_dtype, name: str, interpret=None):
 
 
 _jit_cache: dict[Any, Any] = {}
+# unarmed dispatch wrappers, keyed like _jit_cache: callers compare entry
+# identity (tests pin f1 is f2 for the zero-overhead path), so the span
+# wrapper must be as cached as the jitted program it fronts
+_wrapper_cache: dict[Any, Any] = {}
 
 
 def jit_shard_map(
@@ -390,37 +434,62 @@ def jit_shard_map(
     recording the event in ``resilience.health``).
     """
     from triton_dist_tpu import config as _tdt_config
+    from triton_dist_tpu import obs as _obs
+    from triton_dist_tpu.obs import telemetry as _obs_telem
     from triton_dist_tpu.resilience import faults as _faults
     from triton_dist_tpu.resilience import records as _records
     from triton_dist_tpu.resilience import watchdog as _watchdog
 
     cfg = _tdt_config.get_config()
     armed = int(cfg.timeout_iters) > 0
+    # wait-telemetry tier (ISSUE 9): the traced program grows one more
+    # gathered output, so the request is part of the program cache key
+    ws = armed and _obs.wait_stats_enabled()
+    family = key[0] if isinstance(key, tuple) and key and isinstance(key[0], str) else str(key)
 
-    def _resolve():
+    def _cache_key():
         cfg = _tdt_config.get_config()
-        cache_key = (
+        return (
             mesh, str(in_specs), str(out_specs), donate_argnums, key,
             # trace-time config that changes the kernel program (a cached
             # un-delayed program must not serve a race-shaking, watchdogged,
             # or fault-injected run, and vice versa). The fault-plan token
             # flips when a bounded plan's trigger budget is spent, so a
             # healed retry traces — and caches — the clean program.
-            cfg.debug_comm_delay, cfg.timeout_iters, _faults.plan_token(),
+            cfg.debug_comm_delay, cfg.timeout_iters, _faults.plan_token(), ws,
         )
+
+    def _resolve():
+        cache_key = _cache_key()
         hit = _jit_cache.get(cache_key)
         if hit is None:
             if armed:
                 def fn_diag(*args):
-                    with _watchdog.collect() as diags:
+                    # want_telem rides the scope so the kernels traced
+                    # inside arm their telemetry output to MATCH this
+                    # program's output structure (see watchdog.collect)
+                    with _watchdog.collect(want_telem=ws) as entries:
                         out = fn(*args)
-                    diag = _watchdog.merge(diags)
+                    diag = _watchdog.merge([d for d, _ in entries])
                     bad = diag[0, _records.F_STATUS] != _records.STATUS_OK
+                    if ws:
+                        telems = [t for _, t in entries if t is not None]
+                        telem = (
+                            jnp.stack(telems) if telems
+                            else jnp.zeros(
+                                (1, _obs_telem.TELEM_LEN), jnp.int32
+                            )
+                        )
+                        return _watchdog.poison(out, bad), diag, telem
                     return _watchdog.poison(out, bad), diag
 
                 diag_out_spec = PartitionSpec(tuple(mesh.axis_names), None)
+                armed_out_specs = (
+                    (out_specs, diag_out_spec, diag_out_spec) if ws
+                    else (out_specs, diag_out_spec)
+                )
                 hit = jax.jit(
-                    _shard_map(fn_diag, mesh, in_specs, (out_specs, diag_out_spec)),
+                    _shard_map(fn_diag, mesh, in_specs, armed_out_specs),
                     donate_argnums=donate_argnums,
                 )
             else:
@@ -431,11 +500,39 @@ def jit_shard_map(
             _jit_cache[cache_key] = hit
         return hit
 
-    jitted = _resolve()
     if not armed:
-        return jitted
+        # Cached wrapper, keyed like the program cache: unarmed entries
+        # with the same key return the IDENTICAL callable (pinned in
+        # tests/test_elastic.py). The program is resolved EAGERLY at wrap
+        # time and frozen in the closure — exactly the pre-obs semantics:
+        # a stored unarmed wrapper must never re-resolve under a config
+        # that changed after wrap (re-reading _cache_key per call under a
+        # later-armed watchdog would build the unarmed program and cache
+        # it under the ARMED key, poisoning the shared program cache).
+        # Per-call work is ONE obs.span_enabled() attribute read, so a
+        # wrapper stored while obs was disarmed still emits jit spans
+        # once obs is armed mid-process; `cached` reports whether this
+        # wrapper has dispatched before (jax.jit traces lazily on the
+        # first CALL, so that is the trace-vs-cached boundary).
+        wrap_key = _cache_key()
+        hit = _wrapper_cache.get(wrap_key)
+        if hit is not None:
+            return hit
+        jitted = _resolve()
+        state = {"warm": False}
 
-    family = key[0] if isinstance(key, tuple) and key and isinstance(key[0], str) else str(key)
+        def unarmed_call(*args):
+            if not _obs.span_enabled():
+                state["warm"] = True
+                return jitted(*args)
+            cached = state["warm"]
+            state["warm"] = True
+            with _obs.span(f"jit:{family}", cat="jit", cached=cached,
+                           armed=False):
+                return jitted(*args)
+
+        _wrapper_cache[wrap_key] = unarmed_call
+        return unarmed_call
     n_world = int(mesh.devices.size)
     # peer attribution is keyed by flattened device index; on a multi-axis
     # mesh the diag rows span the product world while records carry the PE
@@ -478,6 +575,19 @@ def jit_shard_map(
         err._tdt_recorded = True
         raise err
 
+    def _launch(*args):
+        """One resolved-program invocation, normalized to (out, diag):
+        the wait-stats variant peels its telemetry output and folds the
+        decoded per-site spin records into the obs registry (success and
+        failure paths alike — a timed-out launch's surviving sites are
+        exactly the attribution a stall question needs)."""
+        if ws:
+            out, diag, telem = _resolve()(*args)
+            _obs_telem.record_decoded(_obs_telem.decode_telem(telem))
+        else:
+            out, diag = _resolve()(*args)
+        return out, diag
+
     def call(*args):
         from triton_dist_tpu.resilience import health
 
@@ -491,7 +601,7 @@ def jit_shard_map(
             # Resolved per call, not at wrap time: callers store these
             # wrappers (models/decode serving steps), and a stored wrapper
             # must pick up a healed fault plan's clean program
-            out, diag = _resolve()(*args)
+            out, diag = _launch(*args)
             if cfg.fault_plan is not None:
                 _faults.note_launch()
             recs = _records.decode_diag(diag)  # forces the device sync
@@ -531,13 +641,20 @@ def jit_shard_map(
         delays = policy.delays(key=family) if policy is not None else ()
         slept = 0.0
         for attempt in range(attempts):
-            out, diag = _resolve()(*args)
+            out, diag = _launch(*args)
             if cfg.fault_plan is not None:
                 _faults.note_launch()
             recs = _records.decode_diag(diag)
             if not recs:
                 if attempt:
                     health.record_recovery(family, attempt)
+                    # stamp the recovery onto the enclosing op:{family}
+                    # guard span BY NAME (the guard layer's ladder-rung
+                    # record, ISSUE 9) — the innermost open span here is
+                    # our own jit:{family} dispatch span
+                    _obs.tracer.annotate_span(
+                        f"op:{family}", retries=attempt
+                    )
                 if cfg.elastic:
                     _elastic.note_clean_step(n_world)
                 return out
@@ -627,7 +744,20 @@ def jit_shard_map(
                 _raise_integrity(int_recs, noted=True)
             return out
 
-    return call
+    def spanned_call(*args):
+        # jit:{family} dispatch span (trace vs cached — the compile-cost
+        # attribution ISSUE 9 asks of this boundary). Enablement checked
+        # per call so stored wrappers pick up a mid-process arming; the
+        # armed path legitimately re-resolves per call (healed fault
+        # plans), so `cached` is read from the program cache itself.
+        if not _obs.span_enabled():
+            return call(*args)
+        cached = _cache_key() in _jit_cache
+        with _obs.span(f"jit:{family}", cat="jit", cached=cached,
+                       armed=True):
+            return call(*args)
+
+    return spanned_call
 
 
 def barrier_all_op(axis: str = "tp", interpret: Any = None) -> None:

@@ -138,6 +138,22 @@ def guarded_call(
 
 
 def _guarded(family, primary, fallback, args, kwargs, *, pin_global):
+    from triton_dist_tpu import obs as _obs
+
+    # observability (ISSUE 9): one span per OUTERMOST guarded entry,
+    # recording which ladder rung actually served the call (fused /
+    # golden_pinned / golden_fallback / integrity / timeout). Nested
+    # guard levels stay span-free — the op-entry span is the unit a
+    # timeline reader cares about; disarmed this is one attribute read.
+    if _guard_depth() > 0 or not _obs.span_enabled():
+        return _guarded_impl(family, primary, fallback, args, kwargs,
+                             pin_global=pin_global, sp=_obs.NULL_SPAN)
+    with _obs.span(f"op:{family}", cat="op") as sp:
+        return _guarded_impl(family, primary, fallback, args, kwargs,
+                             pin_global=pin_global, sp=sp)
+
+
+def _guarded_impl(family, primary, fallback, args, kwargs, *, pin_global, sp):
     from triton_dist_tpu import config as tdt_config
     from triton_dist_tpu.resilience import integrity as _integrity
 
@@ -149,6 +165,7 @@ def _guarded(family, primary, fallback, args, kwargs, *, pin_global):
 
     if fallback is None or not tdt_config.get_config().fallback_to_xla:
         # no golden rung / loud CI posture: detection still runs, loudly
+        sp.set("rung", "fused")
         out = primary(*args, **kwargs)
         if checking:
             _integrity.check_result(family, out)
@@ -162,6 +179,7 @@ def _guarded(family, primary, fallback, args, kwargs, *, pin_global):
         # semaphore state undefined (quarantine; see docs/resilience.md).
         # Recorded once at pin time — not per call, to keep the event deque
         # and counters meaningful.
+        sp.set("rung", "golden_pinned")
         out = fallback(*args, **kwargs)
         if checking:
             _integrity.check_result(family, out, source="golden")
@@ -178,9 +196,11 @@ def _guarded(family, primary, fallback, args, kwargs, *, pin_global):
         return out
 
     try:
+        sp.set("rung", "fused")
         return run_primary()
     except Exception as exc:  # noqa: BLE001 — filtered by fallbackable()
         if _integrity.integrity_in_chain(exc) is not None:
+            sp.set("rung", "integrity")
             # the corruption ladder (resilience/integrity.py): detect →
             # bounded retry (counted separately from timeouts) → golden
             # fallback (checked too) — while every detection's records
@@ -208,7 +228,10 @@ def _guarded(family, primary, fallback, args, kwargs, *, pin_global):
                 # failures degrade to the golden path
                 exc = ladder_exc
         if not fallbackable(exc):
+            sp.set("rung", "error")
+            sp.set("error", type(exc).__name__)
             if _timeout_in_chain(exc):
+                sp.set("rung", "timeout")
                 # the trip itself stays loud (this raise); LATER calls of
                 # this family serve the golden path — its barrier semaphore
                 # may hold residue (partially-drained credits, a late
@@ -243,6 +266,8 @@ def _guarded(family, primary, fallback, args, kwargs, *, pin_global):
             reason="fused path failed; served golden XLA collective path",
             exc=exc,
         )
+        sp.set("rung", "golden_fallback")
+        sp.set("cause", type(exc).__name__)
         return fallback(*args, **kwargs)
 
 

@@ -63,6 +63,12 @@ _lock = threading.Lock()
 _events: collections.deque[HealthEvent] = collections.deque(maxlen=MAX_EVENTS)
 _counters: dict[tuple[str, str], int] = {}
 _total_dropped = 0
+# WHAT was evicted, not just how much: a deque past MAX_EVENTS keeps the
+# newest 256, and without kind attribution a storm of retries could
+# silently push the one integrity event out of the window — the total
+# alone can't tell an operator whether the lost detail mattered
+# (per-(family, kind) counters are never dropped; only event DETAIL is)
+_dropped_by_kind: dict[str, int] = {}
 # families guarded_call serves straight from the golden path without
 # retrying the fused one: {family: (reason, pin_kind)}. Two ways in — a
 # process-global environmental failure (PIN_ENV: the install cannot build
@@ -205,6 +211,10 @@ def _record(ev: HealthEvent) -> None:
     with _lock:
         if len(_events) == _events.maxlen:
             _total_dropped += 1
+            oldest = _events[0]
+            _dropped_by_kind[oldest.kind] = (
+                _dropped_by_kind.get(oldest.kind, 0) + 1
+            )
         _events.append(ev)
         key = (ev.family, ev.kind)
         _counters[key] = _counters.get(key, 0) + 1
@@ -266,7 +276,11 @@ def snapshot() -> dict:
             "healthy": True,
             "counters": {f"{f}:{k}": n for (f, k), n in sorted(_counters.items())},
             "short_circuited": {f: r for f, (r, _) in _short_circuit.items()},
+            # no silent caps (ISSUE 9 satellite): the bounded deque's
+            # evictions are counted AND attributed by kind — emitted via
+            # bench.py --health-json with the rest of the snapshot
             "dropped_events": _total_dropped,
+            "dropped_by_kind": dict(sorted(_dropped_by_kind.items())),
             "last_events": [
                 {
                     "kind": e.kind, "family": e.family, "reason": e.reason,
@@ -338,3 +352,4 @@ def reset(*, keep_short_circuit: bool = False, keep_env: bool = False) -> None:
             else:
                 _short_circuit.clear()
         _total_dropped = 0
+        _dropped_by_kind.clear()

@@ -59,6 +59,14 @@ _KIND_NAMES = {
 }
 
 
+def kind_name(code: int) -> str:
+    """Readable name of a KIND_* code — shared by the timeout-record
+    decode below and the obs layer's wait-telemetry decode
+    (obs/telemetry.py), so a spin histogram and a timeout record name
+    the same wait the same way."""
+    return _KIND_NAMES.get(int(code), f"<kind {int(code)}>")
+
+
 # ---------------------------------------------------------------------------
 # Kernel-family registry: a stable small int per dist_pallas_call(name=...)
 # so the in-kernel record can name the family without strings. Separate from
@@ -99,7 +107,7 @@ def decode_record(row) -> dict:
         "family": family_name_for(row[F_FAMILY]),
         "pe": row[F_PE],
         "site": row[F_SITE],
-        "kind": _KIND_NAMES.get(row[F_KIND], f"<kind {row[F_KIND]}>"),
+        "kind": kind_name(row[F_KIND]),
         "expected": row[F_EXPECTED],
         "observed": row[F_OBSERVED],
         "budget": row[F_BUDGET],

@@ -260,4 +260,9 @@ def call_with_retry(
             continue
         if attempt:
             health.record_recovery(family, attempt)
+            # stamp the absorbed retries onto the enclosing op span — the
+            # obs layer's ladder-rung record (a no-op unless config.obs)
+            from triton_dist_tpu import obs as _obs
+
+            _obs.annotate(retries=attempt, retry_class=cls)
         return out

@@ -35,12 +35,17 @@ from triton_dist_tpu.resilience import records as R
 
 class KernelDiagScope:
     """Ambient per-kernel-trace state: the diag ref, the family code, the
-    wait/signal site counters, and the PE hint ``shmem.my_pe`` registers."""
+    wait/signal site counters, and the PE hint ``shmem.my_pe`` registers.
+
+    ``telem_ref`` (the obs layer's wait-telemetry buffer, ISSUE 9) rides
+    along when ``config.obs.wait_stats`` is armed on top of the watchdog:
+    every bounded wait then also records its observed spin count into its
+    site's telemetry slot — success path included."""
 
     __slots__ = ("diag_ref", "family", "family_code", "pe", "_wait_sites",
-                 "_signal_sites", "_payload_sites")
+                 "_signal_sites", "_payload_sites", "telem_ref")
 
-    def __init__(self, diag_ref, family: str):
+    def __init__(self, diag_ref, family: str, telem_ref=None):
         self.diag_ref = diag_ref
         self.family = family
         self.family_code = R.family_code_for(family)
@@ -48,6 +53,7 @@ class KernelDiagScope:
         self._wait_sites = 0
         self._signal_sites = 0
         self._payload_sites = 0
+        self.telem_ref = telem_ref
 
     def next_wait_site(self) -> int:
         s = self._wait_sites
@@ -83,8 +89,8 @@ def active() -> KernelDiagScope | None:
 
 
 @contextlib.contextmanager
-def kernel_scope(diag_ref, family: str):
-    scope = KernelDiagScope(diag_ref, family)
+def kernel_scope(diag_ref, family: str, telem_ref=None):
+    scope = KernelDiagScope(diag_ref, family, telem_ref=telem_ref)
     _stack().append(scope)
     try:
         yield scope
@@ -141,10 +147,16 @@ def bounded_wait(sem, value, *, kind: int):
         i, _ = state
         return i + 1, pltpu.semaphore_read(sem)
 
-    _, seen = jax.lax.while_loop(
+    spins, seen = jax.lax.while_loop(
         cond, body, (jnp.int32(0), pltpu.semaphore_read(sem))
     )
     ok = seen >= value
+    if scope.telem_ref is not None:
+        # live = this wait actually polled: fast-fail chained waits
+        # (budget clamped to 0 after a first recorded timeout) must not
+        # land in the zero-spin "instant" bin and deflate the very
+        # histograms the stall-attribution instrument exists for
+        _record_wait_telemetry(scope, site, kind, spins, live=budget > 0)
 
     @pl.when(ok)
     def _consume():
@@ -174,6 +186,63 @@ def bounded_wait(sem, value, *, kind: int):
         diag[R.F_BUDGET] = budget
 
     return ok
+
+
+def _record_wait_telemetry(scope, site: int, kind: int, spins, live=True):
+    """Write one bounded wait's observed spin count into its trace-time
+    telemetry slot (obs/telemetry.py layout; ISSUE 9). Runs on success
+    AND on expiry (spins == budget then) — the success-path wait-cost
+    attribution the diag buffer's first-record-wins protocol cannot give.
+    Sites past the slot window bump the overflow header instead of being
+    silently dropped. ``live`` (traced) gates every write: a fast-fail
+    chained wait (zero budget after an earlier recorded timeout) never
+    polled, so recording it as a zero-spin call would poison the
+    histograms. Pure observation: no semaphore, signal, or control flow
+    is touched."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from triton_dist_tpu.obs import telemetry as T
+
+    telem = scope.telem_ref
+    pe = scope.pe if scope.pe is not None else jnp.int32(-1)
+    live = jnp.asarray(live, jnp.bool_)
+    if site >= T.TELEM_SLOTS:
+        # trace-time decision: the site ordinal is static
+        @pl.when(live)
+        def _overflow():
+            telem[T.H_PE] = jnp.asarray(pe, jnp.int32)
+            telem[T.H_OVERFLOW] = telem[T.H_OVERFLOW] + 1
+
+        return
+    spins = jnp.asarray(spins, jnp.int32)
+    base = T.TELEM_HEADER + site * T.TELEM_FIELDS
+
+    @pl.when(live)
+    def _write():
+        telem[T.H_PE] = jnp.asarray(pe, jnp.int32)
+        telem[base + T.T_KIND] = jnp.int32(kind)
+        telem[base + T.T_CALLS] = telem[base + T.T_CALLS] + 1
+        # saturating accumulate: many grid steps spinning near a large
+        # budget could wrap int32 (old and spins are both >= 0, so a
+        # wrapped sum reads < old) — a saturated total beats a negative
+        # mean in exactly the heavy-stall regime this instrument targets
+        old_total = telem[base + T.T_TOTAL]
+        total = old_total + spins
+        telem[base + T.T_TOTAL] = jnp.where(
+            total < old_total, jnp.int32(2**31 - 1), total
+        )
+        telem[base + T.T_MAX] = jnp.maximum(telem[base + T.T_MAX], spins)
+
+    # log4 bin select, mirrored host-side by telemetry.spin_bin: bin 0 is
+    # the zero-spin fast path, the last bin is open-ended
+    b = jnp.int32(0)
+    for k in range(T.TELEM_BINS - 1):
+        b = b + (spins >= jnp.int32(4**k)).astype(jnp.int32)
+    for k in range(T.TELEM_BINS):
+        @pl.when(jnp.logical_and(live, b == k))
+        def _bump(k=k):
+            telem[base + T.T_BINS + k] = telem[base + T.T_BINS + k] + 1
 
 
 def record_integrity_mismatch(sem_value, local_checksum, mismatch, site):
@@ -218,26 +287,44 @@ def _collections() -> list:
 
 
 @contextlib.contextmanager
-def collect():
-    """Collect the diag outputs of every ``dist_pallas_call`` traced inside
-    this scope (jit_shard_map opens one around the traced fn)."""
-    diags: list[Any] = []
-    _collections().append(diags)
+def collect(want_telem: bool = False):
+    """Collect the diag (and optional wait-telemetry) outputs of every
+    ``dist_pallas_call`` traced inside this scope (jit_shard_map opens one
+    around the traced fn). Entries are ``(diag, telem_or_None)`` tuples.
+
+    ``want_telem`` declares whether the program being traced CONSUMES
+    telemetry buffers: ``dist_pallas_call`` arms its telemetry output to
+    match (:func:`telem_wanted`), so the traced kernels and the
+    jit_shard_map output structure can never disagree — even when
+    ``config.obs`` flips between program-build time and jax's (lazy)
+    first-call trace."""
+    entries: list[Any] = []
+    entries_scope = (entries, bool(want_telem))
+    _collections().append(entries_scope)
     try:
-        yield diags
+        yield entries
     finally:
         _collections().pop()
 
 
-def offer(diag) -> bool:
-    """Offer one kernel launch's traced ``int32[DIAG_LEN]`` diag array to
+def telem_wanted() -> "bool | None":
+    """The innermost collect scope's ``want_telem`` flag, or None outside
+    any scope (a dist_pallas_call traced in a USER-level shard_map)."""
+    st = _collections()
+    return st[-1][1] if st else None
+
+
+def offer(diag, telem=None) -> bool:
+    """Offer one kernel launch's traced ``int32[DIAG_LEN]`` diag array
+    (plus its ``int32[TELEM_LEN]`` wait-telemetry buffer when armed) to
     the innermost active collection. Returns False outside one (a
     dist_pallas_call traced inside a USER-level shard_map rather than
     jit_shard_map) — the caller must then poison its outputs in-trace,
-    because no host boundary exists to decode the record and raise."""
+    because no host boundary exists to decode the record and raise (the
+    telemetry is dropped there too: no host boundary, no decode)."""
     st = _collections()
     if st:
-        st[-1].append(diag)
+        st[-1][0].append((diag, telem))
         return True
     return False
 

@@ -44,6 +44,11 @@ def sweep_offered_load(
     ``[{"rate_rps", "snapshot", "n_finished"}, ...]`` in rate order."""
     rows = []
     for lam in rates:
+        # per-row span isolation is structural: each λ gets a FRESH
+        # engine, and the per-phase p50/p99 in its snapshot come from
+        # ENGINE-LOCAL stats — the process-global tracer ring is left
+        # alone, so a --obs-trace export after the sweep still holds
+        # every rate's request lanes
         clock = FakeClock()
         spec = TrafficSpec(
             rate_rps=float(lam), n_requests=n_requests,
@@ -56,6 +61,10 @@ def sweep_offered_load(
                 virtual_step_s=virtual_step_s, slo=slo,
                 **(serving_kw or {}),
             ),
+            # distinct exported span lanes per rate: every λ re-seeds the
+            # same request uids on a fresh t=0 FakeClock, so untagged
+            # tracks would superimpose all rates' request arcs
+            obs_tag=f"lam{lam:g}:",
             **(batcher_kw or {}),
         )
         done = eng.serve(generate_trace(spec))
@@ -89,4 +98,14 @@ def info_lines(rows: list[dict], tag: str = "") -> list[tuple[str, Any, str]]:
         if snap["slo"] is not None:
             out.append((f"serving_slo_attainment_{key}",
                         snap["slo"]["attained"], "fraction"))
+        # per-phase step-time breakdown from the span tracer (ISSUE 9):
+        # present only when obs was armed for the sweep; deterministic
+        # under the FakeClock like every other row
+        for phase in ("queued", "prefill", "decode"):
+            st = snap.get("span_ms", {}).get(f"serving:{phase}")
+            if st is not None and st["count"]:
+                out.append((f"serving_{phase}_p50_ms_{key}",
+                            st["p50_ms"], "ms"))
+                out.append((f"serving_{phase}_p99_ms_{key}",
+                            st["p99_ms"], "ms"))
     return out

@@ -38,6 +38,7 @@ import dataclasses
 from collections import deque
 from typing import Any
 
+from triton_dist_tpu import obs as _obs
 from triton_dist_tpu.models.decode import ContinuousBatcher, Request
 from triton_dist_tpu.resilience import elastic, health
 from triton_dist_tpu.resilience import retry as _retry
@@ -190,6 +191,7 @@ class ServingEngine:
         serving: ServingConfig | None = None,
         metrics: ServingMetrics | None = None,
         clock: Any = None,
+        obs_tag: str = "",
         **batcher_kw: Any,
     ):
         self.cfg, self.params = cfg, params
@@ -214,6 +216,16 @@ class ServingEngine:
         self.mesh = self._target_mesh()
         self._batcher = self._build(self.mesh)
         self._t0 = self.clock.monotonic()
+        # obs (ISSUE 9): live engines fold their metrics into
+        # obs.snapshot(); weak registration, so a dropped engine vanishes.
+        # Phase stats are ENGINE-LOCAL (the global tracer is process-wide
+        # — two live engines must not contaminate each other's p50/p99).
+        # obs_tag prefixes this engine's span TRACKS so concurrent or
+        # sequential engines sharing request uids (the λ-sweep re-seeds
+        # req0.. per rate) land on distinct exported lanes.
+        _obs.register_serving_engine(self)
+        self._obs_tag = str(obs_tag)
+        self._phase_stats: dict[str, Any] = {}
 
     # -- world management ----------------------------------------------
 
@@ -410,6 +422,39 @@ class ServingEngine:
             t_admitted=st.t_admitted, t_first_token=st.t_first,
             t_finished=now, resumed=st.resumed,
         )
+        self._record_phase_spans(self.results[uid], n_tokens=len(tokens))
+
+    def _record_phase_spans(self, fin: "Finished", *, n_tokens: int) -> None:
+        """Per-request lifecycle phases into the obs tracer (ISSUE 9):
+        ``serving:queued`` (enqueue → slot grant), ``serving:prefill``
+        (admission → first token), ``serving:decode`` (first token →
+        finished), and the whole ``serving:e2e`` arc — each on its own
+        request track so exported timelines show concurrent requests as
+        parallel lanes. Timestamps are the ENGINE clock's (explicit, via
+        record_span), so FakeClock runs export byte-identically. No-op
+        when obs is disarmed."""
+        if not _obs.span_enabled():
+            return
+        track = f"{self._obs_tag}req:{fin.uid}"
+
+        def phase(name, t0, t1, **attrs):
+            _obs.record_span(name, t0, t1, cat="serving", track=track,
+                             uid=str(fin.uid), **attrs)
+            st = self._phase_stats.get(name)
+            if st is None:
+                st = self._phase_stats[name] = _obs.tracer.DurationStats()
+            st.record((t1 - t0) * 1e3)
+
+        if fin.t_admitted is not None:
+            phase("serving:queued", fin.t_enqueue, fin.t_admitted)
+        if fin.t_first_token is not None:
+            if fin.t_admitted is not None:
+                phase("serving:prefill", fin.t_admitted, fin.t_first_token,
+                      resumed=fin.resumed)
+            phase("serving:decode", fin.t_first_token, fin.t_finished,
+                  n_tokens=n_tokens)
+        phase("serving:e2e", fin.t_enqueue, fin.t_finished,
+              resumed=fin.resumed, n_tokens=n_tokens)
 
     def _finalize_poisoned(self, uid: Any, toks: list, reason: str,
                            now: float) -> None:
@@ -428,6 +473,9 @@ class ServingEngine:
             uid=uid, tokens=st.tokens + list(toks), reason=reason,
             t_enqueue=st.t_enqueue, t_poisoned=now, resumed=st.resumed,
         )
+        _obs.record_span("serving:poisoned", now, now, cat="serving",
+                         track=f"{self._obs_tag}req:{uid}", uid=str(uid),
+                         reason=reason)
 
     # -- elastic shrink / regrow ---------------------------------------
 
@@ -471,6 +519,7 @@ class ServingEngine:
         re-materialization path; no generated token is lost."""
         old = self._batcher
         now = self.clock.monotonic()
+        rebuild_t0 = now
         # completed work survives first (the drain_finished contract);
         # poisoned evictions are final too — they must not re-enter replay
         for uid, toks, poison_reason in old.drain_poisoned():
@@ -507,6 +556,14 @@ class ServingEngine:
             # admitted but never started (possibly already a replay):
             # resubmit verbatim
             self._batcher.submit(req)
+        # the rebuild/replay arc as one engine-track span (ISSUE 9) —
+        # engine-clock timestamps, so FakeClock runs export identically
+        _obs.record_span(
+            "serving:rebuild", rebuild_t0, self.clock.monotonic(),
+            cat="serving", track=f"{self._obs_tag}engine", reason=reason,
+            world=int(target.devices.size), replayed=len(active),
+            requeued=len(queued),
+        )
 
     def _maybe_probe(self) -> None:
         if self.full_mesh.devices.ndim != 1 or not elastic.enabled():
@@ -596,4 +653,17 @@ class ServingEngine:
             "prefill_bucket_programs": self._batcher.prefill_bucket_count,
             "clock_s": round(now - self._t0, 9),
         }
+        if _obs.span_enabled():
+            # per-phase p50/p99 from the span tracer (ISSUE 9 satellite):
+            # the λ-sweep rows carry a step-time BREAKDOWN (queued /
+            # prefill / decode), not just end-to-end percentiles. Only
+            # present when obs is armed, so disarmed snapshots are
+            # byte-identical to pre-obs ones. ENGINE-LOCAL stats, not the
+            # process-global tracer's — two live engines (a canary beside
+            # production, an elastic regrow test) must each report their
+            # OWN requests' percentiles.
+            snap["span_ms"] = {
+                name: st.snapshot()
+                for name, st in sorted(self._phase_stats.items())
+            }
         return snap

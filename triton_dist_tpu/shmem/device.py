@@ -616,7 +616,13 @@ def _wait_or_watchdog(sem, value, kind):
     poll up to the budget, consume on success, or write the diagnostic
     record and RETURN — the kernel keeps issuing its later signals/puts so
     a timed-out PE can never deadlock its peers (its own later waits
-    fast-fail on a zero budget; the host raises DistTimeoutError)."""
+    fast-fail on a zero budget; the host raises DistTimeoutError).
+
+    Every bounded wait is also the obs layer's telemetry site (ISSUE 9):
+    with ``config.obs.wait_stats`` armed on top of the watchdog, the
+    observed spin count lands in the kernel's telemetry buffer — success
+    path included — keyed by the same trace-time site ordinal the
+    timeout diagnostics use (docs/observability.md)."""
     from triton_dist_tpu.resilience import watchdog as _watchdog
 
     if _watchdog.enabled() and _watchdog.active() is not None:

@@ -349,19 +349,33 @@ def group_profile(
     jax.distributed client) and written into ONE profile run directory —
     the viewer renders a run dir holding all hosts' planes as a single
     merged timeline. Single-process: a plain ``jax.profiler`` trace.
+
+    YIELDS the trace/run directory path (``None`` with ``do_prof=False``)
+    so callers — bench, chip-session scripts — can attach artifacts to
+    the run::
+
+        with group_profile("decode") as run_dir: ...
+
+    When the obs layer is armed (``config.obs``, ISSUE 9) the exit path
+    additionally drops ``obs_trace.json`` — the span/wait-telemetry
+    chrome trace — into the same directory, so XProf planes and host
+    spans render as one timeline.
     """
     if not do_prof:
-        yield
+        yield None
         return
     path = os.path.join(log_dir, name or "trace")
     os.makedirs(path, exist_ok=True)
     jax.profiler.start_trace(path)
     try:
-        yield
+        yield path
     finally:
         jax.profiler.stop_trace()
         if merge_hosts and jax.process_count() > 1:
             _merge_host_traces(path, name or "trace")
+        from triton_dist_tpu import obs as _obs
+
+        _obs.maybe_export_into(path)
 
 
 def _merge_host_traces(path: str, name: str) -> str | None:
