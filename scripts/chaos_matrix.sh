@@ -46,6 +46,16 @@
 # end-to-end attribution (an injected straggler shifts the victim wait
 # site's spin histogram on the chunked ring pipeline).
 #
+# Since ISSUE 10 the matrix also runs the STATIC protocol lint
+# (scripts/protocol_lint.py, full sweep): every tune-space tuple of all
+# seven kernel families at worlds {2, 4, 8} proved credit-balanced and
+# deadlock-free from the captured signal graph alone, plus the
+# seeded-defect harness (analysis/defects.py — dropped wait, dropped or
+# extra signal, swapped chunk issue order, missing drain, each flagged
+# with a slot/site-named diagnosis). Unlike every other tier here it
+# needs NO interpreter, so this coverage is identical on every jax line.
+# Skip with TDT_SKIP_PROTOCOL_LINT=1.
+#
 # Per-cell failures propagate into the exit code (CI gates on it), and a
 # pass/fail summary table is printed after the run.
 #
@@ -64,12 +74,16 @@ trap 'rm -f "$log"' EXIT
 files="tests/test_chaos.py tests/test_elastic.py \
     tests/test_chunked.py tests/test_chunked_a2a.py tests/test_ragged.py \
     tests/test_emitter.py tests/test_serving.py tests/test_integrity.py \
-    tests/test_obs.py"
+    tests/test_obs.py tests/test_analysis.py"
 marker="chaos"
+lint_args=""
 if [ "${1:-}" = "--quick" ]; then
     shift
     files="tests/test_integrity.py tests/test_serving.py tests/test_elastic.py"
     marker="chaos and not slow"
+    # keep the quick posture bounded: worlds {2,4} (the full {2,4,8}
+    # sweep is the default standalone run's job)
+    lint_args="--quick"
 fi
 
 # -v so every cell prints its own PASSED/FAILED/SKIPPED line for the
@@ -100,9 +114,19 @@ awk '
     }
 ' "$log"
 
+lint_rc=0
+if [ "${TDT_SKIP_PROTOCOL_LINT:-0}" != "1" ]; then
+    echo
+    echo "== static protocol lint (full sweep + defect harness) =="
+    # shellcheck disable=SC2086 — $lint_args is a deliberate flag list
+    env JAX_PLATFORMS=cpu timeout -k 10 900 python scripts/protocol_lint.py \
+        $lint_args || lint_rc=$?
+fi
+
 failed=$(grep -cE ' (FAILED|ERROR)$| (FAILED|ERROR) ' "$log" || true)
-if [ "$rc" -ne 0 ] || [ "$failed" -gt 0 ]; then
-    echo "chaos matrix: FAIL (pytest rc=$rc, failing cells=$failed)"
+if [ "$rc" -ne 0 ] || [ "$failed" -gt 0 ] || [ "$lint_rc" -ne 0 ]; then
+    echo "chaos matrix: FAIL (pytest rc=$rc, failing cells=$failed," \
+        "protocol lint rc=$lint_rc)"
     exit 1
 fi
 echo "chaos matrix: PASS"

@@ -19,8 +19,10 @@ Usage::
   ``/tmp/_t1.log``); failures are the ``FAILED <nodeid>[ - reason]`` lines.
 - ``manifest``: defaults to ``tests/known_failures.txt`` next to this repo.
 - ``--update``: rewrite the manifest to exactly this run's failure set
-  (use after deliberately fixing failures, then commit the shrunk file;
-  growing the manifest should always be a reviewed, explained change).
+  and PRINT the node ids removed/added relative to the old manifest (a
+  silent shrink makes review diffs hard to audit). Use after deliberately
+  fixing failures, then commit the shrunk file; growing the manifest
+  should always be a reviewed, explained change.
 
 Exit codes: 0 = subset (prints the fixed set, if any); 1 = new failures
 (prints them); 2 = usage/IO error.
@@ -93,6 +95,19 @@ def main(argv: list[str]) -> int:
             f"diff_failures: manifest rewritten with {len(current)} "
             f"failure(s) (was {len(known)})"
         )
+        # a silent shrink makes review diffs hard to audit: name exactly
+        # which node ids left (and, for a reviewed growth, which arrived)
+        removed = sorted(known - current)
+        added = sorted(current - known)
+        if removed:
+            print(f"  removed {len(removed)} node id(s):")
+            for node in removed:
+                print(f"    - {node}")
+        if added:
+            print(f"  added {len(added)} node id(s) (growing the manifest "
+                  f"should be a reviewed, explained change):")
+            for node in added:
+                print(f"    + {node}")
         return 0
 
     new = sorted(current - known)

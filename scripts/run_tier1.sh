@@ -3,12 +3,17 @@
 # pass" means the same thing everywhere (ROADMAP.md "Tier-1 verify" is
 # this command; keep the two in sync).
 #
-# Two phases:
+# Three phases:
 #   1. the full tier-1 suite (everything not marked `slow`, 870 s budget,
 #      CPU backend, 8 virtual devices via tests/conftest.py — the tests/
 #      glob picks up tests/test_serving.py, the serving-engine suite,
 #      automatically);
-#   2. a fast `chaos`-marker smoke subset (resilience + elastic layers,
+#   2. the static protocol lint (scripts/protocol_lint.py --quick,
+#      ISSUE 10): every fused family's signal graph proved
+#      credit-balanced and deadlock-free from a recorded trace — needs no
+#      interpreter, so a schedule/emitter change that unbalances a slot
+#      fails here on ANY jax line (TDT_SKIP_PROTOCOL_LINT=1 to skip);
+#   3. a fast `chaos`-marker smoke subset (resilience + elastic layers,
 #      incl. the elastic SERVING arcs of tests/test_serving.py) — a
 #      focused re-run of the cells most likely to regress silently,
 #      cheap enough to eyeball on every PR.
@@ -54,6 +59,19 @@ if [ "${TDT_SKIP_FAILURE_DIFF:-0}" != "1" ] && [ "$#" -eq 0 ]; then
     diff_rc=$?
 fi
 
+# static protocol lint (ISSUE 10): prove every fused family's signal
+# graph credit-balanced and deadlock-free at trace time — no interpreter
+# needed, so this gate bites on EVERY jax line. Quick posture (worlds
+# {2,4}; same protocol generators, less wall time — chaos_matrix.sh runs
+# the full {2,4,8} sweep). Skip with TDT_SKIP_PROTOCOL_LINT=1.
+lint_rc=0
+if [ "${TDT_SKIP_PROTOCOL_LINT:-0}" != "1" ]; then
+    echo
+    echo "== static protocol lint (scripts/protocol_lint.py --quick) =="
+    timeout -k 10 420 env JAX_PLATFORMS=cpu \
+        python scripts/protocol_lint.py --quick || lint_rc=$?
+fi
+
 echo
 echo "== chaos smoke (resilience + elastic) =="
 rm -f /tmp/_t1_chaos.log
@@ -80,6 +98,7 @@ printf '  tier-1:      rc=%s  %s passed / %s failed / %s skipped\n' \
 printf '  chaos smoke: rc=%s  %s passed / %s failed / %s skipped\n' \
     "$chaos_rc" "$(count passed /tmp/_t1_chaos.log)" \
     "$(count failed /tmp/_t1_chaos.log)" "$(count skipped /tmp/_t1_chaos.log)"
+printf '  protocol lint: rc=%s\n' "$lint_rc"
 
 t1_ok=0
 if [ "$t1_rc" -ne 0 ]; then
@@ -98,7 +117,7 @@ if [ "$t1_rc" -ne 0 ]; then
     fi
 fi
 if [ "$t1_ok" -ne 0 ] || [ "$chaos_rc" -ne 0 ] || [ "$perf_rc" -ne 0 ] \
-    || [ "$diff_rc" -ne 0 ]; then
+    || [ "$diff_rc" -ne 0 ] || [ "$lint_rc" -ne 0 ]; then
     echo "tier-1 gate: FAIL"
     exit 1
 fi

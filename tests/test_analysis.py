@@ -1,0 +1,305 @@
+"""Host-tier tests for the static signal-protocol verifier (ISSUE 10).
+
+Everything here runs on ANY jax line, CPU, no interpreter — that is the
+whole point of the analysis package: the capture layer replaces the
+``shmem/device.py`` primitive surface and the kernel launcher with
+recording shims, so these cells exercise the same seams on jax 0.4.37
+that the (gated) interpreter chaos tiers exercise on jax >= 0.6.
+
+Covered (the ISSUE 10 satellite list): capture determinism, credit-balance
+proofs for chunk=1 ≡ legacy tuples, every seeded defect flagged with the
+right slot/site, the a2a chunk-major order check, the TELEM_SLOTS budget
+check, and the cross-check cell pinning the verifier's wait-site inventory
+to the set the obs telemetry decode reports for the same launch.
+"""
+
+from __future__ import annotations
+
+from unittest import mock
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_dist_tpu.analysis import capture as C
+from triton_dist_tpu.analysis import defects as D
+from triton_dist_tpu.analysis import sweep as S
+from triton_dist_tpu.analysis.verify import verify_capture
+from triton_dist_tpu.obs import telemetry as T
+from triton_dist_tpu.resilience import records as R
+from triton_dist_tpu.resilience import sites as sites
+
+
+def _cap(family, world, label, spec=None):
+    if spec is None:
+        spec = dict(S.family_tuples(family, world))[label]
+    return S.capture_family(family, world, label, spec)
+
+
+# ---------------------------------------------------------------------------
+# The shared site table (satellite: one numbering, three consumers)
+# ---------------------------------------------------------------------------
+
+def test_sites_table_is_the_single_source():
+    # records re-exports the table, telemetry derives its window from it,
+    # and the kind names decode identically everywhere
+    assert R.KIND_SIGNAL is sites.KIND_SIGNAL
+    assert R.KIND_CHUNK is sites.KIND_CHUNK
+    assert R.KIND_INTEGRITY is sites.KIND_INTEGRITY
+    assert R.kind_name is sites.kind_name
+    assert T.TELEM_SLOTS == sites.TELEM_SLOTS
+    assert sites.kind_name(sites.KIND_CHUNK) == "chunk_wait"
+    assert sites.BOUNDED_KINDS == {
+        sites.KIND_SIGNAL, sites.KIND_WAIT, sites.KIND_BARRIER,
+        sites.KIND_CHUNK,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Capture determinism + chunk=1 ≡ legacy
+# ---------------------------------------------------------------------------
+
+def test_capture_byte_identical_across_runs():
+    a = _cap("a2a", 2, "p1/c2")
+    b = _cap("a2a", 2, "p1/c2")
+    assert a.canonical() == b.canonical()
+
+
+def test_chunk1_capture_identical_to_legacy_tuple():
+    """chunks_per_shard=1 dispatches to the UNCHANGED legacy kernel — the
+    capture layer must see the IDENTICAL protocol, event for event."""
+    from triton_dist_tpu.ops.all_to_all import A2AConfig
+
+    legacy = S.capture_family("a2a", 2, "x", A2AConfig(puts_per_slab=1))
+    chunk1 = S.capture_family("a2a", 2, "x", A2AConfig(chunks_per_shard=1))
+    assert legacy.canonical() == chunk1.canonical()
+
+
+@pytest.mark.parametrize("family,label", [
+    ("allgather", "ring_1d/c1"),
+    ("allgather", "ring_bidir/c1"),
+    ("allgather", "full_mesh_push/c1"),
+    ("reduce_scatter", "scatter_reduce/bm256/c1"),
+    ("a2a", "p1/c1"),
+    ("gemm_rs", "scatter/bm512"),
+])
+def test_legacy_tuples_prove_credit_balance(family, label):
+    rep = verify_capture(_cap(family, 2, label))
+    assert rep.ok, rep.summary()
+    # legacy (unchunked) schedules predate the canary: no landing-view
+    # warnings either — completely silent reports
+    assert not rep.warnings, rep.summary()
+
+
+def test_chunked_ring_proves_credit_balance_with_sites():
+    cap = _cap("allgather", 4, "ring_1d/c2")
+    rep = verify_capture(cap)
+    assert rep.ok, rep.summary()
+    # every chunk wait is a bounded site of the shared numbering
+    launch = cap.traces[0].launches[0]
+    kinds = {e.kind for e in launch.events if e.op == C.WAIT}
+    assert sites.KIND_CHUNK in kinds and sites.KIND_BARRIER in kinds
+    assert launch.n_wait_sites <= sites.TELEM_SLOTS
+
+
+def test_fused_moe_pipeline_chunk1_proves():
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+
+    rep = verify_capture(
+        S.capture_family(
+            "ag_group_gemm", 2, "bm128/c1", GroupGemmConfig(128, 1024, 512)
+        )
+    )
+    assert rep.ok, rep.summary()
+
+
+# ---------------------------------------------------------------------------
+# Seeded defects (analysis/defects.py): each flagged, slot/site named.
+# chaos-marked: these are the static twins of the fault-injection matrix
+# (scripts/chaos_matrix.sh runs them via the marker AND the full
+# protocol_lint sweep; unlike the live cells they never skip)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def defect_pool():
+    return {
+        "a2a/p1/c4/w2": _cap("a2a", 2, "p1/c4"),
+        "allgather/ring_1d/c2/w2": _cap("allgather", 2, "ring_1d/c2"),
+        "allgather/ring_1d/c1/w2": _cap("allgather", 2, "ring_1d/c1"),
+    }
+
+
+@pytest.mark.chaos
+def test_every_seeded_defect_flagged(defect_pool):
+    failures = D.run_defect_suite(defect_pool)
+    assert not failures, failures
+
+
+@pytest.mark.chaos
+def test_dropped_signal_diagnosis_names_site_and_slot(defect_pool):
+    cap = defect_pool["a2a/p1/c4/w2"]
+    seeded = D.seed_defect(cap, "dropped_signal")
+    rep = verify_capture(seeded.capture)
+    (finding,) = [f for f in rep.errors if f.check == "deadlock"][:1]
+    assert seeded.expect_naming in finding.message      # the slot
+    assert "site" in finding.message                    # the wait site
+    assert "fast_all_to_all" in finding.message         # the family
+
+
+@pytest.mark.chaos
+def test_dropped_wait_leaves_named_residue(defect_pool):
+    cap = defect_pool["allgather/ring_1d/c2/w2"]
+    seeded = D.seed_defect(cap, "dropped_wait")
+    rep = verify_capture(seeded.capture)
+    msgs = [f.message for f in rep.errors if f.check == "credit_balance"]
+    assert msgs and any(seeded.expect_naming in m for m in msgs), rep.summary()
+    assert any("does not drain to zero" in m for m in msgs)
+
+
+@pytest.mark.chaos
+def test_missing_drain_flagged_on_send_slot(defect_pool):
+    cap = defect_pool["allgather/ring_1d/c1/w2"]
+    seeded = D.seed_defect(cap, "missing_drain")
+    rep = verify_capture(seeded.capture)
+    msgs = [f.message for f in rep.errors if f.check == "credit_balance"]
+    assert msgs and any(seeded.expect_naming in m for m in msgs), rep.summary()
+
+
+# ---------------------------------------------------------------------------
+# a2a chunk-major order (check 3)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_a2a_chunk_major_clean_and_swapped(defect_pool):
+    cap = defect_pool["a2a/p1/c4/w2"]
+    assert verify_capture(cap).ok
+    seeded = D.seed_defect(cap, "swapped_chunk_order")
+    rep = verify_capture(seeded.capture)
+    hits = [f for f in rep.errors if f.check == "chunk_order"]
+    assert hits and "CHUNK-MAJOR" in hits[0].message, rep.summary()
+    # the order defect is numerically invisible: credits still balance
+    assert not [f for f in rep.errors if f.check == "credit_balance"]
+
+
+def test_a2a_chunk_major_at_world4():
+    cap = _cap("a2a", 4, "p1/c4")
+    rep = verify_capture(cap)
+    assert rep.ok, rep.summary()
+    # the capture really is chunk-major: put slots' chunk index is
+    # non-decreasing within the chunked emission on every rank
+    for t in cap.traces:
+        chunk_ids = [
+            e.slot[1][-1] for e in t.launches[0].events
+            if e.op == C.PUT and e.meta.get("chunk_signal")
+        ]
+        assert chunk_ids == sorted(chunk_ids)
+
+
+# ---------------------------------------------------------------------------
+# TELEM_SLOTS budget (check 4)
+# ---------------------------------------------------------------------------
+
+def test_telem_budget_overflow_reported():
+    # 7 ring steps x 8 chunks = 56 chunk-wait sites + 3 barrier rounds:
+    # past the 32-slot telemetry window — the verifier reports at trace
+    # time what the runtime would only count in the overflow header
+    cap = S.capture_family("allgather", 8, "ring_1d/c8", ("ring_1d", 8))
+    rep = verify_capture(cap)
+    assert rep.ok, rep.summary()  # the schedule itself is sound
+    assert any(w.check == "telem_budget" for w in rep.warnings), (
+        rep.summary()
+    )
+    assert rep.stats["max_sites"] > sites.TELEM_SLOTS
+
+
+def test_telem_budget_quiet_under_window():
+    rep = verify_capture(_cap("allgather", 4, "ring_1d/c4"))
+    assert not [w for w in rep.warnings if w.check == "telem_budget"]
+
+
+# ---------------------------------------------------------------------------
+# Landing-view (canary) coverage (check 5)
+# ---------------------------------------------------------------------------
+
+def test_landing_view_coverage_reported():
+    # the chunked ring allgather declares recv_view (ISSUE 8): silent
+    rep = verify_capture(_cap("allgather", 2, "ring_1d/c2"))
+    assert not [w for w in rep.warnings if w.check == "landing_view"]
+    # the chunked ag_gemm ring does not: the gap is reported by the tool
+    rep2 = verify_capture(_cap("ag_gemm", 2, "bm1024/c2"))
+    assert any(w.check == "landing_view" for w in rep2.warnings)
+
+
+# ---------------------------------------------------------------------------
+# Cross-check: verifier site inventory == obs telemetry decode (satellite)
+# ---------------------------------------------------------------------------
+
+def test_wait_site_inventory_matches_telemetry_decode():
+    """Drive the REAL in-kernel telemetry writer with the captured wait
+    sites of a chunked ring launch and decode it with the REAL host
+    decoder: the (site, kind) inventory must match the verifier's graph
+    exactly — the three consumers of resilience/sites.py agree."""
+    from triton_dist_tpu.resilience import watchdog as W
+
+    cap = _cap("allgather", 2, "ring_1d/c2")
+    launch = cap.traces[0].launches[0]
+    waits = [(e.site, e.kind) for e in launch.events if e.op == C.WAIT]
+    assert waits and len(waits) == launch.n_wait_sites
+
+    class FakeSmem:
+        def __init__(self):
+            self.buf = np.zeros(T.TELEM_LEN, np.int64)
+
+        def __getitem__(self, i):
+            return jnp.int32(int(self.buf[i]))
+
+        def __setitem__(self, i, v):
+            self.buf[i] = int(v)
+
+    def fake_when(cond):
+        def deco(fn):
+            if bool(cond):
+                fn()
+            return fn
+
+        return deco
+
+    ref = FakeSmem()
+    scope = W.KernelDiagScope(None, launch.family, telem_ref=ref)
+    scope.pe = jnp.int32(0)
+    with mock.patch("jax.experimental.pallas.when", fake_when):
+        for site, kind in waits:
+            W._record_wait_telemetry(scope, site, kind, jnp.int32(1))
+    ref.buf[T.H_FAMILY] = R.family_code_for(launch.family)
+    (row,) = T.decode_telem(ref.buf.astype(np.int32))
+    decoded = {(s["site"], s["kind"]) for s in row["sites"]}
+    captured = {(site, sites.kind_name(kind)) for site, kind in waits}
+    assert decoded == captured
+    assert row["overflow_sites"] == 0
+
+
+# ---------------------------------------------------------------------------
+# The CLI (scripts/protocol_lint.py) smoke
+# ---------------------------------------------------------------------------
+
+def test_protocol_lint_cli_quick_subset(capsys):
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "protocol_lint",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "protocol_lint.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main(["--families", "allgather", "--worlds", "2",
+                   "--no-defects"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "PASS" in out and "credit-balanced" in out
+    assert mod.main(["--families", "nosuch"]) == 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-x", "-q"]))

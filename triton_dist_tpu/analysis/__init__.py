@@ -1,0 +1,47 @@
+"""Static signal-protocol verification (ISSUE 10 tentpole).
+
+The repo's fused kernels live and die by hand-maintained signal
+disciplines: data-coupled recv semaphores, per-(step, chunk) signal slots,
+residual drains, bounded waits with a shared site numbering. Until now the
+only checkers were the Mosaic-interpreter race detector (jax >= 0.6, so it
+SKIPs on older lines) and a handful of spy-traced ordering samples. This
+package proves the protocol properties from the PROGRAM alone, GPUVerify
+style (Betts et al., OOPSLA 2012), on any jax line, on CPU, with no
+devices and no interpreter:
+
+- :mod:`capture` — trace each kernel once per rank with recording shims of
+  the ``shmem/device.py`` primitive surface (the
+  ``tests/test_overlap_structure.py::_spy_comm`` monkeypatch seam,
+  promoted to a first-class recording mode) and build its per-rank event
+  trace with every SPMD peer expression resolved to a concrete rank;
+- :mod:`verify` — check, for every rank of a given world: credit balance
+  (every wait producible by matching puts/signals, every slot drained to
+  zero at kernel exit), static deadlock freedom (no wait-without-producer,
+  no circular wait), chunk-major issue order for the chunked a2a family,
+  bounded-wait coverage against the ``resilience/sites.py`` numbering and
+  the ``TELEM_SLOTS`` telemetry window, and landing-view (canary) coverage
+  of the chunked put families;
+- :mod:`defects` — seeded-defect harness: mutate captured traces (dropped
+  wait, dropped/extra signal, swapped chunk issue order, missing drain)
+  and require an actionable, site-numbered diagnosis for each;
+- :mod:`sweep` — drive ``verify_family`` across every tune-space tuple of
+  all seven kernel families at worlds {2, 4, 8} (the CLI is
+  ``scripts/protocol_lint.py``).
+
+See docs/analysis.md for the graph model, the checked invariants, and the
+known limits.
+"""
+
+from triton_dist_tpu.analysis.capture import (
+    CaptureError,
+    WorldCapture,
+    capture_world,
+)
+from triton_dist_tpu.analysis.verify import Report, verify_capture
+from triton_dist_tpu.analysis.defects import DEFECTS, seed_defect
+from triton_dist_tpu.analysis.sweep import (
+    FAMILIES,
+    family_tuples,
+    run_sweep,
+    verify_family,
+)

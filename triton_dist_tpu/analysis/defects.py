@@ -1,0 +1,189 @@
+"""Seeded-defect harness (ISSUE 10 tentpole).
+
+A verifier that has never seen a broken protocol proves nothing. This
+module mutates CAPTURED graphs the way real emitter/schedule bugs would —
+a dropped wait, a dropped or duplicated signal, a swapped chunk issue
+order, a missing end-of-kernel drain — and the test/CI harness requires
+``analysis/verify.py`` to flag every one with an actionable diagnosis that
+names the afflicted slot or site (and to stay SILENT on the unmutated
+twin: the zero-false-positive half of the contract).
+
+Mutations operate on the captured event lists, not on live kernels: the
+defect is injected exactly at the protocol layer the verifier reasons
+about, so each seeded graph isolates one invariant.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+
+from triton_dist_tpu.analysis import capture as C
+from triton_dist_tpu.analysis.verify import _slot_name, verify_capture
+
+
+@dataclasses.dataclass
+class SeededDefect:
+    """One mutated capture plus what the verifier must say about it."""
+
+    name: str
+    capture: C.WorldCapture
+    expect_check: str      # the Finding.check that must appear
+    expect_naming: str     # substring the diagnosis must contain
+
+
+def _events(cap: C.WorldCapture, rank: int = 0) -> list[C.Event]:
+    return cap.traces[rank].launches[-1].events
+
+
+def _find_last(events, op, pred=lambda e: True) -> int:
+    for i in range(len(events) - 1, -1, -1):
+        if events[i].op == op and pred(events[i]):
+            return i
+    raise ValueError(f"capture has no {op!r} event to mutate")
+
+
+def drop_wait(cap: C.WorldCapture) -> SeededDefect:
+    """An emitter that forgets a consuming wait: the matching credit is
+    never drained, so the slot ends the launch pre-satisfied."""
+    cap = copy.deepcopy(cap)
+    events = _events(cap)
+    i = _find_last(events, C.WAIT, lambda e: e.slot[0] != "<barrier>")
+    ev = events.pop(i)
+    return SeededDefect(
+        "dropped_wait", cap, "credit_balance", _slot_name(ev.slot)
+    )
+
+
+def drop_signal(cap: C.WorldCapture) -> SeededDefect:
+    """A lost/never-emitted signal: the consumer's wait has no producer —
+    the static form of the runtime hang the watchdog exists for."""
+    cap = copy.deepcopy(cap)
+    events = _events(cap)
+    i = _find_last(events, C.SIGNAL, lambda e: e.slot[0] != "<barrier>")
+    ev = events.pop(i)
+    return SeededDefect(
+        "dropped_signal", cap, "deadlock", _slot_name(ev.slot)
+    )
+
+
+def extra_signal(cap: C.WorldCapture) -> SeededDefect:
+    """A double-issued signal (the dup_signal chaos kind, statically):
+    one surplus credit survives the launch."""
+    cap = copy.deepcopy(cap)
+    events = _events(cap)
+    i = _find_last(events, C.SIGNAL, lambda e: e.slot[0] != "<barrier>")
+    events.insert(i, copy.deepcopy(events[i]))
+    return SeededDefect(
+        "extra_signal", cap, "credit_balance", _slot_name(events[i].slot)
+    )
+
+
+def swap_chunk_order(cap: C.WorldCapture) -> SeededDefect:
+    """Chunk puts issued peer-major instead of chunk-major: numerically
+    invisible (same credits), but it forfeits the first-chunk-latency
+    contract of the chunked a2a — only the order check can see it."""
+    cap = copy.deepcopy(cap)
+    events = _events(cap)
+    mark = next(
+        (e for e in events
+         if e.op == C.CHUNKED and e.meta.get("form") == "a2a"),
+        None,
+    )
+    if mark is None or mark.meta["n_chunks"] < 2:
+        # ValueError is the harness's "not applicable to this capture"
+        # protocol (run_defect_suite moves on to the next candidate)
+        raise ValueError("need a chunked (>1) a2a capture to swap order")
+    puts = [i for i, e in enumerate(events) if e.op == C.PUT
+            and e.meta.get("chunk_signal")]
+    a, b = None, None
+    for i in puts:
+        for j in puts:
+            if j > i and events[j].slot[1][-1] != events[i].slot[1][-1]:
+                a, b = i, j
+                break
+        if a is not None:
+            break
+    events[a], events[b] = events[b], events[a]
+    return SeededDefect(
+        "swapped_chunk_order", cap, "chunk_order", "CHUNK-MAJOR"
+    )
+
+
+def drop_drain(cap: C.WorldCapture) -> SeededDefect:
+    """A kernel that returns without draining a put's send semaphore
+    (a missing quiet / wait_send): residue on the send slot."""
+    cap = copy.deepcopy(cap)
+    events = _events(cap)
+    i = _find_last(events, C.WAIT_SEND)
+    ev = events.pop(i)
+    return SeededDefect(
+        "missing_drain", cap, "credit_balance", _slot_name(ev.slot)
+    )
+
+
+DEFECTS = {
+    "dropped_wait": drop_wait,
+    "dropped_signal": drop_signal,
+    "extra_signal": extra_signal,
+    "swapped_chunk_order": swap_chunk_order,
+    "missing_drain": drop_drain,
+}
+
+
+def seed_defect(cap: C.WorldCapture, kind: str) -> SeededDefect:
+    return DEFECTS[kind](cap)
+
+
+def run_defect_suite(
+    captures: dict[str, C.WorldCapture], *,
+    require_all: bool = True, notes: list[str] | None = None,
+) -> list[str]:
+    """Drive every defect kind against an applicable clean capture and
+    return a list of failures (empty = the harness is green). ``captures``
+    maps a descriptive key to a clean WorldCapture; defects pick the first
+    capture they apply to. Three-way contract per defect: the clean twin
+    verifies OK, the mutated graph is flagged with the expected check, and
+    the diagnosis names the afflicted slot/site.
+
+    ``require_all=False`` (a family-subset run whose pool cannot offer
+    every defect a capture — e.g. no chunked a2a) downgrades "no
+    applicable capture" from a failure to an entry in ``notes``; the full
+    sweep keeps it a failure, so CI can never silently lose a defect."""
+    failures: list[str] = []
+    for kind, mutate in DEFECTS.items():
+        seeded = None
+        for key, cap in captures.items():
+            try:
+                seeded = mutate(cap)
+            except ValueError:
+                continue
+            clean = verify_capture(cap)
+            if not clean.ok:
+                failures.append(
+                    f"{kind}: clean twin {key} already fails: "
+                    f"{clean.errors[0]}"
+                )
+                break
+            rep = verify_capture(seeded.capture)
+            hits = [f for f in rep.errors if f.check == seeded.expect_check]
+            if not hits:
+                failures.append(
+                    f"{kind}: NOT flagged on {key} (errors: "
+                    f"{[str(f) for f in rep.errors]})"
+                )
+            elif not any(seeded.expect_naming in f.message for f in hits):
+                failures.append(
+                    f"{kind}: diagnosis does not name "
+                    f"{seeded.expect_naming!r}: {hits[0]}"
+                )
+            break
+        if seeded is None:
+            if require_all:
+                failures.append(f"{kind}: no applicable capture offered")
+            elif notes is not None:
+                notes.append(
+                    f"defect {kind} skipped: no applicable capture in "
+                    f"this family subset"
+                )
+    return failures

@@ -1,0 +1,322 @@
+"""Static checks over a captured signal graph (ISSUE 10 tentpole).
+
+Input: a :class:`~triton_dist_tpu.analysis.capture.WorldCapture` — one
+deterministic per-rank event trace of one kernel tuple. The checks:
+
+1. **Credit balance** — every semaphore slot that participates in the
+   signal protocol (received a put/signal credit, or is a put's send side)
+   drains to exactly zero on every rank by kernel exit: every wait's
+   expected count was producible by matching puts/signals, and no residual
+   credit can pre-satisfy the next launch's wait on the (persistent,
+   per-collective_id) hardware semaphores — the residual-drain discipline
+   the integrity canary depends on.
+2. **Static deadlock freedom** — a greedy cross-rank schedule must retire
+   every event. Greedy is exact here: slots are per-rank pools (no two
+   ranks compete for one credit), every rank's trace is sequential, and
+   advancing any rank only ever ADDS credits for others — so a stall is a
+   real wait-without-producer / circular wait, and the report names each
+   blocked rank's site, slot, and missing credits.
+3. **Chunk-major issue order** — inside a chunked-a2a emission, every
+   peer's chunk ``j`` must be issued before any peer's chunk ``j+1`` (the
+   first-chunk-latency contract of
+   ``shmem.putmem_signal_chunked_a2a_nbi_block``).
+4. **Bounded-wait coverage** — every wait edge carries a
+   ``watchdog.bounded_wait`` site; per launch, the sites are the dense
+   ``0..n-1`` numbering of ``resilience/sites.py``; launches whose site
+   count exceeds the ``TELEM_SLOTS`` telemetry window are reported (at
+   runtime such sites only bump an overflow counter — the schedule is
+   still sound, so this is a warning, not an error).
+5. **Landing-view coverage** — chunk-signal puts that declare no
+   ``recv_view=`` landing view get no payload canary; the affected
+   families are reported so the canary-coverage hole is tracked by a tool
+   instead of a docstring (a documented gap, so a warning).
+
+Local DMA chains (slots that never see a put/signal credit) are excluded
+from the balance/deadlock model: their start/wait bookkeeping may sit
+inside data-dependent compute branches, which the eager capture resolves
+for one concrete input only. Every cross-rank edge in these kernels lives
+at the unrolled comm level (the overlap-structure invariant), so the
+protocol slots are always fully resolved.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+
+from triton_dist_tpu.analysis import capture as C
+from triton_dist_tpu.resilience import sites as S
+
+
+@dataclasses.dataclass
+class Finding:
+    check: str       # "credit_balance" | "deadlock" | "chunk_order" | ...
+    message: str
+
+    def __str__(self):
+        return f"[{self.check}] {self.message}"
+
+
+@dataclasses.dataclass
+class Report:
+    family: str
+    world: int
+    label: str
+    errors: list[Finding] = dataclasses.field(default_factory=list)
+    warnings: list[Finding] = dataclasses.field(default_factory=list)
+    stats: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def summary(self) -> str:
+        head = (
+            f"{self.family}[{self.label}] world={self.world}: "
+            f"{'OK' if self.ok else 'FAIL'} "
+            f"(events={self.stats.get('events', 0)}, "
+            f"slots={self.stats.get('protocol_slots', 0)}, "
+            f"sites/launch={self.stats.get('max_sites', 0)})"
+        )
+        lines = [head]
+        lines += [f"  ERROR {f}" for f in self.errors]
+        lines += [f"  warn  {f}" for f in self.warnings]
+        return "\n".join(lines)
+
+
+def _slot_name(slot: tuple) -> str:
+    return f"{slot[0]}{list(slot[1])}"
+
+
+# ---------------------------------------------------------------------------
+# The greedy cross-rank schedule (checks 1 + 2)
+# ---------------------------------------------------------------------------
+
+_BLOCKING = {C.WAIT, C.WAIT_RECV, C.WAIT_SEND, C.DMA_WAIT}
+
+
+def _protocol_slots(cap: C.WorldCapture) -> set:
+    """Slots the signal protocol owns: any slot credited by a put (recv
+    side at the destination, send side at the issuer) or a pure signal.
+    Everything else is a local DMA chain — excluded (module docstring)."""
+    slots = set()
+    for t in cap.traces:
+        for l in t.launches:
+            for e in l.events:
+                if e.op == C.PUT:
+                    slots.add(e.slot)
+                    slots.add(_send_slot(e))
+                elif e.op == C.SIGNAL:
+                    slots.add(e.slot)
+    return slots
+
+
+def _send_slot(put_ev: C.Event) -> tuple:
+    # the put's send-side slot rides in meta (see capture.putmem_nbi_block)
+    return put_ev.meta["send_slot"]
+
+
+def _launch_events(cap: C.WorldCapture, li: int) -> list[list[C.Event]]:
+    return [t.launches[li].events for t in cap.traces]
+
+
+def _simulate(cap: C.WorldCapture, li: int, report: Report) -> None:
+    """Greedy retirement of launch ``li`` across all ranks; appends
+    deadlock and credit-balance findings."""
+    world = cap.world
+    family = cap.traces[0].launches[li].family
+    events = _launch_events(cap, li)
+    protocol = _protocol_slots(cap)
+    pools: dict[tuple, int] = defaultdict(int)  # (rank, slot) -> credits
+    pcs = [0] * world
+
+    def tracked(slot) -> bool:
+        return slot in protocol
+
+    def runnable(r: int):
+        """Whether rank r's next event can retire; returns (ok, why)."""
+        e = events[r][pcs[r]]
+        if e.op in _BLOCKING and tracked(e.slot):
+            need = e.value if e.op == C.WAIT else 1
+            have = pools[(r, e.slot)]
+            return have >= need, (
+                f"{e.op} slot {_slot_name(e.slot)}"
+                + (f" site {e.site}" if e.site is not None else "")
+                + f" needs {need}, has {have}"
+            )
+        return True, ""
+
+    def retire(r: int):
+        e = events[r][pcs[r]]
+        if e.op == C.PUT:
+            pools[(e.dst, e.slot)] += 1           # data-coupled recv credit
+            ss = _send_slot(e)
+            if tracked(ss):
+                pools[(r, ss)] += 1               # local send completion
+        elif e.op == C.SIGNAL:
+            pools[(e.dst, e.slot)] += e.value
+        elif e.op == C.DMA_START and tracked(e.slot):
+            pools[(r, e.slot)] += 1
+        elif e.op in _BLOCKING and tracked(e.slot):
+            pools[(r, e.slot)] -= e.value if e.op == C.WAIT else 1
+        pcs[r] += 1
+
+    progressed = True
+    while progressed:
+        progressed = False
+        for r in range(world):
+            while pcs[r] < len(events[r]):
+                ok, _ = runnable(r)
+                if not ok:
+                    break
+                retire(r)
+                progressed = True
+
+    stuck = [r for r in range(world) if pcs[r] < len(events[r])]
+    if stuck:
+        for r in stuck:
+            _, why = runnable(r)
+            report.errors.append(Finding(
+                "deadlock",
+                f"{family}: rank {r} blocked at event {pcs[r]} — {why}; "
+                f"no matching producer can ever run "
+                f"(wait-without-producer or circular wait)",
+            ))
+        return  # balance over a wedged schedule would double-report
+
+    for (r, slot), credits in sorted(pools.items()):
+        if credits != 0:
+            what = "residual credit" if credits > 0 else "over-consumed"
+            report.errors.append(Finding(
+                "credit_balance",
+                f"{family}: rank {r} slot {_slot_name(slot)} ends with "
+                f"{credits:+d} ({what}) — the slot does not drain to zero "
+                f"at kernel exit, so the next launch on this persistent "
+                f"semaphore starts pre-{'satisfied' if credits > 0 else 'starved'}",
+            ))
+
+
+# ---------------------------------------------------------------------------
+# Checks 3-5: order, site coverage, landing views
+# ---------------------------------------------------------------------------
+
+def _check_chunk_order(cap: C.WorldCapture, li: int, report: Report) -> None:
+    for t in cap.traces:
+        events = t.launches[li].events
+        i = 0
+        while i < len(events):
+            e = events[i]
+            if e.op == C.CHUNKED and e.meta.get("form") == "a2a":
+                n_peers = e.meta["n_peers"]
+                n_chunks = e.meta["n_chunks"]
+                puts = []
+                j = i + 1
+                while j < len(events) and len(puts) < n_peers * n_chunks:
+                    if events[j].op == C.PUT:
+                        puts.append(events[j])
+                    j += 1
+                chunk_of = [p.slot[1][-1] for p in puts]
+                if chunk_of != sorted(chunk_of):
+                    first_bad = next(
+                        k for k in range(1, len(chunk_of))
+                        if chunk_of[k] < chunk_of[k - 1]
+                    )
+                    report.errors.append(Finding(
+                        "chunk_order",
+                        f"{t.launches[li].family}: rank {t.rank} issued "
+                        f"chunk {chunk_of[first_bad]} of slot "
+                        f"{_slot_name(puts[first_bad].slot)} after chunk "
+                        f"{chunk_of[first_bad - 1]} — a2a puts must be "
+                        f"CHUNK-MAJOR (every peer's chunk j before any "
+                        f"chunk j+1)",
+                    ))
+                i = j
+            else:
+                i += 1
+
+
+def _check_sites(cap: C.WorldCapture, li: int, report: Report) -> None:
+    for t in cap.traces:
+        l = t.launches[li]
+        sites = [e.site for e in l.events if e.op == C.WAIT]
+        kinds = [e.kind for e in l.events if e.op == C.WAIT]
+        if any(s is None for s in sites):
+            report.errors.append(Finding(
+                "bounded_wait",
+                f"{l.family}: rank {t.rank} has a wait edge with no "
+                f"bounded_wait site — it would spin forever on a lost "
+                f"signal with no diagnostic",
+            ))
+            continue
+        if sites != list(range(len(sites))) or len(sites) != l.n_wait_sites:
+            report.errors.append(Finding(
+                "site_numbering",
+                f"{l.family}: rank {t.rank} wait sites {sites} are not the "
+                f"dense 0..{l.n_wait_sites - 1} numbering of "
+                f"resilience/sites.py — diag records and telemetry rows "
+                f"would name different waits",
+            ))
+        if any(k not in S.BOUNDED_KINDS for k in kinds):
+            bad = [S.kind_name(k) for k in kinds if k not in S.BOUNDED_KINDS]
+            report.errors.append(Finding(
+                "bounded_wait",
+                f"{l.family}: rank {t.rank} waits with non-bounded "
+                f"kind(s) {bad}",
+            ))
+        if l.n_wait_sites > S.TELEM_SLOTS and t.rank == 0:
+            report.warnings.append(Finding(
+                "telem_budget",
+                f"{l.family}: {l.n_wait_sites} wait sites per launch "
+                f"exceed the TELEM_SLOTS={S.TELEM_SLOTS} telemetry window "
+                f"— sites past it only bump the overflow header "
+                f"(obs/telemetry.py); spin attribution for them is lost",
+            ))
+
+
+def _check_landing_views(cap: C.WorldCapture, li: int, report: Report) -> None:
+    t = cap.traces[0]
+    l = t.launches[li]
+    n_chunk_puts = sum(
+        1 for e in l.events if e.op == C.PUT and e.meta.get("chunk_signal")
+    )
+    n_covered = sum(
+        1 for e in l.events
+        if e.op == C.PUT and e.meta.get("chunk_signal")
+        and e.meta.get("landing_view")
+    )
+    if n_chunk_puts and n_covered < n_chunk_puts:
+        report.warnings.append(Finding(
+            "landing_view",
+            f"{l.family}: {n_chunk_puts - n_covered}/{n_chunk_puts} "
+            f"chunk-signal puts declare no recv_view= landing view — the "
+            f"payload canary (ISSUE 8) cannot cover them; detection for "
+            f"this family rests on the host-tier output guards",
+        ))
+
+
+def verify_capture(cap: C.WorldCapture) -> Report:
+    report = Report(family=cap.family, world=cap.world, label=cap.label)
+    n_launches = len(cap.traces[0].launches)
+    for li in range(n_launches):
+        fams = {t.launches[li].family for t in cap.traces}
+        if len(fams) != 1:
+            report.errors.append(Finding(
+                "structure", f"launch {li} family differs across ranks: {fams}"
+            ))
+            continue
+        _simulate(cap, li, report)
+        _check_chunk_order(cap, li, report)
+        _check_sites(cap, li, report)
+        _check_landing_views(cap, li, report)
+    report.stats = {
+        "events": sum(
+            len(l.events) for t in cap.traces for l in t.launches
+        ),
+        "protocol_slots": len(_protocol_slots(cap)),
+        "max_sites": max(
+            (l.n_wait_sites for t in cap.traces for l in t.launches),
+            default=0,
+        ),
+        "launches": n_launches,
+    }
+    return report

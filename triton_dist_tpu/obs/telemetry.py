@@ -52,9 +52,14 @@ from __future__ import annotations
 
 import threading
 
+from triton_dist_tpu.resilience import sites as _sites
+
 # --- buffer layout (int32 slots) -------------------------------------------
 
-TELEM_SLOTS = 32    # trace-time wait sites recorded per kernel launch
+# the per-launch site window comes from the ONE shared numbering table
+# (resilience/sites.py) — the diag records, this buffer, and the static
+# protocol verifier (triton_dist_tpu/analysis) key waits identically
+TELEM_SLOTS = _sites.TELEM_SLOTS
 TELEM_BINS = 8      # log4 spin-histogram bins per site
 TELEM_FIELDS = 4 + TELEM_BINS
 

@@ -37,6 +37,7 @@ from triton_dist_tpu.resilience import elastic as elastic
 from triton_dist_tpu.resilience import health as health
 from triton_dist_tpu.resilience import integrity as integrity
 from triton_dist_tpu.resilience import retry as retry
+from triton_dist_tpu.resilience import sites as sites
 from triton_dist_tpu.resilience import watchdog as watchdog
 from triton_dist_tpu.resilience.faults import (
     KINDS as FAULT_KINDS,

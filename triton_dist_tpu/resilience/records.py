@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import threading
 
+from triton_dist_tpu.resilience import sites as _sites
+
 # int32 slots of the per-kernel diagnostic buffer
 DIAG_LEN = 8
 
@@ -38,33 +40,18 @@ STATUS_OK = 0
 STATUS_TIMEOUT = 1
 STATUS_INTEGRITY = 2  # a payload canary mismatch, not an expired wait
 
-# wait kinds
-KIND_SIGNAL = 1   # shmem.signal_wait_until
-KIND_WAIT = 2     # shmem.wait (dl.wait parity)
-KIND_BARRIER = 3  # a dissemination-barrier round in shmem.barrier_all
-KIND_CHUNK = 4    # shmem.wait_chunk: a per-chunk arrival wait of a chunked
-                  # put (the sub-shard granularity of the ring pipelines)
-KIND_INTEGRITY = 5  # shmem.wait_chunk canary: the landed chunk's payload
-                    # checksum disagreed with the one the producer folded
-                    # into the chunk signal (resilience/integrity.py) —
-                    # F_EXPECTED is the locally recomputed checksum,
-                    # F_OBSERVED the producer's signalled one
+# wait kinds: re-exported from the ONE shared table (resilience/sites.py,
+# ISSUE 10 satellite) so records, watchdog, obs telemetry, and the static
+# protocol verifier can never drift on the numbering. F_EXPECTED of a
+# KIND_INTEGRITY record is the locally recomputed checksum, F_OBSERVED the
+# producer's signalled one.
+KIND_SIGNAL = _sites.KIND_SIGNAL
+KIND_WAIT = _sites.KIND_WAIT
+KIND_BARRIER = _sites.KIND_BARRIER
+KIND_CHUNK = _sites.KIND_CHUNK
+KIND_INTEGRITY = _sites.KIND_INTEGRITY
 
-_KIND_NAMES = {
-    KIND_SIGNAL: "signal_wait_until",
-    KIND_WAIT: "wait",
-    KIND_BARRIER: "barrier_all",
-    KIND_CHUNK: "chunk_wait",
-    KIND_INTEGRITY: "integrity_check",
-}
-
-
-def kind_name(code: int) -> str:
-    """Readable name of a KIND_* code — shared by the timeout-record
-    decode below and the obs layer's wait-telemetry decode
-    (obs/telemetry.py), so a spin histogram and a timeout record name
-    the same wait the same way."""
-    return _KIND_NAMES.get(int(code), f"<kind {int(code)}>")
+kind_name = _sites.kind_name
 
 
 # ---------------------------------------------------------------------------

@@ -56,6 +56,9 @@ class KernelDiagScope:
         self.telem_ref = telem_ref
 
     def next_wait_site(self) -> int:
+        """THE wait-site allocator: dense ordinals in trace order — the
+        numbering contract of resilience/sites.py that diag records,
+        telemetry rows, and the static protocol verifier all share."""
         s = self._wait_sites
         self._wait_sites += 1
         return s

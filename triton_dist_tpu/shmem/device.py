@@ -2,8 +2,11 @@
 
 This is the TPU-native re-design of the reference's device-side OpenSHMEM
 surface — ``patches/triton/python/triton/language/extra/libshmem_device.py``
-(337 LoC portable stub; full list in reference ``docs/primitives.md:19-56``)
-and the ``dl.*`` dialect ops (``python/triton_dist/language.py:57-112``).
+(337 LoC portable stub) and the ``dl.*`` dialect ops
+(``python/triton_dist/language.py:57-112``). The full mapping tables live
+in ``docs/primitives.md`` (anchors ``#one-sided-puts`` through
+``#barriers`` — section anchors, not line numbers, so they cannot rot as
+that file grows).
 
 Mapping (see SURVEY.md §7 design table):
 
@@ -203,8 +206,8 @@ class PutHandle:
 
 def putmem_nbi_block(dst_ref, src_ref, pe, axis: str, send_sem, recv_sem):
     """Non-blocking one-sided put: write local `src_ref` into PE `pe`'s
-    `dst_ref` (≙ ``libshmem_device.putmem_nbi_block``,
-    reference docs/primitives.md:34).
+    `dst_ref` (≙ ``libshmem_device.putmem_nbi_block``; mapping row in
+    ``docs/primitives.md#one-sided-puts``).
 
     Returns the started ``AsyncCopyDescriptor``. The *remote* device's
     `recv_sem` is incremented when the data has fully landed — this is the
@@ -234,8 +237,8 @@ def putmem_block(dst_ref, src_ref, pe, axis: str, send_sem, recv_sem):
 
 
 def putmem_signal_nbi_block(dst_ref, src_ref, sig_sem, pe, axis: str, send_sem):
-    """Put + signal in one op (≙ ``putmem_signal_nbi_block``,
-    docs/primitives.md:40): on TPU the signal is simply the remote receive
+    """Put + signal in one op (≙ ``putmem_signal_nbi_block``; mapping row
+    in ``docs/primitives.md#one-sided-puts``): on TPU the signal is simply the remote receive
     semaphore of the same DMA, so arrival of the signal *implies* arrival of
     the data (stronger than NVSHMEM, which needs NVSHMEM_SIGNAL_ADD +
     ordering)."""
@@ -306,9 +309,10 @@ def putmem_signal_chunked_nbi_block(
     dst_at, src_at, pe, axis: str, send_at, recv_at, sig_at, spans,
     ready=None, recv_view=None,
 ):
-    """Chunked put + per-chunk signal (≙ one ``putmem_signal_nbi_block`` per
-    sub-shard chunk, reference docs/primitives.md:40 — the producer side of
-    tile-granular progress): split one shard transfer into the static
+    """Chunked put + per-chunk signal (≙ one ``putmem_signal_nbi_block``
+    per sub-shard chunk — the producer side of tile-granular progress;
+    mapping row in ``docs/primitives.md#one-sided-puts``): split one shard
+    transfer into the static
     ``spans`` from :func:`ops.common.chunk_schedule`, each chunk pushed as
     its own DMA whose data-coupled recv semaphore slot signals that chunk's
     arrival alone.
