@@ -994,6 +994,38 @@ def _run_serving(argv) -> None:
     )
     for name, value, unit in sbench.info_lines(rows):
         emit_info(name, value, unit)
+    # overload A/B (ISSUE 11): the same λ axis under flash-crowd burst
+    # traffic with priorities + deadlines, controller OFF vs ON. Off
+    # reproduces the PR 6 collapse (goodput → 0 past saturation as
+    # queueing delay blows every SLO); on sheds the right work — goodput
+    # plateaus, interactive p99 TTFT stays bounded, the shed-rate column
+    # absorbs the excess. Seeded + FakeClock ⇒ both arms replayable;
+    # info lines only, never perf-gated.
+    from triton_dist_tpu.serving import OverloadConfig
+
+    ab_traffic = dict(
+        # flash crowds at MEAN rate λ (burst_every_s derives as
+        # burst_n/λ), so the sweep axis stays offered load
+        process="burst", burst_n=8,
+        priority_mix=((0.6, "interactive"), (0.4, "batch")),
+        # a deadline tighter than the saturation queueing delay: expiry
+        # sheds trim the backlog before it poisons survivors' TTFT
+        deadline_ms=("uniform", 300, 1500),
+    )
+    for tag, overload in (
+        ("_ov_off", None),
+        ("_ov_on", OverloadConfig(min_dwell_steps=4, window_steps=8)),
+    ):
+        ab_rows = sbench.sweep_offered_load(
+            cfg, params, mesh, s_max=32, rates=rates, n_requests=48,
+            prompt_len=("uniform", 2, 6), output_len=("uniform", 2, 8),
+            seed=0, virtual_step_s=0.05,
+            slo=SLOTargets(ttft_ms=800.0, e2e_ms=3000.0),
+            serving_kw=dict(max_queue=24, overload=overload),
+            traffic_kw=ab_traffic, tag=tag.strip("_") + ":",
+        )
+        for name, value, unit in sbench.info_lines(ab_rows, tag=tag):
+            emit_info(name, value, unit)
     if obs_path is not None:
         obs.export_chrome_trace(obs_path, label="bench_serving")
 

@@ -56,6 +56,21 @@
 # needs NO interpreter, so this coverage is identical on every jax line.
 # Skip with TDT_SKIP_PROTOCOL_LINT=1.
 #
+# Since ISSUE 11 the matrix also covers the OVERLOAD cells
+# (tests/test_overload.py): deadline-expiry shedding, priority shed
+# order, per-class retry-budget exhaustion, brownout-ladder hysteresis
+# on a FakeClock, the disarmed-byte-identity pin, and the QUICK CHAOS
+# SOAK cell — one seeded multi-fault campaign (flash-crowd bursts ×
+# persistent straggler × payload corruption) through resilience/soak.py
+# with its invariants (no lost request, no deadlock, balanced
+# accounting, bit-identical seeded replay). The full 20-campaign soak is
+# scripts/chaos_soak.py / `pytest -m soak` (soak implies slow).
+#
+# Every cell runs under a wall-clock budget (TDT_CELL_TIMEOUT_S,
+# default 600 s; conftest.py delivers it as a SIGALRM inside the cell):
+# a hung cell reports as one named FAILED row — and so fails the exit
+# code — instead of stalling the whole matrix.
+#
 # Per-cell failures propagate into the exit code (CI gates on it), and a
 # pass/fail summary table is printed after the run.
 #
@@ -74,17 +89,24 @@ trap 'rm -f "$log"' EXIT
 files="tests/test_chaos.py tests/test_elastic.py \
     tests/test_chunked.py tests/test_chunked_a2a.py tests/test_ragged.py \
     tests/test_emitter.py tests/test_serving.py tests/test_integrity.py \
-    tests/test_obs.py tests/test_analysis.py"
+    tests/test_obs.py tests/test_analysis.py tests/test_overload.py"
 marker="chaos"
 lint_args=""
 if [ "${1:-}" = "--quick" ]; then
     shift
-    files="tests/test_integrity.py tests/test_serving.py tests/test_elastic.py"
+    files="tests/test_integrity.py tests/test_serving.py \
+        tests/test_elastic.py tests/test_overload.py"
     marker="chaos and not slow"
     # keep the quick posture bounded: worlds {2,4} (the full {2,4,8}
     # sweep is the default standalone run's job)
     lint_args="--quick"
 fi
+
+# one hung cell must not stall the matrix: conftest.py turns this budget
+# into a SIGALRM TimeoutError inside the cell (named FAILED row, exit
+# code propagates). Override or set to 0 to disable.
+: "${TDT_CELL_TIMEOUT_S:=600}"
+export TDT_CELL_TIMEOUT_S
 
 # -v so every cell prints its own PASSED/FAILED/SKIPPED line for the
 # summary; the pytest exit code is captured, not exec'd away, so the

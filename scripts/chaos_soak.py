@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Chaos-soak CLI (ISSUE 11): run N seeded multi-fault campaigns and gate
+on their invariants.
+
+Each campaign composes the faults ``chaos_matrix.sh`` only proves in
+isolation — flash-crowd λ bursts × a persistent straggler (mesh shrink
+mid-overload) × payload corruption — through the production serving
+engine with the overload controller armed, and asserts on every one:
+
+- every offered request reaches exactly ONE terminal state
+  (Finished / Shed / Poisoned / terminal Rejected) — no lost requests;
+- the serve loop drains inside the step budget with no residual queued
+  or in-flight work — no deadlock;
+- serving counters, per-class shed counters, and the health registry
+  agree with the terminal census — accounting balances;
+- campaign 0 is re-run from its seed and must reproduce a byte-identical
+  fingerprint — seeded replay.
+
+Usage::
+
+    scripts/chaos_soak.py [--campaigns N] [--seed-base S] [--quick]
+                          [--no-replay-check]
+
+``--quick`` runs 3 small campaigns (the chaos-matrix cell posture);
+the default 20 campaigns are the ISSUE 11 acceptance run. Exit code 0
+iff every campaign is green (and the replay check holds).
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the virtual 8-device CPU mesh, exactly as tests/conftest.py arranges it
+# — MUST happen before jax initializes
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--campaigns", type=int, default=20)
+    ap.add_argument("--seed-base", type=int, default=0)
+    ap.add_argument("--quick", action="store_true",
+                    help="3 small campaigns (chaos-matrix cell posture)")
+    ap.add_argument("--no-replay-check", action="store_true")
+    args = ap.parse_args(argv)
+
+    from triton_dist_tpu import config as tdt_config
+
+    tdt_config.update(interpret=True)
+
+    from triton_dist_tpu.resilience import soak
+
+    n = 3 if args.quick else args.campaigns
+    small = dict(n_requests=12, n_timeouts=1, n_corruptions=1,
+                 fault_window=20) if args.quick else {}
+
+    rows = []
+    t0 = time.time()
+    for k in range(n):
+        spec = soak.SoakSpec(seed=args.seed_base + k, **small)
+        t1 = time.time()
+        res = soak.run_campaign(spec)
+        dt = time.time() - t1
+        census = {}
+        for kind in res.terminals.values():
+            census[kind] = census.get(kind, 0) + 1
+        verdict = "PASS" if res.ok else "FAIL"
+        rows.append((spec.seed, verdict, res))
+        print(
+            f"  campaign seed={spec.seed:<4d} {verdict}  "
+            f"{dt:6.1f}s  terminals={dict(sorted(census.items()))} "
+            f"rebuilds={res.rebuilds} transitions={len(res.transitions)} "
+            f"fp={res.fingerprint[:12]}",
+            flush=True,
+        )
+        if not res.ok:
+            for f in res.failures:
+                print(f"    INVARIANT: {f}")
+            if res.error:
+                print(f"    ERROR: {res.error}")
+
+    replay_ok = True
+    if not args.no_replay_check and rows:
+        seed0, _, first = rows[0]
+        spec = soak.SoakSpec(seed=seed0, **small)
+        again = soak.run_campaign(spec)
+        replay_ok = again.fingerprint == first.fingerprint
+        print(
+            f"  replay check seed={seed0}: "
+            f"{'bit-identical' if replay_ok else 'MISMATCH'} "
+            f"({first.fingerprint[:12]} vs {again.fingerprint[:12]})"
+        )
+
+    n_fail = sum(1 for _, v, _ in rows if v != "PASS")
+    print(
+        f"chaos soak: {len(rows)} campaigns, {n_fail} failing, replay "
+        f"{'OK' if replay_ok else 'MISMATCH'}, {time.time() - t0:.0f}s"
+    )
+    if n_fail or not replay_ok:
+        print("chaos soak: FAIL")
+        return 1
+    print("chaos soak: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

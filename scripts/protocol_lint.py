@@ -11,8 +11,9 @@ of ``triton_dist_tpu/analysis`` — no devices, no interpreter, any jax line
 - chunk-major issue order for the chunked a2a family;
 - bounded-wait coverage (dense ``resilience/sites.py`` site numbering;
   launches past the TELEM_SLOTS telemetry window reported);
-- landing-view (canary) coverage of the chunked put families (reported —
-  the documented ISSUE 8 gap set, tracked here instead of in docstrings).
+- landing-view (canary) coverage of the chunked put families — a FAILURE
+  since ISSUE 11 closed the gap set: every chunk-signal put must declare
+  its ``recv_view=`` so the ISSUE 8 payload canary can cover it.
 
 Then the seeded-defect harness (``analysis/defects.py``) mutates clean
 captures — dropped wait, dropped/extra signal, swapped chunk order,

@@ -25,6 +25,7 @@ layer count that still covers their property, and serving programs are
 shared across tests via the keyed `jit_shard_map` cache."""
 
 import os
+import signal
 
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
@@ -57,14 +58,79 @@ def pytest_configure(config):
         "drop/dup/delay/straggler × kernel-family matrix is additionally "
         "marked slow — run it standalone via scripts/chaos_matrix.sh",
     )
+    config.addinivalue_line(
+        "markers",
+        "soak: long seeded multi-fault chaos campaigns "
+        "(tests/test_overload.py / resilience/soak.py, ISSUE 11). "
+        "Automatically wired slow so tier-1 stays fast; run via "
+        "scripts/chaos_soak.py or `pytest -m soak`",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
-    # quick == everything not explicitly marked slow, so the quick tier
-    # can't silently lose new tests
     for item in items:
-        if "slow" not in item.keywords:
+        # soak implies slow (ISSUE 11): the campaign tier never rides the
+        # fast gate, and forgetting the second marker can't break that
+        if "soak" in item.keywords and "slow" not in item.keywords:
+            item.add_marker(pytest.mark.slow)
+        # quick == everything not explicitly marked slow, so the quick
+        # tier can't silently lose new tests
+        if "slow" not in item.keywords and "soak" not in item.keywords:
             item.add_marker(pytest.mark.quick)
+
+
+def _cell_alarm(item, phase):
+    """Per-cell wall-clock budget (ISSUE 11 satellite): with
+    ``TDT_CELL_TIMEOUT_S`` set (scripts/chaos_matrix.sh exports it), a
+    SIGALRM fires a TimeoutError inside the hung cell, so it reports as
+    one named FAILED/ERROR row instead of stalling the whole matrix.
+    Armed around ALL THREE phases (setup / call / teardown — a fixture
+    can hang just as hard as a test body). Signal delivery needs the
+    main thread + a Python bytecode boundary — true for every
+    interpret-mode cell here; a cell wedged inside a C call fails at its
+    next return to Python."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def scope():
+        budget = float(os.environ.get("TDT_CELL_TIMEOUT_S", "0") or 0)
+        if budget <= 0 or not hasattr(signal, "SIGALRM"):
+            yield
+            return
+
+        def _alarm(signum, frame):
+            raise TimeoutError(
+                f"cell {phase} exceeded TDT_CELL_TIMEOUT_S={budget:g}s: "
+                f"{item.nodeid}"
+            )
+
+        old = signal.signal(signal.SIGALRM, _alarm)
+        signal.setitimer(signal.ITIMER_REAL, budget)
+        try:
+            yield
+        finally:
+            signal.setitimer(signal.ITIMER_REAL, 0)
+            signal.signal(signal.SIGALRM, old)
+
+    return scope()
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_setup(item):
+    with _cell_alarm(item, "setup"):
+        yield
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    with _cell_alarm(item, "call"):
+        yield
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_teardown(item):
+    with _cell_alarm(item, "teardown"):
+        yield
 
 
 @pytest.fixture(scope="session", autouse=True)

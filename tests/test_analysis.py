@@ -221,13 +221,32 @@ def test_telem_budget_quiet_under_window():
 # Landing-view (canary) coverage (check 5)
 # ---------------------------------------------------------------------------
 
-def test_landing_view_coverage_reported():
-    # the chunked ring allgather declares recv_view (ISSUE 8): silent
-    rep = verify_capture(_cap("allgather", 2, "ring_1d/c2"))
-    assert not [w for w in rep.warnings if w.check == "landing_view"]
-    # the chunked ag_gemm ring does not: the gap is reported by the tool
-    rep2 = verify_capture(_cap("ag_gemm", 2, "bm1024/c2"))
-    assert any(w.check == "landing_view" for w in rep2.warnings)
+def test_landing_view_coverage_closed_and_enforced():
+    """ISSUE 11 satellite: the canary gap set is EMPTY — every chunked
+    family (the former gap set included) declares its landing view — and
+    the lint check is now a FAILURE, so a future chunk-signal put cannot
+    land without opting into payload integrity."""
+    for family, label in (
+        ("allgather", "ring_1d/c2"),        # declared since ISSUE 8
+        ("ag_gemm", "bm1024/c2"),           # the former gap set:
+        ("reduce_scatter", "ring/bm256/c2"),
+        ("gemm_rs", "ring/bm512/c2"),
+    ):
+        rep = verify_capture(_cap(family, 2, label))
+        assert rep.ok, rep.summary()
+        assert not [f for f in rep.errors + rep.warnings
+                    if f.check == "landing_view"], rep.summary()
+    # enforcement: strip one put's landing-view declaration — the report
+    # must FAIL (error, not warning), naming the uncovered count
+    cap = _cap("ag_gemm", 2, "bm1024/c2")
+    for t in cap.traces:
+        for e in t.launches[0].events:
+            if e.op == C.PUT and e.meta.get("chunk_signal"):
+                e.meta["landing_view"] = False
+    rep = verify_capture(cap)
+    hits = [f for f in rep.errors if f.check == "landing_view"]
+    assert hits and "recv_view" in hits[0].message, rep.summary()
+    assert not rep.ok
 
 
 # ---------------------------------------------------------------------------

@@ -267,11 +267,13 @@ def test_bench_info_lines_shape():
     m.observe_step(queue_depth=2, occupied=1, slots=2)
     snap = m.snapshot()
     snap["tokens"]["per_s"] = 1.5
+    snap["tokens"]["goodput_per_s"] = 1.5  # the engine-added twin
     rows = [{"rate_rps": 2.5, "snapshot": snap, "n_finished": 1}]
     lines = sbench.info_lines(rows, tag="_t")
     names = [n for n, _, _ in lines]
     assert f"serving_ttft_p50_ms_lam2.5_t" in names
     assert f"serving_slo_attainment_lam2.5_t" in names
+    assert f"serving_goodput_per_s_lam2.5_t" in names
     assert len(set(names)) == len(names)
     for name, value, unit in lines:
         payload = json.dumps({"metric": name, "value": value, "unit": unit})

@@ -27,9 +27,11 @@ deterministic per-rank event trace of one kernel tuple. The checks:
    runtime such sites only bump an overflow counter — the schedule is
    still sound, so this is a warning, not an error).
 5. **Landing-view coverage** — chunk-signal puts that declare no
-   ``recv_view=`` landing view get no payload canary; the affected
-   families are reported so the canary-coverage hole is tracked by a tool
-   instead of a docstring (a documented gap, so a warning).
+   ``recv_view=`` landing view get no payload canary. As of ISSUE 11 the
+   gap set is empty (the fused MoE pipelines and the chunked
+   ag_gemm/gemm_rs/reduce_scatter rings all declare views), so this is an
+   ERROR: a new chunked family cannot land without opting into payload
+   integrity (it was a tracked warning while the gap set was non-empty).
 
 Local DMA chains (slots that never see a put/signal credit) are excluded
 from the balance/deadlock model: their start/wait bookkeeping may sit
@@ -285,12 +287,14 @@ def _check_landing_views(cap: C.WorldCapture, li: int, report: Report) -> None:
         and e.meta.get("landing_view")
     )
     if n_chunk_puts and n_covered < n_chunk_puts:
-        report.warnings.append(Finding(
+        report.errors.append(Finding(
             "landing_view",
             f"{l.family}: {n_chunk_puts - n_covered}/{n_chunk_puts} "
             f"chunk-signal puts declare no recv_view= landing view — the "
-            f"payload canary (ISSUE 8) cannot cover them; detection for "
-            f"this family rests on the host-tier output guards",
+            f"payload canary (ISSUE 8) cannot cover them. The gap set was "
+            f"closed in ISSUE 11; every chunked family must opt in "
+            f"(declare the landing view, or reshape the protocol so the "
+            f"consumer can name where the mirror chunk lands)",
         ))
 
 

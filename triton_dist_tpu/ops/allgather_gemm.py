@@ -144,6 +144,11 @@ def _ag_gemm_chunked_kernel(
     for s in range(n):
         c = jax.lax.rem(me - s + 2 * n, n)
         base = c * m_loc
+        # the put issued at step s is consumed at step s+1, when the left
+        # neighbor's step-s send — shard (me-1-s) mod n — has landed: that
+        # shard's rows are the landing view (ISSUE 8 canary; the same
+        # arithmetic as the chunked ring allgather's base_in)
+        base_in = jax.lax.rem(me - 1 - s + 2 * n, n) * m_loc
         handles = []
         for j, (off, rows) in enumerate(spans):
             if s > 0:
@@ -156,12 +161,18 @@ def _ag_gemm_chunked_kernel(
                     shmem.putmem_signal2_nbi_block(
                         ag_ref.at[sl], ag_ref.at[sl], right, axis,
                         send_sems.at[s, j], recv_sems.at[s, j],
-                        sig_sems.at[s, j],
+                        sig_sems.at[s, j], canary=True,
                     )
                 )
             pipes[j](ag_ref.at[sl], b_ref, out_ref.at[sl])
         if handles:
-            descs.append(shmem.ChunkedPutHandle(handles))
+            descs.append(shmem.ChunkedPutHandle(
+                handles,
+                recv_at=lambda off, rows, b=base_in: ag_ref.at[
+                    pl.ds(b + off, rows)
+                ],
+                spans=spans,
+            ))
     shmem.quiet(*descs)
 
 

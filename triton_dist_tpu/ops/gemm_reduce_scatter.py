@@ -244,11 +244,20 @@ def _gemm_rs_ring_chunked_kernel(
                     shmem.putmem_signal2_nbi_block(
                         recv_buf.at[s, pl.ds(off, rows)], target, right, axis,
                         send_sems.at[s, j], recv_sems.at[s, j],
-                        sig_sems.at[s, j],
+                        sig_sems.at[s, j], canary=True,
                     )
                 )
         if handles:
-            descs.append(shmem.ChunkedPutHandle(handles))
+            # landing view (ISSUE 8 canary): SPMD symmetry — the left
+            # neighbor's step-s partial lands in OUR recv_buf[s] at the
+            # same span coordinates this put addressed on the right
+            descs.append(shmem.ChunkedPutHandle(
+                handles,
+                recv_at=lambda off, rows, s=s: recv_buf.at[
+                    s, pl.ds(off, rows)
+                ],
+                spans=spans,
+            ))
     shmem.quiet(*descs)
 
 

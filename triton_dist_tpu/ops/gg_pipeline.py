@@ -355,6 +355,11 @@ def make_ag_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int, bn: int,
         descs = []
         for s in range(n):
             c = jax.lax.rem(me - s + 2 * n, n)
+            # landing view for the step-s forward (ISSUE 8 canary): the
+            # left neighbor's step-s send — shard (me-1-s) mod n — lands
+            # here and is consumed at step s+1 (the chunked ring
+            # allgather's base_in arithmetic)
+            base_in = jax.lax.rem(me - 1 - s + 2 * n, n) * t_pad_loc
 
             def _group_desc(g, slot, c=c):
                 base = g * bpg * bm
@@ -381,7 +386,7 @@ def make_ag_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int, bn: int,
                             shmem.putmem_signal2_nbi_block(
                                 ag_ref.at[sl], ag_ref.at[sl], right, axis,
                                 send_sems.at[s, j], recv_sems.at[s, j],
-                                sig_sems.at[s, j],
+                                sig_sems.at[s, j], canary=True,
                             )
                         )
                     else:
@@ -516,7 +521,13 @@ def make_ag_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int, bn: int,
                     )
                     it_counter[0] += nb_g * n_jn
             if chunked and s < n - 1:
-                descs.append(shmem.ChunkedPutHandle(chunk_handles))
+                descs.append(shmem.ChunkedPutHandle(
+                    chunk_handles,
+                    recv_at=lambda off, rows, b=base_in: ag_ref.at[
+                        pl.ds(b + off, rows)
+                    ],
+                    spans=spans,
+                ))
 
         # drain final pending output stores, then local ring-put completion
         total_iters = n * nb * n_jn
@@ -747,7 +758,11 @@ def make_moe_rs_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int,
                     push_stage[pslot] = partial_ref[:].astype(out_dtype)
                     if s < n - 1:
                         # the retired slab ships as per-(s, jn, chunk)
-                        # DMAs; landing slot s = sender-distance convention
+                        # DMAs; landing slot s = sender-distance
+                        # convention, so by SPMD symmetry the slab
+                        # incoming at distance s lands at the SAME local
+                        # (s, span, jn) coordinates — dst and landing
+                        # views coincide (ISSUE 8 canary)
                         handle = shmem.putmem_signal_chunked_nbi_block(
                             lambda off, rows, s=s, jn=jn: landing.at[
                                 s, pl.ds(off, rows), pl.ds(jn * bn, bn)
@@ -760,6 +775,9 @@ def make_moe_rs_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int,
                             lambda j, s=s, jn=jn: recv_sems.at[s, jn, j],
                             lambda j, s=s, jn=jn: sig_sems.at[s, jn, j],
                             spans,
+                            recv_view=lambda off, rows, s=s, jn=jn: landing.at[
+                                s, pl.ds(off, rows), pl.ds(jn * bn, bn)
+                            ],
                         )
                         push_handles.setdefault(s, []).append(handle)
                         pending[pslot] = handle.wait_send

@@ -174,6 +174,10 @@ def _ring_rs_chunked_kernel(
 
     sends = []
     # Step 0: own untouched chunk me-1 starts its trip, chunk by chunk.
+    # Landing view (ISSUE 8 canary, wired per ISSUE 11): by SPMD symmetry
+    # the left neighbor's step-s put lands in OUR recv_buf[s] at the same
+    # span coordinates it addressed on us — the dst and landing views
+    # coincide for this staging buffer.
     c0base = jax.lax.rem(me - 1 + n, n) * m_loc
     sends.append(
         shmem.putmem_signal_chunked_nbi_block(
@@ -184,6 +188,7 @@ def _ring_rs_chunked_kernel(
             lambda j: recv_sems.at[0, j],
             lambda j: sig_sems.at[0, j],
             spans,
+            recv_view=lambda off, rows: recv_buf.at[0, pl.ds(off, rows)],
         )
     )
     for s in range(1, n):
@@ -205,11 +210,17 @@ def _ring_rs_chunked_kernel(
                     shmem.putmem_signal2_nbi_block(
                         recv_buf.at[s, sl], acc, right, axis,
                         send_sems.at[s, j], recv_sems.at[s, j],
-                        sig_sems.at[s, j],
+                        sig_sems.at[s, j], canary=True,
                     )
                 )
         if handles:
-            sends.append(shmem.ChunkedPutHandle(handles))
+            sends.append(shmem.ChunkedPutHandle(
+                handles,
+                recv_at=lambda off, rows, s=s: recv_buf.at[
+                    s, pl.ds(off, rows)
+                ],
+                spans=spans,
+            ))
     shmem.quiet(*sends)
 
 

@@ -44,6 +44,16 @@ POISONED = "poisoned"               # serving: one request's logits went
                                     # non-finite; that request was evicted
                                     # and typed-rejected, survivors kept
                                     # streaming (serving/engine.py)
+BROWNOUT = "brownout"               # serving overload ladder transition
+                                    # (serving/overload.py): normal ⇄
+                                    # brownout1 ⇄ brownout2 ⇄
+                                    # shed_all_batch, with the dominant
+                                    # pressure term as the cause
+SHED = "shed"                       # serving: one request load-shed with
+                                    # a typed Shed terminal (deadline
+                                    # expiry, overflow victim, or
+                                    # shed_all_batch) — never a silent
+                                    # drop
 
 # short-circuit pin kinds (why a family is pinned to its golden path)
 PIN_ENV = "env"               # process-global environment failure
@@ -176,6 +186,30 @@ def record_poisoned_request(family: str, uid: Any, reason: str) -> None:
     ))
 
 
+def record_brownout(family: str, frm: str, to: str, *, pressure: float,
+                    cause: str) -> None:
+    """One overload-ladder transition (serving/overload.py), with the
+    dominant pressure term (queue / drain / slo) as the attributed
+    cause."""
+    _record(HealthEvent(
+        kind=BROWNOUT, family=family,
+        reason=f"{frm} -> {to} (pressure={pressure:.3f}, cause={cause})",
+        walltime=time.time(),
+    ))
+
+
+def record_shed(family: str, uid: Any, priority: str, reason: str) -> None:
+    """One request load-shed by the overload controller — typed terminal,
+    counted here so fleet dashboards see shed volume next to timeouts and
+    corruption (the deque may drop old DETAIL under a shed storm; the
+    per-(family, kind) counter never does)."""
+    _record(HealthEvent(
+        kind=SHED, family=family,
+        reason=f"request {uid!r} [{priority}]: {reason}",
+        walltime=time.time(),
+    ))
+
+
 def record_pe_quarantine(pe: int, reason: str) -> None:
     """The elastic layer quarantined peer ``pe`` (elastic.py)."""
     _record(HealthEvent(
@@ -251,13 +285,15 @@ def is_healthy() -> bool:
     """True iff no downgrade, timeout, or corruption has been recorded
     since reset(). Retries/recoveries alone don't flip this — an absorbed
     transient is the system working — but quarantines, unrecovered
-    timeouts, detected corruption, dropped train steps, and poisoned
-    serving requests do: they all mean some work was NOT done on the fast
-    clean path."""
+    timeouts, detected corruption, dropped train steps, poisoned serving
+    requests, overload brownouts, and load sheds do: they all mean some
+    work was NOT done on the fast clean path (a shed/brownout is the
+    overload machinery working AS DESIGNED, but an operator still needs
+    one bit that says "this process refused or degraded work")."""
     with _lock:
         return not any(
             k in (DOWNGRADE, TIMEOUT, PE_QUARANTINE, INTEGRITY, SKIP_STEP,
-                  POISONED)
+                  POISONED, BROWNOUT, SHED)
             for (_, k), n in _counters.items() if n > 0
         )
 

@@ -1,0 +1,409 @@
+"""Chaos-soak harness (ISSUE 11): long seeded campaigns composing the
+faults the matrix only tests in isolation.
+
+``scripts/chaos_matrix.sh`` proves each fault class alone — a dropped
+signal, a straggler, a corrupt payload, a poisoned request. Production
+outages are compositions: a flash crowd lands *while* a PE is straggling
+*while* a DMA path corrupts payloads, and the failure modes that matter
+(lost requests, deadlocked drain loops, double-counted health events)
+only appear at the seams between recovery paths. A **campaign** is one
+seeded serve run that composes:
+
+- **flash-crowd λ bursts** — ``traffic.TrafficSpec(process="burst")``
+  with priorities and deadlines, offered against a deliberately small
+  queue so the overload ladder, overflow sheds, and retry budgets all
+  engage;
+- **a persistent straggler** — fabricated ``DistTimeoutError`` records
+  naming every PE *but* the straggler (the by-absence attribution
+  convention), repeated so the strike threshold quarantines it and the
+  engine shrinks the mesh **mid-overload**, prefix-replaying in-flight
+  work while the queue is still slammed;
+- **payload corruption** — fabricated ``IntegrityError`` canary records
+  naming a corrupt PE directly (the victim-==-culprit convention of
+  resilience/faults.py), driving the integrity rebuild arc.
+
+Faults are injected at the documented host-level chaos seam (the
+``ContinuousBatcher.step`` wrap of tests/test_serving.py): only the
+in-kernel wait is simulated; retry, attribution, quarantine, shrink,
+replay, shedding, and the brownout ladder are all the production paths.
+
+Invariants asserted on every campaign (:func:`check_invariants`):
+
+1. **no lost request** — every offered uid reaches exactly ONE terminal
+   state (Finished / Shed / Poisoned / terminal Rejected);
+2. **no deadlock** — the serve loop drains within the step budget and
+   leaves no queued or in-flight state behind;
+3. **accounting balance** — serving counters, per-class shed counters,
+   and the health registry agree with the terminal census (a recovery
+   path that double-counts or skips an event fails here);
+4. **seeded replay** — the same spec reproduces a byte-identical
+   campaign fingerprint (terminal states, tokens, ladder transitions).
+
+``scripts/chaos_soak.py`` is the CLI; the quick cells ride
+``scripts/chaos_matrix.sh`` and the full 20-campaign soak is the
+``soak`` (slow) pytest tier of tests/test_overload.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+from typing import Any
+
+import numpy as np
+
+from triton_dist_tpu.resilience import retry as _retry
+from triton_dist_tpu.resilience.records import DistTimeoutError
+from triton_dist_tpu.serving.engine import (
+    Finished,
+    Poisoned,
+    Rejected,
+    Shed,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SoakSpec:
+    """One campaign's composition, fully derived from ``seed``.
+
+    The traffic is a flash-crowd burst mix with priorities and deadlines;
+    ``n_timeouts`` straggler trips (all naming the same ``straggler_pe``
+    by absence — persistent, so the strike threshold quarantines it) and
+    ``n_corruptions`` canary trips are scheduled at seed-derived step
+    numbers. ``max_steps`` is the deadlock watchdog."""
+
+    seed: int = 0
+    n_requests: int = 24
+    rate_rps: float = 30.0
+    burst_every_s: float = 0.6
+    burst_n: int = 8
+    priority_mix: tuple = ((0.6, "interactive"), (0.4, "batch"))
+    deadline_ms: tuple = ("uniform", 500, 6000)
+    max_queue: int = 6
+    virtual_step_s: float = 0.05
+    world: int = 4
+    n_timeouts: int = 2
+    n_corruptions: int = 1
+    straggler_pe: int = 1
+    corrupt_pe: int = 2
+    fault_window: int = 40      # fault steps drawn from [2, 2+window)
+    max_steps: int = 50_000
+
+    def validate(self) -> "SoakSpec":
+        if self.n_requests < 1 or self.world < 2:
+            raise ValueError("need n_requests >= 1 and world >= 2")
+        if not 0 <= self.straggler_pe < self.world:
+            raise ValueError("straggler_pe out of range")
+        if not 0 <= self.corrupt_pe < self.world:
+            raise ValueError("corrupt_pe out of range")
+        if self.fault_window < self.n_timeouts + self.n_corruptions:
+            raise ValueError("fault_window too small for the fault count")
+        return self
+
+
+@dataclasses.dataclass
+class CampaignResult:
+    spec: SoakSpec
+    terminals: dict            # uid -> terminal kind name
+    n_steps_hint: int          # batcher step calls observed by the injector
+    rebuilds: int
+    transitions: list          # ladder transitions (dicts)
+    snapshot: dict             # engine snapshot
+    health: dict               # health registry snapshot
+    fingerprint: str
+    failures: list             # invariant violations (empty = green)
+    error: str | None = None   # an escaped exception (deadlock/storm)
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures and self.error is None
+
+
+def _timeout_records(world: int, straggler: int) -> list[dict]:
+    """By-absence attribution: every PE but the straggler reports the
+    expired wait (the convention of elastic.note_timeout_records)."""
+    return [
+        {"pe": pe, "kind": "barrier_all", "site": 0, "status": "timeout",
+         "expected": 1, "observed": 0, "budget": 16}
+        for pe in range(world) if pe != straggler
+    ]
+
+
+def _integrity_records(corrupt_pe: int) -> list[dict]:
+    """Victim == culprit: the canary record names the corrupt PE
+    directly (resilience/faults.py landing-site model)."""
+    return [{"pe": corrupt_pe, "kind": "integrity", "site": 0,
+             "status": "integrity", "expected": 0, "observed": 1}]
+
+
+def fault_schedule(spec: SoakSpec) -> dict[int, tuple[str, int]]:
+    """step-call-number -> ("timeout" | "integrity", pe), seed-derived.
+    Distinct steps, so two faults never race one step (the matrix covers
+    single-step behavior; the soak covers the composition over time)."""
+    rng = np.random.default_rng([int(spec.seed), 0x50AC])
+    n = spec.n_timeouts + spec.n_corruptions
+    steps = sorted(
+        int(s) for s in rng.choice(
+            np.arange(2, 2 + spec.fault_window), size=n, replace=False
+        )
+    )
+    kinds = (
+        [("timeout", spec.straggler_pe)] * spec.n_timeouts
+        + [("integrity", spec.corrupt_pe)] * spec.n_corruptions
+    )
+    rng.shuffle(kinds)  # interleave the fault classes over the campaign
+    return {s: tuple(k) for s, k in zip(steps, kinds)}
+
+
+@contextlib.contextmanager
+def _inject_faults(schedule: dict, world: int):
+    """The host-level chaos seam: wrap ``ContinuousBatcher.step`` so call
+    number ``k`` raises its scheduled fault (tests/test_serving.py's
+    technique, promoted into the harness). Restores the real step on
+    exit; rebuilt batchers (shrink/regrow/downshift) stay wrapped — a
+    persistent straggler outlives every rebuild."""
+    from triton_dist_tpu.models.decode import ContinuousBatcher
+    from triton_dist_tpu.resilience.integrity import DET_CANARY, IntegrityError
+
+    real_step = ContinuousBatcher.step
+    calls = {"n": 0}
+
+    def flaky(self):
+        calls["n"] += 1
+        fault = schedule.get(calls["n"])
+        if fault is not None:
+            kind, pe = fault
+            if kind == "timeout":
+                raise DistTimeoutError(
+                    "batcher_step", _timeout_records(world, pe),
+                    world_size=world,
+                )
+            raise IntegrityError(
+                "batcher_step", DET_CANARY,
+                "soak-injected payload corruption",
+                records=_integrity_records(pe), world_size=world,
+            )
+        return real_step(self)
+
+    ContinuousBatcher.step = flaky
+    try:
+        yield calls
+    finally:
+        ContinuousBatcher.step = real_step
+
+
+def _terminal_kind(res: Any) -> str:
+    for cls in (Finished, Shed, Poisoned, Rejected):
+        if isinstance(res, cls):
+            return cls.__name__.lower()
+    return f"<unknown {type(res).__name__}>"
+
+
+def campaign_fingerprint(result: "CampaignResult") -> str:
+    """Byte-stable digest of everything a campaign decided: per-uid
+    terminal states (tokens included), ladder transitions, rebuild count,
+    and the terminal counters — the seeded-replay pin."""
+    h = hashlib.sha256()
+    h.update(repr(dataclasses.asdict(result.spec)).encode())
+    for uid in sorted(result.terminals):
+        h.update(repr((uid, result.terminals[uid])).encode())
+    h.update(repr(result.transitions).encode())
+    h.update(repr((result.rebuilds,)).encode())
+    reqs = result.snapshot.get("requests", {})
+    h.update(repr(sorted(reqs.items())).encode())
+    return h.hexdigest()
+
+
+def check_invariants(eng, result: CampaignResult, offered_uids: set) -> list:
+    """The campaign's green conditions (module docstring). Returns the
+    violation list (empty = green)."""
+    fails: list[str] = []
+    snap = result.snapshot
+    reqs = snap.get("requests", {})
+    term = result.terminals
+
+    # 1. no lost request: exactly-one-terminal-state per offered uid
+    got = set(term)
+    if got != offered_uids:
+        fails.append(
+            f"terminal census mismatch: missing={sorted(offered_uids - got)} "
+            f"extra={sorted(got - offered_uids)}"
+        )
+    unknown = {u: k for u, k in term.items() if k.startswith("<unknown")}
+    if unknown:
+        fails.append(f"non-terminal results: {unknown}")
+
+    # 2. no deadlock residue: nothing queued or in flight after the drain
+    if eng._pending or eng._states:
+        fails.append(
+            f"residual work after serve: queue={len(eng._pending)} "
+            f"in_flight={len(eng._states)}"
+        )
+
+    # 3. accounting balance: counters == terminal census, both tiers
+    census = {}
+    for k in term.values():
+        census[k] = census.get(k, 0) + 1
+    pairs = (
+        ("finished", census.get("finished", 0)),
+        ("shed", census.get("shed", 0)),
+        ("poisoned", census.get("poisoned", 0)),
+        ("rejected_final", census.get("rejected", 0)),
+    )
+    for name, want in pairs:
+        have = reqs.get(name, 0)
+        if have != want:
+            fails.append(
+                f"counter {name}={have} disagrees with terminal census "
+                f"{want}"
+            )
+    if reqs.get("submitted", 0) != len(offered_uids) + reqs.get(
+        "resubmitted", 0
+    ):
+        fails.append(
+            f"submitted={reqs.get('submitted', 0)} != offered "
+            f"{len(offered_uids)} + resubmitted {reqs.get('resubmitted', 0)}"
+        )
+    ov = snap.get("overload", {})
+    if sum(ov.get("sheds_by_class", {}).values()) != reqs.get("shed", 0):
+        fails.append(
+            f"controller sheds_by_class {ov.get('sheds_by_class')} does not "
+            f"sum to the shed counter {reqs.get('shed', 0)}"
+        )
+    hc = result.health.get("counters", {})
+    if hc.get("serving_engine:serving_rebuild", 0) != result.rebuilds:
+        fails.append(
+            f"health serving_rebuild={hc.get('serving_engine:serving_rebuild', 0)} "
+            f"!= engine rebuilds {result.rebuilds}"
+        )
+    if hc.get("serving_engine:shed", 0) != reqs.get("shed", 0):
+        fails.append(
+            f"health shed={hc.get('serving_engine:shed', 0)} != metrics "
+            f"shed {reqs.get('shed', 0)}"
+        )
+    if hc.get("serving_engine:brownout", 0) != len(result.transitions):
+        fails.append(
+            f"health brownout={hc.get('serving_engine:brownout', 0)} != "
+            f"controller transitions {len(result.transitions)}"
+        )
+    return fails
+
+
+def run_campaign(spec: SoakSpec, *, model=None) -> CampaignResult:
+    """Run one seeded campaign and evaluate its invariants. Process-global
+    state (config, resilience registries, module clock) is snapshotted
+    and restored, so campaigns compose with each other and with a live
+    pytest session. ``model=(cfg, params)`` overrides the built-in tiny
+    4-PE transformer (the test fixture reuse hook)."""
+    import jax
+
+    from triton_dist_tpu import config as tdt_config
+    from triton_dist_tpu import resilience
+    from triton_dist_tpu.serving import (
+        OverloadConfig,
+        ServingConfig,
+        ServingEngine,
+        TrafficSpec,
+        generate_trace,
+    )
+    from triton_dist_tpu.serving.metrics import SLOTargets
+    from jax.sharding import Mesh
+
+    spec.validate()
+    if len(jax.devices()) < spec.world:
+        raise RuntimeError(
+            f"soak needs {spec.world} devices (run under "
+            f"--xla_force_host_platform_device_count, as scripts/chaos_soak.py "
+            f"and conftest.py do); have {len(jax.devices())}"
+        )
+    cfgsnap = tdt_config.get_config()
+    saved = (cfgsnap.elastic, cfgsnap.suspect_threshold,
+             cfgsnap.probation_probes)
+    resilience.reset(keep_env=True)
+    tdt_config.update(
+        elastic=True, suspect_threshold=spec.n_timeouts, probation_probes=1
+    )
+    try:
+        if model is None:
+            from triton_dist_tpu.models import init_params
+            from triton_dist_tpu.models.tp_transformer import TransformerConfig
+            from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig
+            from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig
+
+            # n_kv_heads == world so the (world-1)-survivor mesh is
+            # model-invalid and a shrink must land on world//2 — the
+            # interesting serviceable-mesh case, mid-overload
+            cfg = TransformerConfig(
+                vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=4,
+                n_kv_heads=4, head_dim=8, batch=2, seq=8,
+                ag_config=AGGemmConfig(8, 16, 16),
+                rs_config=GemmRSConfig(8, 16, 16),
+            )
+            from jax.random import PRNGKey
+
+            params = init_params(PRNGKey(1), cfg)
+        else:
+            cfg, params = model
+        mesh = Mesh(np.array(jax.devices()[:spec.world]), ("tp",))
+        traffic = TrafficSpec(
+            rate_rps=spec.rate_rps, n_requests=spec.n_requests,
+            process="burst", burst_every_s=spec.burst_every_s,
+            burst_n=spec.burst_n,
+            prompt_len=("uniform", 2, 4), output_len=("uniform", 2, 5),
+            vocab=cfg.vocab, seed=spec.seed, uid_prefix=f"c{spec.seed}-",
+            priority_mix=spec.priority_mix, deadline_ms=spec.deadline_ms,
+        )
+        trace = generate_trace(traffic)
+        schedule = fault_schedule(spec)
+        clock = _retry.FakeClock()
+        with _retry.clock_scope(clock):
+            eng = ServingEngine(
+                cfg, params, mesh, s_max=16, clock=clock,
+                serving=ServingConfig(
+                    max_queue=spec.max_queue,
+                    virtual_step_s=spec.virtual_step_s,
+                    probe_interval_steps=4,
+                    slo=SLOTargets(ttft_ms=1500.0),
+                    overload=OverloadConfig(
+                        min_dwell_steps=4, window_steps=8,
+                        retry_budget=4,
+                        # identity downshift: brownout2 still drives the
+                        # rebuild+replay arc (composition with the fault
+                        # rebuilds is exactly what the soak is for)
+                        downshift=lambda c: c,
+                    ),
+                ),
+            )
+            error = None
+            with _inject_faults(schedule, spec.world) as calls:
+                try:
+                    done = eng.serve(trace, max_steps=spec.max_steps)
+                except RuntimeError as exc:
+                    error = f"{type(exc).__name__}: {exc}"
+                    done = dict(eng.results)
+        result = CampaignResult(
+            spec=spec,
+            terminals={u: _terminal_kind(r) for u, r in done.items()},
+            n_steps_hint=calls["n"],
+            rebuilds=eng.rebuilds,
+            transitions=[
+                dataclasses.asdict(t)
+                for t in (eng._overload.transitions if eng._overload else ())
+            ],
+            snapshot=eng.snapshot(),
+            health=resilience.health.snapshot(),
+            fingerprint="",
+            failures=[],
+            error=error,
+        )
+        result.fingerprint = campaign_fingerprint(result)
+        offered = {a.request.uid for a in trace}
+        result.failures = check_invariants(eng, result, offered)
+        return result
+    finally:
+        tdt_config.update(
+            elastic=saved[0], suspect_threshold=saved[1],
+            probation_probes=saved[2],
+        )
+        resilience.reset(keep_env=True)

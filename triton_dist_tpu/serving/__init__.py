@@ -2,7 +2,7 @@
 elastic serving loop layered over the kernel-level scheduler
 (``models/decode.ContinuousBatcher``).
 
-Four parts (docs/serving.md "Serving engine" is the full contract):
+Five parts (docs/serving.md "Serving engine" is the full contract):
 
 - :mod:`engine` — :class:`ServingEngine`: lifecycle timestamps at the
   host scheduling boundary (enqueue → admitted → first token →
@@ -12,14 +12,24 @@ Four parts (docs/serving.md "Serving engine" is the full contract):
   serviceable survivor mesh with every in-flight request prefix-replayed
   (prompt + tokens-so-far; no generation lost), and probation
   re-admission grows the world back mid-serving.
+- :mod:`overload` — the overload controller (ISSUE 11): deadline
+  propagation with typed ``Shed`` expiry, interactive/batch priority
+  classes with per-class resubmit token buckets, and the pressure-driven
+  brownout ladder (strict priority → precision downshift →
+  shed-all-batch, hysteresis on recovery) — armed via
+  ``ServingConfig(overload=OverloadConfig(...))``, engine-agnostic by
+  design (the disaggregated-pool topology runs one per pool).
 - :mod:`traffic` — seeded, replayable synthetic workloads (Poisson /
-  deterministic arrivals, length mixtures incl. preset-derived ones);
-  same seed ⇒ byte-identical trace.
+  deterministic / flash-crowd burst arrivals, length mixtures incl.
+  preset-derived ones, per-arrival priority/deadline); same seed ⇒
+  byte-identical trace.
 - :mod:`metrics` — streaming log-binned histograms (TTFT,
-  per-output-token, e2e), load gauges, SLO attainment, and a
+  per-output-token, e2e), load gauges, SLO attainment, goodput
+  (SLO-attaining throughput) and per-class counters, and a
   ``snapshot()`` mirroring ``resilience/health.py``.
-- :mod:`bench` — the ``bench.py bench_serving`` offered-load sweep
-  (virtual clock; ``emit_info`` lines only, never perf-gated).
+- :mod:`bench` — the ``bench.py bench_serving`` offered-load sweep and
+  overload A/B (virtual clock; ``emit_info`` lines only, never
+  perf-gated).
 
 Everything runs on an injectable clock (``resilience/retry.py``'s module
 clock by default), so whole serve runs — latency percentiles included —
@@ -32,11 +42,19 @@ from triton_dist_tpu.serving.engine import (
     Rejected,
     ServingConfig,
     ServingEngine,
+    Shed,
 )
 from triton_dist_tpu.serving.metrics import (
     ServingMetrics,
     SLOTargets,
     StreamingHistogram,
+)
+from triton_dist_tpu.serving.overload import (
+    LADDER,
+    OverloadConfig,
+    OverloadController,
+    PRIORITIES,
+    priority_rank,
 )
 from triton_dist_tpu.serving.traffic import (
     Arrival,
@@ -49,15 +67,21 @@ from triton_dist_tpu.serving.traffic import (
 __all__ = [
     "Arrival",
     "Finished",
+    "LADDER",
+    "OverloadConfig",
+    "OverloadController",
+    "PRIORITIES",
     "Poisoned",
     "Rejected",
     "ServingConfig",
     "ServingEngine",
     "ServingMetrics",
     "SLOTargets",
+    "Shed",
     "StreamingHistogram",
     "TrafficSpec",
     "generate_trace",
     "preset_mix",
+    "priority_rank",
     "trace_fingerprint",
 ]

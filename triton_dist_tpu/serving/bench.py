@@ -39,9 +39,15 @@ def sweep_offered_load(
     slo: SLOTargets | None = None,
     serving_kw: dict | None = None,
     batcher_kw: dict | None = None,
+    traffic_kw: dict | None = None,
+    tag: str = "",
 ) -> list[dict]:
     """One engine + trace per λ; returns
-    ``[{"rate_rps", "snapshot", "n_finished"}, ...]`` in rate order."""
+    ``[{"rate_rps", "snapshot", "n_finished"}, ...]`` in rate order.
+    ``traffic_kw`` merges into the TrafficSpec (the overload A/B passes
+    ``priority_mix``/``deadline_ms`` here); ``serving_kw`` can carry
+    ``overload=OverloadConfig(...)``; ``tag`` keeps the A/B arms' span
+    lanes apart in a merged obs export."""
     rows = []
     for lam in rates:
         # per-row span isolation is structural: each λ gets a FRESH
@@ -54,6 +60,7 @@ def sweep_offered_load(
             rate_rps=float(lam), n_requests=n_requests,
             prompt_len=prompt_len, output_len=output_len,
             vocab=cfg.vocab, seed=seed,
+            **(traffic_kw or {}),
         )
         eng = ServingEngine(
             cfg, params, mesh, s_max=s_max, clock=clock,
@@ -64,7 +71,7 @@ def sweep_offered_load(
             # distinct exported span lanes per rate: every λ re-seeds the
             # same request uids on a fresh t=0 FakeClock, so untagged
             # tracks would superimpose all rates' request arcs
-            obs_tag=f"lam{lam:g}:",
+            obs_tag=f"lam{lam:g}:{tag}",
             **(batcher_kw or {}),
         )
         done = eng.serve(generate_trace(spec))
@@ -93,11 +100,30 @@ def info_lines(rows: list[dict], tag: str = "") -> list[tuple[str, Any, str]]:
         out.append((f"serving_e2e_p99_ms_{key}", lat["e2e"]["p99"], "ms"))
         out.append((f"serving_tokens_per_s_{key}",
                     snap["tokens"]["per_s"], "tok/s"))
+        # goodput (ISSUE 11): SLO-attaining (and deadline-meeting)
+        # throughput — the overload A/B's judged column; equals tokens/s
+        # when no SLO/deadline is configured. Engine snapshots always
+        # carry it; hand-rolled metric snapshots may not.
+        if "goodput_per_s" in snap["tokens"]:
+            out.append((f"serving_goodput_per_s_{key}",
+                        snap["tokens"]["goodput_per_s"], "tok/s"))
         out.append((f"serving_queue_depth_p99_{key}",
                     load["queue_depth"]["p99"], "requests"))
         if snap["slo"] is not None:
             out.append((f"serving_slo_attainment_{key}",
                         snap["slo"]["attained"], "fraction"))
+        if "overload" in snap:
+            reqs = snap["requests"]
+            offered = reqs.get("submitted", 0) - reqs.get("resubmitted", 0)
+            shed_total = reqs.get("shed", 0) + reqs.get("rejected_final", 0)
+            out.append((f"serving_shed_rate_{key}",
+                        round(shed_total / max(1, offered), 6), "fraction"))
+            out.append((f"serving_brownout_transitions_{key}",
+                        reqs.get("brownout_transitions", 0), "transitions"))
+            st = snap.get("by_class", {}).get("ttft_ms", {}).get("interactive")
+            if st is not None and st["count"]:
+                out.append((f"serving_interactive_ttft_p99_ms_{key}",
+                            st["p99"], "ms"))
         # per-phase step-time breakdown from the span tracer (ISSUE 9):
         # present only when obs was armed for the sweep; deterministic
         # under the FakeClock like every other row

@@ -12,6 +12,9 @@ percentiles included, deterministic)::
     submit ──► [bounded queue] ──► admitted ──► first token ──► finished
        │            │ backpressure                  │
        └ Rejected ◄─┘ (reject-on-full | block)      └ resumed (replay)
+       └ Shed    ◄─── overload controller (ISSUE 11, when armed):
+                      deadline expiry / overflow victim / shed_all_batch
+                      — serving/overload.py, docs/serving.md "Overload"
 
 Elastic wiring (engine + ``resilience/elastic.py``): a
 ``DistTimeoutError`` escaping the jitted step has already been through
@@ -35,6 +38,7 @@ mesh back mid-serving through the same replay path.
 from __future__ import annotations
 
 import dataclasses
+import heapq
 from collections import deque
 from typing import Any
 
@@ -42,7 +46,14 @@ from triton_dist_tpu import obs as _obs
 from triton_dist_tpu.models.decode import ContinuousBatcher, Request
 from triton_dist_tpu.resilience import elastic, health
 from triton_dist_tpu.resilience import retry as _retry
+from triton_dist_tpu.serving import overload as _overload
 from triton_dist_tpu.serving.metrics import ServingMetrics, SLOTargets
+from triton_dist_tpu.serving.overload import (
+    OverloadConfig,
+    OverloadController,
+    PRIORITIES,
+    priority_rank,
+)
 from triton_dist_tpu.serving.traffic import Arrival
 
 BACKPRESSURE = ("reject", "block")
@@ -69,6 +80,13 @@ class ServingConfig:
     slo:              latency targets scored per finished request.
     world_ok:         optional override for the degraded-world
                       divisibility predicate (``n -> bool``).
+    overload:         an :class:`~triton_dist_tpu.serving.overload.
+                      OverloadConfig` arms the overload controller
+                      (ISSUE 11): deadline shedding, priority classes,
+                      per-class retry budgets, and the brownout ladder.
+                      None (the default) = the pre-overload engine,
+                      byte for byte. Requires ``backpressure="reject"``
+                      (shed decisions and block-by-serving conflict).
     """
 
     max_queue: int = 256
@@ -79,10 +97,19 @@ class ServingConfig:
     max_step_failures: int = 8
     slo: SLOTargets | None = None
     world_ok: Any = None
+    overload: OverloadConfig | None = None
 
     def validate(self) -> "ServingConfig":
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.overload is not None:
+            self.overload.validate()
+            if self.backpressure != "reject":
+                raise ValueError(
+                    'overload control requires backpressure="reject" — '
+                    "blocking submits would serve traffic the shed policy "
+                    "exists to refuse"
+                )
         if self.backpressure not in BACKPRESSURE:
             raise ValueError(
                 f"backpressure must be one of {BACKPRESSURE}, "
@@ -111,6 +138,24 @@ class Rejected:
     uid: Any
     reason: str
     queue_depth: int
+    priority: str | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Shed:
+    """Typed load-shed terminal (ISSUE 11): the overload controller
+    refused or evicted this request — deadline expired in the queue, it
+    was the overflow victim (lowest class, newest arrival), or the ladder
+    reached ``shed_all_batch``. The request never silently drops: this
+    object is its exactly-one terminal state (the no-lost-request
+    invariant the chaos soak asserts). Only produced with
+    ``ServingConfig.overload`` armed."""
+
+    uid: Any
+    reason: str
+    priority: str
+    t_enqueue: float
+    t_shed: float
 
 
 @dataclasses.dataclass(frozen=True)
@@ -164,6 +209,8 @@ class _ReqState:
     awaiting_first: bool = True      # no token seen since (re)admission
     tokens: list = dataclasses.field(default_factory=list)  # replay prefix
     resumed: int = 0
+    priority: str = "interactive"    # overload class (ISSUE 11)
+    deadline: float | None = None    # absolute engine-clock deadline
 
 
 class ServingEngine:
@@ -203,7 +250,18 @@ class ServingEngine:
         # retry.set_clock(FakeClock()) / retry.clock_scope(...) puts
         # backoffs and serving timestamps on the same timeline
         self.clock = clock if clock is not None else _retry.get_clock()
-        self.metrics = metrics or ServingMetrics(slo=self.serving.slo)
+        # overload control (ISSUE 11): None ⇒ the pre-overload engine,
+        # byte for byte — no controller, no per-class metric surface
+        self._overload = (
+            OverloadController(
+                self.serving.overload, max_queue=self.serving.max_queue
+            )
+            if self.serving.overload is not None else None
+        )
+        self.metrics = metrics or ServingMetrics(
+            slo=self.serving.slo,
+            classes=PRIORITIES if self._overload is not None else None,
+        )
         self.family = "serving_engine"
         self._pending: deque[_ReqState] = deque()
         self._states: dict[Any, _ReqState] = {}
@@ -213,6 +271,13 @@ class ServingEngine:
         self._steps_since_probe = 0
         self._uid_counter = 0
         self._stopping = False
+        self._base_cfg = cfg           # restored when brownout2 descends
+        self._downshifted = False
+        # per-step deltas feeding the controller's pressure window
+        self._step_arrived = 0
+        self._step_finished = 0
+        self._step_slo_ok = 0
+        self._step_slo_scored = 0
         self.mesh = self._target_mesh()
         self._batcher = self._build(self.mesh)
         self._t0 = self.clock.monotonic()
@@ -276,14 +341,28 @@ class ServingEngine:
 
     # -- submission / admission ----------------------------------------
 
-    def submit(self, req: Request, *, arrival_t: float | None = None):
+    def submit(
+        self,
+        req: Request,
+        *,
+        arrival_t: float | None = None,
+        priority: str = "interactive",
+        deadline_ms: float | None = None,
+    ):
         """Enqueue one request. Returns its uid, or a typed
         :class:`Rejected` when the bounded queue is full under the
-        "reject" policy ("block" steps the engine until space frees).
-        ``arrival_t`` backdates the enqueue timestamp to the offered
-        arrival time (the serve loop passes it so queueing delay accrued
-        while the host was mid-step still counts toward TTFT)."""
+        "reject" policy ("block" steps the engine until space frees), or
+        a typed :class:`Shed` when the overload controller refuses it at
+        the door (ISSUE 11). ``arrival_t`` backdates the enqueue
+        timestamp to the offered arrival time (the serve loop passes it
+        so queueing delay accrued while the host was mid-step still
+        counts toward TTFT). ``priority``/``deadline_ms`` are consulted
+        only with ``ServingConfig.overload`` armed; the deadline budget
+        is measured from the (possibly backdated) arrival time."""
         now = self.clock.monotonic() if arrival_t is None else float(arrival_t)
+        ctrl = self._overload
+        if ctrl is not None:
+            priority_rank(priority)  # loud on policy typos
         if req.uid is None:
             req = dataclasses.replace(req, uid=f"r{self._uid_counter}")
             self._uid_counter += 1
@@ -291,6 +370,35 @@ class ServingEngine:
             raise ValueError(f"duplicate request uid {req.uid!r}")
         self._batcher.validate_request(req)
         self.metrics.count("submitted")
+        self._step_arrived += 1
+        if ctrl is not None and not ctrl.submit_allowed(priority):
+            return self._record_shed(
+                req.uid, priority, now, self.clock.monotonic(),
+                "ladder at shed_all_batch: batch refused at submit",
+            )
+        if len(self._pending) >= self.serving.max_queue and ctrl is not None:
+            # shed-before-reject (ISSUE 11): expired queue entries go
+            # first; then the overflow victim — the newest member of the
+            # worst queued class, and only one strictly below the
+            # incoming request's class (never same-class displacement)
+            self._shed_expired(self.clock.monotonic())
+            if len(self._pending) >= self.serving.max_queue:
+                victim = ctrl.shed_victim(
+                    [(s.priority, i) for i, s in enumerate(self._pending)]
+                )
+                if victim is not None and (
+                    priority_rank(self._pending[victim].priority)
+                    > priority_rank(priority)
+                ):
+                    vst = self._pending[victim]
+                    del self._pending[victim]
+                    self._states.pop(vst.req.uid)
+                    self._record_shed(
+                        vst.req.uid, vst.priority, vst.t_enqueue,
+                        self.clock.monotonic(),
+                        "overflow shed: displaced by a higher class at a "
+                        "full queue",
+                    )
         if len(self._pending) >= self.serving.max_queue:
             if self.serving.backpressure == "reject":
                 self.metrics.count("rejected")
@@ -298,6 +406,7 @@ class ServingEngine:
                     req.uid,
                     f"arrival queue full ({self.serving.max_queue})",
                     len(self._pending),
+                    priority if ctrl is not None else None,
                 )
             while len(self._pending) >= self.serving.max_queue:
                 if not self._step_once():
@@ -306,23 +415,62 @@ class ServingEngine:
                         "queue is full but the engine is idle (max_queue "
                         "smaller than the batch can absorb?)"
                     )
-        st = _ReqState(req=req, t_enqueue=now)
+        st = _ReqState(
+            req=req, t_enqueue=now, priority=priority,
+            deadline=None if deadline_ms is None else now + deadline_ms / 1e3,
+        )
         self._states[req.uid] = st
         self._pending.append(st)
         self._admit(self.clock.monotonic())
         return req.uid
 
     def _pop_admission(self) -> _ReqState:
-        if self.serving.admission == "fcfs":
-            return self._pending.popleft()
-        # shortest-prompt-first (stable: earliest among equals)
-        best = min(range(len(self._pending)),
-                   key=lambda i: (len(self._pending[i].req.prompt), i))
+        """Next request under the admission policy; with the controller
+        in a brownout state, strict-priority first (interactive beats
+        batch — deferral, not denial: batch still runs whenever no
+        interactive request is waiting, so a brownout can never wedge the
+        queue), the configured policy ordering within a class."""
+        strict = self._overload is not None and self._overload.strict_priority()
+        if not strict and self.serving.admission == "fcfs":
+            return self._pending.popleft()  # the disarmed hot path
+
+        def key(i):
+            st = self._pending[i]
+            cls = priority_rank(st.priority) if strict else 0
+            if self.serving.admission == "fcfs":
+                return (cls, i)
+            return (cls, len(st.req.prompt), i)
+
+        best = min(range(len(self._pending)), key=key)
         st = self._pending[best]
         del self._pending[best]
         return st
 
+    def _shed_expired(self, now: float) -> None:
+        """Deadline-expiry shedding (ISSUE 11): queued requests whose
+        deadline has passed are shed BEFORE admission — serving them
+        would burn capacity on work the client has already abandoned.
+        In-flight requests are never evicted for a deadline; they finish
+        and are scored SLO-missed (``_finalize``)."""
+        if self._overload is None:
+            return
+        expired = [
+            i for i, st in enumerate(self._pending)
+            if st.deadline is not None and now > st.deadline
+        ]
+        for i in reversed(expired):
+            st = self._pending[i]
+            del self._pending[i]
+            self._states.pop(st.req.uid)
+            self._record_shed(
+                st.req.uid, st.priority, st.t_enqueue, now,
+                "deadline expired in queue",
+            )
+
     def _admit(self, now: float) -> None:
+        ctrl = self._overload
+        if ctrl is not None:
+            self._shed_expired(now)
         while self._batcher.n_free_slots > 0 and self._pending:
             st = self._pop_admission()
             st.t_admitted = now
@@ -358,8 +506,112 @@ class ServingEngine:
         if self.serving.virtual_step_s:
             self.clock.sleep(self.serving.virtual_step_s)
         self._observe(self.clock.monotonic())
+        self._overload_step()
         self._maybe_probe()
         return True
+
+    # -- overload control (ISSUE 11) ------------------------------------
+
+    def _overload_step(self) -> None:
+        """Feed this step's deltas into the controller's pressure window
+        and apply any ladder transition it returns."""
+        ctrl = self._overload
+        if ctrl is None:
+            return
+        tr = ctrl.observe_step(
+            now=self.clock.monotonic(),
+            queue_depth=len(self._pending),
+            arrived=self._step_arrived,
+            finished=self._step_finished,
+            slo_ok=self._step_slo_ok,
+            slo_scored=self._step_slo_scored,
+        )
+        self._step_arrived = self._step_finished = 0
+        self._step_slo_ok = self._step_slo_scored = 0
+        if tr is not None:
+            self._on_brownout_transition(tr)
+
+    def _on_brownout_transition(self, tr) -> None:
+        """One ladder move: record it (health registry + obs span with the
+        attributed cause), shed the queued batch backlog on reaching
+        ``shed_all_batch``, and apply/revert the precision downshift
+        around the brownout2 boundary (through the same rebuild +
+        prefix-replay machinery the elastic arc uses — no in-flight
+        request loses a token over a precision change)."""
+        ctrl = self._overload
+        self.metrics.count("brownout_transitions")
+        self.metrics.count(f"brownout_to_{tr.to}")
+        health.record_brownout(
+            self.family, tr.frm, tr.to, pressure=tr.pressure, cause=tr.cause
+        )
+        _obs.record_span(
+            "serving:brownout", tr.t_s, tr.t_s, cat="serving",
+            track=f"{self._obs_tag}engine", frm=tr.frm, to=tr.to,
+            pressure=tr.pressure, cause=tr.cause,
+        )
+        if tr.to == _overload.SHED_ALL_BATCH:
+            now = self.clock.monotonic()
+            batch = [
+                i for i, st in enumerate(self._pending)
+                if priority_rank(st.priority) > 0
+            ]
+            for i in reversed(batch):
+                st = self._pending[i]
+                del self._pending[i]
+                self._states.pop(st.req.uid)
+                self._record_shed(
+                    st.req.uid, st.priority, st.t_enqueue, now,
+                    "ladder reached shed_all_batch: queued batch shed",
+                )
+        want = ctrl.wants_downshift()
+        if want and not self._downshifted:
+            self._downshifted = True
+            self.cfg = ctrl.config.downshift(self._base_cfg)
+            self.metrics.count("precision_downshifts")
+            self._rebuild(
+                f"brownout precision downshift ({tr.frm} -> {tr.to})"
+            )
+        elif not want and self._downshifted:
+            self._downshifted = False
+            self.cfg = self._base_cfg
+            self._rebuild(
+                f"brownout recovery: precision restored ({tr.frm} -> {tr.to})"
+            )
+
+    def _record_shed(self, uid: Any, priority: str, t_enqueue: float,
+                     now: float, reason: str) -> "Shed":
+        """One request's typed load-shed terminal: metrics + per-class
+        counters, a health event, an obs instant, and the results entry
+        (exactly-one-terminal-state bookkeeping)."""
+        self.metrics.count("shed")
+        self.metrics.count_class("shed", priority)
+        if self._overload is not None:
+            self._overload.note_shed(priority)
+        health.record_shed(self.family, uid, priority, reason)
+        if uid in self.results:
+            raise RuntimeError(
+                f"request {uid!r} shed after a terminal state — shed "
+                f"bookkeeping bug"
+            )
+        shed = Shed(uid=uid, reason=reason, priority=priority,
+                    t_enqueue=t_enqueue, t_shed=now)
+        self.results[uid] = shed
+        _obs.record_span("serving:shed", now, now, cat="serving",
+                         track=f"{self._obs_tag}req:{uid}", uid=str(uid),
+                         reason=reason, priority=priority)
+        return shed
+
+    def _record_terminal_rejected(self, rej: "Rejected") -> None:
+        """Retry budget exhausted: the Rejected becomes the request's
+        terminal state (never silently dropped — the soak invariant)."""
+        if rej.uid in self.results:
+            raise RuntimeError(
+                f"request {rej.uid!r} rejected after a terminal state — "
+                f"retry bookkeeping bug"
+            )
+        self.metrics.count("rejected_final")
+        self.metrics.count_class("rejected_final", rej.priority)
+        self.results[rej.uid] = rej
 
     def _observe(self, now: float) -> None:
         b = self._batcher
@@ -382,14 +634,17 @@ class ServingEngine:
         st.awaiting_first = False
         st.t_first = now
         ttft_ms = (now - st.t_enqueue) * 1e3
+        prio = st.priority if self._overload is not None else None
         if st.resumed:
             # the replay contract: TTFT after a disruption is re-measured
             # and reported as a RESUMED event, never mixed into the clean
             # TTFT distribution
-            self.metrics.observe_first_token(ttft_ms, resumed=True)
+            self.metrics.observe_first_token(ttft_ms, resumed=True,
+                                             priority=prio)
         elif not st.first_recorded:
             st.first_recorded = True
-            self.metrics.observe_first_token(ttft_ms, resumed=False)
+            self.metrics.observe_first_token(ttft_ms, resumed=False,
+                                             priority=prio)
 
     def _finalize(self, uid: Any, toks: list, now: float) -> None:
         st = self._states.pop(uid)
@@ -409,10 +664,26 @@ class ServingEngine:
             (now - st.t_first) / (len(toks) - 1) * 1e3
             if len(toks) > 1 else None
         )
-        self.metrics.observe_finished(
+        # deadline scoring (ISSUE 11): an in-flight request past its
+        # deadline FINISHES (evicting device work buys nothing) but is
+        # scored SLO-missed — its tokens never count toward goodput
+        deadline_ok = None
+        if st.deadline is not None:
+            deadline_ok = now <= st.deadline
+            if not deadline_ok:
+                self.metrics.count("deadline_missed")
+                self.metrics.count_class("deadline_missed", st.priority)
+        goodput_ok = self.metrics.observe_finished(
             ttft_ms=ttft_ms, e2e_ms=e2e_ms, tpot_ms=tpot_ms,
             n_tokens=len(tokens),
+            priority=st.priority if self._overload is not None else None,
+            deadline_ok=deadline_ok,
         )
+        self._step_finished += 1
+        if self.metrics.slo is not None or st.deadline is not None:
+            self._step_slo_scored += 1
+            if goodput_ok:
+                self._step_slo_ok += 1
         if uid in self.results:
             raise RuntimeError(
                 f"request {uid!r} finished twice — replay bookkeeping bug"
@@ -585,19 +856,66 @@ class ServingEngine:
     def serve(self, traffic=(), *, max_steps: int = 1_000_000) -> dict:
         """Drive a (time-sorted or not) iterable of :class:`Arrival`
         through the engine until all offered traffic is ingested and —
-        unless :meth:`stop` said otherwise — every request finished.
-        Between work, the loop sleeps the (injectable) clock to the next
-        arrival. Returns ``dict(self.results)``."""
-        arrivals = deque(sorted(traffic, key=lambda a: a.t_s))
+        unless :meth:`stop` said otherwise — every request reached its
+        terminal state. Between work, the loop sleeps the (injectable)
+        clock to the next arrival. With the overload controller armed, a
+        :class:`Rejected` submit draws from the per-class retry budget
+        and re-enters the schedule after the deterministic backoff
+        (``overload.try_resubmit``); budget/attempt exhaustion makes the
+        Rejected terminal. Returns ``dict(self.results)``."""
+        # (t_s, seq, arrival, attempt) min-heap: resubmits re-enter the
+        # schedule at now + backoff without re-sorting; seq keeps equal
+        # timestamps FIFO and Arrival objects out of the comparison
+        heap: list = []
+        seq = 0
+        for a in sorted(traffic, key=lambda a: a.t_s):
+            heap.append((a.t_s, seq, a, 0))
+            seq += 1
+        heapq.heapify(heap)
         steps = 0
         while True:
             now = self.clock.monotonic()
-            if self._stopping and arrivals:
-                self.metrics.count("cancelled", len(arrivals))
-                arrivals.clear()
-            while arrivals and arrivals[0].t_s <= now:
-                a = arrivals.popleft()
-                self.submit(a.request, arrival_t=a.t_s)
+            if self._stopping and heap:
+                for _, _, a, attempt in heap:
+                    uid = a.request.uid
+                    if (self._overload is not None and attempt > 0
+                            and uid is not None):
+                        # an already-offered request awaiting its backoff:
+                        # cancellation makes its Rejected terminal — the
+                        # never-a-silent-drop invariant survives stop()
+                        self._record_terminal_rejected(Rejected(
+                            uid, "cancelled by stop() while awaiting "
+                            "resubmit", len(self._pending),
+                            getattr(a, "priority", "interactive"),
+                        ))
+                    else:
+                        self.metrics.count("cancelled")
+                heap.clear()
+            while heap and heap[0][0] <= now:
+                _, _, a, attempt = heapq.heappop(heap)
+                # arrival_t is ALWAYS the originally-offered time (a.t_s),
+                # resubmits included: TTFT/e2e accrue from when the client
+                # first asked, and the deadline budget anchors there too —
+                # a retry must not rebase the SLO it is judged against
+                res = self.submit(
+                    a.request, arrival_t=a.t_s,
+                    priority=getattr(a, "priority", "interactive"),
+                    deadline_ms=getattr(a, "deadline_ms", None),
+                )
+                if isinstance(res, Rejected) and self._overload is not None:
+                    delay = self._overload.try_resubmit(
+                        res.priority, attempt, now=self.clock.monotonic()
+                    )
+                    if delay is None:
+                        self._record_terminal_rejected(res)
+                    else:
+                        self.metrics.count("resubmitted")
+                        self.metrics.count_class("resubmitted", res.priority)
+                        heapq.heappush(heap, (
+                            self.clock.monotonic() + delay, seq, a,
+                            attempt + 1,
+                        ))
+                        seq += 1
             if self._step_once():
                 steps += 1
                 if steps >= max_steps:
@@ -607,8 +925,8 @@ class ServingEngine:
                         f"self.results"
                     )
                 continue
-            if arrivals:
-                dt = arrivals[0].t_s - self.clock.monotonic()
+            if heap:
+                dt = heap[0][0] - self.clock.monotonic()
                 if dt > 0:
                     self.clock.sleep(dt)
                 continue
@@ -644,6 +962,12 @@ class ServingEngine:
         snap["tokens"]["per_s"] = round(
             self.metrics.tokens_generated / elapsed, 6
         )
+        # goodput (ISSUE 11): SLO-attaining throughput — the A/B axis the
+        # overload λ-sweep plots (collapses past saturation without the
+        # controller, plateaus with it)
+        snap["tokens"]["goodput_per_s"] = round(
+            self.metrics.tokens_goodput / elapsed, 6
+        )
         snap["engine"] = {
             "world_size": self.world_size,
             "full_world_size": int(self.full_mesh.devices.size),
@@ -653,6 +977,8 @@ class ServingEngine:
             "prefill_bucket_programs": self._batcher.prefill_bucket_count,
             "clock_s": round(now - self._t0, 9),
         }
+        if self._overload is not None:
+            snap["overload"] = self._overload.snapshot()
         if _obs.span_enabled():
             # per-phase p50/p99 from the span tracer (ISSUE 9 satellite):
             # the λ-sweep rows carry a step-time BREAKDOWN (queued /

@@ -161,10 +161,20 @@ class ServingMetrics:
     ``resilience/health.py`` style.
 
     All times arrive in milliseconds from the engine's injectable clock;
-    this module never reads time itself (see module docstring)."""
+    this module never reads time itself (see module docstring).
 
-    def __init__(self, slo: SLOTargets | None = None):
+    ``classes`` (ISSUE 11) opts into the per-priority-class surface the
+    overload controller needs: per-class TTFT histograms plus per-class
+    counters (``count_class``), and **goodput** accounting — tokens from
+    requests that attained every set SLO dimension AND met their deadline
+    count toward ``tokens_goodput``; everything else is throughput the
+    SLO can't use. With ``classes=None`` (the default) the snapshot is
+    the pre-overload one plus the always-present goodput total."""
+
+    def __init__(self, slo: SLOTargets | None = None,
+                 classes: tuple | None = None):
         self.slo = slo
+        self.classes = tuple(classes) if classes is not None else None
         self.ttft_ms = StreamingHistogram()
         self.resumed_ttft_ms = StreamingHistogram()
         self.tpot_ms = StreamingHistogram()
@@ -176,13 +186,27 @@ class ServingMetrics:
         self.slot_occupancy = StreamingHistogram(lo=1e-2, hi=10.0)
         self.counters: dict[str, int] = {}
         self.tokens_generated = 0
+        # goodput = SLO-attaining throughput (deadline included): the
+        # metric the overload A/B judges (docs/serving.md "Overload")
+        self.tokens_goodput = 0
         self._slo_ok = 0
         self._slo_ok_by: dict[str, int] = {"ttft_ms": 0, "e2e_ms": 0,
                                            "tpot_ms": 0}
         self._slo_total = 0
+        self._class_ttft: dict[str, StreamingHistogram] = {
+            c: StreamingHistogram() for c in (self.classes or ())
+        }
+        self._class_counters: dict[str, int] = {}
 
     def count(self, name: str, n: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + n
+
+    def count_class(self, name: str, priority: str | None, n: int = 1) -> None:
+        """Per-class counter (a no-op unless class tracking is on)."""
+        if self.classes is None or priority is None:
+            return
+        key = f"{name}_{priority}"
+        self._class_counters[key] = self._class_counters.get(key, 0) + n
 
     # -- engine observation hooks ---------------------------------------
 
@@ -192,29 +216,44 @@ class ServingMetrics:
         self.queue_depth.record(float(queue_depth))
         self.slot_occupancy.record(occupied / max(1, slots))
 
-    def observe_first_token(self, ttft_ms: float, *,
-                            resumed: bool = False) -> None:
+    def observe_first_token(self, ttft_ms: float, *, resumed: bool = False,
+                            priority: str | None = None) -> None:
         (self.resumed_ttft_ms if resumed else self.ttft_ms).record(ttft_ms)
+        if not resumed and self.classes is not None and priority is not None:
+            hist = self._class_ttft.get(priority)
+            if hist is not None:
+                hist.record(ttft_ms)
 
     def observe_finished(self, *, ttft_ms: float, e2e_ms: float,
-                         tpot_ms: float | None, n_tokens: int) -> None:
+                         tpot_ms: float | None, n_tokens: int,
+                         priority: str | None = None,
+                         deadline_ok: bool | None = None) -> bool:
+        """Score one finished request. Returns whether its tokens counted
+        toward goodput (every set SLO dimension attained AND the deadline
+        — when one was carried — met)."""
         self.count("finished")
+        self.count_class("finished", priority)
         self.tokens_generated += int(n_tokens)
         self.e2e_ms.record(e2e_ms)
         if tpot_ms is not None:
             self.tpot_ms.record(tpot_ms)
-        if self.slo is None:
-            return
-        self._slo_total += 1
-        got = {"ttft_ms": ttft_ms, "e2e_ms": e2e_ms, "tpot_ms": tpot_ms}
-        ok = True
-        for dim, target in self.slo.as_dict().items():
-            dim_ok = got[dim] is not None and got[dim] <= target
-            if dim_ok:
-                self._slo_ok_by[dim] += 1
-            ok = ok and dim_ok
-        if ok:
-            self._slo_ok += 1
+        attained = None
+        if self.slo is not None:
+            self._slo_total += 1
+            got = {"ttft_ms": ttft_ms, "e2e_ms": e2e_ms, "tpot_ms": tpot_ms}
+            ok = True
+            for dim, target in self.slo.as_dict().items():
+                dim_ok = got[dim] is not None and got[dim] <= target
+                if dim_ok:
+                    self._slo_ok_by[dim] += 1
+                ok = ok and dim_ok
+            if ok:
+                self._slo_ok += 1
+            attained = ok
+        goodput_ok = attained is not False and deadline_ok is not False
+        if goodput_ok:
+            self.tokens_goodput += int(n_tokens)
+        return goodput_ok
 
     # -- readout --------------------------------------------------------
 
@@ -234,9 +273,12 @@ class ServingMetrics:
     def snapshot(self) -> dict:
         """One JSON-able view (the health.snapshot() analogue). The engine
         layers its world/clock facts on top (``ServingEngine.snapshot``)."""
-        return {
+        snap = {
             "requests": dict(sorted(self.counters.items())),
-            "tokens": {"generated": self.tokens_generated},
+            "tokens": {
+                "generated": self.tokens_generated,
+                "goodput": self.tokens_goodput,
+            },
             "latency_ms": {
                 "ttft": self.ttft_ms.snapshot(),
                 "resumed_ttft": self.resumed_ttft_ms.snapshot(),
@@ -249,3 +291,12 @@ class ServingMetrics:
             },
             "slo": self.slo_attainment(),
         }
+        if self.classes is not None:
+            snap["by_class"] = {
+                "counters": dict(sorted(self._class_counters.items())),
+                "ttft_ms": {
+                    c: h.snapshot()
+                    for c, h in sorted(self._class_ttft.items())
+                },
+            }
+        return snap

@@ -1,0 +1,356 @@
+"""Overload controller (ISSUE 11 tentpole): SLO-aware admission, priority
+shedding, per-class retry budgets, and a brownout degradation ladder.
+
+Past saturation a bounded queue with reject/block has exactly one failure
+mode: p99 TTFT collapses for *everyone*. Production serving engines treat
+overload as a first-class fault instead — shed the right work (lowest
+priority class first, deadline-expired work always), degrade precision
+before degrading latency, and keep *goodput* (SLO-attaining throughput)
+flat while the shed rate absorbs the excess. This module is that policy,
+deliberately **engine-agnostic**: it consumes plain per-step observations
+(queue depth, arrivals, completions, SLO verdicts) and answers policy
+questions (`admit`, `submit_allowed`, `try_resubmit`); the engine applies
+the decisions (``serving/engine.py``) and the disaggregated-pool topology
+(ROADMAP #2) can run one controller per pool over the same interface.
+
+Three mechanisms, composed:
+
+- **Deadline propagation + expiry shedding.** An arrival may carry a
+  ``deadline_ms`` budget; queued requests whose deadline has passed are
+  shed *before* admission (a typed :class:`~.engine.Shed` terminal — never
+  a silent drop), and in-flight requests past their deadline finish but
+  are scored as SLO-missed (their tokens never count toward goodput).
+- **Priority classes + per-class retry budgets.** ``interactive`` beats
+  ``batch``: queue-overflow sheds strike the lowest class first, and in
+  any brownout state admission is strict-priority. A request Rejected at a
+  full queue may be resubmitted after a deterministic backoff
+  (``resilience.retry.RetryPolicy.delays`` — the existing jitter
+  machinery, injectable clock throughout) drawing from a per-class token
+  bucket, so retry storms are bounded per class, not per request.
+- **The brownout ladder.** A pressure signal in ``[0, 1]`` derived from
+  queue depth, drain rate, and rolling SLO attainment drives::
+
+      normal ──► brownout1 ──► brownout2 ──► shed_all_batch
+        ▲            │             │               │
+        └──(hysteresis: exit thresholds + dwell)───┘
+
+  *brownout1*: strict-priority admission — batch defers while interactive
+  work is pending (overflow/deadline sheds already strike batch first).
+  *brownout2*: additionally requests a **precision downshift** — the
+  engine rebuilds its step on a degraded operand format (the PR 7
+  w8/int8-KV formats) via the ``OverloadConfig.downshift`` hook, trading
+  accumulation precision for step time before trading latency.
+  *shed_all_batch*: batch is refused outright (typed Shed at submit) and
+  the queued batch backlog is shed.
+
+  Climbs are immediate (one rung per observed step — overload is an
+  emergency); descents require the pressure to fall below the *exit*
+  threshold of the current rung AND a minimum dwell, so the ladder cannot
+  flap around a threshold. Every transition is recorded in the health
+  registry (``health.record_brownout`` with the dominant pressure term as
+  the attributed cause) and as an obs span by the engine.
+
+Determinism: the controller reads time only from values the caller passes
+in (the engine's injectable clock), backoff jitter comes from the seeded
+``RetryPolicy`` PRNG, and the pressure window is a fixed-size deque of
+caller-supplied observations — a ``FakeClock`` serve run transitions
+byte-identically every time (pinned in tests/test_overload.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any
+
+from triton_dist_tpu.resilience.retry import RetryPolicy
+
+# priority classes, best first; the index is the shed/admission rank
+PRIORITIES = ("interactive", "batch")
+
+# ladder states, in climbing order
+NORMAL = "normal"
+BROWNOUT1 = "brownout1"
+BROWNOUT2 = "brownout2"
+SHED_ALL_BATCH = "shed_all_batch"
+LADDER = (NORMAL, BROWNOUT1, BROWNOUT2, SHED_ALL_BATCH)
+
+
+def priority_rank(priority: str) -> int:
+    """Lower is better; raises on unknown classes (policy typos must be
+    loud — a misspelled class silently treated as batch would shed it)."""
+    try:
+        return PRIORITIES.index(priority)
+    except ValueError:
+        raise ValueError(
+            f"unknown priority {priority!r}; expected one of {PRIORITIES}"
+        ) from None
+
+
+@dataclasses.dataclass(frozen=True)
+class OverloadConfig:
+    """Policy knobs (arm via ``ServingConfig(overload=OverloadConfig())``).
+
+    enter_pressure:  pressure at/above which the ladder climbs INTO rung
+                     1/2/3 (monotone non-decreasing triple).
+    exit_pressure:   pressure below which the ladder may descend OUT of
+                     rung 1/2/3 (each strictly below its enter twin —
+                     the hysteresis band).
+    min_dwell_steps: observed steps a state must hold before it may
+                     descend (climbs are never delayed).
+    window_steps:    rolling window for the drain-rate and SLO terms.
+    queue_weight / drain_weight / slo_weight: pressure-term weights
+                     (their sum caps the reachable pressure; keep <= 1).
+    retry_policy:    deterministic backoff/jitter schedule for
+                     resubmit-after-Rejected (resilience/retry.py; the
+                     attempt bound is ``max_attempts - 1`` resubmits).
+    retry_budget:    token-bucket capacity per priority class.
+    retry_refill_per_s: bucket refill rate (tokens/second, caller clock).
+    downshift:       optional ``cfg -> degraded_cfg`` hook the engine
+                     applies when entering brownout2 (e.g. flip the MoE
+                     ``GroupGemmConfig.w8`` / int8-KV operand formats) and
+                     reverts on descent. None = the transition is still
+                     recorded, nothing is rebuilt.
+    """
+
+    enter_pressure: tuple = (0.55, 0.75, 0.9)
+    exit_pressure: tuple = (0.35, 0.55, 0.75)
+    min_dwell_steps: int = 8
+    window_steps: int = 16
+    queue_weight: float = 0.5
+    drain_weight: float = 0.2
+    slo_weight: float = 0.3
+    retry_policy: RetryPolicy = RetryPolicy(
+        max_attempts=3, base_delay_s=0.1, multiplier=2.0, max_delay_s=2.0
+    )
+    retry_budget: int = 8
+    retry_refill_per_s: float = 1.0
+    downshift: Any = None
+
+    def validate(self) -> "OverloadConfig":
+        if len(self.enter_pressure) != 3 or len(self.exit_pressure) != 3:
+            raise ValueError(
+                "enter_pressure/exit_pressure must name all 3 rungs, got "
+                f"{self.enter_pressure!r} / {self.exit_pressure!r}"
+            )
+        if list(self.enter_pressure) != sorted(self.enter_pressure):
+            raise ValueError(
+                f"enter_pressure must be non-decreasing, got "
+                f"{self.enter_pressure!r}"
+            )
+        for i, (lo, hi) in enumerate(
+            zip(self.exit_pressure, self.enter_pressure)
+        ):
+            if not lo < hi:
+                raise ValueError(
+                    f"exit_pressure[{i}]={lo} must sit strictly below "
+                    f"enter_pressure[{i}]={hi} (the hysteresis band)"
+                )
+        if self.min_dwell_steps < 1:
+            raise ValueError("min_dwell_steps must be >= 1")
+        if self.window_steps < 1:
+            raise ValueError("window_steps must be >= 1")
+        for name in ("queue_weight", "drain_weight", "slo_weight"):
+            if not 0.0 <= getattr(self, name) <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1]")
+        if self.retry_budget < 0:
+            raise ValueError("retry_budget must be >= 0")
+        if self.retry_refill_per_s < 0:
+            raise ValueError("retry_refill_per_s must be >= 0")
+        self.retry_policy.validate()
+        return self
+
+
+@dataclasses.dataclass(frozen=True)
+class Transition:
+    """One ladder move, as recorded by :meth:`OverloadController.observe_step`."""
+
+    t_s: float
+    frm: str
+    to: str
+    pressure: float
+    cause: str      # the dominant pressure term: "queue" | "drain" | "slo"
+
+
+class OverloadController:
+    """The mutable policy state. One instance per engine (or per pool).
+
+    The engine calls, per scheduling step::
+
+        ctrl.observe_step(now=..., queue_depth=..., arrived=...,
+                          finished=..., slo_ok=..., slo_scored=...)
+
+    and consults :meth:`rung` / :meth:`submit_allowed` /
+    :meth:`strict_priority` / :meth:`wants_downshift` when applying
+    admission and shed decisions. Nothing here reads a clock or an RNG of
+    its own (module docstring)."""
+
+    def __init__(self, config: OverloadConfig, *, max_queue: int):
+        self.config = config.validate()
+        self.max_queue = max(1, int(max_queue))
+        self.state = NORMAL
+        self.transitions: list[Transition] = []
+        self._dwell = 0
+        self._win: deque = deque(maxlen=self.config.window_steps)
+        self._last_pressure = 0.0
+        self._last_cause = "queue"
+        self._tokens = {p: float(self.config.retry_budget) for p in PRIORITIES}
+        self._last_refill: float | None = None
+        self.sheds_by_class = {p: 0 for p in PRIORITIES}
+
+    # -- pressure --------------------------------------------------------
+
+    def _pressure_terms(self, queue_depth: int) -> dict:
+        c = self.config
+        queue_frac = min(1.0, queue_depth / self.max_queue)
+        arrived = sum(w[0] for w in self._win)
+        finished = sum(w[1] for w in self._win)
+        # drain deficit: fraction of the window's offered work the engine
+        # did NOT complete (0 with no arrivals — an idle engine has no
+        # drain problem, whatever its history)
+        drain = 0.0
+        if arrived > 0:
+            drain = min(1.0, max(0.0, (arrived - finished) / arrived))
+        scored = sum(w[3] for w in self._win)
+        ok = sum(w[2] for w in self._win)
+        miss = (scored - ok) / scored if scored > 0 else 0.0
+        return {
+            "queue": c.queue_weight * queue_frac,
+            "drain": c.drain_weight * drain,
+            "slo": c.slo_weight * miss,
+        }
+
+    def pressure(self, queue_depth: int) -> float:
+        """The current composite pressure in [0, 1] (read-only)."""
+        return min(1.0, sum(self._pressure_terms(queue_depth).values()))
+
+    def rung(self) -> int:
+        return LADDER.index(self.state)
+
+    # -- the ladder ------------------------------------------------------
+
+    def observe_step(
+        self,
+        *,
+        now: float,
+        queue_depth: int,
+        arrived: int = 0,
+        finished: int = 0,
+        slo_ok: int = 0,
+        slo_scored: int = 0,
+    ) -> Transition | None:
+        """Fold one engine step's observation into the rolling window and
+        advance the ladder at most one rung. Returns the transition (for
+        health/obs recording) or None."""
+        self._win.append((arrived, finished, slo_ok, slo_scored))
+        terms = self._pressure_terms(queue_depth)
+        p = min(1.0, sum(terms.values()))
+        self._last_pressure = p
+        self._last_cause = max(terms, key=lambda k: (terms[k], k))
+        self._dwell += 1
+        r = self.rung()
+        if r < 3 and p >= self.config.enter_pressure[r]:
+            return self._move(now, LADDER[r + 1], p)
+        if (
+            r > 0
+            and self._dwell >= self.config.min_dwell_steps
+            and p < self.config.exit_pressure[r - 1]
+        ):
+            return self._move(now, LADDER[r - 1], p)
+        return None
+
+    def _move(self, now: float, to: str, pressure: float) -> Transition:
+        tr = Transition(
+            t_s=now, frm=self.state, to=to, pressure=round(pressure, 6),
+            cause=self._last_cause,
+        )
+        self.state = to
+        self._dwell = 0
+        self.transitions.append(tr)
+        return tr
+
+    # -- policy answers --------------------------------------------------
+
+    def submit_allowed(self, priority: str) -> bool:
+        """False ⇒ refuse at the door with a typed Shed (only the batch
+        class in ``shed_all_batch``)."""
+        return not (
+            self.state == SHED_ALL_BATCH and priority_rank(priority) > 0
+        )
+
+    def strict_priority(self) -> bool:
+        """In any brownout state admission is strict-priority: batch only
+        runs when no interactive request is waiting (it still runs
+        eventually — deferral, not starvation into deadlock)."""
+        return self.state != NORMAL
+
+    def wants_downshift(self) -> bool:
+        """brownout2 and above request the degraded precision step."""
+        return self.rung() >= 2 and self.config.downshift is not None
+
+    def shed_victim(self, queued: list) -> int | None:
+        """Pick the overflow-shed victim among ``queued``
+        ``(priority, enqueue_index)`` pairs: the NEWEST member of the
+        WORST class (least sunk queueing time, lowest class first).
+        None ⇒ nothing strictly below the best class is queued."""
+        if not queued:
+            return None
+        worst = max(priority_rank(p) for p, _ in queued)
+        if worst == 0:
+            return None
+        best_i = None
+        for i, (p, _) in enumerate(queued):
+            if priority_rank(p) == worst:
+                best_i = i  # last match = newest enqueue among the class
+        return best_i
+
+    def note_shed(self, priority: str) -> None:
+        self.sheds_by_class[priority] = self.sheds_by_class.get(priority, 0) + 1
+
+    # -- per-class retry budget -----------------------------------------
+
+    def _refill(self, now: float) -> None:
+        if self._last_refill is None:
+            self._last_refill = now
+            return
+        dt = max(0.0, now - self._last_refill)
+        self._last_refill = now
+        if dt and self.config.retry_refill_per_s:
+            for p in self._tokens:
+                self._tokens[p] = min(
+                    float(self.config.retry_budget),
+                    self._tokens[p] + dt * self.config.retry_refill_per_s,
+                )
+
+    def try_resubmit(self, priority: str, attempt: int, *, now: float):
+        """One Rejected request asking to come back. Returns the backoff
+        delay (seconds; the deterministic ``RetryPolicy.delays`` entry for
+        this class and attempt) or None when the attempt bound or the
+        class token bucket says no — the caller records the terminal
+        Rejected. ``attempt`` counts prior resubmits of this request."""
+        priority_rank(priority)  # validate
+        self._refill(now)
+        delays = self.config.retry_policy.delays(key=f"resubmit:{priority}")
+        if attempt >= len(delays):
+            return None
+        if self._tokens[priority] < 1.0:
+            return None
+        self._tokens[priority] -= 1.0
+        return delays[attempt]
+
+    # -- readout ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "state": self.state,
+            "pressure": round(self._last_pressure, 6),
+            "cause": self._last_cause,
+            "transitions": len(self.transitions),
+            "last_transitions": [
+                dataclasses.asdict(t) for t in self.transitions[-8:]
+            ],
+            "retry_tokens": {
+                p: round(v, 6) for p, v in sorted(self._tokens.items())
+            },
+            "sheds_by_class": dict(sorted(self.sheds_by_class.items())),
+        }

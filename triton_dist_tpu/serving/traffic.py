@@ -30,7 +30,7 @@ import numpy as np
 
 from triton_dist_tpu.models.decode import Request
 
-PROCESSES = ("poisson", "deterministic")
+PROCESSES = ("poisson", "deterministic", "burst")
 
 
 def sample_length(dist: tuple, rng: np.random.Generator) -> int:
@@ -87,10 +87,14 @@ def max_length(dist: tuple) -> int:
 @dataclasses.dataclass(frozen=True)
 class Arrival:
     """One scheduled request: ``t_s`` is the offered arrival time on the
-    engine's (injectable) clock."""
+    engine's (injectable) clock. ``priority``/``deadline_ms`` (ISSUE 11)
+    feed the overload controller — the defaults make every pre-overload
+    construction site and trace byte-identical."""
 
     t_s: float
     request: Request
+    priority: str = "interactive"
+    deadline_ms: int | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -99,9 +103,23 @@ class TrafficSpec:
 
     ``rate_rps`` is the offered load λ (mean arrivals/second); under
     ``process="deterministic"`` arrivals land exactly ``1/λ`` apart.
-    Per-request sampling seeds are derived from ``seed`` and the request
-    index, so a request's tokens are reproducible independently of the
-    trace position it was drawn at."""
+    ``process="burst"`` (ISSUE 11) is the flash-crowd shape: crowds of
+    ``burst_n`` arrivals start every ``burst_every_s`` seconds (default
+    ``burst_n / rate_rps``, so the MEAN offered load stays λ and a
+    λ-sweep over burst traffic sweeps what it claims to), Poisson-spaced
+    *within* a crowd at ``burst_rate_rps`` (default 10·λ) — the offered
+    load slams the queue in spikes the mean rate alone never shows. Per-request sampling seeds are derived from ``seed`` and the
+    request index, so a request's tokens are reproducible independently
+    of the trace position it was drawn at.
+
+    ``priority_mix`` (pairs of ``(weight, class)`` over
+    ``serving/overload.py`` PRIORITIES) and ``deadline_ms`` (a tagged
+    integer distribution like the length dists) stamp the overload fields
+    onto each arrival. Both default to None, and their draws come from a
+    SEPARATE seed-derived PRNG — a spec that leaves them unset generates
+    the byte-identical trace (same times, prompts, fingerprint) it did
+    before they existed, and setting them changes neither arrival times
+    nor prompts (pinned in tests/test_overload.py)."""
 
     rate_rps: float
     n_requests: int
@@ -115,6 +133,11 @@ class TrafficSpec:
     seed: int = 0
     start_s: float = 0.0
     uid_prefix: str = "req"
+    burst_every_s: float | None = None
+    burst_n: int = 8
+    burst_rate_rps: float | None = None
+    priority_mix: tuple | None = None
+    deadline_ms: tuple | None = None
 
     def validate(self) -> "TrafficSpec":
         if self.rate_rps <= 0:
@@ -129,24 +152,81 @@ class TrafficSpec:
             raise ValueError(f"vocab must be >= 2, got {self.vocab}")
         _validate_dist("prompt_len", self.prompt_len)
         _validate_dist("output_len", self.output_len)
+        if self.process == "burst":
+            if self.burst_every_s is not None and self.burst_every_s <= 0:
+                raise ValueError(
+                    f"burst_every_s must be > 0, got {self.burst_every_s}"
+                )
+            if self.burst_n < 1:
+                raise ValueError(f"burst_n must be >= 1, got {self.burst_n}")
+            if self.burst_rate_rps is not None and self.burst_rate_rps <= 0:
+                raise ValueError(
+                    f"burst_rate_rps must be > 0, got {self.burst_rate_rps}"
+                )
+        if self.priority_mix is not None:
+            from triton_dist_tpu.serving.overload import priority_rank
+
+            if not self.priority_mix or not all(
+                len(arm) == 2 and float(arm[0]) > 0 for arm in self.priority_mix
+            ):
+                raise ValueError(
+                    f"priority_mix must be ((weight, class), ...) with "
+                    f"positive weights, got {self.priority_mix!r}"
+                )
+            for _, cls in self.priority_mix:
+                priority_rank(cls)  # loud on unknown classes
+        if self.deadline_ms is not None:
+            _validate_dist("deadline_ms", self.deadline_ms)
         return self
 
 
 def generate_trace(spec: TrafficSpec) -> tuple[Arrival, ...]:
     """Expand a spec into its (time-sorted) arrival trace. Same spec ⇒
-    byte-identical trace (one PRNG, fixed draw order)."""
+    byte-identical trace (one PRNG, fixed draw order; the overload fields
+    draw from a second seed-derived PRNG so setting them perturbs neither
+    arrival times nor prompts — fingerprint-stable for unchanged specs)."""
     spec.validate()
     rng = np.random.default_rng(spec.seed)
+    # overload draws (priority / deadline) on their own stream: draw-order
+    # isolation from the times/lengths/prompts above
+    rng_ov = np.random.default_rng([int(spec.seed), 0x0F10AD])
+    prio_arms = None
+    if spec.priority_mix is not None:
+        w = np.array([float(a[0]) for a in spec.priority_mix], np.float64)
+        prio_arms = ([a[1] for a in spec.priority_mix], w / w.sum())
     out = []
     t = float(spec.start_s)
+    burst_rate = spec.burst_rate_rps or 10.0 * spec.rate_rps
+    # default crowd period keeps the MEAN offered rate at λ (docstring)
+    burst_every = (
+        spec.burst_every_s if spec.burst_every_s is not None
+        else spec.burst_n / spec.rate_rps
+    )
     for i in range(spec.n_requests):
         if spec.process == "poisson":
             t += float(rng.exponential(1.0 / spec.rate_rps))
+        elif spec.process == "burst":
+            # flash crowd k holds arrivals [k*burst_n, (k+1)*burst_n) and
+            # opens at start_s + k*burst_every_s; within a crowd the
+            # inter-arrival gaps are Poisson at the (much higher) burst
+            # rate
+            if i % spec.burst_n == 0:
+                t = float(spec.start_s) + (i // spec.burst_n) * burst_every
+            t += float(rng.exponential(1.0 / burst_rate))
         else:
             t += 1.0 / spec.rate_rps
         p_len = sample_length(spec.prompt_len, rng)
         o_len = sample_length(spec.output_len, rng)
         prompt = [int(x) for x in rng.integers(0, spec.vocab, p_len)]
+        priority = "interactive"
+        if prio_arms is not None:
+            priority = prio_arms[0][int(rng_ov.choice(
+                len(prio_arms[0]), p=prio_arms[1]
+            ))]
+        deadline = (
+            sample_length(spec.deadline_ms, rng_ov)
+            if spec.deadline_ms is not None else None
+        )
         out.append(Arrival(
             t_s=t,
             request=Request(
@@ -160,18 +240,26 @@ def generate_trace(spec: TrafficSpec) -> tuple[Arrival, ...]:
                 seed=int(spec.seed) * 1_000_003 + i,
                 uid=f"{spec.uid_prefix}{i}",
             ),
+            priority=priority,
+            deadline_ms=deadline,
         ))
-    return tuple(out)
+    return tuple(sorted(out, key=lambda a: a.t_s))
 
 
 def trace_fingerprint(trace: tuple[Arrival, ...]) -> str:
-    """Stable content hash of a trace — the byte-identical-replay pin."""
+    """Stable content hash of a trace — the byte-identical-replay pin.
+    The overload fields (priority / deadline_ms) enter the hash only when
+    set away from their defaults, so every pre-overload spec keeps its
+    historical fingerprint."""
     h = hashlib.sha256()
     for a in trace:
+        extra = ()
+        if a.priority != "interactive" or a.deadline_ms is not None:
+            extra = (a.priority, a.deadline_ms)
         h.update(repr((
             round(a.t_s, 12), a.request.prompt, a.request.max_new_tokens,
             a.request.eos_id, a.request.temperature, a.request.top_k,
-            a.request.seed, a.request.uid,
+            a.request.seed, a.request.uid, *extra,
         )).encode())
     return h.hexdigest()
 
