@@ -1026,6 +1026,37 @@ def _run_serving(argv) -> None:
         )
         for name, value, unit in sbench.info_lines(ab_rows, tag=tag):
             emit_info(name, value, unit)
+    # prefix-cache A/B (ISSUE 12): the shared-prefix workload (Zipf over
+    # seed-derived system prompts) served cold vs radix-shared, per share
+    # ratio. The on-arm's admission feeds only the divergent suffix, so
+    # p50 TTFT collapses and the hit-rate / prefill-tokens-saved columns
+    # attribute exactly why. Seeded + FakeClock ⇒ byte-identical reruns;
+    # info lines only, never perf-gated. Both arms run the PAGED batcher
+    # (page_size=4) so the A/B isolates the sharing, not the cache layout.
+    from triton_dist_tpu.models.prefix_cache import PrefixCacheConfig
+
+    for share in (0.5, 1.0):
+        # the shared_prefix_mix shape (serving/traffic.py): Zipf over 2
+        # seed-derived 12-token system prompts (3 shared pages at
+        # page_size=4), prepended to each request's suffix with
+        # probability `share`; worst case 12+6+8 = 26 <= s_max=32
+        px_traffic = dict(
+            prefix_pool=2, prefix_len=("fixed", 12), prefix_zipf=1.2,
+            prefix_share=share,
+        )
+        for tag, px in (("_px_off", None), ("_px_on", PrefixCacheConfig())):
+            stag = f"{tag}_s{int(share * 100)}"
+            px_rows = sbench.sweep_offered_load(
+                cfg, params, mesh, s_max=32, rates=rates, n_requests=64,
+                prompt_len=("uniform", 2, 6), output_len=("uniform", 2, 8),
+                seed=0, virtual_step_s=0.05,
+                slo=SLOTargets(ttft_ms=800.0, e2e_ms=3000.0),
+                serving_kw=dict(prefix_cache=px),
+                batcher_kw=dict(page_size=4),
+                traffic_kw=px_traffic, tag=stag.strip("_") + ":",
+            )
+            for name, value, unit in sbench.info_lines(px_rows, tag=stag):
+                emit_info(name, value, unit)
     if obs_path is not None:
         obs.export_chrome_trace(obs_path, label="bench_serving")
 

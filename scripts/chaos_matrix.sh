@@ -66,6 +66,14 @@
 # accounting, bit-identical seeded replay). The full 20-campaign soak is
 # scripts/chaos_soak.py / `pytest -m soak` (soak implies slow).
 #
+# Since ISSUE 12 the matrix also covers the PREFIX-CACHE cells
+# (tests/test_prefix_cache.py): a poisoned SHARED prefix page must
+# strike every reader of the chain (evicted for a cold re-prefill,
+# byte-identical regeneration, no request lost), and the quick
+# shared-prefix soak campaign composes the strike with the straggler /
+# corruption rebuild arcs over burst traffic (resilience/soak.py
+# SoakSpec.shared_prefix; the full set rides scripts/chaos_soak.py).
+#
 # Every cell runs under a wall-clock budget (TDT_CELL_TIMEOUT_S,
 # default 600 s; conftest.py delivers it as a SIGALRM inside the cell):
 # a hung cell reports as one named FAILED row — and so fails the exit
@@ -89,13 +97,15 @@ trap 'rm -f "$log"' EXIT
 files="tests/test_chaos.py tests/test_elastic.py \
     tests/test_chunked.py tests/test_chunked_a2a.py tests/test_ragged.py \
     tests/test_emitter.py tests/test_serving.py tests/test_integrity.py \
-    tests/test_obs.py tests/test_analysis.py tests/test_overload.py"
+    tests/test_obs.py tests/test_analysis.py tests/test_overload.py \
+    tests/test_prefix_cache.py"
 marker="chaos"
 lint_args=""
 if [ "${1:-}" = "--quick" ]; then
     shift
     files="tests/test_integrity.py tests/test_serving.py \
-        tests/test_elastic.py tests/test_overload.py"
+        tests/test_elastic.py tests/test_overload.py \
+        tests/test_prefix_cache.py"
     marker="chaos and not slow"
     # keep the quick posture bounded: worlds {2,4} (the full {2,4,8}
     # sweep is the default standalone run's job)

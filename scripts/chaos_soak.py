@@ -16,14 +16,23 @@ engine with the overload controller armed, and asserts on every one:
 - campaign 0 is re-run from its seed and must reproduce a byte-identical
   fingerprint — seeded replay.
 
+Since ISSUE 12 the run also includes SHARED-PREFIX campaigns
+(``SoakSpec.shared_prefix``): burst traffic over Zipf shared system
+prompts with the radix prefix cache armed, composing the straggler and
+corruption arcs above with a scheduled poisoned SHARED page — the strike
+must evict every reader of the struck chain for a cold re-prefill
+(attributed recovery, no lost request) and the whole campaign must
+replay bit-identically from its seed.
+
 Usage::
 
     scripts/chaos_soak.py [--campaigns N] [--seed-base S] [--quick]
-                          [--no-replay-check]
+                          [--no-replay-check] [--no-prefix]
 
-``--quick`` runs 3 small campaigns (the chaos-matrix cell posture);
-the default 20 campaigns are the ISSUE 11 acceptance run. Exit code 0
-iff every campaign is green (and the replay check holds).
+``--quick`` runs 3 small + 1 shared-prefix campaign (the chaos-matrix
+cell posture); the default 20 + 6 shared-prefix campaigns are the
+ISSUE 11/12 acceptance run. Exit code 0 iff every campaign is green
+(and the replay checks hold).
 """
 
 import argparse
@@ -47,8 +56,11 @@ def main(argv=None) -> int:
     ap.add_argument("--campaigns", type=int, default=20)
     ap.add_argument("--seed-base", type=int, default=0)
     ap.add_argument("--quick", action="store_true",
-                    help="3 small campaigns (chaos-matrix cell posture)")
+                    help="3 small + 1 shared-prefix campaign "
+                         "(chaos-matrix cell posture)")
     ap.add_argument("--no-replay-check", action="store_true")
+    ap.add_argument("--no-prefix", action="store_true",
+                    help="skip the shared-prefix campaign set (ISSUE 12)")
     args = ap.parse_args(argv)
 
     from triton_dist_tpu import config as tdt_config
@@ -60,11 +72,19 @@ def main(argv=None) -> int:
     n = 3 if args.quick else args.campaigns
     small = dict(n_requests=12, n_timeouts=1, n_corruptions=1,
                  fault_window=20) if args.quick else {}
+    n_px = 0 if args.no_prefix else (1 if args.quick else 6)
+
+    def build_spec(k: int):
+        if k < n:
+            return soak.SoakSpec(seed=args.seed_base + k, **small), "std"
+        return soak.SoakSpec.shared_prefix(
+            seed=args.seed_base + 100 + (k - n)
+        ), "px"
 
     rows = []
     t0 = time.time()
-    for k in range(n):
-        spec = soak.SoakSpec(seed=args.seed_base + k, **small)
+    for k in range(n + n_px):
+        spec, kind_tag = build_spec(k)
         t1 = time.time()
         res = soak.run_campaign(spec)
         dt = time.time() - t1
@@ -72,12 +92,20 @@ def main(argv=None) -> int:
         for kind in res.terminals.values():
             census[kind] = census.get(kind, 0) + 1
         verdict = "PASS" if res.ok else "FAIL"
-        rows.append((spec.seed, verdict, res))
+        rows.append((k, verdict, res))
+        px_note = ""
+        if kind_tag == "px":
+            reqs = res.snapshot.get("requests", {})
+            px = res.snapshot.get("prefix_cache", {})
+            px_note = (
+                f" [prefix: hit_rate={px.get('hit_rate', 0)} "
+                f"struck_readers={reqs.get('prefix_struck', 0)}]"
+            )
         print(
-            f"  campaign seed={spec.seed:<4d} {verdict}  "
+            f"  campaign {kind_tag} seed={spec.seed:<4d} {verdict}  "
             f"{dt:6.1f}s  terminals={dict(sorted(census.items()))} "
             f"rebuilds={res.rebuilds} transitions={len(res.transitions)} "
-            f"fp={res.fingerprint[:12]}",
+            f"fp={res.fingerprint[:12]}{px_note}",
             flush=True,
         )
         if not res.ok:
@@ -88,15 +116,20 @@ def main(argv=None) -> int:
 
     replay_ok = True
     if not args.no_replay_check and rows:
-        seed0, _, first = rows[0]
-        spec = soak.SoakSpec(seed=seed0, **small)
-        again = soak.run_campaign(spec)
-        replay_ok = again.fingerprint == first.fingerprint
-        print(
-            f"  replay check seed={seed0}: "
-            f"{'bit-identical' if replay_ok else 'MISMATCH'} "
-            f"({first.fingerprint[:12]} vs {again.fingerprint[:12]})"
-        )
+        # one replay per campaign KIND: the standard arc and (when run)
+        # the shared-prefix arc must both reproduce bit-identically
+        replay_at = [0] + ([n] if n_px else [])
+        for idx in replay_at:
+            spec, kind_tag = build_spec(idx)
+            first = rows[idx][2]
+            again = soak.run_campaign(spec)
+            ok = again.fingerprint == first.fingerprint
+            replay_ok = replay_ok and ok
+            print(
+                f"  replay check {kind_tag} seed={spec.seed}: "
+                f"{'bit-identical' if ok else 'MISMATCH'} "
+                f"({first.fingerprint[:12]} vs {again.fingerprint[:12]})"
+            )
 
     n_fail = sum(1 for _, v, _ in rows if v != "PASS")
     print(

@@ -19,6 +19,10 @@ from triton_dist_tpu.models.decode import (
     generate,
 )
 from triton_dist_tpu.models.pipeline import pipeline_apply, stage_slice
+from triton_dist_tpu.models.prefix_cache import (
+    PagePrefixCache,
+    PrefixCacheConfig,
+)
 from triton_dist_tpu.models.speculative import (
     speculative_generate,
     verify_step,
@@ -52,7 +56,9 @@ from triton_dist_tpu.models.tp_transformer import (
 __all__ = [
     "ContinuousBatcher",
     "KVCacheSpec",
+    "PagePrefixCache",
     "PagedKVCacheSpec",
+    "PrefixCacheConfig",
     "Request",
     "StepsExhaustedError",
     "presets",

@@ -237,6 +237,12 @@ class PagedKVCacheSpec:
     # sequence with a fresh block list). The block-table indirection and
     # paged kernel path are identical either way.
     static_table: bool = False
+    # extra (non-table-assigned) physical pages per PE. The prefix cache
+    # (models/prefix_cache.py) reserves one as the SCRATCH page released
+    # slots' table rows park on, so an idle slot's dummy decode step can
+    # never scribble a page the allocator has re-issued. 0 = the layout
+    # every pre-cache caller built, byte for byte.
+    extra_pages: int = 0
 
     def _geometry(self, cfg, n: int, n_o: int = 1) -> tuple[int, int]:
         s_shard = _shard_of(self.s_max, n)
@@ -259,6 +265,7 @@ class PagedKVCacheSpec:
 
     def init(self, cfg: TransformerConfig, n: int, n_o: int = 1) -> dict:
         pages_per_seq, n_pages = self._geometry(cfg, n, n_o)
+        n_pages += self.extra_pages
         b_att = cfg.batch // n_o   # per-outer-group batch slice
         w = n_o * n                # total PEs
         shape = (
@@ -803,6 +810,7 @@ class ContinuousBatcher:
         fd_config: FlashDecodeConfig | None = None,
         prefill: bool = False,
         interpret: Any = None,
+        prefix_cache: Any = None,
     ):
         self.cfg, self.mesh, self.s_max = cfg, mesh, s_max
         n = mesh.shape[cfg.axis]
@@ -813,13 +821,44 @@ class ContinuousBatcher:
                 "fd_config tiles the contiguous kernel; with page_size the "
                 "page is the block — pass one or the other"
             )
+        # radix prefix cache (ISSUE 12): host-managed block table over the
+        # paged pool; None = the pre-cache batcher, byte for byte
+        self._px = None
+        self._px_dirty = False
+        self.struck: list[tuple[Any, str]] = []
+        if prefix_cache is not None:
+            prefix_cache.validate()
+            if not page_size:
+                raise ValueError(
+                    "prefix_cache shares refcounted chains of PHYSICAL "
+                    "pages — it needs the paged cache (pass page_size)"
+                )
+            if prefill:
+                raise ValueError(
+                    "prefix_cache composes with token-fed admission only: "
+                    "the masked prefill pass has no attend-to-prior-cache "
+                    "form, so a shared prefix could not be skipped (and "
+                    "its KV would not be bit-identical across prefill "
+                    "buckets); ROADMAP #2's disaggregated prefill pool is "
+                    "the streaming form of this"
+                )
+            if n_o > 1:
+                raise ValueError(
+                    "prefix_cache supports flat (1-axis) serving meshes: "
+                    "a hierarchical deployment shards the page pool per "
+                    "outer batch group, so one trie cannot name pages "
+                    "across groups"
+                )
         # prefill + paged composes: the batcher's tables are STATIC
         # (pre-assigned page ranges), exactly what the paged prefill's
         # batch page write needs
         self.prefill = prefill
         self._prefill_progs: dict[int, Any] = {}
         self.spec = (
-            PagedKVCacheSpec(s_max, page_size, static_table=True)
+            PagedKVCacheSpec(
+                s_max, page_size, static_table=True,
+                extra_pages=1 if prefix_cache is not None else 0,
+            )
             if page_size else KVCacheSpec(s_max)
         )
         self.cache = jax.tree.map(
@@ -866,6 +905,14 @@ class ContinuousBatcher:
         # non-finite under an armed config.integrity — evicted, never
         # finished; drained by the serving engine for typed rejection
         self.poisoned: list[tuple[Any, list, str]] = []
+        if prefix_cache is not None:
+            from triton_dist_tpu.models.prefix_cache import PagePrefixCache
+
+            self._px = PagePrefixCache(
+                prefix_cache, n_slots=b, page=page_size,
+                pps_local=(s_max // n) // page_size, n_pes=n,
+            )
+            self._px_dirty = True   # park every row on scratch before step 1
 
     def validate_request(self, req: Request) -> None:
         """Admissibility checks (shared with the serving engine, which
@@ -995,6 +1042,20 @@ class ContinuousBatcher:
                     )
                     if self.prefill and len(req.prompt) > 1:
                         self._admit_prefill(i, req)
+                    elif self._px is not None:
+                        # longest-prefix match (ISSUE 12): every fully
+                        # shared page is skipped — the slot starts its
+                        # feed at the first token whose KV the trie does
+                        # not already hold; the divergent page onward is
+                        # freshly claimed (CoW), so shared pages are
+                        # never written
+                        n_hit = self._px.acquire(
+                            i, req.prompt, req.max_new_tokens
+                        )
+                        self._px_dirty = True
+                        self.pos[i] = n_hit
+                        self.tok[i] = req.prompt[n_hit]
+                        self.slot_fed[i] = n_hit + 1
                     else:
                         self.pos[i] = 0
                         self.tok[i] = req.prompt[0]
@@ -1040,6 +1101,29 @@ class ContinuousBatcher:
         out, self.poisoned = self.poisoned, []
         return out
 
+    def drain_struck(self) -> list[tuple[Any, str]]:
+        """Hand over (and clear) every ``(uid, reason)`` evicted by a
+        poisoned-shared-page strike (ISSUE 12): these requests read a page
+        of the poisoned slot's chain, so their cache state is suspect —
+        they were evicted WITHOUT a terminal state and must be
+        resubmitted for a cold re-prefill (the serving engine restarts
+        them from the original prompt, discarding tokens generated over
+        the struck pages; a direct batcher user must resubmit them
+        itself or they are lost)."""
+        out, self.struck = self.struck, []
+        return out
+
+    def prefix_cache_stats(self) -> dict | None:
+        """The prefix cache's counters + gauges (models/prefix_cache.py),
+        or None when disarmed."""
+        return None if self._px is None else self._px.stats()
+
+    @property
+    def prefix_cache(self):
+        """The live :class:`~triton_dist_tpu.models.prefix_cache.
+        PagePrefixCache` (tests / fault harnesses), or None."""
+        return self._px
+
     def _poison_slot(self, i: int, reason: str) -> None:
         """Evict slot ``i``'s request as poisoned. Containment argument:
         decode rows never mix across the batch dim (attention is
@@ -1054,6 +1138,24 @@ class ContinuousBatcher:
         self.poisoned.append((req.uid, list(self.slot_out[i]), reason))
         self.slot_req[i] = None
         health.record_poisoned_request("continuous_batcher", req.uid, reason)
+        if self._px is not None:
+            # poisoned SHARED pages strike every reader (ISSUE 12): the
+            # poisoned slot's whole chain is detached from the trie (no
+            # future match can serve a possibly-corrupt page), and every
+            # other slot reading any struck page is evicted for a cold
+            # re-prefill — corrupt KV is never served, not even once more
+            readers = self._px.release(i, strike=True)
+            for j in readers:
+                r = self.slot_req[j]
+                self._px.release(j)
+                self.slot_req[j] = None
+                self.struck.append((
+                    r.uid, f"shared prefix page struck: {reason}"
+                ))
+                health.record_prefix_strike(
+                    "continuous_batcher", r.uid, reason
+                )
+            self._px_dirty = True
 
     def export_in_flight(self) -> tuple[list[tuple[Request, list, Any]],
                                         list[Request]]:
@@ -1075,6 +1177,21 @@ class ContinuousBatcher:
         self._admit()
         if self.idle:
             return
+        if self._px is not None and self._px_dirty:
+            # push the host-managed block table (admissions repointed rows
+            # at shared chains / fresh private pages, releases parked rows
+            # on scratch) — the only device-visible artifact of the whole
+            # prefix-cache layer
+            self.cache = dict(
+                self.cache,
+                block_table=jax.device_put(
+                    jnp.asarray(self._px.table),
+                    NamedSharding(
+                        self.mesh, self.spec.specs(self.cfg)["block_table"]
+                    ),
+                ),
+            )
+            self._px_dirty = False
         logits, self.cache = self._step(
             self.params, self.cache,
             jnp.asarray(self.tok), jnp.asarray(self.pos),
@@ -1128,8 +1245,26 @@ class ContinuousBatcher:
                 if done:
                     self.finished.append((req.uid, self.slot_out[i]))
                     self.slot_req[i] = None
+                    if self._px is not None:
+                        self._px.release(i)
+                        self._px_dirty = True
                     continue
             self.pos[i] += 1
+            if self._px is not None:
+                # publish-on-completion: a prompt page enters the trie only
+                # once its last position's KV is written (a reader admitted
+                # earlier would attend to unwritten pages); generated
+                # positions extend the slot's PRIVATE chain only, so pages
+                # touching them are never published
+                p, pg = int(self.pos[i]), self._px.page
+                if p % pg == 0:
+                    g = p // pg - 1
+                    if (g == self._px.next_publish(i)
+                            and (g + 1) * pg <= len(req.prompt)):
+                        if self._px.publish(
+                            i, g, req.prompt[g * pg:(g + 1) * pg]
+                        ):
+                            self._px_dirty = True
 
     def run(self, max_steps: int = 100000) -> list[tuple[Any, list]]:
         """Drive until every queued request finishes; returns

@@ -1,0 +1,465 @@
+"""Radix-shared paged KV prefix cache (ISSUE 12 tentpole).
+
+Millions of requests hammer a handful of system prompts; production
+engines never prefill the same prefix twice — vLLM's PagedAttention makes
+the KV cache a paged indirection and SGLang's RadixAttention shares page
+chains between requests through a prefix trie. The paged
+``ContinuousBatcher`` (models/decode.py) already reduced prefix reuse to a
+METADATA problem: the block table is the only thing a slot's attention
+reads, so sharing a prefix is just two slots' table rows naming the same
+physical pages. This module is that metadata layer:
+
+- **The trie**: one node per *physical page of prompt KV*, keyed by the
+  page's token tuple; a root-to-node path IS a token prefix (page
+  granularity). Node depth ``g`` is the global logical page index, which
+  pins the page to the PE owning sequence positions
+  ``[g*page, (g+1)*page)`` — the sequence-sharded pool layout means a
+  shared chain naturally spans PEs, and every PE's table row gets exactly
+  its own shard's entries.
+- **Longest-prefix match at admission** (:meth:`PagePrefixCache.acquire`):
+  the batcher walks the trie over the prompt's page tuples; every fully
+  matched page is skipped by the prompt feed (the slot starts at
+  ``pos = n_hit``), and only the divergent suffix is charged. The match is
+  capped at ``((len(prompt) - 1) // page) * page`` so at least one prompt
+  token is always fed — the step that produces the first generated token
+  (and its KV write) always lands in a PRIVATE page, never a shared one.
+- **Copy-on-write at the first divergent token**: divergence quantizes to
+  the page containing it — that page is claimed FRESH from the pool and
+  refilled from its first token by the ordinary feed; shared pages are
+  never written. (Writes to shared pages would be bit-identical anyway —
+  decode rows are batch-independent — but the no-mutation discipline is
+  what makes the strike/evict story below auditable.)
+- **Refcounts**: a reader references every node on its chain exactly once
+  (so ``parent.ref >= child.ref`` always — eviction of a ref-0 node can
+  take its whole subtree). Release (finish / cancel / poison / strike)
+  decrements the chain and returns private pages to the free pool; ref-0
+  nodes are RETAINED for future hits and reclaimed LRU-first only under
+  pool pressure, which the capacity argument below makes always
+  sufficient.
+- **Publish-on-completion**: a page enters the trie only after the
+  feeding slot has written its last position — a reader admitted earlier
+  must not attend to unwritten KV. Two slots feeding the same prefix race
+  benignly: the second publish dedups onto the first's node (its own page
+  goes back to the pool, its table row repoints — same bits either way).
+- **Poison fan-out** (:meth:`PagePrefixCache.release` with
+  ``strike=True``): when a slot is poisoned (non-finite logits, ISSUE 8)
+  its whole shared chain is struck — detached from the trie so no future
+  match can serve it — and every OTHER slot reading any struck page is
+  reported so the batcher can evict it for a cold re-prefill. A poisoned
+  shared page must strike every reader; it must never keep serving them
+  corrupt KV.
+
+Capacity argument (why admission can never die of pool exhaustion): per
+PE the pool holds ``n_slots * pages_per_shard`` pages (+1 scratch). A
+slot's logical pages on one PE number at most ``pages_per_shard``, each
+either shared or private, so live pages (private + referenced-shared)
+never exceed the pool; evicting every ref-0 retained node — the eviction
+loop's worst case — therefore always frees enough.
+
+The scratch page: released slots' table rows all point at one reserved
+page per PE, so an idle slot's dummy decode step scribbles scratch
+instead of a page the free list may have re-issued. Scratch is never
+read for correctness (``kv_lens`` masks idle slots' logits out of every
+consumer).
+
+Everything here is host-side Python over a numpy table; the device sees
+only the block-table indirection it already had. Zero new signal edges,
+zero new kernel outputs — ``scripts/protocol_lint.py`` proves the same
+327 cells before and after.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# counter keys (monotone; the serving engine folds them across batcher
+# rebuilds) vs gauges (instantaneous; snapshots read the live batcher's)
+PX_COUNTERS = (
+    "lookups", "hits", "misses", "hit_pages", "prefill_tokens_saved",
+    "cow_pages", "published_pages", "deduped_publishes", "evicted_pages",
+    "struck_pages", "readers_struck",
+)
+PX_GAUGES = ("pages_shared", "shared_refs", "free_pages")
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixCacheConfig:
+    """Arms the radix prefix cache. ``None`` wherever this is accepted
+    (``ServingConfig.prefix_cache``, ``ContinuousBatcher(prefix_cache=)``)
+    means the pre-cache engine, byte for byte (the overload/obs/integrity
+    arming discipline).
+
+    min_hit_pages: smallest fully-matched page count worth taking as a
+        hit — below it the admission runs cold (no refs taken). 1 shares
+        whatever it can; raise it when per-hit bookkeeping outweighs a
+        one-page skip.
+    """
+
+    min_hit_pages: int = 1
+
+    def validate(self) -> "PrefixCacheConfig":
+        if self.min_hit_pages < 1:
+            raise ValueError(
+                f"min_hit_pages must be >= 1, got {self.min_hit_pages}"
+            )
+        return self
+
+
+class _Node:
+    """One shared physical page of prompt KV (see module docstring)."""
+
+    __slots__ = ("tokens", "parent", "children", "phys", "depth", "ref",
+                 "last_use", "detached")
+
+    def __init__(self, tokens, parent, phys, depth):
+        self.tokens = tokens          # the page's token tuple (child key)
+        self.parent = parent
+        self.children: dict = {}
+        self.phys = int(phys)         # local page id on PE depth//pps_local
+        self.depth = int(depth)       # global logical page index
+        self.ref = 0                  # readers currently holding this page
+        self.last_use = 0
+        self.detached = False         # struck: unreachable, page freed at
+                                      # last release
+
+    def __repr__(self):  # pragma: no cover — debugging aid
+        return (f"<page d{self.depth} phys{self.phys} ref{self.ref}"
+                f"{' DETACHED' if self.detached else ''}>")
+
+
+class PagePrefixCache:
+    """Host-side radix index + page allocator over the paged pool.
+
+    Owns the mirrored block table (``self.table``, ``[n_pes, n_slots,
+    pps_local]`` int32 of PE-local physical page ids) the batcher pushes
+    to the device whenever it changes. Global logical page ``g`` lives on
+    PE ``g // pps_local`` at local index ``g % pps_local``; local
+    physical ids ``0..n_slots*pps_local-1`` are allocatable, id
+    ``n_slots*pps_local`` is the scratch page.
+    """
+
+    def __init__(self, cfg: PrefixCacheConfig, *, n_slots: int, page: int,
+                 pps_local: int, n_pes: int):
+        self.cfg = cfg.validate()
+        self.n_slots = int(n_slots)
+        self.page = int(page)
+        self.pps_local = int(pps_local)
+        self.pps_global = int(pps_local) * int(n_pes)
+        self.n_pes = int(n_pes)
+        self.n_pages = self.n_slots * self.pps_local   # allocatable, per PE
+        self.scratch = self.n_pages                    # reserved id, per PE
+        self.table = np.full(
+            (self.n_pes, self.n_slots, self.pps_local), self.scratch,
+            np.int32,
+        )
+        # LIFO free stacks (pop() hands out 0, 1, 2, ... deterministically)
+        self._free = [
+            list(range(self.n_pages - 1, -1, -1)) for _ in range(self.n_pes)
+        ]
+        self._root = _Node((), None, -1, -1)
+        self._root.ref = 1 << 30      # the root is never evictable
+        self._chain: list[list[_Node]] = [[] for _ in range(self.n_slots)]
+        self._private: list[dict[int, int]] = [
+            {} for _ in range(self.n_slots)
+        ]
+        self._next_pub = [0] * self.n_slots
+        self._zombies: set = set()    # detached nodes still referenced
+        self._clock = 0
+        self._c = {k: 0 for k in PX_COUNTERS}
+
+    # -- small helpers --------------------------------------------------
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _pe_of(self, g: int) -> int:
+        return g // self.pps_local
+
+    def _set(self, slot: int, g: int, phys: int) -> None:
+        self.table[self._pe_of(g), slot, g % self.pps_local] = phys
+
+    def chain_len(self, slot: int) -> int:
+        """Shared pages slot ``slot`` currently references (fault-injection
+        harnesses use this to target a slot with a shared chain)."""
+        return len(self._chain[slot])
+
+    def n_readers(self, slot: int) -> int:
+        """Readers (slot ``slot`` included) of the chain it holds — 0 when
+        it holds none. Fault harnesses use >= 2 to pick a poison victim
+        whose strike must fan out to other readers."""
+        chain = self._chain[slot]
+        return chain[0].ref if chain else 0
+
+    # -- allocation / eviction ------------------------------------------
+
+    def _alloc(self, pe: int) -> int:
+        if not self._free[pe]:
+            self._evict_for(pe)
+        return self._free[pe].pop()
+
+    def _free_page(self, pe: int, phys: int) -> None:
+        self._free[pe].append(int(phys))
+
+    def _attached_nodes(self):
+        stack = list(self._root.children.values())
+        while stack:
+            nd = stack.pop()
+            yield nd
+            stack.extend(nd.children.values())
+
+    def _subtree_holds_pe(self, top: _Node, pe: int) -> bool:
+        """Whether ``top``'s subtree owns a page on PE ``pe``. Depth grows
+        monotonically down the tree, so branches past the PE's depth range
+        prune."""
+        hi = (pe + 1) * self.pps_local
+        stack = [top]
+        while stack:
+            nd = stack.pop()
+            if self._pe_of(nd.depth) == pe:
+                return True
+            if nd.depth + 1 < hi:
+                stack.extend(nd.children.values())
+        return False
+
+    def _evict_for(self, pe: int) -> None:
+        """Reclaim retained (ref-0) trie pages until PE ``pe`` has a free
+        page: LRU-first over eviction roots (ref-0 nodes whose parent is
+        still referenced) whose subtree actually OWNS a page on ``pe`` —
+        starvation on one PE must not destroy retained prefixes that
+        could never relieve it. Each eviction takes the whole —
+        necessarily ref-0 — subtree; the module-docstring capacity
+        argument guarantees a qualifying root exists (some ref-0 page
+        lives on ``pe``, and its topmost ref-0 ancestor is a root whose
+        subtree contains it)."""
+        while not self._free[pe]:
+            cand = None
+            for nd in self._attached_nodes():
+                if (nd.ref == 0 and nd.parent.ref > 0
+                        and (cand is None
+                             or (nd.last_use, nd.depth)
+                             < (cand.last_use, cand.depth))
+                        and self._subtree_holds_pe(nd, pe)):
+                    cand = nd
+            if cand is None:
+                raise RuntimeError(
+                    f"prefix cache: PE {pe} free pool empty with no "
+                    f"evictable trie page — page accounting bug "
+                    f"(free={[len(f) for f in self._free]})"
+                )
+            self._evict_subtree(cand)
+
+    def _evict_subtree(self, top: _Node) -> None:
+        top.parent.children.pop(top.tokens)
+        stack = [top]
+        while stack:
+            nd = stack.pop()
+            assert nd.ref == 0, (
+                "evicting a referenced page — refcount monotonicity broken"
+            )
+            self._free_page(self._pe_of(nd.depth), nd.phys)
+            self._c["evicted_pages"] += 1
+            stack.extend(nd.children.values())
+            nd.children = {}
+
+    # -- the admission-side API -----------------------------------------
+
+    def acquire(self, slot: int, prompt, max_new_tokens: int) -> int:
+        """Longest-prefix match + page plan for one admission. Increments
+        refcounts along the matched chain, allocates private pages for
+        every logical page the request can touch past it, and writes the
+        slot's table row. Returns ``n_hit`` — the number of prompt tokens
+        whose KV is already in shared pages (the feed starts at
+        ``pos = n_hit``)."""
+        if self._chain[slot] or self._private[slot]:
+            raise RuntimeError(
+                f"slot {slot} re-acquired without release — slot lifecycle "
+                f"bug"
+            )
+        prompt = [int(t) for t in prompt]
+        pg = self.page
+        L = len(prompt)
+        self._c["lookups"] += 1
+        cap_pages = (L - 1) // pg      # keep >= 1 fed token (docstring)
+        node, chain = self._root, []
+        while len(chain) < cap_pages:
+            key = tuple(prompt[len(chain) * pg:(len(chain) + 1) * pg])
+            child = node.children.get(key)
+            if child is None:
+                break
+            chain.append(child)
+            node = child
+        if len(chain) < self.cfg.min_hit_pages:
+            chain = []
+        for nd in chain:
+            nd.ref += 1
+            nd.last_use = self._tick()
+        n_hit = len(chain) * pg
+        if chain:
+            self._c["hits"] += 1
+            self._c["hit_pages"] += len(chain)
+            self._c["prefill_tokens_saved"] += n_hit
+        else:
+            self._c["misses"] += 1
+        # every logical page the request can touch: validate_request pinned
+        # L + max_new <= s_max, so needed never exceeds pps_global
+        needed = min(-(-(L + max_new_tokens) // pg), self.pps_global)
+        priv: dict[int, int] = {}
+        for g in range(len(chain), needed):
+            priv[g] = self._alloc(self._pe_of(g))
+            if chain and g == len(chain):
+                # the CoW page proper: the one claimed fresh at the first
+                # divergent token (later privates exist for generation)
+                self._c["cow_pages"] += 1
+        for g, nd in enumerate(chain):
+            self._set(slot, g, nd.phys)
+        for g, phys in priv.items():
+            self._set(slot, g, phys)
+        self._chain[slot] = chain
+        self._private[slot] = priv
+        self._next_pub[slot] = len(chain)
+        return n_hit
+
+    def next_publish(self, slot: int) -> int:
+        return self._next_pub[slot]
+
+    def publish(self, slot: int, g: int, tokens) -> bool:
+        """Move slot ``slot``'s now-fully-written prompt page ``g`` into
+        the trie (publish-on-completion). If an identical page was
+        published meanwhile, dedup onto it (our copy returns to the pool,
+        the table row repoints — same bits). Returns True iff the device
+        table changed."""
+        chain = self._chain[slot]
+        if g != len(chain) or g not in self._private[slot]:
+            raise RuntimeError(
+                f"slot {slot} published page {g} out of order "
+                f"(chain depth {len(chain)})"
+            )
+        key = tuple(int(t) for t in tokens)
+        if len(key) != self.page:
+            raise ValueError(
+                f"published page must carry exactly {self.page} tokens, "
+                f"got {len(key)}"
+            )
+        parent = chain[-1] if chain else self._root
+        phys = self._private[slot].pop(g)
+        node = parent.children.get(key)
+        self._next_pub[slot] = g + 1
+        if node is not None:
+            # a concurrent identical producer won the race: drop our copy
+            self._free_page(self._pe_of(g), phys)
+            node.ref += 1
+            node.last_use = self._tick()
+            chain.append(node)
+            self._set(slot, g, node.phys)
+            self._c["deduped_publishes"] += 1
+            return True
+        node = _Node(key, parent, phys, g)
+        node.ref = 1                  # the publisher reads its own page
+        node.last_use = self._tick()
+        parent.children[key] = node
+        chain.append(node)
+        self._c["published_pages"] += 1
+        return False
+
+    def release(self, slot: int, strike: bool = False) -> list[int]:
+        """Release slot ``slot``'s pages (finish / cancel / poison):
+        decrement its chain refcounts, return its private pages to the
+        pool, and point its table row at scratch. ``strike=True`` (the
+        slot was poisoned) additionally detaches its ENTIRE shared chain
+        from the trie — no future match can serve a possibly-corrupt page
+        — and returns every OTHER slot referencing a struck page, for the
+        batcher to evict into a cold re-prefill."""
+        readers: list[int] = []
+        chain = self._chain[slot]
+        if strike and chain:
+            top = chain[0]
+            self._detach_subtree(top)
+            for j in range(self.n_slots):
+                if j != slot and self._chain[j] and self._chain[j][0] is top:
+                    readers.append(j)
+            self._c["readers_struck"] += len(readers)
+        for nd in chain:
+            nd.ref -= 1
+            if nd.ref == 0 and nd.detached:
+                self._free_page(self._pe_of(nd.depth), nd.phys)
+                self._zombies.discard(nd)
+        for g, phys in self._private[slot].items():
+            self._free_page(self._pe_of(g), phys)
+        self._chain[slot] = []
+        self._private[slot] = {}
+        self._next_pub[slot] = 0
+        self.table[:, slot, :] = self.scratch
+        return readers
+
+    def _detach_subtree(self, top: _Node) -> None:
+        top.parent.children.pop(top.tokens)
+        stack = [top]
+        while stack:
+            nd = stack.pop()
+            nd.detached = True
+            self._c["struck_pages"] += 1
+            stack.extend(nd.children.values())
+            nd.children = {}
+            if nd.ref == 0:
+                self._free_page(self._pe_of(nd.depth), nd.phys)
+            else:
+                self._zombies.add(nd)
+
+    # -- readout / invariants -------------------------------------------
+
+    def stats(self) -> dict:
+        n_attached, refs = 0, 0
+        for nd in self._attached_nodes():
+            n_attached += 1
+            refs += nd.ref
+        out = dict(self._c)
+        out["hit_rate"] = round(
+            self._c["hits"] / max(1, self._c["lookups"]), 6
+        )
+        out["pages_shared"] = n_attached
+        out["shared_refs"] = refs
+        out["free_pages"] = sum(len(f) for f in self._free)
+        return out
+
+    def audit(self) -> None:
+        """Assert the page-accounting invariant (tests): per PE, free ∪
+        attached-trie ∪ zombie ∪ private pages partition the allocatable
+        pool — every page owned exactly once, no leaks, no double-owns."""
+        owned: list[dict[int, str]] = [dict() for _ in range(self.n_pes)]
+
+        def own(pe, phys, what):
+            assert 0 <= phys < self.n_pages, (pe, phys, what)
+            assert phys not in owned[pe], (
+                f"page {phys} on PE {pe} owned twice: "
+                f"{owned[pe][phys]} and {what}"
+            )
+            owned[pe][phys] = what
+
+        for pe in range(self.n_pes):
+            for phys in self._free[pe]:
+                own(pe, phys, "free")
+        for nd in self._attached_nodes():
+            own(self._pe_of(nd.depth), nd.phys, f"trie:{nd!r}")
+        for nd in self._zombies:
+            own(self._pe_of(nd.depth), nd.phys, f"zombie:{nd!r}")
+        for slot in range(self.n_slots):
+            for g, phys in self._private[slot].items():
+                own(self._pe_of(g), phys, f"private:slot{slot}:g{g}")
+        for pe in range(self.n_pes):
+            assert len(owned[pe]) == self.n_pages, (
+                f"PE {pe}: {self.n_pages - len(owned[pe])} page(s) leaked"
+            )
+        # chain refcounts: every page refcounted exactly once per reader
+        want: dict[int, int] = {}
+        for slot in range(self.n_slots):
+            for nd in self._chain[slot]:
+                want[id(nd)] = want.get(id(nd), 0) + 1
+        for nd in self._attached_nodes():
+            assert nd.ref == want.get(id(nd), 0), (
+                f"{nd!r}: ref {nd.ref} != {want.get(id(nd), 0)} readers"
+            )
+        for nd in self._zombies:
+            assert nd.ref == want.get(id(nd), 0) and nd.ref > 0, nd

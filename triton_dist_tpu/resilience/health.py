@@ -54,6 +54,10 @@ SHED = "shed"                       # serving: one request load-shed with
                                     # expiry, overflow victim, or
                                     # shed_all_batch) — never a silent
                                     # drop
+PREFIX_STRIKE = "prefix_strike"     # serving: a poisoned SHARED prefix
+                                    # page struck this reader — evicted
+                                    # for a cold re-prefill so corrupt KV
+                                    # is never served (prefix_cache.py)
 
 # short-circuit pin kinds (why a family is pinned to its golden path)
 PIN_ENV = "env"               # process-global environment failure
@@ -182,6 +186,18 @@ def record_poisoned_request(family: str, uid: Any, reason: str) -> None:
     (serving/engine.py per-request quarantine)."""
     _record(HealthEvent(
         kind=POISONED, family=family,
+        reason=f"request {uid!r}: {reason}", walltime=time.time(),
+    ))
+
+
+def record_prefix_strike(family: str, uid: Any, reason: str) -> None:
+    """A poisoned shared prefix page struck reader ``uid`` — it was
+    evicted and resubmitted for a cold re-prefill (ISSUE 12 fan-out).
+    Informational for :func:`is_healthy` purposes: the POISONED event
+    that caused the strike already flipped it (the SERVING_REBUILD
+    rationale)."""
+    _record(HealthEvent(
+        kind=PREFIX_STRIKE, family=family,
         reason=f"request {uid!r}: {reason}", walltime=time.time(),
     ))
 

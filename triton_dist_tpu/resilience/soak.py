@@ -20,7 +20,13 @@ seeded serve run that composes:
   work while the queue is still slammed;
 - **payload corruption** — fabricated ``IntegrityError`` canary records
   naming a corrupt PE directly (the victim-==-culprit convention of
-  resilience/faults.py), driving the integrity rebuild arc.
+  resilience/faults.py), driving the integrity rebuild arc;
+- **a poisoned shared prefix page** (ISSUE 12, ``SoakSpec.shared_prefix``
+  campaigns): burst traffic over Zipf shared prefixes with the radix
+  prefix cache armed, plus scheduled non-finite-logit poisons landing on
+  a slot with a SHARED chain — driving the strike fan-out (every reader
+  of the struck chain evicted and cold-re-prefilled) composed with the
+  rebuild arcs above, which drop the whole trie mid-flight.
 
 Faults are injected at the documented host-level chaos seam (the
 ``ContinuousBatcher.step`` wrap of tests/test_serving.py): only the
@@ -83,12 +89,36 @@ class SoakSpec:
     max_queue: int = 6
     virtual_step_s: float = 0.05
     world: int = 4
+    s_max: int = 16
+    batch: int = 2     # built-in model's slot count (serving concurrency)
     n_timeouts: int = 2
     n_corruptions: int = 1
     straggler_pe: int = 1
     corrupt_pe: int = 2
     fault_window: int = 40      # fault steps drawn from [2, 2+window)
     max_steps: int = 50_000
+    # shared-prefix campaign knobs (ISSUE 12): prefix_pool > 0 arms the
+    # radix prefix cache (page_size required) and prepends Zipf-drawn
+    # system prompts; n_poisons scheduled non-finite-logit poisons prefer
+    # a slot holding a SHARED chain, so the strike fan-out path runs
+    prefix_pool: int = 0
+    prefix_tokens: int = 8
+    prefix_share: float = 1.0
+    page_size: int = 0
+    n_poisons: int = 0
+
+    @classmethod
+    def shared_prefix(cls, seed: int = 0, **over) -> "SoakSpec":
+        """The ISSUE 12 soak shape: burst traffic over shared prefixes ×
+        a straggler × payload corruption × a poisoned shared page."""
+        kw = dict(
+            seed=seed, prefix_pool=2, prefix_tokens=8, page_size=4,
+            s_max=32, batch=4, max_queue=10, rate_rps=12.0, burst_n=6,
+            n_poisons=1, n_timeouts=1, n_corruptions=1,
+            n_requests=18, fault_window=30,
+        )
+        kw.update(over)
+        return cls(**kw)
 
     def validate(self) -> "SoakSpec":
         if self.n_requests < 1 or self.world < 2:
@@ -97,8 +127,19 @@ class SoakSpec:
             raise ValueError("straggler_pe out of range")
         if not 0 <= self.corrupt_pe < self.world:
             raise ValueError("corrupt_pe out of range")
-        if self.fault_window < self.n_timeouts + self.n_corruptions:
+        if self.fault_window < (
+            self.n_timeouts + self.n_corruptions + self.n_poisons
+        ):
             raise ValueError("fault_window too small for the fault count")
+        if self.prefix_pool and not self.page_size:
+            raise ValueError(
+                "shared-prefix campaigns need page_size (the prefix cache "
+                "rides the paged pool)"
+            )
+        if self.n_poisons and not self.prefix_pool:
+            raise ValueError(
+                "n_poisons targets shared chains — set prefix_pool too"
+            )
         return self
 
 
@@ -138,11 +179,12 @@ def _integrity_records(corrupt_pe: int) -> list[dict]:
 
 
 def fault_schedule(spec: SoakSpec) -> dict[int, tuple[str, int]]:
-    """step-call-number -> ("timeout" | "integrity", pe), seed-derived.
-    Distinct steps, so two faults never race one step (the matrix covers
-    single-step behavior; the soak covers the composition over time)."""
+    """step-call-number -> ("timeout" | "integrity" | "poison", pe),
+    seed-derived. Distinct steps, so two faults never race one step (the
+    matrix covers single-step behavior; the soak covers the composition
+    over time)."""
     rng = np.random.default_rng([int(spec.seed), 0x50AC])
-    n = spec.n_timeouts + spec.n_corruptions
+    n = spec.n_timeouts + spec.n_corruptions + spec.n_poisons
     steps = sorted(
         int(s) for s in rng.choice(
             np.arange(2, 2 + spec.fault_window), size=n, replace=False
@@ -151,6 +193,7 @@ def fault_schedule(spec: SoakSpec) -> dict[int, tuple[str, int]]:
     kinds = (
         [("timeout", spec.straggler_pe)] * spec.n_timeouts
         + [("integrity", spec.corrupt_pe)] * spec.n_corruptions
+        + [("poison", -1)] * spec.n_poisons   # pe unused: targets a slot
     )
     rng.shuffle(kinds)  # interleave the fault classes over the campaign
     return {s: tuple(k) for s, k in zip(steps, kinds)}
@@ -168,6 +211,9 @@ def _inject_faults(schedule: dict, world: int):
 
     real_step = ContinuousBatcher.step
     calls = {"n": 0}
+    # armed-but-unfired poisons: a LIST, so n_poisons >= 2 scheduled at
+    # close steps never overwrite each other (each fires in turn)
+    pending: dict = {"poison": []}
 
     def flaky(self):
         calls["n"] += 1
@@ -179,12 +225,50 @@ def _inject_faults(schedule: dict, world: int):
                     "batcher_step", _timeout_records(world, pe),
                     world_size=world,
                 )
-            raise IntegrityError(
-                "batcher_step", DET_CANARY,
-                "soak-injected payload corruption",
-                records=_integrity_records(pe), world_size=world,
-            )
-        return real_step(self)
+            if kind == "integrity":
+                raise IntegrityError(
+                    "batcher_step", DET_CANARY,
+                    "soak-injected payload corruption",
+                    records=_integrity_records(pe), world_size=world,
+                )
+            # kind == "poison" (ISSUE 12): arm a pending poison — fired
+            # below, preferring a slot whose shared chain has ANOTHER
+            # reader so the strike fan-out path actually runs
+            pending["poison"].append(calls["n"])
+        out = real_step(self)
+        if pending["poison"]:
+            px = self.prefix_cache
+            deferred = calls["n"] - pending["poison"][0]
+            target = None
+            if px is not None:
+                # first choice: a chain some OTHER slot is also reading —
+                # poisoning it must strike every reader; defer (bounded)
+                # until such a moment exists, then fall back to any
+                # chained, then any occupied slot. All seed-deterministic.
+                target = next(
+                    (j for j, r in enumerate(self.slot_req)
+                     if r is not None and px.chain_len(j) > 0
+                     and px.n_readers(j) >= 2),
+                    None,
+                )
+                if target is None and deferred >= 150:
+                    target = next(
+                        (j for j, r in enumerate(self.slot_req)
+                         if r is not None and px.chain_len(j) > 0),
+                        None,
+                    )
+            if target is None and deferred >= 300:
+                target = next(
+                    (j for j, r in enumerate(self.slot_req)
+                     if r is not None),
+                    None,
+                )
+            if target is not None:
+                pending["poison"].pop(0)
+                self._poison_slot(
+                    target, "soak-injected poisoned shared page"
+                )
+        return out
 
     ContinuousBatcher.step = flaky
     try:
@@ -271,6 +355,15 @@ def check_invariants(eng, result: CampaignResult, offered_uids: set) -> list:
             f"controller sheds_by_class {ov.get('sheds_by_class')} does not "
             f"sum to the shed counter {reqs.get('shed', 0)}"
         )
+    # scheduled strike coverage actually ran: a shared-prefix campaign
+    # whose deferred poison never found a target must FAIL, not silently
+    # skip the fan-out path it exists to exercise
+    if result.spec.n_poisons and reqs.get("poisoned", 0) < result.spec.n_poisons:
+        fails.append(
+            f"scheduled {result.spec.n_poisons} poison(s) but only "
+            f"{reqs.get('poisoned', 0)} fired — the strike coverage this "
+            f"campaign advertises did not run (retune the spec)"
+        )
     hc = result.health.get("counters", {})
     if hc.get("serving_engine:serving_rebuild", 0) != result.rebuilds:
         fails.append(
@@ -336,7 +429,7 @@ def run_campaign(spec: SoakSpec, *, model=None) -> CampaignResult:
             # interesting serviceable-mesh case, mid-overload
             cfg = TransformerConfig(
                 vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=4,
-                n_kv_heads=4, head_dim=8, batch=2, seq=8,
+                n_kv_heads=4, head_dim=8, batch=spec.batch, seq=8,
                 ag_config=AGGemmConfig(8, 16, 16),
                 rs_config=GemmRSConfig(8, 16, 16),
             )
@@ -346,6 +439,13 @@ def run_campaign(spec: SoakSpec, *, model=None) -> CampaignResult:
         else:
             cfg, params = model
         mesh = Mesh(np.array(jax.devices()[:spec.world]), ("tp",))
+        px_traffic = {}
+        if spec.prefix_pool:
+            px_traffic = dict(
+                prefix_pool=spec.prefix_pool,
+                prefix_len=("fixed", spec.prefix_tokens),
+                prefix_share=spec.prefix_share,
+            )
         traffic = TrafficSpec(
             rate_rps=spec.rate_rps, n_requests=spec.n_requests,
             process="burst", burst_every_s=spec.burst_every_s,
@@ -353,13 +453,19 @@ def run_campaign(spec: SoakSpec, *, model=None) -> CampaignResult:
             prompt_len=("uniform", 2, 4), output_len=("uniform", 2, 5),
             vocab=cfg.vocab, seed=spec.seed, uid_prefix=f"c{spec.seed}-",
             priority_mix=spec.priority_mix, deadline_ms=spec.deadline_ms,
+            **px_traffic,
         )
         trace = generate_trace(traffic)
         schedule = fault_schedule(spec)
+        batcher_kw = {}
+        if spec.page_size:
+            batcher_kw["page_size"] = spec.page_size
         clock = _retry.FakeClock()
         with _retry.clock_scope(clock):
+            from triton_dist_tpu.models.prefix_cache import PrefixCacheConfig
+
             eng = ServingEngine(
-                cfg, params, mesh, s_max=16, clock=clock,
+                cfg, params, mesh, s_max=spec.s_max, clock=clock,
                 serving=ServingConfig(
                     max_queue=spec.max_queue,
                     virtual_step_s=spec.virtual_step_s,
@@ -373,7 +479,11 @@ def run_campaign(spec: SoakSpec, *, model=None) -> CampaignResult:
                         # rebuilds is exactly what the soak is for)
                         downshift=lambda c: c,
                     ),
+                    prefix_cache=(
+                        PrefixCacheConfig() if spec.prefix_pool else None
+                    ),
                 ),
+                **batcher_kw,
             )
             error = None
             with _inject_faults(schedule, spec.world) as calls:

@@ -21,8 +21,15 @@ Five parts (docs/serving.md "Serving engine" is the full contract):
   design (the disaggregated-pool topology runs one per pool).
 - :mod:`traffic` — seeded, replayable synthetic workloads (Poisson /
   deterministic / flash-crowd burst arrivals, length mixtures incl.
-  preset-derived ones, per-arrival priority/deadline); same seed ⇒
-  byte-identical trace.
+  preset-derived ones, per-arrival priority/deadline, Zipf shared-prefix
+  mixes); same seed ⇒ byte-identical trace.
+
+Plus the radix-shared paged KV prefix cache (ISSUE 12;
+``models/prefix_cache.py``, docs/serving.md "Prefix cache"), armed via
+``ServingConfig(prefix_cache=PrefixCacheConfig(...))``: admission-time
+longest-prefix match over a trie of refcounted page chains skips the
+prefill feed for every fully shared page; None = the pre-cache engine,
+byte for byte.
 - :mod:`metrics` — streaming log-binned histograms (TTFT,
   per-output-token, e2e), load gauges, SLO attainment, goodput
   (SLO-attaining throughput) and per-class counters, and a
@@ -36,6 +43,7 @@ clock by default), so whole serve runs — latency percentiles included —
 are deterministic under a :class:`~triton_dist_tpu.resilience.FakeClock`.
 """
 
+from triton_dist_tpu.models.prefix_cache import PrefixCacheConfig
 from triton_dist_tpu.serving.engine import (
     Finished,
     Poisoned,
@@ -61,6 +69,7 @@ from triton_dist_tpu.serving.traffic import (
     TrafficSpec,
     generate_trace,
     preset_mix,
+    shared_prefix_mix,
     trace_fingerprint,
 )
 
@@ -72,6 +81,7 @@ __all__ = [
     "OverloadController",
     "PRIORITIES",
     "Poisoned",
+    "PrefixCacheConfig",
     "Rejected",
     "ServingConfig",
     "ServingEngine",
@@ -83,5 +93,6 @@ __all__ = [
     "generate_trace",
     "preset_mix",
     "priority_rank",
+    "shared_prefix_mix",
     "trace_fingerprint",
 ]

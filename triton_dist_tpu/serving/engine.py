@@ -15,6 +15,10 @@ percentiles included, deterministic)::
        └ Shed    ◄─── overload controller (ISSUE 11, when armed):
                       deadline expiry / overflow victim / shed_all_batch
                       — serving/overload.py, docs/serving.md "Overload"
+       └ (prefix-struck, ISSUE 12: a poisoned SHARED prefix page evicts
+          every reader of the chain — restarted COLD from the original
+          prompt, counted `prefix_struck`, TTFT re-measured as resumed;
+          never a terminal state — docs/serving.md "Prefix cache")
 
 Elastic wiring (engine + ``resilience/elastic.py``): a
 ``DistTimeoutError`` escaping the jitted step has already been through
@@ -44,6 +48,11 @@ from typing import Any
 
 from triton_dist_tpu import obs as _obs
 from triton_dist_tpu.models.decode import ContinuousBatcher, Request
+from triton_dist_tpu.models.prefix_cache import (
+    PX_COUNTERS,
+    PX_GAUGES,
+    PrefixCacheConfig,
+)
 from triton_dist_tpu.resilience import elastic, health
 from triton_dist_tpu.resilience import retry as _retry
 from triton_dist_tpu.serving import overload as _overload
@@ -87,6 +96,17 @@ class ServingConfig:
                       None (the default) = the pre-overload engine,
                       byte for byte. Requires ``backpressure="reject"``
                       (shed decisions and block-by-serving conflict).
+    prefix_cache:     a :class:`~triton_dist_tpu.models.prefix_cache.
+                      PrefixCacheConfig` arms the radix-shared paged KV
+                      prefix cache (ISSUE 12): admission-time
+                      longest-prefix match skips the feed for every
+                      fully shared page, copy-on-write claims fresh
+                      pages at the divergence, refcounted release rides
+                      the slot lifecycle, and a poisoned shared page
+                      strikes (cold-re-prefills) every reader. Needs the
+                      paged batcher (``page_size=`` in the batcher
+                      kwargs). None (the default) = the pre-cache
+                      engine, byte for byte.
     """
 
     max_queue: int = 256
@@ -98,10 +118,13 @@ class ServingConfig:
     slo: SLOTargets | None = None
     world_ok: Any = None
     overload: OverloadConfig | None = None
+    prefix_cache: PrefixCacheConfig | None = None
 
     def validate(self) -> "ServingConfig":
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.prefix_cache is not None:
+            self.prefix_cache.validate()
         if self.overload is not None:
             self.overload.validate()
             if self.backpressure != "reject":
@@ -273,6 +296,9 @@ class ServingEngine:
         self._stopping = False
         self._base_cfg = cfg           # restored when brownout2 descends
         self._downshifted = False
+        # prefix-cache counters accumulated across batcher rebuilds (each
+        # rebuild starts a FRESH trie — the pool is the batcher's)
+        self._px_totals: dict[str, int] = {}
         # per-step deltas feeding the controller's pressure window
         self._step_arrived = 0
         self._step_finished = 0
@@ -335,8 +361,11 @@ class ServingEngine:
         )
 
     def _build(self, mesh) -> ContinuousBatcher:
+        kw = dict(self.batcher_kw)
+        if self.serving.prefix_cache is not None:
+            kw["prefix_cache"] = self.serving.prefix_cache
         return ContinuousBatcher(
-            self.cfg, self.params, mesh, s_max=self.s_max, **self.batcher_kw
+            self.cfg, self.params, mesh, s_max=self.s_max, **kw
         )
 
     # -- submission / admission ----------------------------------------
@@ -627,8 +656,32 @@ class ServingEngine:
                 self._record_first(st, now)
         for uid, toks, reason in b.drain_poisoned():
             self._finalize_poisoned(uid, toks, reason, now)
+        for uid, reason in b.drain_struck():
+            self._restart_struck(uid, reason, now)
         for uid, toks in b.drain_finished():
             self._finalize(uid, toks, now)
+
+    def _restart_struck(self, uid: Any, reason: str, now: float) -> None:
+        """Prefix-strike fan-out (ISSUE 12): this in-flight request was
+        reading a shared page of a POISONED slot's chain, so everything it
+        generated is suspect — restart it COLD: the original request
+        re-enters the batcher (fresh seed-derived RNG, tokens discarded),
+        re-prefills into fresh private pages (the struck chain is gone
+        from the trie), and regenerates the same stream a never-corrupted
+        run produces. TTFT after the strike re-measures as a resumed
+        event, like every other disruption."""
+        st = self._states[uid]
+        st.tokens = []
+        st.resumed += 1
+        st.awaiting_first = True
+        if not st.first_recorded:
+            st.t_first = None
+        self.metrics.count("prefix_struck")
+        _obs.record_span(
+            "serving:px_strike", now, now, cat="serving",
+            track=f"{self._obs_tag}req:{uid}", uid=str(uid), reason=reason,
+        )
+        self._batcher.submit(st.req)
 
     def _record_first(self, st: _ReqState, now: float) -> None:
         st.awaiting_first = False
@@ -797,6 +850,10 @@ class ServingEngine:
             self._finalize_poisoned(uid, toks, poison_reason, now)
         for uid, toks in old.drain_finished():
             self._finalize(uid, toks, now)
+        # struck readers restart into the NEW batcher below; px counters
+        # accumulate at the engine so a rebuild never zeroes the hit-rate
+        struck = old.drain_struck()
+        self._fold_px(old.prefix_cache_stats())
         active, queued = old.export_in_flight()
         target = self._target_mesh()
         self.rebuilds += 1
@@ -827,6 +884,8 @@ class ServingEngine:
             # admitted but never started (possibly already a replay):
             # resubmit verbatim
             self._batcher.submit(req)
+        for uid, strike_reason in struck:
+            self._restart_struck(uid, strike_reason, now)
         # the rebuild/replay arc as one engine-track span (ISSUE 9) —
         # engine-clock timestamps, so FakeClock runs export identically
         _obs.record_span(
@@ -952,6 +1011,27 @@ class ServingEngine:
 
     # -- readout --------------------------------------------------------
 
+    def _fold_px(self, stats: dict | None) -> None:
+        if not stats:
+            return
+        for k in PX_COUNTERS:
+            self._px_totals[k] = self._px_totals.get(k, 0) + stats.get(k, 0)
+
+    def _px_snapshot(self) -> dict | None:
+        """Prefix-cache counters summed across every batcher this engine
+        has run (rebuilds start fresh tries), gauges from the live one."""
+        cur = self._batcher.prefix_cache_stats()
+        if cur is None and not self._px_totals:
+            return None
+        out = {
+            k: (cur or {}).get(k, 0) + self._px_totals.get(k, 0)
+            for k in PX_COUNTERS
+        }
+        for k in PX_GAUGES:
+            out[k] = (cur or {}).get(k, 0)
+        out["hit_rate"] = round(out["hits"] / max(1, out["lookups"]), 6)
+        return out
+
     def snapshot(self) -> dict:
         """The engine's health.snapshot() analogue: serving metrics plus
         world/queue/compile-cache facts. Deterministic under a FakeClock
@@ -979,6 +1059,11 @@ class ServingEngine:
         }
         if self._overload is not None:
             snap["overload"] = self._overload.snapshot()
+        px = self._px_snapshot()
+        if px is not None:
+            # the ISSUE 12 surface: hit-rate, pages-shared gauge, and
+            # prefill-tokens-saved counters the bench A/B reads
+            snap["prefix_cache"] = px
         if _obs.span_enabled():
             # per-phase p50/p99 from the span tracer (ISSUE 9 satellite):
             # the λ-sweep rows carry a step-time BREAKDOWN (queued /

@@ -119,7 +119,19 @@ class TrafficSpec:
     SEPARATE seed-derived PRNG — a spec that leaves them unset generates
     the byte-identical trace (same times, prompts, fingerprint) it did
     before they existed, and setting them changes neither arrival times
-    nor prompts (pinned in tests/test_overload.py)."""
+    nor prompts (pinned in tests/test_overload.py).
+
+    ``prefix_pool`` (ISSUE 12) is the shared-prefix workload: N
+    seed-derived "system prompts" (lengths from ``prefix_len``) are drawn
+    once, and each request independently — with probability
+    ``prefix_share`` — prepends one of them, Zipf-weighted by
+    ``prefix_zipf`` (rank k gets weight ∝ 1/k^zipf: a handful of hot
+    prompts dominate, the production shape the prefix cache exists for).
+    All prefix draws come from their OWN seed-derived PRNG stream (the
+    priority/deadline discipline of ISSUE 11): an unchanged spec keeps
+    its historical ``trace_fingerprint``, and setting the prefix fields
+    changes neither arrival times nor the per-request SUFFIX (the old
+    prompt becomes the suffix) — pinned in tests/test_prefix_cache.py."""
 
     rate_rps: float
     n_requests: int
@@ -138,6 +150,10 @@ class TrafficSpec:
     burst_rate_rps: float | None = None
     priority_mix: tuple | None = None
     deadline_ms: tuple | None = None
+    prefix_pool: int | None = None
+    prefix_len: tuple = ("fixed", 8)
+    prefix_zipf: float = 1.2
+    prefix_share: float = 1.0
 
     def validate(self) -> "TrafficSpec":
         if self.rate_rps <= 0:
@@ -177,6 +193,20 @@ class TrafficSpec:
                 priority_rank(cls)  # loud on unknown classes
         if self.deadline_ms is not None:
             _validate_dist("deadline_ms", self.deadline_ms)
+        if self.prefix_pool is not None:
+            if self.prefix_pool < 1:
+                raise ValueError(
+                    f"prefix_pool must be >= 1, got {self.prefix_pool}"
+                )
+            _validate_dist("prefix_len", self.prefix_len)
+            if not 0.0 < self.prefix_share <= 1.0:
+                raise ValueError(
+                    f"prefix_share must be in (0, 1], got {self.prefix_share}"
+                )
+            if self.prefix_zipf <= 0:
+                raise ValueError(
+                    f"prefix_zipf must be > 0, got {self.prefix_zipf}"
+                )
         return self
 
 
@@ -194,6 +224,22 @@ def generate_trace(spec: TrafficSpec) -> tuple[Arrival, ...]:
     if spec.priority_mix is not None:
         w = np.array([float(a[0]) for a in spec.priority_mix], np.float64)
         prio_arms = ([a[1] for a in spec.priority_mix], w / w.sum())
+    # shared-prefix draws (ISSUE 12) on a THIRD stream: the system-prompt
+    # pool plus each request's (share?, which-prefix) pair — unset specs
+    # never touch it, so their historical fingerprints hold
+    rng_px = np.random.default_rng([int(spec.seed), 0x90EF1C])
+    prefixes = zipf_w = None
+    if spec.prefix_pool is not None:
+        prefixes = [
+            [int(x) for x in rng_px.integers(
+                0, spec.vocab, sample_length(spec.prefix_len, rng_px)
+            )]
+            for _ in range(spec.prefix_pool)
+        ]
+        zipf_w = 1.0 / np.arange(
+            1, spec.prefix_pool + 1, dtype=np.float64
+        ) ** float(spec.prefix_zipf)
+        zipf_w /= zipf_w.sum()
     out = []
     t = float(spec.start_s)
     burst_rate = spec.burst_rate_rps or 10.0 * spec.rate_rps
@@ -218,6 +264,13 @@ def generate_trace(spec: TrafficSpec) -> tuple[Arrival, ...]:
         p_len = sample_length(spec.prompt_len, rng)
         o_len = sample_length(spec.output_len, rng)
         prompt = [int(x) for x in rng.integers(0, spec.vocab, p_len)]
+        if prefixes is not None:
+            # fixed two-draw cadence per request keeps the stream aligned
+            # whatever the outcomes
+            share = float(rng_px.random()) < spec.prefix_share
+            which = int(rng_px.choice(spec.prefix_pool, p=zipf_w))
+            if share:
+                prompt = prefixes[which] + prompt
         priority = "interactive"
         if prio_arms is not None:
             priority = prio_arms[0][int(rng_ov.choice(
@@ -262,6 +315,55 @@ def trace_fingerprint(trace: tuple[Arrival, ...]) -> str:
             a.request.seed, a.request.uid, *extra,
         )).encode())
     return h.hexdigest()
+
+
+def shared_prefix_mix(
+    *,
+    s_max: int,
+    rate_rps: float,
+    n_requests: int,
+    n_prefixes: int = 4,
+    prefix_tokens: int = 12,
+    share: float = 1.0,
+    zipf: float = 1.2,
+    suffix_len: tuple = ("uniform", 2, 6),
+    output_len: tuple = ("uniform", 2, 8),
+    vocab: int = 256,
+    seed: int = 0,
+    **overrides: Any,
+) -> TrafficSpec:
+    """The shared-prefix serving workload (ISSUE 12 satellite): Zipf over
+    ``n_prefixes`` seed-derived system prompts of ``prefix_tokens``
+    tokens, each prepended — with probability ``share`` — to a
+    per-request suffix drawn from ``suffix_len``. Sized so the worst-case
+    ``prefix + suffix + output`` always fits ``s_max`` (admissible by
+    construction, the ``preset_mix`` discipline). This is the traffic
+    that makes the prefix cache's λ-sweep win measurable: at high share
+    ratios the feed cost of almost every admission collapses to the
+    divergent suffix."""
+    spec = TrafficSpec(
+        rate_rps=rate_rps,
+        n_requests=n_requests,
+        prompt_len=suffix_len,
+        output_len=output_len,
+        vocab=vocab,
+        seed=seed,
+        prefix_pool=n_prefixes,
+        prefix_len=("fixed", prefix_tokens),
+        prefix_zipf=zipf,
+        prefix_share=share,
+        **overrides,
+    ).validate()
+    worst = (prefix_tokens + max_length(spec.prompt_len)
+             + max_length(spec.output_len))
+    if worst > s_max:
+        raise ValueError(
+            f"shared_prefix_mix: worst-case prefix({prefix_tokens}) + "
+            f"suffix({max_length(spec.prompt_len)}) + "
+            f"output({max_length(spec.output_len)}) = {worst} exceeds "
+            f"s_max={s_max}"
+        )
+    return spec
 
 
 def preset_mix(
