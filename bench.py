@@ -45,6 +45,7 @@ judge) to use.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 
@@ -968,6 +969,14 @@ def _run_serving(argv) -> None:
         # before any guard ran. Force CPU BEFORE the first jax call; a
         # chip session opts in explicitly with TDT_BENCH_SERVING_TPU=1.
         jax.config.update("jax_platforms", "cpu")
+        # the disagg A/B (ISSUE 13) needs a 4-device host mesh (2 prefill
+        # + 2 decode vs unified-on-4); this runs before the backend
+        # initializes, and the existing world-1 rows are numerically
+        # unaffected by the virtual device count
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=4"
+        )
     mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
     # a deliberately tiny single-block model: the virtual clock prices the
     # steps, so the model only needs to exercise the real batcher/engine
@@ -1056,6 +1065,43 @@ def _run_serving(argv) -> None:
                 traffic_kw=px_traffic, tag=stag.strip("_") + ":",
             )
             for name, value, unit in sbench.info_lines(px_rows, tag=stag):
+                emit_info(name, value, unit)
+    # disaggregated-vs-unified A/B (ISSUE 13, ROADMAP #2): the SAME
+    # seeded traffic and SLO over the same 4 host devices — unified
+    # engine on all 4 vs the two-pool topology (2 prefill + 2 decode,
+    # KV handoff on the int8 wire between them). At high offered load
+    # the unified arm's slots are held for prefill+decode; the disagg
+    # arm's dedicated prefill slots keep first tokens flowing, so p99
+    # TTFT stays bounded while goodput holds. FakeClock + fixed seed ⇒
+    # byte-identical reruns; info lines only, never perf-gated.
+    if len(jax.devices()) >= 4:
+        from triton_dist_tpu.serving import (
+            DisaggServingConfig, HandoffConfig,
+        )
+
+        # n_kv_heads/batch sized for a world-4 unified arm (the disagg
+        # pools run at world 2 each — same model, same divisibility)
+        dg_cfg = dataclasses.replace(cfg, n_kv_heads=4, batch=4)
+        dg_params = init_params(jax.random.PRNGKey(0), dg_cfg)
+        mesh4 = Mesh(np.array(jax.devices()[:4]), ("tp",))
+        dg_traffic = dict(process="burst", burst_n=8)
+        for tag, disagg in (
+            ("_dg_uni", None),
+            ("_dg_split", DisaggServingConfig(
+                prefill_pes=2,
+                handoff=HandoffConfig(page_tokens=4, chunks_per_page=2,
+                                      virtual_chunk_s=0.001),
+            )),
+        ):
+            dg_rows = sbench.sweep_offered_load(
+                dg_cfg, dg_params, mesh4, s_max=32, rates=rates,
+                n_requests=48, prompt_len=("uniform", 2, 6),
+                output_len=("uniform", 4, 8), seed=0, virtual_step_s=0.05,
+                slo=SLOTargets(ttft_ms=800.0, e2e_ms=4000.0),
+                disagg=disagg, traffic_kw=dg_traffic,
+                tag=tag.strip("_") + ":",
+            )
+            for name, value, unit in sbench.info_lines(dg_rows, tag=tag):
                 emit_info(name, value, unit)
     if obs_path is not None:
         obs.export_chrome_trace(obs_path, label="bench_serving")

@@ -66,6 +66,18 @@
 # accounting, bit-identical seeded replay). The full 20-campaign soak is
 # scripts/chaos_soak.py / `pytest -m soak` (soak implies slow).
 #
+# Since ISSUE 13 the matrix also covers the DISAGGREGATED-SERVING cells
+# (tests/test_disagg.py): a corrupted/dropped KV chunk mid-handoff must
+# walk the guard ladder (bounded re-send → whole-sequence re-stream →
+# decode-local cold re-prefill) with the culprit PE struck and the
+# request finishing byte-identically to unified cold prefill; a
+# prefill-pool straggler shrinks the POOL mid-stream; a prefill-pool
+# timeout storm collapses the topology to the unified engine with zero
+# lost requests; and the quick disagg soak campaign replays
+# bit-identically (resilience/soak.py SoakSpec.disagg; the full set
+# rides scripts/chaos_soak.py). The static lint also proves the new
+# kv_stream kernel family (ops/kv_stream.py) at worlds {2, 4, 8}.
+#
 # Since ISSUE 12 the matrix also covers the PREFIX-CACHE cells
 # (tests/test_prefix_cache.py): a poisoned SHARED prefix page must
 # strike every reader of the chain (evicted for a cold re-prefill,
@@ -98,14 +110,14 @@ files="tests/test_chaos.py tests/test_elastic.py \
     tests/test_chunked.py tests/test_chunked_a2a.py tests/test_ragged.py \
     tests/test_emitter.py tests/test_serving.py tests/test_integrity.py \
     tests/test_obs.py tests/test_analysis.py tests/test_overload.py \
-    tests/test_prefix_cache.py"
+    tests/test_prefix_cache.py tests/test_disagg.py"
 marker="chaos"
 lint_args=""
 if [ "${1:-}" = "--quick" ]; then
     shift
     files="tests/test_integrity.py tests/test_serving.py \
         tests/test_elastic.py tests/test_overload.py \
-        tests/test_prefix_cache.py"
+        tests/test_prefix_cache.py tests/test_disagg.py"
     marker="chaos and not slow"
     # keep the quick posture bounded: worlds {2,4} (the full {2,4,8}
     # sweep is the default standalone run's job)

@@ -24,15 +24,25 @@ must evict every reader of the struck chain for a cold re-prefill
 (attributed recovery, no lost request) and the whole campaign must
 replay bit-identically from its seed.
 
+Since ISSUE 13 the run also includes DISAGGREGATED campaigns
+(``SoakSpec.disagg``): burst traffic through the two-pool
+prefill/decode topology with the fault-tolerant KV handoff between
+them — corrupt KV chunks injected mid-handoff (the ``FaultPlan
+pool="decode"`` seam) walk the guard ladder (re-send → re-stream →
+decode-local cold re-prefill, culprit PEs struck), a prefill-pool
+straggler shrinks the POOL mid-stream, and every third seed schedules a
+prefill-pool timeout storm that collapses the topology to the unified
+engine — with zero lost requests and a bit-identical seeded replay.
+
 Usage::
 
     scripts/chaos_soak.py [--campaigns N] [--seed-base S] [--quick]
-                          [--no-replay-check] [--no-prefix]
+                          [--no-replay-check] [--no-prefix] [--no-disagg]
 
-``--quick`` runs 3 small + 1 shared-prefix campaign (the chaos-matrix
-cell posture); the default 20 + 6 shared-prefix campaigns are the
-ISSUE 11/12 acceptance run. Exit code 0 iff every campaign is green
-(and the replay checks hold).
+``--quick`` runs 3 small + 1 shared-prefix + 1 disagg campaign (the
+chaos-matrix cell posture); the default 20 + 6 shared-prefix + 5
+disagg campaigns are the ISSUE 11/12/13 acceptance run. Exit code 0
+iff every campaign is green (and the replay checks hold).
 """
 
 import argparse
@@ -61,6 +71,8 @@ def main(argv=None) -> int:
     ap.add_argument("--no-replay-check", action="store_true")
     ap.add_argument("--no-prefix", action="store_true",
                     help="skip the shared-prefix campaign set (ISSUE 12)")
+    ap.add_argument("--no-disagg", action="store_true",
+                    help="skip the disaggregated campaign set (ISSUE 13)")
     args = ap.parse_args(argv)
 
     from triton_dist_tpu import config as tdt_config
@@ -73,17 +85,22 @@ def main(argv=None) -> int:
     small = dict(n_requests=12, n_timeouts=1, n_corruptions=1,
                  fault_window=20) if args.quick else {}
     n_px = 0 if args.no_prefix else (1 if args.quick else 6)
+    n_dg = 0 if args.no_disagg else (1 if args.quick else 5)
 
     def build_spec(k: int):
         if k < n:
             return soak.SoakSpec(seed=args.seed_base + k, **small), "std"
-        return soak.SoakSpec.shared_prefix(
-            seed=args.seed_base + 100 + (k - n)
-        ), "px"
+        if k < n + n_px:
+            return soak.SoakSpec.shared_prefix(
+                seed=args.seed_base + 100 + (k - n)
+            ), "px"
+        return soak.SoakSpec.disagg(
+            seed=args.seed_base + 200 + (k - n - n_px)
+        ), "disagg"
 
     rows = []
     t0 = time.time()
-    for k in range(n + n_px):
+    for k in range(n + n_px + n_dg):
         spec, kind_tag = build_spec(k)
         t1 = time.time()
         res = soak.run_campaign(spec)
@@ -101,6 +118,14 @@ def main(argv=None) -> int:
                 f" [prefix: hit_rate={px.get('hit_rate', 0)} "
                 f"struck_readers={reqs.get('prefix_struck', 0)}]"
             )
+        elif kind_tag == "disagg":
+            ho = res.snapshot.get("handoff", {})
+            px_note = (
+                f" [handoff: retries={ho.get('chunk_retries', 0)} "
+                f"restreams={ho.get('restreams', 0)} "
+                f"fallbacks={ho.get('fallbacks', 0)} "
+                f"collapsed={res.snapshot.get('engine', {}).get('collapsed')}]"
+            )
         print(
             f"  campaign {kind_tag} seed={spec.seed:<4d} {verdict}  "
             f"{dt:6.1f}s  terminals={dict(sorted(census.items()))} "
@@ -116,9 +141,11 @@ def main(argv=None) -> int:
 
     replay_ok = True
     if not args.no_replay_check and rows:
-        # one replay per campaign KIND: the standard arc and (when run)
-        # the shared-prefix arc must both reproduce bit-identically
-        replay_at = [0] + ([n] if n_px else [])
+        # one replay per campaign KIND: the standard, shared-prefix, and
+        # disagg arcs must each reproduce bit-identically
+        replay_at = [0] + ([n] if n_px else []) + (
+            [n + n_px] if n_dg else []
+        )
         for idx in replay_at:
             spec, kind_tag = build_spec(idx)
             first = rows[idx][2]

@@ -273,6 +273,94 @@ def test_landing_view_coverage_closed_and_enforced():
 
 
 # ---------------------------------------------------------------------------
+# kv_stream: the disaggregated KV handoff family (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_kv_stream_every_tuple_proves(world):
+    """The whole KV_STREAM_TUNE_SPACE (wire × chunks) proves clean at
+    each even world: credit balance, deadlock freedom, dense wait-site
+    numbering — zero warnings (well inside the telemetry window)."""
+    for label, spec in S.family_tuples("kv_stream", world):
+        rep = verify_capture(_cap("kv_stream", world, label, spec))
+        assert rep.ok, rep.summary()
+        assert not rep.warnings, rep.summary()
+        assert rep.stats["max_sites"] <= sites.TELEM_SLOTS
+
+
+def test_kv_stream_chunk_major_and_landing_views():
+    """Structure of the int8-wire capture: per-chunk signal-bearing puts
+    in chunk-major order on BOTH the payload and the scales stream, and
+    EVERY chunk-signal put declares its landing view (the canary opt-in
+    a new chunked family cannot land without)."""
+    cap = _cap("kv_stream", 4, "int8/c4")
+    events = cap.traces[0].launches[0].events
+    chunk_puts = [e for e in events
+                  if e.op == C.PUT and e.meta.get("chunk_signal")]
+    # c4 × (payload + scales) = 8 chunk puts, all canary-covered
+    assert len(chunk_puts) == 8
+    assert all(e.meta.get("landing_view") for e in chunk_puts)
+    # chunk-major within each stream: slot indices ascend
+    for stream in (chunk_puts[:4], chunk_puts[4:]):
+        idx = [e.slot[1][-1] for e in stream]
+        assert idx == sorted(idx), idx
+    # and the mirror pairing: every put targets rank (me + n/2) mod n
+    for t in cap.traces:
+        peers = {e.dst for l in t.launches for e in l.events
+                 if e.op == C.PUT}
+        assert peers == {(t.rank + 2) % 4}, (t.rank, peers)
+
+
+def test_kv_stream_capture_byte_identical():
+    a = _cap("kv_stream", 4, "int8/c2").canonical()
+    b = _cap("kv_stream", 4, "int8/c2").canonical()
+    assert a == b
+
+
+@pytest.mark.chaos
+def test_kv_stream_seeded_defect_twin():
+    """The seeded-defect twin (ISSUE 13 satellite): a dropped chunk
+    signal on the kv_stream wire must be flagged as a deadlock naming
+    the afflicted slot/site, while the clean twin stays silent."""
+    cap = _cap("kv_stream", 4, "native/c2")
+    clean = verify_capture(cap)
+    assert clean.ok and not clean.warnings, clean.summary()
+    seeded = D.seed_defect(cap, "dropped_signal")
+    rep = verify_capture(seeded.capture)
+    hits = [f for f in rep.errors if f.check == "deadlock"]
+    assert hits, rep.summary()
+    assert seeded.expect_naming in hits[0].message, rep.summary()
+    assert "site" in hits[0].message
+    # every other applicable mutation flags too (swap_chunk_order is
+    # a2a-form-only by design), each naming its slot
+    for kind in ("dropped_wait", "extra_signal", "missing_drain"):
+        seeded_k = D.seed_defect(_cap("kv_stream", 4, "native/c2"), kind)
+        rep_k = verify_capture(seeded_k.capture)
+        hits_k = [f for f in rep_k.errors
+                  if f.check == seeded_k.expect_check]
+        assert hits_k, (kind, rep_k.summary())
+        assert any(seeded_k.expect_naming in f.message for f in hits_k), (
+            kind, rep_k.summary()
+        )
+
+
+def test_kv_stream_rejects_odd_world_and_wire_mismatch():
+    import jax.numpy as jnp  # noqa: F811 — local, matches module import
+
+    import triton_dist_tpu.ops.kv_stream as K
+
+    with pytest.raises(ValueError, match="even world"):
+        with mock.patch.object(K, "_axis_size", lambda axis: 3):
+            K._kv_stream_fused(jnp.ones((8, 4)), axis="tp")
+    with pytest.raises(ValueError, match="scales"):
+        with mock.patch.object(K, "_axis_size", lambda axis: 4):
+            K._kv_stream_fused(
+                jnp.ones((8, 4), jnp.int8), axis="tp",
+                config=K.KVStreamConfig(wire="int8"),
+            )
+
+
+# ---------------------------------------------------------------------------
 # Cross-check: verifier site inventory == obs telemetry decode (satellite)
 # ---------------------------------------------------------------------------
 

@@ -25,6 +25,11 @@ Family → tuple spaces:
                         the ragged_dot sentinel has no fused form)
 - ``moe_reduce_rs``   — the fused MoE-Reduce-RS overlap pipeline over
                         ``MOE_RS_TUNE_SPACE`` ∪ ``TP_MOE_TUNE_SPACE``
+- ``kv_stream``       — the disaggregated-serving KV handoff family
+                        (ISSUE 13): ``KV_STREAM_TUNE_SPACE`` — wire
+                        {native, int8-with-scales} × chunks {1, 2, 4}
+                        mirror-pool exchange, every chunk a signal slot
+                        with a declared landing view
 
 Shapes are the smallest that still exercise every protocol arm (enough
 rows for the largest chunk count, every expert populated, ≥2 blocks per
@@ -313,6 +318,44 @@ def _moe_rs_build(world, cfg):
     return make_fn
 
 
+# --- kv_stream (ISSUE 13: the disaggregated KV handoff wire) ----------------
+
+def _kv_tuples(world):
+    from triton_dist_tpu.ops.kv_stream import KV_STREAM_TUNE_SPACE
+
+    return [
+        (f"{c.wire}/c{c.chunks_per_shard}", c) for c in KV_STREAM_TUNE_SPACE
+    ]
+
+
+def _kv_build(world, cfg):
+    import jax.numpy as jnp
+
+    import importlib
+
+    ks = importlib.import_module("triton_dist_tpu.ops.kv_stream")
+
+    # 16 rows: the largest chunk count in the space gets real multi-row
+    # spans; 8 columns stand in for page_size * head_dim
+    if cfg.wire == "int8":
+        payload = jnp.ones((16, 8), jnp.int8)
+        scales = jnp.ones((16, 1), jnp.float32)
+
+        def make_fn(rank):
+            return lambda: ks._kv_stream_fused(
+                payload, scales, axis="tp", config=cfg
+            )
+    else:
+        payload = jnp.ones((16, 8), jnp.float32)
+
+        def make_fn(rank):
+            return lambda: ks._kv_stream_fused(
+                payload, axis="tp", config=cfg
+            )
+
+    return make_fn
+
+
 _COMM_MODULES = (
     "triton_dist_tpu.ops.allgather",
     "triton_dist_tpu.ops.reduce_scatter",
@@ -322,6 +365,7 @@ _COMM_MODULES = (
     "triton_dist_tpu.ops.allgather_group_gemm",
     "triton_dist_tpu.ops.moe_reduce_rs",
     "triton_dist_tpu.ops.group_gemm",
+    "triton_dist_tpu.ops.kv_stream",
     "triton_dist_tpu.ops.common",
 )
 
@@ -344,6 +388,9 @@ FAMILIES: dict[str, FamilySpec] = {
     ),
     "moe_reduce_rs": FamilySpec(
         "moe_reduce_rs", _COMM_MODULES, _moe_rs_build, _moe_rs_tuples
+    ),
+    "kv_stream": FamilySpec(
+        "kv_stream", _COMM_MODULES, _kv_build, _kv_tuples
     ),
 }
 

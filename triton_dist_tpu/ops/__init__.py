@@ -71,6 +71,16 @@ from triton_dist_tpu.ops.flash_decode import (
     quantize_kv,
     quantize_kv_pages,
 )
+# NOTE: the in-shard_map `kv_stream` entry stays module-qualified
+# (ops.kv_stream.kv_stream) — re-exporting it here would shadow the
+# submodule attribute on the package
+from triton_dist_tpu.ops.kv_stream import (
+    KVStreamConfig,
+    KV_STREAM_TUNE_SPACE,
+    dequantize_kv_wire,
+    kv_stream_op,
+    quantize_kv_wire,
+)
 from triton_dist_tpu.ops.grads import ring_attention_grad
 from triton_dist_tpu.ops.ring_attention import (
     RingAttentionConfig,

@@ -1,0 +1,369 @@
+"""KV handoff stream: the chunked-put transfer family of the
+disaggregated prefill/decode topology (ISSUE 13 tentpole, ROADMAP #2).
+
+A disaggregated serving fleet splits prefill and decode onto separate
+accelerator pools; the moment a prompt's paged KV is complete, its pages
+must cross the pool boundary. This module is that wire: one mesh axis
+spans BOTH pools (prefill PEs first, decode PEs second — the
+``serving/disagg.py`` topology), and every PE exchanges its page slab
+with its MIRROR PE in the other pool, ``peer = (me + n/2) mod n`` — an
+involution for every even world, so the SPMD program is symmetric: the
+prefill→decode direction carries freshly prefilled KV pages, the mirror
+direction carries the decode pool's return slab (evicted / migrated
+pages — page migration is symmetric by design).
+
+Robustness is the contract (the reference's EP a2a wire pattern —
+low-precision payload + signal slots — with the ISSUE 8 integrity layer
+on every edge):
+
+- the slab moves **chunk by chunk** through
+  ``shmem.putmem_signal_chunked_nbi_block``: per-chunk DMA + per-chunk
+  pure signal slots, so a consumer admits on *last-page-landed* instead
+  of whole-transfer completion, every chunk wait is watchdog-bounded
+  (chunk-granular timeout diagnostics + per-site wait telemetry,
+  ISSUE 9), and a dropped chunk signal is individually injectable and
+  individually attributed;
+- every chunk declares its ``recv_view=`` **landing view** (mirror
+  symmetry makes it the same offsets of the local out slab), so the
+  payload **canary** rides each chunk signal: a corrupted landing fails
+  its checksum at the receiving PE (victim == culprit, the ISSUE 8
+  landing-site model) — corrupt KV is never silently decoded;
+- the **int8 wire** (``KVStreamConfig(wire="int8")``) streams the page
+  payload at int8 with per-row f32 scales riding their own chunked put
+  (same spans, own signal slots, own landing views) — half the
+  cross-pool bytes, exactly the a2a's low-precision wire shape
+  (``layers/ep_a2a_layer.py``);
+- the whole family is **proved by the static verifier** like every
+  other: ``analysis/sweep.py`` sweeps :data:`KV_STREAM_TUNE_SPACE` at
+  worlds {2, 4, 8} — credit balance, deadlock freedom, dense wait-site
+  numbering, landing-view coverage (``scripts/protocol_lint.py``).
+
+The host-tier serving plane (``serving/handoff.py``) models this wire's
+protocol — chunk canaries, bounded waits, retry ladder — at the
+documented host chaos seam (the PR 11 soak discipline); this kernel is
+the device tier the ladder degrades FROM, and the verifier proves it on
+any jax line, devices or not.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_dist_tpu import resilience
+from triton_dist_tpu.ops.common import (
+    chunk_schedule,
+    dist_pallas_call,
+    jit_shard_map,
+)
+from triton_dist_tpu.shmem import device as shmem
+from triton_dist_tpu.utils import axis_size as _axis_size
+
+WIRES = ("native", "int8")
+
+
+@dataclasses.dataclass(frozen=True)
+class KVStreamConfig:
+    """One tune-space tuple of the KV handoff stream.
+
+    chunks_per_shard: per-transfer chunk count — the landing granularity
+        (a decode-side consumer can admit on the last CHUNK, and each
+        chunk is its own watchdog-bounded, chaos-injectable signal edge).
+    wire: "native" moves the payload as-is; "int8" expects a
+        pre-quantized int8 payload plus per-row f32 scales
+        (:func:`quantize_kv_wire`) and streams the scales on their own
+        chunked put — half the cross-pool bytes on the weight/KV-bound
+        decode side, the reference's low-precision a2a wire shape.
+    """
+
+    chunks_per_shard: int = 1
+    wire: str = "native"
+
+    def validate(self) -> "KVStreamConfig":
+        if self.chunks_per_shard < 1:
+            raise ValueError(
+                f"chunks_per_shard must be >= 1, got {self.chunks_per_shard}"
+            )
+        if self.wire not in WIRES:
+            raise ValueError(
+                f"wire must be one of {WIRES}, got {self.wire!r}"
+            )
+        return self
+
+
+# The tune space the static verifier sweeps (analysis/sweep.py) and the
+# serving plane selects from: every wire × chunking combination.
+KV_STREAM_TUNE_SPACE = tuple(
+    KVStreamConfig(chunks_per_shard=c, wire=w)
+    for w in WIRES
+    for c in (1, 2, 4)
+)
+
+
+def quantize_kv_wire(pages: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-row symmetric int8 quantization of a ``[m, w]`` page slab for
+    the int8 wire: returns ``(payload int8 [m, w], scales f32 [m, 1])``.
+    A KV row (one position × head-feature columns) shares one scale, the
+    int8-KV decode family's convention."""
+    x = pages.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_kv_wire(payload: jax.Array, scales: jax.Array,
+                       dtype=jnp.float32) -> jax.Array:
+    """Inverse of :func:`quantize_kv_wire` (consumer side of the wire)."""
+    return (payload.astype(jnp.float32) * scales.astype(jnp.float32)).astype(
+        dtype
+    )
+
+
+def mirror_peer(me, n: int):
+    """The mirror PE in the other pool: ``(me + n/2) mod n`` — an
+    involution for every even ``n``, so the pairwise exchange is SPMD
+    symmetric (prefill PE i ↔ decode PE i + n/2)."""
+    return jax.lax.rem(me + n // 2, n)
+
+
+def _kv_stream_kernel(
+    x_ref, out_ref, send_sems, recv_sems, sig_sems, *, axis: str, n: int,
+    spans,
+):
+    """Native-wire mirror exchange: this PE's slab streams chunk by chunk
+    to its mirror peer; the mirror's equal-shaped slab lands at the SAME
+    offsets of ``out_ref`` (the landing view — by pair symmetry only the
+    mirror ever writes here)."""
+    me = shmem.my_pe(axis)
+    peer = mirror_peer(me, n)
+    # race shaking (no-op unless config.debug_comm_delay) + the liveness
+    # barrier: every PE's out buffer must exist before landings start
+    shmem.comm_jitter(axis, salt=9)
+    shmem.barrier_all(axis)
+    h = shmem.putmem_signal_chunked_nbi_block(
+        lambda off, rows: out_ref.at[pl.ds(off, rows)],
+        lambda off, rows: x_ref.at[pl.ds(off, rows)],
+        peer, axis,
+        lambda j: send_sems.at[j],
+        lambda j: recv_sems.at[j],
+        lambda j: sig_sems.at[j],
+        spans,
+        # mirror symmetry: the incoming chunk lands at the same offsets
+        # we sent from — the payload-integrity opt-in (ISSUE 8)
+        recv_view=lambda off, rows: out_ref.at[pl.ds(off, rows)],
+    )
+    # last-page-landed: chunk-granular arrival waits, in chunk order (a
+    # serving consumer would hand each landed chunk to admission here)
+    h.wait_recv()
+    shmem.quiet(h)
+
+
+def _kv_stream_w8_kernel(
+    x_ref, s_ref, out_ref, s_out_ref,
+    send_d, recv_d, sig_d, send_s, recv_s, sig_s,
+    *, axis: str, n: int, spans, s_spans,
+):
+    """int8-wire mirror exchange: the quantized payload and its per-row
+    scales ride two chunked puts over the SAME row spans — each with its
+    own signal slots and landing views, so the canary covers both (a
+    corrupt scale row is as fatal as a corrupt payload chunk)."""
+    me = shmem.my_pe(axis)
+    peer = mirror_peer(me, n)
+    shmem.comm_jitter(axis, salt=10)
+    shmem.barrier_all(axis)
+    hd = shmem.putmem_signal_chunked_nbi_block(
+        lambda off, rows: out_ref.at[pl.ds(off, rows)],
+        lambda off, rows: x_ref.at[pl.ds(off, rows)],
+        peer, axis,
+        lambda j: send_d.at[j], lambda j: recv_d.at[j],
+        lambda j: sig_d.at[j],
+        spans,
+        recv_view=lambda off, rows: out_ref.at[pl.ds(off, rows)],
+    )
+    hs = shmem.putmem_signal_chunked_nbi_block(
+        lambda off, rows: s_out_ref.at[pl.ds(off, rows)],
+        lambda off, rows: s_ref.at[pl.ds(off, rows)],
+        peer, axis,
+        lambda j: send_s.at[j], lambda j: recv_s.at[j],
+        lambda j: sig_s.at[j],
+        s_spans,
+        recv_view=lambda off, rows: s_out_ref.at[pl.ds(off, rows)],
+    )
+    # consume per chunk: a landed payload chunk is decodable only once
+    # its scale rows landed too, so wait them pairwise in chunk order
+    for j in range(len(spans)):
+        hd.wait_recv_chunk(j)
+        hs.wait_recv_chunk(j)
+    shmem.quiet(hd, hs)
+
+
+def _kv_stream_xla(payload, scales=None, *, axis="tp", **_):
+    """The golden slow path: the same mirror exchange through XLA's
+    ppermute (single- or both-operand)."""
+    n = _axis_size((axis))
+    if n == 1:
+        return payload if scales is None else (payload, scales)
+    perm = [(i, (i + n // 2) % n) for i in range(n)]
+    out = jax.lax.ppermute(payload, axis, perm)
+    if scales is None:
+        return out
+    return out, jax.lax.ppermute(scales, axis, perm)
+
+
+def _kv_stream_fused(
+    payload: jax.Array,
+    scales: jax.Array | None = None,
+    *,
+    axis: str = "tp",
+    config: KVStreamConfig | None = None,
+    interpret: Any = None,
+):
+    """Fused mirror page-slab exchange (call inside ``jax.shard_map``).
+
+    ``payload``: this PE's ``[m, w]`` page slab (int8 when
+    ``config.wire == "int8"``, any dtype otherwise); ``scales``:
+    ``[m, 1]`` f32 per-row scales, required iff the wire is int8.
+    Returns the mirror peer's landed slab (and scales, int8 wire).
+    World must be even — the two-pool mirror pairing has no odd form —
+    and world 1 is the identity (nothing to hand off)."""
+    cfg = (config or KVStreamConfig()).validate()
+    n = _axis_size((axis))
+    if (cfg.wire == "int8") != (scales is not None):
+        raise ValueError(
+            "KVStreamConfig.wire='int8' requires per-row scales (from "
+            "quantize_kv_wire); the native wire takes none"
+        )
+    if n == 1:
+        return payload if scales is None else (payload, scales)
+    if n % 2:
+        raise ValueError(
+            f"kv_stream needs an even world (mirror pool pairing); got "
+            f"axis {axis!r} size {n}"
+        )
+    m = payload.shape[0]
+    spans = chunk_schedule(m, cfg.chunks_per_shard)
+    chunks = len(spans)
+    if cfg.wire == "int8":
+        if scales.shape[0] != m:
+            raise ValueError(
+                f"scales rows {scales.shape[0]} != payload rows {m}"
+            )
+        s_spans = spans  # same row spans: chunk j's scales ride chunk j
+        out, s_out = dist_pallas_call(
+            functools.partial(
+                _kv_stream_w8_kernel, axis=axis, n=n, spans=spans,
+                s_spans=s_spans,
+            ),
+            name="kv_stream_w8",
+            out_shape=(
+                jax.ShapeDtypeStruct(payload.shape, payload.dtype),
+                jax.ShapeDtypeStruct(scales.shape, scales.dtype),
+            ),
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=(
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ),
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((chunks,)),
+                pltpu.SemaphoreType.DMA((chunks,)),
+                pltpu.SemaphoreType.REGULAR((chunks,)),
+                pltpu.SemaphoreType.DMA((chunks,)),
+                pltpu.SemaphoreType.DMA((chunks,)),
+                pltpu.SemaphoreType.REGULAR((chunks,)),
+            ],
+            interpret=interpret,
+        )(payload, scales)
+        return out, s_out
+    return dist_pallas_call(
+        functools.partial(_kv_stream_kernel, axis=axis, n=n, spans=spans),
+        name="kv_stream",
+        out_shape=jax.ShapeDtypeStruct(payload.shape, payload.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((chunks,)),
+            pltpu.SemaphoreType.DMA((chunks,)),
+            pltpu.SemaphoreType.REGULAR((chunks,)),
+        ],
+        interpret=interpret,
+    )(payload)
+
+
+def kv_stream(
+    payload: jax.Array,
+    scales: jax.Array | None = None,
+    *,
+    axis: str = "tp",
+    config: KVStreamConfig | None = None,
+    interpret: Any = None,
+):
+    """Guarded in-shard_map entry: the fused mirror exchange with the
+    XLA ppermute golden served automatically when the fused kernel cannot
+    run in this environment (resilience layer, docs/resilience.md)."""
+    return resilience.guarded_call(
+        "kv_stream",
+        _kv_stream_fused,
+        _kv_stream_xla,
+        payload, scales, axis=axis, config=config, interpret=interpret,
+    )
+
+
+def _kv_stream_op_xla(
+    payload: jax.Array, mesh: Mesh, *, axis: str = "tp",
+    config: KVStreamConfig | None = None, **_
+):
+    cfg = (config or KVStreamConfig()).validate()
+    if cfg.wire == "int8":
+        def fn(x):
+            q, s = quantize_kv_wire(x)
+            q, s = _kv_stream_xla(q, s, axis=axis)
+            return dequantize_kv_wire(q, s, x.dtype)
+    else:
+        fn = functools.partial(_kv_stream_xla, axis=axis)
+    return jit_shard_map(
+        fn, mesh, P(axis, None), P(axis, None),
+        key=("kv_stream_xla", axis, cfg),
+    )(payload)
+
+
+@resilience.guard_op("kv_stream_op", _kv_stream_op_xla)
+def kv_stream_op(
+    payload: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "tp",
+    config: KVStreamConfig | None = None,
+    interpret: Any = None,
+):
+    """Host-level entry: ``payload`` is a global ``[n*m, w]`` array
+    sharded on dim 0 (each PE's rows are its local page slab); returns
+    the globally mirror-exchanged array with the same sharding. On the
+    int8 wire the slab is quantized per row before the exchange and
+    dequantized after landing — the wire cost is the quantization error,
+    the win is half the cross-pool bytes."""
+    cfg = (config or KVStreamConfig()).validate()
+
+    def fn(x):
+        if cfg.wire == "int8":
+            q, s = quantize_kv_wire(x)
+            q, s = kv_stream(q, s, axis=axis, config=cfg,
+                             interpret=interpret)
+            return dequantize_kv_wire(q, s, x.dtype)
+        return kv_stream(x, axis=axis, config=cfg, interpret=interpret)
+
+    return jit_shard_map(
+        fn, mesh, P(axis, None), P(axis, None),
+        key=("kv_stream", axis, cfg, str(interpret)),
+    )(payload)

@@ -51,6 +51,7 @@ ICI handles below the programming model.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 
@@ -76,6 +77,14 @@ class FaultPlan:
             the kernel body issues.
     family: restrict to one ``dist_pallas_call(name=...)`` family
             (``None`` = all families).
+    pool:   restrict to one serving POOL (ISSUE 13): a disaggregated
+            topology steps each pool (and the handoff plane between
+            them) inside a named :func:`pool_scope` — ``pool="prefill"``
+            / ``"decode"`` targets exactly that side of the KV handoff,
+            so two-pool chaos compositions can corrupt a chunk the
+            prefill pool sent without also afflicting decode-local
+            work. ``None`` (the default) injects regardless of pool,
+            so every existing single-pool plan is byte-unchanged.
     delay_iters: busy-loop iterations for delay_signal / straggler.
     max_triggers: how many WATCHDOG-ARMED OP-ENTRY LAUNCHES the fault
             afflicts before it "heals" (``None`` = persistent for the
@@ -94,6 +103,7 @@ class FaultPlan:
     family: str | None = None
     delay_iters: int = 20_000
     max_triggers: int | None = None
+    pool: str | None = None
 
     def validate(self) -> "FaultPlan":
         if self.kind not in KINDS:
@@ -112,6 +122,13 @@ class FaultPlan:
             raise ValueError(
                 f"FaultPlan.max_triggers must be >= 1 (or None), got "
                 f"{self.max_triggers}"
+            )
+        if self.pool is not None and (
+            not isinstance(self.pool, str) or not self.pool
+        ):
+            raise ValueError(
+                f"FaultPlan.pool must be a non-empty pool name (or None), "
+                f"got {self.pool!r}"
             )
         if self.max_triggers is not None and self.family is not None:
             # note_launch() counts every watchdog-armed op-entry launch,
@@ -138,6 +155,37 @@ class FaultPlan:
             "straggler", pe=pe, family=family, delay_iters=delay_iters,
             max_triggers=None,
         )
+
+
+# ---------------------------------------------------------------------------
+# Pool scoping (ISSUE 13): a disaggregated topology names which pool's
+# work is executing via pool_scope("prefill"/"decode"/...); a plan with
+# pool= set only injects inside the matching scope. Thread-local, like
+# the watchdog's diag scope — two pools stepped from different threads
+# cannot leak each other's scope.
+# ---------------------------------------------------------------------------
+
+_pool_state = threading.local()
+
+
+def current_pool() -> str | None:
+    """The pool name of the innermost active :func:`pool_scope` (None
+    outside any scope — the single-pool world every pre-disagg plan
+    targets)."""
+    return getattr(_pool_state, "name", None)
+
+
+@contextlib.contextmanager
+def pool_scope(name: str):
+    """Mark the dynamic extent of one pool's work (the disaggregated
+    engine wraps each pool's batcher steps and the handoff plane's
+    transfers). Nests: the innermost scope wins."""
+    prev = current_pool()
+    _pool_state.name = str(name)
+    try:
+        yield
+    finally:
+        _pool_state.name = prev
 
 
 # ---------------------------------------------------------------------------
@@ -216,6 +264,11 @@ def active_plan(family: str | None = None) -> FaultPlan | None:
         )
         return None
     if plan.family is not None and family is not None and plan.family != family:
+        return None
+    if plan.pool is not None and plan.pool != current_pool():
+        # pool-scoped plans (ISSUE 13) fire only inside the matching
+        # pool_scope; outside any scope they never fire (a single-pool
+        # caller cannot be "the prefill side" of anything)
         return None
     return plan
 

@@ -58,6 +58,24 @@ PREFIX_STRIKE = "prefix_strike"     # serving: a poisoned SHARED prefix
                                     # page struck this reader — evicted
                                     # for a cold re-prefill so corrupt KV
                                     # is never served (prefix_cache.py)
+# the disaggregated KV handoff guard ladder (ISSUE 13, serving/handoff.py)
+# — each rung attributed like the integrity ladder it mirrors:
+HANDOFF_RETRY = "handoff_retry"     # one chunk re-sent in place after a
+                                    # canary mismatch / bounded-wait
+                                    # timeout (the absorbed-transient
+                                    # rung — does not flip is_healthy,
+                                    # the RETRY convention)
+HANDOFF_RESTREAM = "handoff_restream"  # chunk retries exhausted: the
+                                       # whole sequence re-streamed from
+                                       # the prefill pool
+HANDOFF_FALLBACK = "handoff_fallback"  # re-streams exhausted: the decode
+                                       # pool cold-re-prefills locally —
+                                       # the request is never lost,
+                                       # corrupt KV is never decoded
+POOL_COLLAPSE = "pool_collapse"     # a pool lost its last serviceable PE:
+                                    # the topology collapsed to the
+                                    # unified engine, in-flight work
+                                    # replayed (serving/disagg.py)
 
 # short-circuit pin kinds (why a family is pinned to its golden path)
 PIN_ENV = "env"               # process-global environment failure
@@ -226,6 +244,48 @@ def record_shed(family: str, uid: Any, priority: str, reason: str) -> None:
     ))
 
 
+def record_handoff_retry(family: str, uid: Any, chunk: int, pe: int,
+                         reason: str) -> None:
+    """One KV-handoff chunk re-sent in place (the first ladder rung,
+    serving/handoff.py): ``pe`` is the attributed culprit — the decode
+    PE whose landing failed its canary (victim == culprit), or the
+    prefill sender whose chunk signal never arrived (by absence)."""
+    _record(HealthEvent(
+        kind=HANDOFF_RETRY, family=family,
+        reason=f"request {uid!r} chunk {chunk} (pe{int(pe)}): {reason}",
+        walltime=time.time(),
+    ))
+
+
+def record_handoff_restream(family: str, uid: Any, pe: int,
+                            reason: str) -> None:
+    """Chunk retries exhausted: the whole sequence re-streams from the
+    prefill pool (rung 2 of the handoff ladder)."""
+    _record(HealthEvent(
+        kind=HANDOFF_RESTREAM, family=family,
+        reason=f"request {uid!r} (pe{int(pe)}): {reason}",
+        walltime=time.time(),
+    ))
+
+
+def record_handoff_fallback(family: str, uid: Any, reason: str) -> None:
+    """Re-streams exhausted: the decode pool cold-re-prefills locally
+    (the terminal rung — the request is never lost)."""
+    _record(HealthEvent(
+        kind=HANDOFF_FALLBACK, family=family,
+        reason=f"request {uid!r}: {reason}", walltime=time.time(),
+    ))
+
+
+def record_pool_collapse(family: str, pool: str, reason: str) -> None:
+    """A serving pool lost its last serviceable PE and the disaggregated
+    topology collapsed to the unified engine (serving/disagg.py)."""
+    _record(HealthEvent(
+        kind=POOL_COLLAPSE, family=family,
+        reason=f"pool {pool!r}: {reason}", walltime=time.time(),
+    ))
+
+
 def record_pe_quarantine(pe: int, reason: str) -> None:
     """The elastic layer quarantined peer ``pe`` (elastic.py)."""
     _record(HealthEvent(
@@ -309,7 +369,8 @@ def is_healthy() -> bool:
     with _lock:
         return not any(
             k in (DOWNGRADE, TIMEOUT, PE_QUARANTINE, INTEGRITY, SKIP_STEP,
-                  POISONED, BROWNOUT, SHED)
+                  POISONED, BROWNOUT, SHED, HANDOFF_RESTREAM,
+                  HANDOFF_FALLBACK, POOL_COLLAPSE)
             for (_, k), n in _counters.items() if n > 0
         )
 

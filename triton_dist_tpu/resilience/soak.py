@@ -26,7 +26,16 @@ seeded serve run that composes:
   prefix cache armed, plus scheduled non-finite-logit poisons landing on
   a slot with a SHARED chain — driving the strike fan-out (every reader
   of the struck chain evicted and cold-re-prefilled) composed with the
-  rebuild arcs above, which drop the whole trie mid-flight.
+  rebuild arcs above, which drop the whole trie mid-flight;
+- **the disaggregated two-pool topology** (ISSUE 13, ``SoakSpec.disagg``
+  campaigns): burst traffic through a prefill pool + decode pool with a
+  fault-tolerant KV handoff between them, composing corrupt-KV-chunk
+  injection mid-handoff (the ``FaultPlan pool="decode"`` payload seam —
+  the guard ladder's re-send → re-stream → decode-local-fallback rungs
+  all engage, culprits struck), a prefill-pool straggler (pool-scoped
+  by-absence attribution → quarantine → the POOL shrinks mid-stream),
+  and — when scheduled — a prefill-pool timeout storm that collapses the
+  topology to the unified engine with every in-flight request replayed.
 
 Faults are injected at the documented host-level chaos seam (the
 ``ContinuousBatcher.step`` wrap of tests/test_serving.py): only the
@@ -106,6 +115,32 @@ class SoakSpec:
     prefix_share: float = 1.0
     page_size: int = 0
     n_poisons: int = 0
+    # disaggregated campaign knobs (ISSUE 13): disagg_prefill_pes > 0
+    # runs the two-pool topology; n_chunk_corruptions budgets the
+    # corrupt-KV-chunk FaultPlan fired mid-handoff (pool="decode");
+    # collapse_at_step > 0 schedules a persistent prefill-pool timeout
+    # storm from that (pool) step on, driving quarantine → shrink →
+    # topology collapse to unified
+    disagg_prefill_pes: int = 0
+    n_chunk_corruptions: int = 0
+    collapse_at_step: int = 0
+    handoff_chunks: int = 2
+
+    @classmethod
+    def disagg(cls, seed: int = 0, **over) -> "SoakSpec":
+        """The ISSUE 13 soak shape: burst traffic with priorities and
+        deadlines through the two-pool topology × corrupt KV chunks
+        mid-handoff × a prefill-pool straggler (shrink mid-stream) × —
+        every third seed — a scheduled pool collapse."""
+        kw = dict(
+            seed=seed, world=4, disagg_prefill_pes=2,
+            n_requests=18, rate_rps=16.0, burst_n=6, max_queue=10,
+            n_timeouts=2, n_corruptions=0, n_chunk_corruptions=3,
+            fault_window=30,
+            collapse_at_step=0 if seed % 3 else 24,
+        )
+        kw.update(over)
+        return cls(**kw)
 
     @classmethod
     def shared_prefix(cls, seed: int = 0, **over) -> "SoakSpec":
@@ -139,6 +174,30 @@ class SoakSpec:
         if self.n_poisons and not self.prefix_pool:
             raise ValueError(
                 "n_poisons targets shared chains — set prefix_pool too"
+            )
+        if self.disagg_prefill_pes:
+            if not 1 <= self.disagg_prefill_pes < self.world:
+                raise ValueError(
+                    f"disagg_prefill_pes={self.disagg_prefill_pes} must "
+                    f"leave a decode pool inside world={self.world}"
+                )
+            if self.prefix_pool:
+                raise ValueError(
+                    "disagg and shared-prefix campaign shapes are "
+                    "separate sets (compose later)"
+                )
+            if self.n_corruptions or self.n_poisons:
+                raise ValueError(
+                    "disagg campaigns model corruption at the HANDOFF "
+                    "seam (n_chunk_corruptions); n_corruptions/n_poisons "
+                    "are the unified-engine seams"
+                )
+        if (self.n_chunk_corruptions or self.collapse_at_step) and (
+            not self.disagg_prefill_pes
+        ):
+            raise ValueError(
+                "chunk corruption / pool collapse are handoff faults — "
+                "set disagg_prefill_pes too"
             )
         return self
 
@@ -383,12 +442,286 @@ def check_invariants(eng, result: CampaignResult, offered_uids: set) -> list:
     return fails
 
 
+@contextlib.contextmanager
+def _inject_pool_faults(schedule: dict, *, collapse_at: int):
+    """The pool-aware chaos seam (ISSUE 13): only batcher steps running
+    inside the PREFILL ``faults.pool_scope`` count (the decode pool and
+    any unified engine are untouched). Scheduled ``timeout`` faults
+    fabricate POOL-LOCAL by-absence records (straggler = pool position 1
+    while the pool has one, else 0), and from step ``collapse_at`` on
+    (when > 0) EVERY prefill step times out — the storm that quarantines
+    the pool's PEs / exhausts its failure budget and collapses the
+    topology to unified."""
+    from triton_dist_tpu.models.decode import ContinuousBatcher
+    from triton_dist_tpu.resilience import faults as _faults
+
+    real_step = ContinuousBatcher.step
+    calls = {"n": 0}
+
+    def flaky(self):
+        if _faults.current_pool() != "prefill":
+            return real_step(self)
+        calls["n"] += 1
+        k = calls["n"]
+        fault = schedule.get(k)
+        storm = collapse_at and k >= collapse_at
+        if storm or (fault is not None and fault[0] == "timeout"):
+            w = int(self.mesh.shape[self.cfg.axis])
+            straggler = 1 if w > 1 else 0
+            recs = [
+                {"pe": p, "kind": "barrier_all", "site": 0,
+                 "status": "timeout", "expected": 1, "observed": 0,
+                 "budget": 16}
+                for p in range(w) if p != straggler
+            ]
+            raise DistTimeoutError("batcher_step", recs, world_size=w)
+        return real_step(self)
+
+    ContinuousBatcher.step = flaky
+    try:
+        yield calls
+    finally:
+        ContinuousBatcher.step = real_step
+
+
+def check_disagg_invariants(eng, result: CampaignResult,
+                            offered_uids: set) -> list:
+    """The disagg campaign's green conditions: the four module-docstring
+    invariants over the TWO-POOL composition, plus handoff-ladder and
+    collapse accounting."""
+    fails: list[str] = []
+    snap = result.snapshot
+    reqs = snap.get("requests", {})
+    term = result.terminals
+    spec = result.spec
+
+    got = set(term)
+    if got != offered_uids:
+        fails.append(
+            f"terminal census mismatch: missing={sorted(offered_uids - got)} "
+            f"extra={sorted(got - offered_uids)}"
+        )
+    unknown = {u: k for u, k in term.items() if k.startswith("<unknown")}
+    if unknown:
+        fails.append(f"non-terminal results: {unknown}")
+
+    if eng._states or eng._landings:
+        fails.append(
+            f"residual work after serve: in_flight={len(eng._states)} "
+            f"pending_landings={len(eng._landings)}"
+        )
+    for name, pool in (("prefill", eng.prefill), ("decode", eng.decode)):
+        if name == "prefill" and eng.collapsed:
+            continue  # the dead pool's state is abandoned by design
+        if pool._pending or not pool._batcher.idle:
+            fails.append(f"pool {name} left queued/in-flight work behind")
+
+    census: dict[str, int] = {}
+    for k in term.values():
+        census[k] = census.get(k, 0) + 1
+    for name, want in (
+        ("finished", census.get("finished", 0)),
+        ("shed", census.get("shed", 0)),
+        ("poisoned", census.get("poisoned", 0)),
+    ):
+        if reqs.get(name, 0) != want:
+            fails.append(
+                f"counter {name}={reqs.get(name, 0)} disagrees with "
+                f"terminal census {want}"
+            )
+    ho = snap.get("handoff", {})
+    if ho.get("transfers", 0) != (
+        ho.get("delivered", 0) + ho.get("fallbacks", 0)
+    ):
+        fails.append(
+            f"handoff ladder does not balance: transfers="
+            f"{ho.get('transfers')} != delivered {ho.get('delivered')} + "
+            f"fallbacks {ho.get('fallbacks')}"
+        )
+    if reqs.get("handoffs", 0) != ho.get("transfers", 0):
+        fails.append(
+            f"engine handoffs={reqs.get('handoffs', 0)} != plane "
+            f"transfers {ho.get('transfers', 0)}"
+        )
+    hc = result.health.get("counters", {})
+    if hc.get("kv_handoff:handoff_fallback", 0) != ho.get("fallbacks", 0):
+        fails.append(
+            f"health handoff_fallback="
+            f"{hc.get('kv_handoff:handoff_fallback', 0)} != plane "
+            f"fallbacks {ho.get('fallbacks', 0)}"
+        )
+    if spec.n_chunk_corruptions and not ho.get("canary_mismatches", 0):
+        fails.append(
+            "scheduled chunk corruption never fired — the handoff ladder "
+            "this campaign advertises did not run (retune the spec)"
+        )
+    want_collapse = 1 if spec.collapse_at_step else 0
+    if reqs.get("pool_collapses", 0) != want_collapse:
+        fails.append(
+            f"pool_collapses={reqs.get('pool_collapses', 0)} != scheduled "
+            f"{want_collapse}"
+        )
+    if hc.get("serving_disagg:pool_collapse", 0) != want_collapse:
+        fails.append(
+            f"health pool_collapse="
+            f"{hc.get('serving_disagg:pool_collapse', 0)} != scheduled "
+            f"{want_collapse}"
+        )
+    if spec.n_timeouts and not snap.get("engine", {}).get("collapsed") and (
+        snap.get("pools", {}).get("prefill", {})
+        .get("engine", {}).get("world_size", spec.disagg_prefill_pes)
+        >= spec.disagg_prefill_pes
+    ):
+        fails.append(
+            "scheduled prefill straggler never shrank the pool — the "
+            "mid-stream shrink arc did not run (retune the spec)"
+        )
+    return fails
+
+
+def _run_disagg_campaign(spec: SoakSpec) -> CampaignResult:
+    """One seeded two-pool campaign (dispatched by :func:`run_campaign`
+    when ``spec.disagg_prefill_pes > 0``)."""
+    import jax
+
+    from triton_dist_tpu import config as tdt_config
+    from triton_dist_tpu import resilience
+    from triton_dist_tpu.resilience.faults import FaultPlan
+    from triton_dist_tpu.serving import (
+        DisaggServingConfig,
+        DisaggServingEngine,
+        HandoffConfig,
+        OverloadConfig,
+        ServingConfig,
+        TrafficSpec,
+        generate_trace,
+    )
+    from triton_dist_tpu.serving.metrics import SLOTargets
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < spec.world:
+        raise RuntimeError(
+            f"soak needs {spec.world} devices (run under "
+            f"--xla_force_host_platform_device_count, as "
+            f"scripts/chaos_soak.py and conftest.py do); have "
+            f"{len(jax.devices())}"
+        )
+    cfgsnap = tdt_config.get_config()
+    saved = (cfgsnap.elastic, cfgsnap.suspect_threshold,
+             cfgsnap.probation_probes, cfgsnap.fault_plan)
+    resilience.reset(keep_env=True)
+    tdt_config.update(
+        elastic=True, suspect_threshold=max(1, spec.n_timeouts),
+        probation_probes=1,
+        fault_plan=(
+            FaultPlan("bitflip", pe=-1, pool="decode",
+                      max_triggers=spec.n_chunk_corruptions)
+            if spec.n_chunk_corruptions else None
+        ),
+    )
+    try:
+        from triton_dist_tpu.models import init_params
+        from triton_dist_tpu.models.tp_transformer import TransformerConfig
+        from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig
+        from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig
+        from jax.random import PRNGKey
+
+        cfg = TransformerConfig(
+            vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=4,
+            n_kv_heads=4, head_dim=8, batch=spec.batch, seq=8,
+            ag_config=AGGemmConfig(8, 16, 16),
+            rs_config=GemmRSConfig(8, 16, 16),
+        )
+        params = init_params(PRNGKey(1), cfg)
+        mesh = Mesh(np.array(jax.devices()[:spec.world]), ("tp",))
+        traffic = TrafficSpec(
+            rate_rps=spec.rate_rps, n_requests=spec.n_requests,
+            process="burst", burst_every_s=spec.burst_every_s,
+            burst_n=spec.burst_n,
+            prompt_len=("uniform", 2, 6), output_len=("uniform", 2, 5),
+            vocab=cfg.vocab, seed=spec.seed, uid_prefix=f"dg{spec.seed}-",
+            priority_mix=spec.priority_mix, deadline_ms=spec.deadline_ms,
+        )
+        trace = generate_trace(traffic)
+        schedule = fault_schedule(spec)
+        clock = _retry.FakeClock()
+        with _retry.clock_scope(clock):
+            eng = DisaggServingEngine(
+                cfg, params, mesh, s_max=spec.s_max, clock=clock,
+                serving=DisaggServingConfig(
+                    prefill_pes=spec.disagg_prefill_pes,
+                    virtual_step_s=spec.virtual_step_s,
+                    slo=SLOTargets(ttft_ms=1500.0),
+                    handoff=HandoffConfig(
+                        page_tokens=4,
+                        chunks_per_page=spec.handoff_chunks,
+                        virtual_chunk_s=0.002,
+                    ),
+                    prefill=ServingConfig(
+                        max_queue=spec.max_queue, max_step_failures=3,
+                        overload=OverloadConfig(
+                            min_dwell_steps=4, window_steps=8,
+                            retry_budget=4,
+                        ),
+                    ),
+                    decode=ServingConfig(
+                        max_queue=spec.max_queue,
+                        overload=OverloadConfig(
+                            min_dwell_steps=4, window_steps=8,
+                            retry_budget=4,
+                        ),
+                    ),
+                ),
+            )
+            error = None
+            with _inject_pool_faults(
+                schedule, collapse_at=spec.collapse_at_step
+            ) as calls:
+                try:
+                    done = eng.serve(trace, max_steps=spec.max_steps)
+                except RuntimeError as exc:
+                    error = f"{type(exc).__name__}: {exc}"
+                    done = dict(eng.results)
+        transitions = []
+        for pool in (eng.prefill, eng.decode):
+            if pool._overload is not None:
+                transitions.extend(
+                    dataclasses.asdict(t) for t in pool._overload.transitions
+                )
+        result = CampaignResult(
+            spec=spec,
+            terminals={u: _terminal_kind(r) for u, r in done.items()},
+            n_steps_hint=calls["n"],
+            rebuilds=eng.prefill.rebuilds + eng.decode.rebuilds,
+            transitions=transitions,
+            snapshot=eng.snapshot(),
+            health=resilience.health.snapshot(),
+            fingerprint="",
+            failures=[],
+            error=error,
+        )
+        result.fingerprint = campaign_fingerprint(result)
+        offered = {a.request.uid for a in trace}
+        result.failures = check_disagg_invariants(eng, result, offered)
+        return result
+    finally:
+        tdt_config.update(
+            elastic=saved[0], suspect_threshold=saved[1],
+            probation_probes=saved[2], fault_plan=saved[3],
+        )
+        resilience.reset(keep_env=True)
+
+
 def run_campaign(spec: SoakSpec, *, model=None) -> CampaignResult:
     """Run one seeded campaign and evaluate its invariants. Process-global
     state (config, resilience registries, module clock) is snapshotted
     and restored, so campaigns compose with each other and with a live
     pytest session. ``model=(cfg, params)`` overrides the built-in tiny
-    4-PE transformer (the test fixture reuse hook)."""
+    4-PE transformer (the test fixture reuse hook). A spec with
+    ``disagg_prefill_pes > 0`` runs the two-pool topology campaign
+    (:func:`check_disagg_invariants`)."""
+    if spec.validate().disagg_prefill_pes:
+        return _run_disagg_campaign(spec)
     import jax
 
     from triton_dist_tpu import config as tdt_config

@@ -24,6 +24,19 @@ Five parts (docs/serving.md "Serving engine" is the full contract):
   preset-derived ones, per-arrival priority/deadline, Zipf shared-prefix
   mixes); same seed ⇒ byte-identical trace.
 
+- :mod:`disagg` + :mod:`handoff` — disaggregated prefill/decode serving
+  (ISSUE 13, docs/serving.md "Disaggregated serving"):
+  :class:`DisaggServingEngine` carves the mesh into a prefill pool and
+  a decode pool (one ``ServingEngine`` + ``OverloadController`` each,
+  pool-scoped elastic attribution), streams finished paged KV across
+  the boundary through the fault-tolerant :class:`HandoffPlane` (the
+  ``ops/kv_stream.py`` chunked wire's protocol at the host seam: chunk
+  canaries, the re-send → re-stream → decode-local-fallback guard
+  ladder, the trie as the transfer manifest), admits decode on
+  last-page-landed, and degrades pool-level: brownout sheds to
+  decode-local prefill, a dead prefill pool collapses to unified with
+  zero lost requests.
+
 Plus the radix-shared paged KV prefix cache (ISSUE 12;
 ``models/prefix_cache.py``, docs/serving.md "Prefix cache"), armed via
 ``ServingConfig(prefix_cache=PrefixCacheConfig(...))``: admission-time
@@ -44,6 +57,11 @@ are deterministic under a :class:`~triton_dist_tpu.resilience.FakeClock`.
 """
 
 from triton_dist_tpu.models.prefix_cache import PrefixCacheConfig
+from triton_dist_tpu.serving.disagg import (
+    DisaggServingConfig,
+    DisaggServingEngine,
+    PoolCollapse,
+)
 from triton_dist_tpu.serving.engine import (
     Finished,
     Poisoned,
@@ -51,6 +69,11 @@ from triton_dist_tpu.serving.engine import (
     ServingConfig,
     ServingEngine,
     Shed,
+)
+from triton_dist_tpu.serving.handoff import (
+    HandoffConfig,
+    HandoffPlane,
+    HandoffResult,
 )
 from triton_dist_tpu.serving.metrics import (
     ServingMetrics,
@@ -75,7 +98,13 @@ from triton_dist_tpu.serving.traffic import (
 
 __all__ = [
     "Arrival",
+    "DisaggServingConfig",
+    "DisaggServingEngine",
     "Finished",
+    "HandoffConfig",
+    "HandoffPlane",
+    "HandoffResult",
+    "PoolCollapse",
     "LADDER",
     "OverloadConfig",
     "OverloadController",

@@ -18,7 +18,13 @@ from __future__ import annotations
 
 from typing import Any
 
+import dataclasses
+
 from triton_dist_tpu.resilience.retry import FakeClock
+from triton_dist_tpu.serving.disagg import (
+    DisaggServingConfig,
+    DisaggServingEngine,
+)
 from triton_dist_tpu.serving.engine import ServingConfig, ServingEngine
 from triton_dist_tpu.serving.metrics import SLOTargets
 from triton_dist_tpu.serving.traffic import TrafficSpec, generate_trace
@@ -40,6 +46,7 @@ def sweep_offered_load(
     serving_kw: dict | None = None,
     batcher_kw: dict | None = None,
     traffic_kw: dict | None = None,
+    disagg: DisaggServingConfig | None = None,
     tag: str = "",
 ) -> list[dict]:
     """One engine + trace per λ; returns
@@ -47,7 +54,10 @@ def sweep_offered_load(
     ``traffic_kw`` merges into the TrafficSpec (the overload A/B passes
     ``priority_mix``/``deadline_ms`` here); ``serving_kw`` can carry
     ``overload=OverloadConfig(...)``; ``tag`` keeps the A/B arms' span
-    lanes apart in a merged obs export."""
+    lanes apart in a merged obs export. ``disagg`` (ISSUE 13) swaps the
+    unified engine for the two-pool :class:`DisaggServingEngine` on the
+    (multi-device) ``mesh`` — the coordinator charges ``virtual_step_s``
+    per topology tick and ``slo`` scores at the coordinator tier."""
     rows = []
     for lam in rates:
         # per-row span isolation is structural: each λ gets a FRESH
@@ -62,18 +72,36 @@ def sweep_offered_load(
             vocab=cfg.vocab, seed=seed,
             **(traffic_kw or {}),
         )
-        eng = ServingEngine(
-            cfg, params, mesh, s_max=s_max, clock=clock,
-            serving=ServingConfig(
-                virtual_step_s=virtual_step_s, slo=slo,
-                **(serving_kw or {}),
-            ),
-            # distinct exported span lanes per rate: every λ re-seeds the
-            # same request uids on a fresh t=0 FakeClock, so untagged
-            # tracks would superimpose all rates' request arcs
-            obs_tag=f"lam{lam:g}:{tag}",
-            **(batcher_kw or {}),
-        )
+        if disagg is not None:
+            if serving_kw:
+                raise ValueError(
+                    "serving_kw configures the UNIFIED engine; with "
+                    "disagg= set the per-pool policies live on "
+                    "DisaggServingConfig.prefill/.decode — pass them "
+                    "there (silently ignoring serving_kw would bench an "
+                    "unarmed topology)"
+                )
+            eng = DisaggServingEngine(
+                cfg, params, mesh, s_max=s_max, clock=clock,
+                serving=dataclasses.replace(
+                    disagg, virtual_step_s=virtual_step_s, slo=slo,
+                ),
+                obs_tag=f"lam{lam:g}:{tag}",
+                **(batcher_kw or {}),
+            )
+        else:
+            eng = ServingEngine(
+                cfg, params, mesh, s_max=s_max, clock=clock,
+                serving=ServingConfig(
+                    virtual_step_s=virtual_step_s, slo=slo,
+                    **(serving_kw or {}),
+                ),
+                # distinct exported span lanes per rate: every λ re-seeds
+                # the same request uids on a fresh t=0 FakeClock, so
+                # untagged tracks would superimpose all rates' request arcs
+                obs_tag=f"lam{lam:g}:{tag}",
+                **(batcher_kw or {}),
+            )
         done = eng.serve(generate_trace(spec))
         rows.append({
             "rate_rps": float(lam),
@@ -134,10 +162,22 @@ def info_lines(rows: list[dict], tag: str = "") -> list[tuple[str, Any, str]]:
             if st is not None and st["count"]:
                 out.append((f"serving_interactive_ttft_p99_ms_{key}",
                             st["p99"], "ms"))
-        # per-phase step-time breakdown from the span tracer (ISSUE 9):
-        # present only when obs was armed for the sweep; deterministic
-        # under the FakeClock like every other row
-        for phase in ("queued", "prefill", "decode"):
+        if "handoff" in snap:
+            # the disagg A/B's attribution columns (ISSUE 13): what the
+            # wire moved, what the trie-manifest dedup saved, and how
+            # often the ladder had to fall back
+            ho = snap["handoff"]
+            out.append((f"serving_ho_pages_streamed_{key}",
+                        ho["pages_streamed"], "pages"))
+            out.append((f"serving_ho_pages_deduped_{key}",
+                        ho["pages_deduped"], "pages"))
+            out.append((f"serving_ho_fallbacks_{key}",
+                        ho["fallbacks"], "requests"))
+        # per-phase step-time breakdown from the span tracer (ISSUE 9;
+        # + the ISSUE 13 transfer phase on disagg rows): present only
+        # when obs was armed for the sweep; deterministic under the
+        # FakeClock like every other row
+        for phase in ("queued", "prefill", "transfer", "decode"):
             st = snap.get("span_ms", {}).get(f"serving:{phase}")
             if st is not None and st["count"]:
                 out.append((f"serving_{phase}_p50_ms_{key}",

@@ -1,0 +1,780 @@
+"""Disaggregated prefill/decode serving (ISSUE 13 tentpole, ROADMAP #2).
+
+Production fleets split prefill and decode onto separate accelerator
+pools because the two phases have opposite rooflines: prefill is
+MXU-bound over whole prompts, decode is bandwidth-bound one token at a
+time — and a slot that holds a unified engine's batch for
+``prefill + decode`` steps makes queued TTFT explode at high offered
+load. This module composes the repo's existing machinery into that
+two-pool topology, with **robustness as the contract**:
+
+- **Two pools, one mesh** — a 1-D serving mesh is carved into a prefill
+  pool (the first ``prefill_pes`` devices) and a decode pool (the rest);
+  each pool runs its own :class:`~triton_dist_tpu.serving.engine.
+  ServingEngine` (its own ``ContinuousBatcher``, its own elastic
+  shrink/rebuild arc with POOL-SCOPED PE attribution, its own
+  :class:`~triton_dist_tpu.serving.overload.OverloadController` — the
+  per-pool admission story PR 11 pre-built).
+- **The request lifecycle** — submit → prefill pool (prompt feed + the
+  FIRST token: the client's TTFT comes from the prefill pool) → the
+  **KV handoff** (``serving/handoff.py``: the ``ops/kv_stream.py``
+  chunked wire with per-chunk canaries, modeled at the documented host
+  seam) → decode-pool admission **on last-page-landed** → decode to
+  completion. The decode pool re-materializes the landed KV by feeding
+  the prompt (the host-tier landing form — byte-identical by the
+  prefix-replay containment argument; feed steps ride decode steps the
+  way DMA landings overlap compute), regenerating the first token as
+  position L's decode — the cross-pool consistency check: it must equal
+  the prefill pool's token.
+- **The trie is the transfer manifest** — pages are keyed as the
+  ISSUE 12 radix trie keys them, so shared prefixes stream ONCE; with
+  the prefix cache armed on the prefill pool they are also PREFILLED
+  once.
+- **Degradation ladder** (never a lost request):
+
+  * a corrupt/dropped chunk walks the handoff guard ladder — re-send →
+    re-stream → decode-local cold re-prefill — with the culprit PE
+    struck through the elastic state machine (``serving/handoff.py``);
+  * a browned-out or shrunk prefill pool sheds NEW work to decode-local
+    prefill (its overload ladder at ``local_prefill_rung``+, or a
+    Rejected at its door, routes the request straight into the decode
+    pool — cold, correct, slower);
+  * the prefill pool losing its LAST serviceable PE **collapses the
+    topology to the unified engine**: every in-flight prefill replays
+    into the decode pool (the cold-restart contract regenerates all
+    streams byte-identically), recorded as a ``pool_collapse`` health
+    event; the decode pool IS the unified engine from then on.
+
+Every timestamp rides the injectable clock; ``virtual_step_s`` charges
+ONE step per topology tick (the pools run concurrently in a real fleet,
+so stepping both pools in one tick costs one step of virtual time).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Any
+
+import numpy as np
+from jax.sharding import Mesh
+
+from triton_dist_tpu import obs as _obs
+from triton_dist_tpu.models.decode import Request
+from triton_dist_tpu.resilience import elastic, faults, health
+from triton_dist_tpu.resilience import retry as _retry
+from triton_dist_tpu.serving.engine import (
+    Finished,
+    Poisoned,
+    Rejected,
+    ServingConfig,
+    ServingEngine,
+    Shed,
+    UnrecoverableEngineError,
+)
+from triton_dist_tpu.serving.handoff import (
+    DECODE_POOL,
+    HandoffConfig,
+    HandoffPlane,
+    PREFILL_POOL,
+)
+from triton_dist_tpu.serving.metrics import ServingMetrics, SLOTargets
+from triton_dist_tpu.serving.overload import PRIORITIES
+
+
+class PoolCollapse(RuntimeError):
+    """A pool has no serviceable PE left (every device quarantined, or
+    no survivor count passes the model's divisibility predicate)."""
+
+
+class _PoolEngine(ServingEngine):
+    """A :class:`ServingEngine` that serves ONE pool of a disaggregated
+    topology: its elastic arc runs pool-scoped — quarantined-PE indices
+    are the TOPOLOGY's global numbering (pool position + ``pe_offset``),
+    so a struck decode PE can never shrink the prefill pool — and every
+    step runs inside the pool's ``faults.pool_scope`` (the FaultPlan
+    ``pool=`` injection seam). Probation regrow is coordinator-level
+    future work: quarantined pool PEs stay out (documented limit)."""
+
+    def __init__(self, *args, pool_name: str, pe_offset: int, **kw):
+        self._pool_name = str(pool_name)
+        self._pe_offset = int(pe_offset)
+        super().__init__(*args, **kw)
+        self.family = f"serving_pool_{self._pool_name}"
+
+    def _target_mesh(self):
+        if self.full_mesh.devices.ndim != 1 or not elastic.enabled():
+            return self.full_mesh
+        n = int(self.full_mesh.devices.size)
+        dropped = {
+            pe - self._pe_offset
+            for pe in elastic.quarantined_pes()
+            if self._pe_offset <= pe < self._pe_offset + n
+        }
+        if not dropped:
+            return self.full_mesh
+        devs = [
+            d for i, d in enumerate(self.full_mesh.devices.flat)
+            if i not in dropped
+        ]
+        for k in range(len(devs), 0, -1):
+            if self._world_ok(k):
+                return Mesh(np.array(devs[:k]), (self.cfg.axis,))
+        raise PoolCollapse(
+            f"pool {self._pool_name!r}: no serviceable world among "
+            f"{len(devs)} survivor(s) of {n} "
+            f"(quarantined pool positions: {sorted(dropped)})"
+        )
+
+    def _attribute_timeout(self, exc: BaseException) -> None:
+        # pool-scoped by-absence attribution: the records name POOL
+        # positions; the strike lands on the global index
+        if not elastic.enabled():
+            return
+        err = _retry.timeout_in_chain(exc)
+        if err is None or getattr(err, "world_size", None) is None:
+            return
+        pe = elastic.attribute_straggler(err.records, int(err.world_size))
+        if pe is not None:
+            elastic.report_timeout(pe + self._pe_offset, family=self.family)
+
+    def _attribute_integrity(self, exc: BaseException) -> None:
+        if not elastic.enabled():
+            return
+        from triton_dist_tpu.resilience.integrity import integrity_in_chain
+
+        err = integrity_in_chain(exc)
+        if err is None or not err.records:
+            return
+        world = getattr(err, "world_size", None)
+        for r in err.records:
+            pe = int(r.get("pe", -1))
+            if pe < 0 or (world is not None and pe >= int(world)):
+                continue
+            elastic.report_corruption(pe + self._pe_offset,
+                                      family=self.family)
+
+    def _maybe_probe(self) -> None:
+        # pool probation probes would barrier the pool's sub-mesh with
+        # GLOBAL quarantine indices — not wired; pool PEs stay out once
+        # struck (the coordinator's collapse path covers the terminal
+        # case; docs/serving.md "Disaggregated serving", known limits)
+        return
+
+    def _step_once(self) -> bool:
+        with faults.pool_scope(self._pool_name):
+            return super()._step_once()
+
+
+@dataclasses.dataclass(frozen=True)
+class DisaggServingConfig:
+    """Policy of the two-pool topology.
+
+    prefill_pes:   devices carved off the FRONT of the mesh for the
+                   prefill pool (the rest decode).
+    handoff:       the KV handoff plane policy (wire, chunking, the
+                   guard-ladder retry/re-stream bounds).
+    prefill / decode: each pool's :class:`ServingConfig` — its own
+                   queue bound, admission policy, OverloadConfig (one
+                   controller per pool), and — prefill side — the
+                   ISSUE 12 prefix cache. Pool ``virtual_step_s`` must
+                   stay None: the COORDINATOR charges one step per
+                   topology tick (pools run concurrently).
+    virtual_step_s: that per-tick charge (None = real time).
+    local_prefill_rung: prefill-pool overload rung (0=normal ..
+                   3=shed_all_batch) at/above which NEW submissions
+                   bypass the prefill pool into decode-local prefill —
+                   the brownout shed path.
+    slo:           end-to-end targets scored at the coordinator tier.
+    """
+
+    prefill_pes: int = 1
+    handoff: HandoffConfig = HandoffConfig()
+    prefill: ServingConfig = ServingConfig()
+    decode: ServingConfig = ServingConfig()
+    virtual_step_s: float | None = None
+    local_prefill_rung: int = 2
+    slo: SLOTargets | None = None
+    max_steps_idle: int = 4
+
+    def validate(self) -> "DisaggServingConfig":
+        if self.prefill_pes < 1:
+            raise ValueError(
+                f"prefill_pes must be >= 1, got {self.prefill_pes}"
+            )
+        if not 1 <= self.local_prefill_rung <= 3:
+            raise ValueError(
+                f"local_prefill_rung must be in [1, 3], got "
+                f"{self.local_prefill_rung}"
+            )
+        for name, sc in (("prefill", self.prefill), ("decode", self.decode)):
+            sc.validate()
+            if sc.virtual_step_s is not None:
+                raise ValueError(
+                    f"DisaggServingConfig.{name}.virtual_step_s must be "
+                    f"None — the coordinator charges one step per topology "
+                    f"tick (pools run concurrently); set "
+                    f"DisaggServingConfig.virtual_step_s instead"
+                )
+        self.handoff.validate()
+        if self.virtual_step_s is not None and self.virtual_step_s < 0:
+            raise ValueError("virtual_step_s must be >= 0")
+        return self
+
+
+@dataclasses.dataclass
+class _DState:
+    req: Request                  # the ORIGINAL request as submitted
+    t_enqueue: float
+    priority: str
+    deadline_ms: float | None
+    phase: str                    # "prefill" | "transfer" | "decode"
+    route: str                    # "disagg" | "local" | ...
+    t_prefill_admitted: float | None = None
+    t_first: float | None = None  # the client's first token (TTFT)
+    t_landed: float | None = None
+    handoff: Any = None           # HandoffResult
+    resumed: int = 0
+
+
+class DisaggServingEngine:
+    """The two-pool coordinator (module docstring). Construction mirrors
+    :class:`ServingEngine`; ``batcher_kw`` (``page_size``, ``fd_config``,
+    ``interpret``) applies to both pools::
+
+        eng = DisaggServingEngine(
+            cfg, params, mesh, s_max=32,
+            serving=DisaggServingConfig(prefill_pes=2),
+        )
+        eng.serve(generate_trace(spec)); eng.snapshot()
+    """
+
+    family = "serving_disagg"
+
+    def __init__(
+        self,
+        cfg,
+        params,
+        mesh,
+        *,
+        s_max: int,
+        serving: DisaggServingConfig | None = None,
+        metrics: ServingMetrics | None = None,
+        clock: Any = None,
+        obs_tag: str = "",
+        **batcher_kw: Any,
+    ):
+        self.cfg = cfg
+        self.serving = (serving or DisaggServingConfig()).validate()
+        self.clock = clock if clock is not None else _retry.get_clock()
+        self._obs_tag = str(obs_tag)
+        if mesh.devices.ndim != 1:
+            raise ValueError(
+                "disaggregated serving carves a 1-D mesh into two pools; "
+                f"got {dict(mesh.shape)}"
+            )
+        devices = list(mesh.devices.flat)
+        n_p = self.serving.prefill_pes
+        if n_p >= len(devices):
+            raise ValueError(
+                f"prefill_pes={n_p} leaves no decode pool on a "
+                f"{len(devices)}-device mesh"
+            )
+        page = batcher_kw.get("page_size")
+        if page and page != self.serving.handoff.page_tokens:
+            raise ValueError(
+                f"handoff.page_tokens={self.serving.handoff.page_tokens} "
+                f"must equal the paged batcher's page_size={page} — the "
+                f"transfer manifest IS the trie's page chain"
+            )
+        axis = cfg.axis
+        self.full_mesh = mesh
+        self.s_max = int(s_max)
+        self.prefill = _PoolEngine(
+            cfg, params, Mesh(np.array(devices[:n_p]), (axis,)),
+            s_max=s_max, serving=self.serving.prefill, clock=self.clock,
+            obs_tag=f"{self._obs_tag}pf:", pool_name=PREFILL_POOL,
+            pe_offset=0, **batcher_kw,
+        )
+        self.decode = _PoolEngine(
+            cfg, params, Mesh(np.array(devices[n_p:]), (axis,)),
+            s_max=s_max, serving=self.serving.decode, clock=self.clock,
+            obs_tag=f"{self._obs_tag}dec:", pool_name=DECODE_POOL,
+            pe_offset=n_p, **batcher_kw,
+        )
+        self.handoff_plane = HandoffPlane(
+            self.serving.handoff, s_max=s_max,
+            prefill_world=n_p, decode_world=len(devices) - n_p,
+        )
+        any_ov = (
+            self.serving.prefill.overload is not None
+            or self.serving.decode.overload is not None
+        )
+        self.metrics = metrics or ServingMetrics(
+            slo=self.serving.slo, classes=PRIORITIES if any_ov else None,
+        )
+        self.collapsed = False
+        self.results: dict[Any, Any] = {}
+        self._states: dict[Any, _DState] = {}
+        # (t_due, seq, uid) heaps: landings awaiting decode admission,
+        # and decode submissions bounced by a full queue (re-offered)
+        self._landings: list = []
+        self._seq = 0
+        self._uid_counter = 0
+        self._decode_rebuilds_seen = 0
+        self._stopping = False
+        self._t0 = self.clock.monotonic()
+        self._phase_stats: dict[str, Any] = {}
+        _obs.register_serving_engine(self)
+
+    # -- submission ------------------------------------------------------
+
+    def _route_local(self) -> str | None:
+        """Why a new submission should bypass the prefill pool (None =
+        take the disaggregated path)."""
+        if self.collapsed:
+            return "topology collapsed to unified"
+        ctrl = self.prefill._overload
+        if (ctrl is not None
+                and ctrl.rung() >= self.serving.local_prefill_rung):
+            return f"prefill pool browned out ({ctrl.state})"
+        return None
+
+    def submit(
+        self,
+        req: Request,
+        *,
+        arrival_t: float | None = None,
+        priority: str = "interactive",
+        deadline_ms: float | None = None,
+    ):
+        """Enqueue one request into the topology. Returns its uid, a
+        typed :class:`Rejected` (both pools refused), or a typed
+        :class:`Shed` (a pool's overload controller refused it at the
+        door — a terminal, never a silent drop)."""
+        now = self.clock.monotonic() if arrival_t is None else float(arrival_t)
+        if req.uid is None:
+            req = dataclasses.replace(req, uid=f"d{self._uid_counter}")
+            self._uid_counter += 1
+        if req.uid in self._states or req.uid in self.results:
+            raise ValueError(f"duplicate request uid {req.uid!r}")
+        self.decode._batcher.validate_request(req)
+        self.metrics.count("submitted")
+        st = _DState(
+            req=req, t_enqueue=now, priority=priority,
+            deadline_ms=deadline_ms, phase="prefill", route="disagg",
+        )
+        why_local = self._route_local()
+        if why_local is None:
+            res = self.prefill.submit(
+                dataclasses.replace(req, max_new_tokens=1),
+                arrival_t=now, priority=priority, deadline_ms=deadline_ms,
+            )
+            if isinstance(res, Shed):
+                # the prefill controller's door refusal is a TERMINAL —
+                # surface it as this topology's result
+                self.metrics.count("shed")
+                self.results[req.uid] = res
+                return res
+            if not isinstance(res, Rejected):
+                self._states[req.uid] = st
+                return req.uid
+            why_local = "prefill pool queue full"
+        # decode-local prefill: the shed path of a browned-out / full /
+        # collapsed prefill pool — cold, correct, slower
+        st.route = "local"
+        st.phase = "decode"
+        res = self.decode.submit(
+            req, arrival_t=now, priority=priority, deadline_ms=deadline_ms,
+        )
+        if isinstance(res, Shed):
+            self.metrics.count("shed")
+            self.results[req.uid] = res
+            return res
+        if isinstance(res, Rejected):
+            self.metrics.count("rejected")
+            return Rejected(
+                req.uid,
+                f"both pools refused: {why_local}; decode: {res.reason}",
+                res.queue_depth, res.priority,
+            )
+        # counted only on ACCEPTANCE: a doubly-rejected (re-offered)
+        # arrival must not inflate the degradation-contract readout
+        self.metrics.count("local_prefills")
+        self._states[req.uid] = st
+        return req.uid
+
+    # -- prefill → handoff → decode --------------------------------------
+
+    def _drain_pool_results(self) -> None:
+        for uid in list(self.prefill.results):
+            if uid in self._states:
+                self._on_prefill_result(uid, self.prefill.results.pop(uid))
+        for uid in list(self.decode.results):
+            if uid in self._states:
+                self._on_decode_result(uid, self.decode.results.pop(uid))
+
+    def _on_prefill_result(self, uid: Any, res: Any) -> None:
+        st = self._states[uid]
+        if isinstance(res, (Shed, Poisoned)):
+            # pool-tier terminal (deadline expired in the prefill queue /
+            # poisoned prefill logits): passthrough, exactly one terminal
+            self.metrics.count(
+                "shed" if isinstance(res, Shed) else "poisoned"
+            )
+            self._states.pop(uid)
+            self.results[uid] = res
+            return
+        if isinstance(res, Rejected):
+            # terminal Rejected inside the pool cannot happen here (the
+            # coordinator, not the pool, owns resubmission) — keep loud
+            raise RuntimeError(
+                f"prefill pool produced a terminal Rejected for {uid!r}"
+            )
+        assert isinstance(res, Finished), res
+        st.t_prefill_admitted = res.t_admitted
+        st.t_first = res.t_first_token
+        st.resumed += res.resumed
+        t0 = res.tokens[0]
+        orig = st.req
+        if orig.max_new_tokens <= 1 or (
+            orig.eos_id is not None and t0 == orig.eos_id
+        ):
+            # complete at prefill: the first token was the whole answer
+            self.metrics.count("prefill_completed")
+            self._finalize(uid, list(res.tokens), res.t_finished)
+            return
+        # the KV handoff: stream the prompt's page chain to the decode
+        # pool through the guard ladder; admission gates on t_landed
+        st.phase = "transfer"
+        ho = self.handoff_plane.transfer(uid, orig.prompt,
+                                         now=res.t_finished)
+        st.handoff = ho
+        st.t_landed = ho.t_landed
+        self.metrics.count("handoffs")
+        if ho.outcome == "fallback":
+            # rung 3: the decode pool re-prefills cold — count it as a
+            # resumption (TTFT stays the prefill pool's token; the decode
+            # stream regenerates byte-identically per the strike contract)
+            self.metrics.count("handoff_fallbacks")
+            st.route = "fallback"
+            st.resumed += 1
+        self._push_landing(ho.t_landed, uid)
+
+    def _push_landing(self, t: float, uid: Any) -> None:
+        heapq.heappush(self._landings, (float(t), self._seq, uid))
+        self._seq += 1
+
+    def _flush_landings(self, now: float) -> None:
+        """Admission on last-page-landed: once a handoff's final chunk
+        has landed (engine clock), the request enters the decode pool —
+        anchored at its ORIGINAL arrival time, so deadlines and TTFT/e2e
+        keep accruing across the transfer."""
+        while self._landings and self._landings[0][0] <= now:
+            _, _, uid = heapq.heappop(self._landings)
+            st = self._states.get(uid)
+            if st is None:
+                continue  # terminal elsewhere (collapse replay raced)
+            st.phase = "decode"
+            res = self.decode.submit(
+                st.req, arrival_t=st.t_enqueue, priority=st.priority,
+                deadline_ms=st.deadline_ms,
+            )
+            if isinstance(res, Shed):
+                self.metrics.count("shed")
+                self._states.pop(uid)
+                self.results[uid] = res
+            elif isinstance(res, Rejected):
+                # decode queue full: the landed pages wait; re-offer on
+                # the next tick (bounded — offered traffic is finite and
+                # the decode pool keeps draining)
+                st.phase = "transfer"
+                self._push_landing(
+                    now + (self.serving.virtual_step_s or 1e-3), uid
+                )
+
+    def _on_decode_result(self, uid: Any, res: Any) -> None:
+        st = self._states[uid]
+        if isinstance(res, (Shed, Poisoned)):
+            self.metrics.count(
+                "shed" if isinstance(res, Shed) else "poisoned"
+            )
+            self._states.pop(uid)
+            self.results[uid] = res
+            return
+        if isinstance(res, Rejected):
+            raise RuntimeError(
+                f"decode pool produced a terminal Rejected for {uid!r}"
+            )
+        assert isinstance(res, Finished), res
+        # cross-pool consistency (the decode pool regenerates the first
+        # token the prefill pool already served; the two must agree) is
+        # pinned in tests — a runtime assertion here would mask the
+        # fault-injection soaks that deliberately corrupt handoff state
+        if st.t_first is None:
+            st.t_first = res.t_first_token
+        st.resumed += res.resumed
+        self._finalize(uid, list(res.tokens), res.t_finished)
+
+    def _finalize(self, uid: Any, tokens: list, now: float) -> None:
+        st = self._states.pop(uid)
+        prio = st.priority if self.metrics.classes else None
+        ttft_ms = (st.t_first - st.t_enqueue) * 1e3
+        e2e_ms = (now - st.t_enqueue) * 1e3
+        tpot_ms = (
+            (now - st.t_first) / (len(tokens) - 1) * 1e3
+            if len(tokens) > 1 else None
+        )
+        deadline_ok = None
+        if st.deadline_ms is not None:
+            deadline_ok = now <= st.t_enqueue + st.deadline_ms / 1e3
+            if not deadline_ok:
+                self.metrics.count("deadline_missed")
+        self.metrics.observe_first_token(
+            ttft_ms, resumed=st.resumed > 0, priority=prio
+        )
+        self.metrics.observe_finished(
+            ttft_ms=ttft_ms, e2e_ms=e2e_ms, tpot_ms=tpot_ms,
+            n_tokens=len(tokens), priority=prio, deadline_ok=deadline_ok,
+        )
+        if uid in self.results:
+            raise RuntimeError(
+                f"request {uid!r} finished twice — disagg bookkeeping bug"
+            )
+        fin = Finished(
+            uid=uid, tokens=tokens, t_enqueue=st.t_enqueue,
+            t_admitted=st.t_prefill_admitted, t_first_token=st.t_first,
+            t_finished=now, resumed=st.resumed,
+        )
+        self.results[uid] = fin
+        self._record_phase_spans(st, fin)
+
+    def _record_phase_spans(self, st: _DState, fin: Finished) -> None:
+        """The ISSUE 13 obs satellite: per-request lifecycle with the
+        TRANSFER phase — ``queued → prefill → transfer → decode``
+        decomposes ``e2e`` exactly for every handed-off request (the
+        handoff starts the instant the prefill pool produced the first
+        token, and decode admission gates on last-page-landed). Engine
+        clock timestamps; no-op when obs is disarmed."""
+        if not _obs.span_enabled():
+            return
+        track = f"{self._obs_tag}req:{fin.uid}"
+
+        def phase(name, t0, t1, **attrs):
+            _obs.record_span(name, t0, t1, cat="serving", track=track,
+                             uid=str(fin.uid), **attrs)
+            stats = self._phase_stats.get(name)
+            if stats is None:
+                stats = self._phase_stats[name] = _obs.tracer.DurationStats()
+            stats.record((t1 - t0) * 1e3)
+
+        ho = st.handoff
+        # a fallback-outcome handoff still RAN (and is exactly the case
+        # trace_summary must be able to diagnose), so it gets the full
+        # phase decomposition too; only routes with no handoff at all
+        # (local / collapse) reduce to the e2e span
+        if ho is not None and st.t_landed is not None:
+            phase("serving:queued", fin.t_enqueue, fin.t_admitted)
+            phase("serving:prefill", fin.t_admitted, fin.t_first_token,
+                  pool=PREFILL_POOL)
+            phase("serving:transfer", fin.t_first_token, st.t_landed,
+                  pages_streamed=ho.pages_streamed,
+                  pages_deduped=ho.pages_deduped, chunks=ho.chunks_sent,
+                  retries=ho.retries, restreams=ho.restreams,
+                  outcome=ho.outcome)
+            phase("serving:decode", st.t_landed, fin.t_finished,
+                  n_tokens=len(fin.tokens), pool=DECODE_POOL)
+        phase("serving:e2e", fin.t_enqueue, fin.t_finished,
+              resumed=fin.resumed, n_tokens=len(fin.tokens),
+              route=st.route)
+
+    # -- pool collapse ----------------------------------------------------
+
+    def _collapse(self, why: str) -> None:
+        """The prefill pool is gone: fold the topology into the unified
+        engine (the decode pool) with every in-prefill request replayed
+        — the cold-restart contract regenerates each stream
+        byte-identically, so no request and no token is lost."""
+        if self.collapsed:
+            return
+        self.collapsed = True
+        now = self.clock.monotonic()
+        self.metrics.count("pool_collapses")
+        health.record_pool_collapse(self.family, PREFILL_POOL, why)
+        # completed prefills survive FIRST (the drain_finished contract):
+        # a Finished sitting undrained in the dying pool hands off
+        # normally here — replaying it below too would double-land it
+        self._drain_pool_results()
+        replayed = 0
+        for uid, st in list(self._states.items()):
+            if st.phase != "prefill":
+                continue  # transfer/decode phases are decode-bound already
+            st.route = "collapse"
+            st.phase = "decode"
+            st.resumed += 1
+            self.metrics.count("resumed")
+            # the prefill pool may or may not have admitted it — either
+            # way the decode pool restarts it cold from the original
+            # prompt; pool-engine state is abandoned with the pool
+            self._push_landing(now, uid)
+            replayed += 1
+        # decode-side streamed pages stay valid (their KV is decode-pool
+        # resident); only the prefill side died
+        _obs.record_span(
+            "serving:pool_collapse", now, now, cat="serving",
+            track=f"{self._obs_tag}engine", pool=PREFILL_POOL, reason=why,
+            replayed=replayed,
+        )
+
+    # -- the tick loop ----------------------------------------------------
+
+    def _check_decode_rebuild(self) -> None:
+        if self.decode.rebuilds != self._decode_rebuilds_seen:
+            self._decode_rebuilds_seen = self.decode.rebuilds
+            self.handoff_plane.invalidate()
+
+    def _tick(self) -> bool:
+        """One topology step: the prefill pool, the handoff pipeline, and
+        the decode pool each advance once; ONE ``virtual_step_s`` is
+        charged (the pools run concurrently). Returns False when nothing
+        had work."""
+        worked = False
+        # a decode-pool rebuild (elastic shrink, downshift) built a FRESH
+        # cache: nothing previously streamed is resident anymore, so the
+        # transfer manifest must forget it BEFORE any drain can run a
+        # transfer that would dedup onto destroyed pages — checked again
+        # right after the decode step, which is where rebuilds happen
+        self._check_decode_rebuild()
+        if not self.collapsed:
+            try:
+                worked |= self.prefill._step_once()
+            except (PoolCollapse, UnrecoverableEngineError) as exc:
+                # ONLY the typed pool-is-dead signals collapse; a loud
+                # bookkeeping-bug RuntimeError must stay loud, never be
+                # swallowed into a spurious collapse
+                self._collapse(f"prefill pool unrecoverable: {exc}")
+                worked = True
+        self._drain_pool_results()
+        self._flush_landings(self.clock.monotonic())
+        worked |= self.decode._step_once()
+        self._check_decode_rebuild()
+        self._drain_pool_results()
+        if worked and self.serving.virtual_step_s:
+            self.clock.sleep(self.serving.virtual_step_s)
+        return worked
+
+    def serve(self, traffic=(), *, max_steps: int = 1_000_000) -> dict:
+        """Drive an iterable of :class:`~triton_dist_tpu.serving.traffic.
+        Arrival` until every offered request reaches its terminal state.
+        Returns ``dict(self.results)``."""
+        heap: list = []
+        seq = 0
+        for a in sorted(traffic, key=lambda a: a.t_s):
+            heap.append((a.t_s, seq, a))
+            seq += 1
+        heapq.heapify(heap)
+        steps = 0
+        while True:
+            now = self.clock.monotonic()
+            if self._stopping and heap:
+                for _, _, a in heap:
+                    self.metrics.count("cancelled")
+                heap.clear()
+            while heap and heap[0][0] <= now:
+                _, _, a = heapq.heappop(heap)
+                res = self.submit(
+                    a.request, arrival_t=a.t_s,
+                    priority=getattr(a, "priority", "interactive"),
+                    deadline_ms=getattr(a, "deadline_ms", None),
+                )
+                if isinstance(res, Rejected):
+                    # BOTH pools refused (queues full): the offered
+                    # request is the serve loop's to re-offer — never a
+                    # silent drop. It re-enters after one tick with its
+                    # ORIGINAL arrival time (TTFT/deadline anchors hold,
+                    # the PR 11 retry convention); the loop's step budget
+                    # bounds a permanently wedged topology.
+                    self.metrics.count("reoffered")
+                    heapq.heappush(heap, (
+                        self.clock.monotonic()
+                        + (self.serving.virtual_step_s or 1e-3),
+                        seq, a,
+                    ))
+                    seq += 1
+            if self._tick():
+                steps += 1
+                if steps >= max_steps:
+                    raise RuntimeError(
+                        f"serve(max_steps={max_steps}) exhausted with work "
+                        f"still in flight; finished results are intact in "
+                        f"self.results"
+                    )
+                continue
+            pending = []
+            if heap:
+                pending.append(heap[0][0])
+            if self._landings:
+                pending.append(self._landings[0][0])
+            if pending:
+                dt = min(pending) - self.clock.monotonic()
+                if dt > 0:
+                    self.clock.sleep(dt)
+                continue
+            if self._states:
+                raise RuntimeError(
+                    f"disagg serve wedged: {len(self._states)} request(s) "
+                    f"without work or a pending landing "
+                    f"({sorted(self._states)})"
+                )
+            return dict(self.results)
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> dict:
+        return self.serve((), max_steps=max_steps)
+
+    def stop(self, drain: bool = True) -> None:
+        self._stopping = True
+        self.prefill.stop(drain=drain)
+        self.decode.stop(drain=drain)
+
+    # -- readout ----------------------------------------------------------
+
+    @property
+    def world_size(self) -> int:
+        return (0 if self.collapsed else self.prefill.world_size) + (
+            self.decode.world_size
+        )
+
+    def snapshot(self) -> dict:
+        """Coordinator-tier metrics + the handoff plane's counters + each
+        pool's own snapshot. Deterministic under a FakeClock."""
+        now = self.clock.monotonic()
+        snap = self.metrics.snapshot()
+        elapsed = max(now - self._t0, 1e-9)
+        snap["tokens"]["per_s"] = round(
+            self.metrics.tokens_generated / elapsed, 6
+        )
+        snap["tokens"]["goodput_per_s"] = round(
+            self.metrics.tokens_goodput / elapsed, 6
+        )
+        snap["engine"] = {
+            "topology": "disagg",
+            "collapsed": self.collapsed,
+            "prefill_world": (
+                0 if self.collapsed else self.prefill.world_size
+            ),
+            "decode_world": self.decode.world_size,
+            "in_flight": len(self._states),
+            "pending_landings": len(self._landings),
+            "clock_s": round(now - self._t0, 9),
+        }
+        snap["handoff"] = self.handoff_plane.snapshot()
+        snap["pools"] = {
+            PREFILL_POOL: self.prefill.snapshot(),
+            DECODE_POOL: self.decode.snapshot(),
+        }
+        if _obs.span_enabled():
+            snap["span_ms"] = {
+                name: st.snapshot()
+                for name, st in sorted(self._phase_stats.items())
+            }
+        return snap

@@ -152,6 +152,14 @@ class ServingConfig:
         return self
 
 
+class UnrecoverableEngineError(RuntimeError):
+    """The engine exhausted ``max_step_failures`` consecutive failing
+    steps without recovering — the rebuild/replay machinery cannot make
+    progress. A TYPED signal (not a bare RuntimeError) so a supervising
+    topology (serving/disagg.py) can distinguish "this pool is dead"
+    from a loud bookkeeping-bug RuntimeError it must never swallow."""
+
+
 @dataclasses.dataclass(frozen=True)
 class Rejected:
     """Typed backpressure result: the queue was full under the "reject"
@@ -296,6 +304,7 @@ class ServingEngine:
         self._stopping = False
         self._base_cfg = cfg           # restored when brownout2 descends
         self._downshifted = False
+        self._w8_params = None         # once-quantized serving banks cache
         # prefix-cache counters accumulated across batcher rebuilds (each
         # rebuild starts a FRESH trie — the pool is the batcher's)
         self._px_totals: dict[str, int] = {}
@@ -360,12 +369,48 @@ class ServingEngine:
             self.full_mesh, axis=self.cfg.axis, validate=self._world_ok
         )
 
+    def _serving_params(self):
+        """The param tree the batcher should serve. With a w8 MoE config
+        (``cfg.gg_config.w8``) and FLOAT expert banks, quantize them ONCE
+        here (ISSUE 13 satellite — the tp_transformer.py:360 noted
+        follow-up retired at the engine tier): every decode/prefill call
+        then feeds pre-quantized int8 pools + explicit scales straight
+        through, skipping ``resolve_w8``'s per-call quantize bank
+        read+write. Bit-identical to the on-the-fly path by construction
+        (``resolve_w8`` and ``quantize_moe_serving_params`` share
+        ``quantize_expert_weights``; unit-pinned in tests). Cached — a
+        rebuild (elastic shrink, brownout downshift) re-reads it, and a
+        downshift REVERT (cfg back to non-w8) serves the original float
+        banks again."""
+        c = self.cfg
+        if not getattr(getattr(c, "gg_config", None), "w8", False):
+            return self.params
+        layers = (
+            self.params.get("layers")
+            if isinstance(self.params, dict) else None
+        )
+        if not layers or "w_up" not in layers[0]:
+            return self.params
+        if "w_up_scale" in layers[0]:
+            return self.params  # caller already fed pre-quantized pools
+        import jax.numpy as jnp
+
+        if not jnp.issubdtype(layers[0]["w_up"].dtype, jnp.floating):
+            return self.params  # int8 without scales: stays loud below
+        if self._w8_params is None:
+            from triton_dist_tpu.models.tp_transformer import (
+                quantize_moe_serving_params,
+            )
+
+            self._w8_params = quantize_moe_serving_params(self.params)
+        return self._w8_params
+
     def _build(self, mesh) -> ContinuousBatcher:
         kw = dict(self.batcher_kw)
         if self.serving.prefix_cache is not None:
             kw["prefix_cache"] = self.serving.prefix_cache
         return ContinuousBatcher(
-            self.cfg, self.params, mesh, s_max=self.s_max, **kw
+            self.cfg, self._serving_params(), mesh, s_max=self.s_max, **kw
         )
 
     # -- submission / admission ----------------------------------------
@@ -803,15 +848,25 @@ class ServingEngine:
 
     # -- elastic shrink / regrow ---------------------------------------
 
+    def _attribute_timeout(self, exc: BaseException) -> None:
+        """Peer attribution for one step timeout — overridable so a POOL
+        engine (serving/disagg.py) can offset the records' pool-local PE
+        indices into the topology's global numbering before striking."""
+        elastic.note_timeout_exc(exc, family=self.family)
+
+    def _attribute_integrity(self, exc: BaseException) -> None:
+        """Corruption-attribution twin of :meth:`_attribute_timeout`."""
+        elastic.note_integrity_exc(exc, family=self.family)
+
     def _on_step_timeout(self, exc: BaseException) -> None:
         # offer the failure to peer attribution (the call_with_retry
         # convention; a no-op unless config.elastic) — by quarantine
         # threshold the straggler is out and _target_mesh shrinks
-        elastic.note_timeout_exc(exc, family=self.family)
+        self._attribute_timeout(exc)
         self.metrics.count("step_timeouts")
         self._failures += 1
         if self._failures > self.serving.max_step_failures:
-            raise RuntimeError(
+            raise UnrecoverableEngineError(
                 f"serving engine: {self._failures} consecutive step "
                 f"timeouts without recovering — rebuild/replay cannot make "
                 f"progress (see resilience.health.snapshot())"
@@ -824,11 +879,11 @@ class ServingEngine:
         # note_timeout_exc convention), then rebuild + prefix-replay; a
         # persistently corrupt PE accumulates strikes to quarantine and
         # _target_mesh shrinks around it, exactly the straggler arc
-        elastic.note_integrity_exc(exc, family=self.family)
+        self._attribute_integrity(exc)
         self.metrics.count("step_integrity")
         self._failures += 1
         if self._failures > self.serving.max_step_failures:
-            raise RuntimeError(
+            raise UnrecoverableEngineError(
                 f"serving engine: {self._failures} consecutive corrupt "
                 f"steps without recovering — rebuild/replay cannot make "
                 f"progress (see resilience.health.snapshot())"

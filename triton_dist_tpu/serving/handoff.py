@@ -1,0 +1,382 @@
+"""The fault-tolerant KV handoff plane (ISSUE 13 tentpole, part b).
+
+When a prompt finishes prefilling on the prefill pool, its paged KV must
+cross the pool boundary to a decode-pool PE — and that transfer is a new
+fault domain: a chunk can be dropped (its signal never arrives), torn or
+corrupted mid-flight (its payload canary fails), or a whole pool can
+brown out under it. This module is the HOST-TIER model of that wire —
+the ``ops/kv_stream.py`` chunked-put family's protocol (per-chunk signal
+slots, payload canaries, bounded waits) at the documented host chaos
+seam (the PR 11 soak discipline: only the in-kernel wait is simulated;
+retries, attribution, strikes, and the degradation ladder are the
+production paths) — plus the **guard ladder** that makes the handoff
+robust, mirroring the ISSUE 8 integrity ladder rung for rung:
+
+1. **bounded in-place re-send** — a chunk whose canary mismatches (the
+   landing decode PE is the culprit: victim == culprit, the ISSUE 8
+   landing-site model) or whose signal times out (the prefill sender is
+   the culprit, by absence) is re-sent after the deterministic
+   ``RetryPolicy`` backoff; every attempt strikes the culprit PE through
+   the elastic state machine and lands a ``handoff_retry`` health event;
+2. **whole-sequence re-stream** — chunk retries exhausted: every page of
+   the sequence re-streams from the prefill pool (previously deduped
+   pages included — the corruption could have aliased any of them),
+   recorded as ``handoff_restream``;
+3. **decode-local cold re-prefill** — re-streams exhausted: the request
+   falls back to a cold prefill on the decode pool (``handoff_fallback``)
+   — the request is NEVER lost and corrupt KV is NEVER decoded; the cold
+   restart regenerates the stream byte-identically (the ISSUE 12 strike
+   contract: fresh seed-derived RNG, same tokens).
+
+**The trie is the transfer manifest** (ISSUE 12 × 13): pages are keyed
+exactly as the prefix-cache radix trie keys them — a page's identity is
+its full token prefix through that page — so shared prefixes stream
+ONCE; a second request over the same system prompt transfers only its
+divergent suffix. A re-stream invalidates the sequence's keys first
+(rung 2's conservatism).
+
+Transfer time is charged on the engine's injectable clock
+(``virtual_chunk_s`` per chunk, ``chunk_timeout_s`` per expired wait,
+retry backoffs from the policy), so ``FakeClock`` runs — latency
+percentiles, A/B sweeps, soak fingerprints — are byte-identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from triton_dist_tpu.ops.kv_stream import KVStreamConfig, WIRES
+from triton_dist_tpu.resilience import elastic, health
+from triton_dist_tpu.resilience.faults import PAYLOAD_KINDS
+from triton_dist_tpu.resilience.retry import RetryPolicy
+
+# pool names of the two-pool topology (FaultPlan.pool targets these)
+PREFILL_POOL = "prefill"
+DECODE_POOL = "decode"
+
+OUTCOMES = ("delivered", "fallback")
+
+
+@dataclasses.dataclass(frozen=True)
+class HandoffConfig:
+    """Policy of the KV handoff plane.
+
+    page_tokens:     manifest page granularity (= the paged pool's
+                     page_size when the prefill batcher is paged — one
+                     trie node per page).
+    chunks_per_page: chunk count per streamed page — the landing (and
+                     fault/retry) granularity; with the device wire this
+                     is ``KVStreamConfig.chunks_per_shard`` per page.
+    wire:            "int8" (payload + per-row scales at half the bytes,
+                     the a2a wire shape) or "native".
+    virtual_chunk_s: transfer time charged per streamed chunk on the
+                     engine clock (0 = instantaneous wire; the bench A/B
+                     sets it so transfer shows up in the phase spans).
+    chunk_timeout_s: time a bounded chunk wait burns before its timeout
+                     is declared (charged per timed-out attempt).
+    retry:           deterministic per-chunk re-send backoff (rung 1);
+                     ``max_attempts - 1`` re-sends per chunk.
+    max_restreams:   whole-sequence re-streams (rung 2) before the
+                     decode-local cold re-prefill fallback (rung 3).
+    """
+
+    page_tokens: int = 4
+    chunks_per_page: int = 1
+    wire: str = "int8"
+    virtual_chunk_s: float = 0.0
+    chunk_timeout_s: float = 0.0
+    retry: RetryPolicy = RetryPolicy(
+        max_attempts=3, base_delay_s=0.01, multiplier=2.0, max_delay_s=0.5,
+        jitter=0.0,
+    )
+    max_restreams: int = 1
+
+    def validate(self) -> "HandoffConfig":
+        if self.page_tokens < 1:
+            raise ValueError(
+                f"page_tokens must be >= 1, got {self.page_tokens}"
+            )
+        if self.chunks_per_page < 1:
+            raise ValueError(
+                f"chunks_per_page must be >= 1, got {self.chunks_per_page}"
+            )
+        if self.wire not in WIRES:
+            raise ValueError(f"wire must be one of {WIRES}, got {self.wire!r}")
+        if self.virtual_chunk_s < 0 or self.chunk_timeout_s < 0:
+            raise ValueError("virtual_chunk_s/chunk_timeout_s must be >= 0")
+        if self.max_restreams < 0:
+            raise ValueError(
+                f"max_restreams must be >= 0, got {self.max_restreams}"
+            )
+        self.retry.validate()
+        return self
+
+    def kv_stream_config(self) -> KVStreamConfig:
+        """The device-tier tune-space tuple this policy selects (the
+        kernel the static verifier proves — ops/kv_stream.py)."""
+        return KVStreamConfig(
+            chunks_per_shard=self.chunks_per_page, wire=self.wire
+        ).validate()
+
+
+@dataclasses.dataclass(frozen=True)
+class HandoffResult:
+    """One request's transfer verdict (every rung accounted)."""
+
+    uid: Any
+    outcome: str            # "delivered" | "fallback"
+    t_start: float
+    t_landed: float         # last-page-landed time (admission gate)
+    pages_total: int
+    pages_streamed: int
+    pages_deduped: int      # shared-prefix pages the manifest skipped
+    chunks_sent: int
+    retries: int
+    restreams: int
+    culprit_pe: int | None  # last attributed PE (None = clean transfer)
+
+
+class HandoffPlane:
+    """The pool-boundary transfer state: the decode side's streamed-page
+    manifest (the trie-shaped dedup), the guard ladder, and the
+    counters. One plane per two-pool topology; all time is the caller's
+    injectable clock (timestamps in, timestamps out — nothing here
+    sleeps or reads a wall clock)."""
+
+    family = "kv_handoff"
+
+    def __init__(
+        self,
+        config: HandoffConfig,
+        *,
+        s_max: int,
+        prefill_world: int,
+        decode_world: int,
+        prefill_pe_base: int = 0,
+        decode_pe_base: int | None = None,
+    ):
+        self.cfg = config.validate()
+        self.s_max = int(s_max)
+        self.prefill_world = int(prefill_world)
+        self.decode_world = int(decode_world)
+        self.prefill_pe_base = int(prefill_pe_base)
+        self.decode_pe_base = (
+            int(decode_pe_base) if decode_pe_base is not None
+            else self.prefill_pe_base + self.prefill_world
+        )
+        # decode-side manifest: page keys whose KV already landed — the
+        # radix-trie identity (full token prefix through the page), so
+        # shared prefixes stream once (ISSUE 12 × 13)
+        self._streamed: set[tuple] = set()
+        self.counters = {
+            k: 0 for k in (
+                "transfers", "delivered", "fallbacks", "restreams",
+                "chunk_retries", "canary_mismatches", "chunk_timeouts",
+                "pages_streamed", "pages_deduped", "chunks_sent",
+            )
+        }
+
+    # -- the manifest ----------------------------------------------------
+
+    def manifest(self, prompt) -> list[tuple[int, tuple]]:
+        """The sequence's page chain as ``(logical page g, trie key)``
+        pairs. A page's key is the FULL prefix through it (the radix
+        trie's node identity — two chains sharing page-g tokens but
+        diverging earlier are different pages), so dedup semantics match
+        ``models/prefix_cache.py`` exactly. The final partial page is
+        keyed by however many tokens it holds."""
+        prompt = tuple(int(t) for t in prompt)
+        pg = self.cfg.page_tokens
+        n_pages = -(-len(prompt) // pg)
+        return [
+            (g, prompt[: min((g + 1) * pg, len(prompt))])
+            for g in range(n_pages)
+        ]
+
+    # -- pool PE attribution --------------------------------------------
+
+    def _decode_owner(self, g: int) -> int:
+        """GLOBAL index of the decode-pool PE owning logical page ``g``
+        (the sequence-sharded paged pool layout: positions shard over the
+        pool's axis)."""
+        s_shard = max(1, self.s_max // self.decode_world)
+        local = min((g * self.cfg.page_tokens) // s_shard,
+                    self.decode_world - 1)
+        return self.decode_pe_base + local
+
+    def _prefill_owner(self, g: int) -> int:
+        """GLOBAL index of the prefill-pool PE that held (and streams)
+        logical page ``g``."""
+        s_shard = max(1, self.s_max // self.prefill_world)
+        local = min((g * self.cfg.page_tokens) // s_shard,
+                    self.prefill_world - 1)
+        return self.prefill_pe_base + local
+
+    # -- the fault seam --------------------------------------------------
+
+    def _consult_fault(self, ordinal: int, g: int):
+        """The host-tier chunk fault seam: an armed ``config.fault_plan``
+        may corrupt this chunk's landing (PAYLOAD kinds, decode side —
+        the canary catches it) or drop its signal (drop/delay kinds,
+        prefill side — the bounded wait expires). ``pool=`` scopes the
+        plan to one side of the handoff; ``site=`` is the chunk ordinal
+        within this transfer; ``pe=`` the culprit's GLOBAL index;
+        ``max_triggers`` bounds afflicted chunk attempts. Returns
+        ``("corrupt" | "timeout", culprit_pe)`` or None."""
+        from triton_dist_tpu import config as tdt_config
+        from triton_dist_tpu.resilience import faults
+
+        plan = tdt_config.get_config().fault_plan
+        if plan is None or faults.plan_spent(plan):
+            return None
+        if plan.family is not None and plan.family != self.family:
+            return None
+        if plan.site is not None and plan.site != ordinal:
+            return None
+        if plan.kind in PAYLOAD_KINDS:
+            if plan.pool not in (None, DECODE_POOL):
+                return None
+            pe = self._decode_owner(g)
+            if plan.pe >= 0 and plan.pe != pe:
+                return None
+            faults.note_launch()
+            return ("corrupt", pe)
+        if plan.kind in ("drop_signal", "delay_signal"):
+            if plan.pool not in (None, PREFILL_POOL):
+                return None
+            pe = self._prefill_owner(g)
+            if plan.pe >= 0 and plan.pe != pe:
+                return None
+            faults.note_launch()
+            return ("timeout", pe)
+        return None
+
+    # -- the ladder ------------------------------------------------------
+
+    def _stream_once(
+        self, uid: Any, pages: list, t: float, *, force_all: bool,
+    ) -> tuple[bool, float, int, int, int, int | None]:
+        """One streaming pass over the manifest. Returns ``(ok, t,
+        streamed, deduped, retries, culprit)`` — ``ok=False`` means some
+        chunk exhausted its in-place re-sends (the caller escalates)."""
+        cfg = self.cfg
+        delays = cfg.retry.delays(key=f"{self.family}:{uid}")
+        streamed = deduped = retries = 0
+        ordinal = 0
+        last_pe: int | None = None
+        for g, key in pages:
+            if not force_all and key in self._streamed:
+                deduped += 1
+                continue
+            for _ in range(cfg.chunks_per_page):
+                ordinal += 1
+                for attempt in range(cfg.retry.max_attempts):
+                    fault = self._consult_fault(ordinal - 1, g)
+                    self.counters["chunks_sent"] += 1
+                    if fault is None:
+                        t += cfg.virtual_chunk_s
+                        break
+                    what, pe = fault
+                    last_pe = pe
+                    if what == "corrupt":
+                        # the landed bytes fail the canary riding the
+                        # chunk signal: victim == culprit — the decode
+                        # PE's own landing is corrupt (ISSUE 8 model)
+                        self.counters["canary_mismatches"] += 1
+                        t += cfg.virtual_chunk_s
+                        reason = "payload canary mismatch on landing"
+                        elastic.report_corruption(pe, family=self.family)
+                    else:
+                        # the chunk's pure signal never arrived: the
+                        # bounded wait expires; the silent sender is the
+                        # culprit (by absence)
+                        self.counters["chunk_timeouts"] += 1
+                        t += cfg.chunk_timeout_s
+                        reason = "chunk signal bounded-wait timeout"
+                        elastic.report_timeout(pe, family=self.family)
+                    if attempt == cfg.retry.max_attempts - 1:
+                        return False, t, streamed, deduped, retries, pe
+                    self.counters["chunk_retries"] += 1
+                    retries += 1
+                    t += delays[attempt]
+                    health.record_handoff_retry(
+                        self.family, uid, ordinal - 1, pe, reason
+                    )
+                else:  # pragma: no cover — loop always breaks/returns
+                    raise AssertionError
+            streamed += 1
+            self._streamed.add(key)
+        # exhausted=False: a clean (or retry-absorbed) pass — the last
+        # attributed culprit still rides out for the result's record
+        return True, t, streamed, deduped, retries, last_pe
+
+    def transfer(self, uid: Any, prompt, *, now: float) -> HandoffResult:
+        """Stream one finished prefill's KV pages to the decode pool
+        through the full guard ladder (module docstring). Deterministic:
+        same manifest + same armed fault plan + same ``now`` ⇒ the same
+        result, timestamps included."""
+        pages = self.manifest(prompt)
+        self.counters["transfers"] += 1
+        chunks_before = self.counters["chunks_sent"]
+        t = float(now)
+        restreams = 0
+        tot_streamed = tot_deduped = tot_retries = 0
+        culprit: int | None = None
+        while True:
+            ok, t, streamed, deduped, retries, pe = self._stream_once(
+                uid, pages, t, force_all=restreams > 0,
+            )
+            tot_streamed += streamed
+            tot_deduped += deduped
+            tot_retries += retries
+            if pe is not None:
+                culprit = pe
+            if ok:
+                self.counters["delivered"] += 1
+                outcome = "delivered"
+                break
+            if restreams >= self.cfg.max_restreams:
+                # rung 3: the decode pool cold-re-prefills locally — the
+                # request is never lost, corrupt KV is never decoded
+                self.counters["fallbacks"] += 1
+                health.record_handoff_fallback(
+                    self.family, uid,
+                    f"{restreams} re-stream(s) exhausted; decode-local "
+                    f"cold re-prefill (culprit pe{culprit})",
+                )
+                outcome = "fallback"
+                break
+            # rung 2: whole-sequence re-stream — every page of THIS
+            # sequence re-sends (deduped ones included: the corruption
+            # could alias any of them), so invalidate its keys first
+            restreams += 1
+            self.counters["restreams"] += 1
+            self._streamed.difference_update(key for _, key in pages)
+            health.record_handoff_restream(
+                self.family, uid, culprit if culprit is not None else -1,
+                f"chunk re-sends exhausted; re-stream {restreams}/"
+                f"{self.cfg.max_restreams}",
+            )
+        self.counters["pages_streamed"] += tot_streamed
+        self.counters["pages_deduped"] += tot_deduped
+        return HandoffResult(
+            uid=uid, outcome=outcome, t_start=float(now), t_landed=t,
+            pages_total=len(pages), pages_streamed=tot_streamed,
+            pages_deduped=tot_deduped,
+            chunks_sent=self.counters["chunks_sent"] - chunks_before,
+            retries=tot_retries,
+            restreams=restreams, culprit_pe=culprit,
+        )
+
+    def invalidate(self) -> None:
+        """Drop the decode-side manifest (pool rebuild / topology
+        collapse: the pool's physical pages are gone, so nothing counts
+        as already-streamed anymore)."""
+        self._streamed.clear()
+
+    def snapshot(self) -> dict:
+        out = dict(sorted(self.counters.items()))
+        out["pages_resident"] = len(self._streamed)
+        out["wire"] = self.cfg.wire
+        return out
