@@ -86,6 +86,14 @@
 # corruption rebuild arcs over burst traffic (resilience/soak.py
 # SoakSpec.shared_prefix; the full set rides scripts/chaos_soak.py).
 #
+# Since ISSUE 14 the matrix also covers the SCHEDULE-SYNTHESIZER cells
+# (tests/test_synth.py): seeded emitter-bug mutations on SYNTHESIZED
+# span-policy schedules (window/interleave/torus2d) must be flagged by
+# slot/site while the clean twin stays silent — the static defect twins
+# of the synthesized families, held to the hand-written standard. The
+# full lint below re-proves the whole standing registry
+# (triton_dist_tpu/synth/admitted.py) at worlds {2, 4, 8} on every run.
+#
 # Every cell runs under a wall-clock budget (TDT_CELL_TIMEOUT_S,
 # default 600 s; conftest.py delivers it as a SIGALRM inside the cell):
 # a hung cell reports as one named FAILED row — and so fails the exit
@@ -110,14 +118,15 @@ files="tests/test_chaos.py tests/test_elastic.py \
     tests/test_chunked.py tests/test_chunked_a2a.py tests/test_ragged.py \
     tests/test_emitter.py tests/test_serving.py tests/test_integrity.py \
     tests/test_obs.py tests/test_analysis.py tests/test_overload.py \
-    tests/test_prefix_cache.py tests/test_disagg.py"
+    tests/test_prefix_cache.py tests/test_disagg.py tests/test_synth.py"
 marker="chaos"
 lint_args=""
 if [ "${1:-}" = "--quick" ]; then
     shift
     files="tests/test_integrity.py tests/test_serving.py \
         tests/test_elastic.py tests/test_overload.py \
-        tests/test_prefix_cache.py tests/test_disagg.py"
+        tests/test_prefix_cache.py tests/test_disagg.py \
+        tests/test_synth.py"
     marker="chaos and not slow"
     # keep the quick posture bounded: worlds {2,4} (the full {2,4,8}
     # sweep is the default standalone run's job)

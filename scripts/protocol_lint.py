@@ -60,6 +60,12 @@ def main(argv: list[str]) -> int:
     ap.add_argument("--verbose", action="store_true",
                     help="print every tuple's report line, not just "
                     "failures/warnings")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="write a machine-diffable cell census (one entry "
+                    "per (family, tuple, world) with verdict/stats/"
+                    "findings, sorted keys, no timestamps) — CI diffs two "
+                    "runs' artifacts to see exactly which cells a change "
+                    "added, removed, or flipped")
     args = ap.parse_args(argv)
 
     # the capture layer never launches a kernel, but jax still initializes
@@ -128,6 +134,46 @@ def main(argv: list[str]) -> int:
         print(f"  DEFECT-HARNESS FAIL: {failure}")
     for note in result.skipped:
         print(f"  note  {note}")
+
+    if args.json:
+        # deterministic census artifact (ISSUE 14 satellite): cells sorted
+        # by (family, label, world), sorted keys, no timestamps — two runs
+        # of the same tree produce byte-identical files
+        import json
+
+        census = {
+            "families": sorted(families or list(FAMILIES)),
+            "worlds": sorted(worlds),
+            "cells": [
+                {
+                    "family": r.family,
+                    "label": r.label,
+                    "world": r.world,
+                    "ok": r.ok,
+                    "errors": [str(f) for f in r.errors],
+                    "warnings": [str(f) for f in r.warnings],
+                    "stats": {k: r.stats[k] for k in sorted(r.stats)},
+                }
+                for r in sorted(
+                    result.reports,
+                    key=lambda r: (r.family, r.label, r.world),
+                )
+            ],
+            "defect_failures": list(result.defect_failures),
+            "notes": list(result.skipped),
+            "summary": {
+                "cells": len(result.reports),
+                "proved": len(result.reports) - len(bad),
+                "failing": len(bad),
+                "warnings": n_warn,
+            },
+        }
+        tmp = f"{args.json}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(census, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, args.json)
+        print(f"cell census written to {args.json}")
 
     if args.no_defects:
         defect_status = "skipped (--no-defects)"

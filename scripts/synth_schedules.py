@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Schedule synthesizer CLI: generate → prove → admit (ISSUE 14;
+docs/analysis.md "Generate → prove → tune").
+
+Drives the whole loop of ``triton_dist_tpu/synth`` and prints one
+deterministic report:
+
+1. **generate** — enumerate the declarative policy space
+   (``synth/policies.py``) over both fused-pipeline families with NAMED
+   validity pruning, plus the ``unbalanced-probe`` negative control
+   (``--no-probe`` to skip it);
+2. **prove**    — per candidate: span-schedule validity, the full PR 10
+   static protocol proof (credit balance, deadlock freedom, chunk-major
+   order, telemetry density, landing-view coverage) at worlds {2, 4, 8}
+   (``--quick`` = {2, 4}), and the seeded-defect harness on the
+   candidate's own capture;
+3. **admit**    — proved candidates registered into the family tune
+   spaces strictly after every existing candidate, with their
+   ``perf_model`` cost terms; unproved candidates REJECTED with the named
+   diagnosis.
+
+The report is BYTE-IDENTICAL across invocations (no timestamps, no
+host-dependent numbers — the cost terms use a fixed reference chip):
+``scripts/synth_schedules.py > a; scripts/synth_schedules.py > b;
+cmp a b``. Exit codes: 0 = every non-probe candidate proved AND the
+admissions match the standing registry (``synth/admitted.py``);
+1 = a non-probe candidate failed to prove, or a proved candidate is
+missing from the standing registry (run the loop, review, and commit the
+registry update); 2 = usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="synth_schedules.py",
+        description="generate -> prove -> admit over the overlap-kernel "
+        "emitter's span-policy space",
+    )
+    ap.add_argument("--families", default=None,
+                    help="comma-separated subset of "
+                    "{ag_group_gemm, moe_reduce_rs} (default: both)")
+    ap.add_argument("--quick", action="store_true",
+                    help="prove at worlds {2,4} only (the full run is "
+                    "{2,4,8} — the acceptance posture)")
+    ap.add_argument("--no-probe", action="store_true",
+                    help="skip the unbalanced-probe negative control")
+    ap.add_argument("--no-defects", action="store_true",
+                    help="skip the per-candidate seeded-defect harness")
+    ap.add_argument("--verbose", action="store_true",
+                    help="print per-world progress while proving")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import jax  # noqa: F401
+
+    from triton_dist_tpu.synth import admit as A
+    from triton_dist_tpu.synth import generate as G
+    from triton_dist_tpu.synth import prove as PR
+    from triton_dist_tpu.synth.admitted import SYNTH_ADMITTED
+
+    families = None
+    if args.families:
+        families = [f.strip() for f in args.families.split(",") if f.strip()]
+        known = ("ag_group_gemm", "moe_reduce_rs")
+        unknown = [f for f in families if f not in known]
+        if unknown:
+            print(f"synth_schedules: unknown families {unknown}; "
+                  f"known: {list(known)}", file=sys.stderr)
+            return 2
+
+    worlds = (2, 4) if args.quick else (2, 4, 8)
+    progress = (lambda s: print(f"  .. {s}", flush=True)) if args.verbose \
+        else None
+
+    print(f"== schedule synthesis: families="
+          f"{families or ['ag_group_gemm', 'moe_reduce_rs']} "
+          f"worlds={list(worlds)} ==")
+
+    print("\n-- generate (synth/generate.py) --")
+    cands, pruned = G.generate_candidates(
+        families, include_probe=not args.no_probe,
+    )
+    for c in cands:
+        print(f"  candidate {c.family}[{c.label}]")
+    for p in pruned:
+        print(f"  pruned    {p.family}/{p.policy}"
+              f"{'' if p.chunks is None else f'/c{p.chunks}'}"
+              f" — {p.reason}")
+
+    print("\n-- prove (synth/prove.py) --")
+    proofs = PR.prove_all(
+        cands, worlds, defects=not args.no_defects, progress=progress,
+    )
+    for p in proofs:
+        c = p.candidate
+        if p.ok:
+            cells = len(p.reports)
+            print(f"  proved    {c.family}[{c.label}]: {cells} world cells "
+                  f"OK, {p.warnings} warnings, "
+                  f"{p.defects_run} seeded defects flagged")
+        else:
+            print(f"  UNPROVED  {c.family}[{c.label}]: {p.diagnosis}")
+
+    print("\n-- admit (synth/admit.py) --")
+    report = A.admit(proofs)
+    for a in report.admissions:
+        print(f"  {a.line()}")
+
+    n_probe_rejected = sum(
+        1 for a in report.rejected
+        if a.candidate.policy == "unbalanced-probe"
+    )
+    real_rejected = [
+        a for a in report.rejected
+        if a.candidate.policy != "unbalanced-probe"
+    ]
+    new = [a for a in report.admitted if not a.standing]
+    print(
+        f"\nsynthesis: {len(cands)} candidates, {len(pruned)} pruned, "
+        f"{len(report.admitted)} admitted "
+        f"({len(report.admitted) - len(new)} standing, {len(new)} new), "
+        f"{n_probe_rejected} probe rejections (expected), "
+        f"{len(real_rejected)} real rejections; "
+        f"standing registry holds {len(SYNTH_ADMITTED)} entries"
+    )
+    if real_rejected:
+        print("synthesis: FAIL — a real candidate did not prove")
+        return 1
+    if new:
+        print(
+            "synthesis: NEW proved schedules are not in the standing "
+            "registry (triton_dist_tpu/synth/admitted.py) — review the "
+            "proofs above and commit the registry entries so the tune "
+            "spaces and protocol lint carry them permanently"
+        )
+        return 1
+    print("synthesis: PASS — every candidate proved and standing")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

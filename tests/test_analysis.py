@@ -431,5 +431,61 @@ def test_protocol_lint_cli_quick_subset(capsys):
     assert mod.main(["--families", "nosuch"]) == 2
 
 
+# ---------------------------------------------------------------------------
+# Synthesized schedules in the sweep (ISSUE 14): the standing registry is
+# enumerated STRUCTURALLY — the tune-space constants include it — so
+# protocol_lint proves every admitted schedule permanently. The prove
+# stage itself (three gates, probe rejection) is tests/test_synth.py.
+# ---------------------------------------------------------------------------
+
+def test_sweep_enumerates_admitted_synth_tuples():
+    """Every standing registry entry surfaces as its own labeled tuple in
+    the family sweep — a synthesized schedule cannot silently drop out of
+    the lint's coverage."""
+    from triton_dist_tpu.analysis.sweep import _gg_label
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+    from triton_dist_tpu.synth.admitted import SYNTH_ADMITTED
+
+    assert len(SYNTH_ADMITTED) >= 4  # >= 2 genuinely new families, both sides
+    for family in ("ag_group_gemm", "moe_reduce_rs"):
+        labels = dict(S.family_tuples(family, 4))
+        for fam, kw in SYNTH_ADMITTED:
+            if fam != family:
+                continue
+            cfg = GroupGemmConfig(**kw)
+            label = _gg_label(cfg)
+            assert cfg.span_policy in label  # distinct from the contig twin
+            assert labels.get(label) == cfg
+
+
+@pytest.mark.parametrize("family,label", [
+    ("ag_group_gemm", "bm128/bn1024/c2/window"),
+    ("ag_group_gemm", "bm128/bn1024/c1/torus2d"),
+    ("moe_reduce_rs", "bm128/bn1024/c4/interleave"),
+])
+def test_synth_tuples_prove_at_world8(family, label):
+    """The widest acceptance world for a sample of admitted schedules:
+    credit-balanced, deadlock-free, zero warnings (telemetry density and
+    landing views included — the 0-warning posture the lint gates)."""
+    rep = verify_capture(_cap(family, 8, label))
+    assert rep.ok, rep.summary()
+    assert not rep.warnings, rep.summary()
+
+
+@pytest.mark.chaos
+def test_synth_window_defect_twin_flagged():
+    """The static defect twin on a SYNTHESIZED AG schedule: a dropped
+    chunk signal is flagged by slot/site, the clean twin stays silent
+    (the moe_rs twin lives in tests/test_synth.py)."""
+    cap = _cap("ag_group_gemm", 2, "bm128/bn1024/c4/window")
+    assert verify_capture(cap).ok
+    seeded = D.seed_defect(cap, "dropped_signal")
+    rep = verify_capture(seeded.capture)
+    hits = [f for f in rep.errors if f.check == seeded.expect_check]
+    assert hits and any(seeded.expect_naming in f.message for f in hits), (
+        rep.summary()
+    )
+
+
 if __name__ == "__main__":
     raise SystemExit(pytest.main([__file__, "-x", "-q"]))

@@ -447,3 +447,174 @@ def test_paged_verify_nondivisor_pages_per_step(fuse_heads):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
     )
+
+
+# ---------------------------------------------------------------------------
+# Logit soft-cap + non-pow-2 head dims (ISSUE 14 satellite; VERDICT
+# missing #1 — the reference's soft_cap / BLOCK_DPE machinery,
+# flash_decode.py:103-107,155-190). CPU goldens: every entry is pinned
+# against a local tanh-capped reference; the kernel-level math
+# (_online_softmax_step) is exercised directly as plain jnp, so the
+# padding/capping algebra is covered even where the Pallas build is
+# unavailable. Chip measurement stays deferred (ROADMAP item 1).
+# ---------------------------------------------------------------------------
+
+def _ref_decode_capped(q, k, v, kv_lens, soft_cap=0.0):
+    """Masked-attention golden with the reference's logit soft-cap:
+    ``s = cap * tanh(s / cap)`` on the scaled scores, before masking."""
+    b, hq, d = q.shape
+    _, h_kv, s_len, _ = k.shape
+    g = hq // h_kv
+    q4 = q.reshape(b, h_kv, g, d).astype(jnp.float32)
+    scores = jnp.einsum("bhgd,bhsd->bhgs", q4, k.astype(jnp.float32))
+    scores /= jnp.sqrt(jnp.float32(d))
+    if soft_cap:
+        scores = soft_cap * jnp.tanh(scores / soft_cap)
+    mask = jnp.arange(s_len)[None, :] < kv_lens[:, None]
+    scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgs,bhsd->bhgd", p, v.astype(jnp.float32))
+    return out.reshape(b, hq, d)
+
+
+def test_kernel_head_dim_padding_table():
+    """Power-of-2 dims pass through (today's shapes, bit-unchanged);
+    non-pow-2 dims round up to the next power of two."""
+    from triton_dist_tpu.ops.flash_decode import _kernel_head_dim
+
+    assert _kernel_head_dim(64) == 64
+    assert _kernel_head_dim(128) == 128
+    assert _kernel_head_dim(256) == 256
+    assert _kernel_head_dim(80) == 128
+    assert _kernel_head_dim(96) == 128
+    assert _kernel_head_dim(192) == 256
+    with pytest.raises(ValueError):
+        _kernel_head_dim(0)
+
+
+@pytest.mark.parametrize("soft_cap", [0.0, 20.0])
+def test_online_softmax_step_padding_exact(soft_cap):
+    """The kernel step function (plain jnp — runnable on any box) must be
+    EXACT under head-dim zero-padding: padded q·k terms add 0 to every
+    score and padded v columns emit 0 output columns. This is the
+    algebraic fact the host-level pad-and-slice relies on."""
+    from triton_dist_tpu.ops.flash_decode import (
+        _finalize_softmax, _kernel_head_dim, _online_softmax_step,
+        _pad_head_dim,
+    )
+
+    g, sc, d = 4, 64, 96
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (g, d), jnp.float32)
+    k = jax.random.normal(kk, (sc, d), jnp.float32)
+    v = jax.random.normal(kv_, (sc, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    def run(qx, kx, vx, dd):
+        m0 = jnp.full((g, 1), -jnp.inf)
+        l0 = jnp.zeros((g, 1))
+        a0 = jnp.zeros((g, dd))
+        m, l, a = _online_softmax_step(
+            qx, kx, vx, None, None, 0, jnp.int32(50), scale, m0, l0, a0,
+            soft_cap,
+        )
+        return _finalize_softmax(m, l, a)
+
+    out_ref, lse_ref = run(q, k, v, d)
+    dp = _kernel_head_dim(d)
+    assert dp == 128
+    out_pad, lse_pad = run(
+        _pad_head_dim(q, dp), _pad_head_dim(k, dp), _pad_head_dim(v, dp), dp
+    )
+    np.testing.assert_array_equal(np.asarray(out_pad[:, :d]), np.asarray(out_ref))
+    np.testing.assert_array_equal(np.asarray(out_pad[:, d:]), 0.0)
+    np.testing.assert_array_equal(np.asarray(lse_pad), np.asarray(lse_ref))
+
+
+@pytest.mark.parametrize("block_s", [0, 64])
+def test_flash_decode_soft_cap(block_s):
+    """soft_cap on the decode entry (XLA-native and kernel/golden paths)
+    vs the tanh-capped reference; cap=0 stays bit-identical to the
+    pre-knob result."""
+    b, h_kv, g, s, d = 2, 2, 2, 256, 128
+    q, k, v, kv_lens = _rand_case(jax.random.PRNGKey(11), b, h_kv * g, h_kv, s, d)
+    got = flash_decode(
+        q, k, v, kv_lens, config=FlashDecodeConfig(block_s=block_s, soft_cap=20.0)
+    )
+    want = _ref_decode_capped(q, k, v, kv_lens, soft_cap=20.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+    # the capped result must actually differ from the uncapped one
+    uncapped = flash_decode(q, k, v, kv_lens, config=FlashDecodeConfig(block_s=block_s))
+    assert not np.allclose(np.asarray(got), np.asarray(uncapped))
+    # soft_cap=0.0 is the identity posture — bit-identical to the default
+    zero = flash_decode(
+        q, k, v, kv_lens, config=FlashDecodeConfig(block_s=block_s, soft_cap=0.0)
+    )
+    np.testing.assert_array_equal(np.asarray(zero), np.asarray(uncapped))
+
+
+def test_flash_verify_soft_cap_and_nonpow2():
+    """The verify family: per-row prefix lengths × soft-cap × a d=96
+    head dim, against the capped per-row reference."""
+    from triton_dist_tpu.ops.flash_decode import flash_verify
+
+    b, S, h_kv, g, s, d = 2, 3, 2, 2, 128, 96
+    hq = h_kv * g
+    q = jax.random.normal(jax.random.PRNGKey(21), (b, S, hq, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(22), (b, h_kv, s, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(23), (b, h_kv, s, d), jnp.float32)
+    pos0 = jnp.array([s - S, 40], jnp.int32)
+    lens = pos0[:, None] + jnp.arange(1, S + 1)[None, :]
+    got = flash_verify(
+        q, k, v, lens, config=FlashDecodeConfig(block_s=32, soft_cap=15.0)
+    )
+    # per-row golden: one capped decode per draft position
+    for i in range(S):
+        want = _ref_decode_capped(q[:, i], k, v, lens[:, i], soft_cap=15.0)
+        np.testing.assert_allclose(
+            np.asarray(got[:, i]), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_flash_decode_nonpow2_head_dim():
+    """d=96 (the reference's BLOCK_DPE case) through the decode entry —
+    XLA path natively, kernel path via pad-and-slice — and through the
+    SP merge (lse packing is d-agnostic)."""
+    b, h_kv, g, s, d = 2, 2, 2, 256, 96
+    q, k, v, kv_lens = _rand_case(jax.random.PRNGKey(31), b, h_kv * g, h_kv, s, d)
+    want = _ref_decode_capped(q, k, v, kv_lens)
+    for block_s in (0, 64):
+        got = flash_decode(
+            q, k, v, kv_lens, config=FlashDecodeConfig(block_s=block_s)
+        )
+        assert got.shape == (b, h_kv * g, d)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+    # SP merge over shards keeps the exact-combine invariant at d=96
+    n, s_loc = 4, s // 4
+    outs, lses = [], []
+    for i in range(n):
+        sl = slice(i * s_loc, (i + 1) * s_loc)
+        o, l = flash_decode(
+            q, k[:, :, sl], v[:, :, sl],
+            jnp.clip(kv_lens - i * s_loc, 0, s_loc),
+            config=FlashDecodeConfig(block_s=32), return_lse=True,
+        )
+        outs.append(o)
+        lses.append(l)
+    got = combine_partials(jnp.stack(outs), jnp.stack(lses))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+def test_paged_decode_soft_cap_nonpow2():
+    """The paged entry takes soft_cap as a kwarg (its knobs are kwargs)
+    and pads page pools for non-pow-2 head dims; pinned against the
+    contiguous capped reference at d=96."""
+    b, h_kv, g, s, d, page = 2, 2, 2, 256, 96, 64
+    q, k, v, kv_lens = _rand_case(jax.random.PRNGKey(41), b, h_kv * g, h_kv, s, d)
+    kp, vp, bt = _paginate(k, v, page, key=jax.random.PRNGKey(42), n_extra_pages=2)
+    got = paged_flash_decode(q, kp, vp, kv_lens, bt, soft_cap=25.0)
+    want = _ref_decode_capped(q, k, v, kv_lens, soft_cap=25.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)

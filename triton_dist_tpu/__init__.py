@@ -22,6 +22,10 @@ Layer map (mirrors SURVEY.md §1 of the reference):
   serving/  —       SLO-metered elastic serving engine over the batcher
   obs/      —       observability: host span tracing + device wait
                     telemetry, exported as one chrome-trace timeline
+  analysis/ —       static signal-protocol verifier (trace-time proofs)
+  synth/    —       schedule synthesizer: generate → prove → tune over
+                    the overlap-kernel emitter (admitted schedules in
+                    synth/admitted.py)
   parallel/ —       mesh/bootstrap/topology (≙ reference utils.py bootstrap)
   autotuner —  L8, profiler/aot — aux subsystems
 """

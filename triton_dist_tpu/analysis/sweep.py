@@ -230,9 +230,13 @@ def _gg_cfgs(extra_space):
 
 
 def _gg_label(c):
+    pol = getattr(c, "span_policy", "contig")
     return (
         f"bm{c.block_m}/bn{c.block_n}/c{c.chunks_per_shard}"
         + ("/ragged" if c.ragged else "") + ("/w8" if c.w8 else "")
+        # synthesized span policies (ISSUE 14) are distinct tuples: the
+        # label must separate them from their contig twins
+        + (f"/{pol}" if pol != "contig" else "")
     )
 
 

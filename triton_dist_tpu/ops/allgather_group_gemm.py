@@ -62,6 +62,9 @@ from triton_dist_tpu.ops.moe_utils import (
     gather_sorted_rows,
     moe_align_block_size,
 )
+from triton_dist_tpu.synth.admitted import (
+    admitted_tune_extension as _admitted_tune_extension,
+)
 from triton_dist_tpu.utils import pick_block
 from triton_dist_tpu.utils import axis_size as _axis_size
 
@@ -172,15 +175,18 @@ def _ag_overlap_fused(
         + 2 * 2 * bm * bn * jnp.dtype(out_dtype).itemsize
         + 4 * 2**20
     )
-    from triton_dist_tpu.ops.common import chunk_schedule
+    from triton_dist_tpu.ops.common import resolve_spans
 
     # chunk-granular ring (ISSUE 4): spans quantized to the gather-group
     # size so every chunk holds whole groups; a single-span schedule
     # (incl. every chunks_per_shard=1 config) emits the legacy
-    # shard-granular protocol, bit for bit
-    spans = chunk_schedule(
+    # shard-granular protocol, bit for bit. span_policy (ISSUE 14)
+    # dispatches synthesized tilings — contiguous-ascending only here (the
+    # gather-group coverage below is derived from span offsets)
+    spans = resolve_spans(
         t_pad_loc, max(1, int(getattr(cfg, "chunks_per_shard", 1))),
-        quantum=bpg * bm,
+        bpg * bm, policy=getattr(cfg, "span_policy", "contig"), world=n,
+        side="ag",
     )
     kernel = make_ag_overlap_kernel(
         axis=axis, n=n, nb=nb, n_jn=n_jn, bn=bn, bpg=bpg, bm=bm,
@@ -312,6 +318,13 @@ def ag_group_gemm_overlap(
     if scale is not None:
         assert scale.shape == (b.shape[0], 1, b.shape[2]), (scale.shape, b.shape)
 
+    # span-policy fence BEFORE the guard ladder (ISSUE 14): a side-invalid
+    # or unknown policy is a config error that must fail loudly, not a
+    # kernel failure for guarded_call to downgrade to the golden path
+    from triton_dist_tpu.ops.common import validate_span_policy
+
+    validate_span_policy(getattr(cfg, "span_policy", "contig"), "ag")
+
     a_srt = presort_local_rows(a, ral, axis)
 
     if n == 1:
@@ -393,7 +406,12 @@ AG_GROUP_GEMM_TUNE_SPACE = (
     # RMS), so only a timed sweep may crown it
     GroupGemmConfig(128, 1024, 512, w8=True),
     GroupGemmConfig(128, 1024, 512, ragged=True, w8=True),
-)
+) + _admitted_tune_extension("ag_group_gemm")
+# ^ SYNTHESIZED schedules (ISSUE 14): the standing registry of proved
+# span policies (triton_dist_tpu/synth/admitted.py) appends STRICTLY
+# AFTER every legacy candidate — the no-regression ordering invariant
+# (docs/autotuner.md; pinned by tests/test_synth.py). analysis/sweep.py
+# enumerates this constant, so protocol_lint proves them permanently.
 
 ag_group_gemm_op = contextual_autotune(
     AG_GROUP_GEMM_TUNE_SPACE, name="ag_group_gemm"

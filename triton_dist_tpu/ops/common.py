@@ -315,6 +315,156 @@ def chunk_schedule(
     return tuple(spans)
 
 
+# ---------------------------------------------------------------------------
+# Span-policy schedules (ISSUE 14): alternative span tilings/orderings the
+# schedule synthesizer (triton_dist_tpu/synth/) enumerates and the static
+# verifier proves. The emitter kernels consume the resulting spans
+# UNCHANGED — a policy is purely a different (offset, rows) list. The math
+# lives here (next to chunk_schedule, the kernel side's only dependency);
+# the declarative policy space over it lives in synth/policies.py.
+# ---------------------------------------------------------------------------
+
+def span_window_schedule(
+    rows: int, chunks: int, quantum: int = 1
+) -> tuple[tuple[int, int], ...]:
+    """Arrival-window span tiling (the synthesized ``window`` policy, AG
+    side): contiguous ascending spans with geometrically GROWING sizes —
+    the first chunk is as small as the quantum allows, each later chunk
+    roughly doubles. The consumer's first wait (the exposed first-chunk
+    bubble of ``perf_model.estimate_fused_ring_bubble_ms``) then covers
+    only the smallest span's wire time, while the tail chunks keep DMA
+    descriptor count bounded. ``chunks=1`` (or too few quanta) degrades to
+    :func:`chunk_schedule`'s single span — the legacy protocol, bit for
+    bit (the synthesizer's identity pin)."""
+    if rows < 1:
+        raise ValueError(f"span_window_schedule: rows must be >= 1, got {rows}")
+    if chunks < 1:
+        raise ValueError(
+            f"span_window_schedule: chunks must be >= 1, got {chunks}"
+        )
+    quantum = max(1, min(int(quantum), rows))
+    units = rows // quantum
+    chunks = min(chunks, max(1, units))
+    if chunks == 1:
+        return chunk_schedule(rows, 1, quantum)
+    # doubling weights 1, 2, 4, ... scaled into the unit budget; every
+    # chunk keeps >= 1 unit, the LAST chunk absorbs the remainder (and the
+    # sub-quantum tail) so sizes stay ascending
+    weights = [1 << j for j in range(chunks)]
+    total_w = sum(weights)
+    sizes = [max(1, (units * w) // total_w) for w in weights[:-1]]
+    head = sum(sizes)
+    if head >= units:  # tiny unit budgets: fall back to near-equal spans
+        return chunk_schedule(rows, chunks, quantum)
+    sizes.append(units - head)
+    spans, off = [], 0
+    for j, sz_units in enumerate(sizes):
+        sz = sz_units * quantum
+        if j == chunks - 1:
+            sz += rows - units * quantum  # sub-quantum tail
+        spans.append((off, sz))
+        off += sz
+    return tuple(spans)
+
+
+def span_interleave_schedule(
+    rows: int, chunks: int, quantum: int = 1
+) -> tuple[tuple[int, int], ...]:
+    """Bidirectional chunk interleave (the synthesized ``interleave``
+    policy, MoE combine side): the near-equal contiguous tiling of
+    :func:`chunk_schedule` ISSUED alternately from both ends —
+    ``c0, c_{k-1}, c1, c_{k-2}, …`` — so the landed slab grows inward from
+    its first AND last rows. Per-chunk semaphore slots are positional
+    (``sig_at(j)``), so issue order is free to permute: every PE computes
+    the same permutation from the same static shapes and slot agreement
+    holds exactly as for the contiguous order. Valid ONLY where the
+    consumer drains chunks by slot index (the combine's
+    ``wait_recv_chunk(j)`` loop); the AG gather-group arithmetic requires
+    ascending contiguous coverage — :func:`resolve_spans` rejects the
+    pairing. ``chunks=1`` is the legacy single span, bit for bit."""
+    base = chunk_schedule(rows, chunks, quantum)
+    if len(base) <= 2:
+        return base
+    order, lo, hi = [], 0, len(base) - 1
+    while lo <= hi:
+        order.append(lo)
+        if hi != lo:
+            order.append(hi)
+        lo, hi = lo + 1, hi - 1
+    return tuple(base[i] for i in order)
+
+
+def span_torus2d_schedule(
+    rows: int, chunks: int, quantum: int = 1, world: int = 1
+) -> tuple[tuple[int, int], ...]:
+    """2-D torus-aware span tiling (the synthesized ``torus2d`` policy):
+    the chunk count adapts to the WORLD's most-square 2-D torus
+    factorization (``parallel.topology.torus_factor``) — ``chunks ×
+    inner_dim(world)`` contiguous near-equal spans, so each ring step
+    forwards one span per inner-axis hop of the physical torus and the
+    store-and-forward chain pipelines at the inner-ring granularity. On a
+    world whose factorization is a line (inner dim 1 — e.g. world 2) the
+    schedule degrades to :func:`chunk_schedule` at the caller's chunk
+    count; with ``chunks=1`` there, that is the legacy single span — the
+    identity pin."""
+    from triton_dist_tpu.parallel.topology import torus_factor
+
+    _, inner = torus_factor(max(1, int(world)))
+    return chunk_schedule(rows, max(1, int(chunks)) * inner, quantum)
+
+
+# Registry the overlap host entries dispatch on (GroupGemmConfig
+# .span_policy). "contig" is the legacy schedule — the identity the
+# emitter pin tests compare against. Each entry: (schedule_fn,
+# needs_world, contiguous_ascending).
+SPAN_POLICIES = {
+    "contig": (chunk_schedule, False, True),
+    "window": (span_window_schedule, False, True),
+    "interleave": (span_interleave_schedule, False, False),
+    "torus2d": (span_torus2d_schedule, True, True),
+}
+
+
+def validate_span_policy(policy: str, side: str) -> None:
+    """The span-policy config fence: unknown names and side-invalid
+    pairings raise with a named diagnosis. The overlap HOST entries call
+    this BEFORE their ``guarded_call`` ladder — a policy misconfiguration
+    is a config error that must fail loudly, not a kernel failure the
+    guard may silently downgrade to the golden path."""
+    try:
+        _, _, ascending = SPAN_POLICIES[policy]
+    except KeyError:
+        raise ValueError(
+            f"unknown span_policy {policy!r}; known: {sorted(SPAN_POLICIES)}"
+        ) from None
+    if side == "ag" and not ascending:
+        raise ValueError(
+            f"span_policy {policy!r} emits non-contiguous span order, which "
+            f"the AG gather-group schedule cannot consume (its group "
+            f"coverage is derived from ascending span offsets); valid "
+            f"sides: moe_rs"
+        )
+
+
+def resolve_spans(
+    rows: int, chunks: int, quantum: int, *, policy: str = "contig",
+    world: int = 1, side: str = "moe_rs",
+) -> tuple[tuple[int, int], ...]:
+    """The span schedule for one overlap launch: dispatch
+    ``GroupGemmConfig.span_policy`` to its schedule function.
+    ``side="ag"`` (the AG-GroupGEMM ring) requires ascending contiguous
+    spans — its gather-group arithmetic derives each span's compute
+    coverage from the span offsets, and the last LIST entry absorbs the
+    group tail — so order-permuting policies are rejected with a named
+    diagnosis (the same validity rule ``synth/generate.py`` prunes on).
+    ``policy="contig"`` is byte-for-byte :func:`chunk_schedule`."""
+    validate_span_policy(policy, side)
+    fn, needs_world, _ = SPAN_POLICIES[policy]
+    if needs_world:
+        return fn(rows, chunks, quantum, world)
+    return fn(rows, chunks, quantum)
+
+
 def gemm_add_pipeline(
     bm: int, bn: int, bk: int, m_dim: int, n_dim: int, k_dim: int,
     acc_ref, out_dtype, n_adds: int = 0,

@@ -143,15 +143,51 @@ class FlashDecodeConfig:
     per-step work is tiny (the GQA matmuls pad their handful of q rows up
     to the MXU's 128), so the h_kv-fold reduction in grid steps — fewer
     fixed per-step costs, h_kv-fold larger DMA transfers — is what moves
-    a kernel sitting below the HBM wall toward it."""
+    a kernel sitting below the HBM wall toward it.
+
+    ``soft_cap`` (> 0) applies the logit soft-cap of the reference's
+    split-KV kernel (flash_decode.py:103-107; Gemma-2-family models):
+    ``s = soft_cap * tanh(s / soft_cap)`` on the SCALED scores before
+    masking, identically on every path (Pallas per-head / fused-heads /
+    paged / int8 and the XLA goldens, decode AND verify) so the SP merge
+    and the golden fallbacks stay exact twins. 0.0 (default) = disabled —
+    bit-identical to the pre-knob kernels."""
 
     block_s: int = 2048  # KV chunk per online-softmax step; 0 = XLA-native
     fuse_heads: bool = False  # kv-head loop inside the kernel body
+    soft_cap: float = 0.0  # logit soft-cap; 0 = off
+
+
+def _kernel_head_dim(d: int) -> int:
+    """The head dim the Pallas kernels run at. Power-of-2 dims pass
+    through unchanged (today's shapes); a NON-power-of-2 head dim — the
+    reference handles these with a BLOCK_DMODEL + BLOCK_DPE tail split
+    (flash_decode.py:155-190) — is zero-padded up to the next power of
+    two at the host boundary and the output sliced back. Zero d-columns
+    are exact: padded q·k terms add 0 to every score and padded v columns
+    produce 0 output columns that the slice discards, so (out, lse) are
+    bit-identical to the unpadded math. ``scale`` always uses the TRUE
+    head dim. The XLA-native goldens take any d natively — they are the
+    CPU reference the padded kernels are pinned against."""
+    if d < 1:
+        raise ValueError(f"head dim must be >= 1, got {d}")
+    p = 1
+    while p < d:
+        p <<= 1
+    return p
+
+
+def _pad_head_dim(x, d_pad: int):
+    """Zero-pad the trailing (head) dim of ``x`` up to ``d_pad``."""
+    d = x.shape[-1]
+    if d == d_pad:
+        return x
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, d_pad - d)])
 
 
 def _online_softmax_step(
     q, k_b, v_b, ks_row, vs_row, chunk_start, kv_len, scale,
-    m_prev, l_prev, acc_prev,
+    m_prev, l_prev, acc_prev, soft_cap=0.0,
 ):
     """One KV-chunk update of one head's online-softmax carry; the single
     source of the decode math for the per-head AND fused-heads kernels.
@@ -163,7 +199,11 @@ def _online_softmax_step(
     wall this kernel otherwise sits on. ``ks_row``/``vs_row`` are None on
     the plain path; when present (int8 cache) the K/V tiles upcast to bf16
     (riding under the halved DMA time) and the per-position row scales
-    fold into the scores / probabilities."""
+    fold into the scores / probabilities. ``soft_cap`` > 0 (a static
+    Python float — the branch resolves at trace time) squashes the scaled
+    scores through ``soft_cap * tanh(s / soft_cap)`` BEFORE the length
+    mask, after any int8 dequant scale — the reference's logit soft-cap,
+    in the one place all five kernel paths share."""
     if ks_row is not None:
         k_b = k_b.astype(jnp.bfloat16)
         v_b = v_b.astype(jnp.bfloat16)
@@ -171,6 +211,8 @@ def _online_softmax_step(
         q, k_b, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * (scale if ks_row is None else ks_row * scale)
+    if soft_cap:
+        s = soft_cap * jnp.tanh(s / soft_cap)
     span = chunk_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     s = jnp.where(span < kv_len, s, NEG_INF)
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -201,6 +243,7 @@ def _finalize_softmax(m, l, acc):
 def _flash_decode_body(
     kv_lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, out_ref, lse_ref,
     m_scr, l_scr, acc_scr, *, n_chunks: int, block_s: int, scale: float,
+    soft_cap: float = 0.0,
 ):
     """Per-head online-softmax decode body: grid (b, h_kv, chunk)."""
     b_i = pl.program_id(0)
@@ -221,6 +264,7 @@ def _flash_decode_body(
             None if ks_ref is None else ks_ref[0, 0],
             None if vs_ref is None else vs_ref[0, 0],
             c * block_s, kv_len, scale, m_scr[:], l_scr[:], acc_scr[:],
+            soft_cap,
         )
 
     @pl.when(c == n_chunks - 1)
@@ -244,6 +288,7 @@ def _fused_heads_core(
     c, gate_len, row_len, q_ref, k_ref, v_ref, ks_ref, vs_ref, out_ref,
     lse_ref, m_scr, l_scr, acc_scr,
     *, n_chunks: int, block_s: int, scale: float, h_kv: int,
+    soft_cap: float = 0.0,
 ):
     """Shared ``fuse_heads`` skeleton (decode AND verify): all kv heads of
     the chunk arrive in ONE K slab + ONE V slab, the head loop unrolls
@@ -264,7 +309,7 @@ def _fused_heads_core(
                 None if ks_ref is None else ks_ref[0, j],
                 None if vs_ref is None else vs_ref[0, j],
                 c * block_s, row_len, scale,
-                m_scr[j], l_scr[j], acc_scr[j],
+                m_scr[j], l_scr[j], acc_scr[j], soft_cap,
             )
 
     @pl.when(c == n_chunks - 1)
@@ -323,11 +368,14 @@ def flash_decode(
     )
 
 
-def _xla_decode(q, k, v, kv_lens, *, return_lse):
+def _xla_decode(q, k, v, kv_lens, *, return_lse, soft_cap=0.0):
     """XLA-native GQA decode (``FlashDecodeConfig(block_s=0)``): a masked
     softmax attention XLA fuses into one HBM-bound loop. f32 score/prob
     math matches the Pallas kernel's accumulation precision; the (out, lse)
-    contract is identical, so the SP combine consumes either path."""
+    contract is identical, so the SP combine consumes either path. Takes
+    any head dim natively (no tile padding) — the CPU golden for the
+    kernels' non-power-of-2 head-dim padding; ``soft_cap`` applies the
+    same pre-mask logit squash as :func:`_online_softmax_step`."""
     b, hq, d = q.shape
     _, h_kv, s_len, _ = k.shape
     g = hq // h_kv
@@ -335,6 +383,8 @@ def _xla_decode(q, k, v, kv_lens, *, return_lse):
     s = jnp.einsum(
         "bhgd,bhsd->bhgs", q4, k.astype(jnp.float32)
     ) / math.sqrt(d)
+    if soft_cap:
+        s = soft_cap * jnp.tanh(s / soft_cap)
     span = jnp.arange(s_len, dtype=jnp.int32)
     s = jnp.where(span[None, None, None, :] < kv_lens[:, None, None, None], s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
@@ -366,7 +416,8 @@ def _decode_call(q, k, v, scales, kv_lens, *, config, return_lse, interpret):
                 "cache; int8/paged caches need the Pallas kernel"
             )
         return _xla_decode(
-            q, k, v, kv_lens.astype(jnp.int32), return_lse=return_lse
+            q, k, v, kv_lens.astype(jnp.int32), return_lse=return_lse,
+            soft_cap=cfg.soft_cap,
         )
     return resilience.guarded_call(
         "flash_decode_quant" if scales is not None else "flash_decode",
@@ -376,7 +427,8 @@ def _decode_call(q, k, v, scales, kv_lens, *, config, return_lse, interpret):
         ),
         None if scales is not None else (
             lambda: _xla_decode(
-                q, k, v, kv_lens.astype(jnp.int32), return_lse=return_lse
+                q, k, v, kv_lens.astype(jnp.int32), return_lse=return_lse,
+                soft_cap=cfg.soft_cap,
             )
         ),
     )
@@ -389,7 +441,10 @@ def _decode_call_fused(q, k, v, scales, kv_lens, *, cfg, return_lse, interpret):
     g = hq // h_kv
     sc = pick_block(s_len, cfg.block_s)
     n_chunks = s_len // sc
-    scale = 1.0 / math.sqrt(d)
+    scale = 1.0 / math.sqrt(d)  # the TRUE head dim, before any padding
+    d_out, d = d, _kernel_head_dim(d)
+    if d != d_out:  # non-pow-2 head dim: zero-pad, slice the output back
+        q, k, v = (_pad_head_dim(x, d) for x in (q, k, v))
     # the kernel's matmuls run in the cache dtype (bf16 MXU fast path);
     # mixed-precision callers get their q silently matched to the cache —
     # int8 caches upcast in-kernel, so their q rides bf16
@@ -429,6 +484,7 @@ def _decode_call_fused(q, k, v, scales, kv_lens, *, cfg, return_lse, interpret):
         out, lse = dist_pallas_call(
             functools.partial(
                 kernel, n_chunks=n_chunks, block_s=sc, scale=scale, h_kv=h_kv,
+                soft_cap=cfg.soft_cap,
             ),
             name=name,
             grid=grid,
@@ -451,7 +507,7 @@ def _decode_call_fused(q, k, v, scales, kv_lens, *, cfg, return_lse, interpret):
             uses_barrier=False,
             interpret=interpret,
         )(*args)
-        out = out.reshape(b, hq, d)
+        out = out.reshape(b, hq, d)[..., :d_out]
         lse = lse.reshape(b, hq)
         return (out, lse) if return_lse else out
     grid = (b, h_kv, n_chunks)
@@ -468,7 +524,10 @@ def _decode_call_fused(q, k, v, scales, kv_lens, *, cfg, return_lse, interpret):
         scale_spec = pl.BlockSpec((1, 1, 1, sc), lambda i, j, c: (i, j, 0, c))
         in_specs += [scale_spec, scale_spec]
     out, lse = dist_pallas_call(
-        functools.partial(kernel, n_chunks=n_chunks, block_s=sc, scale=scale),
+        functools.partial(
+            kernel, n_chunks=n_chunks, block_s=sc, scale=scale,
+            soft_cap=cfg.soft_cap,
+        ),
         name=name,
         grid=grid,
         out_shape=(
@@ -492,7 +551,7 @@ def _decode_call_fused(q, k, v, scales, kv_lens, *, cfg, return_lse, interpret):
         uses_barrier=False,
         interpret=interpret,
     )(*args)
-    out = out.reshape(b, hq, d)
+    out = out.reshape(b, hq, d)[..., :d_out]
     lse = lse.reshape(b, hq)
     return (out, lse) if return_lse else out
 
@@ -501,6 +560,7 @@ def _decode_call_fused(q, k, v, scales, kv_lens, *, cfg, return_lse, interpret):
 def _flash_verify_body(
     max_lens_ref, lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
     m_scr, l_scr, acc_scr, *, n_chunks: int, block_s: int, scale: float,
+    soft_cap: float = 0.0,
 ):
     """Multi-position (speculative-verify) decode body: grid
     (b, h_kv, chunk) exactly like :func:`_flash_decode_body`, but the q
@@ -524,7 +584,7 @@ def _flash_verify_body(
         m_scr[:], l_scr[:], acc_scr[:] = _online_softmax_step(
             q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], None, None,
             c * block_s, lens_ref[0, 0], scale,
-            m_scr[:], l_scr[:], acc_scr[:],
+            m_scr[:], l_scr[:], acc_scr[:], soft_cap,
         )
 
     @pl.when(c == n_chunks - 1)
@@ -534,9 +594,10 @@ def _flash_verify_body(
         )
 
 
-def _xla_verify(q, k, v, kv_lens, *, return_lse):
+def _xla_verify(q, k, v, kv_lens, *, return_lse, soft_cap=0.0):
     """XLA-native multi-position decode (block_s=0 sentinel + golden):
-    per-(sequence, position) prefix masks over one einsum."""
+    per-(sequence, position) prefix masks over one einsum. Any head dim,
+    same ``soft_cap`` contract as :func:`_xla_decode`."""
     b, S, hq, d = q.shape
     _, h_kv, s_len, _ = k.shape
     g = hq // h_kv
@@ -544,6 +605,8 @@ def _xla_verify(q, k, v, kv_lens, *, return_lse):
     s = jnp.einsum(
         "bshgd,bhtd->bshgt", q5, k.astype(jnp.float32)
     ) / math.sqrt(d)
+    if soft_cap:
+        s = soft_cap * jnp.tanh(s / soft_cap)
     span = jnp.arange(s_len, dtype=jnp.int32)
     mask = span[None, None, :] < kv_lens[:, :, None]       # [b, S, t]
     s = jnp.where(mask[:, :, None, None, :], s, NEG_INF)
@@ -587,14 +650,18 @@ def flash_verify(
     assert q.shape[2] % k.shape[1] == 0, (q.shape, k.shape)
     kv_lens = kv_lens.astype(jnp.int32)
     if cfg.block_s == 0:
-        return _xla_verify(q, k, v, kv_lens, return_lse=return_lse)
+        return _xla_verify(
+            q, k, v, kv_lens, return_lse=return_lse, soft_cap=cfg.soft_cap
+        )
     return resilience.guarded_call(
         "flash_verify",
         lambda: _flash_verify_fused(
             q, k, v, kv_lens, cfg=cfg, return_lse=return_lse,
             interpret=interpret,
         ),
-        lambda: _xla_verify(q, k, v, kv_lens, return_lse=return_lse),
+        lambda: _xla_verify(
+            q, k, v, kv_lens, return_lse=return_lse, soft_cap=cfg.soft_cap
+        ),
     )
 
 
@@ -605,6 +672,10 @@ def _flash_verify_fused(q, k, v, kv_lens, *, cfg, return_lse, interpret):
     sc = pick_block(s_len, cfg.block_s)
     n_chunks = s_len // sc
     rows = S * g
+    scale = 1.0 / math.sqrt(d)  # the TRUE head dim, before any padding
+    d_out, d = d, _kernel_head_dim(d)
+    if d != d_out:
+        q, k, v = (_pad_head_dim(x, d) for x in (q, k, v))
     q5 = (
         q.reshape(b, S, h_kv, g, d)
         .swapaxes(1, 2)
@@ -622,7 +693,7 @@ def _flash_verify_fused(q, k, v, kv_lens, *, cfg, return_lse, interpret):
     out, lse = dist_pallas_call(
         functools.partial(
             _flash_verify_body, n_chunks=n_chunks, block_s=sc,
-            scale=1.0 / math.sqrt(d),
+            scale=scale, soft_cap=cfg.soft_cap,
         ),
         name="flash_verify",
         grid=(b, h_kv, n_chunks),
@@ -651,7 +722,10 @@ def _flash_verify_fused(q, k, v, kv_lens, *, cfg, return_lse, interpret):
         uses_barrier=False,
         interpret=interpret,
     )(max_lens, lens_rows, q5, k, v)
-    out = out.reshape(b, h_kv, S, g, d).swapaxes(1, 2).reshape(b, S, hq, d)
+    out = (
+        out.reshape(b, h_kv, S, g, d).swapaxes(1, 2)
+        .reshape(b, S, hq, d)[..., :d_out]
+    )
     lse = lse.reshape(b, h_kv, S, g).swapaxes(1, 2).reshape(b, S, hq)
     return (out, lse) if return_lse else out
 
@@ -696,30 +770,30 @@ def _paged_to_contiguous(pages, block_table):
 
 
 def _xla_paged_decode(q, k_pages, v_pages, kv_lens, block_table, *,
-                      return_lse=False):
+                      return_lse=False, soft_cap=0.0):
     """Golden slow path for the paged decode: block-table gather to a
     contiguous cache + the XLA-native masked attention."""
     return _xla_decode(
         q, _paged_to_contiguous(k_pages, block_table),
         _paged_to_contiguous(v_pages, block_table),
-        kv_lens, return_lse=return_lse,
+        kv_lens, return_lse=return_lse, soft_cap=soft_cap,
     )
 
 
 def _xla_paged_verify(q, k_pages, v_pages, kv_lens, block_table, *,
-                      return_lse=False):
+                      return_lse=False, soft_cap=0.0):
     """Golden slow path for the paged multi-position verify."""
     return _xla_verify(
         q, _paged_to_contiguous(k_pages, block_table),
         _paged_to_contiguous(v_pages, block_table),
-        kv_lens, return_lse=return_lse,
+        kv_lens, return_lse=return_lse, soft_cap=soft_cap,
     )
 
 
 def _paged_flash_verify_kernel(
     max_lens_ref, bt_ref, lens_ref, q_ref, *rest,
     n_steps: int, pages_per_step: int, page_size: int, scale: float,
-    h_kv: int, chunk_dim: int,
+    h_kv: int, chunk_dim: int, soft_cap: float = 0.0,
 ):
     """Paged verify over ``pages_per_step`` pages concatenated into one
     [rows, P·page] span per step (same r5 chip finding as
@@ -753,7 +827,7 @@ def _paged_flash_verify_kernel(
             m_scr[j], l_scr[j], acc_scr[j] = _online_softmax_step(
                 q_ref[0, j], k_cat, v_cat, None, None,
                 c * P * page_size, lens_ref[0, 0], scale,
-                m_scr[j], l_scr[j], acc_scr[j],
+                m_scr[j], l_scr[j], acc_scr[j], soft_cap,
             )
 
     @pl.when(c == n_steps - 1)
@@ -772,6 +846,7 @@ def paged_flash_verify(
     *,
     fuse_heads: bool | None = None,
     pages_per_step: int | None = None,
+    soft_cap: float = 0.0,
     return_lse: bool = False,
     interpret: Any = None,
 ):
@@ -782,7 +857,9 @@ def paged_flash_verify(
     already written into their pages). ``fuse_heads`` /
     ``pages_per_step`` (None = the same span-driven auto as
     :func:`paged_flash_decode`, with the verify rows' larger
-    q/out/accumulator residents counted against the VMEM budget).
+    q/out/accumulator residents counted against the VMEM budget);
+    ``soft_cap`` as in :class:`FlashDecodeConfig` (the paged entries take
+    it directly — their knobs are kwargs, not a config).
     Degrades to the gather-reconstructed :func:`_xla_paged_verify` golden
     when the Pallas kernel cannot run in this environment (resilience
     layer, docs/resilience.md)."""
@@ -793,23 +870,30 @@ def paged_flash_verify(
         lambda: _paged_flash_verify_fused(
             q, k_pages, v_pages, kv_lens, block_table,
             fuse_heads=fuse_heads, pages_per_step=pages_per_step,
-            return_lse=return_lse, interpret=interpret,
+            soft_cap=soft_cap, return_lse=return_lse, interpret=interpret,
         ),
         lambda: _xla_paged_verify(
-            q, k_pages, v_pages, kv_lens, block_table, return_lse=return_lse
+            q, k_pages, v_pages, kv_lens, block_table,
+            return_lse=return_lse, soft_cap=soft_cap,
         ),
     )
 
 
 def _paged_flash_verify_fused(
     q, k_pages, v_pages, kv_lens, block_table, *,
-    fuse_heads, pages_per_step, return_lse, interpret,
+    fuse_heads, pages_per_step, soft_cap, return_lse, interpret,
 ):
     b, S, hq, d = q.shape
     n_pages, h_kv, page_size, _ = k_pages.shape
     g = hq // h_kv
     rows = S * g
     max_pages = block_table.shape[1]
+    scale = 1.0 / math.sqrt(d)  # the TRUE head dim, before any padding
+    d_out, d = d, _kernel_head_dim(d)
+    if d != d_out:  # pad the q and the page pools; slice the output back
+        q, k_pages, v_pages = (
+            _pad_head_dim(x, d) for x in (q, k_pages, v_pages)
+        )
     # per-head-grid resident bytes (q block in the cache dtype, f32
     # out/lse blocks, f32 m/l/acc scratches); the fused grid holds h_kv×
     slab_h = page_size * d * k_pages.dtype.itemsize
@@ -893,7 +977,7 @@ def _paged_flash_verify_fused(
             functools.partial(
                 _paged_flash_verify_kernel,
                 n_steps=n_steps, pages_per_step=P, page_size=page_size,
-                scale=1.0 / math.sqrt(d), h_kv=h_kv, chunk_dim=1,
+                scale=scale, h_kv=h_kv, chunk_dim=1, soft_cap=soft_cap,
             ),
             name="paged_flash_verify_fh",
             grid_spec=grid_spec,
@@ -909,7 +993,10 @@ def _paged_flash_verify_fused(
             max_lens, block_table.astype(jnp.int32), lens_rows, q5,
             *(kv for _ in range(P) for kv in (k_pages, v_pages)),
         )
-        out = out.reshape(b, h_kv, S, g, d).swapaxes(1, 2).reshape(b, S, hq, d)
+        out = (
+            out.reshape(b, h_kv, S, g, d).swapaxes(1, 2)
+            .reshape(b, S, hq, d)[..., :d_out]
+        )
         lse = lse.reshape(b, h_kv, S, g).swapaxes(1, 2).reshape(b, S, hq)
         return (out, lse) if return_lse else out
 
@@ -944,7 +1031,7 @@ def _paged_flash_verify_fused(
         functools.partial(
             _paged_flash_verify_kernel,
             n_steps=n_steps, pages_per_step=P, page_size=page_size,
-            scale=1.0 / math.sqrt(d), h_kv=1, chunk_dim=2,
+            scale=scale, h_kv=1, chunk_dim=2, soft_cap=soft_cap,
         ),
         name="paged_flash_verify",
         grid_spec=grid_spec,
@@ -960,7 +1047,10 @@ def _paged_flash_verify_fused(
         max_lens, block_table.astype(jnp.int32), lens_rows, q5,
         *(kv for _ in range(P) for kv in (k_pages, v_pages)),
     )
-    out = out.reshape(b, h_kv, S, g, d).swapaxes(1, 2).reshape(b, S, hq, d)
+    out = (
+        out.reshape(b, h_kv, S, g, d).swapaxes(1, 2)
+        .reshape(b, S, hq, d)[..., :d_out]
+    )
     lse = lse.reshape(b, h_kv, S, g).swapaxes(1, 2).reshape(b, S, hq)
     return (out, lse) if return_lse else out
 
@@ -975,6 +1065,7 @@ def paged_flash_verify_distributed(
     axis: str = "tp",
     fuse_heads: bool | None = None,
     pages_per_step: int | None = None,
+    soft_cap: float = 0.0,
     ag_method: str = "full_mesh_push",
     interpret: Any = None,
 ) -> jax.Array:
@@ -984,7 +1075,7 @@ def paged_flash_verify_distributed(
     out, lse = paged_flash_verify(
         q, k_pages, v_pages, lens_shard, block_table,
         fuse_heads=fuse_heads, pages_per_step=pages_per_step,
-        return_lse=True, interpret=interpret,
+        soft_cap=soft_cap, return_lse=True, interpret=interpret,
     )
     b, S, hq, d = out.shape
     merged = _sp_allgather_combine(
@@ -1068,6 +1159,7 @@ def _paged_flash_decode_kernel(
     kv_lens_ref, block_table_ref, q_ref, *rest,
     n_steps: int, pages_per_step: int, page_size: int,
     scale: float, h_kv: int, chunk_dim: int, quant: bool = False,
+    soft_cap: float = 0.0,
 ):
     """Paged decode over ``pages_per_step`` pages concatenated into one
     [g, P·page] span per step (r5 chip finding: the span, not the page
@@ -1118,7 +1210,7 @@ def _paged_flash_decode_kernel(
             m_scr[j], l_scr[j], acc_scr[j] = _online_softmax_step(
                 q_ref[0, j], k_cat, v_cat, ks_cat, vs_cat,
                 c * P * page_size, kv_len, scale,
-                m_scr[j], l_scr[j], acc_scr[j],
+                m_scr[j], l_scr[j], acc_scr[j], soft_cap,
             )
 
     @pl.when(c == n_steps - 1)
@@ -1139,6 +1231,7 @@ def paged_flash_decode(
     v_scales: jax.Array | None = None,
     fuse_heads: bool | None = None,
     pages_per_step: int | None = None,
+    soft_cap: float = 0.0,
     return_lse: bool = False,
     interpret: Any = None,
 ):
@@ -1193,13 +1286,13 @@ def paged_flash_decode(
         lambda: _paged_flash_decode_fused(
             q, k_pages, v_pages, kv_lens, block_table,
             k_scales=k_scales, v_scales=v_scales, fuse_heads=fuse_heads,
-            pages_per_step=pages_per_step, return_lse=return_lse,
-            interpret=interpret,
+            pages_per_step=pages_per_step, soft_cap=soft_cap,
+            return_lse=return_lse, interpret=interpret,
         ),
         None if k_scales is not None else (
             lambda: _xla_paged_decode(
                 q, k_pages, v_pages, kv_lens, block_table,
-                return_lse=return_lse,
+                return_lse=return_lse, soft_cap=soft_cap,
             )
         ),
     )
@@ -1207,13 +1300,21 @@ def paged_flash_decode(
 
 def _paged_flash_decode_fused(
     q, k_pages, v_pages, kv_lens, block_table, *,
-    k_scales, v_scales, fuse_heads, pages_per_step, return_lse, interpret,
+    k_scales, v_scales, fuse_heads, pages_per_step, soft_cap, return_lse,
+    interpret,
 ):
     b, hq, d = q.shape
     n_pages, h_kv, page_size, _ = k_pages.shape
     g = hq // h_kv
     max_pages = block_table.shape[1]
     quant = k_scales is not None
+    d_out = d
+    scale = 1.0 / math.sqrt(d)  # the TRUE head dim, before any padding
+    d = _kernel_head_dim(d)
+    if d != d_out:  # pad the q and the page pools; slice the output back
+        q, k_pages, v_pages = (
+            _pad_head_dim(x, d) for x in (q, k_pages, v_pages)
+        )
     if quant:
         assert v_scales is not None
         assert k_scales.shape == (n_pages, h_kv, 1, page_size), k_scales.shape
@@ -1262,7 +1363,6 @@ def _paged_flash_decode_fused(
             f"raises it). Reduce page_size, toggle fuse_heads, or use "
             f"flash_decode on a contiguous cache."
         )
-    scale = 1.0 / math.sqrt(d)
     # match q to the pool's COMPUTE dtype (int8 pools upcast to bf16 in
     # the kernel — the same contract as flash_decode_quant)
     q4 = q.reshape(b, h_kv, g, d).astype(
@@ -1316,7 +1416,7 @@ def _paged_flash_decode_fused(
                 _paged_flash_decode_kernel,
                 n_steps=n_steps, pages_per_step=P,
                 page_size=page_size, scale=scale, h_kv=h_kv, chunk_dim=1,
-                quant=quant,
+                quant=quant, soft_cap=soft_cap,
             ),
             name="paged_flash_decode_q_fh" if quant else "paged_flash_decode_fh",
             grid_spec=grid_spec,
@@ -1333,7 +1433,7 @@ def _paged_flash_decode_fused(
             q4, *(kv for _ in range(P) for kv in (k_pages, v_pages)),
             *(sc for _ in range(P) for sc in (k_scales, v_scales) if quant),
         )
-        out = out.reshape(b, hq, d)
+        out = out.reshape(b, hq, d)[..., :d_out]
         lse = lse.reshape(b, hq)
         return (out, lse) if return_lse else out
 
@@ -1378,7 +1478,7 @@ def _paged_flash_decode_fused(
             _paged_flash_decode_kernel,
             n_steps=n_steps, pages_per_step=P,
             page_size=page_size, scale=scale, h_kv=1, chunk_dim=2,
-            quant=quant,
+            quant=quant, soft_cap=soft_cap,
         ),
         name="paged_flash_decode_q" if quant else "paged_flash_decode",
         grid_spec=grid_spec,
@@ -1395,7 +1495,7 @@ def _paged_flash_decode_fused(
         q4, *(kv for _ in range(P) for kv in (k_pages, v_pages)),
         *(sc for _ in range(P) for sc in (k_scales, v_scales) if quant),
     )
-    out = out.reshape(b, hq, d)
+    out = out.reshape(b, hq, d)[..., :d_out]
     lse = lse.reshape(b, hq)
     return (out, lse) if return_lse else out
 
@@ -1443,6 +1543,7 @@ def paged_flash_decode_distributed(
     axis: str = "tp",
     fuse_heads: bool | None = None,
     pages_per_step: int | None = None,
+    soft_cap: float = 0.0,
     ag_method: str = "full_mesh_push",
     interpret: Any = None,
 ) -> jax.Array:
@@ -1455,7 +1556,7 @@ def paged_flash_decode_distributed(
     out, lse = paged_flash_decode(
         q, k_pages, v_pages, kv_lens_shard, block_table,
         fuse_heads=fuse_heads, pages_per_step=pages_per_step,
-        return_lse=True, interpret=interpret,
+        soft_cap=soft_cap, return_lse=True, interpret=interpret,
     )
     return _sp_allgather_combine(out, lse, axis, ag_method, interpret)
 
@@ -1544,7 +1645,8 @@ def flash_decode_op(
     if n == 1 and config is not None and config.block_s == 0:
         # world-1 XLA-native sentinel: no SPMD machinery (see ag_gemm_op)
         return _xla_decode(
-            q, k, v, kv_lens.astype(jnp.int32), return_lse=False
+            q, k, v, kv_lens.astype(jnp.int32), return_lse=False,
+            soft_cap=config.soft_cap,
         )
 
     def fn(q, k_s, v_s, kv_lens):
@@ -1605,13 +1707,17 @@ def _fd_effective_block(cfg, q, k, v, kv_lens, mesh, *, axis="tp", **_):
     )
 
 
-def _flash_decode_op_xla(q, k, v, kv_lens, mesh, **_):
+def _flash_decode_op_xla(q, k, v, kv_lens, mesh, *, config=None, **_):
     """Op-level golden: the XLA-native masked attention over the full
     cache — no SPMD machinery at all (jit shards the einsums under the
     arrays' placement), so it survives any topology the fused SP
-    pipeline cannot."""
+    pipeline cannot. Honors the config's ``soft_cap`` — the golden must
+    compute the same capped logits as the kernel it stands in for."""
     del mesh
-    return _xla_decode(q, k, v, kv_lens.astype(jnp.int32), return_lse=False)
+    return _xla_decode(
+        q, k, v, kv_lens.astype(jnp.int32), return_lse=False,
+        soft_cap=config.soft_cap if config is not None else 0.0,
+    )
 
 
 flash_decode_op = contextual_autotune(

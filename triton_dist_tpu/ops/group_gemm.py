@@ -77,6 +77,17 @@ class GroupGemmConfig:
     # blocks, so the MoE pipeline routes it through the sequential
     # composition.
     backend: str = "pallas"
+    # Span-schedule policy of the OVERLAPPED pipelines (ISSUE 14): how the
+    # per-ring-step shard / combine slab is tiled into chunk spans.
+    # "contig" (default) is the legacy near-equal contiguous tiling of
+    # ``ops.common.chunk_schedule``, bit for bit; the other names
+    # ("window", "interleave", "torus2d" — ``ops.common.SPAN_POLICIES``)
+    # are SYNTHESIZED schedules that enter tune spaces only after the
+    # generate → prove → admit loop of ``triton_dist_tpu/synth`` proves
+    # them credit-balanced and deadlock-free (docs/analysis.md). The grid
+    # group_gemm and sequential compositions ignore it, like
+    # chunks_per_shard.
+    span_policy: str = "contig"
 
 
 # The MXU row tile: live rows are quantized UP to this many before the

@@ -52,6 +52,9 @@ from triton_dist_tpu.ops.moe_utils import (
     valid_rows_from_sorted,
 )
 from triton_dist_tpu.ops.reduce_scatter import ReduceScatterConfig, reduce_scatter
+from triton_dist_tpu.synth.admitted import (
+    admitted_tune_extension as _admitted_tune_extension,
+)
 from triton_dist_tpu.utils import pick_block
 from triton_dist_tpu.utils import axis_size as _axis_size
 
@@ -170,15 +173,18 @@ def _moe_rs_overlap_fused(
         jax.ShapeDtypeStruct((m_out, h_dim), out_dtype),            # own_buf
         jax.ShapeDtypeStruct((max(n - 1, 1), m_out, h_dim), out_dtype),
     ]
-    from triton_dist_tpu.ops.common import chunk_schedule
+    from triton_dist_tpu.ops.common import resolve_spans
 
     # combine-side chunk schedule (ISSUE 4): spans over the pushed slab's
     # m_out rows, quantized to 128 so chunk boundaries stay tile-aligned;
     # a single-span schedule (incl. chunk=1 and world-1) emits the legacy
-    # whole-slab push protocol, bit for bit
-    spans = chunk_schedule(
+    # whole-slab push protocol, bit for bit. span_policy (ISSUE 14)
+    # dispatches synthesized tilings/orderings — the combine consumes
+    # chunks by slot index, so order-permuting policies are valid here
+    spans = resolve_spans(
         m_out, max(1, int(getattr(cfg, "chunks_per_shard", 1))) if n > 1 else 1,
-        quantum=128,
+        128, policy=getattr(cfg, "span_policy", "contig"), world=n,
+        side="moe_rs",
     )
     kernel = make_moe_rs_overlap_kernel(
         axis=axis, n=n, nb=nb, n_jn=n_jn, bn=bn, m_out=m_out,
@@ -318,6 +324,12 @@ def moe_reduce_rs_overlap(
         assert scale.shape == (w_down.shape[0], 1, w_down.shape[2]), (
             scale.shape, w_down.shape,
         )
+    # span-policy fence BEFORE the guard ladder (ISSUE 14): an unknown
+    # policy is a config error that must fail loudly, not a kernel
+    # failure for guarded_call to downgrade to the golden path
+    from triton_dist_tpu.ops.common import validate_span_policy
+
+    validate_span_policy(getattr(cfg, "span_policy", "contig"), "moe_rs")
     return resilience.guarded_call(
         "moe_reduce_rs_overlap",
         functools.partial(_moe_rs_overlap_fused, cfg=cfg, interpret=interpret),
@@ -412,7 +424,12 @@ MOE_RS_TUNE_SPACE = (
     GroupGemmConfig(128, 1024, 512, ragged=True),
     GroupGemmConfig(128, 1024, 512, w8=True),
     GroupGemmConfig(128, 1024, 512, ragged=True, w8=True),
-)
+) + _admitted_tune_extension("moe_reduce_rs")
+# ^ SYNTHESIZED schedules (ISSUE 14): the standing registry of proved
+# span policies (triton_dist_tpu/synth/admitted.py) appends STRICTLY
+# AFTER every legacy candidate — the no-regression ordering invariant
+# (docs/autotuner.md; pinned by tests/test_synth.py). analysis/sweep.py
+# enumerates this constant, so protocol_lint proves them permanently.
 
 moe_reduce_rs_op = contextual_autotune(MOE_RS_TUNE_SPACE, name="moe_reduce_rs")(
     moe_reduce_rs_op

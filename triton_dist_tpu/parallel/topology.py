@@ -255,6 +255,27 @@ def remap_world(axis_size: int, quarantined) -> dict[int, int]:
             enumerate(surviving_ring(axis_size, quarantined))}
 
 
+def torus_factor(n: int) -> tuple[int, int]:
+    """Most-square 2-D torus factorization ``(outer, inner)`` of an axis of
+    ``n`` PEs: ``inner`` is the largest divisor of ``n`` at most ``√n``
+    (``inner <= outer``, ``outer * inner == n``). This is the standing
+    question 2-D-aware schedules ask of a flattened mesh axis — e.g. the
+    synthesized ``torus2d`` span policy (``ops.common.span_torus2d_schedule``)
+    sizes its chunk count to the inner ring so each forwarded span crosses
+    one inner-axis hop. Worlds with no square-ish factorization (primes,
+    n <= 2) return ``(n, 1)`` — a line, no inner ring."""
+    n = int(n)
+    if n < 1:
+        raise ValueError(f"torus_factor: world must be >= 1, got {n}")
+    inner = 1
+    d = 2
+    while d * d <= n:
+        if n % d == 0:
+            inner = d
+        d += 1
+    return n // inner, inner
+
+
 def is_dcn_axis_name(name) -> bool:
     """Whether collectives on this axis name must ride DCN: declared via
     ``config.dcn_axes`` (user) or auto-detected for the latest mesh using

@@ -369,6 +369,59 @@ def estimate_w8_overlap_time_ms(
     return t_ring + wb / (spec.hbm_gbps * 1e9) * 1e3
 
 
+def estimate_span_policy_time_ms(
+    policy: str,
+    shard_bytes: int,
+    n_pes: int,
+    chunks_per_shard: int = 1,
+    spec: ChipSpec | None = None,
+) -> float:
+    """Ranking cost term for a span-schedule policy (ISSUE 14): completion
+    time of the chunk-pipelined ring PLUS the exposed per-hop first-chunk
+    bubble — the quantity the synthesized schedules exist to move. Used by
+    ``synth/admit.py`` to order admitted candidates within a family (and
+    recorded in the admission report); ``contextual_autotune`` still times
+    the real schedules, this model only ranks.
+
+    Per-policy terms, each with an honest reduction contract:
+
+    - ``"contig"``: :func:`estimate_ring_chunked_time_ms` +
+      :func:`estimate_fused_ring_bubble_ms` — the legacy model, unchanged.
+    - ``"window"``: same completion (same total bytes, same stage count),
+      but the bubble's chunk fraction is the SMALLEST span of the
+      geometric tiling (weight ``1 / (2^chunks - 1)``) instead of
+      ``1/chunks``. ``chunks=1`` reduces exactly to ``contig``.
+    - ``"interleave"``: identical to ``contig`` — a pure issue-order
+      permutation moves no bytes and adds no stages; its win (the
+      consumer's inward drain order) is not priced by this wire model,
+      which is exactly why only a timed sweep may crown it.
+    - ``"torus2d"``: ``contig`` with the chunk count scaled by the inner
+      dimension of ``topology.torus_factor(n_pes)``. A line world
+      (inner 1) reduces exactly to ``contig``.
+    """
+    spec = spec or detect_chip()
+    chunks = max(1, int(chunks_per_shard))
+    if policy == "torus2d":
+        from triton_dist_tpu.parallel.topology import torus_factor
+
+        chunks *= torus_factor(max(1, n_pes))[1]
+        policy = "contig"
+    t = estimate_ring_chunked_time_ms(shard_bytes, n_pes, chunks, spec)
+    if policy == "window" and chunks > 1:
+        if n_pes <= 1:
+            return t
+        frac = 1.0 / ((1 << chunks) - 1)
+        chunk_wire = shard_bytes * frac / (
+            2 * spec.ici_gbps_per_link * 1e9
+        ) * 1e3
+        return t + (n_pes - 1) * (ICI_HOP_LATENCY_MS + chunk_wire)
+    if policy in ("contig", "interleave", "window"):
+        return t + estimate_fused_ring_bubble_ms(
+            shard_bytes, n_pes, chunks, spec
+        )
+    raise ValueError(f"unknown span policy {policy!r}")
+
+
 def suggest_w8_overlap(
     t_rows: int,
     n_experts: int,

@@ -1,0 +1,49 @@
+"""Schedule synthesizer: a generate → prove → tune engine over the
+overlap-kernel emitter (ISSUE 14; docs/analysis.md "Generate → prove →
+tune").
+
+PR 7 made overlap schedules a POLICY of one emitter; PR 10 made protocol
+soundness provable in seconds on any jax line. This package closes the
+loop into a search engine the hand-written reference cannot match:
+
+- ``policies.py``  — the declarative schedule-policy space beyond the
+  legacy ring/chunked spans (arrival-window tilings for the AG ring,
+  bidirectional chunk interleave for the MoE combine, 2-D torus-aware
+  chunk derivation over ``parallel/topology.py``), each just a different
+  span list the ``ops/gg_pipeline.py`` emitter consumes unchanged;
+- ``generate.py``  — deterministic candidate enumeration with NAMED
+  validity pruning;
+- ``prove.py``     — three static gates per candidate: span-schedule
+  validity, the full PR 10 protocol proof at worlds {2, 4, 8}, and the
+  seeded-defect harness demonstrating the verifier has teeth on the
+  synthesized graph;
+- ``admit.py``     — proved schedules enter the family tune spaces
+  strictly AFTER every existing candidate (the standing no-regression
+  ordering invariant) with ``perf_model`` cost terms; unproved candidates
+  are rejected with a named diagnosis, never registered;
+- ``admitted.py``  — the committed standing registry the tune-space
+  modules and ``analysis/sweep.py`` replay at import, so
+  ``scripts/protocol_lint.py`` covers every admitted schedule
+  permanently.
+
+``scripts/synth_schedules.py`` drives the loop end to end and prints a
+byte-identical report across invocations.
+
+Import note: this ``__init__`` stays lazy — ``admitted.py`` is imported
+by the ops tune-space modules at import time and must not drag the rest
+of the package (which imports those same ops modules) in behind it.
+"""
+
+from __future__ import annotations
+
+_SUBMODULES = ("admitted", "policies", "generate", "prove", "admit")
+
+__all__ = list(_SUBMODULES)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        return importlib.import_module(f"{__name__}.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,141 @@
+"""The admit stage: proved schedules enter the tune spaces (ISSUE 14).
+
+``admit`` consumes ``synth/prove.py`` proofs and enforces the loop's two
+contracts:
+
+- **No unproved schedule is ever registered.** A candidate whose proof
+  failed any gate is REJECTED with the proof's named diagnosis — the
+  admission report shows exactly which invariant died and where.
+- **The no-regression ordering invariant.** Admitted candidates are
+  appended to the family's LIVE tune space strictly AFTER every existing
+  candidate (``extend_tune_space`` appends to the list
+  ``contextual_autotune`` closes over, so the running process's tuner
+  sees them immediately), and the standing registry
+  (``synth/admitted.py``) replays the same order at import time — a
+  sweep-free walk (``cached_or_first`` / interpreter) can never apply a
+  synthesized schedule untimed, pinned by ``tests/test_synth.py``.
+
+Each admitted candidate carries its ``perf_model`` cost term
+(:func:`~triton_dist_tpu.perf_model.estimate_span_policy_time_ms` at a
+reference decode-regime shard) so the report ranks what the tuner will
+time. Registration into ``analysis/sweep.py`` is structural: the sweep
+enumerates the tune-space constants, which include the standing registry
+— ``scripts/protocol_lint.py`` therefore proves every admitted schedule
+on every run, permanently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from triton_dist_tpu.synth.admitted import is_admitted
+from triton_dist_tpu.synth.generate import Candidate
+from triton_dist_tpu.synth.prove import Proof
+
+# Reference shard for the report's cost ranking: a decode-regime slab
+# (256 rows x 4 KiB) at world 8 — the regime the overlap schedules serve
+_COST_SHARD_BYTES = 256 * 4096
+_COST_WORLD = 8
+
+
+@dataclasses.dataclass
+class Admission:
+    candidate: Candidate
+    admitted: bool
+    standing: bool          # already in the committed registry
+    diagnosis: str          # rejection reason (empty when admitted)
+    cost_ms: float | None   # perf_model ranking term (admitted only)
+
+    def line(self) -> str:
+        c = self.candidate
+        if not self.admitted:
+            return (
+                f"REJECTED  {c.family}[{c.label}] — {self.diagnosis}"
+            )
+        state = "standing" if self.standing else "newly admitted"
+        return (
+            f"admitted  {c.family}[{c.label}] ({state}; "
+            f"cost {self.cost_ms:.4f} ms @ w{_COST_WORLD} ref shard)"
+        )
+
+
+@dataclasses.dataclass
+class AdmissionReport:
+    admissions: list[Admission]
+
+    @property
+    def admitted(self) -> list[Admission]:
+        return [a for a in self.admissions if a.admitted]
+
+    @property
+    def rejected(self) -> list[Admission]:
+        return [a for a in self.admissions if not a.admitted]
+
+    @property
+    def ok(self) -> bool:
+        """The loop is healthy when every admitted candidate matches the
+        standing registry posture (rejections are expected for probes)."""
+        return all(a.standing for a in self.admitted)
+
+
+def family_op(family: str):
+    """The live autotuned op whose tune space a family's admissions
+    extend."""
+    import importlib
+
+    # importlib, not `from ... import`: the ops package re-exports
+    # same-named FUNCTIONS (ops.moe_reduce_rs the op) that shadow the
+    # submodules as package attributes
+    if family == "ag_group_gemm":
+        m = importlib.import_module(
+            "triton_dist_tpu.ops.allgather_group_gemm"
+        )
+        return m.ag_group_gemm_op
+    if family == "moe_reduce_rs":
+        m = importlib.import_module("triton_dist_tpu.ops.moe_reduce_rs")
+        return m.moe_reduce_rs_op
+    raise ValueError(f"unknown synthesis family {family!r}")
+
+
+def extend_tune_space(op, cfg) -> bool:
+    """Append ``cfg`` to a wrapped op's live tune space, strictly after
+    every existing candidate. ``contextual_autotune`` exposes (and closes
+    over) the same list object as ``op.autotune_configs``, so the append
+    is visible to subsequent sweeps in this process. Idempotent: a config
+    already present (legacy or previously admitted) is never duplicated
+    and never moved — admission order can only ever append. Returns
+    whether the space grew."""
+    space = op.autotune_configs
+    if cfg in space:
+        return False
+    space.append(cfg)
+    return True
+
+
+def admit(proofs: list[Proof]) -> AdmissionReport:
+    """Register every PROVED candidate; reject the rest with the proof's
+    named diagnosis."""
+    from triton_dist_tpu import perf_model
+
+    admissions: list[Admission] = []
+    for proof in proofs:
+        cand = proof.candidate
+        if not proof.ok:
+            admissions.append(Admission(
+                candidate=cand, admitted=False, standing=False,
+                diagnosis=proof.diagnosis or "unproved", cost_ms=None,
+            ))
+            continue
+        standing = is_admitted(cand.family, cand.cfg)
+        extend_tune_space(family_op(cand.family), cand.cfg)
+        cost = perf_model.estimate_span_policy_time_ms(
+            cand.policy, _COST_SHARD_BYTES, _COST_WORLD,
+            cand.cfg.chunks_per_shard,
+            spec=perf_model.CHIP_SPECS["v5e"],  # fixed ref chip: the
+            # report must not depend on the host the script runs on
+        )
+        admissions.append(Admission(
+            candidate=cand, admitted=True, standing=standing,
+            diagnosis="", cost_ms=cost,
+        ))
+    return AdmissionReport(admissions)

@@ -1,0 +1,72 @@
+"""Standing registry of PROVED synthesized schedules (ISSUE 14).
+
+This is the durable half of the generate → prove → admit loop
+(docs/analysis.md "Generate → prove → tune"): every entry here was
+produced by ``scripts/synth_schedules.py`` — enumerated by
+``synth/generate.py``, proved credit-balanced / deadlock-free /
+chunk-ordered / telemetry-dense / landing-view-covered at worlds
+{2, 4, 8} by ``synth/prove.py`` (including the seeded-defect harness),
+and admitted by ``synth/admit.py``. The family tune-space modules
+(``ops/allgather_group_gemm.py``, ``ops/moe_reduce_rs.py``) append
+:func:`admitted_tune_extension` STRICTLY AFTER their legacy candidates —
+the standing no-regression ordering invariant (docs/autotuner.md): a
+sweep-free walk can never apply a synthesized schedule untimed — and
+``analysis/sweep.py`` therefore covers every admitted tuple permanently
+(``scripts/protocol_lint.py`` proves them on every run, like the
+hand-written schedules).
+
+Entries are plain data (family → GroupGemmConfig kwargs) so this module
+stays import-light: the ops modules import it at tune-space build time,
+and it must not import them back. Never hand-edit an entry into this
+table without a proof — ``synth/admit.py`` refuses unproved candidates,
+and ``tests/test_synth.py`` re-proves the whole registry in CI.
+"""
+
+from __future__ import annotations
+
+# (family, kwargs) in ADMISSION ORDER. The base tile (128, 1024, 512) is
+# each family's best-known leader tile; the synthesized axis is the span
+# schedule, not the tiling (format/validity axes compose later exactly as
+# they do for the legacy candidates).
+SYNTH_ADMITTED: tuple[tuple[str, dict], ...] = (
+    # window (AG side): geometric ascending spans — the consumer's
+    # first-chunk wait covers only the smallest span's wire time
+    ("ag_group_gemm",
+     dict(block_m=128, block_n=1024, block_k=512, chunks_per_shard=2,
+          span_policy="window")),
+    ("ag_group_gemm",
+     dict(block_m=128, block_n=1024, block_k=512, chunks_per_shard=4,
+          span_policy="window")),
+    # torus2d (both sides): chunk count adapts to the world's most-square
+    # 2-D torus factorization (topology.torus_factor)
+    ("ag_group_gemm",
+     dict(block_m=128, block_n=1024, block_k=512, chunks_per_shard=1,
+          span_policy="torus2d")),
+    # interleave (MoE combine side): bidirectional chunk issue order —
+    # the landed slab grows inward from both ends. chunks=2 is NOT here:
+    # a both-ends order of two chunks is the contiguous order, and
+    # generate.py's identity-degeneracy prune rejects it by schedule
+    # comparison (the coverage starts where the permutation is real)
+    ("moe_reduce_rs",
+     dict(block_m=128, block_n=1024, block_k=512, chunks_per_shard=4,
+          span_policy="interleave")),
+    ("moe_reduce_rs",
+     dict(block_m=128, block_n=1024, block_k=512, chunks_per_shard=1,
+          span_policy="torus2d")),
+)
+
+
+def admitted_tune_extension(family: str) -> tuple:
+    """The admitted synthesized candidates of one family, in admission
+    order, as GroupGemmConfig instances — appended by the family tune-space
+    modules strictly after their legacy candidates."""
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+
+    return tuple(
+        GroupGemmConfig(**kw) for fam, kw in SYNTH_ADMITTED if fam == family
+    )
+
+
+def is_admitted(family: str, cfg) -> bool:
+    """Whether ``cfg`` is a standing registry entry of ``family``."""
+    return cfg in admitted_tune_extension(family)

@@ -1,0 +1,147 @@
+"""Candidate enumeration with validity pruning (ISSUE 14).
+
+``generate_candidates`` walks the declarative policy space of
+``synth/policies.py`` across both fused-pipeline families and emits
+concrete (family, policy, params) candidates as ``GroupGemmConfig``
+tuples the existing host entries consume directly. Pruning is NAMED —
+every rejected combination carries the reason, so the synthesis report
+(``scripts/synth_schedules.py``) shows what was considered, not just
+what survived:
+
+- **side validity** — a policy invalid on a family's pipeline side
+  (e.g. ``interleave`` on the AG ring, whose gather-group coverage
+  requires ascending contiguous spans) is pruned, mirroring the
+  ``ops.common.validate_span_policy`` fence the emitter itself enforces;
+- **identity degeneracy** — parameter points whose schedule EQUALS the
+  legacy contiguous schedule at every sample shape and every
+  verification world are pruned by direct schedule comparison (e.g. any
+  policy at ``chunks_per_shard=1`` on a non-adaptive axis, or
+  ``interleave`` at 2 chunks — a both-ends order of two chunks IS the
+  contiguous order): they would re-prove the legacy protocol under a
+  new label, not a new schedule;
+- **duplicate** — a candidate equal to one emitted earlier in the walk
+  is pruned.
+
+The enumeration is deterministic (fixed policy order × fixed chunk
+axes), so two invocations produce byte-identical candidate lists — the
+precondition for the synthesis report's byte-identity contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from triton_dist_tpu.synth import policies as P
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One concrete synthesized schedule: a (family, policy, params) point
+    expressed as the ``GroupGemmConfig`` the host entry consumes."""
+
+    family: str      # verifier family: "ag_group_gemm" | "moe_reduce_rs"
+    policy: str      # SpanPolicy.name
+    cfg: object      # GroupGemmConfig
+    label: str       # analysis/sweep label (_gg_label form)
+    rationale: str
+
+    def key(self) -> tuple:
+        return (self.family, self.label)
+
+
+@dataclasses.dataclass(frozen=True)
+class Pruned:
+    family: str
+    policy: str
+    chunks: int | None
+    reason: str
+
+
+# Each family's synthesized candidates ride the family's best-known
+# leader tile — the span schedule is the synthesized axis; the
+# format/validity axes (ragged, w8) compose onto proved schedules later
+# exactly as they compose onto the legacy ones.
+_BASE_TILE = dict(block_m=128, block_n=1024, block_k=512)
+
+
+def _identity_degenerate(pol, chunks: int, worlds=(2, 4, 8)) -> bool:
+    """True when the policy's span schedule EQUALS the legacy contiguous
+    schedule at every sample shape and every verification world — the
+    candidate would re-prove the legacy protocol under a new label, not
+    a new schedule. Direct schedule comparison, so degeneracies the
+    policy author did not anticipate (e.g. ``interleave`` at 2 chunks:
+    any both-ends order of two chunks IS the contiguous order) are
+    caught by the same rule as the obvious single-span points."""
+    from triton_dist_tpu.ops.common import chunk_schedule
+
+    return all(
+        pol.spans(rows, chunks, quantum, world)
+        == chunk_schedule(rows, chunks, quantum)
+        for world in worlds
+        for rows, quantum in P.SPAN_SAMPLES
+    )
+
+
+def _label(cfg) -> str:
+    from triton_dist_tpu.analysis.sweep import _gg_label
+
+    return _gg_label(cfg)
+
+
+def generate_candidates(
+    families=None, *, include_probe: bool = False,
+) -> tuple[list[Candidate], list[Pruned]]:
+    """Enumerate the candidate space. Returns ``(candidates, pruned)`` in
+    deterministic order. ``include_probe=True`` appends the
+    ``UNBALANCED_PROBE`` negative control (one candidate per side) so the
+    prove → admit rejection path is exercised on every synthesis run."""
+    from triton_dist_tpu.ops.group_gemm import GroupGemmConfig
+
+    families = tuple(families or ("ag_group_gemm", "moe_reduce_rs"))
+    side_of = {v: k for k, v in P.FAMILY_OF_SIDE.items()}
+    out: list[Candidate] = []
+    pruned: list[Pruned] = []
+    seen: set[tuple] = set()
+    pool = P.POLICIES + ((P.UNBALANCED_PROBE,) if include_probe else ())
+    for family in families:
+        side = side_of[family]
+        for pol in pool:
+            if side not in pol.sides:
+                pruned.append(Pruned(
+                    family, pol.name, None,
+                    f"side-invalid: the {side!r} pipeline cannot consume "
+                    f"{pol.name!r} spans "
+                    f"(valid sides: {', '.join(pol.sides)})",
+                ))
+                continue
+            for chunks in pol.chunk_axis:
+                # the probe is exempt: its schedule must reach the prove
+                # stage to exercise the rejection path
+                if pol.name != "unbalanced-probe" and _identity_degenerate(
+                    pol, chunks
+                ):
+                    pruned.append(Pruned(
+                        family, pol.name, chunks,
+                        "identity-degenerate: the schedule equals the "
+                        "legacy contiguous tiling at every sample shape "
+                        "and world — the legacy protocol under a new "
+                        "label",
+                    ))
+                    continue
+                cfg = GroupGemmConfig(
+                    **_BASE_TILE, chunks_per_shard=chunks,
+                    span_policy=pol.name,
+                )
+                cand = Candidate(
+                    family=family, policy=pol.name, cfg=cfg,
+                    label=_label(cfg), rationale=pol.rationale,
+                )
+                if cand.key() in seen:
+                    pruned.append(Pruned(
+                        family, pol.name, chunks,
+                        "duplicate of an earlier candidate",
+                    ))
+                    continue
+                seen.add(cand.key())
+                out.append(cand)
+    return out, pruned

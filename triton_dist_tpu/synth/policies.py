@@ -1,0 +1,159 @@
+"""Declarative schedule-policy space of the synthesizer (ISSUE 14).
+
+A :class:`SpanPolicy` names one FAMILY of span schedules beyond the legacy
+ring/chunked tiling: which pipeline sides may consume it, which chunk
+counts are worth enumerating, how it degrades to the legacy single-span
+protocol (the emitter identity pin), and why it might win (the rationale
+``synth/admit.py`` records). The span MATH lives next to
+``chunk_schedule`` in ``ops/common.py`` (``SPAN_POLICIES``) — the only
+dependency the kernel host entries take; this module is the declarative
+layer ``synth/generate.py`` enumerates over.
+
+The contract with the emitter (``ops/gg_pipeline.py``): a policy is
+nothing but a different ``(offset, rows)`` span list — the kernel bodies
+consume it UNCHANGED. Per-chunk semaphore slots are positional, every PE
+computes the same spans from the same static shapes, so slot agreement
+across PEs holds for any policy by SPMD symmetry, exactly as for the
+legacy schedule. What a policy can still break — credit balance, deadlock
+freedom, issue order, telemetry density, landing-view coverage — is
+exactly what ``synth/prove.py`` must prove before ``synth/admit.py`` will
+register it.
+
+``UNBALANCED_PROBE`` is the loop's negative control: a deliberately
+broken policy (overlapping spans — double-covered rows) that
+``generate.py`` never enumerates and ``prove.py`` must REJECT with a
+named schedule-validity diagnosis. It exists so the rejection path is
+exercised on every synthesis run, not just in tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from triton_dist_tpu.ops.common import SPAN_POLICIES, chunk_schedule
+
+# The two pipeline sides the emitter serves (ops/gg_pipeline.py):
+# "ag" = the fused AG-GroupGEMM ring (ascending contiguous spans only —
+# its gather-group coverage derives from span offsets), "moe_rs" = the
+# fused MoE combine push (chunks drained by slot index: order-free).
+SIDES = ("ag", "moe_rs")
+
+# side -> the verifier family name of analysis/sweep.py
+FAMILY_OF_SIDE = {"ag": "ag_group_gemm", "moe_rs": "moe_reduce_rs"}
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanPolicy:
+    """One declarative schedule-policy family."""
+
+    name: str
+    sides: tuple[str, ...]       # pipeline sides the policy is valid on
+    chunk_axis: tuple[int, ...]  # chunks_per_shard values worth enumerating
+    world_adaptive: bool         # spans depend on the world size
+    rationale: str               # why it could win (admit.py records this)
+    identity: str                # how it degrades to the legacy single span
+    _fn: Callable | None = None  # probe-only override (not in SPAN_POLICIES)
+
+    def spans(self, rows: int, chunks: int, quantum: int = 1,
+              world: int = 1) -> tuple[tuple[int, int], ...]:
+        if self._fn is not None:
+            return self._fn(rows, chunks, quantum, world)
+        fn, needs_world, _ = SPAN_POLICIES[self.name]
+        return fn(rows, chunks, quantum, world) if needs_world else fn(
+            rows, chunks, quantum
+        )
+
+    def identity_params(self) -> dict:
+        """(chunks_per_shard, world) at which this policy's schedule is the
+        legacy single span — the tuple the emitter identity pin captures."""
+        return {"chunks_per_shard": 1, "world": 2}
+
+
+WINDOW = SpanPolicy(
+    name="window",
+    sides=("ag",),
+    chunk_axis=(2, 4),
+    world_adaptive=False,
+    rationale=(
+        "arrival-window consumption for the AG ring: geometric ascending "
+        "span sizes put the smallest chunk on the wire first, so the "
+        "consumer's per-hop first-chunk wait (the exposed bubble of "
+        "perf_model.estimate_fused_ring_bubble_ms) shrinks toward one "
+        "quantum's wire time while descriptor count stays bounded"
+    ),
+    identity="chunks_per_shard=1 emits chunk_schedule's single span",
+)
+
+INTERLEAVE = SpanPolicy(
+    name="interleave",
+    sides=("moe_rs",),
+    # chunks=2 is identity-degenerate (any both-ends order of 2 chunks IS
+    # the contiguous order) — generate.py's schedule-equality prune
+    # rejects it with a named reason; the real coverage starts at 4
+    chunk_axis=(2, 4),
+    world_adaptive=False,
+    rationale=(
+        "bidirectional chunk interleave for the MoE combine: the pushed "
+        "slab's chunks issue alternately from both ends, so the landing "
+        "rank's slab grows inward from its first AND last rows and the "
+        "final reduce pipeline's first and last tiles are ready earliest; "
+        "pure issue-order permutation — same spans, same credits"
+    ),
+    identity="chunks_per_shard=1 emits chunk_schedule's single span",
+)
+
+TORUS2D = SpanPolicy(
+    name="torus2d",
+    sides=("ag", "moe_rs"),
+    chunk_axis=(1,),
+    world_adaptive=True,
+    rationale=(
+        "2-D torus-aware tiling: chunk count = chunks_per_shard x the "
+        "inner dimension of the world's most-square torus factorization "
+        "(parallel.topology.torus_factor), so each forwarded span matches "
+        "one inner-ring hop of the physical 2-D mesh instead of a "
+        "world-blind constant"
+    ),
+    identity=(
+        "a line world (inner dim 1, e.g. world 2) at chunks_per_shard=1 "
+        "emits chunk_schedule's single span"
+    ),
+)
+
+
+def _overlapping_spans(rows, chunks, quantum, world):
+    """The probe's deliberately broken schedule: the contiguous tiling
+    with every span after the first pulled back one quantum — rows at each
+    boundary are double-covered while the shard tail is never sent."""
+    base = chunk_schedule(rows, max(2, chunks), quantum)
+    if len(base) < 2:
+        return base
+    q = max(1, min(quantum, rows))
+    return (base[0],) + tuple((max(0, off - q), sz) for off, sz in base[1:])
+
+
+UNBALANCED_PROBE = SpanPolicy(
+    name="unbalanced-probe",
+    sides=("ag", "moe_rs"),
+    chunk_axis=(2,),
+    world_adaptive=False,
+    rationale=(
+        "NEGATIVE CONTROL: overlapping spans double-cover chunk-boundary "
+        "rows and drop the shard tail — an unprovable schedule the admit "
+        "stage must reject with a named diagnosis, never register"
+    ),
+    identity="none (the probe is never admitted)",
+    _fn=_overlapping_spans,
+)
+
+# (rows, quantum) sample points shared by the generate-stage degeneracy
+# prune and the prove-stage validity gate: a many-quanta shard, a
+# quantum-misaligned tail, and a tiny shard that forces chunk clamping —
+# the shapes where tiling bugs (and vacuous schedules) live
+SPAN_SAMPLES = ((1024, 128), (1040, 128), (16, 1), (256, 128))
+
+# The enumerable space (generate.py walks this; the probe is NOT in it)
+POLICIES: tuple[SpanPolicy, ...] = (WINDOW, INTERLEAVE, TORUS2D)
+
+POLICY_BY_NAME = {p.name: p for p in POLICIES + (UNBALANCED_PROBE,)}

@@ -1,0 +1,227 @@
+"""The prove stage: static proof of every candidate schedule (ISSUE 14).
+
+A synthesized schedule is held to a STRICTLY harder standard than a
+hand-written one — three gates, all static, all on any jax line:
+
+1. **Schedule validity** (:func:`check_spans`): the policy's span list
+   must exactly tile the shard — full coverage, no overlap, in-bounds,
+   and (on the AG side) ascending contiguous order, the same fence
+   ``ops.common.resolve_spans`` enforces at emit time. This is where a
+   deliberately unbalanced policy (``policies.UNBALANCED_PROBE``) dies
+   with a named diagnosis before it ever reaches a kernel.
+2. **Protocol proof**: capture + verify the emitted kernel per world in
+   {2, 4, 8} through the PR 10 machinery (``analysis/capture.py`` /
+   ``verify.py``) — credit balance, static deadlock freedom, chunk-major
+   issue order, bounded-wait telemetry density, landing-view coverage.
+3. **Seeded-defect harness** (``analysis/defects.py``): the candidate's
+   own capture is mutated the way emitter bugs would mutate it (dropped
+   wait, dropped/extra signal, missing drain) and the verifier must flag
+   every applicable mutation with a slot/site-named diagnosis while the
+   clean twin stays silent — a synthesized family enters the tune spaces
+   only if the verifier demonstrably HAS teeth on its graph.
+
+``admit.py`` consumes the resulting :class:`Proof` objects; an unproved
+candidate is rejected there with this module's diagnosis, never
+registered.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from triton_dist_tpu.synth import policies as P
+from triton_dist_tpu.synth.generate import Candidate
+
+WORLDS = (2, 4, 8)
+
+# (rows, quantum) sample points for the schedule-validity gate — shared
+# with generate.py's identity-degeneracy prune (policies.SPAN_SAMPLES)
+_SPAN_SAMPLES = P.SPAN_SAMPLES
+
+# defect kinds applicable to the fused-pipeline families (the chunk-order
+# swap needs a chunked a2a capture; these families' chunked puts are the
+# ring form, checked structurally by the verifier instead)
+_DEFECT_KINDS = (
+    "dropped_wait", "dropped_signal", "extra_signal", "missing_drain",
+)
+
+
+@dataclasses.dataclass
+class Proof:
+    candidate: Candidate
+    schedule_findings: list[str] = dataclasses.field(default_factory=list)
+    reports: list = dataclasses.field(default_factory=list)  # verify.Report
+    defect_failures: list[str] = dataclasses.field(default_factory=list)
+    defect_notes: list[str] = dataclasses.field(default_factory=list)
+    defects_run: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return (
+            not self.schedule_findings
+            and bool(self.reports)
+            and all(r.ok for r in self.reports)
+            and not self.defect_failures
+        )
+
+    @property
+    def warnings(self) -> int:
+        return sum(len(r.warnings) for r in self.reports)
+
+    @property
+    def diagnosis(self) -> str:
+        """The first failing gate's named finding (empty when ok)."""
+        if self.schedule_findings:
+            return f"schedule_validity: {self.schedule_findings[0]}"
+        for r in self.reports:
+            if not r.ok:
+                return f"{r.family}[{r.label}] w{r.world}: {r.errors[0]}"
+        if self.defect_failures:
+            return f"defect_harness: {self.defect_failures[0]}"
+        if not self.reports:
+            return "no protocol capture produced"
+        return ""
+
+
+def check_spans(
+    spans, rows: int, *, ascending_required: bool,
+) -> list[str]:
+    """Static validity of one span schedule over a ``rows``-row shard:
+    in-bounds, positive sizes, exact disjoint coverage, and (when the
+    consuming side requires it) ascending contiguous order. Returns
+    named findings (empty = valid)."""
+    findings: list[str] = []
+    if not spans:
+        return [f"empty span schedule over {rows} rows"]
+    for off, sz in spans:
+        if sz < 1:
+            findings.append(f"span ({off}, {sz}) has non-positive size")
+        if off < 0 or off + sz > rows:
+            findings.append(
+                f"span ({off}, {sz}) exceeds the {rows}-row shard"
+            )
+    if findings:
+        return findings
+    by_off = sorted(spans)
+    cursor = 0
+    for off, sz in by_off:
+        if off < cursor:
+            findings.append(
+                f"span ({off}, {sz}) OVERLAPS the previous span (rows "
+                f"{off}..{cursor - 1} double-covered) — the mirrored "
+                f"per-chunk credits no longer describe a partition of the "
+                f"shard"
+            )
+            cursor = max(cursor, off + sz)
+            continue
+        if off > cursor:
+            findings.append(
+                f"rows {cursor}..{off - 1} are covered by NO span — the "
+                f"shard tail/gap is never transferred"
+            )
+        cursor = off + sz
+    if cursor < rows:
+        findings.append(
+            f"rows {cursor}..{rows - 1} are covered by NO span — the "
+            f"shard tail is never transferred"
+        )
+    if ascending_required and list(spans) != by_off:
+        findings.append(
+            "span order is not ascending — the AG gather-group schedule "
+            "derives compute coverage from span offsets and cannot "
+            "consume a permuted order"
+        )
+    return findings
+
+
+def _policy_of(cand: Candidate) -> P.SpanPolicy:
+    return P.POLICY_BY_NAME[cand.policy]
+
+
+def prove_candidate(
+    cand: Candidate, worlds=WORLDS, *, defects: bool = True,
+    progress=None,
+) -> Proof:
+    """Run all three gates for one candidate."""
+    from triton_dist_tpu.analysis import capture as C
+    from triton_dist_tpu.analysis import defects as D
+    from triton_dist_tpu.analysis.sweep import verify_family
+    from triton_dist_tpu.analysis.verify import Finding, Report
+
+    say = progress or (lambda s: None)
+    proof = Proof(cand)
+    pol = _policy_of(cand)
+    side = {v: k for k, v in P.FAMILY_OF_SIDE.items()}[cand.family]
+    ascending = side == "ag"
+
+    # gate 1: schedule validity across sample shapes and worlds
+    for world in worlds:
+        for rows, quantum in _SPAN_SAMPLES:
+            spans = pol.spans(
+                rows, cand.cfg.chunks_per_shard, quantum, world,
+            )
+            for f in check_spans(spans, rows, ascending_required=ascending):
+                proof.schedule_findings.append(
+                    f"{cand.policy} rows={rows} q={quantum} w={world}: {f}"
+                )
+        if proof.schedule_findings:
+            return proof  # an invalid tiling never reaches a kernel
+
+    # gate 2: capture + verify at every world
+    rep_cap = None
+    for world in worlds:
+        say(f"{cand.family}[{cand.label}] world={world}")
+        try:
+            rep, cap = verify_family(
+                cand.family, world, cand.label, cand.cfg
+            )
+        except C.CaptureError as exc:
+            rep = Report(family=cand.family, world=world, label=cand.label)
+            rep.errors.append(Finding("capture", str(exc)))
+            proof.reports.append(rep)
+            continue
+        proof.reports.append(rep)
+        if rep.ok and world == worlds[-1]:
+            rep_cap = cap
+
+    # gate 3: the seeded-defect harness on the candidate's own capture
+    if defects and rep_cap is not None and all(r.ok for r in proof.reports):
+        say(f"{cand.family}[{cand.label}] seeded defects")
+        from triton_dist_tpu.analysis.verify import verify_capture
+
+        for kind in _DEFECT_KINDS:
+            try:
+                seeded = D.seed_defect(rep_cap, kind)
+            except ValueError as exc:
+                proof.defect_notes.append(f"{kind}: not applicable ({exc})")
+                continue
+            rep = verify_capture(seeded.capture)
+            hits = [f for f in rep.errors if f.check == seeded.expect_check]
+            if not hits:
+                proof.defect_failures.append(
+                    f"{kind}: NOT flagged on {cand.family}[{cand.label}] "
+                    f"(errors: {[str(f) for f in rep.errors]})"
+                )
+            elif not any(
+                seeded.expect_naming in f.message for f in hits
+            ):
+                proof.defect_failures.append(
+                    f"{kind}: diagnosis does not name "
+                    f"{seeded.expect_naming!r}: {hits[0]}"
+                )
+            proof.defects_run += 1
+        if proof.defects_run == 0:
+            proof.defect_failures.append(
+                "no defect kind applicable to this capture — the harness "
+                "cannot demonstrate teeth on the synthesized graph"
+            )
+    return proof
+
+
+def prove_all(
+    candidates, worlds=WORLDS, *, defects: bool = True, progress=None,
+) -> list[Proof]:
+    return [
+        prove_candidate(c, worlds, defects=defects, progress=progress)
+        for c in candidates
+    ]
