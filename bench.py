@@ -131,12 +131,13 @@ def emit_info(metric, value, unit):
 
 
 def _append_health_json(path, name, snap):
-    """Merge one metric's end-of-run ``resilience.health.snapshot()``
-    (incl. the ISSUE 8 integrity / skip-step / poisoned counters) into the
-    ``--health-json`` artifact: a ``{metric_name: snapshot}`` JSON map the
-    driver leaves next to ``BENCH_*.json``. Tolerates a missing or
-    corrupt existing file (a dead artifact must never take a metric
-    down); written whole-file so a killed run leaves valid JSON."""
+    """Merge one metric's end-of-run ``obs.snapshot()`` (the versioned
+    ISSUE 15 schema: health + spans + wait telemetry + armed
+    flight-recorder sections under ``obs.export.SNAPSHOT_SECTIONS``)
+    into the ``--health-json`` artifact: a ``{metric_name: snapshot}``
+    JSON map the driver leaves next to ``BENCH_*.json``. Tolerates a
+    missing or corrupt existing file (a dead artifact must never take a
+    metric down); written whole-file so a killed run leaves valid JSON."""
     try:
         with open(path) as f:
             data = json.load(f)
@@ -1253,13 +1254,17 @@ def _run_one(name: str) -> None:
                 f"[bench {name}] resilience health: " + json.dumps(snap),
                 file=sys.stderr, flush=True,
             )
-        # --health-json (ISSUE 8 satellite): machine-readable end-of-run
-        # health artifact next to BENCH_*.json — one entry per metric
-        # (each metric runs in its own subprocess; sequential, so the
-        # read-merge-write below cannot race)
+        # --health-json (ISSUE 8 satellite, unified under the ISSUE 15
+        # snapshot schema): machine-readable end-of-run artifact next to
+        # BENCH_*.json — one obs.snapshot() per metric (versioned
+        # top-level sections; health rides inside it). Each metric runs
+        # in its own subprocess; sequential, so the read-merge-write
+        # below cannot race.
         path = os.environ.get("TDT_BENCH_HEALTH_JSON")
         if path:
-            _append_health_json(path, name, snap)
+            from triton_dist_tpu import obs as _obs_mod
+
+            _append_health_json(path, name, _obs_mod.snapshot())
 
 
 def main() -> None:

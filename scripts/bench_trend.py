@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Bench trend gate (ISSUE 15 satellite): diff a fresh ``bench.py`` run
+against ``BASELINE.json`` and the prior ``BENCH_*.json`` driver
+artifacts, and exit non-zero on any per-metric regression beyond the
+named tolerance — the automated trend gate the bench trajectory was
+missing (``scripts/perf_gate.sh`` gates vs_baseline FLOORS per family;
+this gates each metric against its own measured HISTORY, so a slow drift
+that never crosses a floor still fails loudly).
+
+Inputs it understands (all stdlib, no deps):
+
+- a bench log / stdout capture: every line that parses as a JSON object
+  with ``metric`` + numeric ``value`` counts (exactly what ``bench.py``
+  emits; interleaved warnings are ignored);
+- a driver artifact (``BENCH_r*.json``): the JSON lines are recovered
+  from its ``tail`` field;
+- ``BASELINE.json``: its ``published`` map (``metric -> value``)
+  contributes reference points when non-empty.
+
+Direction is inferred from the metric's unit: ``us/ms/s/ns`` are
+lower-is-better, everything else (TFLOPS, tok/s, GB/s, x) higher. The
+reference for each metric is the BEST historical reading; a fresh value
+worse than it by more than ``--tolerance`` (relative) is a REGRESSION.
+Metrics with no history are reported NEW and never gate.
+
+Usage (wired into ``scripts/chip_session.sh`` after the driver bench)::
+
+    python scripts/bench_trend.py docs/chip_logs/<stamp>_bench_driver_mode.log \\
+        --baseline BASELINE.json --history 'BENCH_*.json' [--tolerance 0.05]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+
+# direction is gated ONLY for units whose better-direction is known; a
+# metric with any other unit (e.g. the serving sweep's "requests" /
+# "fraction" load gauges, where lower queue depth is BETTER) is reported
+# UNTRACKED and never gated — guessing a direction would fail exactly
+# the improvements
+LOWER_IS_BETTER_UNITS = ("us", "ms", "s", "ns")
+HIGHER_IS_BETTER_UNITS = ("TFLOPS", "GFLOPS", "tok/s", "toks/s", "GB/s",
+                          "x", "")
+
+
+def parse_metric_lines(text: str) -> dict[str, dict]:
+    """``metric -> {"value": float, "unit": str}`` from JSON-object lines
+    embedded in ``text`` (later lines win — bench re-emission order)."""
+    out: dict[str, dict] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(row, dict) or "metric" not in row:
+            continue
+        v = row.get("value")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[str(row["metric"])] = {
+                "value": float(v), "unit": str(row.get("unit", "")),
+            }
+    return out
+
+
+def load_run(path: str) -> dict[str, dict]:
+    """Parse one input file: a bench log, or a BENCH_r*.json driver
+    artifact (metrics recovered from its ``tail``)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        return parse_metric_lines(text)
+    if isinstance(doc, dict) and isinstance(doc.get("tail"), str):
+        return parse_metric_lines(doc["tail"])
+    if isinstance(doc, dict):
+        # a {metric: value} map (the BASELINE.json "published" shape)
+        return {
+            str(k): {"value": float(v), "unit": ""}
+            for k, v in doc.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+    return {}
+
+
+def lower_is_better(unit: str) -> bool:
+    return unit in LOWER_IS_BETTER_UNITS
+
+
+def best_reference(history: list[tuple[str, dict[str, dict]]],
+                   metric: str, unit: str):
+    """(best_value, source_name) across every historical run carrying
+    ``metric`` — best under the unit's direction; None with no history."""
+    best = None
+    src = None
+    for name, run in history:
+        row = run.get(metric)
+        if row is None:
+            continue
+        v = row["value"]
+        if best is None or (
+            v < best if lower_is_better(unit) else v > best
+        ):
+            best, src = v, name
+    return best, src
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("fresh", help="fresh bench log / driver artifact")
+    ap.add_argument("--baseline", default="BASELINE.json",
+                    help="BASELINE.json (its published map contributes "
+                         "reference points); missing file = skipped")
+    ap.add_argument("--history", action="append", default=[],
+                    help="glob of prior runs (e.g. 'BENCH_*.json'); "
+                         "repeatable")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="relative regression tolerance (default 0.05)")
+    args = ap.parse_args(argv)
+
+    fresh = load_run(args.fresh)
+    if not fresh:
+        print(f"bench_trend: no metric lines found in {args.fresh!r} — "
+              f"nothing to gate (treating as pass)")
+        return 0
+
+    history: list[tuple[str, dict[str, dict]]] = []
+    for pattern in (args.history or ["BENCH_*.json"]):
+        for path in sorted(glob.glob(pattern)):
+            if os.path.abspath(path) == os.path.abspath(args.fresh):
+                continue
+            run = load_run(path)
+            if run:
+                history.append((os.path.basename(path), run))
+    if args.baseline and os.path.exists(args.baseline):
+        try:
+            with open(args.baseline) as f:
+                published = json.load(f).get("published") or {}
+        except (ValueError, AttributeError):
+            published = {}
+        if published:
+            history.append((os.path.basename(args.baseline), {
+                str(k): {"value": float(v), "unit": ""}
+                for k, v in published.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            }))
+
+    regressions = 0
+    new = 0
+    untracked = 0
+    rows = []
+    for metric in sorted(fresh):
+        unit = fresh[metric]["unit"]
+        value = fresh[metric]["value"]
+        if unit not in LOWER_IS_BETTER_UNITS + HIGHER_IS_BETTER_UNITS:
+            untracked += 1
+            rows.append((metric, value, unit, "-", "-",
+                         "UNTRACKED (unknown direction)"))
+            continue
+        ref, src = best_reference(history, metric, unit)
+        if ref is None:
+            new += 1
+            rows.append((metric, value, unit, "-", "-", "NEW"))
+            continue
+        if ref == 0:
+            # no relative scale against a zero reference: any move in
+            # the worse direction is a regression, a hold at zero is ok
+            worse = value > 0 if lower_is_better(unit) else value < 0
+            delta = math.inf if worse else 0.0
+        elif lower_is_better(unit):
+            delta = (value - ref) / abs(ref)
+        else:
+            delta = (ref - value) / abs(ref)
+        verdict = "REGRESSED" if delta > args.tolerance else "ok"
+        if verdict == "REGRESSED":
+            regressions += 1
+        rows.append((metric, value, unit, f"{ref} ({src})",
+                     f"{delta * +100:+.1f}%", verdict))
+
+    w = max(len(r[0]) for r in rows)
+    print(f"bench trend vs {len(history)} historical run(s), tolerance "
+          f"{args.tolerance:.1%} (delta = how much WORSE than best):")
+    for metric, value, unit, ref, delta, verdict in rows:
+        print(f"  {metric.ljust(w)}  {value:>10} {unit:<7} "
+              f"best={ref:<28} worse_by={delta:<7} {verdict}")
+    print(
+        f"bench_trend: {len(rows)} metric(s), {regressions} regressed, "
+        f"{new} new, {untracked} untracked — "
+        f"{'FAIL' if regressions else 'PASS'}"
+    )
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

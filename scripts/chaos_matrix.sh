@@ -94,6 +94,13 @@
 # full lint below re-proves the whole standing registry
 # (triton_dist_tpu/synth/admitted.py) at worlds {2, 4, 8} on every run.
 #
+# Since ISSUE 15 the matrix also covers the FLIGHT-RECORDER cells
+# (tests/test_flight_recorder.py): the chaos-marked quick soak must
+# write exactly ONE post-mortem bundle per health-flipping event
+# (resilience/soak.py check_blackbox_invariant) with byte-identical
+# bundles + metrics exports across seeded replays, and the burn-rate
+# alert must fire BEFORE the brownout ladder reaches shed_all_batch.
+#
 # Every cell runs under a wall-clock budget (TDT_CELL_TIMEOUT_S,
 # default 600 s; conftest.py delivers it as a SIGALRM inside the cell):
 # a hung cell reports as one named FAILED row — and so fails the exit
@@ -118,7 +125,8 @@ files="tests/test_chaos.py tests/test_elastic.py \
     tests/test_chunked.py tests/test_chunked_a2a.py tests/test_ragged.py \
     tests/test_emitter.py tests/test_serving.py tests/test_integrity.py \
     tests/test_obs.py tests/test_analysis.py tests/test_overload.py \
-    tests/test_prefix_cache.py tests/test_disagg.py tests/test_synth.py"
+    tests/test_prefix_cache.py tests/test_disagg.py tests/test_synth.py \
+    tests/test_flight_recorder.py"
 marker="chaos"
 lint_args=""
 if [ "${1:-}" = "--quick" ]; then
@@ -126,7 +134,7 @@ if [ "${1:-}" = "--quick" ]; then
     files="tests/test_integrity.py tests/test_serving.py \
         tests/test_elastic.py tests/test_overload.py \
         tests/test_prefix_cache.py tests/test_disagg.py \
-        tests/test_synth.py"
+        tests/test_synth.py tests/test_flight_recorder.py"
     marker="chaos and not slow"
     # keep the quick posture bounded: worlds {2,4} (the full {2,4,8}
     # sweep is the default standalone run's job)

@@ -14,7 +14,13 @@ engine with the overload controller armed, and asserts on every one:
 - serving counters, per-class shed counters, and the health registry
   agree with the terminal census — accounting balances;
 - campaign 0 is re-run from its seed and must reproduce a byte-identical
-  fingerprint — seeded replay.
+  fingerprint — seeded replay;
+- every campaign runs under the armed ISSUE 15 flight recorder and
+  asserts the bundle-per-flip invariant: each health-flipping event
+  (brownout, handoff re-stream/fallback, pool collapse, prefix strike,
+  quarantine, integrity) freezes exactly ONE post-mortem bundle — no
+  duplicates, no misses, no suppression
+  (``resilience.soak.check_blackbox_invariant``).
 
 Since ISSUE 12 the run also includes SHARED-PREFIX campaigns
 (``SoakSpec.shared_prefix``): burst traffic over Zipf shared system

@@ -38,7 +38,16 @@ timeout 1800 python bench.py > "docs/chip_logs/${stamp}_bench_driver_mode.log" 2
 driver_rc=$?
 echo "driver rc=$driver_rc" >> "docs/chip_logs/${stamp}_bench_driver_mode.log"
 
-echo "=== [2b] observability capture (ISSUE 9): span + wait-telemetry trace"
+echo "=== [2b] bench trend gate (ISSUE 15): fresh driver numbers vs BASELINE + BENCH_*.json"
+# per-metric history diff (scripts/bench_trend.py): a slow drift that
+# never crosses a perf_gate.sh floor still fails here, loudly
+python scripts/bench_trend.py "docs/chip_logs/${stamp}_bench_driver_mode.log" \
+  --baseline BASELINE.json --history 'BENCH_*.json' \
+  > "docs/chip_logs/${stamp}_bench_trend.log" 2>&1
+trend_rc=$?
+echo "trend rc=$trend_rc" >> "docs/chip_logs/${stamp}_bench_trend.log"
+
+echo "=== [2c] observability capture (ISSUE 9): span + wait-telemetry trace"
 # A SEPARATE instrumented pass so the observation cost (armed watchdog
 # diag outputs + spin telemetry) can never contaminate the driver-mode
 # numbers above; its timings are not evidence — the artifact is: the
@@ -101,6 +110,7 @@ native_rc=$?
 echo "native serving rc=$native_rc" >> "docs/chip_logs/${stamp}_native_serving.log"
 
 # obs_rc is reported but deliberately NOT in the exit aggregation: the
-# observability capture is a best-effort instrument, never a gate
-echo "rc: tuned=$tuned_rc driver=$driver_rc obs=$obs_rc smoke=$smoke_rc world8=$world_rc pjrt=$pjrt_rc serving=$serving_rc native=$native_rc"
-exit $(( tuned_rc || driver_rc || smoke_rc || world_rc || pjrt_rc || serving_rc || native_rc ))
+# observability capture is a best-effort instrument, never a gate.
+# trend_rc IS a gate (ISSUE 15): a regressed metric fails the session.
+echo "rc: tuned=$tuned_rc driver=$driver_rc trend=$trend_rc obs=$obs_rc smoke=$smoke_rc world8=$world_rc pjrt=$pjrt_rc serving=$serving_rc native=$native_rc"
+exit $(( tuned_rc || driver_rc || trend_rc || smoke_rc || world_rc || pjrt_rc || serving_rc || native_rc ))

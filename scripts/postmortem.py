@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Render black-box incident bundles into human-readable post-mortem
+reports (ISSUE 15, flight-recorder part 3).
+
+A bundle is the deterministic JSON ``triton_dist_tpu/obs/blackbox.py``
+writes the instant a health-flipping event fires (brownout, handoff
+re-stream/fallback, pool collapse, prefix strike, quarantine, integrity
+strike): the trigger, the last-N spans leading in, the full metrics-plane
+snapshot, the wait-telemetry aggregation, the live burn-rate alert
+states, the elastic attribution chain, and the health registry. This CLI
+answers the on-call question — *what fired, which PE/pool/rung, and what
+did the system look like going in* — from the artifact alone, no log
+archaeology.
+
+Dependency-free stdlib CLI::
+
+    python scripts/postmortem.py INCIDENT.json [...]      # bundle files
+    python scripts/postmortem.py --dir BUNDLE_DIR [-n 8]  # whole dir
+    python scripts/postmortem.py --dir DIR --summary      # one-line each
+
+Output is a pure function of the bundle bytes (sorted, no wall clock),
+so two renders of the same bundle are byte-identical — the bench-artifact
+discipline (pinned in tests/test_flight_recorder.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+# the metric series a post-mortem reader wants first: load, pressure,
+# goodput, ladder/terminal counters (everything else prints under -v)
+_HEADLINE_METRICS = (
+    "serving_queue_depth",
+    "serving_slots_occupied",
+    "serving_world_size",
+    "serving_tokens_goodput_per_s",
+    "overload_pressure",
+    "overload_rung",
+    "serving_requests_total",
+    "health_events_total",
+    "handoff_chunk_retries_total",
+    "handoff_restreams_total",
+    "handoff_fallbacks_total",
+    "px_readers_struck",
+    "alerts_total",
+)
+
+
+def load_bundle(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "trigger" not in doc:
+        raise SystemExit(
+            f"postmortem: {path!r} is not an incident bundle (no trigger)"
+        )
+    return doc
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+
+
+def _series_value(row: dict) -> str:
+    v = row.get("value")
+    if isinstance(v, dict):  # histogram snapshot
+        return (f"n={v.get('count', 0)} p50={v.get('p50_ms', 0)} "
+                f"p99={v.get('p99_ms', 0)} max={v.get('max_ms', 0)}")
+    return str(v)
+
+
+def _firing_alerts(bundle: dict) -> list[str]:
+    rules = (bundle.get("alerts") or {}).get("rules", {})
+    out = []
+    for key, row in sorted(rules.items()):
+        if row.get("state") == "firing":
+            out.append(
+                f"{key} FIRING since {row.get('t_s')}s "
+                f"(fast={row.get('fast')}, slow={row.get('slow')})"
+            )
+    return out
+
+
+def summary_line(path: str, bundle: dict) -> str:
+    trig = bundle["trigger"]
+    firing = _firing_alerts(bundle)
+    led = f" alerts_firing={len(firing)}" if firing else " no_alert_led"
+    return (
+        f"{os.path.basename(path)}: [{trig.get('kind')}] "
+        f"{trig.get('family')} @ {trig.get('clock_s')}s — "
+        f"{trig.get('reason')}{led}"
+    )
+
+
+def render(path: str, bundle: dict, *, n_spans: int = 8,
+           verbose: bool = False) -> str:
+    trig = bundle["trigger"]
+    lines = [
+        f"== incident {bundle.get('seq', '?'):>4} · {trig.get('kind')} "
+        f"({trig.get('family')}) ==",
+        f"  at engine clock {trig.get('clock_s')}s: {trig.get('reason')}",
+    ]
+    if trig.get("detail"):
+        lines.append(f"  detail: {json.dumps(trig['detail'], sort_keys=True)}")
+
+    firing = _firing_alerts(bundle)
+    if firing:
+        lines.append("  alerts at the flip (did an alert lead this?):")
+        lines.extend(f"    {row}" for row in firing)
+    else:
+        lines.append("  alerts at the flip: none firing")
+
+    attribution = bundle.get("attribution") or {}
+    peers = attribution.get("peers") or {}
+    if peers:
+        lines.append("  attribution chain (elastic peer states):")
+        for pe, row in sorted(peers.items(), key=lambda kv: int(kv[0])):
+            lines.append(
+                f"    pe{pe}: {row.get('state')} "
+                f"({row.get('strikes')} strike(s))"
+            )
+    else:
+        lines.append("  attribution chain: all peers healthy")
+
+    counters = (bundle.get("health") or {}).get("counters", {})
+    if counters:
+        lines.append("  health counters at the flip:")
+        for key, n in sorted(counters.items()):
+            lines.append(f"    {key} = {n}")
+
+    series = (bundle.get("metrics") or {}).get("series", [])
+    picked = [
+        row for row in series
+        if verbose or row.get("name") in _HEADLINE_METRICS
+    ]
+    lines.append(
+        f"  metrics leading in ({len(picked)}/{len(series)} series"
+        f"{'' if verbose else '; -v for all'}):"
+    )
+    for row in picked:
+        lines.append(
+            f"    {row.get('name')}{_fmt_labels(row.get('labels', {}))} "
+            f"= {_series_value(row)}"
+        )
+
+    spans = bundle.get("spans") or []
+    tail = spans[-n_spans:]
+    lines.append(
+        f"  last spans (newest last; {len(tail)}/{len(spans)} shown):"
+    )
+    for sp in tail:
+        t0, t1 = sp.get("t_start"), sp.get("t_end")
+        dur = "" if t1 is None else f" +{round((t1 - t0) * 1e3, 3)}ms"
+        attrs = sp.get("attrs") or {}
+        keys = ("rung", "reason", "to", "state", "rule", "outcome")
+        notes = " ".join(
+            f"{k}={attrs[k]}" for k in keys if k in attrs
+        )
+        lines.append(
+            f"    {t0:>12.6f}s {sp.get('name')}{dur}"
+            + (f"  [{notes}]" if notes else "")
+        )
+    if not tail:
+        lines.append("    (none recorded — spans disarmed at the flip)")
+
+    wt = bundle.get("wait_telemetry") or {}
+    sites = wt.get("sites") or []
+    if sites:
+        top = sorted(sites, key=lambda s: (-s.get("total_spins", 0),
+                                           s.get("family", "")))[:5]
+        lines.append("  top wait sites by total spins:")
+        for s in top:
+            lines.append(
+                f"    {s.get('family')} site {s.get('site')} "
+                f"({s.get('kind')}): total={s.get('total_spins')} "
+                f"max={s.get('max_spins')}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundles", nargs="*", help="incident bundle JSON files")
+    ap.add_argument("--dir", help="render every incident_*.json in DIR")
+    ap.add_argument("-n", type=int, default=8, help="spans shown per bundle")
+    ap.add_argument("--summary", action="store_true",
+                    help="one line per bundle instead of full reports")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print every metric series, not just headliners")
+    args = ap.parse_args(argv)
+
+    paths = list(args.bundles)
+    if args.dir:
+        paths.extend(sorted(glob.glob(os.path.join(args.dir,
+                                                   "incident_*.json"))))
+    if not paths:
+        ap.error("no bundles: pass files or --dir DIR")
+
+    first = True
+    for path in paths:
+        bundle = load_bundle(path)
+        if args.summary:
+            print(summary_line(path, bundle))
+            continue
+        if not first:
+            print()
+        first = False
+        print(render(path, bundle, n_spans=args.n, verbose=args.verbose))
+    if not args.summary:
+        print()
+        print(f"{len(paths)} incident bundle(s) rendered")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:     # report piped into head/less and closed
+        sys.exit(0)

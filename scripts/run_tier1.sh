@@ -6,8 +6,8 @@
 # Three phases:
 #   1. the full tier-1 suite (everything not marked `slow`, 870 s budget,
 #      CPU backend, 8 virtual devices via tests/conftest.py — the tests/
-#      glob picks up tests/test_serving.py, the serving-engine suite,
-#      automatically);
+#      glob picks up tests/test_serving.py and the ISSUE 15
+#      tests/test_flight_recorder.py automatically);
 #   2. the static protocol lint (scripts/protocol_lint.py --quick,
 #      ISSUE 10): every fused family's signal graph proved
 #      credit-balanced and deadlock-free from a recorded trace — needs no

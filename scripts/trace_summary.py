@@ -12,17 +12,26 @@ tables a chip session pastes straight into its log:
 - top-N slowest spans (which op entries / serving phases cost the time),
   with their ladder rung when recorded.
 
+Since ISSUE 15, ``--incidents DIR`` folds that directory's black-box
+post-mortem bundles (``obs/blackbox.py``) into a third table — trigger
+kind, family, engine-clock time, whether a burn-rate alert was firing
+when the flip landed, and the attributed culprit PEs — so ONE command
+answers "where did the run stall *and* what broke"
+(``scripts/postmortem.py`` renders any single bundle in full).
+
 Dependency-free stdlib CLI::
 
     python scripts/trace_summary.py docs/chip_logs/obs_trace.json [-n 15]
-    python bench.py --obs-trace /tmp/obs.json && \\
-        python scripts/trace_summary.py /tmp/obs.json
+    python scripts/trace_summary.py obs.json --incidents bundles/
+    python scripts/trace_summary.py --incidents bundles/   # bundles only
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import sys
 
 
@@ -74,6 +83,47 @@ def span_rows(events: list[dict]) -> list[dict]:
     return rows
 
 
+def incident_rows(paths: list[str]) -> list[dict]:
+    """One row per post-mortem bundle: what fired, when, whether an
+    alert led it, and the attributed culprit PEs."""
+    rows = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                b = json.load(f)
+        except (OSError, ValueError):
+            continue
+        trig = b.get("trigger") or {}
+        firing = [
+            key for key, row in sorted(
+                ((b.get("alerts") or {}).get("rules") or {}).items()
+            )
+            if row.get("state") == "firing"
+        ]
+        peers = (b.get("attribution") or {}).get("peers") or {}
+        culprits = ",".join(
+            f"pe{pe}:{row.get('state')}"
+            for pe, row in sorted(peers.items(), key=lambda kv: int(kv[0]))
+        )
+        rows.append({
+            "bundle": os.path.basename(path),
+            "kind": trig.get("kind", "?"),
+            "family": trig.get("family", "?"),
+            "clock_s": trig.get("clock_s", ""),
+            "alerts_firing": ";".join(firing) or "-",
+            "culprits": culprits or "-",
+            "reason": (trig.get("reason") or "")[:60],
+        })
+    # clock_s may be missing on a truncated/foreign bundle (shown as "");
+    # never let str-vs-float comparison take the whole summary down
+    rows.sort(key=lambda r: (
+        not isinstance(r["clock_s"], (int, float)),
+        r["clock_s"] if isinstance(r["clock_s"], (int, float)) else 0.0,
+        r["bundle"],
+    ))
+    return rows
+
+
 def _table(rows: list[dict], cols: list[tuple[str, str]], n: int) -> str:
     if not rows:
         return "  (none)"
@@ -92,9 +142,32 @@ def _table(rows: list[dict], cols: list[tuple[str, str]], n: int) -> str:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="obs chrome-trace JSON path")
+    ap.add_argument("trace", nargs="?",
+                    help="obs chrome-trace JSON path (optional when "
+                         "--incidents is given)")
     ap.add_argument("-n", type=int, default=10, help="rows per table")
+    ap.add_argument("--incidents", metavar="DIR",
+                    help="fold DIR's black-box incident bundles into the "
+                         "summary (ISSUE 15)")
     args = ap.parse_args(argv)
+    if args.trace is None and args.incidents is None:
+        ap.error("need a trace path and/or --incidents DIR")
+
+    if args.incidents is not None:
+        paths = sorted(glob.glob(
+            os.path.join(args.incidents, "incident_*.json")
+        ))
+        incidents = incident_rows(paths)
+        print(f"== incidents ({len(incidents)} bundle(s) in "
+              f"{args.incidents}) ==")
+        print(_table(incidents, [
+            ("clock_s", "clock_s"), ("kind", "kind"), ("family", "family"),
+            ("alerts_firing", "alerts_firing"), ("culprits", "culprits"),
+            ("reason", "reason"),
+        ], max(args.n, len(incidents))))
+        if args.trace is None:
+            return 0
+        print()
 
     events = load_events(args.trace)
     waits = wait_rows(events)

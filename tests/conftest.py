@@ -150,10 +150,22 @@ def _resilience_isolation():
     this jax install can build fused kernels doesn't change per test, and
     re-paying the failing trace hundreds of times would)."""
     from triton_dist_tpu import resilience
+    from triton_dist_tpu.obs import alerts, blackbox, metrics
+
+    def _flight_recorder_reset():
+        # the ISSUE 15 flight-recorder registries are process-global like
+        # the health registry: series/alerts/bundle census recorded by an
+        # armed test must not leak into the next one (the tracer ring and
+        # telemetry aggregation stay: test_obs manages those explicitly)
+        metrics.reset()
+        alerts.reset()
+        blackbox.reset()
 
     resilience.reset(keep_env=True)
+    _flight_recorder_reset()
     yield
     resilience.reset(keep_env=True)
+    _flight_recorder_reset()
 
 
 @pytest.fixture(scope="session")

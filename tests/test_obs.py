@@ -375,8 +375,11 @@ def test_obs_snapshot_merges_surfaces():
             pass
     health.record_downgrade("famx", "because")
     snap = obs.snapshot()
-    assert set(snap) == {"spans", "dropped_spans", "wait_telemetry",
-                        "health", "serving"}
+    # the always-present sections of the versioned schema (ISSUE 15:
+    # flight-recorder sections appear only when their tier is armed)
+    assert set(snap) == {"schema", "spans", "dropped_spans",
+                         "wait_telemetry", "health", "serving"}
+    assert snap["schema"] == obs.SNAPSHOT_SCHEMA
     assert "op:x" in snap["spans"]
     assert "famx:downgrade" in snap["health"]["counters"]
     json.dumps(snap)

@@ -74,6 +74,8 @@ import dataclasses
 
 import numpy as np
 
+from triton_dist_tpu.obs import metrics as _mx
+
 # counter keys (monotone; the serving engine folds them across batcher
 # rebuilds) vs gauges (instantaneous; snapshots read the live batcher's)
 PX_COUNTERS = (
@@ -171,6 +173,13 @@ class PagePrefixCache:
 
     # -- small helpers --------------------------------------------------
 
+    def _bump(self, key: str, n: int = 1) -> None:
+        """One counter increment, mirrored into the obs metrics plane
+        (ISSUE 15: ``px_<key>`` labeled counters — a no-op while the
+        plane is disarmed, so the pre-metrics cache is byte-identical)."""
+        self._c[key] += n
+        _mx.counter(f"px_{key}", n, family="prefix_cache")
+
     def _tick(self) -> int:
         self._clock += 1
         return self._clock
@@ -260,7 +269,7 @@ class PagePrefixCache:
                 "evicting a referenced page — refcount monotonicity broken"
             )
             self._free_page(self._pe_of(nd.depth), nd.phys)
-            self._c["evicted_pages"] += 1
+            self._bump("evicted_pages")
             stack.extend(nd.children.values())
             nd.children = {}
 
@@ -281,7 +290,7 @@ class PagePrefixCache:
         prompt = [int(t) for t in prompt]
         pg = self.page
         L = len(prompt)
-        self._c["lookups"] += 1
+        self._bump("lookups")
         cap_pages = (L - 1) // pg      # keep >= 1 fed token (docstring)
         node, chain = self._root, []
         while len(chain) < cap_pages:
@@ -298,11 +307,11 @@ class PagePrefixCache:
             nd.last_use = self._tick()
         n_hit = len(chain) * pg
         if chain:
-            self._c["hits"] += 1
-            self._c["hit_pages"] += len(chain)
-            self._c["prefill_tokens_saved"] += n_hit
+            self._bump("hits")
+            self._bump("hit_pages", len(chain))
+            self._bump("prefill_tokens_saved", n_hit)
         else:
-            self._c["misses"] += 1
+            self._bump("misses")
         # every logical page the request can touch: validate_request pinned
         # L + max_new <= s_max, so needed never exceeds pps_global
         needed = min(-(-(L + max_new_tokens) // pg), self.pps_global)
@@ -312,7 +321,7 @@ class PagePrefixCache:
             if chain and g == len(chain):
                 # the CoW page proper: the one claimed fresh at the first
                 # divergent token (later privates exist for generation)
-                self._c["cow_pages"] += 1
+                self._bump("cow_pages")
         for g, nd in enumerate(chain):
             self._set(slot, g, nd.phys)
         for g, phys in priv.items():
@@ -354,14 +363,14 @@ class PagePrefixCache:
             node.last_use = self._tick()
             chain.append(node)
             self._set(slot, g, node.phys)
-            self._c["deduped_publishes"] += 1
+            self._bump("deduped_publishes")
             return True
         node = _Node(key, parent, phys, g)
         node.ref = 1                  # the publisher reads its own page
         node.last_use = self._tick()
         parent.children[key] = node
         chain.append(node)
-        self._c["published_pages"] += 1
+        self._bump("published_pages")
         return False
 
     def release(self, slot: int, strike: bool = False) -> list[int]:
@@ -380,7 +389,7 @@ class PagePrefixCache:
             for j in range(self.n_slots):
                 if j != slot and self._chain[j] and self._chain[j][0] is top:
                     readers.append(j)
-            self._c["readers_struck"] += len(readers)
+            self._bump("readers_struck", len(readers))
         for nd in chain:
             nd.ref -= 1
             if nd.ref == 0 and nd.detached:
@@ -400,7 +409,7 @@ class PagePrefixCache:
         while stack:
             nd = stack.pop()
             nd.detached = True
-            self._c["struck_pages"] += 1
+            self._bump("struck_pages")
             stack.extend(nd.children.values())
             nd.children = {}
             if nd.ref == 0:

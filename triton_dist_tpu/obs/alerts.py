@@ -1,0 +1,352 @@
+"""SLO burn-rate alerts (ISSUE 15, flight-recorder part 2):
+multi-window rules evaluated on the engine clock, so alerts LEAD the
+degradation ladder instead of narrating it after the fact.
+
+The classic SRE shape: each rule watches one signal through a FAST and a
+SLOW window pair and fires only when BOTH breach — the fast window makes
+the alert lead (a real burn shows up within ~a second of engine time),
+the slow window keeps a single bad step from paging anyone. Rules are
+pure functions of caller-supplied timestamps and samples (the serving
+engine feeds its injectable clock), so a FakeClock run fires
+byte-identically every replay.
+
+Signals (``AlertRule.signal``):
+
+- ``slo_miss_frac`` — fraction of scored requests in the window that
+  missed goodput (SLO dims + deadline); the goodput-burn rule.
+- ``ttft_p99_ms`` — windowed p99 of first-token latency, thresholded at
+  a multiple of the SLO target (rule auto-derived when the engine has a
+  ``ttft_ms`` SLO; absent otherwise).
+- ``handoff_retry_rate`` — handoff-ladder retries+restreams per
+  transfer in the window (the disaggregated topology feeds it).
+- ``health_flip_rate`` — health-flipping events per second of engine
+  time (``resilience.health.flip_total()`` deltas).
+
+Firing/resolving emits a typed :class:`AlertEvent`; the engine records
+each as a ``health.record_alert`` event (kind ``alert`` —
+informational: the alert predicts the flip, the degradation itself
+flips ``is_healthy``), an ``obs:alert`` span instant, and an
+``alerts_total`` metrics-plane counter. The ordering contract — the
+goodput-burn alert fires BEFORE the brownout ladder reaches
+``shed_all_batch`` in a seeded overload run — is pinned in
+tests/test_flight_recorder.py: the engine evaluates alerts after
+scoring each step's finishes and before the ladder observes them, and
+the fast window breaches on the first scored misses while
+``shed_all_batch`` still needs the miss term to push pressure past its
+last enter threshold.
+
+The process-wide :func:`state_snapshot` registry (every engine's live
+rule states + fire/resolve counters) is what the black box freezes into
+each post-mortem bundle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import deque
+
+SIGNALS = ("slo_miss_frac", "ttft_p99_ms", "handoff_retry_rate",
+           "health_flip_rate")
+FIRING = "firing"
+RESOLVED = "resolved"
+OK = "ok"
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    """One multi-window burn-rate rule. Fires when the fast AND slow
+    window values both reach their thresholds (with at least
+    ``min_count`` scored samples/denominator in the fast window);
+    resolves when both fall below ``clear_ratio`` × their thresholds —
+    the hysteresis band, so a rule cannot flap around one threshold."""
+
+    name: str
+    signal: str
+    fast_s: float = 0.5
+    slow_s: float = 2.5
+    fast_threshold: float = 0.5
+    slow_threshold: float = 0.25
+    min_count: int = 1
+    clear_ratio: float = 0.8
+
+    def validate(self) -> "AlertRule":
+        if self.signal not in SIGNALS:
+            raise ValueError(
+                f"AlertRule.signal must be one of {SIGNALS}, got "
+                f"{self.signal!r}"
+            )
+        if not 0 < self.fast_s <= self.slow_s:
+            raise ValueError(
+                f"need 0 < fast_s <= slow_s, got {self.fast_s}/{self.slow_s}"
+            )
+        if self.min_count < 1:
+            raise ValueError("min_count must be >= 1")
+        if not 0.0 < self.clear_ratio <= 1.0:
+            raise ValueError("clear_ratio must be in (0, 1]")
+        return self
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertConfig:
+    """Arms burn-rate alerting via ``ObsConfig(alerts=AlertConfig())``.
+
+    rules:        explicit rule tuple, or () = the default set (goodput
+                  burn, handoff-retry burn, health-flip burn, plus a
+                  TTFT-p99 burn when the engine carries a ``ttft_ms``
+                  SLO target).
+    fast_s/slow_s: window pair applied to the default rules (engine-
+                  clock seconds; virtual-clock scale in tests/bench).
+    ttft_factor_fast/slow: the TTFT rule's thresholds as multiples of
+                  the SLO target.
+    """
+
+    rules: tuple = ()
+    fast_s: float = 0.5
+    slow_s: float = 2.5
+    ttft_factor_fast: float = 2.0
+    ttft_factor_slow: float = 1.5
+
+    def validate(self) -> "AlertConfig":
+        if not 0 < self.fast_s <= self.slow_s:
+            raise ValueError(
+                f"need 0 < fast_s <= slow_s, got {self.fast_s}/{self.slow_s}"
+            )
+        if self.ttft_factor_fast < self.ttft_factor_slow:
+            raise ValueError(
+                "ttft_factor_fast must be >= ttft_factor_slow (the fast "
+                "window is the steeper burn)"
+            )
+        for r in self.rules:
+            r.validate()
+        return self
+
+    def resolve_rules(self, slo_ttft_ms: float | None = None) -> tuple:
+        """The live rule set for one engine (defaults unless explicit)."""
+        if self.rules:
+            return tuple(r.validate() for r in self.rules)
+        w = dict(fast_s=self.fast_s, slow_s=self.slow_s)
+        rules = [
+            AlertRule("goodput_burn", "slo_miss_frac",
+                      fast_threshold=0.5, slow_threshold=0.25, **w),
+            AlertRule("handoff_retry_burn", "handoff_retry_rate",
+                      fast_threshold=0.5, slow_threshold=0.2, **w),
+            AlertRule("health_flip_burn", "health_flip_rate",
+                      fast_threshold=2.0, slow_threshold=0.5, **w),
+        ]
+        if slo_ttft_ms:
+            rules.append(AlertRule(
+                "ttft_p99_burn", "ttft_p99_ms",
+                fast_threshold=self.ttft_factor_fast * slo_ttft_ms,
+                slow_threshold=self.ttft_factor_slow * slo_ttft_ms,
+                min_count=4, **w,
+            ))
+        return tuple(r.validate() for r in rules)
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertEvent:
+    """One rule transition (fired or resolved), as the engine records it
+    into health/obs/metrics."""
+
+    rule: str
+    signal: str
+    state: str        # "firing" | "resolved"
+    t_s: float
+    fast: float
+    slow: float
+
+
+# --- the process-wide state registry (what the black box freezes) ----------
+
+_lock = threading.Lock()
+_active: dict[tuple, dict] = {}     # (family, rule) -> state row
+_counters: dict[str, int] = {}      # f"{family}:{rule}:{state}" -> n
+
+
+def _register(family: str, ev: AlertEvent) -> None:
+    with _lock:
+        _active[(family, ev.rule)] = {
+            "signal": ev.signal, "state": ev.state,
+            "t_s": round(ev.t_s, 9),
+            "fast": round(ev.fast, 6), "slow": round(ev.slow, 6),
+        }
+        key = f"{family}:{ev.rule}:{ev.state}"
+        _counters[key] = _counters.get(key, 0) + 1
+
+
+def state_snapshot() -> dict:
+    """Every engine's live rule states + fire/resolve counters,
+    deterministically ordered — frozen into each post-mortem bundle and
+    folded into ``obs.snapshot()``."""
+    with _lock:
+        return {
+            "rules": {
+                f"{fam}:{rule}": dict(row)
+                for (fam, rule), row in sorted(_active.items())
+            },
+            "counters": dict(sorted(_counters.items())),
+        }
+
+
+def reset() -> None:
+    with _lock:
+        _active.clear()
+        _counters.clear()
+
+
+# --- per-engine evaluation --------------------------------------------------
+
+def resolve_engine(*, family: str,
+                   slo_ttft_ms: "float | None" = None) -> "AlertEngine | None":
+    """The serving engines' lazy-arming seam: an :class:`AlertEngine`
+    when ``ObsConfig.alerts`` is armed right now, else None (one shared
+    resolution for ServingEngine, the pool engines, and the disagg
+    coordinator)."""
+    from triton_dist_tpu import config as tdt_config
+
+    ocfg = tdt_config.get_config().obs
+    acfg = None if ocfg is None else getattr(ocfg, "alerts", None)
+    if acfg is None:
+        return None
+    return AlertEngine(acfg, family=family, slo_ttft_ms=slo_ttft_ms)
+
+
+def evaluate_and_record(ae: "AlertEngine", now: float, *, count,
+                        obs_tag: str = "") -> "list[AlertEvent]":
+    """Advance ``ae`` and record every transition everywhere the flight
+    recorder promises — the engine's own counter (``count``, e.g.
+    ``ServingMetrics.count``: ``alerts_firing``/``alerts_resolved``), a
+    health event (kind ``alert``), an ``obs:alert`` span instant on the
+    engine track, and an ``alerts_total`` metrics-plane counter. ONE
+    recording contract for every engine tier (unified / pool / disagg
+    coordinator), so the surfaces can never silently diverge."""
+    from triton_dist_tpu import obs as _obs
+    from triton_dist_tpu.obs import metrics as _metrics
+    from triton_dist_tpu.resilience import health as _health
+
+    out = ae.evaluate(now)
+    for ev in out:
+        count(f"alerts_{ev.state}")
+        _health.record_alert(ae.family, ev.rule, ev.state,
+                             signal=ev.signal, fast=ev.fast, slow=ev.slow)
+        _obs.record_span(
+            "obs:alert", ev.t_s, ev.t_s, cat="obs", track=f"{obs_tag}engine",
+            rule=ev.rule, state=ev.state, signal=ev.signal,
+            fast=round(ev.fast, 6), slow=round(ev.slow, 6),
+        )
+        _metrics.counter("alerts_total", engine=ae.family, rule=ev.rule,
+                         state=ev.state)
+    return out
+
+
+class AlertEngine:
+    """One engine's burn-rate evaluator. All time arrives from the
+    caller (the engine's injectable clock); nothing here reads a wall
+    clock or an RNG, so seeded serve runs alert byte-identically."""
+
+    def __init__(self, config: AlertConfig, *, family: str,
+                 slo_ttft_ms: float | None = None):
+        self.config = config.validate()
+        self.family = str(family)
+        self.rules = self.config.resolve_rules(slo_ttft_ms)
+        horizon = max((r.slow_s for r in self.rules), default=1.0)
+        self._horizon = horizon
+        # sample streams, pruned to the slowest window
+        self._miss: deque = deque()       # (t, missed 0/1)
+        self._ttft: deque = deque()       # (t, ttft_ms)
+        self._handoff: deque = deque()    # (t, retries, transfers)
+        self._flips: deque = deque()      # (t, new_flips)
+        self._flip_total = 0
+        self.states = {r.name: OK for r in self.rules}
+        self.events: list[AlertEvent] = []
+
+    # -- feeds ----------------------------------------------------------
+
+    def observe_request(self, now: float, *, slo_ok: bool,
+                        ttft_ms: float) -> None:
+        self._miss.append((float(now), 0 if slo_ok else 1))
+        self._ttft.append((float(now), float(ttft_ms)))
+
+    def observe_handoff(self, now: float, *, retries: int,
+                        transfers: int = 1) -> None:
+        self._handoff.append((float(now), int(retries), int(transfers)))
+
+    def observe_flips(self, now: float, flip_total: int) -> None:
+        """Feed the CUMULATIVE health flip count; deltas are derived."""
+        new = max(0, int(flip_total) - self._flip_total)
+        self._flip_total = int(flip_total)
+        if new:
+            self._flips.append((float(now), new))
+
+    # -- evaluation -----------------------------------------------------
+
+    def _prune(self, now: float) -> None:
+        lo = now - self._horizon
+        for dq in (self._miss, self._ttft, self._handoff, self._flips):
+            while dq and dq[0][0] < lo:
+                dq.popleft()
+
+    def _window(self, dq: deque, now: float, w: float) -> list:
+        lo = now - w
+        return [row for row in dq if row[0] >= lo]
+
+    def _value(self, rule: AlertRule, now: float, w: float):
+        """(value, count) of ``rule.signal`` over the trailing window
+        ``w`` — count is the sample/denominator volume ``min_count``
+        gates on."""
+        if rule.signal == "slo_miss_frac":
+            rows = self._window(self._miss, now, w)
+            n = len(rows)
+            return ((sum(m for _, m in rows) / n) if n else 0.0, n)
+        if rule.signal == "ttft_p99_ms":
+            vals = sorted(v for _, v in self._window(self._ttft, now, w))
+            n = len(vals)
+            if not n:
+                return 0.0, 0
+            return vals[min(n - 1, int(0.99 * n))], n
+        if rule.signal == "handoff_retry_rate":
+            rows = self._window(self._handoff, now, w)
+            tr = sum(t for _, _, t in rows)
+            return ((sum(r for _, r, _ in rows) / tr) if tr else 0.0, tr)
+        # health_flip_rate: flips per second of engine time
+        rows = self._window(self._flips, now, w)
+        return sum(n for _, n in rows) / w, len(rows)
+
+    def evaluate(self, now: float) -> list[AlertEvent]:
+        """Advance every rule against the trailing windows; returns the
+        transitions (fired/resolved) for the engine to record."""
+        now = float(now)
+        self._prune(now)
+        out: list[AlertEvent] = []
+        for rule in self.rules:
+            fast, n_fast = self._value(rule, now, rule.fast_s)
+            slow, _ = self._value(rule, now, rule.slow_s)
+            state = self.states[rule.name]
+            if (state != FIRING and n_fast >= rule.min_count
+                    and fast >= rule.fast_threshold
+                    and slow >= rule.slow_threshold):
+                ev = AlertEvent(rule=rule.name, signal=rule.signal,
+                                state=FIRING, t_s=now, fast=fast, slow=slow)
+            elif (state == FIRING
+                  and fast < rule.fast_threshold * rule.clear_ratio
+                  and slow < rule.slow_threshold * rule.clear_ratio):
+                ev = AlertEvent(rule=rule.name, signal=rule.signal,
+                                state=RESOLVED, t_s=now, fast=fast,
+                                slow=slow)
+            else:
+                continue
+            self.states[rule.name] = ev.state
+            self.events.append(ev)
+            _register(self.family, ev)
+            out.append(ev)
+        return out
+
+    def snapshot(self) -> dict:
+        return {
+            "rules": {
+                r.name: {"signal": r.signal, "state": self.states[r.name]}
+                for r in self.rules
+            },
+            "events": len(self.events),
+        }

@@ -1,0 +1,246 @@
+"""The black box (ISSUE 15, flight-recorder part 3): deterministic
+post-mortem incident bundles, written the instant a trigger-set health
+event fires — so the question "what did the system look like when the
+guard/brownout/handoff ladder tripped?" has an artifact, not a log
+archaeology session.
+
+Trigger set (:data:`BLACKBOX_KINDS` — deliberately NARROWER than
+``health.FLIP_KINDS``: per-request flips like ``shed``/``poisoned`` and
+the per-call ``downgrade``/``timeout`` would bundle-storm under exactly
+the load a post-mortem reader cares about; the ladder transition that
+CAUSED them is the incident): brownout-ladder transitions,
+handoff re-streams and decode-local fallbacks, pool collapse, prefix
+strikes, PE quarantines, and detected corruption. The hook rides
+``resilience/health.py``'s single ``_record`` funnel (called OUTSIDE
+its lock), so exactly ONE bundle lands per flipping event — no
+duplicates, no misses (the chaos-soak invariant,
+``resilience/soak.py``).
+
+Each bundle is one JSON file, ``incident_{seq:04d}_{kind}.json`` in
+``BlackboxConfig.dir``, written atomically (tmp + rename — a killed run
+leaves valid JSON) with sorted keys and NO wall-clock timestamps (the
+only clock read is the injectable resilience clock), so two FakeClock
+replays of the same seeded campaign produce **byte-identical** bundles
+(``cmp``-verified in tests/test_flight_recorder.py). Layout
+(``schema: tdt-incident-v1``; docs/observability.md "Black box"):
+
+- ``trigger`` — the health event (kind / family / reason / detail) and
+  its injectable-clock timestamp;
+- ``spans`` — the last ``last_spans`` finished spans from the tracer
+  ring (the seconds of lifecycle leading into the incident);
+- ``metrics`` — the full metrics-plane JSON snapshot at the instant of
+  the flip (the "10 seconds of metrics leading in": every counter,
+  gauge, and histogram as it stood);
+- ``wait_telemetry`` — the per-(family, site, kind) spin aggregation;
+- ``alerts`` — the live burn-rate rule states (did an alert lead this?);
+- ``attribution`` — ``resilience.elastic.summary()``: per-PE strike
+  counts and quarantine states — the chain that names the culprit;
+- ``health`` — counters + the last events (walltime stripped).
+
+Bundles past ``max_bundles`` are SUPPRESSED AND COUNTED
+(``census()["suppressed"]`` — no silent caps); the soak invariant
+requires zero suppression, so a campaign that out-writes its bound
+fails loudly instead of silently losing its tail.
+
+``scripts/postmortem.py`` renders a bundle (or a directory of them)
+into the human-readable incident report; ``scripts/trace_summary.py
+--incidents DIR`` folds them into its tables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+
+INCIDENT_SCHEMA = "tdt-incident-v1"
+
+# the health kinds that write a bundle (ISSUE 15 trigger set — each one
+# means refused/degraded/struck work; resilience/health.py owns the
+# kind vocabulary)
+BLACKBOX_KINDS = (
+    "brownout",
+    "handoff_restream",
+    "handoff_fallback",
+    "pool_collapse",
+    "prefix_strike",
+    "pe_quarantine",
+    "integrity",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BlackboxConfig:
+    """Arms the black box via ``ObsConfig(blackbox=BlackboxConfig(dir))``.
+
+    dir:         where incident bundles land (created on first write).
+    last_spans:  finished spans frozen into each bundle (newest last).
+    max_bundles: bundle bound per arming — excess flips are suppressed
+                 AND counted (never silently dropped).
+    kinds:       the triggering health kinds (default
+                 :data:`BLACKBOX_KINDS`).
+    """
+
+    dir: str
+    last_spans: int = 64
+    max_bundles: int = 256
+    kinds: tuple = BLACKBOX_KINDS
+
+    def validate(self) -> "BlackboxConfig":
+        if not self.dir:
+            raise ValueError("BlackboxConfig.dir must be a directory path")
+        if self.last_spans < 0:
+            raise ValueError("last_spans must be >= 0")
+        if self.max_bundles < 1:
+            raise ValueError("max_bundles must be >= 1")
+        unknown = set(self.kinds) - set(BLACKBOX_KINDS)
+        if unknown:
+            raise ValueError(
+                f"unknown blackbox kinds {sorted(unknown)}; known: "
+                f"{BLACKBOX_KINDS}"
+            )
+        return self
+
+
+_lock = threading.Lock()
+_seq = 0
+_suppressed = 0
+_by_kind: dict[str, int] = {}
+_files: list[str] = []
+
+
+def _cfg() -> "BlackboxConfig | None":
+    from triton_dist_tpu import config as tdt_config
+
+    obs = tdt_config.get_config().obs
+    return None if obs is None else getattr(obs, "blackbox", None)
+
+
+def enabled() -> bool:
+    return _cfg() is not None
+
+
+def _jsonable(v):
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    return repr(v)
+
+
+def on_health_event(ev) -> "str | None":
+    """The health-registry hook (``health._record`` calls this outside
+    its lock): write one bundle when ``ev.kind`` is a triggering kind
+    and the black box is armed. Returns the bundle path (None when
+    disarmed / non-triggering / suppressed). Never raises into the
+    recording path — an observability failure must not take down the
+    recovery it observes."""
+    cfg = _cfg()
+    if cfg is None or ev.kind not in cfg.kinds:
+        return None
+    global _seq, _suppressed
+    with _lock:
+        if _seq >= cfg.max_bundles:
+            # the suppression is accounted ONLY in _suppressed: by_kind
+            # counts bundles actually written (the soak census compares
+            # it against the health flip counters)
+            _suppressed += 1
+            return None
+        seq = _seq
+        _seq += 1
+        _by_kind[ev.kind] = _by_kind.get(ev.kind, 0) + 1
+    try:
+        path = _write_bundle(cfg, seq, ev)
+    except Exception as e:  # pragma: no cover - disk-full etc.
+        # the docstring contract: an observability failure (disk, an
+        # un-serializable snapshot shape) must not take down the
+        # recovery path that just recorded the flip
+        import sys
+
+        print(f"obs.blackbox: bundle write failed: {e}", file=sys.stderr,
+              flush=True)
+        return None
+    with _lock:
+        _files.append(os.path.basename(path))
+    return path
+
+
+def _write_bundle(cfg: BlackboxConfig, seq: int, ev) -> str:
+    from triton_dist_tpu.obs import alerts as _alerts
+    from triton_dist_tpu.obs import metrics as _metrics
+    from triton_dist_tpu.obs import telemetry as _telemetry
+    from triton_dist_tpu.obs import tracer as _tracer
+    from triton_dist_tpu.resilience import elastic, health
+    from triton_dist_tpu.resilience import retry as _retry
+
+    spans = _tracer.spans()[-cfg.last_spans:] if cfg.last_spans else []
+    with health._lock:
+        counters = {f"{f}:{k}": n
+                    for (f, k), n in sorted(health._counters.items())}
+        # explicit field selection drops the event's walltime stamp —
+        # bundle bytes must be a pure function of the seeded run
+        last_events = [
+            {"kind": e.kind, "family": e.family, "reason": e.reason,
+             "detail": _jsonable(e.detail)}
+            for e in list(health._events)[-16:]
+        ]
+    bundle = {
+        "schema": INCIDENT_SCHEMA,
+        "seq": seq,
+        "trigger": {
+            "kind": ev.kind,
+            "family": ev.family,
+            "reason": ev.reason,
+            "detail": _jsonable(ev.detail),
+            "clock_s": round(_retry.get_clock().monotonic(), 9),
+        },
+        "spans": [
+            {
+                "name": sp.name, "cat": sp.cat, "track": sp.track,
+                "t_start": round(sp.t_start, 9),
+                "t_end": None if sp.t_end is None else round(sp.t_end, 9),
+                "depth": sp.depth, "seq": sp.seq,
+                "attrs": _jsonable(sp.attrs),
+            }
+            for sp in spans
+        ],
+        "metrics": _metrics.json_snapshot(),
+        "wait_telemetry": _telemetry.wait_summary(),
+        "alerts": _alerts.state_snapshot(),
+        "attribution": _jsonable(elastic.summary()),
+        "health": {
+            "counters": counters,
+            "last_events": last_events,
+        },
+    }
+    os.makedirs(cfg.dir, exist_ok=True)
+    path = os.path.join(cfg.dir, f"incident_{seq:04d}_{ev.kind}.json")
+    text = json.dumps(bundle, indent=1, sort_keys=True,
+                      separators=(",", ": ")) + "\n"
+    return _metrics._atomic_write(path, text)
+
+
+def census() -> dict:
+    """Bundle accounting: written / suppressed / by-kind / filenames —
+    what the soak's bundle-per-flip invariant and ``obs.snapshot()``
+    read."""
+    with _lock:
+        return {
+            "written": len(_files),
+            "requested": _seq,
+            "suppressed": _suppressed,
+            "by_kind": dict(sorted(_by_kind.items())),
+            "files": sorted(_files),
+        }
+
+
+def reset() -> None:
+    global _seq, _suppressed
+    with _lock:
+        _seq = 0
+        _suppressed = 0
+        _by_kind.clear()
+        _files.clear()

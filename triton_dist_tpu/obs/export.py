@@ -16,10 +16,21 @@ Two surfaces:
   timestamp comes from the injectable clock, so a FakeClock run exports
   byte-identically (asserted in tests/test_obs.py).
 - :func:`snapshot` — one JSON-able dict merging span stats, the wait
-  telemetry summary, ``resilience.health.snapshot()``, and the snapshot
+  telemetry summary, ``resilience.health.snapshot()``, the snapshot
   of every live :class:`~triton_dist_tpu.serving.engine.ServingEngine`
   (engines self-register at construction; weakly, so a dead engine never
-  pins memory or shows up as a ghost).
+  pins memory or shows up as a ghost), and — when the flight recorder is
+  armed (ISSUE 15) — the metrics plane, the live alert states, and the
+  black-box bundle census.
+
+The top-level snapshot key set is THE versioned schema
+(:data:`SNAPSHOT_SCHEMA` / :data:`SNAPSHOT_SECTIONS`): every section an
+``obs.snapshot()`` / ``bench.py --health-json`` artifact may carry is
+registered here with its contract, :func:`validate_snapshot` refuses
+unknown keys at snapshot time, and serving-engine snapshots are held to
+the :data:`ENGINE_SECTIONS` registry by the schema test
+(tests/test_flight_recorder.py) — a future section must register or it
+cannot land (no silent schema collisions).
 """
 
 from __future__ import annotations
@@ -31,6 +42,58 @@ from typing import Any
 
 from triton_dist_tpu.obs import telemetry as _telemetry
 from triton_dist_tpu.obs import tracer as _tracer
+
+# the versioned snapshot schema (ISSUE 15 satellite): bump the suffix on
+# any INCOMPATIBLE change to a registered section's shape
+SNAPSHOT_SCHEMA = "tdt-snapshot-v1"
+
+# obs.snapshot() / --health-json top-level sections. "always" sections
+# appear in every snapshot; "armed" ones only with their tier armed —
+# so a disarmed snapshot stays byte-identical to its pre-flight-recorder
+# self (the arming discipline).
+SNAPSHOT_SECTIONS = {
+    "schema": "always: the SNAPSHOT_SCHEMA version string",
+    "spans": "always: per-name span duration stats (tracer.span_stats)",
+    "dropped_spans": "always: span-ring evictions (counted, never silent)",
+    "wait_telemetry": "always: per-(family, site, kind) spin aggregation",
+    "health": "always: resilience.health.snapshot() (elastic included)",
+    "serving": "always: live serving engines' snapshots (None when none)",
+    "metrics": "armed (ObsConfig.metrics): metrics-plane JSON snapshot",
+    "alerts": "armed (ObsConfig.alerts): live burn-rate rule states",
+    "blackbox": "armed (ObsConfig.blackbox): incident-bundle census",
+}
+
+# ServingEngine.snapshot() / DisaggServingEngine.snapshot() top-level
+# sections (pool snapshots under "pools" recurse into this same table).
+ENGINE_SECTIONS = {
+    "requests": "always: terminal/lifecycle counters",
+    "tokens": "always: generated/goodput totals + per_s rates",
+    "latency_ms": "always: ttft/resumed_ttft/tpot/e2e histograms",
+    "load": "always: queue-depth / slot-occupancy histograms",
+    "slo": "always: SLO targets + attainment (None without targets)",
+    "by_class": "armed (overload): per-priority-class counters + TTFT",
+    "engine": "always: world/queue/clock facts (disagg: topology facts)",
+    "overload": "armed (overload): ladder state, pressure, sheds",
+    "prefix_cache": "armed (prefix_cache): PX counters + gauges",
+    "span_ms": "armed (obs spans): per-phase p50/p99 breakdown",
+    "alerts": "armed (obs alerts): this engine's rule states",
+    "handoff": "disagg only: the handoff plane's counter set",
+    "pools": "disagg only: per-pool engine snapshots (ENGINE_SECTIONS)",
+}
+
+
+def validate_snapshot(snap: dict, sections: dict = SNAPSHOT_SECTIONS, *,
+                      what: str = "obs.snapshot") -> dict:
+    """Refuse top-level keys the schema registry does not name (the
+    future-sections-cannot-silently-collide pin). Returns ``snap``."""
+    unknown = set(snap) - set(sections)
+    if unknown:
+        raise ValueError(
+            f"{what}: unregistered snapshot section(s) {sorted(unknown)} — "
+            f"register them in obs/export.py (SNAPSHOT_SECTIONS / "
+            f"ENGINE_SECTIONS) and document them in docs/observability.md"
+        )
+    return snap
 
 _serving_engines: "weakref.WeakValueDictionary[int, Any]" = (
     weakref.WeakValueDictionary()
@@ -182,8 +245,16 @@ def maybe_export_into(run_dir: str) -> str | None:
 
 
 def snapshot() -> dict:
-    """One merged observability view: span stats + wait telemetry +
-    ``resilience.health`` + every live serving engine's metrics."""
+    """One merged observability view under the versioned schema
+    (:data:`SNAPSHOT_SECTIONS`): span stats + wait telemetry +
+    ``resilience.health`` + every live serving engine's metrics, plus
+    the armed flight-recorder sections (metrics plane / alert states /
+    bundle census — absent when disarmed, so a disarmed snapshot is
+    byte-identical to its pre-flight-recorder self)."""
+    from triton_dist_tpu import config as tdt_config
+    from triton_dist_tpu.obs import alerts as _alerts
+    from triton_dist_tpu.obs import blackbox as _blackbox
+    from triton_dist_tpu.obs import metrics as _metrics
     from triton_dist_tpu.resilience import health
 
     serving = {}
@@ -191,10 +262,20 @@ def snapshot() -> dict:
         eng = _serving_engines.get(key)
         if eng is not None:
             serving[f"engine{key}"] = eng.snapshot()
-    return {
+    snap = {
+        "schema": SNAPSHOT_SCHEMA,
         "spans": _tracer.span_stats(),
         "dropped_spans": _tracer.dropped_spans(),
         "wait_telemetry": _telemetry.wait_summary(),
         "health": health.snapshot(),
         "serving": serving or None,
     }
+    ocfg = tdt_config.get_config().obs
+    if ocfg is not None:
+        if getattr(ocfg, "metrics", None) is not None:
+            snap["metrics"] = _metrics.json_snapshot()
+        if getattr(ocfg, "alerts", None) is not None:
+            snap["alerts"] = _alerts.state_snapshot()
+        if getattr(ocfg, "blackbox", None) is not None:
+            snap["blackbox"] = _blackbox.census()
+    return validate_snapshot(snap)

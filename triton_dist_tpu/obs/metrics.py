@@ -1,0 +1,303 @@
+"""The metrics plane (ISSUE 15, flight-recorder part 1): a
+dependency-free process-wide registry of labeled counters, gauges, and
+streaming histograms — the continuous-export surface every serving
+subsystem's private `snapshot()` tallies were missing.
+
+Design rules (the ``resilience/health.py`` / ``obs/tracer.py``
+discipline):
+
+- **Dependency-free and bounded** — one dict of series behind one lock.
+  The series bound is ``MetricsConfig.max_series``; series refused past
+  it are COUNTED (``dropped_series`` — no silent caps). Histograms reuse
+  the tracer's streaming :class:`~triton_dist_tpu.obs.tracer.
+  DurationStats` (log-binned, O(1) record, percentiles survive any
+  volume).
+- **Deterministic** — exports are sorted-key / sorted-series with fixed
+  float rounding, and the only timestamp (``clock_s`` in the JSON
+  export) comes from the injectable resilience clock — two FakeClock
+  replays of the same seeded run export **byte-identically**
+  (``cmp``-verified in tests/test_flight_recorder.py, like every bench
+  artifact).
+- **Zero overhead disarmed** — every entry point checks
+  ``config.obs.metrics`` first; ``None`` (the default) records nothing,
+  so every instrumented subsystem behaves byte-identically to its
+  pre-metrics self (pinned).
+
+Instrumented subsystems (each forwards the tallies it already keeps —
+the plane mirrors, it never replaces, the local snapshot surfaces):
+
+- ``serving/metrics.py`` (ServingEngine + every pool engine): request
+  terminal census, TTFT/e2e/tpot histograms, tokens + goodput, queue
+  depth and slot occupancy — labeled ``engine=<family>``;
+- ``serving/overload.py``: composite pressure + per-term gauges, ladder
+  rung, transition and shed counters;
+- ``models/prefix_cache.py``: the PX counter set (hits, pages shared /
+  evicted / struck, tokens saved) + gauges;
+- ``serving/handoff.py``: the full handoff-ladder counter set + resident
+  manifest gauge;
+- ``resilience/health.py``: every health event as
+  ``health_events_total{kind, family}`` (strikes by PE ride the
+  ``family="pe{N}"`` convention);
+- the wait-telemetry aggregation (``obs/telemetry.py``) is folded in at
+  export time (:func:`prometheus_text` / :func:`json_snapshot`).
+
+Exports:
+
+- :func:`prometheus_text` — the Prometheus text exposition format
+  (counters as ``_total``, histograms as summaries with
+  p50/p95/p99 quantile lines), deterministically ordered;
+- :func:`json_snapshot` — the machine-diffable sorted-key JSON twin;
+- :func:`export_prometheus` / :func:`export_json` — atomic whole-file
+  writes of the above.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+
+from triton_dist_tpu.obs.tracer import DurationStats
+
+JSON_SCHEMA = "tdt-metrics-v1"
+
+# the exposition-name prefix (one namespace for the whole repo)
+PREFIX = "tdt_"
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricsConfig:
+    """Arms the metrics plane via ``ObsConfig(metrics=MetricsConfig())``.
+
+    max_series: bound on distinct (name, labels) series — a label typo
+        exploding cardinality is refused past it and COUNTED in
+        ``dropped_series`` (no silent caps), never an unbounded dict.
+    """
+
+    max_series: int = 4096
+
+    def validate(self) -> "MetricsConfig":
+        if self.max_series < 1:
+            raise ValueError(
+                f"MetricsConfig.max_series must be >= 1, got "
+                f"{self.max_series}"
+            )
+        return self
+
+
+_lock = threading.Lock()
+# (name, ((label, value), ...)) -> value | DurationStats
+_series: dict = {}
+_types: dict[str, str] = {}     # name -> "counter" | "gauge" | "histogram"
+_dropped = 0
+
+
+def _cfg() -> "MetricsConfig | None":
+    from triton_dist_tpu import config as tdt_config
+
+    obs = tdt_config.get_config().obs
+    return None if obs is None else getattr(obs, "metrics", None)
+
+
+def enabled() -> bool:
+    return _cfg() is not None
+
+
+def _key(name: str, labels: dict) -> tuple:
+    return (name, tuple(sorted((str(k), str(v)) for k, v in labels.items())))
+
+
+def _record(name: str, kind: str, labels: dict, cfg: MetricsConfig,
+            apply) -> None:
+    """Resolve the (name, labels) cell and ``apply`` the update under ONE
+    lock hold — a concurrent reset() can never orphan the cell between
+    resolution and update. A NEW series past the bound is refused and
+    counted."""
+    global _dropped
+    key = _key(name, labels)
+    with _lock:
+        prior = _types.get(name)
+        if prior is None:
+            _types[name] = kind
+        elif prior != kind:
+            raise ValueError(
+                f"metric {name!r} already registered as {prior}, not {kind}"
+            )
+        cell = _series.get(key)
+        if cell is None:
+            if len(_series) >= cfg.max_series:
+                _dropped += 1
+                return
+            cell = _series[key] = (
+                DurationStats() if kind == "histogram" else [0.0]
+            )
+        apply(cell)
+
+
+def counter(name: str, n: float = 1, **labels) -> None:
+    """Increment a monotone counter (no-op disarmed)."""
+    cfg = _cfg()
+    if cfg is None:
+        return
+
+    def apply(cell):
+        cell[0] += n
+
+    _record(name, "counter", labels, cfg, apply)
+
+
+def gauge(name: str, value: float, **labels) -> None:
+    """Set a point-in-time gauge (no-op disarmed)."""
+    cfg = _cfg()
+    if cfg is None:
+        return
+
+    def apply(cell):
+        cell[0] = float(value)
+
+    _record(name, "gauge", labels, cfg, apply)
+
+
+def observe(name: str, value: float, **labels) -> None:
+    """Record one sample into a streaming histogram — percentiles via
+    the tracer's :class:`DurationStats` (no-op disarmed)."""
+    cfg = _cfg()
+    if cfg is None:
+        return
+    _record(name, "histogram", labels, cfg,
+            lambda cell: cell.record(value))
+
+
+def dropped_series() -> int:
+    with _lock:
+        return _dropped
+
+
+def _clock_s() -> float:
+    from triton_dist_tpu.resilience import retry as _retry
+
+    return round(_retry.get_clock().monotonic(), 9)
+
+
+def _sorted_series() -> list:
+    with _lock:
+        return sorted(
+            (name, labels, _types[name], cell)
+            for (name, labels), cell in _series.items()
+        )
+
+
+def _label_str(labels: tuple) -> str:
+    if not labels:
+        return ""
+    body = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + body + "}"
+
+
+def _fmt(v: float) -> str:
+    """Deterministic number formatting: integers without a dot, floats
+    rounded to 6 places with the trailing zeros trimmed (repr drift
+    between runs would break the byte-identity contract)."""
+    if float(v) == int(v):
+        return str(int(v))
+    return format(round(float(v), 6), ".6f").rstrip("0").rstrip(".")
+
+
+def prometheus_text() -> str:
+    """The Prometheus text exposition of every series (plus the
+    wait-telemetry aggregation), deterministically ordered — counters as
+    ``<name>_total``-style lines, gauges plain, histograms as summaries
+    (p50/p95/p99 quantile lines + ``_sum`` / ``_count``). Readable
+    regardless of arming (export never needs the armed config)."""
+    from triton_dist_tpu.obs import telemetry as _telemetry
+
+    out: list[str] = []
+    last_name = None
+    for name, labels, kind, cell in _sorted_series():
+        full = PREFIX + name
+        if name != last_name:
+            last_name = name
+            ptype = "summary" if kind == "histogram" else kind
+            out.append(f"# TYPE {full} {ptype}")
+        if kind == "histogram":
+            snap = cell.snapshot()
+            for q, key in (("0.5", "p50_ms"), ("0.95", "p95_ms"),
+                           ("0.99", "p99_ms")):
+                ql = labels + (("quantile", q),)
+                out.append(f"{full}{_label_str(ql)} {_fmt(snap[key])}")
+            out.append(f"{full}_sum{_label_str(labels)} "
+                       f"{_fmt(snap['total_ms'])}")
+            out.append(f"{full}_count{_label_str(labels)} "
+                       f"{_fmt(snap['count'])}")
+        else:
+            out.append(f"{full}{_label_str(labels)} {_fmt(cell[0])}")
+    # the wait-telemetry aggregation rides the same plane at export time
+    wt = _telemetry.wait_summary()
+    if wt["sites"]:
+        out.append(f"# TYPE {PREFIX}wait_spins_total counter")
+        for s in wt["sites"]:
+            lb = (("family", s["family"]), ("kind", s["kind"]),
+                  ("site", str(s["site"])))
+            out.append(f"{PREFIX}wait_spins_total{_label_str(lb)} "
+                       f"{_fmt(s['total_spins'])}")
+    if dropped_series():
+        out.append(f"# TYPE {PREFIX}metrics_dropped_series counter")
+        out.append(f"{PREFIX}metrics_dropped_series {_fmt(dropped_series())}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def json_snapshot() -> dict:
+    """The machine-diffable JSON twin of :func:`prometheus_text`:
+    sorted series, sorted keys, the one timestamp from the injectable
+    clock — byte-identical across FakeClock replays."""
+    from triton_dist_tpu.obs import telemetry as _telemetry
+
+    series = []
+    for name, labels, kind, cell in _sorted_series():
+        row: dict = {"name": name, "type": kind,
+                     "labels": {k: v for k, v in labels}}
+        if kind == "histogram":
+            row["value"] = cell.snapshot()
+        else:
+            v = cell[0]
+            row["value"] = int(v) if float(v) == int(v) else round(v, 6)
+        series.append(row)
+    return {
+        "schema": JSON_SCHEMA,
+        "clock_s": _clock_s(),
+        "series": series,
+        "dropped_series": dropped_series(),
+        "wait_telemetry": _telemetry.wait_summary(),
+    }
+
+
+def _atomic_write(path: str, text: str) -> str:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path
+
+
+def export_prometheus(path: str) -> str:
+    """Atomic whole-file write of :func:`prometheus_text`."""
+    return _atomic_write(path, prometheus_text())
+
+
+def export_json(path: str) -> str:
+    """Atomic whole-file write of :func:`json_snapshot` (sorted keys,
+    fixed separators — the bench-artifact serialization discipline)."""
+    return _atomic_write(
+        path,
+        json.dumps(json_snapshot(), indent=1, sort_keys=True,
+                   separators=(",", ": ")) + "\n",
+    )
+
+
+def reset() -> None:
+    global _dropped
+    with _lock:
+        _series.clear()
+        _types.clear()
+        _dropped = 0

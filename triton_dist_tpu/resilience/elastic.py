@@ -156,6 +156,7 @@ def _strike(pe: int, family: str | None, what: str) -> str:
     from triton_dist_tpu import config as tdt_config
 
     threshold = max(1, int(tdt_config.get_config().suspect_threshold))
+    reason = None
     with _lock:
         p = _get(pe)
         if p.state == QUARANTINED:
@@ -163,10 +164,22 @@ def _strike(pe: int, family: str | None, what: str) -> str:
         p.strikes += 1
         p.clean_probes = 0
         if p.state == PROBATION or p.strikes >= threshold:
-            _quarantine_locked(p, family, what)
+            p.state = QUARANTINED
+            p.clean_probes = 0
+            reason = (
+                f"{p.strikes} strike(s), last a {what}"
+                + (f" (family {family!r})" if family else "")
+            )
         else:
             p.state = SUSPECT
-        return p.state
+        state = p.state
+    if reason is not None:
+        # record OUTSIDE the peer lock: the health funnel fans out to the
+        # flight recorder (obs/blackbox.py), whose bundle freezes
+        # elastic.summary() — recording under _lock would self-deadlock
+        health.record_pe_quarantine(pe, reason=reason)
+        _maybe_release_family_pins()
+    return state
 
 
 def report_success(pe: int) -> str:
@@ -266,19 +279,6 @@ def note_integrity_exc(exc: BaseException, family: str | None = None) -> int | N
     )
 
 
-def _quarantine_locked(
-    p: PeerHealth, family: str | None, what: str = "timeout"
-) -> None:
-    p.state = QUARANTINED
-    p.clean_probes = 0
-    health.record_pe_quarantine(
-        p.pe,
-        reason=f"{p.strikes} strike(s), last a {what}"
-        + (f" (family {family!r})" if family else ""),
-    )
-    _maybe_release_family_pins()
-
-
 def quarantine(pe: int, reason: str = "operator request") -> None:
     """Force a PE into quarantine (operator/test entry)."""
     with _lock:
@@ -287,7 +287,9 @@ def quarantine(pe: int, reason: str = "operator request") -> None:
             return
         p.state = QUARANTINED
         p.clean_probes = 0
-        health.record_pe_quarantine(pe, reason=reason)
+    # outside the peer lock (the _strike rationale: the health funnel
+    # fans out to the flight recorder, which reads elastic.summary())
+    health.record_pe_quarantine(pe, reason=reason)
     _maybe_release_family_pins()
 
 

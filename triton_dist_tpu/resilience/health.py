@@ -76,6 +76,18 @@ POOL_COLLAPSE = "pool_collapse"     # a pool lost its last serviceable PE:
                                     # the topology collapsed to the
                                     # unified engine, in-flight work
                                     # replayed (serving/disagg.py)
+ALERT = "alert"                     # an SLO burn-rate rule fired or
+                                    # resolved (obs/alerts.py, ISSUE 15)
+                                    # — informational for is_healthy():
+                                    # the alert PREDICTS the flip, the
+                                    # degradation it predicts flips
+
+# the kinds that flip is_healthy(): each one means some work was NOT
+# done on the fast clean path (the flight recorder's burn-rate alerts
+# count these as "flips" — obs/alerts.py health_flip_rate)
+FLIP_KINDS = (DOWNGRADE, TIMEOUT, PE_QUARANTINE, INTEGRITY, SKIP_STEP,
+              POISONED, BROWNOUT, SHED, HANDOFF_RESTREAM,
+              HANDOFF_FALLBACK, POOL_COLLAPSE)
 
 # short-circuit pin kinds (why a family is pinned to its golden path)
 PIN_ENV = "env"               # process-global environment failure
@@ -286,6 +298,20 @@ def record_pool_collapse(family: str, pool: str, reason: str) -> None:
     ))
 
 
+def record_alert(family: str, rule: str, state: str, *, signal: str,
+                 fast: float, slow: float) -> None:
+    """One SLO burn-rate rule transition (obs/alerts.py, ISSUE 15):
+    ``state`` is "firing" or "resolved", ``fast``/``slow`` the window
+    values at the transition. Informational for :func:`is_healthy` —
+    the alert PREDICTS a flip; the degradation it predicts flips."""
+    _record(HealthEvent(
+        kind=ALERT, family=family,
+        reason=f"rule {rule} [{signal}] {state} "
+               f"(fast={fast:.4g}, slow={slow:.4g})",
+        walltime=time.time(),
+    ))
+
+
 def record_pe_quarantine(pe: int, reason: str) -> None:
     """The elastic layer quarantined peer ``pe`` (elastic.py)."""
     _record(HealthEvent(
@@ -328,6 +354,22 @@ def _record(ev: HealthEvent) -> None:
         _events.append(ev)
         key = (ev.family, ev.kind)
         _counters[key] = _counters.get(key, 0) + 1
+    # the flight-recorder fan-out (ISSUE 15) runs OUTSIDE the lock: the
+    # metrics plane mirrors every event as a labeled counter, and a
+    # health-FLIPPING event freezes a post-mortem bundle — whose capture
+    # reads this registry and elastic.summary() (lock re-entry)
+    _publish(ev)
+
+
+def _publish(ev: HealthEvent) -> None:
+    """Mirror one event into the obs metrics plane and offer it to the
+    black box (both no-ops when disarmed — the pre-metrics posture).
+    Lazy import: obs pulls this module in through its exporters."""
+    from triton_dist_tpu.obs import blackbox as _blackbox
+    from triton_dist_tpu.obs import metrics as _metrics
+
+    _metrics.counter("health_events_total", kind=ev.kind, family=ev.family)
+    _blackbox.on_health_event(ev)
 
 
 def events(kind: str | None = None) -> list[HealthEvent]:
@@ -365,14 +407,24 @@ def is_healthy() -> bool:
     requests, overload brownouts, and load sheds do: they all mean some
     work was NOT done on the fast clean path (a shed/brownout is the
     overload machinery working AS DESIGNED, but an operator still needs
-    one bit that says "this process refused or degraded work")."""
+    one bit that says "this process refused or degraded work"). The
+    flipping kind set IS :data:`FLIP_KINDS` — also the burn-rate alerts'
+    ``health_flip_rate`` feed (obs/alerts.py via :func:`flip_total`).
+    The black box triggers on its OWN narrower ``BLACKBOX_KINDS`` subset
+    (plus the informational ``prefix_strike``) — a shed storm must not
+    write a bundle per shed."""
     with _lock:
         return not any(
-            k in (DOWNGRADE, TIMEOUT, PE_QUARANTINE, INTEGRITY, SKIP_STEP,
-                  POISONED, BROWNOUT, SHED, HANDOFF_RESTREAM,
-                  HANDOFF_FALLBACK, POOL_COLLAPSE)
-            for (_, k), n in _counters.items() if n > 0
+            k in FLIP_KINDS for (_, k), n in _counters.items() if n > 0
         )
+
+
+def flip_total() -> int:
+    """Total health-FLIPPING events recorded since reset() — the
+    cumulative feed of the ``health_flip_rate`` burn-rate signal
+    (obs/alerts.py derives per-window deltas from it)."""
+    with _lock:
+        return sum(n for (_, k), n in _counters.items() if k in FLIP_KINDS)
 
 
 def corrupt_families() -> set[str]:

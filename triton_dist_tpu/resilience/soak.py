@@ -52,7 +52,14 @@ Invariants asserted on every campaign (:func:`check_invariants`):
    and the health registry agree with the terminal census (a recovery
    path that double-counts or skips an event fails here);
 4. **seeded replay** — the same spec reproduces a byte-identical
-   campaign fingerprint (terminal states, tokens, ladder transitions).
+   campaign fingerprint (terminal states, tokens, ladder transitions);
+5. **one bundle per flip** (ISSUE 15, :func:`check_blackbox_invariant`)
+   — every campaign runs under an armed flight recorder
+   (:func:`_flight_recorder`: metrics plane + black box) and every
+   event of the black-box trigger set (``BLACKBOX_KINDS``: brownouts,
+   handoff restream/fallback, pool collapse, prefix strikes,
+   quarantines, integrity) must freeze exactly one post-mortem bundle —
+   no duplicates, no misses, no suppression.
 
 ``scripts/chaos_soak.py`` is the CLI; the quick cells ride
 ``scripts/chaos_matrix.sh`` and the full 20-campaign soak is the
@@ -334,6 +341,73 @@ def _inject_faults(schedule: dict, world: int):
         yield calls
     finally:
         ContinuousBatcher.step = real_step
+
+
+@contextlib.contextmanager
+def _flight_recorder():
+    """Arm the ISSUE 15 flight recorder around one campaign: the metrics
+    plane plus the black box writing into a throwaway dir, spans off.
+    Observation-only by construction — campaign fingerprints hash
+    decisions (terminals / transitions / counters), none of which the
+    recorder can touch — so replay byte-identity is preserved while
+    every campaign proves the bundle-per-flip invariant
+    (:func:`check_blackbox_invariant`) as part of its green conditions."""
+    import shutil
+    import tempfile
+
+    from triton_dist_tpu import config as tdt_config
+    from triton_dist_tpu import obs
+
+    prev = tdt_config.get_config().obs
+    tmp = tempfile.mkdtemp(prefix="tdt_soak_blackbox_")
+    obs.metrics.reset()
+    obs.alerts.reset()
+    obs.blackbox.reset()
+    tdt_config.update(obs=obs.ObsConfig(
+        spans=False,
+        metrics=obs.MetricsConfig(),
+        blackbox=obs.BlackboxConfig(dir=tmp, max_bundles=4096),
+    ))
+    try:
+        yield
+    finally:
+        tdt_config.update(obs=prev)
+        obs.metrics.reset()
+        obs.alerts.reset()
+        obs.blackbox.reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def check_blackbox_invariant(health_snap: dict) -> list:
+    """The ISSUE 15 soak invariant: exactly ONE post-mortem bundle per
+    health-flipping event — no duplicates, no misses, no suppression —
+    judged per triggering kind against the black-box census. Call while
+    the campaign's :func:`_flight_recorder` scope is still armed."""
+    from triton_dist_tpu.obs import blackbox as _bb
+
+    census = _bb.census()
+    by_kind: dict[str, int] = {}
+    for key, n in health_snap.get("counters", {}).items():
+        kind = key.rsplit(":", 1)[-1]
+        if kind in _bb.BLACKBOX_KINDS:
+            by_kind[kind] = by_kind.get(kind, 0) + n
+    fails: list[str] = []
+    if census["suppressed"]:
+        fails.append(
+            f"black box suppressed {census['suppressed']} bundle(s) — the "
+            f"campaign out-wrote max_bundles (no silent caps: raise it)"
+        )
+    if census["by_kind"] != by_kind:
+        fails.append(
+            f"bundle census {census['by_kind']} != health flip census "
+            f"{by_kind} — not exactly one bundle per flipping event"
+        )
+    if census["written"] != sum(by_kind.values()):
+        fails.append(
+            f"bundles written {census['written']} != total flipping "
+            f"events {sum(by_kind.values())}"
+        )
+    return fails
 
 
 def _terminal_kind(res: Any) -> str:
@@ -645,64 +719,71 @@ def _run_disagg_campaign(spec: SoakSpec) -> CampaignResult:
         trace = generate_trace(traffic)
         schedule = fault_schedule(spec)
         clock = _retry.FakeClock()
-        with _retry.clock_scope(clock):
-            eng = DisaggServingEngine(
-                cfg, params, mesh, s_max=spec.s_max, clock=clock,
-                serving=DisaggServingConfig(
-                    prefill_pes=spec.disagg_prefill_pes,
-                    virtual_step_s=spec.virtual_step_s,
-                    slo=SLOTargets(ttft_ms=1500.0),
-                    handoff=HandoffConfig(
-                        page_tokens=4,
-                        chunks_per_page=spec.handoff_chunks,
-                        virtual_chunk_s=0.002,
-                    ),
-                    prefill=ServingConfig(
-                        max_queue=spec.max_queue, max_step_failures=3,
-                        overload=OverloadConfig(
-                            min_dwell_steps=4, window_steps=8,
-                            retry_budget=4,
+        with _flight_recorder():
+            with _retry.clock_scope(clock):
+                eng = DisaggServingEngine(
+                    cfg, params, mesh, s_max=spec.s_max, clock=clock,
+                    serving=DisaggServingConfig(
+                        prefill_pes=spec.disagg_prefill_pes,
+                        virtual_step_s=spec.virtual_step_s,
+                        slo=SLOTargets(ttft_ms=1500.0),
+                        handoff=HandoffConfig(
+                            page_tokens=4,
+                            chunks_per_page=spec.handoff_chunks,
+                            virtual_chunk_s=0.002,
+                        ),
+                        prefill=ServingConfig(
+                            max_queue=spec.max_queue, max_step_failures=3,
+                            overload=OverloadConfig(
+                                min_dwell_steps=4, window_steps=8,
+                                retry_budget=4,
+                            ),
+                        ),
+                        decode=ServingConfig(
+                            max_queue=spec.max_queue,
+                            overload=OverloadConfig(
+                                min_dwell_steps=4, window_steps=8,
+                                retry_budget=4,
+                            ),
                         ),
                     ),
-                    decode=ServingConfig(
-                        max_queue=spec.max_queue,
-                        overload=OverloadConfig(
-                            min_dwell_steps=4, window_steps=8,
-                            retry_budget=4,
-                        ),
-                    ),
-                ),
-            )
-            error = None
-            with _inject_pool_faults(
-                schedule, collapse_at=spec.collapse_at_step
-            ) as calls:
-                try:
-                    done = eng.serve(trace, max_steps=spec.max_steps)
-                except RuntimeError as exc:
-                    error = f"{type(exc).__name__}: {exc}"
-                    done = dict(eng.results)
-        transitions = []
-        for pool in (eng.prefill, eng.decode):
-            if pool._overload is not None:
-                transitions.extend(
-                    dataclasses.asdict(t) for t in pool._overload.transitions
                 )
-        result = CampaignResult(
-            spec=spec,
-            terminals={u: _terminal_kind(r) for u, r in done.items()},
-            n_steps_hint=calls["n"],
-            rebuilds=eng.prefill.rebuilds + eng.decode.rebuilds,
-            transitions=transitions,
-            snapshot=eng.snapshot(),
-            health=resilience.health.snapshot(),
-            fingerprint="",
-            failures=[],
-            error=error,
-        )
-        result.fingerprint = campaign_fingerprint(result)
-        offered = {a.request.uid for a in trace}
-        result.failures = check_disagg_invariants(eng, result, offered)
+                error = None
+                with _inject_pool_faults(
+                    schedule, collapse_at=spec.collapse_at_step
+                ) as calls:
+                    try:
+                        done = eng.serve(trace, max_steps=spec.max_steps)
+                    except RuntimeError as exc:
+                        error = f"{type(exc).__name__}: {exc}"
+                        done = dict(eng.results)
+            transitions = []
+            for pool in (eng.prefill, eng.decode):
+                if pool._overload is not None:
+                    transitions.extend(
+                        dataclasses.asdict(t)
+                        for t in pool._overload.transitions
+                    )
+            result = CampaignResult(
+                spec=spec,
+                terminals={u: _terminal_kind(r) for u, r in done.items()},
+                n_steps_hint=calls["n"],
+                rebuilds=eng.prefill.rebuilds + eng.decode.rebuilds,
+                transitions=transitions,
+                snapshot=eng.snapshot(),
+                health=resilience.health.snapshot(),
+                fingerprint="",
+                failures=[],
+                error=error,
+            )
+            result.fingerprint = campaign_fingerprint(result)
+            offered = {a.request.uid for a in trace}
+            # the bundle-per-flip check runs INSIDE the recorder scope
+            # (the census dies with it)
+            result.failures = (
+                check_disagg_invariants(eng, result, offered)
+                + check_blackbox_invariant(result.health)
+            )
         return result
     finally:
         tdt_config.update(
@@ -794,55 +875,65 @@ def run_campaign(spec: SoakSpec, *, model=None) -> CampaignResult:
         if spec.page_size:
             batcher_kw["page_size"] = spec.page_size
         clock = _retry.FakeClock()
-        with _retry.clock_scope(clock):
-            from triton_dist_tpu.models.prefix_cache import PrefixCacheConfig
+        with _flight_recorder():
+            with _retry.clock_scope(clock):
+                from triton_dist_tpu.models.prefix_cache import (
+                    PrefixCacheConfig,
+                )
 
-            eng = ServingEngine(
-                cfg, params, mesh, s_max=spec.s_max, clock=clock,
-                serving=ServingConfig(
-                    max_queue=spec.max_queue,
-                    virtual_step_s=spec.virtual_step_s,
-                    probe_interval_steps=4,
-                    slo=SLOTargets(ttft_ms=1500.0),
-                    overload=OverloadConfig(
-                        min_dwell_steps=4, window_steps=8,
-                        retry_budget=4,
-                        # identity downshift: brownout2 still drives the
-                        # rebuild+replay arc (composition with the fault
-                        # rebuilds is exactly what the soak is for)
-                        downshift=lambda c: c,
+                eng = ServingEngine(
+                    cfg, params, mesh, s_max=spec.s_max, clock=clock,
+                    serving=ServingConfig(
+                        max_queue=spec.max_queue,
+                        virtual_step_s=spec.virtual_step_s,
+                        probe_interval_steps=4,
+                        slo=SLOTargets(ttft_ms=1500.0),
+                        overload=OverloadConfig(
+                            min_dwell_steps=4, window_steps=8,
+                            retry_budget=4,
+                            # identity downshift: brownout2 still drives
+                            # the rebuild+replay arc (composition with the
+                            # fault rebuilds is exactly what the soak is
+                            # for)
+                            downshift=lambda c: c,
+                        ),
+                        prefix_cache=(
+                            PrefixCacheConfig() if spec.prefix_pool else None
+                        ),
                     ),
-                    prefix_cache=(
-                        PrefixCacheConfig() if spec.prefix_pool else None
-                    ),
-                ),
-                **batcher_kw,
+                    **batcher_kw,
+                )
+                error = None
+                with _inject_faults(schedule, spec.world) as calls:
+                    try:
+                        done = eng.serve(trace, max_steps=spec.max_steps)
+                    except RuntimeError as exc:
+                        error = f"{type(exc).__name__}: {exc}"
+                        done = dict(eng.results)
+            result = CampaignResult(
+                spec=spec,
+                terminals={u: _terminal_kind(r) for u, r in done.items()},
+                n_steps_hint=calls["n"],
+                rebuilds=eng.rebuilds,
+                transitions=[
+                    dataclasses.asdict(t)
+                    for t in (eng._overload.transitions
+                              if eng._overload else ())
+                ],
+                snapshot=eng.snapshot(),
+                health=resilience.health.snapshot(),
+                fingerprint="",
+                failures=[],
+                error=error,
             )
-            error = None
-            with _inject_faults(schedule, spec.world) as calls:
-                try:
-                    done = eng.serve(trace, max_steps=spec.max_steps)
-                except RuntimeError as exc:
-                    error = f"{type(exc).__name__}: {exc}"
-                    done = dict(eng.results)
-        result = CampaignResult(
-            spec=spec,
-            terminals={u: _terminal_kind(r) for u, r in done.items()},
-            n_steps_hint=calls["n"],
-            rebuilds=eng.rebuilds,
-            transitions=[
-                dataclasses.asdict(t)
-                for t in (eng._overload.transitions if eng._overload else ())
-            ],
-            snapshot=eng.snapshot(),
-            health=resilience.health.snapshot(),
-            fingerprint="",
-            failures=[],
-            error=error,
-        )
-        result.fingerprint = campaign_fingerprint(result)
-        offered = {a.request.uid for a in trace}
-        result.failures = check_invariants(eng, result, offered)
+            result.fingerprint = campaign_fingerprint(result)
+            offered = {a.request.uid for a in trace}
+            # one bundle per health-flipping event (ISSUE 15) — judged
+            # while the campaign's flight-recorder scope is still armed
+            result.failures = (
+                check_invariants(eng, result, offered)
+                + check_blackbox_invariant(result.health)
+            )
         return result
     finally:
         tdt_config.update(

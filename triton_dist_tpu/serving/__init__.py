@@ -46,7 +46,13 @@ byte for byte.
 - :mod:`metrics` — streaming log-binned histograms (TTFT,
   per-output-token, e2e), load gauges, SLO attainment, goodput
   (SLO-attaining throughput) and per-class counters, and a
-  ``snapshot()`` mirroring ``resilience/health.py``.
+  ``snapshot()`` mirroring ``resilience/health.py``. Since ISSUE 15
+  every engine/pool/controller/cache/handoff tally is ALSO mirrored
+  into the obs metrics plane (``obs/metrics.py``, labeled per engine),
+  engines evaluate SLO burn-rate alerts on their own clock
+  (``obs/alerts.py``; armed via ``ObsConfig(alerts=...)``), and every
+  health-flipping event freezes a post-mortem bundle
+  (``obs/blackbox.py``) — all None-disarmed, byte-identical off.
 - :mod:`bench` — the ``bench.py bench_serving`` offered-load sweep and
   overload A/B (virtual clock; ``emit_info`` lines only, never
   perf-gated).

@@ -60,6 +60,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from triton_dist_tpu import obs as _obs
+from triton_dist_tpu.obs import metrics as _mx
 from triton_dist_tpu.models.decode import Request
 from triton_dist_tpu.resilience import elastic, faults, health
 from triton_dist_tpu.resilience import retry as _retry
@@ -326,6 +327,11 @@ class DisaggServingEngine:
         self._t0 = self.clock.monotonic()
         self._phase_stats: dict[str, Any] = {}
         _obs.register_serving_engine(self)
+        # coordinator-tier burn-rate alerting (ISSUE 15): fed by the
+        # handoff ladder (handoff_retry_rate) and the cross-pool e2e
+        # scoring, on top of each pool engine's own evaluator
+        self._alerts = None
+        self._alerts_resolved = False
 
     # -- submission ------------------------------------------------------
 
@@ -373,7 +379,7 @@ class DisaggServingEngine:
             if isinstance(res, Shed):
                 # the prefill controller's door refusal is a TERMINAL —
                 # surface it as this topology's result
-                self.metrics.count("shed")
+                self._count_terminal("shed", priority)
                 self.results[req.uid] = res
                 return res
             if not isinstance(res, Rejected):
@@ -388,10 +394,12 @@ class DisaggServingEngine:
             req, arrival_t=now, priority=priority, deadline_ms=deadline_ms,
         )
         if isinstance(res, Shed):
-            self.metrics.count("shed")
+            self._count_terminal("shed", priority)
             self.results[req.uid] = res
             return res
         if isinstance(res, Rejected):
+            # NOT terminal: serve() re-offers a double rejection, so it
+            # stays out of the serving_requests_total terminal census
             self.metrics.count("rejected")
             return Rejected(
                 req.uid,
@@ -419,8 +427,9 @@ class DisaggServingEngine:
         if isinstance(res, (Shed, Poisoned)):
             # pool-tier terminal (deadline expired in the prefill queue /
             # poisoned prefill logits): passthrough, exactly one terminal
-            self.metrics.count(
-                "shed" if isinstance(res, Shed) else "poisoned"
+            self._count_terminal(
+                "shed" if isinstance(res, Shed) else "poisoned",
+                st.priority,
             )
             self._states.pop(uid)
             self.results[uid] = res
@@ -452,6 +461,13 @@ class DisaggServingEngine:
         st.handoff = ho
         st.t_landed = ho.t_landed
         self.metrics.count("handoffs")
+        ae = self._alert_eng()
+        if ae is not None:
+            # the handoff-retry burn feed: rung-1 re-sends AND rung-2
+            # re-streams both count — each is the ladder absorbing a wire
+            # fault (obs/alerts.py handoff_retry_rate)
+            ae.observe_handoff(ho.t_landed,
+                               retries=ho.retries + ho.restreams)
         if ho.outcome == "fallback":
             # rung 3: the decode pool re-prefills cold — count it as a
             # resumption (TTFT stays the prefill pool's token; the decode
@@ -481,7 +497,7 @@ class DisaggServingEngine:
                 deadline_ms=st.deadline_ms,
             )
             if isinstance(res, Shed):
-                self.metrics.count("shed")
+                self._count_terminal("shed", st.priority)
                 self._states.pop(uid)
                 self.results[uid] = res
             elif isinstance(res, Rejected):
@@ -496,8 +512,9 @@ class DisaggServingEngine:
     def _on_decode_result(self, uid: Any, res: Any) -> None:
         st = self._states[uid]
         if isinstance(res, (Shed, Poisoned)):
-            self.metrics.count(
-                "shed" if isinstance(res, Shed) else "poisoned"
+            self._count_terminal(
+                "shed" if isinstance(res, Shed) else "poisoned",
+                st.priority,
             )
             self._states.pop(uid)
             self.results[uid] = res
@@ -516,6 +533,14 @@ class DisaggServingEngine:
         st.resumed += res.resumed
         self._finalize(uid, list(res.tokens), res.t_finished)
 
+    def _count_terminal(self, terminal: str, priority: str) -> None:
+        """One coordinator-tier terminal: the private tally AND its
+        metrics-plane mirror (the every-tally-is-also-mirrored
+        contract; :meth:`_finalize` mirrors ``finished`` itself)."""
+        self.metrics.count(terminal)
+        _mx.counter("serving_requests_total", engine=self.family,
+                    terminal=terminal, priority=priority)
+
     def _finalize(self, uid: Any, tokens: list, now: float) -> None:
         st = self._states.pop(uid)
         prio = st.priority if self.metrics.classes else None
@@ -533,10 +558,29 @@ class DisaggServingEngine:
         self.metrics.observe_first_token(
             ttft_ms, resumed=st.resumed > 0, priority=prio
         )
-        self.metrics.observe_finished(
+        goodput_ok = self.metrics.observe_finished(
             ttft_ms=ttft_ms, e2e_ms=e2e_ms, tpot_ms=tpot_ms,
             n_tokens=len(tokens), priority=prio, deadline_ok=deadline_ok,
         )
+        if _mx.enabled():
+            _mx.counter("serving_requests_total", engine=self.family,
+                        terminal="finished", priority=st.priority)
+            _mx.counter("serving_tokens_total", len(tokens),
+                        engine=self.family)
+            if goodput_ok:
+                _mx.counter("serving_tokens_goodput_total", len(tokens),
+                            engine=self.family)
+            # resumed first-tokens ride their own series, the engine.py
+            # convention — replay TTFT must not skew the clean p99
+            _mx.observe(
+                "serving_resumed_ttft_ms" if st.resumed
+                else "serving_ttft_ms",
+                ttft_ms, engine=self.family,
+            )
+            _mx.observe("serving_e2e_ms", e2e_ms, engine=self.family)
+        ae = self._alert_eng()
+        if ae is not None:
+            ae.observe_request(now, slo_ok=goodput_ok, ttft_ms=ttft_ms)
         if uid in self.results:
             raise RuntimeError(
                 f"request {uid!r} finished twice — disagg bookkeeping bug"
@@ -600,6 +644,7 @@ class DisaggServingEngine:
         self.collapsed = True
         now = self.clock.monotonic()
         self.metrics.count("pool_collapses")
+        _mx.counter("serving_pool_collapses_total", engine=self.family)
         health.record_pool_collapse(self.family, PREFILL_POOL, why)
         # completed prefills survive FIRST (the drain_finished contract):
         # a Finished sitting undrained in the dying pool hands off
@@ -624,6 +669,31 @@ class DisaggServingEngine:
             "serving:pool_collapse", now, now, cat="serving",
             track=f"{self._obs_tag}engine", pool=PREFILL_POOL, reason=why,
             replayed=replayed,
+        )
+
+    # -- burn-rate alerts (ISSUE 15) --------------------------------------
+
+    def _alert_eng(self):
+        """Coordinator-tier evaluator, lazily resolved from
+        ``ObsConfig.alerts`` (None when disarmed) — the ServingEngine
+        convention, through the same shared seam."""
+        if not self._alerts_resolved:
+            self._alerts_resolved = True
+            slo = self.serving.slo
+            self._alerts = _obs.alerts.resolve_engine(
+                family=self.family,
+                slo_ttft_ms=None if slo is None else slo.ttft_ms,
+            )
+        return self._alerts
+
+    def _alerts_step(self) -> None:
+        ae = self._alert_eng()
+        if ae is None:
+            return
+        now = self.clock.monotonic()
+        ae.observe_flips(now, health.flip_total())
+        _obs.alerts.evaluate_and_record(
+            ae, now, count=self.metrics.count, obs_tag=self._obs_tag,
         )
 
     # -- the tick loop ----------------------------------------------------
@@ -661,6 +731,16 @@ class DisaggServingEngine:
         self._drain_pool_results()
         if worked and self.serving.virtual_step_s:
             self.clock.sleep(self.serving.virtual_step_s)
+        # coordinator-tier alerts after both pools advanced (the pool
+        # engines evaluated their own rules inside their _step_once)
+        self._alerts_step()
+        if worked and _mx.enabled():
+            _mx.gauge("serving_in_flight", len(self._states),
+                      engine=self.family)
+            _mx.gauge("serving_pending_landings", len(self._landings),
+                      engine=self.family)
+            _mx.gauge("serving_collapsed", int(self.collapsed),
+                      engine=self.family)
         return worked
 
     def serve(self, traffic=(), *, max_steps: int = 1_000_000) -> dict:
@@ -768,6 +848,8 @@ class DisaggServingEngine:
             "clock_s": round(now - self._t0, 9),
         }
         snap["handoff"] = self.handoff_plane.snapshot()
+        if self._alerts is not None:
+            snap["alerts"] = self._alerts.snapshot()
         snap["pools"] = {
             PREFILL_POOL: self.prefill.snapshot(),
             DECODE_POOL: self.decode.snapshot(),

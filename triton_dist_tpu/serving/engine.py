@@ -47,6 +47,7 @@ from collections import deque
 from typing import Any
 
 from triton_dist_tpu import obs as _obs
+from triton_dist_tpu.obs import metrics as _mx
 from triton_dist_tpu.models.decode import ContinuousBatcher, Request
 from triton_dist_tpu.models.prefix_cache import (
     PX_COUNTERS,
@@ -326,6 +327,11 @@ class ServingEngine:
         _obs.register_serving_engine(self)
         self._obs_tag = str(obs_tag)
         self._phase_stats: dict[str, Any] = {}
+        # burn-rate alerting (ISSUE 15): resolved LAZILY on the first
+        # step, so a subclass's family override (_PoolEngine) and a
+        # post-construction ObsConfig(alerts=...) arming are both seen
+        self._alerts = None
+        self._alerts_resolved = False
 
     # -- world management ----------------------------------------------
 
@@ -580,9 +586,44 @@ class ServingEngine:
         if self.serving.virtual_step_s:
             self.clock.sleep(self.serving.virtual_step_s)
         self._observe(self.clock.monotonic())
+        # alerts evaluate AFTER this step's finishes were scored and
+        # BEFORE the ladder observes them (ISSUE 15): the burn-rate rule
+        # sees the misses on the step they happen, the ladder needs the
+        # pressure window to integrate them — so a goodput burn alert
+        # FIRES before the ladder can reach shed_all_batch (pinned in
+        # tests/test_flight_recorder.py: alerts lead degradation)
+        self._alerts_step()
         self._overload_step()
         self._maybe_probe()
         return True
+
+    # -- burn-rate alerts (ISSUE 15) ------------------------------------
+
+    def _alert_eng(self):
+        """The lazily-resolved per-engine burn-rate evaluator (None when
+        ``ObsConfig.alerts`` is disarmed at first use)."""
+        if not self._alerts_resolved:
+            self._alerts_resolved = True
+            slo = self.serving.slo
+            self._alerts = _obs.alerts.resolve_engine(
+                family=self.family,
+                slo_ttft_ms=None if slo is None else slo.ttft_ms,
+            )
+        return self._alerts
+
+    def _alerts_step(self) -> None:
+        """Advance every rule on the engine clock; each transition is
+        recorded through the ONE shared fan-out
+        (``obs.alerts.evaluate_and_record``: engine counter, health
+        event, ``obs:alert`` instant, metrics-plane counter)."""
+        ae = self._alert_eng()
+        if ae is None:
+            return
+        now = self.clock.monotonic()
+        ae.observe_flips(now, health.flip_total())
+        _obs.alerts.evaluate_and_record(
+            ae, now, count=self.metrics.count, obs_tag=self._obs_tag,
+        )
 
     # -- overload control (ISSUE 11) ------------------------------------
 
@@ -602,7 +643,19 @@ class ServingEngine:
         )
         self._step_arrived = self._step_finished = 0
         self._step_slo_ok = self._step_slo_scored = 0
+        if _mx.enabled():
+            # the controller's pressure terms, composite, and ladder rung
+            # as labeled gauges (ISSUE 15: the flight recorder sees the
+            # pressure BUILD, not just the transition it caused)
+            _mx.gauge("overload_pressure", ctrl.last_pressure,
+                      engine=self.family)
+            for term, v in ctrl.pressure_terms(len(self._pending)).items():
+                _mx.gauge("overload_pressure_term", v, engine=self.family,
+                          term=term)
+            _mx.gauge("overload_rung", ctrl.rung(), engine=self.family)
         if tr is not None:
+            _mx.counter("overload_transitions_total", engine=self.family,
+                        to=tr.to)
             self._on_brownout_transition(tr)
 
     def _on_brownout_transition(self, tr) -> None:
@@ -659,6 +712,8 @@ class ServingEngine:
         (exactly-one-terminal-state bookkeeping)."""
         self.metrics.count("shed")
         self.metrics.count_class("shed", priority)
+        _mx.counter("serving_requests_total", engine=self.family,
+                    terminal="shed", priority=priority)
         if self._overload is not None:
             self._overload.note_shed(priority)
         health.record_shed(self.family, uid, priority, reason)
@@ -685,6 +740,9 @@ class ServingEngine:
             )
         self.metrics.count("rejected_final")
         self.metrics.count_class("rejected_final", rej.priority)
+        _mx.counter("serving_requests_total", engine=self.family,
+                    terminal="rejected_final",
+                    priority=rej.priority or "interactive")
         self.results[rej.uid] = rej
 
     def _observe(self, now: float) -> None:
@@ -693,6 +751,21 @@ class ServingEngine:
             queue_depth=len(self._pending) + len(b.queue),
             occupied=b.n_active, slots=self.cfg.batch,
         )
+        if _mx.enabled():
+            # the continuous-export mirror of the private step tallies
+            # (ISSUE 15 tentpole): labeled by engine so pool engines
+            # (serving_pool_prefill/decode) land on their own series
+            _mx.counter("serving_steps_total", engine=self.family)
+            _mx.gauge("serving_queue_depth",
+                      len(self._pending) + len(b.queue), engine=self.family)
+            _mx.gauge("serving_slots_occupied", b.n_active,
+                      engine=self.family)
+            _mx.gauge("serving_world_size", self.world_size,
+                      engine=self.family)
+            elapsed = max(now - self._t0, 1e-9)
+            _mx.gauge("serving_tokens_goodput_per_s",
+                      round(self.metrics.tokens_goodput / elapsed, 6),
+                      engine=self.family)
         for i, r in enumerate(b.slot_req):
             if r is None:
                 continue
@@ -722,6 +795,7 @@ class ServingEngine:
         if not st.first_recorded:
             st.t_first = None
         self.metrics.count("prefix_struck")
+        _mx.counter("serving_prefix_struck_total", engine=self.family)
         _obs.record_span(
             "serving:px_strike", now, now, cat="serving",
             track=f"{self._obs_tag}req:{uid}", uid=str(uid), reason=reason,
@@ -739,10 +813,13 @@ class ServingEngine:
             # TTFT distribution
             self.metrics.observe_first_token(ttft_ms, resumed=True,
                                              priority=prio)
+            _mx.observe("serving_resumed_ttft_ms", ttft_ms,
+                        engine=self.family)
         elif not st.first_recorded:
             st.first_recorded = True
             self.metrics.observe_first_token(ttft_ms, resumed=False,
                                              priority=prio)
+            _mx.observe("serving_ttft_ms", ttft_ms, engine=self.family)
 
     def _finalize(self, uid: Any, toks: list, now: float) -> None:
         st = self._states.pop(uid)
@@ -777,6 +854,22 @@ class ServingEngine:
             priority=st.priority if self._overload is not None else None,
             deadline_ok=deadline_ok,
         )
+        if _mx.enabled():
+            _mx.counter("serving_requests_total", engine=self.family,
+                        terminal="finished", priority=st.priority)
+            _mx.counter("serving_tokens_total", len(tokens),
+                        engine=self.family)
+            if goodput_ok:
+                _mx.counter("serving_tokens_goodput_total", len(tokens),
+                            engine=self.family)
+            _mx.observe("serving_e2e_ms", e2e_ms, engine=self.family)
+            if tpot_ms is not None:
+                _mx.observe("serving_tpot_ms", tpot_ms, engine=self.family)
+        ae = self._alert_eng()
+        if ae is not None:
+            # the goodput-burn / TTFT-burn feed: one sample per scored
+            # finish, on the engine clock (evaluated in _alerts_step)
+            ae.observe_request(now, slo_ok=goodput_ok, ttft_ms=ttft_ms)
         self._step_finished += 1
         if self.metrics.slo is not None or st.deadline is not None:
             self._step_slo_scored += 1
@@ -834,6 +927,8 @@ class ServingEngine:
         slot eviction; survivors' streams are untouched."""
         st = self._states.pop(uid)
         self.metrics.count("poisoned")
+        _mx.counter("serving_requests_total", engine=self.family,
+                    terminal="poisoned", priority=st.priority)
         if uid in self.results:
             raise RuntimeError(
                 f"request {uid!r} finished twice — poison bookkeeping bug"
@@ -913,6 +1008,7 @@ class ServingEngine:
         target = self._target_mesh()
         self.rebuilds += 1
         self.metrics.count("rebuilds")
+        _mx.counter("serving_rebuilds_total", engine=self.family)
         health.record_serving_rebuild(
             self.family, world=int(target.devices.size),
             reason=f"{reason}; {len(active)} in-flight replayed, "
@@ -1114,6 +1210,10 @@ class ServingEngine:
         }
         if self._overload is not None:
             snap["overload"] = self._overload.snapshot()
+        if self._alerts is not None:
+            # only when the alert tier is armed, so disarmed snapshots
+            # stay byte-identical to pre-flight-recorder ones (pinned)
+            snap["alerts"] = self._alerts.snapshot()
         px = self._px_snapshot()
         if px is not None:
             # the ISSUE 12 surface: hit-rate, pages-shared gauge, and

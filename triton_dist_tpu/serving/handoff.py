@@ -46,6 +46,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any
 
+from triton_dist_tpu.obs import metrics as _mx
 from triton_dist_tpu.ops.kv_stream import KVStreamConfig, WIRES
 from triton_dist_tpu.resilience import elastic, health
 from triton_dist_tpu.resilience.faults import PAYLOAD_KINDS
@@ -177,6 +178,13 @@ class HandoffPlane:
             )
         }
 
+    def _bump(self, key: str, n: int = 1) -> None:
+        """One ladder/volume counter increment, mirrored into the obs
+        metrics plane (ISSUE 15: ``handoff_<key>_total`` — a no-op while
+        the plane is disarmed, the pre-metrics posture)."""
+        self.counters[key] += n
+        _mx.counter(f"handoff_{key}_total", n, family=self.family)
+
     # -- the manifest ----------------------------------------------------
 
     def manifest(self, prompt) -> list[tuple[int, tuple]]:
@@ -273,7 +281,7 @@ class HandoffPlane:
                 ordinal += 1
                 for attempt in range(cfg.retry.max_attempts):
                     fault = self._consult_fault(ordinal - 1, g)
-                    self.counters["chunks_sent"] += 1
+                    self._bump("chunks_sent")
                     if fault is None:
                         t += cfg.virtual_chunk_s
                         break
@@ -283,7 +291,7 @@ class HandoffPlane:
                         # the landed bytes fail the canary riding the
                         # chunk signal: victim == culprit — the decode
                         # PE's own landing is corrupt (ISSUE 8 model)
-                        self.counters["canary_mismatches"] += 1
+                        self._bump("canary_mismatches")
                         t += cfg.virtual_chunk_s
                         reason = "payload canary mismatch on landing"
                         elastic.report_corruption(pe, family=self.family)
@@ -291,13 +299,13 @@ class HandoffPlane:
                         # the chunk's pure signal never arrived: the
                         # bounded wait expires; the silent sender is the
                         # culprit (by absence)
-                        self.counters["chunk_timeouts"] += 1
+                        self._bump("chunk_timeouts")
                         t += cfg.chunk_timeout_s
                         reason = "chunk signal bounded-wait timeout"
                         elastic.report_timeout(pe, family=self.family)
                     if attempt == cfg.retry.max_attempts - 1:
                         return False, t, streamed, deduped, retries, pe
-                    self.counters["chunk_retries"] += 1
+                    self._bump("chunk_retries")
                     retries += 1
                     t += delays[attempt]
                     health.record_handoff_retry(
@@ -317,7 +325,7 @@ class HandoffPlane:
         same manifest + same armed fault plan + same ``now`` ⇒ the same
         result, timestamps included."""
         pages = self.manifest(prompt)
-        self.counters["transfers"] += 1
+        self._bump("transfers")
         chunks_before = self.counters["chunks_sent"]
         t = float(now)
         restreams = 0
@@ -333,13 +341,13 @@ class HandoffPlane:
             if pe is not None:
                 culprit = pe
             if ok:
-                self.counters["delivered"] += 1
+                self._bump("delivered")
                 outcome = "delivered"
                 break
             if restreams >= self.cfg.max_restreams:
                 # rung 3: the decode pool cold-re-prefills locally — the
                 # request is never lost, corrupt KV is never decoded
-                self.counters["fallbacks"] += 1
+                self._bump("fallbacks")
                 health.record_handoff_fallback(
                     self.family, uid,
                     f"{restreams} re-stream(s) exhausted; decode-local "
@@ -351,15 +359,15 @@ class HandoffPlane:
             # sequence re-sends (deduped ones included: the corruption
             # could alias any of them), so invalidate its keys first
             restreams += 1
-            self.counters["restreams"] += 1
+            self._bump("restreams")
             self._streamed.difference_update(key for _, key in pages)
             health.record_handoff_restream(
                 self.family, uid, culprit if culprit is not None else -1,
                 f"chunk re-sends exhausted; re-stream {restreams}/"
                 f"{self.cfg.max_restreams}",
             )
-        self.counters["pages_streamed"] += tot_streamed
-        self.counters["pages_deduped"] += tot_deduped
+        self._bump("pages_streamed", tot_streamed)
+        self._bump("pages_deduped", tot_deduped)
         return HandoffResult(
             uid=uid, outcome=outcome, t_start=float(now), t_landed=t,
             pages_total=len(pages), pages_streamed=tot_streamed,

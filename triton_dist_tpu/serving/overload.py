@@ -224,6 +224,22 @@ class OverloadController:
         """The current composite pressure in [0, 1] (read-only)."""
         return min(1.0, sum(self._pressure_terms(queue_depth).values()))
 
+    def pressure_terms(self, queue_depth: int) -> dict:
+        """The weighted per-term decomposition (``queue`` / ``drain`` /
+        ``slo``) of :meth:`pressure` — the flight recorder's per-term
+        gauges read it (obs/metrics.py, ISSUE 15), so an operator sees
+        WHICH term is building before a transition attributes it."""
+        return {
+            k: round(v, 6)
+            for k, v in self._pressure_terms(queue_depth).items()
+        }
+
+    @property
+    def last_pressure(self) -> float:
+        """Composite pressure at the last observed step (read-only —
+        the metrics-plane gauge feed)."""
+        return round(self._last_pressure, 6)
+
     def rung(self) -> int:
         return LADDER.index(self.state)
 
