@@ -1104,6 +1104,48 @@ def _run_serving(argv) -> None:
             )
             for name, value, unit in sbench.info_lines(dg_rows, tag=tag):
                 emit_info(name, value, unit)
+    # fleet A/B (ISSUE 16, ROADMAP #3): the SAME seeded shared-prefix
+    # traffic over the same 4 host devices, three ways — one 4-wide
+    # unified engine vs a 4×1 fleet routed by prefix affinity vs the
+    # same fleet routed by a seeded uniform draw. Equal virtual devices,
+    # per-replica radix caches on every arm, so the columns isolate the
+    # ROUTER: affinity lands repeat prefixes on the replica whose trie
+    # already holds them (hit-rate up, p50 TTFT down vs random, which
+    # scatters each hot prefix across all 4 cold caches). FakeClock +
+    # fixed seed ⇒ byte-identical reruns; info lines only, never
+    # perf-gated.
+    if len(jax.devices()) >= 4:
+        from triton_dist_tpu.models.prefix_cache import (
+            PrefixCacheConfig as _PxConfig,
+        )
+        from triton_dist_tpu.serving import FleetConfig, ServingConfig
+
+        fl_cfg = dataclasses.replace(cfg, n_kv_heads=4, batch=4)
+        fl_params = init_params(jax.random.PRNGKey(0), fl_cfg)
+        fl_mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))
+        fl_traffic = dict(
+            prefix_pool=4, prefix_len=("fixed", 12), prefix_zipf=1.2,
+            prefix_share=0.75,
+        )
+        fl_serving = ServingConfig(prefix_cache=_PxConfig())
+        for tag, fleet_arm, serving_arm in (
+            ("_fl_uni", None, dict(prefix_cache=_PxConfig())),
+            ("_fl_aff", FleetConfig(replicas=4, routing="affinity",
+                                    serving=fl_serving), None),
+            ("_fl_rand", FleetConfig(replicas=4, routing="random",
+                                     serving=fl_serving), None),
+        ):
+            fl_rows = sbench.sweep_offered_load(
+                fl_cfg, fl_params, fl_mesh, s_max=32, rates=rates,
+                n_requests=64, prompt_len=("uniform", 2, 6),
+                output_len=("uniform", 2, 8), seed=0, virtual_step_s=0.05,
+                slo=SLOTargets(ttft_ms=800.0, e2e_ms=4000.0),
+                fleet=fleet_arm, serving_kw=serving_arm,
+                batcher_kw=dict(page_size=4),
+                traffic_kw=fl_traffic, tag=tag.strip("_") + ":",
+            )
+            for name, value, unit in sbench.info_lines(fl_rows, tag=tag):
+                emit_info(name, value, unit)
     if obs_path is not None:
         obs.export_chrome_trace(obs_path, label="bench_serving")
 

@@ -101,6 +101,16 @@
 # bundles + metrics exports across seeded replays, and the burn-rate
 # alert must fire BEFORE the brownout ladder reaches shed_all_batch.
 #
+# Since ISSUE 16 the matrix also covers the FLEET cells
+# (tests/test_fleet.py): a replica killed mid-burst (typed step death
+# out of its decode pool) must have every queued + in-flight request
+# re-offered to the survivors with the ORIGINAL arrival/deadline
+# anchors and token streams byte-identical to an unkilled run (greedy
+# AND seeded-sampled); graceful drain and crash must produce equivalent
+# terminal censuses; and the quick fleet soak campaign (replica death ×
+# corrupt handoff × overload, resilience/soak.py SoakSpec.fleet)
+# replays bit-identically (the full set rides scripts/chaos_soak.py).
+#
 # Every cell runs under a wall-clock budget (TDT_CELL_TIMEOUT_S,
 # default 600 s; conftest.py delivers it as a SIGALRM inside the cell):
 # a hung cell reports as one named FAILED row — and so fails the exit
@@ -126,7 +136,7 @@ files="tests/test_chaos.py tests/test_elastic.py \
     tests/test_emitter.py tests/test_serving.py tests/test_integrity.py \
     tests/test_obs.py tests/test_analysis.py tests/test_overload.py \
     tests/test_prefix_cache.py tests/test_disagg.py tests/test_synth.py \
-    tests/test_flight_recorder.py"
+    tests/test_flight_recorder.py tests/test_fleet.py"
 marker="chaos"
 lint_args=""
 if [ "${1:-}" = "--quick" ]; then
@@ -134,7 +144,8 @@ if [ "${1:-}" = "--quick" ]; then
     files="tests/test_integrity.py tests/test_serving.py \
         tests/test_elastic.py tests/test_overload.py \
         tests/test_prefix_cache.py tests/test_disagg.py \
-        tests/test_synth.py tests/test_flight_recorder.py"
+        tests/test_synth.py tests/test_flight_recorder.py \
+        tests/test_fleet.py"
     marker="chaos and not slow"
     # keep the quick posture bounded: worlds {2,4} (the full {2,4,8}
     # sweep is the default standalone run's job)

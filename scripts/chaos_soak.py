@@ -40,15 +40,27 @@ straggler shrinks the POOL mid-stream, and every third seed schedules a
 prefill-pool timeout storm that collapses the topology to the unified
 engine — with zero lost requests and a bit-identical seeded replay.
 
+Since ISSUE 16 the run also includes FLEET campaigns
+(``SoakSpec.fleet``): burst traffic routed by prefix affinity through a
+2-replica fleet of disaggregated engines — corrupt KV chunks on the
+replicas' handoff seams, and every second seed a decode-pool timeout
+storm that KILLS one replica mid-burst: the router's failover must
+re-offer every request the dead replica owned to the survivor with the
+original arrival/deadline anchors (zero lost,
+``check_fleet_invariants``), and the whole campaign must replay
+bit-identically from its seed.
+
 Usage::
 
     scripts/chaos_soak.py [--campaigns N] [--seed-base S] [--quick]
                           [--no-replay-check] [--no-prefix] [--no-disagg]
+                          [--no-fleet]
 
-``--quick`` runs 3 small + 1 shared-prefix + 1 disagg campaign (the
-chaos-matrix cell posture); the default 20 + 6 shared-prefix + 5
-disagg campaigns are the ISSUE 11/12/13 acceptance run. Exit code 0
-iff every campaign is green (and the replay checks hold).
+``--quick`` runs 3 small + 1 shared-prefix + 1 disagg + 1 fleet
+campaign (the chaos-matrix cell posture); the default 20 + 6
+shared-prefix + 5 disagg + 4 fleet campaigns are the ISSUE 11/12/13/16
+acceptance run. Exit code 0 iff every campaign is green (and the
+replay checks hold).
 """
 
 import argparse
@@ -79,6 +91,8 @@ def main(argv=None) -> int:
                     help="skip the shared-prefix campaign set (ISSUE 12)")
     ap.add_argument("--no-disagg", action="store_true",
                     help="skip the disaggregated campaign set (ISSUE 13)")
+    ap.add_argument("--no-fleet", action="store_true",
+                    help="skip the fleet campaign set (ISSUE 16)")
     args = ap.parse_args(argv)
 
     from triton_dist_tpu import config as tdt_config
@@ -92,6 +106,7 @@ def main(argv=None) -> int:
                  fault_window=20) if args.quick else {}
     n_px = 0 if args.no_prefix else (1 if args.quick else 6)
     n_dg = 0 if args.no_disagg else (1 if args.quick else 5)
+    n_fl = 0 if args.no_fleet else (1 if args.quick else 4)
 
     def build_spec(k: int):
         if k < n:
@@ -100,13 +115,17 @@ def main(argv=None) -> int:
             return soak.SoakSpec.shared_prefix(
                 seed=args.seed_base + 100 + (k - n)
             ), "px"
-        return soak.SoakSpec.disagg(
-            seed=args.seed_base + 200 + (k - n - n_px)
-        ), "disagg"
+        if k < n + n_px + n_dg:
+            return soak.SoakSpec.disagg(
+                seed=args.seed_base + 200 + (k - n - n_px)
+            ), "disagg"
+        return soak.SoakSpec.fleet(
+            seed=args.seed_base + 300 + (k - n - n_px - n_dg)
+        ), "fleet"
 
     rows = []
     t0 = time.time()
-    for k in range(n + n_px + n_dg):
+    for k in range(n + n_px + n_dg + n_fl):
         spec, kind_tag = build_spec(k)
         t1 = time.time()
         res = soak.run_campaign(spec)
@@ -132,6 +151,13 @@ def main(argv=None) -> int:
                 f"fallbacks={ho.get('fallbacks', 0)} "
                 f"collapsed={res.snapshot.get('engine', {}).get('collapsed')}]"
             )
+        elif kind_tag == "fleet":
+            fls = res.snapshot.get("fleet", {})
+            px_note = (
+                f" [fleet: failovers={fls.get('failovers', 0)} "
+                f"reoffered={fls.get('failover_reoffered', 0)} "
+                f"dead={res.snapshot.get('engine', {}).get('dead')}]"
+            )
         print(
             f"  campaign {kind_tag} seed={spec.seed:<4d} {verdict}  "
             f"{dt:6.1f}s  terminals={dict(sorted(census.items()))} "
@@ -147,11 +173,11 @@ def main(argv=None) -> int:
 
     replay_ok = True
     if not args.no_replay_check and rows:
-        # one replay per campaign KIND: the standard, shared-prefix, and
-        # disagg arcs must each reproduce bit-identically
+        # one replay per campaign KIND: the standard, shared-prefix,
+        # disagg, and fleet arcs must each reproduce bit-identically
         replay_at = [0] + ([n] if n_px else []) + (
             [n + n_px] if n_dg else []
-        )
+        ) + ([n + n_px + n_dg] if n_fl else [])
         for idx in replay_at:
             spec, kind_tag = build_spec(idx)
             first = rows[idx][2]

@@ -1,0 +1,532 @@
+"""Fleet router plane (triton_dist_tpu/serving/fleet.py, docs/serving.md
+"Fleet"; ISSUE 16): prefix-affinity routing, pressure-aware placement,
+and zero-lost replica failover over N replicas behind one engine-shaped
+surface.
+
+Tier structure mirrors tests/test_serving.py / tests/test_disagg.py:
+
+- **host tier** (no device stepping): config/mesh validation, the trie
+  page-key fingerprint, routing order (affinity > pressure > index),
+  shed_all_batch exclusion at the router, dead-replica exclusion, the
+  drain guard rails, and the ISSUE 16 satellites (sticky ``client_id``
+  traffic streams; the ``replica=`` label through the metrics plane and
+  incident-bundle trigger);
+- **engine tier**: real replicas on the virtual CPU mesh — the
+  ``FleetConfig(replicas=1)`` byte-identity pin against the bare single
+  engine;
+- **chaos tier** (``pytest.mark.chaos``, wired into
+  ``scripts/chaos_matrix.sh`` full and ``--quick``): a replica killed
+  mid-burst by a typed step death (and by a firing router-side
+  ``health_flip_burn`` alert) must re-offer every request it owned to
+  the survivor with the ORIGINAL arrival/deadline anchors and finish
+  them with tokens byte-identical to an unkilled run — greedy AND
+  seeded-sampled; graceful drain and crash produce equivalent terminal
+  censuses; and the quick fleet soak campaign
+  (``resilience/soak.py SoakSpec.fleet``) replays bit-identically.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from triton_dist_tpu import config as tdt_config
+from triton_dist_tpu import obs
+from triton_dist_tpu.models import init_params
+from triton_dist_tpu.models.decode import Request
+from triton_dist_tpu.models.tp_transformer import TransformerConfig
+from triton_dist_tpu.obs import metrics as mx
+from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig
+from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig
+from triton_dist_tpu.resilience import health, retry
+from triton_dist_tpu.serving import (
+    FleetConfig,
+    FleetRouter,
+    ServingConfig,
+    ServingEngine,
+    TrafficSpec,
+    generate_trace,
+    trace_fingerprint,
+)
+from triton_dist_tpu.serving.disagg import DisaggServingConfig
+from triton_dist_tpu.serving.engine import Finished, UnrecoverableEngineError
+from triton_dist_tpu.serving.fleet import _SHED_RUNG, prefix_page_keys
+from triton_dist_tpu.serving.handoff import HandoffConfig
+
+
+@pytest.fixture(autouse=True)
+def _restore_config():
+    cfg = tdt_config.get_config()
+    snap = (cfg.obs, cfg.timeout_iters, cfg.fault_plan, cfg.elastic)
+    yield
+    tdt_config.update(
+        obs=snap[0], timeout_iters=snap[1], fault_plan=snap[2],
+        elastic=snap[3],
+    )
+    retry.set_clock(None)
+    obs.reset()
+
+
+def _cfg(**over):
+    base = dict(
+        vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=4, n_kv_heads=2,
+        head_dim=8, batch=2, seq=8,
+        ag_config=AGGemmConfig(8, 16, 16), rs_config=GemmRSConfig(8, 16, 16),
+    )
+    base.update(over)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def tiny1():
+    cfg = _cfg()
+    return cfg, init_params(jax.random.PRNGKey(0), cfg)
+
+
+@pytest.fixture(scope="session")
+def mesh1() -> Mesh:
+    return Mesh(np.array(jax.devices()[:1]), ("tp",))
+
+
+@pytest.fixture(scope="session")
+def mesh2() -> Mesh:
+    return Mesh(np.array(jax.devices()[:2]), ("tp",))
+
+
+def _fleet(tiny, mesh, *, replicas=2, clock=None, **fleet_over):
+    cfg, params = tiny
+    fleet_over.setdefault(
+        "serving", ServingConfig(virtual_step_s=0.05)
+    )
+    return FleetRouter(
+        cfg, params, mesh, s_max=8,
+        clock=clock if clock is not None else retry.FakeClock(),
+        fleet=FleetConfig(replicas=replicas, **fleet_over),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host tier: config + fingerprint
+# ---------------------------------------------------------------------------
+
+def test_fleet_config_validation():
+    with pytest.raises(ValueError, match="replicas"):
+        FleetConfig(replicas=0).validate()
+    with pytest.raises(ValueError, match="routing"):
+        FleetConfig(routing="round_robin").validate()
+    with pytest.raises(ValueError, match="page_tokens"):
+        FleetConfig(page_tokens=0).validate()
+    # the affinity fingerprint must mirror the replica cache it predicts
+    dis = DisaggServingConfig(handoff=HandoffConfig(page_tokens=8))
+    with pytest.raises(ValueError, match="page_tokens"):
+        FleetConfig(disagg=dis, page_tokens=4).validate()
+    FleetConfig(disagg=dis, page_tokens=8).validate()
+
+
+def test_prefix_page_keys_are_full_prefixes():
+    keys = prefix_page_keys([1, 2, 3, 4, 5, 6, 7, 8, 9, 10], 4)
+    assert keys == [
+        (1, 2, 3, 4),
+        (1, 2, 3, 4, 5, 6, 7, 8),
+        (1, 2, 3, 4, 5, 6, 7, 8, 9, 10),
+    ]
+    # sub-page prompt: one key, the whole prompt
+    assert prefix_page_keys([7, 7], 4) == [(7, 7)]
+    # two prompts share a key iff the ENTIRE prefix matches
+    assert prefix_page_keys([1, 2, 3, 4, 9], 4)[0] == keys[0]
+    assert prefix_page_keys([9, 2, 3, 4], 4)[0] != keys[0]
+
+
+def test_fleet_mesh_validation(tiny1):
+    cfg, params = tiny1
+    bad = Mesh(np.array(jax.devices()[:3]), ("tp",))
+    with pytest.raises(ValueError, match="equal slices"):
+        FleetRouter(cfg, params, bad, s_max=8,
+                    fleet=FleetConfig(replicas=2))
+    two_d = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("a", "b"))
+    with pytest.raises(ValueError, match="1-D"):
+        FleetRouter(cfg, params, two_d, s_max=8,
+                    fleet=FleetConfig(replicas=2))
+
+
+# ---------------------------------------------------------------------------
+# Host tier: routing order
+# ---------------------------------------------------------------------------
+
+def test_affinity_routes_repeat_prefix_to_same_replica(tiny1, mesh2):
+    fl = _fleet(tiny1, mesh2)
+    # cold prompt: pressure placement, index tiebreak -> r0
+    uid_a = fl.submit(Request([1, 2, 3, 4, 5], max_new_tokens=2, uid="a"))
+    assert uid_a == "a" and fl._owner["a"] == 0
+    # shares the first page key (1,2,3,4): affinity beats the fact that
+    # r0 already has more outstanding work than r1
+    fl.submit(Request([1, 2, 3, 4, 6], max_new_tokens=2, uid="b"))
+    assert fl._owner["b"] == 0
+    assert fl._affinity_hits == 1
+    # unrelated prompt: no affinity anywhere, pressure places it on the
+    # idle replica
+    fl.submit(Request([9, 9, 9], max_new_tokens=2, uid="c"))
+    assert fl._owner["c"] == 1
+    snap = fl.snapshot()
+    assert snap["fleet"]["routing"] == "affinity"
+    assert snap["fleet"]["routed"] == {"r0": 2, "r1": 1}
+    assert snap["fleet"]["affinity_lookups"] == 3
+    assert snap["fleet"]["resident_keys"]["r0"] > 0
+
+
+def test_pressure_tiebreak_prefers_less_loaded(tiny1, mesh2):
+    fl = _fleet(tiny1, mesh2)
+    fl.submit(Request([1, 2, 3], max_new_tokens=2, uid="a"))
+    order = fl._route([5, 6, 7], "interactive")
+    assert [r.idx for r, _ in order] == [1, 0]
+    assert order[0][1] == "pressure"
+
+
+def test_shed_all_batch_excluded_from_batch_routing(tiny1, mesh2):
+    fl = _fleet(tiny1, mesh2)
+    # instance-level override of the rung signal: r0 is at
+    # shed_all_batch, r1 is healthy
+    fl._rung = lambda rep: _SHED_RUNG if rep.idx == 0 else 0
+    assert [r.idx for r, _ in fl._route([1, 2], "batch")] == [1]
+    # interactive traffic still sees both (r1 first: rung sorts the
+    # pressure key)
+    assert {r.idx for r, _ in fl._route([1, 2], "interactive")} == {0, 1}
+    # every live replica shedding: the candidate list is NOT emptied —
+    # the replica's own typed door-shed is the honest terminal
+    fl._rung = lambda rep: _SHED_RUNG
+    assert {r.idx for r, _ in fl._route([1, 2], "batch")} == {0, 1}
+
+
+def test_dead_replicas_excluded_then_fleet_dies(tiny1, mesh2):
+    fl = _fleet(tiny1, mesh2)
+    fl.replicas[0].alive = False
+    fl.submit(Request([1, 2, 3], max_new_tokens=2, uid="a"))
+    assert fl._owner["a"] == 1
+    fl.replicas[1].alive = False
+    with pytest.raises(UnrecoverableEngineError, match="no live replicas"):
+        fl.submit(Request([4, 5, 6], max_new_tokens=2, uid="b"))
+
+
+def test_drain_guard_rails(tiny1, mesh2):
+    fl = _fleet(tiny1, mesh2)
+    fl.drain(0)
+    assert fl.replicas[0].draining
+    # a draining replica receives no new routes
+    assert [r.idx for r, _ in fl._route([1, 2], "interactive")] == [1]
+    with pytest.raises(ValueError, match="last live replica"):
+        fl.drain("r1")
+    with pytest.raises(ValueError, match="unknown replica"):
+        fl.drain("r9")
+    # nothing in flight: the drained replica retires on the spot
+    fl._retire_drained()
+    assert not fl.replicas[0].alive and not fl.replicas[0].draining
+    assert fl.snapshot()["engine"]["dead"] == ["r0"]
+    assert health.counters().get(("serving_fleet", "replica_drain"), 0) == 1
+
+
+def test_random_routing_is_seeded(tiny1, mesh2):
+    orders = []
+    for _ in range(2):
+        fl = _fleet(tiny1, mesh2, routing="random", seed=3)
+        orders.append(
+            [[r.idx for r, _ in fl._route([1, 2], "interactive")]
+             for _ in range(8)]
+        )
+    assert orders[0] == orders[1]
+    # the rotation keeps every live replica as rejection fallback
+    assert all(sorted(o) == [0, 1] for o in orders[0])
+
+
+# ---------------------------------------------------------------------------
+# Host tier: the ISSUE 16 satellites
+# ---------------------------------------------------------------------------
+
+def test_traffic_client_id_streams():
+    base = dict(rate_rps=20.0, n_requests=16, prompt_len=("uniform", 3, 5),
+                output_len=("fixed", 3), vocab=32, seed=5)
+    plain = generate_trace(TrafficSpec(**base))
+    sticky = generate_trace(
+        TrafficSpec(client_pool=3, client_zipf=1.5, **base)
+    )
+    assert all(a.client_id is None for a in plain)
+    assert all(a.client_id in {"c0", "c1", "c2"} for a in sticky)
+    # the Zipf head dominates
+    assert sum(a.client_id == "c0" for a in sticky) >= 6
+    # arming the client stream changes neither arrival times nor prompts
+    assert [a.t_s for a in sticky] == [a.t_s for a in plain]
+    assert [a.request.prompt for a in sticky] == \
+        [a.request.prompt for a in plain]
+    # ... and is deterministic, but DOES join the trace fingerprint
+    again = generate_trace(TrafficSpec(client_pool=3, client_zipf=1.5, **base))
+    assert [a.client_id for a in again] == [a.client_id for a in sticky]
+    assert trace_fingerprint(sticky) == trace_fingerprint(again)
+    assert trace_fingerprint(sticky) != trace_fingerprint(plain)
+    with pytest.raises(ValueError, match="client_pool"):
+        TrafficSpec(client_pool=0, **base).validate()
+    with pytest.raises(ValueError, match="client_zipf"):
+        TrafficSpec(client_pool=2, client_zipf=0.0, **base).validate()
+
+
+def test_replica_label_rides_metrics_and_bundle(tmp_path):
+    tdt_config.update(obs=obs.ObsConfig(
+        metrics=obs.MetricsConfig(),
+        blackbox=obs.BlackboxConfig(dir=str(tmp_path)),
+    ))
+    with mx.label_scope(replica="r7"):
+        mx.counter("fleet_routed_total", engine="serving_fleet",
+                   policy="affinity")
+        # a flip-kind health event inside the scope: the incident bundle
+        # must stamp the replica that tripped
+        health.record_replica_failover(
+            "serving_fleet", "r7", "synthetic", reoffered=2
+        )
+    assert 'replica="r7"' in mx.prometheus_text()
+    bundles = [json.load(open(tmp_path / f))
+               for f in sorted(os.listdir(tmp_path))]
+    trig = [b["trigger"] for b in bundles
+            if b["trigger"]["kind"] == "replica_failover"]
+    assert len(trig) == 1
+    assert trig[0]["replica"] == "r7"
+    assert trig[0]["family"] == "serving_fleet"
+    # outside any scope the stamp is absent, not empty
+    health.record_replica_failover(
+        "serving_fleet", "r8", "synthetic", reoffered=0
+    )
+    bundles = [json.load(open(tmp_path / f))
+               for f in sorted(os.listdir(tmp_path))]
+    trig = [b["trigger"] for b in bundles
+            if b["trigger"]["kind"] == "replica_failover"]
+    assert len(trig) == 2 and trig[1].get("replica") is None
+
+
+# ---------------------------------------------------------------------------
+# Engine tier: the arming-discipline pin
+# ---------------------------------------------------------------------------
+
+def test_size1_fleet_byte_identical_to_single_engine(tiny1, mesh1):
+    cfg, params = tiny1
+    spec = TrafficSpec(rate_rps=25.0, n_requests=8,
+                       prompt_len=("uniform", 3, 5), output_len=("fixed", 3),
+                       vocab=cfg.vocab, seed=2)
+    serving = ServingConfig(virtual_step_s=0.05)
+    outs, snaps = [], []
+    for build_fleet in (False, True):
+        clock = retry.FakeClock()
+        with retry.clock_scope(clock):
+            if build_fleet:
+                eng = FleetRouter(
+                    cfg, params, mesh1, s_max=8, clock=clock,
+                    fleet=FleetConfig(replicas=1, serving=serving),
+                )
+            else:
+                eng = ServingEngine(cfg, params, mesh1, s_max=8,
+                                    clock=clock, serving=serving)
+            outs.append(eng.serve(generate_trace(spec)))
+            snaps.append(eng.snapshot())
+    assert set(outs[0]) == set(outs[1])
+    for uid in outs[0]:
+        assert outs[0][uid] == outs[1][uid], uid
+    # the one replica's snapshot IS the single engine's snapshot
+    assert snaps[1]["replicas"]["r0"] == snaps[0]
+
+
+# ---------------------------------------------------------------------------
+# Chaos tier: failover, drain, alert-driven death, the soak campaign
+# ---------------------------------------------------------------------------
+
+def _kill_after(rep, n_steps):
+    """Instance-level monkeypatch: the replica's step raises the TYPED
+    death signal after ``n_steps`` successful steps."""
+    orig = rep.engine._step_once
+    calls = {"n": 0}
+
+    def dying():
+        calls["n"] += 1
+        if calls["n"] > n_steps:
+            raise UnrecoverableEngineError("injected replica death")
+        return orig()
+
+    rep.engine._step_once = dying
+
+
+def _reqs(n, **kw):
+    return [
+        Request([1 + i % 5, 2 + i % 3, 3], max_new_tokens=3,
+                uid=f"q{i}", **kw)
+        for i in range(n)
+    ]
+
+
+def _run_fleet(tiny1, mesh2, requests, *, kill_after=None, drain=None):
+    clock = retry.FakeClock()
+    with retry.clock_scope(clock):
+        fl = _fleet(tiny1, mesh2, clock=clock)
+        for req in requests:
+            res = fl.submit(req, arrival_t=0.0, deadline_ms=60_000.0)
+            assert res == req.uid, res
+        if kill_after is not None:
+            _kill_after(fl.replicas[1], kill_after)
+        if drain is not None:
+            fl.drain(drain)
+        done = fl.run_until_idle()
+    return fl, done
+
+
+@pytest.mark.chaos
+def test_fleet_failover_zero_lost_greedy(tiny1, mesh2):
+    """A replica killed mid-burst by a typed step death: its queued +
+    in-flight requests are re-offered to the survivor with the original
+    anchors, every request finishes, tokens byte-identical to the
+    unkilled fleet."""
+    base_fl, base = _run_fleet(tiny1, mesh2, _reqs(6))
+    assert base_fl.snapshot()["fleet"]["failovers"] == 0
+    # both replicas got work (the failover below re-offers something)
+    assert len({base_fl.replicas[0].routed, base_fl.replicas[1].routed}) > 0
+    fl, done = _run_fleet(tiny1, mesh2, _reqs(6), kill_after=1)
+    snap = fl.snapshot()
+    assert snap["engine"]["dead"] == ["r1"]
+    assert snap["fleet"]["failovers"] == 1
+    assert snap["fleet"]["failover_reoffered"] >= 1
+    assert health.counters().get(("serving_fleet", "replica_failover")) == 1
+    assert set(done) == set(base)
+    for uid in base:
+        assert isinstance(done[uid], Finished), uid
+        assert done[uid].tokens == base[uid].tokens, uid
+        # never-rebase-the-SLO: the re-offer kept the ORIGINAL arrival
+        # anchor, so its e2e must cover the pre-death wait too
+        assert done[uid].e2e_ms >= base[uid].e2e_ms - 1e-6, uid
+
+
+@pytest.mark.chaos
+def test_fleet_failover_zero_lost_seeded_sampled(tiny1, mesh2):
+    """Same arc with per-request SEEDED sampling: a cold re-offer
+    regenerates the same stream byte-for-byte because Request.seed owns
+    the RNG, not the slot that died."""
+    mk = lambda: [  # noqa: E731
+        Request([1 + i, 2, 3], max_new_tokens=3, temperature=0.8,
+                top_k=5, seed=100 + i, uid=f"s{i}")
+        for i in range(6)
+    ]
+    _, base = _run_fleet(tiny1, mesh2, mk())
+    fl, done = _run_fleet(tiny1, mesh2, mk(), kill_after=1)
+    assert fl.snapshot()["fleet"]["failovers"] == 1
+    assert set(done) == set(base)
+    for uid in base:
+        assert isinstance(done[uid], Finished), uid
+        assert done[uid].tokens == base[uid].tokens, uid
+
+
+@pytest.mark.chaos
+def test_drain_vs_crash_census_equivalence(tiny1, mesh2):
+    """Planned maintenance (drain) and a crash at the same point end in
+    the SAME terminal census: every request Finished, identical tokens —
+    the only difference is who pays (drain finishes in place and flips
+    nothing; crash re-offers and records a failover)."""
+    fl_d, done_d = _run_fleet(tiny1, mesh2, _reqs(6), drain=1)
+    fl_c, done_c = _run_fleet(tiny1, mesh2, _reqs(6), kill_after=0)
+    assert set(done_d) == set(done_c)
+    for uid in done_d:
+        assert isinstance(done_d[uid], Finished), uid
+        assert done_d[uid].tokens == done_c[uid].tokens, uid
+    sd, sc = fl_d.snapshot(), fl_c.snapshot()
+    assert sd["engine"]["dead"] == sc["engine"]["dead"] == ["r1"]
+    assert sd["fleet"]["failovers"] == 0 and sd["fleet"]["drains"] == 1
+    assert sc["fleet"]["failovers"] == 1
+
+
+@pytest.mark.chaos
+def test_alert_driven_replica_death(tiny1, mesh2):
+    """The router-side burn-rate death: health flips recorded DURING a
+    replica's steps are attributed to that replica; when its
+    health_flip_burn rule fires, the router fails it over exactly like
+    a typed step death — zero lost."""
+    tdt_config.update(obs=obs.ObsConfig(alerts=obs.AlertConfig()))
+    clock = retry.FakeClock()
+    with retry.clock_scope(clock):
+        fl = _fleet(tiny1, mesh2, clock=clock)
+        for req in _reqs(6):
+            fl.submit(req, arrival_t=0.0)
+        rep = fl.replicas[1]
+        orig = rep.engine._step_once
+        fired = {"n": 0}
+
+        def flipping():
+            # a burst of flip-kind health events inside MY step: the
+            # router's per-replica delta pins them on r1
+            if fired["n"] < 2:
+                fired["n"] += 1
+                health.record_skip_step("synthetic")
+                health.record_skip_step("synthetic")
+            return orig()
+
+        rep.engine._step_once = flipping
+        done = fl.run_until_idle()
+    snap = fl.snapshot()
+    assert snap["engine"]["dead"] == ["r1"]
+    assert snap["fleet"]["failovers"] == 1
+    assert fl.metrics.counters.get("alerts_firing", 0) >= 1
+    assert fl.replicas[0].flips == 0 and rep.flips >= 2
+    assert all(isinstance(r, Finished) for r in done.values())
+    # ... and with alerts disarmed the same flips kill nothing
+    tdt_config.update(obs=None)
+    clock = retry.FakeClock()
+    with retry.clock_scope(clock):
+        fl2 = _fleet(tiny1, mesh2, clock=clock)
+        for req in _reqs(4):
+            fl2.submit(req, arrival_t=0.0)
+        rep2 = fl2.replicas[1]
+        orig2 = rep2.engine._step_once
+
+        def flipping2():
+            health.record_skip_step("synthetic")
+            return orig2()
+
+        rep2.engine._step_once = flipping2
+        fl2.run_until_idle()
+    assert fl2.snapshot()["engine"]["dead"] == []
+
+
+@pytest.mark.chaos
+def test_fleet_soak_campaign_quick_and_replay():
+    """The chaos-matrix fleet soak cell: one seeded 2-replica campaign
+    (burst traffic × corrupt KV chunks on the replicas' handoff seams)
+    passes every invariant and replays bit-identically from its seed."""
+    from triton_dist_tpu.resilience import soak
+
+    spec = soak.SoakSpec.fleet(seed=1)
+    assert spec.replica_kill_at_step == 0
+    res = soak.run_campaign(spec)
+    assert res.ok, (res.failures, res.error)
+    assert res.snapshot["engine"]["dead"] == []
+    again = soak.run_campaign(spec)
+    assert again.fingerprint == res.fingerprint
+
+
+@pytest.mark.chaos
+def test_fleet_soak_kill_campaign():
+    """The replica-kill composition (every second seed): the decode-pool
+    timeout storm must actually KILL the target replica and the campaign
+    still satisfies every invariant — zero lost across the failover."""
+    from triton_dist_tpu.resilience import soak
+
+    spec = soak.SoakSpec.fleet(seed=0)
+    assert spec.replica_kill_at_step > 0
+    res = soak.run_campaign(spec)
+    assert res.ok, (res.failures, res.error)
+    assert res.snapshot["engine"]["dead"] == ["r1"]
+    assert res.snapshot["fleet"]["failovers"] == 1
+
+
+@pytest.mark.soak
+def test_fleet_soak_campaign_set():
+    """The full ISSUE 16 fleet set (4 seeds — what scripts/chaos_soak.py
+    runs); soak marker ⇒ slow, never rides tier-1."""
+    from triton_dist_tpu.resilience import soak
+
+    for seed in range(300, 304):
+        res = soak.run_campaign(soak.SoakSpec.fleet(seed=seed))
+        assert res.ok, (seed, res.failures, res.error)

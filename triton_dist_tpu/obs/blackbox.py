@@ -10,7 +10,10 @@ the per-call ``downgrade``/``timeout`` would bundle-storm under exactly
 the load a post-mortem reader cares about; the ladder transition that
 CAUSED them is the incident): brownout-ladder transitions,
 handoff re-streams and decode-local fallbacks, pool collapse, prefix
-strikes, PE quarantines, and detected corruption. The hook rides
+strikes, PE quarantines, detected corruption, and fleet replica
+failover (ISSUE 16 — the bundle's ``trigger.replica`` names which
+replica died, read from the ambient ``metrics.label_scope``). The hook
+rides
 ``resilience/health.py``'s single ``_record`` funnel (called OUTSIDE
 its lock), so exactly ONE bundle lands per flipping event — no
 duplicates, no misses (the chaos-soak invariant,
@@ -67,6 +70,7 @@ BLACKBOX_KINDS = (
     "prefix_strike",
     "pe_quarantine",
     "integrity",
+    "replica_failover",
 )
 
 
@@ -177,6 +181,11 @@ def _write_bundle(cfg: BlackboxConfig, seq: int, ev) -> str:
     from triton_dist_tpu.resilience import retry as _retry
 
     spans = _tracer.spans()[-cfg.last_spans:] if cfg.last_spans else []
+    # the triggering replica (ISSUE 16): a fleet-driven event fires
+    # inside the router's metrics.label_scope(replica=...), so the
+    # ambient label names which replica tripped — postmortems at N
+    # replicas need the id, the shared family string no longer suffices
+    replica = _metrics.current_labels().get("replica")
     with health._lock:
         counters = {f"{f}:{k}": n
                     for (f, k), n in sorted(health._counters.items())}
@@ -195,6 +204,7 @@ def _write_bundle(cfg: BlackboxConfig, seq: int, ev) -> str:
             "family": ev.family,
             "reason": ev.reason,
             "detail": _jsonable(ev.detail),
+            "replica": replica,
             "clock_s": round(_retry.get_clock().monotonic(), 9),
         },
         "spans": [

@@ -53,6 +53,7 @@ Exports:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
@@ -104,7 +105,44 @@ def enabled() -> bool:
     return _cfg() is not None
 
 
+# Ambient labels (ISSUE 16): the fleet router wraps each replica's step
+# in ``label_scope(replica=...)``, threading a ``replica=`` label through
+# EVERY series mirrored inside the scope — the same labels seam the
+# ``engine=<family>`` kwarg rides, without touching any of the engine's
+# call sites. A plain stack, not a thread-local: the registry is
+# process-wide and the serving tier drives replicas from one thread.
+# With the stack empty (the only state outside a fleet run) series keys
+# are byte-identical to the pre-fleet plane.
+_ambient: list[tuple] = []
+
+
+@contextlib.contextmanager
+def label_scope(**labels):
+    """Attach ``labels`` to every series recorded inside the scope
+    (explicit call-site labels win on collision). Also readable while
+    disarmed via :func:`current_labels` — the black box stamps the
+    triggering replica from it, and the soak's fleet fault injector
+    targets one replica's steps through it."""
+    _ambient.append(tuple((str(k), str(v)) for k, v in labels.items()))
+    try:
+        yield
+    finally:
+        _ambient.pop()
+
+
+def current_labels() -> dict:
+    """The merged ambient labels (innermost scope wins). Cheap and
+    config-independent — callers outside the metrics plane use it as a
+    "which replica is executing" register."""
+    out: dict[str, str] = {}
+    for frame in _ambient:
+        out.update(frame)
+    return out
+
+
 def _key(name: str, labels: dict) -> tuple:
+    if _ambient:
+        labels = {**current_labels(), **labels}
     return (name, tuple(sorted((str(k), str(v)) for k, v in labels.items())))
 
 

@@ -76,6 +76,20 @@ POOL_COLLAPSE = "pool_collapse"     # a pool lost its last serviceable PE:
                                     # the topology collapsed to the
                                     # unified engine, in-flight work
                                     # replayed (serving/disagg.py)
+REPLICA_FAILOVER = "replica_failover"  # fleet (ISSUE 16): a replica was
+                                       # declared dead (typed step
+                                       # failure or a firing burn-rate
+                                       # alert) — its queued + in-flight
+                                       # requests re-offered to
+                                       # survivors with original SLO
+                                       # anchors (serving/fleet.py)
+REPLICA_DRAIN = "replica_drain"     # fleet: a replica finished a
+                                    # GRACEFUL drain and retired —
+                                    # planned maintenance, nothing
+                                    # re-offered, informational for
+                                    # is_healthy() (the failover twin
+                                    # flips; a drain is the machinery
+                                    # working on request)
 ALERT = "alert"                     # an SLO burn-rate rule fired or
                                     # resolved (obs/alerts.py, ISSUE 15)
                                     # — informational for is_healthy():
@@ -87,7 +101,7 @@ ALERT = "alert"                     # an SLO burn-rate rule fired or
 # count these as "flips" — obs/alerts.py health_flip_rate)
 FLIP_KINDS = (DOWNGRADE, TIMEOUT, PE_QUARANTINE, INTEGRITY, SKIP_STEP,
               POISONED, BROWNOUT, SHED, HANDOFF_RESTREAM,
-              HANDOFF_FALLBACK, POOL_COLLAPSE)
+              HANDOFF_FALLBACK, POOL_COLLAPSE, REPLICA_FAILOVER)
 
 # short-circuit pin kinds (why a family is pinned to its golden path)
 PIN_ENV = "env"               # process-global environment failure
@@ -295,6 +309,31 @@ def record_pool_collapse(family: str, pool: str, reason: str) -> None:
     _record(HealthEvent(
         kind=POOL_COLLAPSE, family=family,
         reason=f"pool {pool!r}: {reason}", walltime=time.time(),
+    ))
+
+
+def record_replica_failover(family: str, replica: str, reason: str, *,
+                            reoffered: int) -> None:
+    """The fleet router declared replica ``replica`` dead and re-offered
+    its ``reoffered`` queued + in-flight requests to survivors with their
+    original arrival/deadline anchors (serving/fleet.py, ISSUE 16). The
+    replica id rides ``detail`` so incident bundles name it."""
+    _record(HealthEvent(
+        kind=REPLICA_FAILOVER, family=family,
+        reason=f"replica {replica!r}: {reason}",
+        detail={"replica": replica, "reoffered": int(reoffered)},
+        walltime=time.time(),
+    ))
+
+
+def record_replica_drain(family: str, replica: str) -> None:
+    """Replica ``replica`` finished a graceful drain and retired —
+    planned maintenance (the failover twin that loses nothing and flips
+    nothing)."""
+    _record(HealthEvent(
+        kind=REPLICA_DRAIN, family=family,
+        reason=f"replica {replica!r}: drained and retired",
+        detail={"replica": replica}, walltime=time.time(),
     ))
 
 

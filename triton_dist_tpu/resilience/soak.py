@@ -35,7 +35,15 @@ seeded serve run that composes:
   all engage, culprits struck), a prefill-pool straggler (pool-scoped
   by-absence attribution → quarantine → the POOL shrinks mid-stream),
   and — when scheduled — a prefill-pool timeout storm that collapses the
-  topology to the unified engine with every in-flight request replayed.
+  topology to the unified engine with every in-flight request replayed;
+- **the N-replica fleet** (ISSUE 16, ``SoakSpec.fleet`` campaigns):
+  burst traffic routed by prefix affinity over N disaggregated replicas,
+  composing corrupt-KV-chunk injection on the replicas' handoff seams
+  with — when scheduled — a decode-pool timeout storm that KILLS one
+  replica mid-burst (consecutive-failure exhaustion → the typed
+  ``UnrecoverableEngineError`` → router failover re-offers every queued
+  and in-flight request to the survivors with the original SLO anchors;
+  :func:`check_fleet_invariants` asserts zero lost).
 
 Faults are injected at the documented host-level chaos seam (the
 ``ContinuousBatcher.step`` wrap of tests/test_serving.py): only the
@@ -132,6 +140,34 @@ class SoakSpec:
     n_chunk_corruptions: int = 0
     collapse_at_step: int = 0
     handoff_chunks: int = 2
+    # fleet campaign knobs (ISSUE 16): fleet_replicas > 0 runs the
+    # N-replica router over disaggregated replicas (1 prefill PE + the
+    # rest decode each); replica_kill_at_step > 0 storms the KILL
+    # TARGET's decode pool with timeouts from that (pool-step) count on
+    # — consecutive-failure exhaustion raises the typed
+    # UnrecoverableEngineError out of the replica and the ROUTER's
+    # failover re-offers its work to survivors mid-burst
+    fleet_replicas: int = 0
+    replica_kill_at_step: int = 0
+    replica_kill_target: int = 1
+
+    @classmethod
+    def fleet(cls, seed: int = 0, **over) -> "SoakSpec":
+        """The ISSUE 16 soak shape: burst traffic with priorities and
+        deadlines through a 2-replica fleet of disaggregated engines
+        (1 prefill + 1 decode PE each on world=4) × corrupt KV chunks
+        mid-handoff × — every second seed — a replica killed mid-burst
+        by a decode-pool timeout storm (failover re-offers its queued +
+        in-flight work to the survivor with the original SLO anchors)."""
+        kw = dict(
+            seed=seed, world=4, fleet_replicas=2, disagg_prefill_pes=1,
+            n_requests=16, rate_rps=14.0, burst_n=5, max_queue=10,
+            n_timeouts=0, n_corruptions=0, n_chunk_corruptions=2,
+            fault_window=30,
+            replica_kill_at_step=0 if seed % 2 else 12,
+        )
+        kw.update(over)
+        return cls(**kw)
 
     @classmethod
     def disagg(cls, seed: int = 0, **over) -> "SoakSpec":
@@ -182,8 +218,35 @@ class SoakSpec:
             raise ValueError(
                 "n_poisons targets shared chains — set prefix_pool too"
             )
+        if self.fleet_replicas:
+            if not self.disagg_prefill_pes:
+                raise ValueError(
+                    "fleet campaigns run disaggregated replicas — set "
+                    "disagg_prefill_pes (per replica) too"
+                )
+            if self.world % self.fleet_replicas:
+                raise ValueError(
+                    f"world={self.world} does not split into "
+                    f"fleet_replicas={self.fleet_replicas} equal slices"
+                )
+            if not 0 <= self.replica_kill_target < self.fleet_replicas:
+                raise ValueError("replica_kill_target out of range")
+            per = self.world // self.fleet_replicas
+            if not 1 <= self.disagg_prefill_pes < per:
+                raise ValueError(
+                    f"disagg_prefill_pes={self.disagg_prefill_pes} must "
+                    f"leave a decode pool inside each replica's "
+                    f"{per}-device slice"
+                )
+        elif self.replica_kill_at_step:
+            raise ValueError(
+                "replica_kill_at_step is a fleet fault — set "
+                "fleet_replicas too"
+            )
         if self.disagg_prefill_pes:
-            if not 1 <= self.disagg_prefill_pes < self.world:
+            if not self.fleet_replicas and not (
+                1 <= self.disagg_prefill_pes < self.world
+            ):
                 raise ValueError(
                     f"disagg_prefill_pes={self.disagg_prefill_pes} must "
                     f"leave a decode pool inside world={self.world}"
@@ -793,6 +856,282 @@ def _run_disagg_campaign(spec: SoakSpec) -> CampaignResult:
         resilience.reset(keep_env=True)
 
 
+@contextlib.contextmanager
+def _inject_fleet_faults(*, kill_at: int, target: str):
+    """The replica-aware chaos seam (ISSUE 16): only batcher steps
+    running inside the kill target's ``metrics.label_scope(replica=...)``
+    AND the decode ``faults.pool_scope`` count — every other replica and
+    pool is untouched. From (pool-step) ``kill_at`` on, every such step
+    times out: the decode pool's consecutive-failure budget exhausts,
+    the typed :class:`UnrecoverableEngineError` propagates out of the
+    replica's tick, and the ROUTER — not anything inside the replica —
+    must recover every request it owned."""
+    from triton_dist_tpu.models.decode import ContinuousBatcher
+    from triton_dist_tpu.obs import metrics as _metrics
+    from triton_dist_tpu.resilience import faults as _faults
+
+    real_step = ContinuousBatcher.step
+    calls = {"n": 0}
+
+    def flaky(self):
+        if (_metrics.current_labels().get("replica") != target
+                or _faults.current_pool() != "decode"):
+            return real_step(self)
+        calls["n"] += 1
+        if kill_at and calls["n"] >= kill_at:
+            w = int(self.mesh.devices.size)
+            recs = [
+                {"pe": p, "kind": "barrier_all", "site": 0,
+                 "status": "timeout", "expected": 1, "observed": 0,
+                 "budget": 16}
+                for p in range(w) if p != 0
+            ]
+            raise DistTimeoutError("batcher_step", recs, world_size=w)
+        return real_step(self)
+
+    ContinuousBatcher.step = flaky
+    try:
+        yield calls
+    finally:
+        ContinuousBatcher.step = real_step
+
+
+def check_fleet_invariants(fl, result: CampaignResult,
+                           offered_uids: set) -> list:
+    """The fleet campaign's green conditions: the module-docstring
+    invariants over the N-replica composition — zero lost across a
+    replica death, router accounting balance, and failover/health
+    agreement."""
+    fails: list[str] = []
+    snap = result.snapshot
+    reqs = snap.get("requests", {})
+    term = result.terminals
+    spec = result.spec
+
+    # 1. no lost request — across replica death and re-offer
+    got = set(term)
+    if got != offered_uids:
+        fails.append(
+            f"terminal census mismatch: missing={sorted(offered_uids - got)} "
+            f"extra={sorted(got - offered_uids)}"
+        )
+    unknown = {u: k for u, k in term.items() if k.startswith("<unknown")}
+    if unknown:
+        fails.append(f"non-terminal results: {unknown}")
+
+    # 2. no residue — at the router and inside every surviving replica
+    if fl._states:
+        fails.append(
+            f"router residue after serve: in_flight={len(fl._states)}"
+        )
+    for rep in fl.replicas:
+        if rep.alive and rep.engine._states:
+            fails.append(
+                f"replica {rep.name} left {len(rep.engine._states)} "
+                f"request(s) behind"
+            )
+
+    # 3. accounting balance at the fleet tier: every _submit_offer is
+    # counted, so submitted == offered + reject re-offers + failover
+    # re-offers — a silently double-routed or dropped offer breaks this
+    census: dict[str, int] = {}
+    for k in term.values():
+        census[k] = census.get(k, 0) + 1
+    for name, want in (
+        ("finished", census.get("finished", 0)),
+        ("shed", census.get("shed", 0)),
+        ("poisoned", census.get("poisoned", 0)),
+    ):
+        if reqs.get(name, 0) != want:
+            fails.append(
+                f"fleet counter {name}={reqs.get(name, 0)} disagrees "
+                f"with terminal census {want}"
+            )
+    want_submitted = (len(offered_uids) + reqs.get("reoffered", 0)
+                      + reqs.get("failover_reoffered", 0))
+    if reqs.get("submitted", 0) != want_submitted:
+        fails.append(
+            f"submitted={reqs.get('submitted', 0)} != offered "
+            f"{len(offered_uids)} + reoffered {reqs.get('reoffered', 0)} "
+            f"+ failover_reoffered {reqs.get('failover_reoffered', 0)}"
+        )
+
+    # 4. the scheduled faults actually ran, and health agrees
+    hc = result.health.get("counters", {})
+    want_failovers = 1 if spec.replica_kill_at_step else 0
+    if reqs.get("failovers", 0) != want_failovers:
+        fails.append(
+            f"failovers={reqs.get('failovers', 0)} != scheduled "
+            f"{want_failovers}"
+        )
+    if hc.get("serving_fleet:replica_failover", 0) != want_failovers:
+        fails.append(
+            f"health replica_failover="
+            f"{hc.get('serving_fleet:replica_failover', 0)} != scheduled "
+            f"{want_failovers}"
+        )
+    if spec.replica_kill_at_step:
+        dead = snap.get("engine", {}).get("dead", [])
+        want_dead = f"r{spec.replica_kill_target}"
+        if dead != [want_dead]:
+            fails.append(
+                f"dead replicas {dead} != [{want_dead!r}] — the storm "
+                f"killed the wrong replica (or none)"
+            )
+    if spec.n_chunk_corruptions and not hc.get(
+        "kv_handoff:handoff_retry", 0
+    ):
+        fails.append(
+            "scheduled chunk corruption never fired — the handoff ladder "
+            "this campaign advertises did not run (retune the spec)"
+        )
+    return fails
+
+
+def _run_fleet_campaign(spec: SoakSpec) -> CampaignResult:
+    """One seeded fleet campaign (dispatched by :func:`run_campaign`
+    when ``spec.fleet_replicas > 0``): N disaggregated replicas behind
+    the router, chunk corruption on the decode handoff seam, and — when
+    scheduled — one replica killed mid-burst.
+
+    Elastic stays DISABLED here: PE strike attribution is a
+    process-global namespace indexed by mesh position, and N replicas'
+    identically-numbered slices would cross-contaminate it (a strike on
+    r0's decode PE would quarantine r1's) — the fleet's recovery story
+    is REPLICA-scoped (failover), not PE-scoped (shrink). Known limit,
+    docs/serving.md "Fleet"."""
+    import jax
+
+    from triton_dist_tpu import config as tdt_config
+    from triton_dist_tpu import resilience
+    from triton_dist_tpu.resilience.faults import FaultPlan
+    from triton_dist_tpu.serving import (
+        DisaggServingConfig,
+        HandoffConfig,
+        OverloadConfig,
+        ServingConfig,
+        TrafficSpec,
+        generate_trace,
+    )
+    from triton_dist_tpu.serving.fleet import FleetConfig, FleetRouter
+    from triton_dist_tpu.serving.metrics import SLOTargets
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < spec.world:
+        raise RuntimeError(
+            f"soak needs {spec.world} devices (run under "
+            f"--xla_force_host_platform_device_count, as "
+            f"scripts/chaos_soak.py and conftest.py do); have "
+            f"{len(jax.devices())}"
+        )
+    cfgsnap = tdt_config.get_config()
+    saved = (cfgsnap.elastic, cfgsnap.fault_plan)
+    resilience.reset(keep_env=True)
+    tdt_config.update(
+        elastic=False,
+        fault_plan=(
+            FaultPlan("bitflip", pe=-1, pool="decode",
+                      max_triggers=spec.n_chunk_corruptions)
+            if spec.n_chunk_corruptions else None
+        ),
+    )
+    try:
+        from triton_dist_tpu.models import init_params
+        from triton_dist_tpu.models.tp_transformer import TransformerConfig
+        from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig
+        from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig
+        from jax.random import PRNGKey
+
+        cfg = TransformerConfig(
+            vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=4,
+            n_kv_heads=2, head_dim=8, batch=spec.batch, seq=8,
+            ag_config=AGGemmConfig(8, 16, 16),
+            rs_config=GemmRSConfig(8, 16, 16),
+        )
+        params = init_params(PRNGKey(1), cfg)
+        mesh = Mesh(np.array(jax.devices()[:spec.world]), ("tp",))
+        traffic = TrafficSpec(
+            rate_rps=spec.rate_rps, n_requests=spec.n_requests,
+            process="burst", burst_every_s=spec.burst_every_s,
+            burst_n=spec.burst_n,
+            prompt_len=("uniform", 2, 6), output_len=("uniform", 2, 5),
+            vocab=cfg.vocab, seed=spec.seed, uid_prefix=f"fl{spec.seed}-",
+            priority_mix=spec.priority_mix, deadline_ms=spec.deadline_ms,
+        )
+        trace = generate_trace(traffic)
+        clock = _retry.FakeClock()
+        pool_serving = ServingConfig(
+            max_queue=spec.max_queue, max_step_failures=3,
+            overload=OverloadConfig(
+                min_dwell_steps=4, window_steps=8, retry_budget=4,
+            ),
+        )
+        with _flight_recorder():
+            with _retry.clock_scope(clock):
+                fl = FleetRouter(
+                    cfg, params, mesh, s_max=spec.s_max, clock=clock,
+                    fleet=FleetConfig(
+                        replicas=spec.fleet_replicas,
+                        disagg=DisaggServingConfig(
+                            prefill_pes=spec.disagg_prefill_pes,
+                            virtual_step_s=spec.virtual_step_s,
+                            slo=SLOTargets(ttft_ms=1500.0),
+                            handoff=HandoffConfig(
+                                page_tokens=4,
+                                chunks_per_page=spec.handoff_chunks,
+                                virtual_chunk_s=0.002,
+                            ),
+                            prefill=pool_serving,
+                            decode=pool_serving,
+                        ),
+                        slo=SLOTargets(ttft_ms=1500.0),
+                    ),
+                )
+                error = None
+                with _inject_fleet_faults(
+                    kill_at=spec.replica_kill_at_step,
+                    target=f"r{spec.replica_kill_target}",
+                ) as calls:
+                    try:
+                        done = fl.serve(trace, max_steps=spec.max_steps)
+                    except RuntimeError as exc:
+                        error = f"{type(exc).__name__}: {exc}"
+                        done = dict(fl.results)
+            transitions = []
+            for rep in fl.replicas:
+                for pool in (rep.engine.prefill, rep.engine.decode):
+                    if pool._overload is not None:
+                        transitions.extend(
+                            dataclasses.asdict(t)
+                            for t in pool._overload.transitions
+                        )
+            result = CampaignResult(
+                spec=spec,
+                terminals={u: _terminal_kind(r) for u, r in done.items()},
+                n_steps_hint=calls["n"],
+                rebuilds=sum(
+                    rep.engine.prefill.rebuilds + rep.engine.decode.rebuilds
+                    for rep in fl.replicas
+                ),
+                transitions=transitions,
+                snapshot=fl.snapshot(),
+                health=resilience.health.snapshot(),
+                fingerprint="",
+                failures=[],
+                error=error,
+            )
+            result.fingerprint = campaign_fingerprint(result)
+            offered = {a.request.uid for a in trace}
+            result.failures = (
+                check_fleet_invariants(fl, result, offered)
+                + check_blackbox_invariant(result.health)
+            )
+        return result
+    finally:
+        tdt_config.update(elastic=saved[0], fault_plan=saved[1])
+        resilience.reset(keep_env=True)
+
+
 def run_campaign(spec: SoakSpec, *, model=None) -> CampaignResult:
     """Run one seeded campaign and evaluate its invariants. Process-global
     state (config, resilience registries, module clock) is snapshotted
@@ -800,8 +1139,11 @@ def run_campaign(spec: SoakSpec, *, model=None) -> CampaignResult:
     pytest session. ``model=(cfg, params)`` overrides the built-in tiny
     4-PE transformer (the test fixture reuse hook). A spec with
     ``disagg_prefill_pes > 0`` runs the two-pool topology campaign
-    (:func:`check_disagg_invariants`)."""
-    if spec.validate().disagg_prefill_pes:
+    (:func:`check_disagg_invariants`); ``fleet_replicas > 0`` runs the
+    N-replica router campaign (:func:`check_fleet_invariants`)."""
+    if spec.validate().fleet_replicas:
+        return _run_fleet_campaign(spec)
+    if spec.disagg_prefill_pes:
         return _run_disagg_campaign(spec)
     import jax
 

@@ -36,6 +36,17 @@ Five parts (docs/serving.md "Serving engine" is the full contract):
   last-page-landed, and degrades pool-level: brownout sheds to
   decode-local prefill, a dead prefill pool collapses to unified with
   zero lost requests.
+- :mod:`fleet` — the router plane over N replicas (ISSUE 16,
+  docs/serving.md "Fleet"): :class:`FleetRouter` carves a 1-D mesh into
+  N equal slices running one full engine each (unified or
+  disaggregated), routes each arrival by prefix affinity (the trie page
+  keys, cross-replica never-prefill-twice) with pressure-aware fallback
+  (brownout rung / outstanding / pressure — a ``shed_all_batch`` replica
+  stops receiving batch traffic at the router), and fails over a dead
+  replica (typed step death or a firing per-replica flip-burn alert) by
+  re-offering every queued + in-flight request to survivors with the
+  ORIGINAL arrival/deadline anchors — zero lost, never-rebase-the-SLO.
+  ``FleetConfig(replicas=1)`` is byte-identical to the bare engine.
 
 Plus the radix-shared paged KV prefix cache (ISSUE 12;
 ``models/prefix_cache.py``, docs/serving.md "Prefix cache"), armed via
@@ -67,6 +78,10 @@ from triton_dist_tpu.serving.disagg import (
     DisaggServingConfig,
     DisaggServingEngine,
     PoolCollapse,
+)
+from triton_dist_tpu.serving.fleet import (
+    FleetConfig,
+    FleetRouter,
 )
 from triton_dist_tpu.serving.engine import (
     Finished,
@@ -107,6 +122,8 @@ __all__ = [
     "DisaggServingConfig",
     "DisaggServingEngine",
     "Finished",
+    "FleetConfig",
+    "FleetRouter",
     "HandoffConfig",
     "HandoffPlane",
     "HandoffResult",

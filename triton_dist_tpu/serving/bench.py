@@ -26,6 +26,7 @@ from triton_dist_tpu.serving.disagg import (
     DisaggServingEngine,
 )
 from triton_dist_tpu.serving.engine import ServingConfig, ServingEngine
+from triton_dist_tpu.serving.fleet import FleetConfig, FleetRouter
 from triton_dist_tpu.serving.metrics import SLOTargets
 from triton_dist_tpu.serving.traffic import TrafficSpec, generate_trace
 
@@ -47,6 +48,7 @@ def sweep_offered_load(
     batcher_kw: dict | None = None,
     traffic_kw: dict | None = None,
     disagg: DisaggServingConfig | None = None,
+    fleet: FleetConfig | None = None,
     tag: str = "",
 ) -> list[dict]:
     """One engine + trace per λ; returns
@@ -57,7 +59,16 @@ def sweep_offered_load(
     lanes apart in a merged obs export. ``disagg`` (ISSUE 13) swaps the
     unified engine for the two-pool :class:`DisaggServingEngine` on the
     (multi-device) ``mesh`` — the coordinator charges ``virtual_step_s``
-    per topology tick and ``slo`` scores at the coordinator tier."""
+    per topology tick and ``slo`` scores at the coordinator tier.
+    ``fleet`` (ISSUE 16) swaps in the N-replica :class:`FleetRouter` on
+    the same mesh — the router charges ``virtual_step_s`` per fleet tick
+    and ``slo`` scores at the fleet tier."""
+    if fleet is not None and disagg is not None:
+        raise ValueError(
+            "pass the disagg config INSIDE FleetConfig(disagg=...) to "
+            "bench a fleet of disaggregated replicas — fleet= and "
+            "disagg= together is ambiguous"
+        )
     rows = []
     for lam in rates:
         # per-row span isolation is structural: each λ gets a FRESH
@@ -72,7 +83,37 @@ def sweep_offered_load(
             vocab=cfg.vocab, seed=seed,
             **(traffic_kw or {}),
         )
-        if disagg is not None:
+        if fleet is not None:
+            if serving_kw:
+                raise ValueError(
+                    "serving_kw configures the UNIFIED engine; with "
+                    "fleet= set the per-replica policy lives on "
+                    "FleetConfig.serving/.disagg — pass it there "
+                    "(silently ignoring serving_kw would bench an "
+                    "unarmed fleet)"
+                )
+            if fleet.disagg is not None:
+                fl = dataclasses.replace(
+                    fleet, slo=slo,
+                    disagg=dataclasses.replace(
+                        fleet.disagg, virtual_step_s=virtual_step_s,
+                        slo=slo,
+                    ),
+                )
+            else:
+                fl = dataclasses.replace(
+                    fleet, slo=slo,
+                    serving=dataclasses.replace(
+                        fleet.serving, virtual_step_s=virtual_step_s,
+                        slo=slo,
+                    ),
+                )
+            eng = FleetRouter(
+                cfg, params, mesh, s_max=s_max, clock=clock, fleet=fl,
+                obs_tag=f"lam{lam:g}:{tag}",
+                **(batcher_kw or {}),
+            )
+        elif disagg is not None:
             if serving_kw:
                 raise ValueError(
                     "serving_kw configures the UNIFIED engine; with "
@@ -162,6 +203,18 @@ def info_lines(rows: list[dict], tag: str = "") -> list[tuple[str, Any, str]]:
             if st is not None and st["count"]:
                 out.append((f"serving_interactive_ttft_p99_ms_{key}",
                             st["p99"], "ms"))
+        if "fleet" in snap:
+            # the fleet A/B's judged columns (ISSUE 16): did affinity
+            # routing actually land repeat prefixes on warm replicas,
+            # and what did robustness cost (failovers, re-offers)?
+            fl = snap["fleet"]
+            out.append((f"serving_fleet_affinity_hit_rate_{key}",
+                        fl["affinity_hit_rate"], "fraction"))
+            out.append((f"serving_fleet_failovers_{key}",
+                        fl["failovers"], "replicas"))
+            out.append((f"serving_fleet_reoffered_{key}",
+                        fl["reoffered"] + fl["failover_reoffered"],
+                        "requests"))
         if "handoff" in snap:
             # the disagg A/B's attribution columns (ISSUE 13): what the
             # wire moved, what the trie-manifest dedup saved, and how

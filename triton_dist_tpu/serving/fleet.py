@@ -1,0 +1,760 @@
+"""Fleet-scale serving (ISSUE 16, ROADMAP #3): a seeded, clock-driven
+router plane over N independent replicas.
+
+The millions-of-users story needs the one dimension PRs 11–15 never
+touched: replica COUNT. :class:`FleetRouter` carves a 1-D mesh into N
+equal slices and runs one full engine per slice — each a
+:class:`~triton_dist_tpu.serving.engine.ServingEngine` or (with
+``FleetConfig.disagg`` set) a two-pool
+:class:`~triton_dist_tpu.serving.disagg.DisaggServingEngine` — behind
+one submit/serve surface with three robustness pillars:
+
+- **Prefix-affinity routing** — each arrival's prompt is fingerprinted
+  with the ISSUE 12 trie page keys (the full prefix through each
+  ``page_tokens`` boundary, exactly ``HandoffPlane.manifest``'s keying)
+  and routed to the replica whose cache already holds the longest chain
+  of them: the cross-replica form of never-prefill-twice. The router
+  keeps its own model of per-replica residency (what it routed there);
+  it cannot see replica-local trie evictions — a stale-affinity route
+  costs a cold prefill, never correctness (known limit,
+  docs/serving.md "Fleet").
+- **Pressure-aware placement** — ties and affinity misses place on the
+  per-replica signals the ISSUE 15 metrics plane exports (brownout
+  rung, outstanding requests, composite pressure), never blind
+  round-robin. A replica at ``shed_all_batch`` stops receiving batch
+  traffic AT THE ROUTER — one rung before its own door would shed it.
+  ``routing="random"`` (seeded) exists as the A/B baseline arm.
+- **Replica failover** — the ISSUE 13 collapse machinery at fleet
+  scope. A replica is declared dead on a typed step failure
+  (:class:`UnrecoverableEngineError` / :class:`PoolCollapse` — bare
+  exceptions stay loud) or when its router-side ``health_flip_burn``
+  burn-rate alert fires (per-replica flip attribution via step deltas;
+  ``FleetConfig.fail_on_alert``). Its finished results are drained
+  FIRST, then every queued and in-flight request is re-offered to
+  survivors COLD from the original request — with the ORIGINAL
+  arrival-time and deadline anchors (the ISSUE 11 never-rebase-the-SLO
+  rule). Zero lost: every offered uid still reaches exactly one
+  terminal, and a cold re-offer regenerates the same stream
+  byte-for-byte (greedy and seeded-sampled — ``Request.seed`` is
+  per-request). :meth:`FleetRouter.drain` is the planned-maintenance
+  twin: no new routes, in-flight work finishes in place, then the
+  replica retires — crash and drain produce equivalent terminal
+  censuses (pinned in tests/test_fleet.py).
+
+Arming discipline: ``FleetConfig(replicas=1)`` builds ONE engine over
+the full mesh with the serving config verbatim and :meth:`serve`
+delegates to it — byte-identical results and snapshot to the bare
+single engine (pinned), the None-posture of every subsystem here.
+At N > 1 the per-replica ``virtual_step_s`` moves up to the router:
+replicas run CONCURRENTLY, so one fleet tick steps every live replica
+once and charges the virtual clock ONE step (the disagg coordinator's
+tick discipline).
+
+Observability: each replica's step runs inside
+``obs.metrics.label_scope(replica="rN")``, threading a ``replica=``
+label through every engine-mirrored series without touching engine
+call sites, and the black box stamps ``trigger.replica`` from the same
+scope — incident bundles name the replica that tripped (ISSUE 16
+satellite).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Any
+
+import numpy as np
+from jax.sharding import Mesh
+
+from triton_dist_tpu import obs as _obs
+from triton_dist_tpu.obs import metrics as _mx
+from triton_dist_tpu.resilience import health
+from triton_dist_tpu.resilience import retry as _retry
+from triton_dist_tpu.serving.disagg import (
+    DisaggServingConfig,
+    DisaggServingEngine,
+    PoolCollapse,
+)
+from triton_dist_tpu.serving.engine import (
+    Finished,
+    Poisoned,
+    Rejected,
+    ServingConfig,
+    ServingEngine,
+    Shed,
+    UnrecoverableEngineError,
+)
+from triton_dist_tpu.serving.metrics import ServingMetrics, SLOTargets
+from triton_dist_tpu.serving.overload import (
+    LADDER,
+    PRIORITIES,
+    SHED_ALL_BATCH,
+    priority_rank,
+)
+
+ROUTING_POLICIES = ("affinity", "random")
+
+_SHED_RUNG = LADDER.index(SHED_ALL_BATCH)
+
+
+def prefix_page_keys(prompt, page_tokens: int) -> list[tuple]:
+    """The ISSUE 12 trie keys of a prompt at page granularity: for page
+    ``g``, the FULL prefix through that page's end (so a key equals a
+    key iff the entire prefix matches — ``HandoffPlane.manifest`` /
+    ``models/prefix_cache.py`` chain keying)."""
+    pg = int(page_tokens)
+    n_pages = -(-len(prompt) // pg)
+    return [
+        tuple(prompt[: min((g + 1) * pg, len(prompt))])
+        for g in range(n_pages)
+    ]
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Policy of the router plane.
+
+    replicas:      engine count; the 1-D mesh is carved into this many
+                   equal slices. 1 = the arming-discipline posture
+                   (byte-identical to the single engine, pinned).
+    serving:       each replica's :class:`ServingConfig` (ignored when
+                   ``disagg`` is set).
+    disagg:        arm each replica as a two-pool
+                   :class:`DisaggServingEngine` with this config.
+    routing:       "affinity" (prefix-affinity, pressure fallback) or
+                   "random" (seeded uniform over eligible replicas —
+                   the A/B baseline arm).
+    seed:          the router's own PRNG stream (random routing only).
+    page_tokens:   affinity fingerprint granularity — keep equal to the
+                   replica trie/handoff page size so the router's
+                   residency model mirrors the caches it predicts
+                   (validated against ``disagg.handoff.page_tokens``).
+    slo:           end-to-end targets scored at the FLEET tier (each
+                   replica additionally scores its own).
+    fail_on_alert: router-side burn-rate rule name whose firing declares
+                   a replica dead (None disables; only active when
+                   ``ObsConfig.alerts`` is armed). Flip attribution is
+                   per replica: the router feeds each replica's alert
+                   engine only the health flips recorded during THAT
+                   replica's steps.
+    """
+
+    replicas: int = 1
+    serving: ServingConfig = ServingConfig()
+    disagg: DisaggServingConfig | None = None
+    routing: str = "affinity"
+    seed: int = 0
+    page_tokens: int = 4
+    slo: SLOTargets | None = None
+    fail_on_alert: str | None = "health_flip_burn"
+
+    def validate(self) -> "FleetConfig":
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if self.routing not in ROUTING_POLICIES:
+            raise ValueError(
+                f"routing must be one of {ROUTING_POLICIES}, got "
+                f"{self.routing!r}"
+            )
+        if self.page_tokens < 1:
+            raise ValueError(
+                f"page_tokens must be >= 1, got {self.page_tokens}"
+            )
+        self.serving.validate()
+        if self.disagg is not None:
+            self.disagg.validate()
+            if self.disagg.handoff.page_tokens != self.page_tokens:
+                raise ValueError(
+                    f"page_tokens={self.page_tokens} must equal "
+                    f"disagg.handoff.page_tokens="
+                    f"{self.disagg.handoff.page_tokens} — the affinity "
+                    f"fingerprint must mirror the cache it predicts"
+                )
+        return self
+
+
+@dataclasses.dataclass
+class _Replica:
+    """Router-side view of one replica."""
+
+    idx: int
+    name: str
+    engine: Any
+    alive: bool = True
+    draining: bool = False
+    routed: int = 0
+    flips: int = 0              # health flips attributed to MY steps
+    resident: set = dataclasses.field(default_factory=set)
+    alerts: Any = None
+    alerts_resolved: bool = False
+
+
+@dataclasses.dataclass
+class _FOffer:
+    """One routable unit: the original request plus the SLO anchors it
+    was first offered with — failover re-offers carry these verbatim
+    (never-rebase-the-SLO)."""
+
+    req: Any
+    t_anchor: float
+    priority: str
+    deadline_ms: float | None
+    client_id: str | None = None
+
+
+class FleetRouter:
+    """N replicas behind one engine-shaped surface (see module
+    docstring). Constructor mirrors :class:`ServingEngine`'s; the mesh
+    must be 1-D with ``len(devices) % replicas == 0``."""
+
+    family = "serving_fleet"
+
+    def __init__(
+        self,
+        cfg,
+        params,
+        mesh,
+        *,
+        s_max: int,
+        fleet: FleetConfig | None = None,
+        metrics: ServingMetrics | None = None,
+        clock: Any = None,
+        obs_tag: str = "",
+        **batcher_kw: Any,
+    ):
+        self.cfg = cfg
+        self.fleet = (fleet or FleetConfig()).validate()
+        self.clock = clock if clock is not None else _retry.get_clock()
+        self._obs_tag = str(obs_tag)
+        n = self.fleet.replicas
+        if mesh.devices.ndim != 1:
+            raise ValueError(
+                f"the fleet carves a 1-D mesh into {n} replica slice(s); "
+                f"got {dict(mesh.shape)}"
+            )
+        devices = list(mesh.devices.flat)
+        if len(devices) % n:
+            raise ValueError(
+                f"{len(devices)} device(s) do not split into "
+                f"replicas={n} equal slices"
+            )
+        per = len(devices) // n
+        self.full_mesh = mesh
+        self.s_max = int(s_max)
+        # N == 1 keeps the serving config VERBATIM on the one replica
+        # (the byte-identity pin); N > 1 moves virtual_step_s up to the
+        # router — replicas run concurrently, one tick charges one step
+        self._virtual_step_s = None
+        if self.fleet.disagg is not None:
+            rep_serving = self.fleet.disagg
+            if n > 1:
+                self._virtual_step_s = rep_serving.virtual_step_s
+                rep_serving = dataclasses.replace(
+                    rep_serving, virtual_step_s=None
+                )
+            mk = lambda sub, tag: DisaggServingEngine(  # noqa: E731
+                cfg, params, sub, s_max=s_max, serving=rep_serving,
+                clock=self.clock, obs_tag=tag, **batcher_kw,
+            )
+        else:
+            rep_serving = self.fleet.serving
+            if n > 1:
+                self._virtual_step_s = rep_serving.virtual_step_s
+                rep_serving = dataclasses.replace(
+                    rep_serving, virtual_step_s=None
+                )
+            mk = lambda sub, tag: ServingEngine(  # noqa: E731
+                cfg, params, sub, s_max=s_max, serving=rep_serving,
+                clock=self.clock, obs_tag=tag, **batcher_kw,
+            )
+        self.replicas = [
+            _Replica(
+                idx=i, name=f"r{i}",
+                engine=mk(
+                    Mesh(np.array(devices[i * per:(i + 1) * per]),
+                         (cfg.axis,)),
+                    f"{self._obs_tag}r{i}:" if n > 1 else self._obs_tag,
+                ),
+            )
+            for i in range(n)
+        ]
+        any_classes = self.replicas[0].engine.metrics.classes is not None
+        self.metrics = metrics or ServingMetrics(
+            slo=self.fleet.slo,
+            classes=PRIORITIES if any_classes else None,
+        )
+        self.results: dict[Any, Any] = {}
+        self._states: dict[Any, _FOffer] = {}
+        self._owner: dict[Any, int] = {}
+        self._backlog: list[_FOffer] = []
+        self._affinity_lookups = 0
+        self._affinity_hits = 0
+        self._rng = np.random.default_rng([int(self.fleet.seed), 0xF1EE7])
+        self._uid_counter = 0
+        self._stopping = False
+        self._t0 = self.clock.monotonic()
+
+    # -- replica signals -------------------------------------------------
+
+    def _rung(self, rep: _Replica) -> int:
+        eng = rep.engine
+        if isinstance(eng, DisaggServingEngine):
+            ctrls = [eng.prefill._overload, eng.decode._overload]
+        else:
+            ctrls = [eng._overload]
+        return max((c.rung() for c in ctrls if c is not None), default=0)
+
+    def _pressure(self, rep: _Replica) -> float:
+        eng = rep.engine
+        if isinstance(eng, DisaggServingEngine):
+            ctrls = [eng.prefill._overload, eng.decode._overload]
+        else:
+            ctrls = [eng._overload]
+        return max(
+            (c.last_pressure for c in ctrls if c is not None), default=0.0
+        )
+
+    def _outstanding(self, rep: _Replica) -> int:
+        return len(rep.engine._states)
+
+    def _live(self) -> list[_Replica]:
+        return [r for r in self.replicas if r.alive and not r.draining]
+
+    # -- routing ---------------------------------------------------------
+
+    def _pressure_key(self, rep: _Replica):
+        # deterministic total order: rung first (a browned-out replica
+        # is the last resort), then outstanding work, composite
+        # pressure, and the index as the final tiebreak
+        return (self._rung(rep), self._outstanding(rep),
+                self._pressure(rep), rep.idx)
+
+    def _route(self, prompt, priority: str) -> list[tuple[_Replica, str]]:
+        """Candidate replicas in offer order, each tagged with the
+        policy that ranked it ("affinity" | "pressure" | "random")."""
+        cands = self._live()
+        if not cands:
+            return []
+        if priority_rank(priority) > 0:
+            # shed_all_batch stops batch traffic AT THE ROUTER — one
+            # rung before the replica's own door (unless every live
+            # replica is shedding; then its typed door-shed is the
+            # honest terminal)
+            open_ = [r for r in cands if self._rung(r) < _SHED_RUNG]
+            if open_:
+                cands = open_
+        if self.fleet.routing == "random":
+            # one seeded draw per routed offer: a rotation keeps the
+            # full candidate list as rejection fallback
+            start = int(self._rng.integers(0, len(cands)))
+            order = cands[start:] + cands[:start]
+            return [(r, "random") for r in order]
+        keys = prefix_page_keys(prompt, self.fleet.page_tokens)
+        self._affinity_lookups += 1
+
+        def score(rep: _Replica) -> int:
+            n = 0
+            for k in keys:
+                if k not in rep.resident:
+                    break
+                n += 1
+            return n
+
+        scored = sorted(
+            ((score(r), r) for r in cands),
+            key=lambda sr: (-sr[0],) + self._pressure_key(sr[1]),
+        )
+        if scored[0][0] > 0:
+            self._affinity_hits += 1
+        return [
+            (r, "affinity" if s > 0 else "pressure") for s, r in scored
+        ]
+
+    def _mark_resident(self, rep: _Replica, prompt) -> None:
+        rep.resident.update(prefix_page_keys(prompt, self.fleet.page_tokens))
+
+    # -- submission ------------------------------------------------------
+
+    def submit(
+        self,
+        req,
+        *,
+        arrival_t: float | None = None,
+        priority: str = "interactive",
+        deadline_ms: float | None = None,
+        client_id: str | None = None,
+    ):
+        """Route one request into the fleet. Returns its uid, a typed
+        :class:`Shed` (the chosen replica's door refused it — terminal),
+        or a typed :class:`Rejected` (EVERY eligible replica refused —
+        not terminal at the fleet: :meth:`serve` re-offers it with the
+        original anchors, the disagg coordinator convention)."""
+        now = self.clock.monotonic() if arrival_t is None else float(arrival_t)
+        if req.uid is None:
+            req = dataclasses.replace(req, uid=f"f{self._uid_counter}")
+            self._uid_counter += 1
+        if req.uid in self._states or req.uid in self.results:
+            raise ValueError(f"duplicate request uid {req.uid!r}")
+        off = _FOffer(req=req, t_anchor=now, priority=priority,
+                      deadline_ms=deadline_ms, client_id=client_id)
+        return self._submit_offer(off)
+
+    def _submit_offer(self, off: _FOffer):
+        self.metrics.count("submitted")
+        self.metrics.count_class("submitted", off.priority)
+        order = self._route(off.req.prompt, off.priority)
+        if not order:
+            raise UnrecoverableEngineError(
+                "fleet has no live replicas left to route to"
+            )
+        last_rej = None
+        for rep, policy in order:
+            res = rep.engine.submit(
+                off.req, arrival_t=off.t_anchor, priority=off.priority,
+                deadline_ms=off.deadline_ms,
+            )
+            if isinstance(res, Rejected):
+                last_rej = res
+                continue
+            rep.routed += 1
+            if _mx.enabled():
+                _mx.counter("fleet_routed_total", engine=self.family,
+                            replica=rep.name, policy=policy)
+            if isinstance(res, Shed):
+                # terminal at the replica's door: collect it into the
+                # fleet census immediately (it is already in the
+                # replica's results dict)
+                rep.engine.results.pop(off.req.uid, None)
+                self.results[off.req.uid] = res
+                self.metrics.count("shed")
+                self.metrics.count_class("shed", off.priority)
+                return res
+            self._states[off.req.uid] = off
+            self._owner[off.req.uid] = rep.idx
+            self._mark_resident(rep, off.req.prompt)
+            return off.req.uid
+        # every eligible replica refused — not terminal here
+        self.metrics.count("rejected")
+        return Rejected(
+            off.req.uid,
+            f"all {len(order)} live replica(s) refused: {last_rej.reason}",
+            last_rej.queue_depth, last_rej.priority,
+        )
+
+    # -- terminal collection --------------------------------------------
+
+    def _collect(self, rep: _Replica) -> None:
+        """Pop the replica's terminal results into the fleet census
+        (fleet-tier latency/SLO scoring happens here, on the terminals'
+        own anchored timestamps)."""
+        eng = rep.engine
+        if not eng.results:
+            return
+        for uid in list(eng.results):
+            off = self._states.get(uid)
+            if off is None or self._owner.get(uid) != rep.idx:
+                continue
+            res = eng.results.pop(uid)
+            self._states.pop(uid)
+            self._owner.pop(uid)
+            self.results[uid] = res
+            if isinstance(res, Finished):
+                tpot = None
+                if len(res.tokens) > 1:
+                    tpot = ((res.t_finished - res.t_first_token)
+                            / (len(res.tokens) - 1) * 1000.0)
+                self.metrics.observe_first_token(
+                    res.ttft_ms, resumed=bool(res.resumed),
+                    priority=off.priority,
+                )
+                deadline_ok = None
+                if off.deadline_ms is not None:
+                    deadline_ok = res.e2e_ms <= float(off.deadline_ms)
+                self.metrics.observe_finished(
+                    ttft_ms=res.ttft_ms, e2e_ms=res.e2e_ms, tpot_ms=tpot,
+                    n_tokens=len(res.tokens), priority=off.priority,
+                    deadline_ok=deadline_ok,
+                )
+            elif isinstance(res, Poisoned):
+                self.metrics.count("poisoned")
+                self.metrics.count_class("poisoned", off.priority)
+            elif isinstance(res, Shed):
+                self.metrics.count("shed")
+                self.metrics.count_class("shed", off.priority)
+            else:
+                # a replica-internal terminal Rejected cannot arise (the
+                # router owns the serve loop) — but never drop a result
+                self.metrics.count("rejected_final")
+
+    # -- failover and drain ---------------------------------------------
+
+    def _fail_replica(self, rep: _Replica, why: str) -> None:
+        """The ISSUE 13 collapse discipline at fleet scope: finished
+        results drain FIRST, then every request the dead replica still
+        owned is re-offered to survivors cold — original request,
+        original arrival/deadline anchors, zero lost."""
+        if not rep.alive:
+            return
+        rep.alive = False
+        rep.draining = False
+        self._collect(rep)
+        orphans = [uid for uid, own in self._owner.items()
+                   if own == rep.idx]
+        for uid in orphans:
+            off = self._states.pop(uid)
+            self._owner.pop(uid)
+            self._backlog.append(off)
+        rep.resident.clear()
+        self.metrics.count("failovers")
+        self.metrics.count("failover_reoffered", len(orphans))
+        with _mx.label_scope(replica=rep.name):
+            # recorded inside the replica's label scope so the metrics
+            # mirror AND the incident bundle name the dead replica
+            health.record_replica_failover(
+                self.family, rep.name, why, reoffered=len(orphans)
+            )
+        if _mx.enabled():
+            _mx.counter("fleet_failovers_total", engine=self.family,
+                        replica=rep.name)
+            _mx.counter("fleet_failover_reoffered_total", len(orphans),
+                        engine=self.family, replica=rep.name)
+
+    def drain(self, replica) -> None:
+        """Gracefully retire one replica (planned maintenance): no new
+        routes land on it, its queued + in-flight work finishes in
+        place, then it leaves the fleet. ``replica`` is an index or a
+        name ("r2")."""
+        rep = self._resolve(replica)
+        if not rep.alive:
+            raise ValueError(f"replica {rep.name!r} is not alive")
+        if rep.draining:
+            return
+        if len(self._live()) <= 1:
+            raise ValueError(
+                f"cannot drain {rep.name!r}: it is the last live replica"
+            )
+        rep.draining = True
+        self.metrics.count("drains")
+
+    def _resolve(self, replica) -> _Replica:
+        for rep in self.replicas:
+            if replica == rep.idx or replica == rep.name:
+                return rep
+        raise ValueError(f"unknown replica {replica!r}")
+
+    def _retire_drained(self) -> None:
+        for rep in self.replicas:
+            if (rep.alive and rep.draining
+                    and not any(own == rep.idx
+                                for own in self._owner.values())):
+                rep.alive = False
+                rep.draining = False
+                rep.resident.clear()
+                self.metrics.count("drained")
+                health.record_replica_drain(self.family, rep.name)
+
+    # -- alert-driven death ---------------------------------------------
+
+    def _alert_death(self, rep: _Replica, now: float) -> bool:
+        rule = self.fleet.fail_on_alert
+        if rule is None:
+            return False
+        if not rep.alerts_resolved:
+            rep.alerts_resolved = True
+            rep.alerts = _obs.alerts.resolve_engine(
+                family=f"{self.family}:{rep.name}"
+            )
+        ae = rep.alerts
+        if ae is None:
+            return False
+        ae.observe_flips(now, rep.flips)
+        _obs.alerts.evaluate_and_record(
+            ae, now, count=self.metrics.count, obs_tag=self._obs_tag
+        )
+        return ae.states.get(rule) == "firing"
+
+    # -- the tick --------------------------------------------------------
+
+    def _tick(self) -> bool:
+        """Step every live replica once (concurrent semantics: ONE
+        virtual step charged for the whole tick), collect terminals,
+        fail replicas on typed death signals or a firing flip alert,
+        retire finished drains."""
+        worked = False
+        for rep in self.replicas:
+            if not rep.alive:
+                continue
+            flips0 = health.flip_total()
+            try:
+                with _mx.label_scope(replica=rep.name):
+                    if isinstance(rep.engine, DisaggServingEngine):
+                        worked = rep.engine._tick() or worked
+                    else:
+                        worked = rep.engine._step_once() or worked
+            except (PoolCollapse, UnrecoverableEngineError) as exc:
+                self._fail_replica(
+                    rep, f"unrecoverable step failure: {exc}"
+                )
+                worked = True
+                continue
+            rep.flips += max(0, health.flip_total() - flips0)
+            self._collect(rep)
+            if self._alert_death(rep, self.clock.monotonic()):
+                self._fail_replica(
+                    rep,
+                    f"burn-rate alert {self.fleet.fail_on_alert!r} firing",
+                )
+                worked = True
+        self._retire_drained()
+        if worked and self._virtual_step_s:
+            self.clock.sleep(self._virtual_step_s)
+        self._observe()
+        return worked
+
+    def _observe(self) -> None:
+        if not _mx.enabled():
+            return
+        for rep in self.replicas:
+            _mx.gauge("fleet_replica_alive", int(rep.alive),
+                      engine=self.family, replica=rep.name)
+            if rep.alive:
+                _mx.gauge("fleet_replica_outstanding",
+                          self._outstanding(rep), engine=self.family,
+                          replica=rep.name)
+                _mx.gauge("fleet_replica_rung", self._rung(rep),
+                          engine=self.family, replica=rep.name)
+        _mx.gauge("fleet_in_flight", len(self._states),
+                  engine=self.family)
+
+    # -- the serve loop --------------------------------------------------
+
+    def serve(self, traffic=(), *, max_steps: int = 1_000_000) -> dict:
+        """Drive an iterable of :class:`Arrival` through the fleet until
+        every offered request reached its terminal. Size-1 fleets
+        delegate to the single replica's own serve loop — the router
+        plane adds NOTHING, byte for byte (the arming-discipline pin)."""
+        if len(self.replicas) == 1:
+            out = self.replicas[0].engine.serve(traffic, max_steps=max_steps)
+            self.results.update(out)
+            return dict(self.results)
+        heap: list = []
+        seq = 0
+        for a in sorted(traffic, key=lambda a: a.t_s):
+            off = _FOffer(
+                req=a.request, t_anchor=a.t_s,
+                priority=getattr(a, "priority", "interactive"),
+                deadline_ms=getattr(a, "deadline_ms", None),
+                client_id=getattr(a, "client_id", None),
+            )
+            heap.append((a.t_s, seq, off, 0))
+            seq += 1
+        heapq.heapify(heap)
+        reoffer_delay = self._virtual_step_s or 1e-3
+        steps = 0
+        while True:
+            now = self.clock.monotonic()
+            while heap and heap[0][0] <= now:
+                _, _, off, attempt = heapq.heappop(heap)
+                if off.req.uid in self._states or off.req.uid in self.results:
+                    raise ValueError(
+                        f"duplicate request uid {off.req.uid!r}"
+                    )
+                res = self._submit_offer(off)
+                if isinstance(res, Rejected):
+                    # every live replica refused: re-offer next tick,
+                    # ORIGINAL anchors intact (never-rebase-the-SLO)
+                    self.metrics.count("reoffered")
+                    heapq.heappush(
+                        heap, (now + reoffer_delay, seq, off, attempt + 1)
+                    )
+                    seq += 1
+            # failover re-offers land here from _fail_replica (possibly
+            # mid-tick); they go back through routing immediately
+            while self._backlog:
+                off = self._backlog.pop(0)
+                heapq.heappush(heap, (now, seq, off, 0))
+                seq += 1
+            if self._tick():
+                steps += 1
+                if steps >= max_steps:
+                    raise RuntimeError(
+                        f"fleet serve(max_steps={max_steps}) exhausted "
+                        f"with work still in flight; finished results "
+                        f"are intact in self.results"
+                    )
+                continue
+            if self._backlog:
+                continue
+            if heap:
+                dt = heap[0][0] - self.clock.monotonic()
+                if dt > 0:
+                    self.clock.sleep(dt)
+                continue
+            if self._states:
+                raise RuntimeError(
+                    f"fleet serve wedged: {len(self._states)} request(s) "
+                    f"neither terminal nor progressing "
+                    f"({sorted(self._states)})"
+                )
+            return dict(self.results)
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> dict:
+        """Serve what is already routed/backlogged (no new traffic)."""
+        return self.serve((), max_steps=max_steps)
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop ingesting new traffic on every replica."""
+        self._stopping = True
+        for rep in self.replicas:
+            if rep.alive:
+                rep.engine.stop(drain=drain)
+
+    # -- readout ---------------------------------------------------------
+
+    def world_size(self) -> int:
+        return sum(
+            rep.engine.world_size() for rep in self.replicas if rep.alive
+        )
+
+    def snapshot(self) -> dict:
+        now = self.clock.monotonic()
+        elapsed = max(now - self._t0, 1e-9)
+        snap = self.metrics.snapshot()
+        snap["tokens"]["per_s"] = round(
+            self.metrics.tokens_generated / elapsed, 3
+        )
+        snap["tokens"]["goodput_per_s"] = round(
+            self.metrics.tokens_goodput / elapsed, 3
+        )
+        snap["engine"] = {
+            "topology": "fleet",
+            "family": self.family,
+            "replicas": len(self.replicas),
+            "alive": [r.name for r in self.replicas if r.alive],
+            "draining": [r.name for r in self.replicas if r.draining],
+            "dead": [r.name for r in self.replicas if not r.alive],
+            "in_flight": len(self._states),
+            "clock_s": round(now, 9),
+        }
+        reqs = self.metrics.counters
+        snap["fleet"] = {
+            "routing": self.fleet.routing,
+            "routed": {r.name: r.routed for r in self.replicas},
+            "affinity_lookups": self._affinity_lookups,
+            "affinity_hits": self._affinity_hits,
+            "affinity_hit_rate": round(
+                self._affinity_hits / max(1, self._affinity_lookups), 6
+            ),
+            "failovers": reqs.get("failovers", 0),
+            "failover_reoffered": reqs.get("failover_reoffered", 0),
+            "reoffered": reqs.get("reoffered", 0),
+            "drains": reqs.get("drains", 0),
+            "resident_keys": {
+                r.name: len(r.resident) for r in self.replicas
+            },
+        }
+        snap["replicas"] = {
+            r.name: r.engine.snapshot() for r in self.replicas if r.alive
+        }
+        return snap

@@ -95,6 +95,10 @@ class Arrival:
     request: Request
     priority: str = "interactive"
     deadline_ms: int | None = None
+    # client_id (ISSUE 16): the sticky-client label fleet-affinity
+    # campaigns group by — None (the default) keeps every pre-fleet
+    # construction site and trace byte-identical
+    client_id: str | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -131,7 +135,16 @@ class TrafficSpec:
     priority/deadline discipline of ISSUE 11): an unchanged spec keeps
     its historical ``trace_fingerprint``, and setting the prefix fields
     changes neither arrival times nor the per-request SUFFIX (the old
-    prompt becomes the suffix) — pinned in tests/test_prefix_cache.py."""
+    prompt becomes the suffix) — pinned in tests/test_prefix_cache.py.
+
+    ``client_pool`` (ISSUE 16) stamps a sticky ``client_id`` onto each
+    arrival: N client labels, Zipf-weighted by ``client_zipf`` (a
+    handful of hot clients dominate — the production shape
+    fleet-affinity routing exists for). The draws come from their OWN
+    seed-derived PRNG stream (the priority/deadline discipline): an
+    unchanged spec keeps its historical fingerprint, and setting the
+    client fields changes neither arrival times nor prompts — pinned in
+    tests/test_fleet.py."""
 
     rate_rps: float
     n_requests: int
@@ -154,6 +167,8 @@ class TrafficSpec:
     prefix_len: tuple = ("fixed", 8)
     prefix_zipf: float = 1.2
     prefix_share: float = 1.0
+    client_pool: int | None = None
+    client_zipf: float = 1.2
 
     def validate(self) -> "TrafficSpec":
         if self.rate_rps <= 0:
@@ -207,6 +222,15 @@ class TrafficSpec:
                 raise ValueError(
                     f"prefix_zipf must be > 0, got {self.prefix_zipf}"
                 )
+        if self.client_pool is not None:
+            if self.client_pool < 1:
+                raise ValueError(
+                    f"client_pool must be >= 1, got {self.client_pool}"
+                )
+            if self.client_zipf <= 0:
+                raise ValueError(
+                    f"client_zipf must be > 0, got {self.client_zipf}"
+                )
         return self
 
 
@@ -240,6 +264,16 @@ def generate_trace(spec: TrafficSpec) -> tuple[Arrival, ...]:
             1, spec.prefix_pool + 1, dtype=np.float64
         ) ** float(spec.prefix_zipf)
         zipf_w /= zipf_w.sum()
+    # sticky-client draws (ISSUE 16) on a FOURTH stream: one Zipf draw
+    # per request when armed — unset specs never touch it, so their
+    # historical fingerprints hold
+    rng_cl = np.random.default_rng([int(spec.seed), 0xC11E27])
+    client_w = None
+    if spec.client_pool is not None:
+        client_w = 1.0 / np.arange(
+            1, spec.client_pool + 1, dtype=np.float64
+        ) ** float(spec.client_zipf)
+        client_w /= client_w.sum()
     out = []
     t = float(spec.start_s)
     burst_rate = spec.burst_rate_rps or 10.0 * spec.rate_rps
@@ -280,6 +314,9 @@ def generate_trace(spec: TrafficSpec) -> tuple[Arrival, ...]:
             sample_length(spec.deadline_ms, rng_ov)
             if spec.deadline_ms is not None else None
         )
+        client = None
+        if client_w is not None:
+            client = f"c{int(rng_cl.choice(spec.client_pool, p=client_w))}"
         out.append(Arrival(
             t_s=t,
             request=Request(
@@ -295,6 +332,7 @@ def generate_trace(spec: TrafficSpec) -> tuple[Arrival, ...]:
             ),
             priority=priority,
             deadline_ms=deadline,
+            client_id=client,
         ))
     return tuple(sorted(out, key=lambda a: a.t_s))
 
@@ -309,6 +347,10 @@ def trace_fingerprint(trace: tuple[Arrival, ...]) -> str:
         extra = ()
         if a.priority != "interactive" or a.deadline_ms is not None:
             extra = (a.priority, a.deadline_ms)
+        if a.client_id is not None:
+            # the client label (ISSUE 16) joins the hash only when set —
+            # every pre-fleet spec keeps its historical fingerprint
+            extra = extra + (a.client_id,)
         h.update(repr((
             round(a.t_s, 12), a.request.prompt, a.request.max_new_tokens,
             a.request.eos_id, a.request.temperature, a.request.top_k,
