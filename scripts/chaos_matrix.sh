@@ -111,6 +111,17 @@
 # corrupt handoff × overload, resilience/soak.py SoakSpec.fleet)
 # replays bit-identically (the full set rides scripts/chaos_soak.py).
 #
+# Since ISSUE 17 the matrix also covers the RECOVERY-PLANE cells
+# (tests/test_recovery.py): the elastic-ON fleet with per-replica
+# ElasticScope namespaces must keep strikes inside their replica
+# (pe{N}@r{i} health families only), regrow a quarantined decode pool
+# by probation mid-serve, un-collapse a collapsed prefill pool after a
+# clean probation window, and resurrect a dead replica (probe rounds →
+# fresh engine → cold trie + affinity ramp) that then serves again —
+# with the quick recovery soak campaign
+# (resilience/soak.py SoakSpec.fleet_recovery_spec) replaying
+# bit-identically.
+#
 # Every cell runs under a wall-clock budget (TDT_CELL_TIMEOUT_S,
 # default 600 s; conftest.py delivers it as a SIGALRM inside the cell):
 # a hung cell reports as one named FAILED row — and so fails the exit
@@ -136,7 +147,8 @@ files="tests/test_chaos.py tests/test_elastic.py \
     tests/test_emitter.py tests/test_serving.py tests/test_integrity.py \
     tests/test_obs.py tests/test_analysis.py tests/test_overload.py \
     tests/test_prefix_cache.py tests/test_disagg.py tests/test_synth.py \
-    tests/test_flight_recorder.py tests/test_fleet.py"
+    tests/test_flight_recorder.py tests/test_fleet.py \
+    tests/test_recovery.py"
 marker="chaos"
 lint_args=""
 if [ "${1:-}" = "--quick" ]; then
@@ -145,7 +157,7 @@ if [ "${1:-}" = "--quick" ]; then
         tests/test_elastic.py tests/test_overload.py \
         tests/test_prefix_cache.py tests/test_disagg.py \
         tests/test_synth.py tests/test_flight_recorder.py \
-        tests/test_fleet.py"
+        tests/test_fleet.py tests/test_recovery.py"
     marker="chaos and not slow"
     # keep the quick posture bounded: worlds {2,4} (the full {2,4,8}
     # sweep is the default standalone run's job)

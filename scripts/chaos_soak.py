@@ -50,17 +50,29 @@ original arrival/deadline anchors (zero lost,
 ``check_fleet_invariants``), and the whole campaign must replay
 bit-identically from its seed.
 
+Since ISSUE 17 the run also includes RECOVERY campaigns
+(``SoakSpec.fleet_recovery_spec``): the fleet runs elastic-ON with
+per-replica ``ElasticScope`` namespaces and the full recovery ladder
+armed, composing — on the survivor — a decode straggler pair (PE
+quarantine → pool shrink → probation regrow mid-serve) and a
+prefill-pool storm (collapse → clean probation → un-collapse) with —
+on the target — a windowed decode storm (typed death → probes fail
+while the storm lasts → resurrection with a cold trie and an affinity
+ramp once it clears). Strikes must land in ``pe{N}@r{i}`` scoped
+health families only, the re-admitted replica must serve again, and
+the whole campaign must replay bit-identically from its seed.
+
 Usage::
 
     scripts/chaos_soak.py [--campaigns N] [--seed-base S] [--quick]
                           [--no-replay-check] [--no-prefix] [--no-disagg]
-                          [--no-fleet]
+                          [--no-fleet] [--no-recovery]
 
-``--quick`` runs 3 small + 1 shared-prefix + 1 disagg + 1 fleet
-campaign (the chaos-matrix cell posture); the default 20 + 6
-shared-prefix + 5 disagg + 4 fleet campaigns are the ISSUE 11/12/13/16
-acceptance run. Exit code 0 iff every campaign is green (and the
-replay checks hold).
+``--quick`` runs 3 small + 1 shared-prefix + 1 disagg + 1 fleet +
+1 recovery campaign (the chaos-matrix cell posture); the default 20 +
+6 shared-prefix + 5 disagg + 4 fleet + 3 recovery campaigns are the
+ISSUE 11/12/13/16/17 acceptance run. Exit code 0 iff every campaign
+is green (and the replay checks hold).
 """
 
 import argparse
@@ -93,6 +105,8 @@ def main(argv=None) -> int:
                     help="skip the disaggregated campaign set (ISSUE 13)")
     ap.add_argument("--no-fleet", action="store_true",
                     help="skip the fleet campaign set (ISSUE 16)")
+    ap.add_argument("--no-recovery", action="store_true",
+                    help="skip the recovery-plane campaign set (ISSUE 17)")
     args = ap.parse_args(argv)
 
     from triton_dist_tpu import config as tdt_config
@@ -107,6 +121,7 @@ def main(argv=None) -> int:
     n_px = 0 if args.no_prefix else (1 if args.quick else 6)
     n_dg = 0 if args.no_disagg else (1 if args.quick else 5)
     n_fl = 0 if args.no_fleet else (1 if args.quick else 4)
+    n_rc = 0 if args.no_recovery else (1 if args.quick else 3)
 
     def build_spec(k: int):
         if k < n:
@@ -119,13 +134,17 @@ def main(argv=None) -> int:
             return soak.SoakSpec.disagg(
                 seed=args.seed_base + 200 + (k - n - n_px)
             ), "disagg"
-        return soak.SoakSpec.fleet(
-            seed=args.seed_base + 300 + (k - n - n_px - n_dg)
-        ), "fleet"
+        if k < n + n_px + n_dg + n_fl:
+            return soak.SoakSpec.fleet(
+                seed=args.seed_base + 300 + (k - n - n_px - n_dg)
+            ), "fleet"
+        return soak.SoakSpec.fleet_recovery_spec(
+            seed=args.seed_base + 400 + (k - n - n_px - n_dg - n_fl)
+        ), "recovery"
 
     rows = []
     t0 = time.time()
-    for k in range(n + n_px + n_dg + n_fl):
+    for k in range(n + n_px + n_dg + n_fl + n_rc):
         spec, kind_tag = build_spec(k)
         t1 = time.time()
         res = soak.run_campaign(spec)
@@ -158,6 +177,17 @@ def main(argv=None) -> int:
                 f"reoffered={fls.get('failover_reoffered', 0)} "
                 f"dead={res.snapshot.get('engine', {}).get('dead')}]"
             )
+        elif kind_tag == "recovery":
+            fls = res.snapshot.get("fleet", {})
+            hc = res.health.get("counters", {})
+            px_note = (
+                f" [recovery: resurrections={fls.get('resurrections', 0)} "
+                f"regrows={hc.get('serving_pool_decode:pool_regrow', 0)}"
+                f"+{hc.get('serving_pool_prefill:pool_regrow', 0)} "
+                f"uncollapses="
+                f"{hc.get('serving_disagg:pool_uncollapse', 0)} "
+                f"dead={res.snapshot.get('engine', {}).get('dead')}]"
+            )
         print(
             f"  campaign {kind_tag} seed={spec.seed:<4d} {verdict}  "
             f"{dt:6.1f}s  terminals={dict(sorted(census.items()))} "
@@ -177,7 +207,9 @@ def main(argv=None) -> int:
         # disagg, and fleet arcs must each reproduce bit-identically
         replay_at = [0] + ([n] if n_px else []) + (
             [n + n_px] if n_dg else []
-        ) + ([n + n_px + n_dg] if n_fl else [])
+        ) + ([n + n_px + n_dg] if n_fl else []) + (
+            [n + n_px + n_dg + n_fl] if n_rc else []
+        )
         for idx in replay_at:
             spec, kind_tag = build_spec(idx)
             first = rows[idx][2]

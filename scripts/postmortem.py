@@ -4,13 +4,17 @@ reports (ISSUE 15, flight-recorder part 3).
 
 A bundle is the deterministic JSON ``triton_dist_tpu/obs/blackbox.py``
 writes the instant a health-flipping event fires (brownout, handoff
-re-stream/fallback, pool collapse, prefix strike, quarantine, integrity
-strike): the trigger, the last-N spans leading in, the full metrics-plane
-snapshot, the wait-telemetry aggregation, the live burn-rate alert
-states, the elastic attribution chain, and the health registry. This CLI
-answers the on-call question — *what fired, which PE/pool/rung, and what
-did the system look like going in* — from the artifact alone, no log
-archaeology.
+re-stream/fallback, pool collapse/regrow/un-collapse, prefix strike,
+quarantine, integrity strike, replica failover/re-admission): the
+trigger, the last-N spans leading in, the full metrics-plane snapshot,
+the wait-telemetry aggregation, the live burn-rate alert states, the
+elastic attribution chain, and the health registry. This CLI answers
+the on-call question — *what fired, which PE/pool/rung, and what did
+the system look like going in* — from the artifact alone, no log
+archaeology. Since ISSUE 17 the attribution chain may be a SCOPED
+elastic namespace (``owner`` names the replica that owns it), and the
+recovery-plane kinds (``pool_regrow``, ``pool_uncollapse``,
+``replica_readmit``) each freeze one bundle per round trip.
 
 Dependency-free stdlib CLI::
 
@@ -47,6 +51,10 @@ _HEADLINE_METRICS = (
     "handoff_fallbacks_total",
     "px_readers_struck",
     "alerts_total",
+    "serving_pool_regrows_total",
+    "serving_pool_uncollapses_total",
+    "fleet_resurrections_total",
+    "fleet_replica_state",
 )
 
 
@@ -117,6 +125,7 @@ def render(path: str, bundle: dict, *, n_spans: int = 8,
 
     attribution = bundle.get("attribution") or {}
     peers = attribution.get("peers") or {}
+    scoped = attribution.get("scopes") or {}
     if peers:
         lines.append("  attribution chain (elastic peer states):")
         for pe, row in sorted(peers.items(), key=lambda kv: int(kv[0])):
@@ -124,8 +133,16 @@ def render(path: str, bundle: dict, *, n_spans: int = 8,
                 f"    pe{pe}: {row.get('state')} "
                 f"({row.get('strikes')} strike(s))"
             )
-    else:
+    elif not scoped:
         lines.append("  attribution chain: all peers healthy")
+    for owner, sc in sorted(scoped.items()):
+        lines.append(f"  attribution chain [scope @{owner}]:")
+        for pe, row in sorted((sc.get("peers") or {}).items(),
+                              key=lambda kv: int(kv[0])):
+            lines.append(
+                f"    pe{pe}: {row.get('state')} "
+                f"({row.get('strikes')} strike(s))"
+            )
 
     counters = (bundle.get("health") or {}).get("counters", {})
     if counters:

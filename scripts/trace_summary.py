@@ -100,11 +100,21 @@ def incident_rows(paths: list[str]) -> list[dict]:
             )
             if row.get("state") == "firing"
         ]
-        peers = (b.get("attribution") or {}).get("peers") or {}
-        culprits = ",".join(
+        attribution = b.get("attribution") or {}
+        peers = attribution.get("peers") or {}
+        bits = [
             f"pe{pe}:{row.get('state')}"
             for pe, row in sorted(peers.items(), key=lambda kv: int(kv[0]))
-        )
+        ]
+        # scoped namespaces (ISSUE 17): owned-scope culprits render as
+        # pe{N}@{owner} so a fleet bundle names the replica too
+        for owner, sc in sorted((attribution.get("scopes") or {}).items()):
+            bits.extend(
+                f"pe{pe}@{owner}:{row.get('state')}"
+                for pe, row in sorted((sc.get("peers") or {}).items(),
+                                      key=lambda kv: int(kv[0]))
+            )
+        culprits = ",".join(bits)
         rows.append({
             "bundle": os.path.basename(path),
             "kind": trig.get("kind", "?"),

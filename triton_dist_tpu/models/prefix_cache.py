@@ -170,6 +170,14 @@ class PagePrefixCache:
         self._zombies: set = set()    # detached nodes still referenced
         self._clock = 0
         self._c = {k: 0 for k in PX_COUNTERS}
+        # ISSUE 17 satellite 1 (the fleet router's residency mirror):
+        # when set, every evicted/struck trie node's FULL-prefix key
+        # (the root→node token chain — exactly what
+        # serving.fleet.prefix_page_keys derives) is reported in one
+        # call per removal, so an affinity index built from published
+        # pages can drop what this cache just freed. None (default):
+        # no observable change.
+        self.evict_listener = None
 
     # -- small helpers --------------------------------------------------
 
@@ -260,8 +268,30 @@ class PagePrefixCache:
                 )
             self._evict_subtree(cand)
 
+    def _node_key(self, nd: _Node) -> tuple:
+        """The node's full-prefix key: the token chain root→node, i.e.
+        ``prompt[:(depth+1) * page]`` — the same keys
+        ``serving.fleet.prefix_page_keys`` derives for full pages, so a
+        residency mirror keyed on published pages can subtract exactly
+        what a removal frees. Parent pointers survive subtree removal,
+        so this is valid on just-removed nodes."""
+        parts = []
+        while nd.parent is not None:
+            parts.append(nd.tokens)
+            nd = nd.parent
+        out: list = []
+        for p in reversed(parts):
+            out.extend(p)
+        return tuple(out)
+
+    def _notify_removed(self, nodes: "list[_Node]") -> None:
+        if self.evict_listener is None or not nodes:
+            return
+        self.evict_listener([self._node_key(nd) for nd in nodes])
+
     def _evict_subtree(self, top: _Node) -> None:
         top.parent.children.pop(top.tokens)
+        removed: list = []
         stack = [top]
         while stack:
             nd = stack.pop()
@@ -271,7 +301,9 @@ class PagePrefixCache:
             self._free_page(self._pe_of(nd.depth), nd.phys)
             self._bump("evicted_pages")
             stack.extend(nd.children.values())
+            removed.append(nd)
             nd.children = {}
+        self._notify_removed(removed)
 
     # -- the admission-side API -----------------------------------------
 
@@ -405,17 +437,23 @@ class PagePrefixCache:
 
     def _detach_subtree(self, top: _Node) -> None:
         top.parent.children.pop(top.tokens)
+        removed: list = []
         stack = [top]
         while stack:
             nd = stack.pop()
             nd.detached = True
             self._bump("struck_pages")
             stack.extend(nd.children.values())
+            removed.append(nd)
             nd.children = {}
             if nd.ref == 0:
                 self._free_page(self._pe_of(nd.depth), nd.phys)
             else:
                 self._zombies.add(nd)
+        # struck pages count as removed for the residency mirror too:
+        # no future match can serve them, so routing toward them is a
+        # guaranteed miss
+        self._notify_removed(removed)
 
     # -- readout / invariants -------------------------------------------
 

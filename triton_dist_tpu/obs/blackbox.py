@@ -61,16 +61,23 @@ INCIDENT_SCHEMA = "tdt-incident-v1"
 
 # the health kinds that write a bundle (ISSUE 15 trigger set — each one
 # means refused/degraded/struck work; resilience/health.py owns the
-# kind vocabulary)
+# kind vocabulary). The ISSUE 17 recovery kinds (pool_regrow,
+# pool_uncollapse, replica_readmit) ride the same schema pin: one
+# bundle per recovery transition, and an unregistered recovery kind
+# fails BlackboxConfig.validate loudly instead of silently not
+# triggering.
 BLACKBOX_KINDS = (
     "brownout",
     "handoff_restream",
     "handoff_fallback",
     "pool_collapse",
+    "pool_regrow",
+    "pool_uncollapse",
     "prefix_strike",
     "pe_quarantine",
     "integrity",
     "replica_failover",
+    "replica_readmit",
 )
 
 
@@ -220,7 +227,13 @@ def _write_bundle(cfg: BlackboxConfig, seq: int, ev) -> str:
         "metrics": _metrics.json_snapshot(),
         "wait_telemetry": _telemetry.wait_summary(),
         "alerts": _alerts.state_snapshot(),
-        "attribution": _jsonable(elastic.summary()),
+        # scoped namespaces (ISSUE 17) fold in only when degraded, so a
+        # fleet-free run's bundle bytes match the pre-scoping schema
+        "attribution": _jsonable(
+            dict(elastic.summary(), scopes=scoped)
+            if (scoped := elastic.scope_summaries())
+            else elastic.summary()
+        ),
         "health": {
             "counters": counters,
             "last_events": last_events,

@@ -90,6 +90,24 @@ REPLICA_DRAIN = "replica_drain"     # fleet: a replica finished a
                                     # is_healthy() (the failover twin
                                     # flips; a drain is the machinery
                                     # working on request)
+# the ISSUE 17 recovery plane: every rung above the single engine can
+# heal, and each healing transition is recorded here (and triggers a
+# blackbox bundle — BLACKBOX_KINDS) so operators can audit recoveries
+# exactly like failures. None of these flip is_healthy(): recovery is
+# the machinery UNDOING a flip, not adding one.
+POOL_REGROW = "pool_regrow"         # disagg: a pool's quarantined PE
+                                    # passed probation and the pool
+                                    # rebuilt at a larger world
+                                    # (serving/disagg.py)
+POOL_UNCOLLAPSE = "pool_uncollapse"  # disagg: after a clean probation
+                                     # window the collapsed topology
+                                     # re-carved its prefill pool —
+                                     # collapse is no longer one-way
+REPLICA_READMIT = "replica_readmit"  # fleet: a dead/drained replica
+                                     # passed probation, rebuilt its
+                                     # engine, and re-entered placement
+                                     # with a cold trie + affinity ramp
+                                     # (serving/fleet.py)
 ALERT = "alert"                     # an SLO burn-rate rule fired or
                                     # resolved (obs/alerts.py, ISSUE 15)
                                     # — informational for is_healthy():
@@ -351,19 +369,73 @@ def record_alert(family: str, rule: str, state: str, *, signal: str,
     ))
 
 
-def record_pe_quarantine(pe: int, reason: str) -> None:
-    """The elastic layer quarantined peer ``pe`` (elastic.py)."""
+def _pe_family(pe: int, owner: "str | None") -> str:
+    """The health family of one PE's elastic events: ``pe{N}`` in the
+    process-global default scope (the pre-ISSUE-17 name, byte-unchanged),
+    ``pe{N}@{owner}`` in an owned :class:`ElasticScope` — so counters
+    alone prove which namespace a strike landed in (the fleet soak's
+    scope-isolation invariant)."""
+    base = f"pe{int(pe)}"
+    return base if owner is None else f"{base}@{owner}"
+
+
+def record_pe_quarantine(pe: int, reason: str,
+                         owner: "str | None" = None) -> None:
+    """The elastic layer quarantined peer ``pe`` (elastic.py), in the
+    scope named by ``owner`` (None = the default scope)."""
     _record(HealthEvent(
-        kind=PE_QUARANTINE, family=f"pe{int(pe)}", reason=reason,
+        kind=PE_QUARANTINE, family=_pe_family(pe, owner), reason=reason,
         walltime=time.time(),
     ))
 
 
-def record_pe_readmission(pe: int) -> None:
+def record_pe_readmission(pe: int, owner: "str | None" = None) -> None:
     """Peer ``pe`` passed probation and rejoined the world."""
     _record(HealthEvent(
-        kind=PE_READMIT, family=f"pe{int(pe)}",
+        kind=PE_READMIT, family=_pe_family(pe, owner),
         reason="clean probation probe(s); re-admitted",
+        walltime=time.time(),
+    ))
+
+
+def record_pool_regrow(family: str, pool: str, world: int,
+                       pes: "list[int] | tuple[int, ...]" = ()) -> None:
+    """A disagg pool's quarantined PE(s) passed probation and the pool
+    rebuilt at ``world`` PEs (serving/disagg.py, ISSUE 17). Informational
+    for :func:`is_healthy` — the quarantine that shrank the pool already
+    flipped it; the regrow is the recovery plane working."""
+    _record(HealthEvent(
+        kind=POOL_REGROW, family=family,
+        reason=f"pool {pool!r}: re-admitted pe(s) "
+               f"{sorted(int(p) for p in pes)}; regrown to world={int(world)}",
+        detail={"pool": pool, "world": int(world),
+                "pes": [int(p) for p in pes]},
+        walltime=time.time(),
+    ))
+
+
+def record_pool_uncollapse(family: str, pool: str, reason: str) -> None:
+    """The collapsed disagg topology re-carved pool ``pool`` after a
+    clean probation window (serving/disagg.py, ISSUE 17) — the reverse
+    arc of :func:`record_pool_collapse`. Informational for
+    :func:`is_healthy` (the collapse flipped; this is the undo)."""
+    _record(HealthEvent(
+        kind=POOL_UNCOLLAPSE, family=family,
+        reason=f"pool {pool!r}: {reason}", walltime=time.time(),
+    ))
+
+
+def record_replica_readmit(family: str, replica: str, reason: str, *,
+                           world: int) -> None:
+    """The fleet router resurrected replica ``replica``: clean probation
+    probes, a fresh ``world``-PE engine build, and re-entry into
+    placement with a cold trie + affinity ramp (serving/fleet.py, ISSUE
+    17). The failover/drain that removed it flipped health; the
+    resurrection is informational."""
+    _record(HealthEvent(
+        kind=REPLICA_READMIT, family=family,
+        reason=f"replica {replica!r}: {reason}",
+        detail={"replica": replica, "world": int(world)},
         walltime=time.time(),
     ))
 

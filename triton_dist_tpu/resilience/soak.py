@@ -150,6 +150,47 @@ class SoakSpec:
     fleet_replicas: int = 0
     replica_kill_at_step: int = 0
     replica_kill_target: int = 1
+    # recovery-plane campaign knobs (ISSUE 17): fleet_recovery runs the
+    # fleet elastic-ON with per-replica ElasticScopes and arms the whole
+    # recovery ladder (pool probation regrow, reversible collapse,
+    # replica resurrection). replica_revive_at_step closes the kill
+    # storm's window — it counts GLOBAL fleet decode steps (any
+    # replica), so a dead target's window still closes while the
+    # survivor serves. pool_strag_at_step fires a two-step straggler
+    # pair on the SURVIVOR's decode pool (quarantine → pool shrink →
+    # probation regrow); prefill_storm_at_step storms the survivor's
+    # prefill pool into collapse (→ probation → un-collapse). Both
+    # count that pool's OWN steps.
+    fleet_recovery: bool = False
+    replica_revive_at_step: int = 0
+    pool_strag_at_step: int = 0
+    prefill_storm_at_step: int = 0
+
+    @classmethod
+    def fleet_recovery_spec(cls, seed: int = 0, **over) -> "SoakSpec":
+        """The ISSUE 17 soak shape: burst traffic through a 2-replica
+        fleet of disaggregated engines (2 prefill + 2 decode PEs each on
+        world=8), elastic ON and replica-scoped, composing — on the
+        survivor — a decode straggler pair (PE quarantine → pool shrink
+        → probation regrow mid-serve) and a prefill-pool storm (collapse
+        → clean probation → un-collapse) with — on the target — a
+        windowed decode timeout storm (typed death → failed probes
+        while the storm lasts → resurrection with a cold trie and an
+        affinity ramp once it clears). Strikes must stay inside their
+        replica's scope and every re-admitted replica must serve again
+        (:func:`check_fleet_invariants`)."""
+        kw = dict(
+            seed=seed, world=8, fleet_replicas=2, disagg_prefill_pes=2,
+            n_requests=28, rate_rps=10.0, burst_every_s=0.8, burst_n=4,
+            max_queue=12, n_timeouts=0, n_corruptions=0,
+            n_chunk_corruptions=0, fault_window=30,
+            fleet_recovery=True,
+            replica_kill_at_step=14, replica_revive_at_step=34,
+            pool_strag_at_step=4, prefill_storm_at_step=3,
+            max_steps=60_000,
+        )
+        kw.update(over)
+        return cls(**kw)
 
     @classmethod
     def fleet(cls, seed: int = 0, **over) -> "SoakSpec":
@@ -242,6 +283,35 @@ class SoakSpec:
             raise ValueError(
                 "replica_kill_at_step is a fleet fault — set "
                 "fleet_replicas too"
+            )
+        if self.fleet_recovery and not self.fleet_replicas:
+            raise ValueError(
+                "fleet_recovery arms the fleet recovery plane — set "
+                "fleet_replicas too"
+            )
+        if not self.fleet_recovery and (
+            self.replica_revive_at_step
+            or self.pool_strag_at_step
+            or self.prefill_storm_at_step
+        ):
+            raise ValueError(
+                "replica_revive_at_step / pool_strag_at_step / "
+                "prefill_storm_at_step are recovery-plane faults — set "
+                "fleet_recovery too"
+            )
+        if self.replica_revive_at_step and not self.replica_kill_at_step:
+            raise ValueError(
+                "replica_revive_at_step closes a kill storm — set "
+                "replica_kill_at_step too"
+            )
+        if (
+            self.replica_revive_at_step
+            and self.replica_revive_at_step <= self.replica_kill_at_step
+        ):
+            raise ValueError(
+                "replica_revive_at_step must come after "
+                "replica_kill_at_step (the storm window is "
+                "[kill, revive) in global decode steps)"
             )
         if self.disagg_prefill_pes:
             if not self.fleet_replicas and not (
@@ -896,6 +966,89 @@ def _inject_fleet_faults(*, kill_at: int, target: str):
         ContinuousBatcher.step = real_step
 
 
+@contextlib.contextmanager
+def _inject_recovery_faults(*, kill_at: int, revive_at: int, target: str,
+                            strag_at: int, storm_at: int, survivor: str):
+    """The recovery-plane chaos seam (ISSUE 17): three composed fault
+    arcs, each keyed on the replica ``metrics.label_scope`` + pool
+    ``faults.pool_scope`` ambient labels so nothing leaks across
+    replicas.
+
+    - ``target`` decode storm over GLOBAL decode steps ``[kill_at,
+      revive_at)`` — global (any replica's decode step advances the
+      window) because the dead target's own counter freezes at death,
+      and a window keyed on it would never close. While the storm
+      lasts, ``elastic.probe_world`` is ALSO gated false for the
+      target, so the router's resurrection probes fail honestly until
+      the window clears; the first clean round after ``revive_at``
+      re-admits the replica.
+    - ``survivor`` decode straggler pair at its OWN pool steps
+      ``[strag_at, strag_at+2)``: two strikes on the silent PE hit the
+      quarantine threshold without exhausting the step-failure budget —
+      pool shrinks, serves degraded, then probation regrows it.
+    - ``survivor`` prefill storm at its OWN pool steps ``[storm_at,
+      storm_at+6)``: long enough to exhaust the consecutive-failure
+      budget even across a mid-storm quarantine rebuild — the pool
+      dies, the topology collapses to unified, and the clean probation
+      window after the storm un-collapses it."""
+    from triton_dist_tpu.models.decode import ContinuousBatcher
+    from triton_dist_tpu.obs import metrics as _metrics
+    from triton_dist_tpu.resilience import elastic as _elastic
+    from triton_dist_tpu.resilience import faults as _faults
+
+    real_step = ContinuousBatcher.step
+    real_probe = _elastic.probe_world
+    calls = {"n": 0}
+    own: dict[tuple, int] = {}
+
+    def _storming() -> bool:
+        if not kill_at or calls["n"] < kill_at:
+            return False
+        return not revive_at or calls["n"] < revive_at
+
+    def _timeout(w: int, silent: int) -> DistTimeoutError:
+        recs = [
+            {"pe": p, "kind": "barrier_all", "site": 0,
+             "status": "timeout", "expected": 1, "observed": 0,
+             "budget": 16}
+            for p in range(w) if p != silent
+        ]
+        return DistTimeoutError("batcher_step", recs, world_size=w)
+
+    def flaky(self):
+        rep = _metrics.current_labels().get("replica")
+        pool = _faults.current_pool()
+        if rep is None or pool not in ("prefill", "decode"):
+            return real_step(self)
+        w = int(self.mesh.devices.size)
+        mine = own[(rep, pool)] = own.get((rep, pool), 0) + 1
+        if pool == "decode":
+            calls["n"] += 1
+            if rep == target and _storming():
+                raise _timeout(w, 0)
+            if (rep == survivor and strag_at
+                    and strag_at <= mine < strag_at + 2):
+                raise _timeout(w, 1 % w)
+        elif (rep == survivor and storm_at
+                and storm_at <= mine < storm_at + 6):
+            raise _timeout(w, 0)
+        return real_step(self)
+
+    def gated_probe(mesh, axis="tp"):
+        if (_metrics.current_labels().get("replica") == target
+                and _storming()):
+            return False
+        return real_probe(mesh, axis=axis)
+
+    ContinuousBatcher.step = flaky
+    _elastic.probe_world = gated_probe
+    try:
+        yield calls
+    finally:
+        ContinuousBatcher.step = real_step
+        _elastic.probe_world = real_probe
+
+
 def check_fleet_invariants(fl, result: CampaignResult,
                            offered_uids: set) -> list:
     """The fleet campaign's green conditions: the module-docstring
@@ -970,7 +1123,7 @@ def check_fleet_invariants(fl, result: CampaignResult,
             f"{hc.get('serving_fleet:replica_failover', 0)} != scheduled "
             f"{want_failovers}"
         )
-    if spec.replica_kill_at_step:
+    if spec.replica_kill_at_step and not spec.fleet_recovery:
         dead = snap.get("engine", {}).get("dead", [])
         want_dead = f"r{spec.replica_kill_target}"
         if dead != [want_dead]:
@@ -985,6 +1138,76 @@ def check_fleet_invariants(fl, result: CampaignResult,
             "scheduled chunk corruption never fired — the handoff ladder "
             "this campaign advertises did not run (retune the spec)"
         )
+
+    # 5. the recovery plane (ISSUE 17): every arc the spec scheduled
+    # must have completed its round trip, and PE strikes must have
+    # stayed inside their replica's scope
+    if spec.fleet_recovery:
+        target = f"r{spec.replica_kill_target}"
+        if spec.replica_kill_at_step and spec.replica_revive_at_step:
+            dead = snap.get("engine", {}).get("dead", [])
+            if dead:
+                fails.append(
+                    f"replicas {dead} still dead after the storm window "
+                    f"closed — resurrection never completed"
+                )
+            if hc.get("serving_fleet:replica_readmit", 0) < 1:
+                fails.append(
+                    "no replica_readmit health event — the scheduled "
+                    "resurrection arc did not run"
+                )
+            fin = (
+                snap.get("replicas", {}).get(target, {})
+                .get("requests", {}).get("finished", 0)
+            )
+            if not fin:
+                fails.append(
+                    f"resurrected {target} finished 0 requests — its "
+                    f"fresh engine never served (ramp too long, or the "
+                    f"traffic tail ended before re-admission)"
+                )
+        if spec.pool_strag_at_step and not hc.get(
+            "serving_pool_decode:pool_regrow", 0
+        ):
+            fails.append(
+                "no decode pool_regrow health event — the scheduled "
+                "straggler quarantine never probed back in"
+            )
+        if spec.prefill_storm_at_step:
+            if not hc.get("serving_disagg:pool_collapse", 0):
+                fails.append(
+                    "no pool_collapse — the scheduled prefill storm "
+                    "never killed the pool (retune the spec)"
+                )
+            if not hc.get("serving_disagg:pool_uncollapse", 0):
+                fails.append(
+                    "no pool_uncollapse health event — the collapsed "
+                    "topology never re-carved after its clean window"
+                )
+        # scope isolation: every PE strike family must carry its
+        # replica owner — a bare ``pe{N}`` family means a strike
+        # escaped into the process-global namespace (the exact
+        # cross-contamination scoped namespaces exist to prevent)
+        owners: set[str] = set()
+        for key in hc:
+            fam = key.rsplit(":", 1)[0]
+            if not fam.startswith("pe") or not fam[2:3].isdigit():
+                continue
+            if "@" not in fam:
+                fails.append(
+                    f"unscoped PE health family {fam!r} in an "
+                    f"elastic_scope fleet — a strike crossed into the "
+                    f"default namespace"
+                )
+            else:
+                owners.add(fam.split("@", 1)[1])
+        replica_names = {r.name for r in fl.replicas}
+        stray = owners - replica_names
+        if stray:
+            fails.append(
+                f"PE strike owners {sorted(stray)} are not replicas "
+                f"{sorted(replica_names)}"
+            )
     return fails
 
 
@@ -994,12 +1217,21 @@ def _run_fleet_campaign(spec: SoakSpec) -> CampaignResult:
     the router, chunk corruption on the decode handoff seam, and — when
     scheduled — one replica killed mid-burst.
 
-    Elastic stays DISABLED here: PE strike attribution is a
-    process-global namespace indexed by mesh position, and N replicas'
-    identically-numbered slices would cross-contaminate it (a strike on
-    r0's decode PE would quarantine r1's) — the fleet's recovery story
-    is REPLICA-scoped (failover), not PE-scoped (shrink). Known limit,
-    docs/serving.md "Fleet"."""
+    Two shapes share this runner. The LEGACY shape
+    (``fleet_recovery=False``) keeps elastic DISABLED: before ISSUE 17,
+    PE strike attribution was one process-global namespace indexed by
+    mesh position, and N replicas' identically-numbered slices would
+    have cross-contaminated it (a strike on r0's decode PE would have
+    quarantined r1's) — that shape pins the failover-only posture.
+    The RECOVERY shape (``SoakSpec.fleet_recovery_spec``) runs elastic
+    ON with ``FleetConfig(elastic_scope=True)``: each replica owns an
+    :class:`~triton_dist_tpu.resilience.elastic.ElasticScope`, strikes
+    land in ``pe{N}@r{i}`` health families, and the full recovery
+    ladder is armed — pool probation regrow
+    (``DisaggServingConfig.pool_probe_steps``), reversible collapse
+    (``collapse_probation_steps``), and replica resurrection
+    (``FleetConfig.resurrect``). docs/resilience.md "Recovery
+    plane"."""
     import jax
 
     from triton_dist_tpu import config as tdt_config
@@ -1013,7 +1245,11 @@ def _run_fleet_campaign(spec: SoakSpec) -> CampaignResult:
         TrafficSpec,
         generate_trace,
     )
-    from triton_dist_tpu.serving.fleet import FleetConfig, FleetRouter
+    from triton_dist_tpu.serving.fleet import (
+        FleetConfig,
+        FleetRouter,
+        ResurrectConfig,
+    )
     from triton_dist_tpu.serving.metrics import SLOTargets
     from jax.sharding import Mesh
 
@@ -1027,8 +1263,9 @@ def _run_fleet_campaign(spec: SoakSpec) -> CampaignResult:
     cfgsnap = tdt_config.get_config()
     saved = (cfgsnap.elastic, cfgsnap.fault_plan)
     resilience.reset(keep_env=True)
+    recovery = spec.fleet_recovery
     tdt_config.update(
-        elastic=False,
+        elastic=bool(recovery),
         fault_plan=(
             FaultPlan("bitflip", pe=-1, pool="decode",
                       max_triggers=spec.n_chunk_corruptions)
@@ -1083,15 +1320,38 @@ def _run_fleet_campaign(spec: SoakSpec) -> CampaignResult:
                             ),
                             prefill=pool_serving,
                             decode=pool_serving,
+                            pool_probe_steps=3 if recovery else None,
+                            collapse_probation_steps=(
+                                5 if recovery else None
+                            ),
                         ),
                         slo=SLOTargets(ttft_ms=1500.0),
+                        elastic_scope=recovery,
+                        resurrect=(
+                            ResurrectConfig(probe_steps=5, ramp_steps=2)
+                            if recovery else None
+                        ),
                     ),
                 )
                 error = None
-                with _inject_fleet_faults(
-                    kill_at=spec.replica_kill_at_step,
-                    target=f"r{spec.replica_kill_target}",
-                ) as calls:
+                if recovery:
+                    survivor = (
+                        f"r{(spec.replica_kill_target + 1) % spec.fleet_replicas}"
+                    )
+                    injector = _inject_recovery_faults(
+                        kill_at=spec.replica_kill_at_step,
+                        revive_at=spec.replica_revive_at_step,
+                        target=f"r{spec.replica_kill_target}",
+                        strag_at=spec.pool_strag_at_step,
+                        storm_at=spec.prefill_storm_at_step,
+                        survivor=survivor,
+                    )
+                else:
+                    injector = _inject_fleet_faults(
+                        kill_at=spec.replica_kill_at_step,
+                        target=f"r{spec.replica_kill_target}",
+                    )
+                with injector as calls:
                     try:
                         done = fl.serve(trace, max_steps=spec.max_steps)
                     except RuntimeError as exc:

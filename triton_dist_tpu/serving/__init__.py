@@ -47,6 +47,17 @@ Five parts (docs/serving.md "Serving engine" is the full contract):
   re-offering every queued + in-flight request to survivors with the
   ORIGINAL arrival/deadline anchors — zero lost, never-rebase-the-SLO.
   ``FleetConfig(replicas=1)`` is byte-identical to the bare engine.
+  Since ISSUE 17 the fleet also runs the RECOVERY plane: per-replica
+  elastic namespaces (``FleetConfig(elastic_scope=True)`` — one
+  ``ElasticScope`` per replica, strikes never cross), replica
+  resurrection (``FleetConfig(resurrect=ResurrectConfig(...))`` —
+  dead/drained replicas probe back in with a cold trie and an
+  affinity-only ramp), disagg pools regrow via pool-scoped probation
+  rounds (``DisaggServingConfig.pool_probe_steps``), and a collapsed
+  topology un-collapses after a clean probation window
+  (``DisaggServingConfig.collapse_probation_steps``) — every knob
+  None/off-disarmed, byte-identical off (docs/resilience.md
+  "Recovery plane").
 
 Plus the radix-shared paged KV prefix cache (ISSUE 12;
 ``models/prefix_cache.py``, docs/serving.md "Prefix cache"), armed via
@@ -82,6 +93,7 @@ from triton_dist_tpu.serving.disagg import (
 from triton_dist_tpu.serving.fleet import (
     FleetConfig,
     FleetRouter,
+    ResurrectConfig,
 )
 from triton_dist_tpu.serving.engine import (
     Finished,
@@ -135,6 +147,7 @@ __all__ = [
     "Poisoned",
     "PrefixCacheConfig",
     "Rejected",
+    "ResurrectConfig",
     "ServingConfig",
     "ServingEngine",
     "ServingMetrics",

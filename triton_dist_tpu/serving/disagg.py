@@ -94,14 +94,30 @@ class _PoolEngine(ServingEngine):
     are the TOPOLOGY's global numbering (pool position + ``pe_offset``),
     so a struck decode PE can never shrink the prefill pool — and every
     step runs inside the pool's ``faults.pool_scope`` (the FaultPlan
-    ``pool=`` injection seam). Probation regrow is coordinator-level
-    future work: quarantined pool PEs stay out (documented limit)."""
+    ``pool=`` injection seam). With ``pool_probe_steps`` armed (ISSUE 17
+    recovery plane) the pool runs its own probation rounds: the probe
+    barriers the POOL sub-mesh only, with the candidate set pinned to
+    this pool's global indices, and re-admitted PEs rejoin mid-serve
+    through the ordinary rebuild+replay arc. ``pool_probe_steps=None``
+    keeps the pre-recovery posture byte-identically: quarantined pool
+    PEs stay out."""
 
-    def __init__(self, *args, pool_name: str, pe_offset: int, **kw):
+    def __init__(self, *args, pool_name: str, pe_offset: int,
+                 pool_probe_steps: "int | None" = None, **kw):
         self._pool_name = str(pool_name)
         self._pe_offset = int(pe_offset)
+        self._pool_probe_steps = (
+            None if pool_probe_steps is None else int(pool_probe_steps)
+        )
         super().__init__(*args, **kw)
         self.family = f"serving_pool_{self._pool_name}"
+
+    def _pool_quarantined(self) -> list[int]:
+        """This pool's quarantined PEs, GLOBAL indices."""
+        n = int(self.full_mesh.devices.size)
+        lo, hi = self._pe_offset, self._pe_offset + n
+        return [pe for pe in self._elastic.quarantined_pes()
+                if lo <= pe < hi]
 
     def _target_mesh(self):
         if self.full_mesh.devices.ndim != 1 or not elastic.enabled():
@@ -109,7 +125,7 @@ class _PoolEngine(ServingEngine):
         n = int(self.full_mesh.devices.size)
         dropped = {
             pe - self._pe_offset
-            for pe in elastic.quarantined_pes()
+            for pe in self._elastic.quarantined_pes()
             if self._pe_offset <= pe < self._pe_offset + n
         }
         if not dropped:
@@ -137,7 +153,8 @@ class _PoolEngine(ServingEngine):
             return
         pe = elastic.attribute_straggler(err.records, int(err.world_size))
         if pe is not None:
-            elastic.report_timeout(pe + self._pe_offset, family=self.family)
+            self._elastic.report_timeout(pe + self._pe_offset,
+                                         family=self.family)
 
     def _attribute_integrity(self, exc: BaseException) -> None:
         if not elastic.enabled():
@@ -152,15 +169,45 @@ class _PoolEngine(ServingEngine):
             pe = int(r.get("pe", -1))
             if pe < 0 or (world is not None and pe >= int(world)):
                 continue
-            elastic.report_corruption(pe + self._pe_offset,
-                                      family=self.family)
+            self._elastic.report_corruption(pe + self._pe_offset,
+                                            family=self.family)
 
     def _maybe_probe(self) -> None:
-        # pool probation probes would barrier the pool's sub-mesh with
-        # GLOBAL quarantine indices — not wired; pool PEs stay out once
-        # struck (the coordinator's collapse path covers the terminal
-        # case; docs/serving.md "Disaggregated serving", known limits)
-        return
+        """Pool probation regrow (ISSUE 17, tentpole b). The historical
+        barrier-scope problem — a probation round would barrier the
+        pool's sub-mesh against GLOBAL quarantine indices — is solved by
+        probing the pool sub-mesh inside the pool's own fault scope (we
+        run inside ``_step_once``'s ``faults.pool_scope``) with the
+        candidate set pinned via ``pes=`` to this pool's slice of the
+        global numbering, so one pool's failed probe can never reset the
+        other pool's probation counters (satellite 6)."""
+        if self._pool_probe_steps is None:
+            return  # pre-recovery posture: struck pool PEs stay out
+        if self.full_mesh.devices.ndim != 1 or not elastic.enabled():
+            return
+        mine = self._pool_quarantined()
+        if not mine:
+            self._steps_since_probe = 0
+            return
+        self._steps_since_probe += 1
+        if self._steps_since_probe < self._pool_probe_steps:
+            return
+        self._steps_since_probe = 0
+        self._elastic.probe_quarantined(
+            self.full_mesh, axis=self.cfg.axis, pes=mine,
+        )
+        target = self._target_mesh()
+        if list(target.devices.flat) != list(self.mesh.devices.flat):
+            rejoined = [
+                pe for pe in mine
+                if self._elastic.state(pe) != elastic.QUARANTINED
+            ]
+            health.record_pool_regrow(
+                self.family, self._pool_name,
+                world=int(target.devices.size), pes=rejoined,
+            )
+            _mx.counter("serving_pool_regrows_total", engine=self.family)
+            self._rebuild("probation re-admission regrew the pool")
 
     def _step_once(self) -> bool:
         with faults.pool_scope(self._pool_name):
@@ -187,6 +234,21 @@ class DisaggServingConfig:
                    bypass the prefill pool into decode-local prefill —
                    the brownout shed path.
     slo:           end-to-end targets scored at the coordinator tier.
+    pool_probe_steps: ISSUE 17 recovery plane — every N worked pool
+                   steps with quarantined PEs in the pool's slice, the
+                   pool runs a probation probe round over its OWN
+                   sub-mesh (candidates pinned to its global indices);
+                   re-admitted PEs rejoin mid-serve through rebuild+
+                   replay. None (default) keeps the pre-recovery
+                   posture byte-identically: struck pool PEs stay out.
+    collapse_probation_steps: ISSUE 17 recovery plane — after N clean
+                   (rebuild-free, worked) unified ticks post-collapse
+                   AND a clean prefill-slice probe round, the
+                   coordinator re-carves the two-pool topology
+                   (un-collapse). In-flight requests finish where they
+                   run; new submissions take the disagg path again.
+                   None (default): collapse stays terminal, byte-
+                   identically.
     """
 
     prefill_pes: int = 1
@@ -197,11 +259,24 @@ class DisaggServingConfig:
     local_prefill_rung: int = 2
     slo: SLOTargets | None = None
     max_steps_idle: int = 4
+    pool_probe_steps: int | None = None
+    collapse_probation_steps: int | None = None
 
     def validate(self) -> "DisaggServingConfig":
         if self.prefill_pes < 1:
             raise ValueError(
                 f"prefill_pes must be >= 1, got {self.prefill_pes}"
+            )
+        if self.pool_probe_steps is not None and self.pool_probe_steps < 1:
+            raise ValueError(
+                f"pool_probe_steps must be >= 1 (or None to disarm), got "
+                f"{self.pool_probe_steps}"
+            )
+        if (self.collapse_probation_steps is not None
+                and self.collapse_probation_steps < 1):
+            raise ValueError(
+                f"collapse_probation_steps must be >= 1 (or None to "
+                f"disarm), got {self.collapse_probation_steps}"
             )
         if not 1 <= self.local_prefill_rung <= 3:
             raise ValueError(
@@ -263,10 +338,17 @@ class DisaggServingEngine:
         metrics: ServingMetrics | None = None,
         clock: Any = None,
         obs_tag: str = "",
+        elastic_scope: Any = None,
         **batcher_kw: Any,
     ):
         self.cfg = cfg
         self.serving = (serving or DisaggServingConfig()).validate()
+        # the elastic namespace BOTH pools share (pool-offset PE
+        # attribution keys it by topology-global index); None = the
+        # process-global DEFAULT scope, the pre-ISSUE-17 behavior
+        self._elastic = (
+            elastic_scope if elastic_scope is not None else elastic.DEFAULT
+        )
         self.clock = clock if clock is not None else _retry.get_clock()
         self._obs_tag = str(obs_tag)
         if mesh.devices.ndim != 1:
@@ -291,21 +373,30 @@ class DisaggServingEngine:
         axis = cfg.axis
         self.full_mesh = mesh
         self.s_max = int(s_max)
+        # the un-collapse arc re-carves the prefill pool from the same
+        # slice — keep the carve (params + batcher policy + sub-mesh)
+        self.params = params
+        self._batcher_kw = dict(batcher_kw)
+        self._n_prefill = n_p
+        self._prefill_mesh = Mesh(np.array(devices[:n_p]), (axis,))
         self.prefill = _PoolEngine(
-            cfg, params, Mesh(np.array(devices[:n_p]), (axis,)),
+            cfg, params, self._prefill_mesh,
             s_max=s_max, serving=self.serving.prefill, clock=self.clock,
             obs_tag=f"{self._obs_tag}pf:", pool_name=PREFILL_POOL,
-            pe_offset=0, **batcher_kw,
+            pe_offset=0, elastic_scope=self._elastic,
+            pool_probe_steps=self.serving.pool_probe_steps, **batcher_kw,
         )
         self.decode = _PoolEngine(
             cfg, params, Mesh(np.array(devices[n_p:]), (axis,)),
             s_max=s_max, serving=self.serving.decode, clock=self.clock,
             obs_tag=f"{self._obs_tag}dec:", pool_name=DECODE_POOL,
-            pe_offset=n_p, **batcher_kw,
+            pe_offset=n_p, elastic_scope=self._elastic,
+            pool_probe_steps=self.serving.pool_probe_steps, **batcher_kw,
         )
         self.handoff_plane = HandoffPlane(
             self.serving.handoff, s_max=s_max,
             prefill_world=n_p, decode_world=len(devices) - n_p,
+            elastic_scope=self._elastic,
         )
         any_ov = (
             self.serving.prefill.overload is not None
@@ -315,6 +406,7 @@ class DisaggServingEngine:
             slo=self.serving.slo, classes=PRIORITIES if any_ov else None,
         )
         self.collapsed = False
+        self._uncollapse_clean = 0
         self.results: dict[Any, Any] = {}
         self._states: dict[Any, _DState] = {}
         # (t_due, seq, uid) heaps: landings awaiting decode admission,
@@ -671,6 +763,61 @@ class DisaggServingEngine:
             replayed=replayed,
         )
 
+    # -- reversible collapse (ISSUE 17, tentpole c) -----------------------
+
+    def _maybe_uncollapse(self) -> None:
+        """After ``collapse_probation_steps`` clean (rebuild-free,
+        worked) unified ticks, probe the prefill slice; if every PE the
+        collapse left quarantined passes, re-carve the two-pool
+        topology. A failed probe restarts the probation window — the
+        same restart-on-failure arc a PE's own probation runs."""
+        cps = self.serving.collapse_probation_steps
+        if cps is None or not self.collapsed or self._uncollapse_clean < cps:
+            return
+        mine = [pe for pe in self._elastic.quarantined_pes()
+                if pe < self._n_prefill]
+        if mine:
+            with faults.pool_scope(PREFILL_POOL):
+                self._elastic.probe_quarantined(
+                    self._prefill_mesh, axis=self.cfg.axis, pes=mine,
+                )
+            if any(self._elastic.state(pe) == elastic.QUARANTINED
+                   for pe in mine):
+                self._uncollapse_clean = 0
+                return
+        self._uncollapse()
+
+    def _uncollapse(self) -> None:
+        """Re-carve the prefill pool on its original slice. In-flight
+        requests finish where they run (collapse-routed work stays
+        decode-bound, zero lost); only NEW submissions take the disagg
+        path again. The handoff manifest needs no invalidation — the
+        decode pool (the transfer target) survived the whole arc."""
+        now = self.clock.monotonic()
+        self.prefill = _PoolEngine(
+            self.cfg, self.params, self._prefill_mesh, s_max=self.s_max,
+            serving=self.serving.prefill, clock=self.clock,
+            obs_tag=f"{self._obs_tag}pf:", pool_name=PREFILL_POOL,
+            pe_offset=0, elastic_scope=self._elastic,
+            pool_probe_steps=self.serving.pool_probe_steps,
+            **self._batcher_kw,
+        )
+        self.collapsed = False
+        self._uncollapse_clean = 0
+        self.metrics.count("pool_uncollapses")
+        _mx.counter("serving_pool_uncollapses_total", engine=self.family)
+        health.record_pool_uncollapse(
+            self.family, PREFILL_POOL,
+            f"{self.serving.collapse_probation_steps} clean unified "
+            f"step(s); prefill pool re-carved at "
+            f"world={int(self.prefill.world_size)}",
+        )
+        _obs.record_span(
+            "serving:pool_uncollapse", now, now, cat="serving",
+            track=f"{self._obs_tag}engine", pool=PREFILL_POOL,
+            world=int(self.prefill.world_size),
+        )
+
     # -- burn-rate alerts (ISSUE 15) --------------------------------------
 
     def _alert_eng(self):
@@ -709,6 +856,7 @@ class DisaggServingEngine:
         charged (the pools run concurrently). Returns False when nothing
         had work."""
         worked = False
+        rb_before = self.decode.rebuilds
         # a decode-pool rebuild (elastic shrink, downshift) built a FRESH
         # cache: nothing previously streamed is resident anymore, so the
         # transfer manifest must forget it BEFORE any drain can run a
@@ -734,6 +882,16 @@ class DisaggServingEngine:
         # coordinator-tier alerts after both pools advanced (the pool
         # engines evaluated their own rules inside their _step_once)
         self._alerts_step()
+        # reversible collapse (ISSUE 17): only WORKED, rebuild-free
+        # unified ticks count toward the probation window — an idle
+        # topology proves nothing, and a rebuild mid-window restarts it
+        if (self.collapsed and worked
+                and self.serving.collapse_probation_steps is not None):
+            if self.decode.rebuilds == rb_before:
+                self._uncollapse_clean += 1
+            else:
+                self._uncollapse_clean = 0
+            self._maybe_uncollapse()
         if worked and _mx.enabled():
             _mx.gauge("serving_in_flight", len(self._states),
                       engine=self.family)

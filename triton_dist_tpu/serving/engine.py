@@ -271,11 +271,19 @@ class ServingEngine:
         metrics: ServingMetrics | None = None,
         clock: Any = None,
         obs_tag: str = "",
+        elastic_scope: Any = None,
         **batcher_kw: Any,
     ):
         self.cfg, self.params = cfg, params
         self.full_mesh = mesh
         self.s_max = int(s_max)
+        # the elastic namespace this engine strikes/probes in (ISSUE 17):
+        # None ⇒ the process-global default scope, byte-identical to the
+        # pre-scoping engine. A fleet passes one scope per replica so
+        # strikes never cross replica slices. Set before _target_mesh —
+        # the first mesh resolution already consults it.
+        self._elastic = (elastic_scope if elastic_scope is not None
+                         else elastic.DEFAULT)
         self.batcher_kw = dict(batcher_kw)
         self.serving = (serving or ServingConfig()).validate()
         # default clock = the resilience module clock, so one
@@ -371,7 +379,7 @@ class ServingEngine:
         hierarchical mesh serves un-shrunk."""
         if self.full_mesh.devices.ndim != 1 or not elastic.enabled():
             return self.full_mesh
-        return elastic.serviceable_mesh(
+        return self._elastic.serviceable_mesh(
             self.full_mesh, axis=self.cfg.axis, validate=self._world_ok
         )
 
@@ -947,11 +955,11 @@ class ServingEngine:
         """Peer attribution for one step timeout — overridable so a POOL
         engine (serving/disagg.py) can offset the records' pool-local PE
         indices into the topology's global numbering before striking."""
-        elastic.note_timeout_exc(exc, family=self.family)
+        self._elastic.note_timeout_exc(exc, family=self.family)
 
     def _attribute_integrity(self, exc: BaseException) -> None:
         """Corruption-attribution twin of :meth:`_attribute_timeout`."""
-        elastic.note_integrity_exc(exc, family=self.family)
+        self._elastic.note_integrity_exc(exc, family=self.family)
 
     def _on_step_timeout(self, exc: BaseException) -> None:
         # offer the failure to peer attribution (the call_with_retry
@@ -1049,14 +1057,14 @@ class ServingEngine:
     def _maybe_probe(self) -> None:
         if self.full_mesh.devices.ndim != 1 or not elastic.enabled():
             return
-        if not elastic.quarantined_pes():
+        if not self._elastic.quarantined_pes():
             self._steps_since_probe = 0
             return
         self._steps_since_probe += 1
         if self._steps_since_probe < self.serving.probe_interval_steps:
             return
         self._steps_since_probe = 0
-        elastic.probe_quarantined(self.full_mesh, axis=self.cfg.axis)
+        self._elastic.probe_quarantined(self.full_mesh, axis=self.cfg.axis)
         target = self._target_mesh()
         if list(target.devices.flat) != list(self.mesh.devices.flat):
             self._rebuild("probation re-admission regrew the world")

@@ -14,10 +14,12 @@ one submit/serve surface with three robustness pillars:
   ``page_tokens`` boundary, exactly ``HandoffPlane.manifest``'s keying)
   and routed to the replica whose cache already holds the longest chain
   of them: the cross-replica form of never-prefill-twice. The router
-  keeps its own model of per-replica residency (what it routed there);
-  it cannot see replica-local trie evictions — a stale-affinity route
-  costs a cold prefill, never correctness (known limit,
-  docs/serving.md "Fleet").
+  keeps its own model of per-replica residency (what it routed there),
+  kept honest by the eviction mirror (ISSUE 17): each replica trie's
+  ``evict_listener`` drops evicted/struck page keys from the router's
+  affinity index the moment the cache frees them. A stale route (a
+  partial last page, an unattached mid-step eviction) still costs only
+  a cold prefill, never correctness.
 - **Pressure-aware placement** — ties and affinity misses place on the
   per-replica signals the ISSUE 15 metrics plane exports (brownout
   rung, outstanding requests, composite pressure), never blind
@@ -40,6 +42,18 @@ one submit/serve surface with three robustness pillars:
   twin: no new routes, in-flight work finishes in place, then the
   replica retires — crash and drain produce equivalent terminal
   censuses (pinned in tests/test_fleet.py).
+- **The recovery plane** (ISSUE 17) — ``FleetConfig.elastic_scope``
+  gives each replica its own
+  :class:`~triton_dist_tpu.resilience.elastic.ElasticScope` (strikes
+  never cross replica boundaries; health families carry the owner,
+  ``pe{N}@rN``), and ``FleetConfig.resurrect`` re-admits dead AND
+  drained replicas: clean probe rounds → a fresh engine on the same
+  slice → re-entry with a cold trie and an affinity-only ramp
+  (``ResurrectConfig.ramp_steps``). Each resurrection records
+  ``health.record_replica_readmit`` and one incident bundle; the
+  ``fleet_replica_state`` gauge tracks down → ramping → live →
+  draining per replica. Both knobs default off — byte-identical to the
+  pre-recovery fleet.
 
 Arming discipline: ``FleetConfig(replicas=1)`` builds ONE engine over
 the full mesh with the serving config verbatim and :meth:`serve`
@@ -69,6 +83,7 @@ from jax.sharding import Mesh
 
 from triton_dist_tpu import obs as _obs
 from triton_dist_tpu.obs import metrics as _mx
+from triton_dist_tpu.resilience import elastic
 from triton_dist_tpu.resilience import health
 from triton_dist_tpu.resilience import retry as _retry
 from triton_dist_tpu.serving.disagg import (
@@ -112,6 +127,42 @@ def prefix_page_keys(prompt, page_tokens: int) -> list[tuple]:
 
 
 @dataclasses.dataclass(frozen=True)
+class ResurrectConfig:
+    """Arms replica resurrection (ISSUE 17 recovery plane): dead and
+    drained replicas are probed and — on a clean round — rebuilt and
+    re-entered into placement.
+
+    probe_steps: fleet ticks between probe rounds on a down replica.
+                 Each round barriers the replica's device slice (and,
+                 when ``FleetConfig.elastic_scope`` gives the replica
+                 its own elastic namespace, probes that scope's
+                 quarantined PEs); a failed round leaves it down until
+                 the next one.
+    ramp_steps:  ticks after resurrection during which the replica only
+                 receives AFFINITY traffic. Its trie is cold (the
+                 router's residency model was cleared with the dead
+                 engine), so pressure placement — which loves an idle
+                 replica — would flood it with cold prefills; the ramp
+                 lets residency rebuild from hits before it competes on
+                 pressure. 0 = no ramp.
+    """
+
+    probe_steps: int = 8
+    ramp_steps: int = 4
+
+    def validate(self) -> "ResurrectConfig":
+        if self.probe_steps < 1:
+            raise ValueError(
+                f"probe_steps must be >= 1, got {self.probe_steps}"
+            )
+        if self.ramp_steps < 0:
+            raise ValueError(
+                f"ramp_steps must be >= 0, got {self.ramp_steps}"
+            )
+        return self
+
+
+@dataclasses.dataclass(frozen=True)
 class FleetConfig:
     """Policy of the router plane.
 
@@ -138,6 +189,17 @@ class FleetConfig:
                    per replica: the router feeds each replica's alert
                    engine only the health flips recorded during THAT
                    replica's steps.
+    elastic_scope: ISSUE 17 recovery plane — give each replica its OWN
+                   elastic namespace (:class:`~triton_dist_tpu.
+                   resilience.elastic.ElasticScope`, owner ``rN``), so
+                   one replica's PE strikes can never quarantine
+                   another's PEs, and strike attribution in the health
+                   registry carries the owner (``pe{N}@rN``). False
+                   (default): every replica shares the process-global
+                   scope, the pre-recovery behavior byte-identically.
+    resurrect:     arm dead/drained-replica resurrection with this
+                   :class:`ResurrectConfig`. None (default): down
+                   replicas stay down, byte-identically.
     """
 
     replicas: int = 1
@@ -148,8 +210,12 @@ class FleetConfig:
     page_tokens: int = 4
     slo: SLOTargets | None = None
     fail_on_alert: str | None = "health_flip_burn"
+    elastic_scope: bool = False
+    resurrect: ResurrectConfig | None = None
 
     def validate(self) -> "FleetConfig":
+        if self.resurrect is not None:
+            self.resurrect.validate()
         if self.replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {self.replicas}")
         if self.routing not in ROUTING_POLICIES:
@@ -188,6 +254,11 @@ class _Replica:
     resident: set = dataclasses.field(default_factory=set)
     alerts: Any = None
     alerts_resolved: bool = False
+    # ISSUE 17 recovery plane
+    scope: Any = None           # my ElasticScope (None = shared DEFAULT)
+    ramp: int = 0               # affinity-only ticks left post-resurrect
+    ticks_dead: int = 0         # ticks since death (probe cadence)
+    resurrections: int = 0
 
 
 @dataclasses.dataclass
@@ -253,10 +324,7 @@ class FleetRouter:
                 rep_serving = dataclasses.replace(
                     rep_serving, virtual_step_s=None
                 )
-            mk = lambda sub, tag: DisaggServingEngine(  # noqa: E731
-                cfg, params, sub, s_max=s_max, serving=rep_serving,
-                clock=self.clock, obs_tag=tag, **batcher_kw,
-            )
+            engine_cls: Any = DisaggServingEngine
         else:
             rep_serving = self.fleet.serving
             if n > 1:
@@ -264,21 +332,42 @@ class FleetRouter:
                 rep_serving = dataclasses.replace(
                     rep_serving, virtual_step_s=None
                 )
-            mk = lambda sub, tag: ServingEngine(  # noqa: E731
-                cfg, params, sub, s_max=s_max, serving=rep_serving,
-                clock=self.clock, obs_tag=tag, **batcher_kw,
-            )
-        self.replicas = [
-            _Replica(
-                idx=i, name=f"r{i}",
-                engine=mk(
-                    Mesh(np.array(devices[i * per:(i + 1) * per]),
-                         (cfg.axis,)),
-                    f"{self._obs_tag}r{i}:" if n > 1 else self._obs_tag,
-                ),
-            )
+            engine_cls = ServingEngine
+        # the per-slice engine factory is KEPT (not a construction-time
+        # local): resurrection (ISSUE 17) rebuilds a dead replica's
+        # engine from the same carve
+        self._rep_meshes = [
+            Mesh(np.array(devices[i * per:(i + 1) * per]), (cfg.axis,))
             for i in range(n)
         ]
+        self._rep_tags = [
+            f"{self._obs_tag}r{i}:" if n > 1 else self._obs_tag
+            for i in range(n)
+        ]
+        self._rep_scopes = [
+            elastic.ElasticScope(owner=f"r{i}")
+            if self.fleet.elastic_scope else None
+            for i in range(n)
+        ]
+
+        def mk(i: int):
+            kw = dict(batcher_kw)
+            if self._rep_scopes[i] is not None:
+                kw["elastic_scope"] = self._rep_scopes[i]
+            return engine_cls(
+                cfg, params, self._rep_meshes[i], s_max=s_max,
+                serving=rep_serving, clock=self.clock,
+                obs_tag=self._rep_tags[i], **kw,
+            )
+
+        self._mk_engine = mk
+        self.replicas = [
+            _Replica(idx=i, name=f"r{i}", engine=mk(i),
+                     scope=self._rep_scopes[i])
+            for i in range(n)
+        ]
+        for rep in self.replicas:
+            self._attach_evict_mirror(rep)
         any_classes = self.replicas[0].engine.metrics.classes is not None
         self.metrics = metrics or ServingMetrics(
             slo=self.fleet.slo,
@@ -321,6 +410,34 @@ class FleetRouter:
     def _live(self) -> list[_Replica]:
         return [r for r in self.replicas if r.alive and not r.draining]
 
+    # -- the residency eviction mirror (ISSUE 17 satellite 1) ------------
+
+    def _rep_caches(self, rep: _Replica) -> list:
+        eng = rep.engine
+        engines = (
+            [eng.prefill, eng.decode]
+            if isinstance(eng, DisaggServingEngine) else [eng]
+        )
+        out = []
+        for e in engines:
+            px = getattr(getattr(e, "_batcher", None), "_px", None)
+            if px is not None:
+                out.append(px)
+        return out
+
+    def _attach_evict_mirror(self, rep: _Replica) -> None:
+        """Hook each replica trie's ``evict_listener`` so evicted/struck
+        page keys drop out of the router's residency model the moment
+        the cache frees them — a stale-affinity route is still only a
+        cold prefill, but it no longer happens for keys the router could
+        KNOW are gone. Re-attached every tick: engine rebuilds (elastic
+        shrink, un-collapse, resurrection) build fresh caches."""
+        for px in self._rep_caches(rep):
+            if px.evict_listener is None:
+                def drop(keys, _rep=rep):
+                    _rep.resident.difference_update(keys)
+                px.evict_listener = drop
+
     # -- routing ---------------------------------------------------------
 
     def _pressure_key(self, rep: _Replica):
@@ -346,9 +463,13 @@ class FleetRouter:
                 cands = open_
         if self.fleet.routing == "random":
             # one seeded draw per routed offer: a rotation keeps the
-            # full candidate list as rejection fallback
-            start = int(self._rng.integers(0, len(cands)))
-            order = cands[start:] + cands[:start]
+            # full candidate list as rejection fallback. Ramping
+            # (just-resurrected) replicas sit out the draw while any
+            # other candidate exists — random routing has no affinity
+            # signal to ramp on, so they re-enter cold after the ramp.
+            warm = [r for r in cands if r.ramp <= 0] or cands
+            start = int(self._rng.integers(0, len(warm)))
+            order = warm[start:] + warm[:start]
             return [(r, "random") for r in order]
         keys = prefix_page_keys(prompt, self.fleet.page_tokens)
         self._affinity_lookups += 1
@@ -367,7 +488,15 @@ class FleetRouter:
         )
         if scored[0][0] > 0:
             self._affinity_hits += 1
-        return [
+        # a ramping replica takes AFFINITY traffic only (ISSUE 17): its
+        # trie is cold and pressure placement loves an idle replica —
+        # without the ramp every cold prefill in flight would pile onto
+        # the resurrected engine. Unless it is all that's left.
+        out = [
+            (r, "affinity" if s > 0 else "pressure")
+            for s, r in scored if s > 0 or r.ramp <= 0
+        ]
+        return out or [
             (r, "affinity" if s > 0 else "pressure") for s, r in scored
         ]
 
@@ -554,6 +683,70 @@ class FleetRouter:
                 self.metrics.count("drained")
                 health.record_replica_drain(self.family, rep.name)
 
+    # -- resurrection (ISSUE 17, tentpole d) -----------------------------
+
+    def _maybe_resurrect(self) -> bool:
+        """Probe down (dead or drain-retired) replicas every
+        ``resurrect.probe_steps`` ticks; a clean round rebuilds the
+        engine and re-enters placement. Returns True when a replica
+        came back this tick. Disarmed (``resurrect=None``): down
+        replicas stay down, byte-identically."""
+        rc = self.fleet.resurrect
+        if rc is None:
+            return False
+        came_back = False
+        for rep in self.replicas:
+            if rep.alive:
+                continue
+            rep.ticks_dead += 1
+            if rep.ticks_dead < rc.probe_steps:
+                continue
+            rep.ticks_dead = 0
+            if self._probe_replica(rep):
+                self._resurrect(rep)
+                came_back = True
+        return came_back
+
+    def _probe_replica(self, rep: _Replica) -> bool:
+        """One probe round on a down replica, run inside its metrics
+        label scope so fault plans keyed on the replica label keep
+        firing — a mid-storm probe fails honestly and the replica stays
+        down. A replica with its own elastic scope probes that scope's
+        quarantined PEs through the ordinary probation machinery (the
+        round is clean once none remain quarantined); otherwise one
+        world barrier over its slice decides."""
+        mesh = self._rep_meshes[rep.idx]
+        with _mx.label_scope(replica=rep.name):
+            if rep.scope is not None and rep.scope.quarantined_pes():
+                rep.scope.probe_quarantined(mesh, axis=self.cfg.axis)
+                return not rep.scope.quarantined_pes()
+            return elastic.probe_world(mesh, axis=self.cfg.axis)
+
+    def _resurrect(self, rep: _Replica) -> None:
+        per = int(self._rep_meshes[rep.idx].devices.size)
+        rep.engine = self._mk_engine(rep.idx)
+        rep.alive = True
+        rep.draining = False
+        rep.flips = 0
+        rep.resident.clear()   # cold trie: the affinity model restarts honest
+        rep.alerts = None      # resolve_engine hands back fresh rule state
+        rep.alerts_resolved = False
+        rep.ramp = self.fleet.resurrect.ramp_steps
+        rep.resurrections += 1
+        self._attach_evict_mirror(rep)
+        self.metrics.count("resurrections")
+        with _mx.label_scope(replica=rep.name):
+            # inside the label scope: the metrics mirror AND the
+            # incident bundle name the replica that came back
+            health.record_replica_readmit(
+                self.family, rep.name,
+                f"clean probe round; engine rebuilt at world={per}",
+                world=per,
+            )
+        if _mx.enabled():
+            _mx.counter("fleet_resurrections_total", engine=self.family,
+                        replica=rep.name)
+
     # -- alert-driven death ---------------------------------------------
 
     def _alert_death(self, rep: _Replica, now: float) -> bool:
@@ -599,6 +792,10 @@ class FleetRouter:
                 worked = True
                 continue
             rep.flips += max(0, health.flip_total() - flips0)
+            # a rebuild mid-step (elastic shrink, un-collapse) built a
+            # fresh trie — re-hook the residency mirror before the next
+            # routing decision reads rep.resident
+            self._attach_evict_mirror(rep)
             self._collect(rep)
             if self._alert_death(rep, self.clock.monotonic()):
                 self._fail_replica(
@@ -607,6 +804,10 @@ class FleetRouter:
                 )
                 worked = True
         self._retire_drained()
+        for rep in self.replicas:
+            if rep.alive and rep.ramp > 0:
+                rep.ramp -= 1
+        worked = self._maybe_resurrect() or worked
         if worked and self._virtual_step_s:
             self.clock.sleep(self._virtual_step_s)
         self._observe()
@@ -617,6 +818,19 @@ class FleetRouter:
             return
         for rep in self.replicas:
             _mx.gauge("fleet_replica_alive", int(rep.alive),
+                      engine=self.family, replica=rep.name)
+            # the recovery-plane state machine, one gauge per replica
+            # (ISSUE 17): 0=down, 1=ramping (resurrected, affinity-only),
+            # 2=live, 3=draining
+            if not rep.alive:
+                state = 0
+            elif rep.draining:
+                state = 3
+            elif rep.ramp > 0:
+                state = 1
+            else:
+                state = 2
+            _mx.gauge("fleet_replica_state", state,
                       engine=self.family, replica=rep.name)
             if rep.alive:
                 _mx.gauge("fleet_replica_outstanding",
@@ -750,6 +964,11 @@ class FleetRouter:
             "failover_reoffered": reqs.get("failover_reoffered", 0),
             "reoffered": reqs.get("reoffered", 0),
             "drains": reqs.get("drains", 0),
+            "resurrections": reqs.get("resurrections", 0),
+            "resurrected": {
+                r.name: r.resurrections for r in self.replicas
+                if r.resurrections
+            },
             "resident_keys": {
                 r.name: len(r.resident) for r in self.replicas
             },

@@ -156,9 +156,17 @@ class HandoffPlane:
         decode_world: int,
         prefill_pe_base: int = 0,
         decode_pe_base: int | None = None,
+        elastic_scope: Any = None,
     ):
         self.cfg = config.validate()
         self.s_max = int(s_max)
+        # the elastic namespace the ladder strikes into (ISSUE 17): the
+        # plane blames pool PEs at their GLOBAL index, and a fleet's
+        # per-replica topology must land those strikes in ITS replica's
+        # scope, not the process-global one. None ⇒ the default scope
+        # (every pre-scoping call site, byte-unchanged).
+        self._elastic = (elastic_scope if elastic_scope is not None
+                         else elastic.DEFAULT)
         self.prefill_world = int(prefill_world)
         self.decode_world = int(decode_world)
         self.prefill_pe_base = int(prefill_pe_base)
@@ -294,7 +302,8 @@ class HandoffPlane:
                         self._bump("canary_mismatches")
                         t += cfg.virtual_chunk_s
                         reason = "payload canary mismatch on landing"
-                        elastic.report_corruption(pe, family=self.family)
+                        self._elastic.report_corruption(pe,
+                                                        family=self.family)
                     else:
                         # the chunk's pure signal never arrived: the
                         # bounded wait expires; the silent sender is the
@@ -302,7 +311,7 @@ class HandoffPlane:
                         self._bump("chunk_timeouts")
                         t += cfg.chunk_timeout_s
                         reason = "chunk signal bounded-wait timeout"
-                        elastic.report_timeout(pe, family=self.family)
+                        self._elastic.report_timeout(pe, family=self.family)
                     if attempt == cfg.retry.max_attempts - 1:
                         return False, t, streamed, deduped, retries, pe
                     self._bump("chunk_retries")
