@@ -1067,6 +1067,56 @@ def _run_serving(argv) -> None:
             )
             for name, value, unit in sbench.info_lines(px_rows, tag=stag):
                 emit_info(name, value, unit)
+    # prefix-cache × fast-prefill A/B (ISSUE 18): the share=1.0 workload
+    # again, but with MXU prefill ARMED on both arms (prefill=True) and a
+    # work-proportional prefill charge (virtual_prefill_work_s) pricing
+    # each pass's swept query×key rectangle. The off arm bulk-prefills
+    # the whole 14-18-token prompt at the dense 32×32 bucket rectangle;
+    # the on arm's trie hit routes only the 2-6-token divergent suffix
+    # through a ranged strip (8 rows × 18 keys) — p50 TTFT collapses by
+    # the swept-work ratio. Seeded + FakeClock ⇒ byte-identical reruns;
+    # info lines only, never perf-gated.
+    pxp_traffic = dict(
+        prefix_pool=2, prefix_len=("fixed", 12), prefix_zipf=1.2,
+        prefix_share=1.0,
+    )
+    for tag, px in (("_pxp_off", None), ("_pxp_on", PrefixCacheConfig())):
+        pxp_rows = sbench.sweep_offered_load(
+            cfg, params, mesh, s_max=32, rates=rates, n_requests=64,
+            prompt_len=("uniform", 2, 6), output_len=("uniform", 2, 8),
+            seed=0, virtual_step_s=0.05,
+            slo=SLOTargets(ttft_ms=800.0, e2e_ms=3000.0),
+            serving_kw=dict(prefix_cache=px,
+                            virtual_prefill_work_s=0.0008),
+            batcher_kw=dict(page_size=4, prefill=True),
+            traffic_kw=pxp_traffic, tag=tag.strip("_") + ":",
+        )
+        for name, value, unit in sbench.info_lines(pxp_rows, tag=tag):
+            emit_info(name, value, unit)
+    # chunked-prefill A/B (ISSUE 18): a heavy-tail prompt mix (15% of
+    # requests replaced by 20-token long prompts, the rest 2-6 tokens)
+    # with MXU prefill armed and work-priced on both arms. The off arm
+    # bulk-prefills a long prompt in ONE step at the dense 32×32 bucket
+    # rectangle (1024 swept pairs) — every neighbor admitted or queued
+    # behind it eats the whole lump in its TTFT; the on arm splits it
+    # into 4-token suffix-only ranged chunks (Σ 4×hi = 240 swept pairs)
+    # interleaved with decode steps, so the lump both shrinks ~4× and
+    # spreads — p99 TTFT collapses at every λ. Seeded + FakeClock ⇒
+    # byte-identical reruns; info lines only, never perf-gated.
+    cp_traffic = dict(long_prompt_frac=0.15, long_prompt_len=("fixed", 20))
+    for tag, chunk in (("_cp_off", None), ("_cp_on", 4)):
+        cp_rows = sbench.sweep_offered_load(
+            cfg, params, mesh, s_max=32, rates=rates, n_requests=48,
+            prompt_len=("uniform", 2, 6), output_len=("uniform", 2, 8),
+            seed=0, virtual_step_s=0.05,
+            slo=SLOTargets(ttft_ms=800.0, e2e_ms=3000.0),
+            serving_kw=dict(virtual_prefill_work_s=0.0015,
+                            prefill_chunk_tokens=chunk),
+            batcher_kw=dict(prefill=True),
+            traffic_kw=cp_traffic, tag=tag.strip("_") + ":",
+        )
+        for name, value, unit in sbench.info_lines(cp_rows, tag=tag):
+            emit_info(name, value, unit)
     # disaggregated-vs-unified A/B (ISSUE 13, ROADMAP #2): the SAME
     # seeded traffic and SLO over the same 4 host devices — unified
     # engine on all 4 vs the two-pool topology (2 prefill + 2 decode,

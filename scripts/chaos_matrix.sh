@@ -122,6 +122,15 @@
 # (resilience/soak.py SoakSpec.fleet_recovery_spec) replaying
 # bit-identically.
 #
+# Since ISSUE 18 the matrix also covers the RANGED-PREFILL cells
+# (tests/test_ranged_prefill.py): the pipelined disagg handoff — decode
+# admission at FIRST-page-landed while the tail streams — must keep the
+# transfer-span decomposition exact with tokens byte-identical, and a
+# corrupt KV chunk injected mid-pipelined-handoff must walk the guard
+# ladder with zero lost requests and a bit-identical seeded replay
+# (resilience/soak.py SoakSpec.disagg(pipelined_handoff=True); the full
+# set rides scripts/chaos_soak.py).
+#
 # Every cell runs under a wall-clock budget (TDT_CELL_TIMEOUT_S,
 # default 600 s; conftest.py delivers it as a SIGALRM inside the cell):
 # a hung cell reports as one named FAILED row — and so fails the exit
@@ -148,7 +157,7 @@ files="tests/test_chaos.py tests/test_elastic.py \
     tests/test_obs.py tests/test_analysis.py tests/test_overload.py \
     tests/test_prefix_cache.py tests/test_disagg.py tests/test_synth.py \
     tests/test_flight_recorder.py tests/test_fleet.py \
-    tests/test_recovery.py"
+    tests/test_recovery.py tests/test_ranged_prefill.py"
 marker="chaos"
 lint_args=""
 if [ "${1:-}" = "--quick" ]; then
@@ -157,7 +166,8 @@ if [ "${1:-}" = "--quick" ]; then
         tests/test_elastic.py tests/test_overload.py \
         tests/test_prefix_cache.py tests/test_disagg.py \
         tests/test_synth.py tests/test_flight_recorder.py \
-        tests/test_fleet.py tests/test_recovery.py"
+        tests/test_fleet.py tests/test_recovery.py \
+        tests/test_ranged_prefill.py"
     marker="chaos and not slow"
     # keep the quick posture bounded: worlds {2,4} (the full {2,4,8}
     # sweep is the default standalone run's job)

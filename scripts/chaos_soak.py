@@ -62,6 +62,14 @@ ramp once it clears). Strikes must land in ``pe{N}@r{i}`` scoped
 health families only, the re-admitted replica must serve again, and
 the whole campaign must replay bit-identically from its seed.
 
+Since ISSUE 18 the run also includes PIPELINED-DISAGG campaigns (the
+``SoakSpec.disagg`` shape with ``pipelined_handoff=True``): the same
+corrupt-chunk / pool-straggler / scheduled-collapse arcs, but the decode
+pool admits each delivered handoff at its FIRST page's landing instead
+of the last — admission overlaps the streaming tail, and the zero-lost /
+exactly-one-terminal / bundle-per-flip invariants plus the bit-identical
+seeded replay must all hold at the earlier gate.
+
 Usage::
 
     scripts/chaos_soak.py [--campaigns N] [--seed-base S] [--quick]
@@ -69,10 +77,11 @@ Usage::
                           [--no-fleet] [--no-recovery]
 
 ``--quick`` runs 3 small + 1 shared-prefix + 1 disagg + 1 fleet +
-1 recovery campaign (the chaos-matrix cell posture); the default 20 +
-6 shared-prefix + 5 disagg + 4 fleet + 3 recovery campaigns are the
-ISSUE 11/12/13/16/17 acceptance run. Exit code 0 iff every campaign
-is green (and the replay checks hold).
+1 recovery + 1 pipelined-disagg campaign (the chaos-matrix cell
+posture); the default 20 + 6 shared-prefix + 5 disagg + 4 fleet +
+3 recovery + 3 pipelined-disagg campaigns are the ISSUE 11/12/13/16/17/
+18 acceptance run. Exit code 0 iff every campaign is green (and the
+replay checks hold).
 """
 
 import argparse
@@ -122,6 +131,7 @@ def main(argv=None) -> int:
     n_dg = 0 if args.no_disagg else (1 if args.quick else 5)
     n_fl = 0 if args.no_fleet else (1 if args.quick else 4)
     n_rc = 0 if args.no_recovery else (1 if args.quick else 3)
+    n_pd = 0 if args.no_disagg else (1 if args.quick else 3)
 
     def build_spec(k: int):
         if k < n:
@@ -138,13 +148,18 @@ def main(argv=None) -> int:
             return soak.SoakSpec.fleet(
                 seed=args.seed_base + 300 + (k - n - n_px - n_dg)
             ), "fleet"
-        return soak.SoakSpec.fleet_recovery_spec(
-            seed=args.seed_base + 400 + (k - n - n_px - n_dg - n_fl)
-        ), "recovery"
+        if k < n + n_px + n_dg + n_fl + n_rc:
+            return soak.SoakSpec.fleet_recovery_spec(
+                seed=args.seed_base + 400 + (k - n - n_px - n_dg - n_fl)
+            ), "recovery"
+        return soak.SoakSpec.disagg(
+            seed=args.seed_base + 500 + (k - n - n_px - n_dg - n_fl - n_rc),
+            pipelined_handoff=True,
+        ), "disagg-pipe"
 
     rows = []
     t0 = time.time()
-    for k in range(n + n_px + n_dg + n_fl + n_rc):
+    for k in range(n + n_px + n_dg + n_fl + n_rc + n_pd):
         spec, kind_tag = build_spec(k)
         t1 = time.time()
         res = soak.run_campaign(spec)
@@ -162,7 +177,7 @@ def main(argv=None) -> int:
                 f" [prefix: hit_rate={px.get('hit_rate', 0)} "
                 f"struck_readers={reqs.get('prefix_struck', 0)}]"
             )
-        elif kind_tag == "disagg":
+        elif kind_tag.startswith("disagg"):
             ho = res.snapshot.get("handoff", {})
             px_note = (
                 f" [handoff: retries={ho.get('chunk_retries', 0)} "
@@ -209,7 +224,7 @@ def main(argv=None) -> int:
             [n + n_px] if n_dg else []
         ) + ([n + n_px + n_dg] if n_fl else []) + (
             [n + n_px + n_dg + n_fl] if n_rc else []
-        )
+        ) + ([n + n_px + n_dg + n_fl + n_rc] if n_pd else [])
         for idx in replay_at:
             spec, kind_tag = build_spec(idx)
             first = rows[idx][2]

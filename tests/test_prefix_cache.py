@@ -265,14 +265,18 @@ def test_min_hit_pages_and_config_validation():
         PrefixCacheConfig(min_hit_pages=0).validate()
 
 
-def test_batcher_arming_requires_paged_flat_fed(tiny1, mesh1):
+def test_batcher_arming_requires_paged_flat(tiny1, mesh1):
     cfg, params = tiny1
     with pytest.raises(ValueError, match="page_size"):
         ContinuousBatcher(cfg, params, mesh1, s_max=16,
                           prefix_cache=PrefixCacheConfig())
-    with pytest.raises(ValueError, match="token-fed"):
-        ContinuousBatcher(cfg, params, mesh1, s_max=16, page_size=4,
-                          prefill=True, prefix_cache=PrefixCacheConfig())
+    # prefill=True + prefix cache composes since ISSUE 18: a trie hit
+    # ranged-prefills only the divergent suffix (tests/
+    # test_ranged_prefill.py pins the byte-identity); the paged-pool
+    # requirement stands — shared pages ARE the prior-KV block table
+    bt = ContinuousBatcher(cfg, params, mesh1, s_max=16, page_size=4,
+                           prefill=True, prefix_cache=PrefixCacheConfig())
+    assert bt._px is not None
 
 
 # ---------------------------------------------------------------------------

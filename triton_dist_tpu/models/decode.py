@@ -184,7 +184,9 @@ class KVCacheSpec:
         boundaries) and run the multi-row SP verify attention. k_new,
         v_new ``[b, S, h_kv, d]``; q ``[b, S, hq, d]``; pos0 ``[b]``.
         Returns ``(attn [b, S, hq, d] f32, cache)``."""
-        from triton_dist_tpu.ops.flash_decode import flash_verify_distributed
+        from triton_dist_tpu.ops.flash_decode import (
+            flash_ranged_prefill_distributed,
+        )
 
         S = k_new.shape[1]
         s_shard = _shard_of(self.s_max, n)
@@ -206,13 +208,10 @@ class KVCacheSpec:
             v_new.astype(vc.dtype), mode="drop"
         )
         cache = dict(cache, k=cache["k"].at[li].set(kc), v=cache["v"].at[li].set(vc))
-        # per-(sequence, chunk-row) valid prefix in the LOCAL shard: row i
-        # attends global positions < pos0 + i + 1
-        lens = jax.vmap(
-            lambda i: _local_lens(pos0 + i, me, s_shard), out_axes=1
-        )(jnp.arange(S))                                   # [b, S]
-        attn = flash_verify_distributed(
-            q.astype(kc.dtype), kc, vc, lens,
+        # row i attends global positions < pos0 + i + 1: the ranged entry
+        # derives the per-(sequence, chunk-row) local prefix from pos0
+        attn = flash_ranged_prefill_distributed(
+            q.astype(kc.dtype), kc, vc, pos0,
             axis=cfg.axis, config=fd_config, interpret=interpret,
         )
         return attn, cache
@@ -369,7 +368,7 @@ class PagedKVCacheSpec:
         pages one decode step at a time and cannot batch-claim a chunk
         that opens several pages."""
         from triton_dist_tpu.ops.flash_decode import (
-            paged_flash_verify_distributed,
+            paged_flash_ranged_prefill_distributed,
         )
 
         if not self.static_table:
@@ -398,11 +397,8 @@ class PagedKVCacheSpec:
         cache = dict(
             cache, k=cache["k"].at[li].set(kc), v=cache["v"].at[li].set(vc)
         )
-        lens = jax.vmap(
-            lambda i: _local_lens(pos0 + i, me, s_shard), out_axes=1
-        )(jnp.arange(S))                                   # [b, S]
-        attn = paged_flash_verify_distributed(
-            q.astype(kc.dtype), kc, vc, lens, bt,
+        attn = paged_flash_ranged_prefill_distributed(
+            q.astype(kc.dtype), kc, vc, pos0, bt,
             axis=cfg.axis, interpret=interpret,
         )
         return attn, cache
@@ -809,6 +805,7 @@ class ContinuousBatcher:
         page_size: int | None = None,
         fd_config: FlashDecodeConfig | None = None,
         prefill: bool = False,
+        prefill_chunk_tokens: int | None = None,
         interpret: Any = None,
         prefix_cache: Any = None,
     ):
@@ -833,15 +830,12 @@ class ContinuousBatcher:
                     "prefix_cache shares refcounted chains of PHYSICAL "
                     "pages — it needs the paged cache (pass page_size)"
                 )
-            if prefill:
-                raise ValueError(
-                    "prefix_cache composes with token-fed admission only: "
-                    "the masked prefill pass has no attend-to-prior-cache "
-                    "form, so a shared prefix could not be skipped (and "
-                    "its KV would not be bit-identical across prefill "
-                    "buckets); ROADMAP #2's disaggregated prefill pool is "
-                    "the streaming form of this"
-                )
+            # prefill=True composes (ISSUE 18): admission routes through
+            # the suffix-only RANGED prefill (prefill_cache_ranged), whose
+            # per-row causal mask attends the trie hit's already-landed
+            # pages — the attend-to-prior-cache form the masked prefill
+            # lacked. Every prefill admission (hit AND miss) rides it, so
+            # a hit is bit-identical to its own miss by range composition.
             if n_o > 1:
                 raise ValueError(
                     "prefix_cache supports flat (1-axis) serving meshes: "
@@ -853,7 +847,35 @@ class ContinuousBatcher:
         # (pre-assigned page ranges), exactly what the paged prefill's
         # batch page write needs
         self.prefill = prefill
+        if prefill_chunk_tokens is not None:
+            if not prefill:
+                raise ValueError(
+                    "prefill_chunk_tokens bounds the ranged chunks of "
+                    "MXU-rate admission — it needs prefill=True (token-fed "
+                    "admission already interleaves one token per step)"
+                )
+            if prefill_chunk_tokens < 1:
+                raise ValueError("prefill_chunk_tokens must be >= 1")
+        self.prefill_chunk_tokens = prefill_chunk_tokens
+        self._fd_config = fd_config
+        self._interpret = interpret
         self._prefill_progs: dict[int, Any] = {}
+        self._ranged_progs: dict[int, Any] = {}
+        # chunked-prefill state: slot -> next unfed prompt position. A
+        # parked slot sits at pos = s_max (owned by no PE: its dummy
+        # decode writes drop) while bounded ranged chunks land between
+        # decode steps.
+        self._chunk: dict[int, int] = {}
+        # cumulative REAL prompt tokens run through the MXU prefill paths
+        # (bucket prefill + ranged chunks; pad positions excluded)
+        self.prefill_tokens_total = 0
+        # cumulative prefill WORK in swept query×key token-pairs: a bulk
+        # bucket pass computes the dense padded bucket×bucket rectangle
+        # (every query row against every key slot, mask applied after),
+        # while a ranged chunk sweeps only its chunk_bucket×hi strip —
+        # the asymmetry the serving engine's virtual_prefill_work_s
+        # charge model bills (ISSUE 18)
+        self.prefill_work_total = 0
         self.spec = (
             PagedKVCacheSpec(
                 s_max, page_size, static_table=True,
@@ -983,6 +1005,139 @@ class ContinuousBatcher:
             )
         return bucket
 
+    def _ranged_prog(self, bucket: int):
+        """Jitted suffix-only ranged-prefill program for one padded chunk
+        length (``prefill_cache_ranged`` — the verify forward): tokens
+        ``[b, bucket]`` at per-slot start positions ``pos0``, attending
+        already-landed KV. Non-target rows park at ``pos0 = s_max`` —
+        owned by no PE, so their writes drop and their logits are
+        ignored. No ``b*bucket`` divisibility constraint: the ranged
+        forward gathers features, not tokens."""
+        if bucket in self._ranged_progs:
+            return self._ranged_progs[bucket]
+        cfg, spec = self.cfg, self.spec
+
+        def fn(params, cache, tokens, pos0):
+            return prefill_cache_ranged(
+                cfg, params, cache, tokens, pos0, spec=spec,
+                fd_config=self._fd_config, interpret=self._interpret,
+            )
+
+        from triton_dist_tpu.ops.common import jit_shard_map
+
+        prog = jit_shard_map(
+            fn, self.mesh,
+            (
+                specs_for(cfg, self.params), spec.specs(cfg), P(None, None),
+                P(None),
+            ),
+            (P(None, None, None), spec.specs(cfg)),
+            key=(
+                "batcher_ranged", cfg, spec, self._fd_config, bucket,
+                str(self._interpret),
+            ),
+            donate_argnums=(1,),  # see self._step: the old cache is dead
+        )
+        self._ranged_progs[bucket] = prog
+        return prog
+
+    def _push_px_table(self) -> None:
+        """Push the host-managed block table (admissions repointed rows at
+        shared chains / fresh private pages, releases parked rows on
+        scratch) — the only device-visible artifact of the whole
+        prefix-cache layer. Must land before any device program whose
+        paged scatter or attention reads the table."""
+        self.cache = dict(
+            self.cache,
+            block_table=jax.device_put(
+                jnp.asarray(self._px.table),
+                NamedSharding(
+                    self.mesh, self.spec.specs(self.cfg)["block_table"]
+                ),
+            ),
+        )
+        self._px_dirty = False
+
+    def _ranged_pass(self, i: int, req: Request, lo: int, hi: int) -> None:
+        """One suffix-only ranged-prefill pass for slot ``i`` over prompt
+        positions ``[lo, hi)``. Pads to a power-of-two chunk bucket
+        (compiled once per bucket, like ``_prefill_prog``); pad rows of
+        the target slot write junk KV at positions ``>= hi``, which the
+        next chunk / decode step overwrites before ``kv_lens`` ever
+        exposes it (the documented dirty-cache discipline). When ``hi``
+        reaches the prompt end, completes admission exactly like
+        ``_admit_prefill`` — the first token samples from position
+        ``L-1``'s row."""
+        L = len(req.prompt)
+        S = hi - lo
+        bucket = 1
+        while bucket < S:
+            bucket *= 2
+        tokens = np.zeros((self.cfg.batch, bucket), np.int32)
+        tokens[i, :S] = req.prompt[lo:hi]
+        pos0 = np.full(self.cfg.batch, self.s_max, np.int32)  # parked rows
+        pos0[i] = lo
+        if self._px is not None and self._px_dirty:
+            # the paged scatter and attention read the device table: an
+            # acquire/publish that just repointed this slot's row must
+            # land first
+            self._push_px_table()
+        logits, self.cache = self._ranged_prog(bucket)(
+            self.params, self.cache, jnp.asarray(tokens), jnp.asarray(pos0)
+        )
+        self.prefill_tokens_total += S
+        self.prefill_work_total += bucket * hi
+        if self._px is not None:
+            # publish-on-completion, batch form: every prompt page fully
+            # covered by [0, hi) enters the trie now (its last position's
+            # KV just landed) — the same gate the decode loop applies one
+            # page at a time
+            pg = self._px.page
+            while True:
+                g = self._px.next_publish(i)
+                if (g + 1) * pg > hi or (g + 1) * pg > L:
+                    break
+                if self._px.publish(i, g, req.prompt[g * pg:(g + 1) * pg]):
+                    self._px_dirty = True
+        if hi < L:
+            return  # mid-prompt chunk: no token to sample yet
+        from triton_dist_tpu.resilience import integrity as _integrity
+
+        last_i = np.asarray(logits[i, S - 1], np.float32)
+        if _integrity.output_checks_enabled() and not np.isfinite(last_i).all():
+            # poisoned at admission: quarantine before a token exists
+            self._poison_slot(i, "non-finite prefill logits")
+            return
+        t0 = req.sample(last_i, self.slot_rng[i])
+        self.slot_fed[i] = L
+        self.slot_out[i] = [t0]
+        self.tok[i] = t0
+        self.pos[i] = L
+        if len(self.slot_out[i]) >= req.max_new_tokens or (
+            req.eos_id is not None and t0 == req.eos_id
+        ):
+            self.finished.append((req.uid, self.slot_out[i]))
+            self.slot_req[i] = None
+            if self._px is not None:
+                self._px.release(i)
+                self._px_dirty = True
+
+    def _admit_ranged(self, i: int, req: Request, lo: int) -> None:
+        """Ranged admission: feed prompt positions ``[lo, L)`` — the
+        divergent suffix past a trie hit, or the whole prompt — through
+        the suffix-only ranged prefill: one pass, or parked into bounded
+        chunks when ``prefill_chunk_tokens`` is armed and the suffix is
+        longer (the chunks land between decode steps, so a long prompt
+        cannot stall a decode-heavy batch)."""
+        ct = self.prefill_chunk_tokens
+        if ct is not None and len(req.prompt) - lo > ct:
+            self._chunk[i] = lo
+            self.pos[i] = self.s_max      # parked: owned by no PE
+            self.tok[i] = 0
+            self.slot_fed[i] = 0
+            return
+        self._ranged_pass(i, req, lo, len(req.prompt))
+
     def _admit_prefill(self, i: int, req: Request) -> None:
         """MXU-rate admission: one masked full-forward pass writes the
         whole prompt's KV and yields the first generated token."""
@@ -1000,6 +1155,8 @@ class ContinuousBatcher:
             jnp.asarray(np.arange(self.cfg.batch) == i),
             jnp.asarray(pick),
         )
+        self.prefill_tokens_total += L
+        self.prefill_work_total += bucket * bucket
         from triton_dist_tpu.resilience import integrity as _integrity
 
         last_i = np.asarray(last[i], np.float32)
@@ -1041,7 +1198,30 @@ class ContinuousBatcher:
                         else np.random.default_rng(req.seed)
                     )
                     if self.prefill and len(req.prompt) > 1:
-                        self._admit_prefill(i, req)
+                        if self._px is not None:
+                            # px × fast prefill (ISSUE 18): the trie hit's
+                            # pages are the ranged pass's already-landed
+                            # prior — only the divergent suffix runs. The
+                            # MISS path rides the same ranged entry from
+                            # lo=0, so hit ≡ miss bit for bit (range
+                            # composition), and both ≡ the token-fed px
+                            # engine (decode-chain equivalence).
+                            n_hit = self._px.acquire(
+                                i, req.prompt, req.max_new_tokens
+                            )
+                            self._px_dirty = True
+                            self._admit_ranged(i, req, n_hit)
+                        elif (self.prefill_chunk_tokens is not None
+                              and len(req.prompt)
+                              > self.prefill_chunk_tokens):
+                            # chunked-prefill scheduling: park the slot;
+                            # bounded ranged chunks land between decode
+                            # steps. Shorter prompts keep the legacy
+                            # bucket prefill byte for byte (the
+                            # armed-but-untriggered pin).
+                            self._admit_ranged(i, req, 0)
+                        else:
+                            self._admit_prefill(i, req)
                     elif self._px is not None:
                         # longest-prefix match (ISSUE 12): every fully
                         # shared page is skipped — the slot starts its
@@ -1137,6 +1317,7 @@ class ContinuousBatcher:
         req = self.slot_req[i]
         self.poisoned.append((req.uid, list(self.slot_out[i]), reason))
         self.slot_req[i] = None
+        self._chunk.pop(i, None)
         health.record_poisoned_request("continuous_batcher", req.uid, reason)
         if self._px is not None:
             # poisoned SHARED pages strike every reader (ISSUE 12): the
@@ -1149,6 +1330,7 @@ class ContinuousBatcher:
                 r = self.slot_req[j]
                 self._px.release(j)
                 self.slot_req[j] = None
+                self._chunk.pop(j, None)
                 self.struck.append((
                     r.uid, f"shared prefix page struck: {reason}"
                 ))
@@ -1177,21 +1359,24 @@ class ContinuousBatcher:
         self._admit()
         if self.idle:
             return
+        # chunked-prefill scheduling (ISSUE 18): each parked slot gets ONE
+        # bounded ranged chunk per step, interleaved with the decode step
+        # below — decode rows never mix across the batch dim, so the
+        # chunk passes leave every neighbor's stream byte-identical
+        for i in sorted(self._chunk):
+            req = self.slot_req[i]
+            if req is None:           # struck/poisoned mid-flight
+                self._chunk.pop(i, None)
+                continue
+            lo = self._chunk[i]
+            hi = min(lo + self.prefill_chunk_tokens, len(req.prompt))
+            if hi < len(req.prompt):
+                self._chunk[i] = hi
+            else:
+                del self._chunk[i]    # final chunk: _ranged_pass admits
+            self._ranged_pass(i, req, lo, hi)
         if self._px is not None and self._px_dirty:
-            # push the host-managed block table (admissions repointed rows
-            # at shared chains / fresh private pages, releases parked rows
-            # on scratch) — the only device-visible artifact of the whole
-            # prefix-cache layer
-            self.cache = dict(
-                self.cache,
-                block_table=jax.device_put(
-                    jnp.asarray(self._px.table),
-                    NamedSharding(
-                        self.mesh, self.spec.specs(self.cfg)["block_table"]
-                    ),
-                ),
-            )
-            self._px_dirty = False
+            self._push_px_table()
         logits, self.cache = self._step(
             self.params, self.cache,
             jnp.asarray(self.tok), jnp.asarray(self.pos),
@@ -1221,6 +1406,12 @@ class ContinuousBatcher:
         for i, req in enumerate(self.slot_req):
             if req is None:
                 continue  # idle slot decoded a dummy token; ignore
+            if i in self._chunk:
+                # parked mid-chunk: the slot's decode row was a dummy
+                # (pos = s_max — no PE owns it, nothing was written) and
+                # its garbage logits carry no health signal; its position
+                # advances via the ranged chunks, not here
+                continue
             if row_ok is not None and not row_ok[i]:
                 # poison quarantine: THIS request is evicted and typed-
                 # rejected; its neighbors' rows are untouched (see
@@ -1306,7 +1497,7 @@ def _prompt_shard(prompt, b, length, cfg):
 def prefill_cache(
     cfg, params, cache, prompt_loc, spec, s_max, slot_mask=None, pick=None
 ):
-    """Chunked prefill (call inside shard_map): run the full TP transformer
+    """Bulk prefill (call inside shard_map): run the full TP transformer
     forward over the flattened prompt shard and write every position's
     post-RoPE k/v into the decode cache in ONE pass — prompt processing at
     MXU rates instead of token-by-token (the serving-side gap between a
@@ -1429,3 +1620,102 @@ def prefill_cache(
         # restore the global batch layout the host loop schedules against
         last = jax.lax.all_gather(last, _outer_of(c), axis=0, tiled=True)
     return cache, last
+
+
+def prefill_cache_ranged(
+    cfg: TransformerConfig,
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,   # [b, S] int32 — range inputs per sequence
+    pos0: jax.Array,     # [] or [b] int32 — first range position
+    *,
+    spec: KVCacheSpec | PagedKVCacheSpec,
+    fd_config: FlashDecodeConfig | None = None,
+    interpret: Any = None,
+) -> tuple[jax.Array, dict]:
+    """Suffix-only RANGED prefill (call inside ``jax.shard_map``): run the
+    transformer forward over a prompt RANGE ``[pos0, pos0+S)`` per
+    sequence, attending to ALREADY-LANDED KV below the range — exact
+    causal masking across the range boundary rides the per-row prefix
+    lengths of the ranged flash entries
+    (``ops.flash_decode.flash_ranged_prefill_distributed`` and its paged
+    twin, via ``spec.update_multi_and_attend``). Returns ``(logits
+    [b, S, vocab], new_cache)`` — row i's logits are the next-token
+    distribution after inputs ``..., tokens[:, i]``, exactly what S
+    successive ``decode_step`` calls would produce (bit-identical: pinned
+    in tests/test_ranged_prefill.py), at ONE cache/weight pass.
+
+    This is the primitive ROADMAP #2 queued three subsystems behind: a
+    prefix-cache trie hit feeds only the divergent suffix (the shared
+    pages' KV is the "already landed" prior), chunked-prefill scheduling
+    feeds bounded consecutive ranges interleaved with decode steps, and
+    the speculative verify step (``models.speculative.verify_step``,
+    which delegates here) is the S-draft-token instance. Composing
+    consecutive ranges equals one whole-range pass bit for bit — every
+    row's causal mask names the same global prefix either way.
+
+    Cache layouts dispatch through ``spec.update_multi_and_attend``
+    (contiguous, or paged with a static table — the paged spec raises on
+    the runtime bump allocator, which cannot batch-claim a range).
+    Hierarchical deployments (``cfg.ep_outer``) run DP attention per
+    outer group exactly as in ``decode_step``; the logits re-gather to
+    the global layout."""
+    n_o, my_o = _outer_dims(cfg)
+    if cfg.batch % n_o:
+        raise ValueError(
+            f"batch={cfg.batch} must divide over the {n_o} outer groups"
+        )
+    b_att = cfg.batch // n_o
+    c = dataclasses.replace(cfg, batch=b_att) if n_o > 1 else cfg
+    n = _axis_size(c.axis)
+    me = jax.lax.axis_index(c.axis)
+    g = c.n_q_heads // c.n_kv_heads
+    d = c.head_dim
+    assert c.n_kv_heads % n == 0, (c.n_kv_heads, n)
+    S = tokens.shape[1]
+    pos0_g = jnp.broadcast_to(jnp.asarray(pos0, jnp.int32), (cfg.batch,))
+    if n_o > 1:
+        tokens = jax.lax.dynamic_slice_in_dim(tokens, my_o * b_att, b_att, 0)
+        pos0_b = jax.lax.dynamic_slice_in_dim(pos0_g, my_o * b_att, b_att, 0)
+    else:
+        pos0_b = pos0_g
+    b = b_att
+    m = b * S
+    pos_flat = (pos0_b[:, None] + jnp.arange(S, dtype=jnp.int32)).reshape(-1)
+
+    x = params["embed"][tokens.reshape(-1)]                # [m, H] b-major
+    for li, p in enumerate(params["layers"]):
+        h = rmsnorm(x, p["attn_norm"], c.norm_eps)
+        qkv_loc = h @ p["wqkv"].reshape(c.hidden, -1)      # [m, qkv/n]
+        qkv = jax.lax.all_gather(qkv_loc, c.axis, axis=1, tiled=True)
+        qkv = qkv.reshape(m, c.n_kv_heads, g + 2, d)
+        q = qkv[:, :, :g, :].reshape(m, 1, c.n_q_heads, d)
+        k_new = qkv[:, :, g, :].reshape(m, 1, c.n_kv_heads, d)
+        v_new = qkv[:, :, g + 1, :]                        # [m, h_kv, d]
+        rope_b = jax.vmap(lambda xi, pi: rope(xi, pi, c.rope_theta))
+        q = rope_b(q, pos_flat[:, None])[:, 0]             # [m, hq, d]
+        k_new = rope_b(k_new, pos_flat[:, None])[:, 0]     # [m, h_kv, d]
+
+        attn, cache = spec.update_multi_and_attend(
+            c, cache, li,
+            k_new.reshape(b, S, c.n_kv_heads, d),
+            v_new.reshape(b, S, c.n_kv_heads, d),
+            q.reshape(b, S, c.n_q_heads, d),
+            pos0_b, me, n, fd_config, interpret,
+        )                                                  # [b, S, hq, d]
+        attn_loc = jax.lax.dynamic_slice_in_dim(
+            attn.reshape(m, c.n_q_heads, d),
+            me * (c.n_q_heads // n), c.n_q_heads // n, axis=1,
+        ).reshape(m, -1).astype(x.dtype)
+        x = x + jax.lax.psum(attn_loc @ p["wo"], c.axis)
+        x = _decode_mlp(c, x, p, me, n, n_o, interpret)
+
+    x = rmsnorm(x, params["final_norm"], c.norm_eps)
+    logits_loc = x @ params["lm_head"]                     # [m, V/n]
+    logits = jax.lax.all_gather(logits_loc, c.axis, axis=1, tiled=True)
+    logits = logits.reshape(b, S, c.vocab)
+    if n_o > 1:
+        logits = jax.lax.all_gather(
+            logits, _outer_of(cfg), axis=0, tiled=True
+        )
+    return logits, cache

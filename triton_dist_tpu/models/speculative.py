@@ -45,22 +45,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from triton_dist_tpu.models.decode import (
     KVCacheSpec,
     PagedKVCacheSpec,
-    _decode_mlp,
     _mesh_outer,
-    _outer_dims,
-    _outer_of,
     _prompt_shard,
     decode_step,
     prefill_cache,
+    prefill_cache_ranged,
     specs_for,
 )
-from triton_dist_tpu.models.tp_transformer import (
-    TransformerConfig,
-    rmsnorm,
-    rope,
-)
+from triton_dist_tpu.models.tp_transformer import TransformerConfig
 from triton_dist_tpu.ops.flash_decode import FlashDecodeConfig
-from triton_dist_tpu.utils import axis_size as _axis_size
 
 
 def verify_step(
@@ -80,72 +73,16 @@ def verify_step(
     after inputs ``tokens[:, :i+1]``, exactly what S successive
     decode_steps would produce, at one cache/weight pass. The chunk's k/v
     are appended (owner-gated per position) before attention; causality
-    within the chunk rides the per-row prefix lengths."""
-    # cache layouts dispatch through spec.update_multi_and_attend
-    # (contiguous, or paged with a static table — the paged spec raises
-    # on the runtime bump allocator, which cannot batch-claim a chunk)
-    # hierarchical deployment: DP attention per outer group exactly as in
-    # decode_step — the group's batch slice, then the EP MLP spans the
-    # mesh and the logits re-gather to the global layout
-    n_o, my_o = _outer_dims(cfg)
-    if cfg.batch % n_o:
-        raise ValueError(
-            f"batch={cfg.batch} must divide over the {n_o} outer groups"
-        )
-    b_att = cfg.batch // n_o
-    c = dataclasses.replace(cfg, batch=b_att) if n_o > 1 else cfg
-    n = _axis_size(c.axis)
-    me = jax.lax.axis_index(c.axis)
-    g = c.n_q_heads // c.n_kv_heads
-    d = c.head_dim
-    assert c.n_kv_heads % n == 0, (c.n_kv_heads, n)
-    S = tokens.shape[1]
-    pos0_g = jnp.broadcast_to(jnp.asarray(pos0, jnp.int32), (cfg.batch,))
-    if n_o > 1:
-        tokens = jax.lax.dynamic_slice_in_dim(tokens, my_o * b_att, b_att, 0)
-        pos0_b = jax.lax.dynamic_slice_in_dim(pos0_g, my_o * b_att, b_att, 0)
-    else:
-        pos0_b = pos0_g
-    b = b_att
-    m = b * S
-    pos_flat = (pos0_b[:, None] + jnp.arange(S, dtype=jnp.int32)).reshape(-1)
+    within the chunk rides the per-row prefix lengths.
 
-    x = params["embed"][tokens.reshape(-1)]                # [m, H] b-major
-    for li, p in enumerate(params["layers"]):
-        h = rmsnorm(x, p["attn_norm"], c.norm_eps)
-        qkv_loc = h @ p["wqkv"].reshape(c.hidden, -1)      # [m, qkv/n]
-        qkv = jax.lax.all_gather(qkv_loc, c.axis, axis=1, tiled=True)
-        qkv = qkv.reshape(m, c.n_kv_heads, g + 2, d)
-        q = qkv[:, :, :g, :].reshape(m, 1, c.n_q_heads, d)
-        k_new = qkv[:, :, g, :].reshape(m, 1, c.n_kv_heads, d)
-        v_new = qkv[:, :, g + 1, :]                        # [m, h_kv, d]
-        rope_b = jax.vmap(lambda xi, pi: rope(xi, pi, c.rope_theta))
-        q = rope_b(q, pos_flat[:, None])[:, 0]             # [m, hq, d]
-        k_new = rope_b(k_new, pos_flat[:, None])[:, 0]     # [m, h_kv, d]
-
-        attn, cache = spec.update_multi_and_attend(
-            c, cache, li,
-            k_new.reshape(b, S, c.n_kv_heads, d),
-            v_new.reshape(b, S, c.n_kv_heads, d),
-            q.reshape(b, S, c.n_q_heads, d),
-            pos0_b, me, n, fd_config, interpret,
-        )                                                  # [b, S, hq, d]
-        attn_loc = jax.lax.dynamic_slice_in_dim(
-            attn.reshape(m, c.n_q_heads, d),
-            me * (c.n_q_heads // n), c.n_q_heads // n, axis=1,
-        ).reshape(m, -1).astype(x.dtype)
-        x = x + jax.lax.psum(attn_loc @ p["wo"], c.axis)
-        x = _decode_mlp(c, x, p, me, n, n_o, interpret)
-
-    x = rmsnorm(x, params["final_norm"], c.norm_eps)
-    logits_loc = x @ params["lm_head"]                     # [m, V/n]
-    logits = jax.lax.all_gather(logits_loc, c.axis, axis=1, tiled=True)
-    logits = logits.reshape(b, S, c.vocab)
-    if n_o > 1:
-        logits = jax.lax.all_gather(
-            logits, _outer_of(cfg), axis=0, tiled=True
-        )
-    return logits, cache
+    The forward itself lives in ``decode.prefill_cache_ranged`` (ISSUE
+    18): verification is the S-draft-token instance of the suffix-only
+    ranged prefill — same append, same per-row causal mask against the
+    landed prior. This entry is the stable speculative-decoding name."""
+    return prefill_cache_ranged(
+        cfg, params, cache, tokens, pos0,
+        spec=spec, fd_config=fd_config, interpret=interpret,
+    )
 
 
 def speculative_generate(

@@ -1085,6 +1085,76 @@ def paged_flash_verify_distributed(
     return merged.reshape(b, S, hq, d)
 
 
+def _ranged_local_lens(pos0, S, axis, s_shard):
+    """Per-(sequence, range-row) valid prefix in THIS PE's sequence shard
+    for a suffix-only ranged prefill: row i of the range attends global
+    positions ``<= pos0 + i`` — exact causal masking across the range
+    boundary — and this PE covers ``[me*s_shard, (me+1)*s_shard)``."""
+    me = jax.lax.axis_index(axis)
+    pos_mat = (
+        jnp.asarray(pos0, jnp.int32).reshape(-1, 1)
+        + jnp.arange(S, dtype=jnp.int32)[None, :]
+    )                                                      # [b, S]
+    return jnp.clip(pos_mat + 1 - me * s_shard, 0, s_shard).astype(jnp.int32)
+
+
+def flash_ranged_prefill_distributed(
+    q: jax.Array,
+    k_shard: jax.Array,
+    v_shard: jax.Array,
+    pos0: jax.Array,
+    *,
+    axis: str = "tp",
+    config: FlashDecodeConfig | None = None,
+    ag_method: str = "full_mesh_push",
+    interpret: Any = None,
+) -> jax.Array:
+    """Suffix-only RANGED prefill over a contiguous SP cache (call inside
+    ``jax.shard_map``) — the flash family's attend-to-prior-cache prefill
+    (ROADMAP #2): q carries a prompt RANGE's rows ``[b, S, q_heads, d]``
+    at global positions ``pos0 .. pos0+S-1`` whose own k/v are ALREADY
+    WRITTEN into the shard; row i attends every landed position
+    ``<= pos0+i``. The per-row prefix lengths are derived from ``pos0``
+    here and the multi-position verify attention runs unchanged, so
+    composing consecutive ranges is bit-identical to one whole-prompt
+    pass: every row's mask names the same global prefix either way."""
+    S = q.shape[1]
+    lens = _ranged_local_lens(pos0, S, axis, k_shard.shape[2])
+    return flash_verify_distributed(
+        q, k_shard, v_shard, lens,
+        axis=axis, config=config, ag_method=ag_method, interpret=interpret,
+    )
+
+
+def paged_flash_ranged_prefill_distributed(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    pos0: jax.Array,
+    block_table: jax.Array,
+    *,
+    axis: str = "tp",
+    fuse_heads: bool | None = None,
+    pages_per_step: int | None = None,
+    soft_cap: float = 0.0,
+    ag_method: str = "full_mesh_push",
+    interpret: Any = None,
+) -> jax.Array:
+    """Paged twin of :func:`flash_ranged_prefill_distributed`: the same
+    suffix-only ranged prefill over each PE's page POOL, with the range's
+    prior pages named by ``block_table`` (the reference's block-table
+    indirection) — per-row lengths from ``pos0``, then the paged
+    multi-position verify."""
+    S = q.shape[1]
+    s_shard = block_table.shape[1] * k_pages.shape[2]
+    lens = _ranged_local_lens(pos0, S, axis, s_shard)
+    return paged_flash_verify_distributed(
+        q, k_pages, v_pages, lens, block_table,
+        axis=axis, fuse_heads=fuse_heads, pages_per_step=pages_per_step,
+        soft_cap=soft_cap, ag_method=ag_method, interpret=interpret,
+    )
+
+
 def quantize_kv(k: jax.Array, v: jax.Array):
     """Per-(batch, head, position) absmax int8 quantization of a KV cache
     (k, v ``[b, h_kv, s, d]``) → ``(k_q, v_q, k_scale, v_scale)`` with

@@ -140,6 +140,10 @@ class SoakSpec:
     n_chunk_corruptions: int = 0
     collapse_at_step: int = 0
     handoff_chunks: int = 2
+    # ISSUE 18: decode-pool admission on FIRST-page-landed (the
+    # pipelined handoff) instead of last — same ladder, same faults,
+    # earlier admission; False keeps the historical posture
+    pipelined_handoff: bool = False
     # fleet campaign knobs (ISSUE 16): fleet_replicas > 0 runs the
     # N-replica router over disaggregated replicas (1 prefill PE + the
     # rest decode each); replica_kill_at_step > 0 storms the KILL
@@ -338,6 +342,11 @@ class SoakSpec:
             raise ValueError(
                 "chunk corruption / pool collapse are handoff faults — "
                 "set disagg_prefill_pes too"
+            )
+        if self.pipelined_handoff and not self.disagg_prefill_pes:
+            raise ValueError(
+                "pipelined_handoff gates decode-pool admission — set "
+                "disagg_prefill_pes too"
             )
         return self
 
@@ -865,6 +874,7 @@ def _run_disagg_campaign(spec: SoakSpec) -> CampaignResult:
                             chunks_per_page=spec.handoff_chunks,
                             virtual_chunk_s=0.002,
                         ),
+                        pipelined_admission=spec.pipelined_handoff,
                         prefill=ServingConfig(
                             max_queue=spec.max_queue, max_step_failures=3,
                             overload=OverloadConfig(

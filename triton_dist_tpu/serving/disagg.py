@@ -249,6 +249,18 @@ class DisaggServingConfig:
                    run; new submissions take the disagg path again.
                    None (default): collapse stays terminal, byte-
                    identically.
+    pipelined_admission: ISSUE 18 — admit a delivered handoff into the
+                   decode pool at its FIRST page's landing time
+                   (``HandoffResult.page_landings[0]``) instead of the
+                   last (``t_landed``): the decode pool's suffix-only
+                   ranged prefill can start attending page 0 while
+                   later pages are still on the wire, overlapping
+                   transfer with decode-side work. Fallback outcomes
+                   (rung 3: decode-local cold re-prefill — no landed
+                   pages to pipeline over) keep the last-page gate.
+                   Host-tier only: no kv_stream signal edges change.
+                   False (default) keeps last-page-landed admission
+                   byte-identically.
     """
 
     prefill_pes: int = 1
@@ -261,6 +273,7 @@ class DisaggServingConfig:
     max_steps_idle: int = 4
     pool_probe_steps: int | None = None
     collapse_probation_steps: int | None = None
+    pipelined_admission: bool = False
 
     def validate(self) -> "DisaggServingConfig":
         if self.prefill_pes < 1:
@@ -552,6 +565,14 @@ class DisaggServingEngine:
                                          now=res.t_finished)
         st.handoff = ho
         st.t_landed = ho.t_landed
+        if (self.serving.pipelined_admission
+                and ho.outcome == "delivered" and ho.page_landings):
+            # ISSUE 18 pipelined admission: gate on the FIRST page's
+            # landing — the decode pool starts while the tail streams.
+            # st.t_landed moves with the gate so the serving:transfer
+            # span decomposition stays exact (transfer ends at
+            # admission; the overlapped tail is decode-side time).
+            st.t_landed = ho.page_landings[0]
         self.metrics.count("handoffs")
         ae = self._alert_eng()
         if ae is not None:
@@ -567,7 +588,7 @@ class DisaggServingEngine:
             self.metrics.count("handoff_fallbacks")
             st.route = "fallback"
             st.resumed += 1
-        self._push_landing(ho.t_landed, uid)
+        self._push_landing(st.t_landed, uid)
 
     def _push_landing(self, t: float, uid: Any) -> None:
         heapq.heappush(self._landings, (float(t), self._seq, uid))

@@ -108,6 +108,25 @@ class ServingConfig:
                       paged batcher (``page_size=`` in the batcher
                       kwargs). None (the default) = the pre-cache
                       engine, byte for byte.
+    prefill_chunk_tokens: chunked-prefill scheduling (ISSUE 18): prompts
+                      longer than this admit through bounded suffix-only
+                      ranged-prefill chunks interleaved with decode
+                      steps, so one long prompt cannot stall a
+                      decode-heavy batch. Needs ``prefill=True`` in the
+                      batcher kwargs. None (the default) = unchunked
+                      admission, byte for byte.
+    virtual_prefill_work_s: charge each unit of prefill WORK — a swept
+                      query×key token-pair — this much time on the
+                      engine clock, alongside ``virtual_step_s``. A bulk
+                      bucket prefill computes the dense padded
+                      bucket×bucket rectangle (mask applied after the
+                      sweep), so a 24-token prompt at bucket 32 bills
+                      1024 pairs in one step; suffix-only ranged chunks
+                      sweep only their chunk_bucket×hi strips (336 pairs
+                      for the same prompt at chunk 4) — the kernel-true
+                      cost asymmetry under which chunked admission's
+                      tail-latency win is measurable. None (default) =
+                      prefill charges nothing, as before.
     """
 
     max_queue: int = 256
@@ -120,10 +139,17 @@ class ServingConfig:
     world_ok: Any = None
     overload: OverloadConfig | None = None
     prefix_cache: PrefixCacheConfig | None = None
+    prefill_chunk_tokens: int | None = None
+    virtual_prefill_work_s: float | None = None
 
     def validate(self) -> "ServingConfig":
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.prefill_chunk_tokens is not None and self.prefill_chunk_tokens < 1:
+            raise ValueError("prefill_chunk_tokens must be >= 1 (or None)")
+        if (self.virtual_prefill_work_s is not None
+                and self.virtual_prefill_work_s < 0):
+            raise ValueError("virtual_prefill_work_s must be >= 0")
         if self.prefix_cache is not None:
             self.prefix_cache.validate()
         if self.overload is not None:
@@ -423,9 +449,16 @@ class ServingEngine:
         kw = dict(self.batcher_kw)
         if self.serving.prefix_cache is not None:
             kw["prefix_cache"] = self.serving.prefix_cache
-        return ContinuousBatcher(
+        if self.serving.prefill_chunk_tokens is not None:
+            kw["prefill_chunk_tokens"] = self.serving.prefill_chunk_tokens
+        batcher = ContinuousBatcher(
             self.cfg, self._serving_params(), mesh, s_max=self.s_max, **kw
         )
+        # a fresh batcher's prefill-work counter restarts at 0: resync the
+        # engine's charge watermark so rebuilt+replayed admissions charge
+        # their own work, not a stale delta
+        self._prefill_work_seen = 0
+        return batcher
 
     # -- submission / admission ----------------------------------------
 
@@ -593,6 +626,18 @@ class ServingEngine:
         self._failures = 0
         if self.serving.virtual_step_s:
             self.clock.sleep(self.serving.virtual_step_s)
+        if self.serving.virtual_prefill_work_s:
+            # work-proportional prefill charge (ISSUE 18): this step's
+            # swept query×key token-pairs through the MXU prefill paths
+            # (dense bucket rectangle, or ranged-chunk strips) cost time
+            # on the engine clock — the kernel-true cost model under
+            # which an unchunked long admission visibly stalls the whole
+            # batch and chunked admission both spreads AND shrinks it
+            total = self._batcher.prefill_work_total
+            delta = total - self._prefill_work_seen
+            self._prefill_work_seen = total
+            if delta > 0:
+                self.clock.sleep(delta * self.serving.virtual_prefill_work_s)
         self._observe(self.clock.monotonic())
         # alerts evaluate AFTER this step's finishes were scored and
         # BEFORE the ladder observes them (ISSUE 15): the burn-rate rule

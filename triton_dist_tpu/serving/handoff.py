@@ -136,6 +136,13 @@ class HandoffResult:
     retries: int
     restreams: int
     culprit_pe: int | None  # last attributed PE (None = clean transfer)
+    # per-logical-page FINAL landing times, sorted by page index (a page
+    # that re-streamed reports its last landing; a deduped page reports
+    # the instant the manifest skipped it). ``page_landings[0]`` is the
+    # pipelined-admission gate (ISSUE 18): the decode pool may admit the
+    # request the moment its first page lands instead of waiting for
+    # ``t_landed`` (the last). Empty only on legacy-constructed results.
+    page_landings: tuple[float, ...] = ()
 
 
 class HandoffPlane:
@@ -272,18 +279,23 @@ class HandoffPlane:
 
     def _stream_once(
         self, uid: Any, pages: list, t: float, *, force_all: bool,
-    ) -> tuple[bool, float, int, int, int, int | None]:
+    ) -> tuple[bool, float, int, int, int, int | None, dict]:
         """One streaming pass over the manifest. Returns ``(ok, t,
-        streamed, deduped, retries, culprit)`` — ``ok=False`` means some
-        chunk exhausted its in-place re-sends (the caller escalates)."""
+        streamed, deduped, retries, culprit, landings)`` — ``ok=False``
+        means some chunk exhausted its in-place re-sends (the caller
+        escalates). ``landings`` maps logical page g to the time its KV
+        finished landing this pass (deduped pages land instantly: their
+        bytes are already resident)."""
         cfg = self.cfg
         delays = cfg.retry.delays(key=f"{self.family}:{uid}")
         streamed = deduped = retries = 0
         ordinal = 0
         last_pe: int | None = None
+        landings: dict = {}
         for g, key in pages:
             if not force_all and key in self._streamed:
                 deduped += 1
+                landings[g] = t
                 continue
             for _ in range(cfg.chunks_per_page):
                 ordinal += 1
@@ -313,7 +325,8 @@ class HandoffPlane:
                         reason = "chunk signal bounded-wait timeout"
                         self._elastic.report_timeout(pe, family=self.family)
                     if attempt == cfg.retry.max_attempts - 1:
-                        return False, t, streamed, deduped, retries, pe
+                        return (False, t, streamed, deduped, retries, pe,
+                                landings)
                     self._bump("chunk_retries")
                     retries += 1
                     t += delays[attempt]
@@ -324,9 +337,10 @@ class HandoffPlane:
                     raise AssertionError
             streamed += 1
             self._streamed.add(key)
+            landings[g] = t
         # exhausted=False: a clean (or retry-absorbed) pass — the last
         # attributed culprit still rides out for the result's record
-        return True, t, streamed, deduped, retries, last_pe
+        return True, t, streamed, deduped, retries, last_pe, landings
 
     def transfer(self, uid: Any, prompt, *, now: float) -> HandoffResult:
         """Stream one finished prefill's KV pages to the decode pool
@@ -340,10 +354,15 @@ class HandoffPlane:
         restreams = 0
         tot_streamed = tot_deduped = tot_retries = 0
         culprit: int | None = None
+        landings: dict = {}
         while True:
-            ok, t, streamed, deduped, retries, pe = self._stream_once(
+            (ok, t, streamed, deduped, retries, pe,
+             pass_landings) = self._stream_once(
                 uid, pages, t, force_all=restreams > 0,
             )
+            # later passes overwrite: a re-streamed page's FINAL landing
+            # is the one the decode pool actually keeps
+            landings.update(pass_landings)
             tot_streamed += streamed
             tot_deduped += deduped
             tot_retries += retries
@@ -384,6 +403,7 @@ class HandoffPlane:
             chunks_sent=self.counters["chunks_sent"] - chunks_before,
             retries=tot_retries,
             restreams=restreams, culprit_pe=culprit,
+            page_landings=tuple(landings[g] for g in sorted(landings)),
         )
 
     def invalidate(self) -> None:

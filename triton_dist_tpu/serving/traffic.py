@@ -144,7 +144,19 @@ class TrafficSpec:
     seed-derived PRNG stream (the priority/deadline discipline): an
     unchanged spec keeps its historical fingerprint, and setting the
     client fields changes neither arrival times nor prompts — pinned in
-    tests/test_fleet.py."""
+    tests/test_fleet.py.
+
+    ``long_prompt_frac`` / ``long_prompt_len`` (ISSUE 18) inject the
+    heavy-tail prompt mix chunked prefill exists for: each request is
+    independently long with probability ``long_prompt_frac``, and a long
+    request's BASE prompt (before any ``prefix_pool`` prepend) is
+    replaced by one drawn from ``long_prompt_len``. All long-prompt
+    draws come from their OWN seed-derived PRNG stream and the main
+    stream's draws are still consumed, so: an unset spec keeps its
+    historical ``trace_fingerprint`` byte-identically, and an armed
+    spec's NON-long requests keep the exact arrival times and prompts
+    they had unarmed (only the replaced prompts differ) — pinned in
+    tests/test_ranged_prefill.py."""
 
     rate_rps: float
     n_requests: int
@@ -169,6 +181,8 @@ class TrafficSpec:
     prefix_share: float = 1.0
     client_pool: int | None = None
     client_zipf: float = 1.2
+    long_prompt_frac: float | None = None
+    long_prompt_len: tuple | None = None
 
     def validate(self) -> "TrafficSpec":
         if self.rate_rps <= 0:
@@ -231,6 +245,22 @@ class TrafficSpec:
                 raise ValueError(
                     f"client_zipf must be > 0, got {self.client_zipf}"
                 )
+        if self.long_prompt_frac is not None:
+            if not 0.0 < self.long_prompt_frac <= 1.0:
+                raise ValueError(
+                    f"long_prompt_frac must be in (0, 1], got "
+                    f"{self.long_prompt_frac}"
+                )
+            if self.long_prompt_len is None:
+                raise ValueError(
+                    "long_prompt_frac needs long_prompt_len (the tagged "
+                    "length distribution long prompts draw from)"
+                )
+            _validate_dist("long_prompt_len", self.long_prompt_len)
+        elif self.long_prompt_len is not None:
+            raise ValueError(
+                "long_prompt_len needs long_prompt_frac to arm it"
+            )
         return self
 
 
@@ -274,6 +304,10 @@ def generate_trace(spec: TrafficSpec) -> tuple[Arrival, ...]:
             1, spec.client_pool + 1, dtype=np.float64
         ) ** float(spec.client_zipf)
         client_w /= client_w.sum()
+    # long-prompt draws (ISSUE 18) on a FIFTH stream: each request's
+    # (long?, length, tokens) triple when armed — unset specs never touch
+    # it, so their historical fingerprints hold
+    rng_lp = np.random.default_rng([int(spec.seed), 0x10BF6C])
     out = []
     t = float(spec.start_s)
     burst_rate = spec.burst_rate_rps or 10.0 * spec.rate_rps
@@ -298,6 +332,18 @@ def generate_trace(spec: TrafficSpec) -> tuple[Arrival, ...]:
         p_len = sample_length(spec.prompt_len, rng)
         o_len = sample_length(spec.output_len, rng)
         prompt = [int(x) for x in rng.integers(0, spec.vocab, p_len)]
+        if spec.long_prompt_frac is not None:
+            # fixed two-draw cadence (the overload-stream discipline);
+            # the main stream's p_len/prompt draws above were still
+            # consumed, so NON-long requests are byte-identical to the
+            # unarmed spec's. The replacement happens BEFORE any prefix
+            # prepend: a long request can still share a system prompt.
+            is_long = float(rng_lp.random()) < spec.long_prompt_frac
+            lp_len = sample_length(spec.long_prompt_len, rng_lp)
+            if is_long:
+                prompt = [int(x) for x in rng_lp.integers(
+                    0, spec.vocab, lp_len
+                )]
         if prefixes is not None:
             # fixed two-draw cadence per request keeps the stream aligned
             # whatever the outcomes
