@@ -460,6 +460,43 @@ def bench_flash_decode_int8(mesh, n):
     )
 
 
+def bench_flash_decode_fp8(mesh, n):
+    """fp8-KV decode (ISSUE 19): float8_e4m3 cache + per-row f32 scales —
+    the int8 twin one byte-format lower. Info lines only (no
+    vs_baseline): the fp8 floor story starts at the next chip session;
+    these rows exist so it measures for free."""
+    from triton_dist_tpu.ops.flash_decode import (
+        FlashDecodeConfig, _xla_decode, flash_decode_fp8, quantize_kv_fp8,
+    )
+
+    s = _sc(8192)
+    b, hq, h_kv, d, q, k, v, kv_lens = _decode_case(s)
+    k_q, v_q, ks, vs = quantize_kv_fp8(k, v)
+    cfg = FlashDecodeConfig(block_s=2048, fuse_heads=True)
+
+    # k/v as parameters, not closures — see bench_flash_decode_paged
+    fused = lambda q, k_q, v_q, k, v: flash_decode_fp8(
+        q, k_q, v_q, ks, vs, kv_lens, config=cfg
+    )
+
+    @jax.jit
+    def xla_bf16(q, k_q, v_q, k, v):
+        del k_q, v_q
+        return _xla_decode(q, k, v, kv_lens, return_lse=False)
+
+    out = fused(q, k_q, v_q, k, v)
+    ref = xla_bf16(q, k_q, v_q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=1.5e-1, rtol=1.5e-1
+    )
+    t_f, t_b, ratio = bench_pair(
+        fused, xla_bf16, (q, k_q, v_q, k, v), iters=_it(_it(1500))
+    )
+    tag = f"b{b}hq{hq}kv{h_kv}s{s}"
+    emit_info(f"flash_decode_fp8_us_{tag}", t_f * 1e3, "us")
+    emit_info(f"flash_decode_fp8_vs_bf16_{tag}", ratio, "x")
+
+
 def bench_moe(mesh, n):
     """Mixtral-8x7B-class MoE TP MLP (E=8, topk=2, hidden=4096, ffn=14336):
     the single-kernel overlapped AG-GroupGEMM → MoE-Reduce-RS pair vs the
@@ -720,6 +757,111 @@ def _bench_moe_w8_fused(mesh, n, m_tok, h_dim, f_dim, n_exp, topk):
     tag = f"tp{n}_m{m_tok}e{n_exp}k{topk}h{h_dim}f{f_dim}"
     emit_info(f"moe_w8_fused_pipeline_ms_{tag}", t8, "ms")
     emit_info(f"moe_w8_fused_vs_bf16_{tag}", ratio, "x")
+
+
+def bench_moe_fp8(mesh, n):
+    """Decode-shaped MoE grouped GEMM with fp8_e4m3 expert weights
+    (ISSUE 19): the second scaled operand format, one rung below w8 on
+    the same weight-bound argument. Info lines only (no vs_baseline) —
+    the rows ride next to moe_w8_* so the next chip session measures fp8
+    for free, and stay byte-stable on the fixed seeds."""
+    import dataclasses as dc
+
+    from triton_dist_tpu.ops.grads import tp_moe_mlp_op
+    from triton_dist_tpu.ops.group_gemm import (
+        GroupGemmConfig, group_gemm, group_gemm_fp8,
+        quantize_expert_weights_fp8,
+    )
+    from triton_dist_tpu.ops.moe_utils import (
+        moe_align_block_size, select_experts,
+    )
+
+    m_tok, h_dim, f_dim, n_exp, topk = 256, _sc(4096), _sc(14336), 8, 2
+    bm = 128
+    kx, kw, kl = jax.random.split(jax.random.PRNGKey(7), 3)
+    tw, ids = select_experts(
+        jax.random.normal(kl, (m_tok, n_exp), jnp.float32), topk
+    )
+    al = moe_align_block_size(ids.reshape(-1), n_exp, bm)
+    x = jax.random.normal(kx, (m_tok, h_dim), jnp.bfloat16)
+    sti = al.sorted_token_ids
+    xs = jnp.where(
+        (sti < m_tok * topk)[:, None],
+        x[jnp.clip(sti // topk, 0, m_tok - 1)], 0,
+    )
+    w = jax.random.normal(kw, (n_exp, h_dim, f_dim), jnp.bfloat16) / 16
+    w_q, scale = quantize_expert_weights_fp8(w)
+    cfg = GroupGemmConfig(bm, 1024, 512)
+    eids = al.expert_ids
+
+    fused = lambda xs, w_q, scale, w: group_gemm_fp8(  # noqa: E731
+        xs, w_q, scale, eids, config=cfg
+    )
+
+    def bf16(xs, w_q, scale, w):
+        del w_q, scale
+        return group_gemm(xs, w, eids, config=cfg)
+
+    out = fused(xs, w_q, scale, w)
+    ref = bf16(xs, w_q, scale, w)
+    np.testing.assert_allclose(
+        np.asarray(out[:64], np.float32), np.asarray(ref[:64], np.float32),
+        atol=0.5, rtol=8e-2,
+    )
+    t_f, t_b, ratio = bench_pair(
+        fused, bf16, (xs, w_q, scale, w), iters=_it(200)
+    )
+    tag = f"m{m_tok}e{n_exp}k{topk}h{h_dim}f{f_dim}"
+    emit_info(f"moe_fp8_decode_gemm_ms_{tag}", t_f, "ms")
+    emit_info(f"moe_fp8_decode_gemm_vs_bf16_{tag}", ratio, "x")
+
+    # fused-overlap fp8 A/B — the GroupGemmConfig.fp8 axis through the
+    # overlapped pipeline, best-effort like the w8 twin
+    if n > 1:
+        try:
+            f_pipe = (f_dim // n) * n
+            kx2, kw2, kl2 = jax.random.split(jax.random.PRNGKey(9), 3)
+            tw2, ids2 = select_experts(
+                jax.random.normal(kl2, (m_tok, n_exp), jnp.float32), topk
+            )
+            x2 = jax.device_put(
+                jax.random.normal(kx2, (m_tok, h_dim), jnp.bfloat16),
+                NamedSharding(mesh, P("tp", None)),
+            )
+            ku2, kd2 = jax.random.split(kw2)
+            w_up = jax.random.normal(
+                ku2, (n_exp, h_dim, f_pipe), jnp.bfloat16) / 16
+            w_down = jax.random.normal(
+                kd2, (n_exp, f_pipe, h_dim), jnp.bfloat16) / 16
+            base_cfg = (
+                GroupGemmConfig(8, 32, 32) if _CPU_FALLBACK
+                else GroupGemmConfig(128, 1024, 512)
+            )
+            fp8_cfg = dc.replace(base_cfg, fp8=True)
+            fused_f8 = lambda x, wu, wd, i, t: tp_moe_mlp_op(  # noqa: E731
+                x, wu, wd, i, t, mesh, overlap=True, config=fp8_cfg
+            )
+            fused_bf = lambda x, wu, wd, i, t: tp_moe_mlp_op(  # noqa: E731
+                x, wu, wd, i, t, mesh, overlap=True, config=base_cfg
+            )
+            args = (x2, w_up, w_down, ids2, tw2)
+            out8 = fused_f8(*args)
+            outb = fused_bf(*args)
+            np.testing.assert_allclose(
+                np.asarray(out8[:32], np.float32),
+                np.asarray(outb[:32], np.float32),
+                atol=0.5, rtol=8e-2,
+            )
+            t8, tb, ratio = bench_pair(fused_f8, fused_bf, args,
+                                       iters=_it(64))
+            ptag = f"tp{n}_m{m_tok}e{n_exp}k{topk}h{h_dim}f{f_dim}"
+            emit_info(f"moe_fp8_fused_pipeline_ms_{ptag}", t8, "ms")
+            emit_info(f"moe_fp8_fused_vs_bf16_{ptag}", ratio, "x")
+        except Exception as e:  # noqa: BLE001 — attribution is optional
+            import sys
+
+            print(f"[bench moe_fp8] fused-overlap A/B skipped: {e!r:.200}",
+                  file=sys.stderr, flush=True)
 
 
 def bench_ag_gemm(mesh, n):
@@ -1143,6 +1285,13 @@ def _run_serving(argv) -> None:
                 handoff=HandoffConfig(page_tokens=4, chunks_per_page=2,
                                       virtual_chunk_s=0.001),
             )),
+            # ISSUE 19: the same two-pool split on the fp8 handoff wire —
+            # serving_*_fp8_wire rows next to the int8-wire _dg_split arm
+            ("_dg_fp8_wire", DisaggServingConfig(
+                prefill_pes=2,
+                handoff=HandoffConfig(page_tokens=4, chunks_per_page=2,
+                                      virtual_chunk_s=0.001, wire="fp8"),
+            )),
         ):
             dg_rows = sbench.sweep_offered_load(
                 dg_cfg, dg_params, mesh4, s_max=32, rates=rates,
@@ -1267,13 +1416,16 @@ _METRICS = {
     "flash_decode": bench_flash_decode,
     "flash_decode_paged": bench_flash_decode_paged,
     "flash_decode_int8": bench_flash_decode_int8,
+    "flash_decode_fp8": bench_flash_decode_fp8,
     "moe": bench_moe,
     "moe_w8": bench_moe_w8,
+    "moe_fp8": bench_moe_fp8,
     "ag_gemm": bench_ag_gemm,
 }
 _EXEC_ORDER = (
     "ag_gemm", "gemm_rs", "all_to_all", "flash_decode",
-    "flash_decode_paged", "flash_decode_int8", "moe", "moe_w8",
+    "flash_decode_paged", "flash_decode_int8", "flash_decode_fp8",
+    "moe", "moe_w8", "moe_fp8",
 )
 _FLAGSHIP = _EXEC_ORDER[0]  # runs first (healthiest chip), EMITTED last
 _METRIC_TIMEOUT_S = int(os.environ.get("TDT_BENCH_METRIC_TIMEOUT", "1500"))

@@ -131,6 +131,16 @@
 # (resilience/soak.py SoakSpec.disagg(pipelined_handoff=True); the full
 # set rides scripts/chaos_soak.py).
 #
+# Since ISSUE 19 the matrix also covers the FP8 cells
+# (tests/test_fp8.py): the brownout3 rung — a two-stage precision
+# downshift (w8 then fp8) driven through the rebuild+replay machinery —
+# must climb AND revert with zero lost requests and a bit-identical
+# seeded replay, and a corrupt KV chunk on the fp8 handoff wire must
+# walk the same guard ladder as int8 (the wire format changes the
+# payload bytes, never the integrity protocol). The static lint also
+# proves the fp8 tune tuples (the w8 twins' exact slot structure) at
+# worlds {2, 4, 8}.
+#
 # Every cell runs under a wall-clock budget (TDT_CELL_TIMEOUT_S,
 # default 600 s; conftest.py delivers it as a SIGALRM inside the cell):
 # a hung cell reports as one named FAILED row — and so fails the exit
@@ -157,7 +167,8 @@ files="tests/test_chaos.py tests/test_elastic.py \
     tests/test_obs.py tests/test_analysis.py tests/test_overload.py \
     tests/test_prefix_cache.py tests/test_disagg.py tests/test_synth.py \
     tests/test_flight_recorder.py tests/test_fleet.py \
-    tests/test_recovery.py tests/test_ranged_prefill.py"
+    tests/test_recovery.py tests/test_ranged_prefill.py \
+    tests/test_fp8.py"
 marker="chaos"
 lint_args=""
 if [ "${1:-}" = "--quick" ]; then
@@ -167,7 +178,7 @@ if [ "${1:-}" = "--quick" ]; then
         tests/test_prefix_cache.py tests/test_disagg.py \
         tests/test_synth.py tests/test_flight_recorder.py \
         tests/test_fleet.py tests/test_recovery.py \
-        tests/test_ranged_prefill.py"
+        tests/test_ranged_prefill.py tests/test_fp8.py"
     marker="chaos and not slow"
     # keep the quick posture bounded: worlds {2,4} (the full {2,4,8}
     # sweep is the default standalone run's job)

@@ -88,7 +88,9 @@ def _case_ids():
 
 
 def _w8_like(cfg):
-    return getattr(cfg, "w8", False)
+    # both scaled operand formats ride the same pruning hook; only bf16
+    # candidates carry the never-pruned guarantee
+    return getattr(cfg, "w8", False) or getattr(cfg, "fp8", False)
 
 
 # ---------------------------------------------------------------------------
@@ -115,9 +117,16 @@ def test_w8_tune_space_ordering():
         assert not space[0].ragged and space[0].chunks_per_shard == 1
         for i, c in enumerate(space):
             if _w8_like(c):
-                twin = dataclasses.replace(c, w8=False)
+                twin = dataclasses.replace(c, w8=False, fp8=False)
                 assert twin in space[:i], (
                     f"w8 candidate {c} has no earlier bf16 twin"
+                )
+            if getattr(c, "fp8", False):
+                # ISSUE 19: fp8 sits strictly after its w8 twin too —
+                # the admission order is legacy < w8 < fp8
+                twin = dataclasses.replace(c, w8=True, fp8=False)
+                assert twin in space[:i], (
+                    f"fp8 candidate {c} has no earlier w8 twin"
                 )
             if c.ragged:
                 # PR 5's invariant survives the w8 extension

@@ -82,6 +82,30 @@ def test_tune_space_lists_legacy_first_synth_strictly_after(family):
 
 
 @pytest.mark.parametrize("family", FAMILIES)
+def test_tune_space_admission_order_legacy_w8_fp8(family):
+    """ISSUE 19: within the pre-synth prefix the operand formats admit in
+    strict order — every w8 candidate after its bf16 twin, every fp8
+    candidate after BOTH its bf16 and its w8 twin (legacy < w8 < fp8), so
+    a sweep-free walk meets proven formats before speculative ones."""
+    import dataclasses
+
+    space = _tune_space(family)
+    assert any(getattr(c, "fp8", False) for c in space), (
+        f"{family}: the fp8 axis must be swept"
+    )
+    for i, c in enumerate(space):
+        if getattr(c, "fp8", False):
+            assert not c.w8, "fp8 tuples never set w8 (exclusive formats)"
+            bf16 = dataclasses.replace(c, w8=False, fp8=False)
+            w8 = dataclasses.replace(c, w8=True, fp8=False)
+            assert bf16 in space[:i], f"fp8 {c} admitted before its bf16 twin"
+            assert w8 in space[:i], f"fp8 {c} admitted before its w8 twin"
+        elif getattr(c, "w8", False):
+            bf16 = dataclasses.replace(c, w8=False)
+            assert bf16 in space[:i], f"w8 {c} admitted before its bf16 twin"
+
+
+@pytest.mark.parametrize("family", FAMILIES)
 def test_live_admission_appends_never_reorders(family):
     """admit.extend_tune_space appends only; re-admitting a standing
     candidate (or a legacy one) never duplicates or moves it."""

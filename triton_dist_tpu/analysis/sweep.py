@@ -234,6 +234,7 @@ def _gg_label(c):
     return (
         f"bm{c.block_m}/bn{c.block_n}/c{c.chunks_per_shard}"
         + ("/ragged" if c.ragged else "") + ("/w8" if c.w8 else "")
+        + ("/fp8" if getattr(c, "fp8", False) else "")
         # synthesized span policies (ISSUE 14) are distinct tuples: the
         # label must separate them from their contig twins
         + (f"/{pol}" if pol != "contig" else "")
@@ -341,8 +342,10 @@ def _kv_build(world, cfg):
 
     # 16 rows: the largest chunk count in the space gets real multi-row
     # spans; 8 columns stand in for page_size * head_dim
-    if cfg.wire == "int8":
-        payload = jnp.ones((16, 8), jnp.int8)
+    if cfg.wire in ks.QUANT_WIRES:
+        payload = jnp.ones(
+            (16, 8), ks.FP8_WIRE_DTYPE if cfg.wire == "fp8" else jnp.int8
+        )
         scales = jnp.ones((16, 1), jnp.float32)
 
         def make_fn(rank):

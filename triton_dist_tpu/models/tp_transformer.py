@@ -303,22 +303,34 @@ def moe_param_specs(cfg: MoETransformerConfig) -> dict:
     return specs
 
 
-def quantize_moe_serving_params(params: dict) -> dict:
-    """int8-quantize every layer's expert banks for SERVING (weight-only
-    PTQ, per-(expert, out-column) scales — ``ops.quantize_expert_weights``):
-    replaces ``w_up``/``w_down`` with int8 pools and adds
-    ``w_up_scale``/``w_down_scale``. Halves the expert-weight HBM stream
-    that decode-shaped MoE is bound by; the model detects the quantized
-    keys and dequantizes appropriately per path (post-matmul scale on the
-    decode einsums; explicit dequant on the compute-bound prefill).
-    Returns a NEW params tree; specs via :func:`moe_quantized_param_specs`."""
-    from triton_dist_tpu.ops.group_gemm import quantize_expert_weights
+def quantize_moe_serving_params(params: dict, fmt: str = "int8") -> dict:
+    """Quantize every layer's expert banks for SERVING (weight-only PTQ,
+    per-(expert, out-column) scales): replaces ``w_up``/``w_down`` with
+    quantized pools and adds ``w_up_scale``/``w_down_scale``.
+    ``fmt="int8"`` (``ops.quantize_expert_weights``) halves the
+    expert-weight HBM stream that decode-shaped MoE is bound by;
+    ``fmt="fp8"`` (``ops.quantize_expert_weights_fp8``, ISSUE 19) quarters
+    it on fp8-rate hardware via float8_e4m3 slabs. The model detects the
+    quantized keys and dequantizes appropriately per path (post-matmul
+    scale on the decode einsums; explicit dequant on the compute-bound
+    prefill). Returns a NEW params tree; specs via
+    :func:`moe_quantized_param_specs` (scale shapes match across formats)."""
+    from triton_dist_tpu.ops.group_gemm import (
+        quantize_expert_weights,
+        quantize_expert_weights_fp8,
+    )
 
+    if fmt not in ("int8", "fp8"):
+        raise ValueError(f"fmt must be 'int8' or 'fp8', got {fmt!r}")
+    quantize = (
+        quantize_expert_weights_fp8 if fmt == "fp8"
+        else quantize_expert_weights
+    )
     params = dict(params)
     params["layers"] = [dict(p) for p in params["layers"]]
     for p in params["layers"]:
         for name in ("w_up", "w_down"):
-            w_q, scale = quantize_expert_weights(p[name])
+            w_q, scale = quantize(p[name])
             p[name] = w_q
             p[name + "_scale"] = scale
     return params
@@ -355,12 +367,13 @@ class TPMoETransformer(TPTransformer):
         w_up, w_down = p["w_up"], p["w_down"]
         w_up_scale = w_down_scale = None
         if "w_up_scale" in p:
-            if getattr(c.gg_config, "w8", False):
-                # w8 single-pass serving (ISSUE 8 satellite — the PR 7
-                # noted follow-up): feed the pre-quantized int8 pools +
-                # scales straight through the fused pipeline's scale=
-                # operands, skipping BOTH the bf16 materialization below
-                # AND resolve_w8's per-call quantize bank read+write
+            if (getattr(c.gg_config, "w8", False)
+                    or getattr(c.gg_config, "fp8", False)):
+                # scaled-format single-pass serving (ISSUE 8 satellite,
+                # fp8 rung ISSUE 19): feed the pre-quantized int8/fp8
+                # pools + scales straight through the fused pipeline's
+                # scale= operands, skipping BOTH the bf16 materialization
+                # below AND resolve_w8's per-call quantize bank read+write
                 w_up_scale = p["w_up_scale"]
                 w_down_scale = p["w_down_scale"]
             else:

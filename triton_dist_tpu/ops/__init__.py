@@ -29,8 +29,10 @@ from triton_dist_tpu.ops.allgather_group_gemm import (
 from triton_dist_tpu.ops.group_gemm import (
     GroupGemmConfig,
     group_gemm,
+    group_gemm_fp8,
     group_gemm_w8,
     quantize_expert_weights,
+    quantize_expert_weights_fp8,
 )
 from triton_dist_tpu.ops.moe_reduce_rs import (
     moe_reduce_rs,
@@ -59,17 +61,24 @@ from triton_dist_tpu.ops.flash_decode import (
     flash_decode,
     flash_decode_distributed,
     flash_decode_op,
+    flash_decode_fp8,
+    flash_decode_fp8_distributed,
     flash_decode_quant,
     flash_decode_quant_distributed,
+    flash_ranged_prefill_fp8_distributed,
     flash_verify,
     flash_verify_distributed,
+    flash_verify_fp8,
     paged_flash_decode,
     paged_flash_decode_distributed,
+    paged_flash_decode_fp8,
     paged_flash_decode_quant,
     paged_flash_verify,
     paged_flash_verify_distributed,
     quantize_kv,
+    quantize_kv_fp8,
     quantize_kv_pages,
+    quantize_kv_pages_fp8,
 )
 # NOTE: the in-shard_map `kv_stream` entry stays module-qualified
 # (ops.kv_stream.kv_stream) — re-exporting it here would shadow the
@@ -80,6 +89,7 @@ from triton_dist_tpu.ops.kv_stream import (
     dequantize_kv_wire,
     kv_stream_op,
     quantize_kv_wire,
+    quantize_kv_wire_fp8,
 )
 from triton_dist_tpu.ops.grads import ring_attention_grad
 from triton_dist_tpu.ops.ring_attention import (

@@ -51,6 +51,7 @@ from triton_dist_tpu.ops.allgather import all_gather
 from triton_dist_tpu.ops.common import dist_pallas_call, jit_shard_map
 from triton_dist_tpu.ops.gg_pipeline import OperandFormat, make_ag_overlap_kernel
 from triton_dist_tpu.ops.group_gemm import (
+    FP8_DTYPE,
     GroupGemmConfig,
     _group_gemm_xla,
     _panel_for,
@@ -165,6 +166,10 @@ def _ag_overlap_fused(
     bm = ral.block_m
     t_pad_loc = ral.t_pad_loc
     w8 = scale is not None
+    # the operand format is keyed off the bank dtype, not the config: a
+    # float8 pool means the scale rows came from quantize_expert_weights_fp8
+    # and the slabs stream at quarter-rate HBM bytes (ISSUE 19)
+    fp8 = w8 and b.dtype == FP8_DTYPE
     bn = pick_block(n_loc, cfg.block_n)
     n_jn = n_loc // bn
     itemsize = jnp.dtype(a_srt.dtype).itemsize
@@ -191,7 +196,8 @@ def _ag_overlap_fused(
     kernel = make_ag_overlap_kernel(
         axis=axis, n=n, nb=nb, n_jn=n_jn, bn=bn, bpg=bpg, bm=bm,
         out_dtype=out_dtype, spans=spans, ragged=ragged,
-        panel=_panel_for(bm) if ragged else 0, fmt=OperandFormat(w8),
+        panel=_panel_for(bm) if ragged else 0,
+        fmt=OperandFormat(w8 and not fp8, fp8),
     )
     if len(spans) > 1:
         ring_scratch = [
@@ -406,6 +412,11 @@ AG_GROUP_GEMM_TUNE_SPACE = (
     # RMS), so only a timed sweep may crown it
     GroupGemmConfig(128, 1024, 512, w8=True),
     GroupGemmConfig(128, 1024, 512, ragged=True, w8=True),
+    # fp8 axis (ISSUE 19): fp8_e4m3 weight slabs at quarter-rate HBM bytes
+    # through the SAME slot structure as w8 — registered strictly after
+    # their w8 twins (legacy < w8 < fp8, append-only)
+    GroupGemmConfig(128, 1024, 512, fp8=True),
+    GroupGemmConfig(128, 1024, 512, ragged=True, fp8=True),
 ) + _admitted_tune_extension("ag_group_gemm")
 # ^ SYNTHESIZED schedules (ISSUE 14): the standing registry of proved
 # span policies (triton_dist_tpu/synth/admitted.py) appends STRICTLY

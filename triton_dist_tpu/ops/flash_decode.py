@@ -54,6 +54,13 @@ from triton_dist_tpu.utils import cdiv, pick_block
 
 NEG_INF = float("-inf")
 
+# fp8 KV cache (ISSUE 19): same payload dtype + absmax ceiling as the
+# weight path's fp8_e4m3 format (ops/group_gemm.py) — the kernels are
+# payload-dtype generic (the in-kernel bf16 upcast covers int8 AND fp8),
+# so fp8 only changes the quantizer and the guard/kernel names.
+FP8_KV_DTYPE = jnp.float8_e4m3fn
+_FP8_KV_MAX = 448.0
+
 
 def _scoped_vmem_limit_bytes() -> int:
     """XLA's per-kernel scoped-vmem stack limit: pipeline buffers + scratch
@@ -413,14 +420,21 @@ def _decode_call(q, k, v, scales, kv_lens, *, config, return_lse, interpret):
         if scales is not None:
             raise ValueError(
                 "block_s=0 (XLA-native) supports only the contiguous bf16 "
-                "cache; int8/paged caches need the Pallas kernel"
+                "cache; int8/fp8/paged caches need the Pallas kernel"
             )
         return _xla_decode(
             q, k, v, kv_lens.astype(jnp.int32), return_lse=return_lse,
             soft_cap=cfg.soft_cap,
         )
+    if scales is None:
+        family = "flash_decode"
+    else:
+        family = (
+            "flash_decode_fp8" if k.dtype == FP8_KV_DTYPE
+            else "flash_decode_quant"
+        )
     return resilience.guarded_call(
-        "flash_decode_quant" if scales is not None else "flash_decode",
+        family,
         lambda: _decode_call_fused(
             q, k, v, scales, kv_lens, cfg=cfg, return_lse=return_lse,
             interpret=interpret,
@@ -452,11 +466,12 @@ def _decode_call_fused(q, k, v, scales, kv_lens, *, cfg, return_lse, interpret):
         jnp.bfloat16 if scales is not None else k.dtype
     )
     args = [kv_lens.astype(jnp.int32), q4, k, v]
+    fp8 = scales is not None and k.dtype == FP8_KV_DTYPE
     if scales is None:
         kv_bytes = 2 * b * h_kv * s_len * d * k.dtype.itemsize
     else:
         args += [scales[0].astype(jnp.float32), scales[1].astype(jnp.float32)]
-        kv_bytes = 2 * b * h_kv * s_len * (d + 4)  # int8 payload + f32 scale
+        kv_bytes = 2 * b * h_kv * s_len * (d + 4)  # 1B payload + f32 scale
     cost = pl.CostEstimate(
         flops=4 * b * hq * s_len * d,
         bytes_accessed=kv_bytes,
@@ -475,7 +490,7 @@ def _decode_call_fused(q, k, v, scales, kv_lens, *, cfg, return_lse, interpret):
         if scales is None:
             name, kernel = "flash_decode_fh", _flash_decode_fused_heads_kernel
         else:
-            name = "flash_decode_fh_quant"
+            name = "flash_decode_fh_fp8" if fp8 else "flash_decode_fh_quant"
             kernel = _flash_decode_fused_heads_quant_kernel
             scale_spec = pl.BlockSpec(
                 (1, h_kv, 1, sc), lambda i, c: (i, 0, 0, c)
@@ -520,7 +535,8 @@ def _decode_call_fused(q, k, v, scales, kv_lens, *, cfg, return_lse, interpret):
     if scales is None:
         name, kernel = "flash_decode", _flash_decode_kernel
     else:
-        name, kernel = "flash_decode_quant", _flash_decode_quant_kernel
+        name = "flash_decode_fp8" if fp8 else "flash_decode_quant"
+        kernel = _flash_decode_quant_kernel
         scale_spec = pl.BlockSpec((1, 1, 1, sc), lambda i, j, c: (i, j, 0, c))
         in_specs += [scale_spec, scale_spec]
     out, lse = dist_pallas_call(
@@ -558,9 +574,9 @@ def _decode_call_fused(q, k, v, scales, kv_lens, *, cfg, return_lse, interpret):
 
 
 def _flash_verify_body(
-    max_lens_ref, lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
-    m_scr, l_scr, acc_scr, *, n_chunks: int, block_s: int, scale: float,
-    soft_cap: float = 0.0,
+    max_lens_ref, lens_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, out_ref,
+    lse_ref, m_scr, l_scr, acc_scr, *, n_chunks: int, block_s: int,
+    scale: float, soft_cap: float = 0.0,
 ):
     """Multi-position (speculative-verify) decode body: grid
     (b, h_kv, chunk) exactly like :func:`_flash_decode_body`, but the q
@@ -569,7 +585,10 @@ def _flash_verify_body(
     (``lens_ref``, VMEM). The per-sequence MAX length (SMEM) gates whole
     chunks. The S-fold wider score matmul is the point: the cache streams
     from HBM ONCE for all S draft positions, where S single-token decodes
-    would stream it S times — and the MXU sees S*g rows instead of g."""
+    would stream it S times — and the MXU sees S*g rows instead of g.
+    ``ks_ref``/``vs_ref`` are None on the plain path; when present
+    (quantized cache) the per-position row scales fold exactly as in
+    :func:`_flash_decode_body`."""
     b_i = pl.program_id(0)
     c = pl.program_id(2)
 
@@ -582,7 +601,9 @@ def _flash_verify_body(
     @pl.when(c * block_s < max_lens_ref[b_i])
     def _():
         m_scr[:], l_scr[:], acc_scr[:] = _online_softmax_step(
-            q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], None, None,
+            q_ref[0, 0], k_ref[0, 0], v_ref[0, 0],
+            None if ks_ref is None else ks_ref[0, 0],
+            None if vs_ref is None else vs_ref[0, 0],
             c * block_s, lens_ref[0, 0], scale,
             m_scr[:], l_scr[:], acc_scr[:], soft_cap,
         )
@@ -592,6 +613,20 @@ def _flash_verify_body(
         out_ref[0, 0], lse_ref[0, 0] = _finalize_softmax(
             m_scr[:], l_scr[:], acc_scr[:]
         )
+
+
+def _flash_verify_kernel(
+    max_lens_ref, lens_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
+    m_scr, l_scr, acc_scr, **kw,
+):
+    _flash_verify_body(
+        max_lens_ref, lens_ref, q_ref, k_ref, v_ref, None, None, out_ref,
+        lse_ref, m_scr, l_scr, acc_scr, **kw,
+    )
+
+
+def _flash_verify_quant_kernel(*refs, **kw):
+    _flash_verify_body(*refs, **kw)
 
 
 def _xla_verify(q, k, v, kv_lens, *, return_lse, soft_cap=0.0):
@@ -665,7 +700,8 @@ def flash_verify(
     )
 
 
-def _flash_verify_fused(q, k, v, kv_lens, *, cfg, return_lse, interpret):
+def _flash_verify_fused(q, k, v, kv_lens, *, cfg, return_lse, interpret,
+                        scales=None):
     b, S, hq, d = q.shape
     _, h_kv, s_len, _ = k.shape
     g = hq // h_kv
@@ -676,38 +712,55 @@ def _flash_verify_fused(q, k, v, kv_lens, *, cfg, return_lse, interpret):
     d_out, d = d, _kernel_head_dim(d)
     if d != d_out:
         q, k, v = (_pad_head_dim(x, d) for x in (q, k, v))
+    # quantized caches upcast in-kernel, so their q rides bf16 (the same
+    # contract as _decode_call_fused)
     q5 = (
         q.reshape(b, S, h_kv, g, d)
         .swapaxes(1, 2)
         .reshape(b, h_kv, rows, d)
-        .astype(k.dtype)
+        .astype(jnp.bfloat16 if scales is not None else k.dtype)
     )
     # per-row length column: row s*g + j masks with kv_lens[b, s]
     lens_rows = jnp.repeat(kv_lens, g, axis=1).reshape(b, 1, rows, 1)
     max_lens = jnp.max(kv_lens, axis=1)
     cost = pl.CostEstimate(
         flops=4 * b * S * hq * s_len * d,
-        bytes_accessed=2 * b * h_kv * s_len * d * k.dtype.itemsize,
+        bytes_accessed=2 * b * h_kv * s_len * (
+            (d + 4) if scales is not None else d * k.dtype.itemsize
+        ),
         transcendentals=b * S * hq * s_len,
     )
+    args = [max_lens, lens_rows, q5, k, v]
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # max_lens (chunk gate)
+        pl.BlockSpec((1, 1, rows, 1), lambda i, j, c: (i, 0, 0, 0)),
+        pl.BlockSpec((1, 1, rows, d), lambda i, j, c: (i, j, 0, 0)),
+        pl.BlockSpec((1, 1, sc, d), lambda i, j, c: (i, j, c, 0)),
+        pl.BlockSpec((1, 1, sc, d), lambda i, j, c: (i, j, c, 0)),
+    ]
+    if scales is None:
+        name, kernel = "flash_verify", _flash_verify_kernel
+    else:
+        name = (
+            "flash_verify_fp8" if k.dtype == FP8_KV_DTYPE
+            else "flash_verify_quant"
+        )
+        kernel = _flash_verify_quant_kernel
+        args += [scales[0].astype(jnp.float32), scales[1].astype(jnp.float32)]
+        scale_spec = pl.BlockSpec((1, 1, 1, sc), lambda i, j, c: (i, j, 0, c))
+        in_specs += [scale_spec, scale_spec]
     out, lse = dist_pallas_call(
         functools.partial(
-            _flash_verify_body, n_chunks=n_chunks, block_s=sc,
+            kernel, n_chunks=n_chunks, block_s=sc,
             scale=scale, soft_cap=cfg.soft_cap,
         ),
-        name="flash_verify",
+        name=name,
         grid=(b, h_kv, n_chunks),
         out_shape=(
             jax.ShapeDtypeStruct((b, h_kv, rows, d), jnp.float32),
             jax.ShapeDtypeStruct((b, h_kv, rows, 1), jnp.float32),
         ),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # max_lens (chunk gate)
-            pl.BlockSpec((1, 1, rows, 1), lambda i, j, c: (i, 0, 0, 0)),
-            pl.BlockSpec((1, 1, rows, d), lambda i, j, c: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, sc, d), lambda i, j, c: (i, j, c, 0)),
-            pl.BlockSpec((1, 1, sc, d), lambda i, j, c: (i, j, c, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, 1, rows, d), lambda i, j, c: (i, j, 0, 0)),
             pl.BlockSpec((1, 1, rows, 1), lambda i, j, c: (i, j, 0, 0)),
@@ -721,7 +774,7 @@ def _flash_verify_fused(q, k, v, kv_lens, *, cfg, return_lse, interpret):
         dimension_semantics=("parallel", "parallel", "arbitrary"),
         uses_barrier=False,
         interpret=interpret,
-    )(max_lens, lens_rows, q5, k, v)
+    )(*args)
     out = (
         out.reshape(b, h_kv, S, g, d).swapaxes(1, 2)
         .reshape(b, S, hq, d)[..., :d_out]
@@ -1225,6 +1278,139 @@ def flash_decode_quant_distributed(
     return _sp_allgather_combine(out, lse, axis, ag_method, interpret)
 
 
+def quantize_kv_fp8(k: jax.Array, v: jax.Array):
+    """fp8_e4m3 twin of :func:`quantize_kv` (ISSUE 19): per-(batch, head,
+    position) absmax rows at the e4m3 ceiling (448) instead of int8's 127,
+    same ``[b, h_kv, 1, s]`` f32 scale layout. The payload is 1 byte like
+    int8 — the traffic win over int8 is on the WIRE and weight paths; here
+    fp8 trades int8's uniform 8-bit grid for e4m3's tapered one (denser
+    near zero, where attention logits live)."""
+
+    def q1(x):
+        xf = x.astype(jnp.float32)
+        s = jnp.max(jnp.abs(xf), axis=-1) / _FP8_KV_MAX       # [b, h, s]
+        s = jnp.maximum(s, 1e-8)
+        xq = jnp.clip(xf / s[..., None], -_FP8_KV_MAX, _FP8_KV_MAX).astype(
+            FP8_KV_DTYPE
+        )
+        return xq, s[:, :, None, :]                           # [b, h, 1, s]
+
+    k_q, k_s = q1(k)
+    v_q, v_s = q1(v)
+    return k_q, v_q, k_s, v_s
+
+
+def flash_decode_fp8(
+    q: jax.Array,
+    k_q: jax.Array,
+    v_q: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    kv_lens: jax.Array,
+    *,
+    config: FlashDecodeConfig | None = None,
+    return_lse: bool = False,
+    interpret: Any = None,
+):
+    """GQA batch decode over an fp8-quantized KV cache (from
+    :func:`quantize_kv_fp8`) — the fp8 twin of :func:`flash_decode_quant`:
+    the same upcast-in-kernel shape (fp8 tiles rise to bf16 under the
+    halved DMA time, row scales fold into scores/probabilities), the same
+    q→bf16 contract, ``soft_cap`` and non-pow-2 head dims ride through."""
+    return _decode_call(
+        q, k_q, v_q, (k_scale, v_scale), kv_lens, config=config,
+        return_lse=return_lse, interpret=interpret,
+    )
+
+
+def flash_decode_fp8_distributed(
+    q: jax.Array,
+    k_q: jax.Array,
+    v_q: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    kv_lens_shard: jax.Array,
+    *,
+    axis: str = "tp",
+    config: FlashDecodeConfig | None = None,
+    ag_method: str = "full_mesh_push",
+    interpret: Any = None,
+) -> jax.Array:
+    """SP/CP decode over an fp8 KV cache: per-shard fp8 partials,
+    standard (out, lse) merge — the fp8 twin of
+    :func:`flash_decode_quant_distributed`."""
+    out, lse = flash_decode_fp8(
+        q, k_q, v_q, k_scale, v_scale, kv_lens_shard,
+        config=config, return_lse=True, interpret=interpret,
+    )
+    return _sp_allgather_combine(out, lse, axis, ag_method, interpret)
+
+
+def flash_verify_fp8(
+    q: jax.Array,
+    k_q: jax.Array,
+    v_q: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    kv_lens: jax.Array,
+    *,
+    config: FlashDecodeConfig | None = None,
+    return_lse: bool = False,
+    interpret: Any = None,
+):
+    """Multi-position verify over an fp8 KV cache — :func:`flash_verify`
+    with the decode family's quantized-cache contract (per-position row
+    scales fold in-kernel, q rides bf16). Quantized caches have no golden
+    slow path, so failures stay loud."""
+    cfg = config or FlashDecodeConfig()
+    assert q.shape[2] % k_q.shape[1] == 0, (q.shape, k_q.shape)
+    kv_lens = kv_lens.astype(jnp.int32)
+    if cfg.block_s == 0:
+        raise ValueError(
+            "block_s=0 (XLA-native) supports only the contiguous bf16 "
+            "cache; fp8 caches need the Pallas kernel"
+        )
+    return resilience.guarded_call(
+        "flash_verify_fp8",
+        lambda: _flash_verify_fused(
+            q, k_q, v_q, kv_lens, cfg=cfg, return_lse=return_lse,
+            interpret=interpret, scales=(k_scale, v_scale),
+        ),
+        None,
+    )
+
+
+def flash_ranged_prefill_fp8_distributed(
+    q: jax.Array,
+    k_q_shard: jax.Array,
+    v_q_shard: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    pos0: jax.Array,
+    *,
+    axis: str = "tp",
+    config: FlashDecodeConfig | None = None,
+    ag_method: str = "full_mesh_push",
+    interpret: Any = None,
+) -> jax.Array:
+    """fp8 twin of :func:`flash_ranged_prefill_distributed`: suffix-only
+    ranged prefill over a contiguous fp8 SP cache shard (call inside
+    ``jax.shard_map``) — per-row prefix lengths from ``pos0``, the fp8
+    multi-position verify, then the standard (out ‖ lse) merge."""
+    S = q.shape[1]
+    lens = _ranged_local_lens(pos0, S, axis, k_q_shard.shape[2])
+    out, lse = flash_verify_fp8(
+        q, k_q_shard, v_q_shard, k_scale, v_scale, lens,
+        config=config, return_lse=True, interpret=interpret,
+    )
+    b, S, hq, d = out.shape
+    merged = _sp_allgather_combine(
+        out.reshape(b * S, hq, d), lse.reshape(b * S, hq), axis, ag_method,
+        interpret,
+    )
+    return merged.reshape(b, S, hq, d)
+
+
 def _paged_flash_decode_kernel(
     kv_lens_ref, block_table_ref, q_ref, *rest,
     n_steps: int, pages_per_step: int, page_size: int,
@@ -1351,8 +1537,15 @@ def paged_flash_decode(
     """
     assert q.shape[1] % k_pages.shape[1] == 0, (q.shape, k_pages.shape)
     kv_lens = kv_lens.astype(jnp.int32)
+    if k_scales is None:
+        family = "paged_flash_decode"
+    else:
+        family = (
+            "paged_flash_decode_fp8" if k_pages.dtype == FP8_KV_DTYPE
+            else "paged_flash_decode_q"
+        )
     return resilience.guarded_call(
-        "paged_flash_decode_q" if k_scales is not None else "paged_flash_decode",
+        family,
         lambda: _paged_flash_decode_fused(
             q, k_pages, v_pages, kv_lens, block_table,
             k_scales=k_scales, v_scales=v_scales, fuse_heads=fuse_heads,
@@ -1597,6 +1790,31 @@ def paged_flash_decode_quant(
     layout — the last cell of the serving cache matrix): thin alias of
     :func:`paged_flash_decode` with the scale pools attached; argument
     order mirrors the contiguous quant entry."""
+    return paged_flash_decode(
+        q, k_pages_q, v_pages_q, kv_lens, block_table,
+        k_scales=k_scales, v_scales=v_scales, **kw,
+    )
+
+
+def quantize_kv_pages_fp8(k_pages: jax.Array, v_pages: jax.Array):
+    """fp8 twin of :func:`quantize_kv_pages` — :func:`quantize_kv_fp8`
+    applied to the page pool (one implementation, two cache layouts)."""
+    return quantize_kv_fp8(k_pages, v_pages)
+
+
+def paged_flash_decode_fp8(
+    q: jax.Array,
+    k_pages_q: jax.Array,
+    v_pages_q: jax.Array,
+    k_scales: jax.Array,
+    v_scales: jax.Array,
+    kv_lens: jax.Array,
+    block_table: jax.Array,
+    **kw,
+):
+    """fp8-pool paged decode (:func:`flash_decode_fp8` × the paged
+    layout): thin alias of :func:`paged_flash_decode` with the fp8 scale
+    pools attached; argument order mirrors the contiguous fp8 entry."""
     return paged_flash_decode(
         q, k_pages_q, v_pages_q, kv_lens, block_table,
         k_scales=k_scales, v_scales=v_scales, **kw,

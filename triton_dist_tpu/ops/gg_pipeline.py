@@ -13,12 +13,16 @@ moe_reduce_rs}.py`` are thin spec builders over it:
   (PR 5's ``pl.when``-guarded ``panel``-row dots, dead rows exact zeros);
 - **operand format** — :class:`OperandFormat`: bf16 (identity) vs w8
   (int8 B stream at half the bytes + per-(expert, out-column) f32 scale
-  fold BEFORE any ragged mask, the legacy w8-kernel ordering).
+  fold BEFORE any ragged mask, the legacy w8-kernel ordering) vs fp8
+  (ISSUE 19: fp8_e4m3 B stream — the SAME slot structure as w8, scale
+  rows riding the same local weight-prefetch chain; only the payload
+  dtype and the host-side quantizer differ, so the kernel trace is the
+  w8 trace with an fp8 B operand).
 
 Migration contract: at chunk=1 / ragged=False / bf16 every generated body
 traces the SAME statement sequence as the retired legacy kernels, so
 outputs are bit-identical — pinned by ``tests/test_emitter.py`` against
-verbatim copies of the legacy bodies. w8 adds weight-scale DMAs (local
+verbatim copies of the legacy bodies. w8/fp8 add weight-scale DMAs (local
 HBM) and NO signal edges.
 """
 
@@ -37,26 +41,43 @@ from triton_dist_tpu.utils import pick_block
 
 @dataclasses.dataclass(frozen=True)
 class OperandFormat:
-    """Weight operand-format policy. ``w8=False`` is the identity (the
+    """Weight operand-format policy. The default is the identity (the
     legacy trace, bit for bit); ``w8=True`` upcasts the int8 B tile to the
     activation dtype on the VPU under the halved DMA time and folds the
     per-(expert, out-column) scale into the f32 accumulator BEFORE any
     ragged dead-row mask (live rows match the grid w8 kernel bit for
-    bit)."""
+    bit); ``fp8=True`` (ISSUE 19) is the SAME policy over an fp8_e4m3
+    payload — the upcast/fold trace is shared verbatim (``scaled``), the
+    formats differ only in which bank dtype the host quantizer emits and
+    in the tune-tuple identity the autotuner ranks. Construction keeps
+    the historical positional form ``OperandFormat(w8)`` working."""
 
     w8: bool = False
+    fp8: bool = False
+
+    def __post_init__(self):
+        if self.w8 and self.fp8:
+            raise ValueError("OperandFormat: w8 and fp8 are exclusive")
+
+    @property
+    def scaled(self) -> bool:
+        """True when a per-(expert, out-column) scale row rides the weight
+        stream — the shared structural predicate of the w8 and fp8
+        formats (scale slots, fold sites, ref layouts)."""
+        return self.w8 or self.fp8
 
     def mxu_b(self, b_tile, a_dtype):
         """The B tile as the MXU consumes it."""
-        return b_tile.astype(a_dtype) if self.w8 else b_tile
+        return b_tile.astype(a_dtype) if self.scaled else b_tile
 
     def fold(self, acc, s_row):
         """Finalize an f32 accumulator/tile: fold the scale row (shape
-        broadcastable over rows) under w8; identity otherwise."""
-        return acc * s_row if self.w8 else acc
+        broadcastable over rows) under w8/fp8; identity otherwise."""
+        return acc * s_row if self.scaled else acc
 
 
 BF16 = OperandFormat(False)
+FP8 = OperandFormat(False, True)
 
 
 # ---------------------------------------------------------------------------
@@ -80,7 +101,7 @@ def make_group_gemm_kernel(*, n_k: int, out_dtype, act_fn=None,
         else:
             e_ref, a_ref, b_ref, *rest = refs
             v_ref = None
-        if fmt.w8:
+        if fmt.scaled:
             s_ref, o_ref, acc_ref = rest
         else:
             (o_ref, acc_ref), s_ref = rest, None
@@ -273,7 +294,7 @@ def make_ag_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int, bn: int,
     (schedule walkthrough: docs/moe_overlap.md). Single span = the legacy
     shard-granular ring bit for bit; several = the PR 4 chunk protocol (a
     gather-group DMA never prefetches across a chunk boundary); ragged =
-    panel-guarded dots (no new signal edges); ``fmt.w8`` = int8 weight
+    panel-guarded dots (no new signal edges); ``fmt.scaled`` = int8 weight
     slabs at half the bytes + a per-(expert, bn-slab) scale row on the
     SAME double-buffered prefetch chain, folded before staging.
 
@@ -289,12 +310,12 @@ def make_ag_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int, bn: int,
         vid_ref = it.pop(0) if ragged else None
         a_ref = it.pop(0)
         b_ref = it.pop(0)
-        s_ref = it.pop(0) if fmt.w8 else None
+        s_ref = it.pop(0) if fmt.scaled else None
         out_ref = it.pop(0)
         ag_ref = it.pop(0)
         a_all = it.pop(0)
         b_buf = it.pop(0)
-        s_buf = it.pop(0) if fmt.w8 else None
+        s_buf = it.pop(0) if fmt.scaled else None
         out_stage = it.pop(0)
         copy_sem = it.pop(0)
         send_sems = it.pop(0)
@@ -302,7 +323,7 @@ def make_ag_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int, bn: int,
         sig_sems = it.pop(0) if chunked else None
         gsems = it.pop(0)
         bsem = it.pop(0)
-        ssem = it.pop(0) if fmt.w8 else None
+        ssem = it.pop(0) if fmt.scaled else None
         (outsem,) = it
 
         me = shmem.my_pe(axis)
@@ -318,7 +339,7 @@ def make_ag_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int, bn: int,
                 b_ref.at[e, :, pl.ds(jn_v * bn, bn)], b_buf.at[slot],
                 bsem.at[slot],
             ).start()
-            if fmt.w8:
+            if fmt.scaled:
                 pltpu.make_async_copy(
                     s_ref.at[e, :, pl.ds(jn_v * bn, bn)], s_buf.at[slot],
                     ssem.at[slot],
@@ -330,7 +351,7 @@ def make_ag_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int, bn: int,
                 b_ref.at[e, :, pl.ds(jn_v * bn, bn)], b_buf.at[slot],
                 bsem.at[slot],
             ).wait()
-            if fmt.w8:
+            if fmt.scaled:
                 pltpu.make_async_copy(
                     s_ref.at[e, :, pl.ds(jn_v * bn, bn)], s_buf.at[slot],
                     ssem.at[slot],
@@ -464,7 +485,7 @@ def make_ag_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int, bn: int,
                             _b_start(e2, jn2v, 1 - slot)
 
                         if ragged:
-                            s_row = s_buf[slot][0] if fmt.w8 else None
+                            s_row = s_buf[slot][0] if fmt.scaled else None
                         else:
                             y = jnp.dot(
                                 a_all[gslot, pl.ds(b_rel * bm, bm), :],
@@ -472,7 +493,7 @@ def make_ag_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int, bn: int,
                                 preferred_element_type=jnp.float32,
                             )
                             y = fmt.fold(
-                                y, s_buf[slot][0] if fmt.w8 else None
+                                y, s_buf[slot][0] if fmt.scaled else None
                             )
                         # out_stage slots alternate on the GLOBAL iter
                         # count (group counts may be odd); a slot's
@@ -564,7 +585,7 @@ def make_moe_rs_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int,
     legacy whole-slab push bit for bit; several = the PR 4 chunked push on
     per-(step, slab, chunk) slots, consumed chunk by chunk; ragged = the
     panel rule on GEMM and combine both (the push schedule never consults
-    valid_rows); ``fmt.w8`` = int8 W_down slabs + scale rows on the same
+    valid_rows); ``fmt.scaled`` = int8 W_down slabs + scale rows on the same
     prefetch chain, folded before the combine consumes each tile.
 
     Ref layout: inputs ``eid, [vid], h, w, [s], dst, wrow``; outputs
@@ -580,7 +601,7 @@ def make_moe_rs_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int,
         vid_ref = it.pop(0) if ragged else None
         h_ref = it.pop(0)
         w_ref = it.pop(0)
-        s_ref = it.pop(0) if fmt.w8 else None
+        s_ref = it.pop(0) if fmt.scaled else None
         dst_ref = it.pop(0)
         wrow_ref = it.pop(0)
         out_ref = it.pop(0)
@@ -588,14 +609,14 @@ def make_moe_rs_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int,
         landing = it.pop(0)
         h_buf = it.pop(0)
         w_buf = it.pop(0)
-        s_buf = it.pop(0) if fmt.w8 else None
+        s_buf = it.pop(0) if fmt.scaled else None
         push_stage = it.pop(0)
         ids_v = it.pop(0)
         w_v = it.pop(0)
         partial_ref = it.pop(0)
         hsem = it.pop(0)
         wsem = it.pop(0)
-        ssem = it.pop(0) if fmt.w8 else None
+        ssem = it.pop(0) if fmt.scaled else None
         metasem = it.pop(0)
         if chunked:
             stage_sems, local_sem, recv_sems, sig_sems = it
@@ -617,7 +638,7 @@ def make_moe_rs_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int,
                 w_ref.at[e, :, pl.ds(jn_v * bn, bn)], w_buf.at[slot],
                 wsem.at[slot],
             ).start()
-            if fmt.w8:
+            if fmt.scaled:
                 pltpu.make_async_copy(
                     s_ref.at[e, :, pl.ds(jn_v * bn, bn)], s_buf.at[slot],
                     ssem.at[slot],
@@ -628,7 +649,7 @@ def make_moe_rs_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int,
                 w_ref.at[e, :, pl.ds(jn_v * bn, bn)], w_buf.at[slot],
                 wsem.at[slot],
             ).wait()
-            if fmt.w8:
+            if fmt.scaled:
                 pltpu.make_async_copy(
                     s_ref.at[e, :, pl.ds(jn_v * bn, bn)], s_buf.at[slot],
                     ssem.at[slot],
@@ -697,7 +718,7 @@ def make_moe_rs_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int,
                             fmt.mxu_b(w_buf[slot], cdt),
                             preferred_element_type=jnp.float32,
                         )
-                        y = fmt.fold(y, s_buf[slot][0] if fmt.w8 else None)
+                        y = fmt.fold(y, s_buf[slot][0] if fmt.scaled else None)
                         d = ids_v[b]               # [bm] destination tokens
                         w_r = w_v[b]               # [bm] routing weights
                         sel = jax.lax.broadcasted_iota(
@@ -714,7 +735,7 @@ def make_moe_rs_overlap_kernel(*, axis: str, n: int, nb: int, n_jn: int,
                         _moe_ragged_blk(
                             h_buf, w_buf, ids_v, w_v, partial_ref, hslot,
                             slot, b, vid_ref[c, b], m_out, bm, panel, cdt,
-                            fmt, s_buf[slot][0] if fmt.w8 else None,
+                            fmt, s_buf[slot][0] if fmt.scaled else None,
                         )
                     return slot
 

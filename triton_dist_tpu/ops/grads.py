@@ -255,14 +255,16 @@ def _tp_moe_forward_impl(x, w_up, w_down, topk_ids, topk_weights, axis,
             "pass both w_up_scale and w_down_scale (pre-quantized serving "
             "banks), or neither"
         )
-    if w_up_scale is not None and (
-        w_up.dtype != jnp.int8 or w_down.dtype != jnp.int8
-    ):
-        raise ValueError(
-            f"explicit scales mark the banks as int8 pools; got "
-            f"w_up {w_up.dtype}, w_down {w_down.dtype} — quantize with "
-            f"ops.quantize_expert_weights first"
-        )
+    if w_up_scale is not None:
+        from triton_dist_tpu.ops.group_gemm import FP8_DTYPE
+
+        if (w_up.dtype not in (jnp.int8, FP8_DTYPE)
+                or w_down.dtype not in (jnp.int8, FP8_DTYPE)):
+            raise ValueError(
+                f"explicit scales mark the banks as int8/fp8 pools; got "
+                f"w_up {w_up.dtype}, w_down {w_down.dtype} — quantize with "
+                f"ops.quantize_expert_weights(_fp8) first"
+            )
     from triton_dist_tpu.ops.allgather_group_gemm import (
         ag_group_gemm,
         ag_group_gemm_overlap,
@@ -423,8 +425,8 @@ def _tp_moe_bwd(axis, activation, gg_config, interpret, overlap, res, dout):
     # GEMM, the dw accumulation AND the y_sorted remat run with the axis
     # stripped, differentiating against the FULL-PRECISION residual banks
     # (straight-through — quantization's own derivative is zero a.e.).
-    if getattr(cfg, "w8", False):
-        cfg = dataclasses.replace(cfg, w8=False)
+    if getattr(cfg, "w8", False) or getattr(cfg, "fp8", False):
+        cfg = dataclasses.replace(cfg, w8=False, fp8=False)
     # pre-quantized serving banks (ISSUE 8 satellite): dequantize ONCE for
     # the straight-through backward — the int8 pools are the only residual
     # there is, and the scales are constants (zero cotangents below)
@@ -629,9 +631,9 @@ def _gg_bwd(config, out_dtype, interpret, assume_sorted, res, dout):
 
     a_sorted, b, expert_ids, valid_rows = res
     cfg = config or GroupGemmConfig()
-    # straight-through w8: grads flow through the full-precision bank
-    if getattr(cfg, "w8", False):
-        cfg = dataclasses.replace(cfg, w8=False)
+    # straight-through w8/fp8: grads flow through the full-precision bank
+    if getattr(cfg, "w8", False) or getattr(cfg, "fp8", False):
+        cfg = dataclasses.replace(cfg, w8=False, fp8=False)
     da = group_gemm(
         dout.astype(a_sorted.dtype), b.transpose(0, 2, 1), expert_ids,
         valid_rows=valid_rows, config=cfg, out_dtype=jnp.float32,
@@ -791,6 +793,13 @@ TP_MOE_TUNE_SPACE = (
     GroupGemmConfig(128, 1024, 512, w8=True),
     GroupGemmConfig(512, 1024, 512, ragged=True, w8=True),
     GroupGemmConfig(128, 1024, 512, ragged=True, w8=True),
+    # fp8 axis (ISSUE 19): fp8_e4m3 expert weights at QUARTER-rate HBM
+    # bytes through the same w8 slot structure — strictly after their w8
+    # twins (legacy < w8 < fp8, append-only; same weight-bound pruning)
+    GroupGemmConfig(512, 1024, 512, fp8=True),
+    GroupGemmConfig(128, 1024, 512, fp8=True),
+    GroupGemmConfig(512, 1024, 512, ragged=True, fp8=True),
+    GroupGemmConfig(128, 1024, 512, ragged=True, fp8=True),
     # the XLA sentinel (VERDICT r5 #1): the whole pipeline with both
     # grouped GEMMs lowered to jax.lax.ragged_dot over the same layout
     # (sequential composition — rank-major blocks aren't globally
@@ -814,6 +823,10 @@ TP_MOE_TUNE_SPACE = (
     # w8 × chunked (× ragged): strictly after the bf16 chunked twins
     GroupGemmConfig(512, 1024, 512, chunks_per_shard=2, w8=True),
     GroupGemmConfig(512, 1024, 512, chunks_per_shard=2, ragged=True, w8=True),
+    # fp8 × chunked (× ragged): strictly after the w8 chunked twins, at
+    # the very end of the chunked tail (append-only admission order)
+    GroupGemmConfig(512, 1024, 512, chunks_per_shard=2, fp8=True),
+    GroupGemmConfig(512, 1024, 512, chunks_per_shard=2, ragged=True, fp8=True),
 )
 
 def _moe_block_sensible(cfg, x, w_up, w_down, topk_ids, topk_weights,
@@ -845,10 +858,10 @@ def _moe_block_sensible(cfg, x, w_up, w_down, topk_ids, topk_weights,
     t = topk_ids.shape[0] * topk_ids.shape[1]
     if cfg.block_m > 128 and w_up.shape[0] * cfg.block_m > t // 2:
         return False
-    if getattr(cfg, "w8", False):
-        # weight-bound hook (ISSUE 7): bf16 candidates are NEVER subject
-        # to it — pruning can only remove w8 candidates, so the bf16
-        # chunk=1 leaders always survive.
+    if getattr(cfg, "w8", False) or getattr(cfg, "fp8", False):
+        # weight-bound hook (ISSUE 7/19): bf16 candidates are NEVER
+        # subject to it — pruning can only remove w8/fp8 candidates, so
+        # the bf16 chunk=1 leaders always survive.
         from triton_dist_tpu import perf_model
 
         if not perf_model.suggest_w8_overlap(t, w_up.shape[0]):

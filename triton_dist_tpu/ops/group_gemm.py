@@ -88,6 +88,22 @@ class GroupGemmConfig:
     # group_gemm and sequential compositions ignore it, like
     # chunks_per_shard.
     span_policy: str = "contig"
+    # fp8 weights (ISSUE 19): quantize the expert bank to fp8_e4m3 + the
+    # SAME per-(expert, out-column) f32 scale layout as w8 and stream the
+    # weight bytes at quarter rate through every grouped GEMM — one rung
+    # below w8 on the precision ladder, the remaining lever for the
+    # still-sub-ceiling decode-shaped weight stream. Rides the w8 slot
+    # structure verbatim (``OperandFormat.scaled``); exclusive with
+    # ``w8``. SERVING knob like w8: forward-only, every backward strips
+    # it. False = untouched. (Appended after span_policy so historical
+    # positional constructions keep their meaning.)
+    fp8: bool = False
+
+    def __post_init__(self):
+        if self.w8 and self.fp8:
+            raise ValueError(
+                "GroupGemmConfig: w8 and fp8 are exclusive operand formats"
+            )
 
 
 # The MXU row tile: live rows are quantized UP to this many before the
@@ -117,19 +133,45 @@ def quantize_expert_weights(b: jax.Array):
     return b_q, scale
 
 
+# fp8_e4m3fn: the finite-max e4m3 variant every backend ships; 448 is its
+# largest normal — the absmax maps onto it exactly as 127 does for int8.
+FP8_DTYPE = jnp.float8_e4m3fn
+_FP8_MAX = 448.0
+
+
+def quantize_expert_weights_fp8(b: jax.Array):
+    """Per-(expert, out-column) absmax fp8_e4m3 quantization of expert
+    weights ``[E, K, N]`` → ``(b_q fp8, scale f32 [E, 1, N])`` for
+    :func:`group_gemm_fp8` / ``GroupGemmConfig(fp8=True)`` — the int8
+    quantizer's exact shape with 448 (the e4m3 max normal) in 127's seat
+    and the rounding left to the dtype cast (e4m3 keeps a mantissa, so
+    nearest-even beats pre-rounding). Scale layout is identical to
+    :func:`quantize_expert_weights`, so every scale-fold site downstream
+    is shared."""
+    bf = b.astype(jnp.float32)
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(bf), axis=1, keepdims=True) / _FP8_MAX, 1e-8
+    )
+    b_q = jnp.clip(bf / scale, -_FP8_MAX, _FP8_MAX).astype(FP8_DTYPE)
+    return b_q, scale
+
+
 def resolve_w8(b: jax.Array, scale: jax.Array | None, cfg: GroupGemmConfig):
-    """The w8 config axis at an op boundary: with ``cfg.w8`` and no caller
-    scales, quantize the float bank on the fly; explicit ``scale`` (the
-    pre-quantized serving path) wins. Returns ``(b, scale)``."""
-    if scale is not None or not cfg.w8:
+    """The quantized-format config axes at an op boundary: with ``cfg.w8``
+    or ``cfg.fp8`` and no caller scales, quantize the float bank on the
+    fly; explicit ``scale`` (the pre-quantized serving path) wins.
+    Returns ``(b, scale)``."""
+    if scale is not None or not (cfg.w8 or getattr(cfg, "fp8", False)):
         return b, scale
-    if not jnp.issubdtype(b.dtype, jnp.floating):
+    fp8 = getattr(cfg, "fp8", False)
+    if not jnp.issubdtype(b.dtype, jnp.floating) or b.dtype == FP8_DTYPE:
         raise ValueError(
-            "GroupGemmConfig.w8 with an integer weight bank needs the "
-            "matching per-(expert, out-column) scale (pass scale=, from "
-            "quantize_expert_weights)"
+            f"GroupGemmConfig.{'fp8' if fp8 else 'w8'} with a pre-quantized "
+            "weight bank needs the matching per-(expert, out-column) scale "
+            "(pass scale=, from quantize_expert_weights"
+            f"{'_fp8' if fp8 else ''})"
         )
-    return quantize_expert_weights(b)
+    return (quantize_expert_weights_fp8 if fp8 else quantize_expert_weights)(b)
 
 
 def _ragged_dot_group_gemm(
@@ -241,16 +283,17 @@ def _group_gemm_fused(
                     (1, 1, bn), lambda j, i, kk, e_ref: (e_ref[i], 0, j)
                 )
             )
+    fp8 = w8 and b.dtype == FP8_DTYPE  # format keyed off the BANK dtype
     if w8:
         args.append(scale.astype(jnp.float32))
-        name = "group_gemm_w8"
-        w_bytes = n_exp * k_dim * n_dim  # int8: 1 byte
+        name = "group_gemm_fp8" if fp8 else "group_gemm_w8"
+        w_bytes = n_exp * k_dim * n_dim  # int8/fp8: 1 byte
     else:
         name = "group_gemm"
         w_bytes = n_exp * k_dim * n_dim * b.dtype.itemsize
     kernel = make_group_gemm_kernel(
         n_k=n_k, out_dtype=out_dtype, act_fn=act_fn,
-        fmt=OperandFormat(w8), ragged=ragged,
+        fmt=OperandFormat(w8 and not fp8, fp8), ragged=ragged,
         panel=_panel_for(bm) if ragged else 0,
     )
     return dist_pallas_call(
@@ -374,6 +417,32 @@ def group_gemm_w8(
     counts (each expert's slab is read regardless of how few rows route
     to it), so int8 weights halve the bound resource. Thin alias of
     :func:`group_gemm` with the ``scale`` operand."""
+    return group_gemm(
+        a_sorted, b_q, expert_ids, valid_rows=valid_rows, scale=scale,
+        config=config, out_dtype=out_dtype, act_fn=act_fn,
+        interpret=interpret,
+    )
+
+
+def group_gemm_fp8(
+    a_sorted: jax.Array,
+    b_q: jax.Array,
+    scale: jax.Array,
+    expert_ids: jax.Array,
+    *,
+    valid_rows: jax.Array | None = None,
+    config: GroupGemmConfig | None = None,
+    out_dtype: Any = None,
+    act_fn: Any = None,
+    interpret: Any = None,
+) -> jax.Array:
+    """:func:`group_gemm` over fp8_e4m3-quantized expert weights (from
+    :func:`quantize_expert_weights_fp8`) — :func:`group_gemm_w8`'s exact
+    twin one precision rung down (ISSUE 19): the fp8 B tiles upcast
+    in-kernel and the per-(expert, out-column) scales fold into the
+    accumulator at the last K step, the shared ``OperandFormat.scaled``
+    trace. Thin alias of :func:`group_gemm` with the ``scale`` operand;
+    the format is keyed off the bank dtype."""
     return group_gemm(
         a_sorted, b_q, expert_ids, valid_rows=valid_rows, scale=scale,
         config=config, out_dtype=out_dtype, act_fn=act_fn,

@@ -32,7 +32,11 @@ on every edge):
   payload at int8 with per-row f32 scales riding their own chunked put
   (same spans, own signal slots, own landing views) — half the
   cross-pool bytes, exactly the a2a's low-precision wire shape
-  (``layers/ep_a2a_layer.py``);
+  (``layers/ep_a2a_layer.py``); the **fp8 wire** (ISSUE 19) is its
+  fp8_e4m3 twin — the same two-put protocol with the same signal/canary
+  discipline, the payload at the e4m3 ceiling (448) instead of int8's
+  127 (the reference's headline a2a runs fp8 payloads with traveling
+  scales);
 - the whole family is **proved by the static verifier** like every
   other: ``analysis/sweep.py`` sweeps :data:`KV_STREAM_TUNE_SPACE` at
   worlds {2, 4, 8} — credit balance, deadlock freedom, dense wait-site
@@ -66,7 +70,12 @@ from triton_dist_tpu.ops.common import (
 from triton_dist_tpu.shmem import device as shmem
 from triton_dist_tpu.utils import axis_size as _axis_size
 
-WIRES = ("native", "int8")
+WIRES = ("native", "int8", "fp8")
+# the quantized wires share one protocol (payload put + scale put); they
+# differ only in payload dtype and quantizer ceiling
+QUANT_WIRES = ("int8", "fp8")
+FP8_WIRE_DTYPE = jnp.float8_e4m3fn
+_FP8_WIRE_MAX = 448.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,7 +89,10 @@ class KVStreamConfig:
         pre-quantized int8 payload plus per-row f32 scales
         (:func:`quantize_kv_wire`) and streams the scales on their own
         chunked put — half the cross-pool bytes on the weight/KV-bound
-        decode side, the reference's low-precision a2a wire shape.
+        decode side, the reference's low-precision a2a wire shape;
+        "fp8" is the fp8_e4m3 twin (:func:`quantize_kv_wire_fp8`,
+        ISSUE 19) — the same two-put protocol, e4m3's tapered grid on
+        the wire.
     """
 
     chunks_per_shard: int = 1
@@ -117,6 +129,29 @@ def quantize_kv_wire(pages: jax.Array) -> tuple[jax.Array, jax.Array]:
     scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
     q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
     return q, scale.astype(jnp.float32)
+
+
+def quantize_kv_wire_fp8(pages: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """fp8_e4m3 twin of :func:`quantize_kv_wire` (ISSUE 19): per-row
+    absmax at the e4m3 ceiling (448), ``(payload fp8 [m, w], scales f32
+    [m, 1])`` — the same wire shape, the same 1-byte payload, e4m3's
+    tapered grid instead of int8's uniform one."""
+    x = pages.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / _FP8_WIRE_MAX, 1.0)
+    q = jnp.clip(x / scale, -_FP8_WIRE_MAX, _FP8_WIRE_MAX).astype(
+        FP8_WIRE_DTYPE
+    )
+    return q, scale.astype(jnp.float32)
+
+
+def quantize_kv_wire_for(wire: str, pages: jax.Array):
+    """The quantizer of a QUANT_WIRES member (dispatch by wire name)."""
+    if wire == "int8":
+        return quantize_kv_wire(pages)
+    if wire == "fp8":
+        return quantize_kv_wire_fp8(pages)
+    raise ValueError(f"not a quantized wire: {wire!r}")
 
 
 def dequantize_kv_wire(payload: jax.Array, scales: jax.Array,
@@ -228,18 +263,21 @@ def _kv_stream_fused(
 ):
     """Fused mirror page-slab exchange (call inside ``jax.shard_map``).
 
-    ``payload``: this PE's ``[m, w]`` page slab (int8 when
-    ``config.wire == "int8"``, any dtype otherwise); ``scales``:
-    ``[m, 1]`` f32 per-row scales, required iff the wire is int8.
-    Returns the mirror peer's landed slab (and scales, int8 wire).
-    World must be even — the two-pool mirror pairing has no odd form —
-    and world 1 is the identity (nothing to hand off)."""
+    ``payload``: this PE's ``[m, w]`` page slab (the wire's quantized
+    dtype when ``config.wire`` is in :data:`QUANT_WIRES`, any dtype
+    otherwise); ``scales``: ``[m, 1]`` f32 per-row scales, required iff
+    the wire is quantized. Returns the mirror peer's landed slab (and
+    scales, quantized wires). World must be even — the two-pool mirror
+    pairing has no odd form — and world 1 is the identity (nothing to
+    hand off)."""
     cfg = (config or KVStreamConfig()).validate()
     n = _axis_size((axis))
-    if (cfg.wire == "int8") != (scales is not None):
+    if (cfg.wire in QUANT_WIRES) != (scales is not None):
         raise ValueError(
-            "KVStreamConfig.wire='int8' requires per-row scales (from "
-            "quantize_kv_wire); the native wire takes none"
+            f"KVStreamConfig.wire={cfg.wire!r}: quantized wires "
+            f"{QUANT_WIRES} require per-row scales (from "
+            f"quantize_kv_wire / quantize_kv_wire_fp8); the native wire "
+            f"takes none"
         )
     if n == 1:
         return payload if scales is None else (payload, scales)
@@ -251,18 +289,21 @@ def _kv_stream_fused(
     m = payload.shape[0]
     spans = chunk_schedule(m, cfg.chunks_per_shard)
     chunks = len(spans)
-    if cfg.wire == "int8":
+    if cfg.wire in QUANT_WIRES:
         if scales.shape[0] != m:
             raise ValueError(
                 f"scales rows {scales.shape[0]} != payload rows {m}"
             )
         s_spans = spans  # same row spans: chunk j's scales ride chunk j
+        # ONE kernel for both quantized wires (payload-dtype generic —
+        # the protocol never reads the payload); distinct launch names
+        # keep the guard/telemetry families separate
         out, s_out = dist_pallas_call(
             functools.partial(
                 _kv_stream_w8_kernel, axis=axis, n=n, spans=spans,
                 s_spans=s_spans,
             ),
-            name="kv_stream_w8",
+            name="kv_stream_fp8" if cfg.wire == "fp8" else "kv_stream_w8",
             out_shape=(
                 jax.ShapeDtypeStruct(payload.shape, payload.dtype),
                 jax.ShapeDtypeStruct(scales.shape, scales.dtype),
@@ -325,9 +366,9 @@ def _kv_stream_op_xla(
     config: KVStreamConfig | None = None, **_
 ):
     cfg = (config or KVStreamConfig()).validate()
-    if cfg.wire == "int8":
+    if cfg.wire in QUANT_WIRES:
         def fn(x):
-            q, s = quantize_kv_wire(x)
+            q, s = quantize_kv_wire_for(cfg.wire, x)
             q, s = _kv_stream_xla(q, s, axis=axis)
             return dequantize_kv_wire(q, s, x.dtype)
     else:
@@ -350,14 +391,14 @@ def kv_stream_op(
     """Host-level entry: ``payload`` is a global ``[n*m, w]`` array
     sharded on dim 0 (each PE's rows are its local page slab); returns
     the globally mirror-exchanged array with the same sharding. On the
-    int8 wire the slab is quantized per row before the exchange and
-    dequantized after landing — the wire cost is the quantization error,
-    the win is half the cross-pool bytes."""
+    quantized wires (int8 / fp8) the slab is quantized per row before the
+    exchange and dequantized after landing — the wire cost is the
+    quantization error, the win is the 1-byte payload."""
     cfg = (config or KVStreamConfig()).validate()
 
     def fn(x):
-        if cfg.wire == "int8":
-            q, s = quantize_kv_wire(x)
+        if cfg.wire in QUANT_WIRES:
+            q, s = quantize_kv_wire_for(cfg.wire, x)
             q, s = kv_stream(q, s, axis=axis, config=cfg,
                              interpret=interpret)
             return dequantize_kv_wire(q, s, x.dtype)

@@ -40,6 +40,7 @@ from triton_dist_tpu.ops.gg_pipeline import (
     make_moe_rs_overlap_kernel,
 )
 from triton_dist_tpu.ops.group_gemm import (
+    FP8_DTYPE,
     GroupGemmConfig,
     _group_gemm_xla,
     _panel_for,
@@ -162,6 +163,9 @@ def _moe_rs_overlap_fused(
     nb = expert_ids.shape[1]
     bm = t_pad_loc // nb
     w8 = scale is not None
+    # format keyed off the bank dtype (ISSUE 19): a float8 W_down pool
+    # streams at quarter-rate HBM bytes through the same w8 slot structure
+    fp8 = w8 and w_down.dtype == FP8_DTYPE
     h_dim = w_down.shape[2]
     itemsize = jnp.dtype(h_sorted.dtype).itemsize
     bn = rs_block_n_for(
@@ -189,7 +193,8 @@ def _moe_rs_overlap_fused(
     kernel = make_moe_rs_overlap_kernel(
         axis=axis, n=n, nb=nb, n_jn=n_jn, bn=bn, m_out=m_out,
         out_dtype=out_dtype, spans=spans, ragged=ragged,
-        panel=_panel_for(bm) if ragged else 0, fmt=OperandFormat(w8),
+        panel=_panel_for(bm) if ragged else 0,
+        fmt=OperandFormat(w8 and not fp8, fp8),
     )
     if len(spans) > 1:
         push_scratch = [
@@ -424,6 +429,11 @@ MOE_RS_TUNE_SPACE = (
     GroupGemmConfig(128, 1024, 512, ragged=True),
     GroupGemmConfig(128, 1024, 512, w8=True),
     GroupGemmConfig(128, 1024, 512, ragged=True, w8=True),
+    # fp8 axis (ISSUE 19): fp8_e4m3 W_down slabs at quarter-rate HBM
+    # bytes — registered strictly after their w8 twins (legacy < w8 < fp8,
+    # append-only)
+    GroupGemmConfig(128, 1024, 512, fp8=True),
+    GroupGemmConfig(128, 1024, 512, ragged=True, fp8=True),
 ) + _admitted_tune_extension("moe_reduce_rs")
 # ^ SYNTHESIZED schedules (ISSUE 14): the standing registry of proved
 # span policies (triton_dist_tpu/synth/admitted.py) appends STRICTLY

@@ -26,14 +26,17 @@ class ChipSpec:
     ici_gbps_per_link: float    # one direction, per link, GB/s
     ici_links: int              # torus links per chip
     vmem_mib: int
+    # fp8_e4m3 MXU peak; 0 = the generation has no fp8 path (v4), and
+    # pricing an fp8 candidate on it is a config error the models raise on
+    fp8_tops: float = 0.0
 
 
 # Public spec-sheet numbers (cloud.google.com/tpu/docs/system-architecture).
 CHIP_SPECS = {
-    "v4": ChipSpec("v4", 275, 275, 1228, 50, 6, 128),
-    "v5e": ChipSpec("v5e", 197, 394, 819, 50, 4, 128),
-    "v5p": ChipSpec("v5p", 459, 918, 2765, 100, 6, 128),
-    "v6e": ChipSpec("v6e", 918, 1836, 1640, 100, 4, 128),
+    "v4": ChipSpec("v4", 275, 275, 1228, 50, 6, 128, fp8_tops=0),
+    "v5e": ChipSpec("v5e", 197, 394, 819, 50, 4, 128, fp8_tops=394),
+    "v5p": ChipSpec("v5p", 459, 918, 2765, 100, 6, 128, fp8_tops=918),
+    "v6e": ChipSpec("v6e", 918, 1836, 1640, 100, 4, 128, fp8_tops=1836),
 }
 
 _KIND_ALIASES = {
@@ -343,6 +346,7 @@ def estimate_w8_overlap_time_ms(
     weight_bytes: int = 0,
     chunks_per_shard: int = 1,
     w8: bool = False,
+    fp8: bool = False,
     spec: ChipSpec | None = None,
 ) -> float:
     """Fused AG-GroupGEMM / MoE-Reduce-RS overlap time model with the
@@ -355,17 +359,36 @@ def estimate_w8_overlap_time_ms(
     noise) and touches nothing else: weights are local, so w8 adds no
     ring/chunk edges.
 
-    ``w8=False`` reduces EXACTLY to the existing chunked ring model plus
-    the full-rate weight term (and with ``weight_bytes=0`` to the ring
-    model alone) — the honesty contract the unit tests pin. A deliberate
-    sum (upper bound): on chip the weight stream partially hides under the
-    ring chunks; the model exists to rank chunk/w8 candidates, not to
-    predict absolutes."""
+    ``fp8=True`` (ISSUE 19) QUARTERS the weight term instead — the
+    float8_e4m3 slabs stream one byte per bf16-pair element and the
+    quarter-rate bank read is the whole point of the second operand
+    format; mutually exclusive with ``w8``, and pricing it on a chip
+    generation without an fp8 MXU path (``spec.fp8_tops == 0``, v4)
+    raises rather than returning a time for hardware that can't run it.
+
+    ``w8=False`` (and ``fp8=False``) reduces EXACTLY to the existing
+    chunked ring model plus the full-rate weight term (and with
+    ``weight_bytes=0`` to the ring model alone) — the honesty contract
+    the unit tests pin. A deliberate sum (upper bound): on chip the
+    weight stream partially hides under the ring chunks; the model exists
+    to rank chunk/w8/fp8 candidates, not to predict absolutes."""
     spec = spec or detect_chip()
+    if w8 and fp8:
+        raise ValueError("w8 and fp8 are exclusive operand formats")
+    if fp8 and not spec.fp8_tops:
+        raise ValueError(
+            f"chip {spec.name!r} has no fp8 MXU rate (fp8_tops=0) — an "
+            f"fp8 candidate cannot be priced for it"
+        )
     t_ring = estimate_ring_chunked_time_ms(
         shard_bytes, n_pes, chunks_per_shard, spec
     )
-    wb = weight_bytes / 2.0 if w8 else float(weight_bytes)
+    if fp8:
+        wb = weight_bytes / 4.0
+    elif w8:
+        wb = weight_bytes / 2.0
+    else:
+        wb = float(weight_bytes)
     return t_ring + wb / (spec.hbm_gbps * 1e9) * 1e3
 
 

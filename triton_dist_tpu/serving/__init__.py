@@ -114,6 +114,7 @@ from triton_dist_tpu.serving.metrics import (
     StreamingHistogram,
 )
 from triton_dist_tpu.serving.overload import (
+    BROWNOUT3,
     LADDER,
     OverloadConfig,
     OverloadController,
@@ -140,6 +141,7 @@ __all__ = [
     "HandoffPlane",
     "HandoffResult",
     "PoolCollapse",
+    "BROWNOUT3",
     "LADDER",
     "OverloadConfig",
     "OverloadController",

@@ -338,8 +338,13 @@ class ServingEngine:
         self._uid_counter = 0
         self._stopping = False
         self._base_cfg = cfg           # restored when brownout2 descends
-        self._downshifted = False
+        # how many downshift stages are composed onto _base_cfg right now
+        # (0 = serving the base config; the legacy single-callable hook
+        # only ever reaches depth 1; a two-stage ladder reaches 2 at
+        # brownout3)
+        self._downshift_depth = 0
         self._w8_params = None         # once-quantized serving banks cache
+        self._fp8_params = None        # ... and the fp8 twin (ISSUE 19)
         # prefix-cache counters accumulated across batcher rebuilds (each
         # rebuild starts a FRESH trie — the pool is the batcher's)
         self._px_totals: dict[str, int] = {}
@@ -410,8 +415,9 @@ class ServingEngine:
         )
 
     def _serving_params(self):
-        """The param tree the batcher should serve. With a w8 MoE config
-        (``cfg.gg_config.w8``) and FLOAT expert banks, quantize them ONCE
+        """The param tree the batcher should serve. With a scaled-format
+        MoE config (``cfg.gg_config.w8`` or ``.fp8``) and FLOAT expert
+        banks, quantize them ONCE
         here (ISSUE 13 satellite — the tp_transformer.py:360 noted
         follow-up retired at the engine tier): every decode/prefill call
         then feeds pre-quantized int8 pools + explicit scales straight
@@ -423,7 +429,9 @@ class ServingEngine:
         downshift REVERT (cfg back to non-w8) serves the original float
         banks again."""
         c = self.cfg
-        if not getattr(getattr(c, "gg_config", None), "w8", False):
+        gg = getattr(c, "gg_config", None)
+        fp8 = getattr(gg, "fp8", False)
+        if not (getattr(gg, "w8", False) or fp8):
             return self.params
         layers = (
             self.params.get("layers")
@@ -436,12 +444,21 @@ class ServingEngine:
         import jax.numpy as jnp
 
         if not jnp.issubdtype(layers[0]["w_up"].dtype, jnp.floating):
-            return self.params  # int8 without scales: stays loud below
-        if self._w8_params is None:
-            from triton_dist_tpu.models.tp_transformer import (
-                quantize_moe_serving_params,
-            )
+            return self.params  # int8/fp8 without scales: stays loud below
+        from triton_dist_tpu.models.tp_transformer import (
+            quantize_moe_serving_params,
+        )
 
+        if fp8:
+            # brownout3's operand format (ISSUE 19): float8_e4m3 pools at
+            # quarter-rate HBM bytes, cached separately from the w8 banks
+            # so a 2 -> 3 -> 2 rung walk re-quantizes neither
+            if self._fp8_params is None:
+                self._fp8_params = quantize_moe_serving_params(
+                    self.params, fmt="fp8"
+                )
+            return self._fp8_params
+        if self._w8_params is None:
             self._w8_params = quantize_moe_serving_params(self.params)
         return self._w8_params
 
@@ -743,20 +760,24 @@ class ServingEngine:
                     st.req.uid, st.priority, st.t_enqueue, now,
                     "ladder reached shed_all_batch: queued batch shed",
                 )
-        want = ctrl.wants_downshift()
-        if want and not self._downshifted:
-            self._downshifted = True
-            self.cfg = ctrl.config.downshift(self._base_cfg)
-            self.metrics.count("precision_downshifts")
-            self._rebuild(
-                f"brownout precision downshift ({tr.frm} -> {tr.to})"
-            )
-        elif not want and self._downshifted:
-            self._downshifted = False
-            self.cfg = self._base_cfg
-            self._rebuild(
-                f"brownout recovery: precision restored ({tr.frm} -> {tr.to})"
-            )
+        depth = ctrl.downshift_depth()
+        if depth != self._downshift_depth:
+            deeper = depth > self._downshift_depth
+            self._downshift_depth = depth
+            cfg = self._base_cfg
+            for stage in ctrl.config.downshift_stages()[:depth]:
+                cfg = stage(cfg)
+            self.cfg = cfg
+            if deeper:
+                self.metrics.count("precision_downshifts")
+                self._rebuild(
+                    f"brownout precision downshift ({tr.frm} -> {tr.to})"
+                )
+            else:
+                self._rebuild(
+                    f"brownout recovery: precision restored "
+                    f"({tr.frm} -> {tr.to})"
+                )
 
     def _record_shed(self, uid: Any, priority: str, t_enqueue: float,
                      now: float, reason: str) -> "Shed":

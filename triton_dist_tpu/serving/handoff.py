@@ -70,7 +70,9 @@ class HandoffConfig:
                      fault/retry) granularity; with the device wire this
                      is ``KVStreamConfig.chunks_per_shard`` per page.
     wire:            "int8" (payload + per-row scales at half the bytes,
-                     the a2a wire shape) or "native".
+                     the a2a wire shape), "fp8" (float8_e4m3 payload +
+                     per-row scales at a quarter of the f32 page bytes,
+                     ISSUE 19), or "native".
     virtual_chunk_s: transfer time charged per streamed chunk on the
                      engine clock (0 = instantaneous wire; the bench A/B
                      sets it so transfer shows up in the phase spans).

@@ -43,6 +43,13 @@ Three mechanisms, composed:
   *shed_all_batch*: batch is refused outright (typed Shed at submit) and
   the queued batch backlog is shed.
 
+  With a TWO-stage ``downshift`` (ISSUE 19) the ladder grows a rung:
+  *brownout3* sits between brownout2 and shed_all_batch and composes the
+  second stage (fp8 — quarter-rate weight traffic) on top of the first
+  (w8), so the engine trades a second helping of precision before it
+  starts refusing work. Single-callable configs keep the legacy 4-state
+  ladder byte-identically.
+
   Climbs are immediate (one rung per observed step — overload is an
   emergency); descents require the pressure to fall below the *exit*
   threshold of the current rung AND a minimum dwell, so the ladder cannot
@@ -68,10 +75,15 @@ from triton_dist_tpu.resilience.retry import RetryPolicy
 # priority classes, best first; the index is the shed/admission rank
 PRIORITIES = ("interactive", "batch")
 
-# ladder states, in climbing order
+# ladder states, in climbing order. LADDER is the legacy (single-stage
+# downshift) shape; a two-stage ``OverloadConfig.downshift`` inserts
+# BROWNOUT3 between BROWNOUT2 and SHED_ALL_BATCH (ISSUE 19: the fp8
+# rung below w8) — read the effective ladder off
+# ``OverloadConfig.ladder()`` / ``OverloadController._ladder``.
 NORMAL = "normal"
 BROWNOUT1 = "brownout1"
 BROWNOUT2 = "brownout2"
+BROWNOUT3 = "brownout3"
 SHED_ALL_BATCH = "shed_all_batch"
 LADDER = (NORMAL, BROWNOUT1, BROWNOUT2, SHED_ALL_BATCH)
 
@@ -106,11 +118,17 @@ class OverloadConfig:
                      attempt bound is ``max_attempts - 1`` resubmits).
     retry_budget:    token-bucket capacity per priority class.
     retry_refill_per_s: bucket refill rate (tokens/second, caller clock).
-    downshift:       optional ``cfg -> degraded_cfg`` hook the engine
-                     applies when entering brownout2 (e.g. flip the MoE
-                     ``GroupGemmConfig.w8`` / int8-KV operand formats) and
-                     reverts on descent. None = the transition is still
-                     recorded, nothing is rebuilt.
+    downshift:       optional precision-degradation hook(s). A single
+                     ``cfg -> degraded_cfg`` callable is the legacy
+                     shape: the engine applies it on entering brownout2
+                     (e.g. flip the MoE ``GroupGemmConfig.w8`` / int8-KV
+                     operand formats) and reverts on descent. A SEQUENCE
+                     of callables is a ladder of its own (ISSUE 19): two
+                     stages grow the brownout ladder by one rung —
+                     brownout2 applies stage 0 (w8), the new brownout3
+                     applies stage 1 composed on top (fp8), and each
+                     descent peels one stage back off. None = the
+                     transition is still recorded, nothing is rebuilt.
     """
 
     enter_pressure: tuple = (0.55, 0.75, 0.9)
@@ -127,10 +145,37 @@ class OverloadConfig:
     retry_refill_per_s: float = 1.0
     downshift: Any = None
 
+    def downshift_stages(self) -> tuple:
+        """The downshift hook normalized to a tuple of ``cfg -> cfg``
+        stages: ``()`` when unset, one stage for the legacy single
+        callable, the sequence itself otherwise."""
+        if self.downshift is None:
+            return ()
+        if callable(self.downshift):
+            return (self.downshift,)
+        return tuple(self.downshift)
+
+    def ladder(self) -> tuple:
+        """The effective ladder for THIS config: the legacy 4-state shape
+        unless a second downshift stage earns brownout3 its rung."""
+        if len(self.downshift_stages()) >= 2:
+            return (NORMAL, BROWNOUT1, BROWNOUT2, BROWNOUT3, SHED_ALL_BATCH)
+        return LADDER
+
     def validate(self) -> "OverloadConfig":
-        if len(self.enter_pressure) != 3 or len(self.exit_pressure) != 3:
+        stages = self.downshift_stages()
+        if len(stages) > 2:
             raise ValueError(
-                "enter_pressure/exit_pressure must name all 3 rungs, got "
+                f"downshift supports at most 2 stages (w8 then fp8 — one "
+                f"brownout rung each), got {len(stages)}"
+            )
+        if not all(callable(s) for s in stages):
+            raise ValueError("every downshift stage must be callable")
+        n = len(self.ladder()) - 1
+        if len(self.enter_pressure) != n or len(self.exit_pressure) != n:
+            raise ValueError(
+                f"enter_pressure/exit_pressure must name all {n} rungs of "
+                f"the {len(self.ladder())}-state ladder, got "
                 f"{self.enter_pressure!r} / {self.exit_pressure!r}"
             )
         if list(self.enter_pressure) != sorted(self.enter_pressure):
@@ -187,6 +232,7 @@ class OverloadController:
 
     def __init__(self, config: OverloadConfig, *, max_queue: int):
         self.config = config.validate()
+        self._ladder = self.config.ladder()
         self.max_queue = max(1, int(max_queue))
         self.state = NORMAL
         self.transitions: list[Transition] = []
@@ -241,7 +287,7 @@ class OverloadController:
         return round(self._last_pressure, 6)
 
     def rung(self) -> int:
-        return LADDER.index(self.state)
+        return self._ladder.index(self.state)
 
     # -- the ladder ------------------------------------------------------
 
@@ -265,14 +311,14 @@ class OverloadController:
         self._last_cause = max(terms, key=lambda k: (terms[k], k))
         self._dwell += 1
         r = self.rung()
-        if r < 3 and p >= self.config.enter_pressure[r]:
-            return self._move(now, LADDER[r + 1], p)
+        if r < len(self._ladder) - 1 and p >= self.config.enter_pressure[r]:
+            return self._move(now, self._ladder[r + 1], p)
         if (
             r > 0
             and self._dwell >= self.config.min_dwell_steps
             and p < self.config.exit_pressure[r - 1]
         ):
-            return self._move(now, LADDER[r - 1], p)
+            return self._move(now, self._ladder[r - 1], p)
         return None
 
     def _move(self, now: float, to: str, pressure: float) -> Transition:
@@ -303,6 +349,17 @@ class OverloadController:
     def wants_downshift(self) -> bool:
         """brownout2 and above request the degraded precision step."""
         return self.rung() >= 2 and self.config.downshift is not None
+
+    def downshift_depth(self) -> int:
+        """How many downshift stages the current rung composes onto the
+        engine's base config: 0 below brownout2, stage 0 at brownout2,
+        stages 0..1 at brownout3, capped at the configured stage count
+        (shed_all_batch keeps the deepest composition — shedding batch is
+        a worse emergency than the one that degraded precision)."""
+        r = self.rung()
+        if r < 2:
+            return 0
+        return min(r - 1, len(self.config.downshift_stages()))
 
     def shed_victim(self, queued: list) -> int | None:
         """Pick the overflow-shed victim among ``queued``
