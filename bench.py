@@ -1259,6 +1259,38 @@ def _run_serving(argv) -> None:
         )
         for name, value, unit in sbench.info_lines(cp_rows, tag=tag):
             emit_info(name, value, unit)
+    # speculative-decoding A/B (ISSUE 20, ROADMAP #5): the same λ axis
+    # plain vs speculative at k ∈ {2, 4}. The draft is the TARGET itself
+    # (a self-draft: acceptance rate α = 1 by construction), so the A/B
+    # isolates the serving cost model — each round emits k tokens per
+    # slot at 1 + (c_verify + c_draft)·k step units instead of k units,
+    # and tokens/s scales by perf_model.estimate_spec_decode_gain(k, 1.0)
+    # (~1.45× at k=2, ~2.29× at k=4). A real smaller draft trades α
+    # against draft cost — the acceptance-rate info line is the column
+    # that attributes any shortfall. Seeded + FakeClock ⇒ byte-identical
+    # reruns; info lines only, never perf-gated.
+    from triton_dist_tpu.serving import SpecDecodeConfig
+
+    for tag, sd in (
+        ("_sd_off", None),
+        ("_sd_on_k2", SpecDecodeConfig(draft_cfg=cfg, draft_params=params,
+                                       k=2)),
+        ("_sd_on_k4", SpecDecodeConfig(draft_cfg=cfg, draft_params=params,
+                                       k=4)),
+    ):
+        # outputs long relative to k: max_new truncation throws drafted
+        # overhang away, so short-output traffic under-states the win
+        # (that regime is what adaptive-k / the shed rung are for)
+        sd_rows = sbench.sweep_offered_load(
+            cfg, params, mesh, s_max=48, rates=rates, n_requests=32,
+            prompt_len=("uniform", 2, 6), output_len=("uniform", 12, 20),
+            seed=0, virtual_step_s=0.05,
+            slo=SLOTargets(ttft_ms=800.0, e2e_ms=3000.0),
+            serving_kw=dict(speculative=sd),
+            tag=tag.strip("_") + ":",
+        )
+        for name, value, unit in sbench.info_lines(sd_rows, tag=tag):
+            emit_info(name, value, unit)
     # disaggregated-vs-unified A/B (ISSUE 13, ROADMAP #2): the SAME
     # seeded traffic and SLO over the same 4 host devices — unified
     # engine on all 4 vs the two-pool topology (2 prefill + 2 decode,

@@ -141,6 +141,16 @@
 # proves the fp8 tune tuples (the w8 twins' exact slot structure) at
 # worlds {2, 4, 8}.
 #
+# Since ISSUE 20 the matrix also covers the SPECULATIVE-SERVING cells
+# (tests/test_spec_serving.py): a corrupted draft token injected
+# mid-round must be REJECTED by the batched verify pass with the token
+# stream byte-identical to a non-speculative run, and the quick
+# speculative soak campaign — self-draft speculation × scheduled draft
+# corruption × a straggler shrink + prefix replay mid-speculation —
+# must come up green with a bit-identical seeded replay
+# (resilience/soak.py SoakSpec.speculative; the full set rides
+# scripts/chaos_soak.py).
+#
 # Every cell runs under a wall-clock budget (TDT_CELL_TIMEOUT_S,
 # default 600 s; conftest.py delivers it as a SIGALRM inside the cell):
 # a hung cell reports as one named FAILED row — and so fails the exit
@@ -168,7 +178,7 @@ files="tests/test_chaos.py tests/test_elastic.py \
     tests/test_prefix_cache.py tests/test_disagg.py tests/test_synth.py \
     tests/test_flight_recorder.py tests/test_fleet.py \
     tests/test_recovery.py tests/test_ranged_prefill.py \
-    tests/test_fp8.py"
+    tests/test_fp8.py tests/test_spec_serving.py"
 marker="chaos"
 lint_args=""
 if [ "${1:-}" = "--quick" ]; then
@@ -178,7 +188,8 @@ if [ "${1:-}" = "--quick" ]; then
         tests/test_prefix_cache.py tests/test_disagg.py \
         tests/test_synth.py tests/test_flight_recorder.py \
         tests/test_fleet.py tests/test_recovery.py \
-        tests/test_ranged_prefill.py tests/test_fp8.py"
+        tests/test_ranged_prefill.py tests/test_fp8.py \
+        tests/test_spec_serving.py"
     marker="chaos and not slow"
     # keep the quick posture bounded: worlds {2,4} (the full {2,4,8}
     # sweep is the default standalone run's job)

@@ -70,18 +70,28 @@ of the last — admission overlaps the streaming tail, and the zero-lost /
 exactly-one-terminal / bundle-per-flip invariants plus the bit-identical
 seeded replay must all hold at the earlier gate.
 
+Since ISSUE 20 the run also includes SPECULATIVE campaigns
+(``SoakSpec.speculative``): burst traffic through the unified engine
+with self-draft speculative decoding armed, composing scheduled
+corrupt-draft injections (each flipped draft token must be REJECTED by
+the batched verify pass) with a persistent straggler (mesh shrink +
+prefix replay mid-speculation) — the finished set and every finished
+token stream must be byte-identical to a clean NON-speculative run of
+the same trace, and the whole campaign must replay bit-identically
+from its seed.
+
 Usage::
 
     scripts/chaos_soak.py [--campaigns N] [--seed-base S] [--quick]
                           [--no-replay-check] [--no-prefix] [--no-disagg]
-                          [--no-fleet] [--no-recovery]
+                          [--no-fleet] [--no-recovery] [--no-spec]
 
 ``--quick`` runs 3 small + 1 shared-prefix + 1 disagg + 1 fleet +
-1 recovery + 1 pipelined-disagg campaign (the chaos-matrix cell
-posture); the default 20 + 6 shared-prefix + 5 disagg + 4 fleet +
-3 recovery + 3 pipelined-disagg campaigns are the ISSUE 11/12/13/16/17/
-18 acceptance run. Exit code 0 iff every campaign is green (and the
-replay checks hold).
+1 recovery + 1 pipelined-disagg + 1 speculative campaign (the
+chaos-matrix cell posture); the default 20 + 6 shared-prefix + 5 disagg
++ 4 fleet + 3 recovery + 3 pipelined-disagg + 3 speculative campaigns
+are the ISSUE 11/12/13/16/17/18/20 acceptance run. Exit code 0 iff
+every campaign is green (and the replay checks hold).
 """
 
 import argparse
@@ -116,6 +126,8 @@ def main(argv=None) -> int:
                     help="skip the fleet campaign set (ISSUE 16)")
     ap.add_argument("--no-recovery", action="store_true",
                     help="skip the recovery-plane campaign set (ISSUE 17)")
+    ap.add_argument("--no-spec", action="store_true",
+                    help="skip the speculative campaign set (ISSUE 20)")
     args = ap.parse_args(argv)
 
     from triton_dist_tpu import config as tdt_config
@@ -132,6 +144,7 @@ def main(argv=None) -> int:
     n_fl = 0 if args.no_fleet else (1 if args.quick else 4)
     n_rc = 0 if args.no_recovery else (1 if args.quick else 3)
     n_pd = 0 if args.no_disagg else (1 if args.quick else 3)
+    n_sp = 0 if args.no_spec else (1 if args.quick else 3)
 
     def build_spec(k: int):
         if k < n:
@@ -152,14 +165,20 @@ def main(argv=None) -> int:
             return soak.SoakSpec.fleet_recovery_spec(
                 seed=args.seed_base + 400 + (k - n - n_px - n_dg - n_fl)
             ), "recovery"
-        return soak.SoakSpec.disagg(
-            seed=args.seed_base + 500 + (k - n - n_px - n_dg - n_fl - n_rc),
-            pipelined_handoff=True,
-        ), "disagg-pipe"
+        if k < n + n_px + n_dg + n_fl + n_rc + n_pd:
+            return soak.SoakSpec.disagg(
+                seed=args.seed_base + 500
+                + (k - n - n_px - n_dg - n_fl - n_rc),
+                pipelined_handoff=True,
+            ), "disagg-pipe"
+        return soak.SoakSpec.speculative(
+            seed=args.seed_base + 600
+            + (k - n - n_px - n_dg - n_fl - n_rc - n_pd),
+        ), "spec"
 
     rows = []
     t0 = time.time()
-    for k in range(n + n_px + n_dg + n_fl + n_rc + n_pd):
+    for k in range(n + n_px + n_dg + n_fl + n_rc + n_pd + n_sp):
         spec, kind_tag = build_spec(k)
         t1 = time.time()
         res = soak.run_campaign(spec)
@@ -203,6 +222,13 @@ def main(argv=None) -> int:
                 f"{hc.get('serving_disagg:pool_uncollapse', 0)} "
                 f"dead={res.snapshot.get('engine', {}).get('dead')}]"
             )
+        elif kind_tag == "spec":
+            sp = res.snapshot.get("speculative", {})
+            px_note = (
+                f" [spec: accept_rate={sp.get('accept_rate')} "
+                f"rollbacks={sp.get('rollback_total', 0)} "
+                f"draft_faults={sp.get('draft_faults_injected', 0)}]"
+            )
         print(
             f"  campaign {kind_tag} seed={spec.seed:<4d} {verdict}  "
             f"{dt:6.1f}s  terminals={dict(sorted(census.items()))} "
@@ -219,12 +245,15 @@ def main(argv=None) -> int:
     replay_ok = True
     if not args.no_replay_check and rows:
         # one replay per campaign KIND: the standard, shared-prefix,
-        # disagg, and fleet arcs must each reproduce bit-identically
+        # disagg, fleet, recovery, pipelined-disagg, and speculative
+        # arcs must each reproduce bit-identically
         replay_at = [0] + ([n] if n_px else []) + (
             [n + n_px] if n_dg else []
         ) + ([n + n_px + n_dg] if n_fl else []) + (
             [n + n_px + n_dg + n_fl] if n_rc else []
-        ) + ([n + n_px + n_dg + n_fl + n_rc] if n_pd else [])
+        ) + ([n + n_px + n_dg + n_fl + n_rc] if n_pd else []) + (
+            [n + n_px + n_dg + n_fl + n_rc + n_pd] if n_sp else []
+        )
         for idx in replay_at:
             spec, kind_tag = build_spec(idx)
             first = rows[idx][2]

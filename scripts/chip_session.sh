@@ -96,8 +96,8 @@ echo "=== [6/7] serving throughput (continuous batching, tokens/s)"
   ep_rc=$?
   timeout 1800 python scripts/serving_bench.py mixtral-8x7b:ep-hier 2 4 120
   eph_rc=$?
-  # speculative decoding: plain vs draft-speculated greedy (same tokens)
-  timeout 1800 python scripts/speculative_bench.py llama-3.1-8b 8 4 96 4
+  # speculative serving: plain vs spec arms on the shared sweep harness
+  TDT_BENCH_SERVING_TPU=1 timeout 1800 python scripts/speculative_bench.py llama-3.1-8b 8 4 4
   spec_rc=$?
 } > "docs/chip_logs/${stamp}_serving.log" 2>&1
 echo "serving rc=$serving_rc moe=$moe_rc moe_w8=$moe_q_rc ep=$ep_rc ep_hier=$eph_rc spec=$spec_rc" \

@@ -203,3 +203,34 @@ def test_speculative_hier_ep_target(mesh2x4, mesh4):
         s_max=s_max, draft_k=3, page_size=2,
     )
     np.testing.assert_array_equal(np.asarray(got_paged), np.asarray(want))
+
+
+def test_accept_lengths_per_slot_vs_lockstep():
+    """The shared acceptance core (ISSUE 20): ``accept_lengths`` returns
+    PER-SLOT counts — the serving batcher consumes the rows directly,
+    the lockstep loop here advances by the batch ``min`` of the same
+    rows — and the np/jnp namespaces agree element for element, so the
+    per-slot/lockstep equivalence is structural, not coincidental."""
+    from triton_dist_tpu.models.speculative import accept_lengths
+
+    k = 3
+    drafts = np.array([
+        [5, 6, 7],    # full agreement: capped at k-1 = 2
+        [5, 9, 7],    # diverges at j=1 (the later re-match must NOT count)
+        [1, 2, 3],    # diverges immediately
+    ], np.int32)
+    preds = np.array([
+        [5, 6, 7, 8],
+        [5, 6, 7, 8],
+        [9, 9, 9, 9],
+    ], np.int32)
+    per_slot = accept_lengths(drafts, preds, k)
+    assert per_slot.tolist() == [2, 1, 0]
+    got_j = accept_lengths(
+        jnp.asarray(drafts), jnp.asarray(preds), k, xp=jnp
+    )
+    assert np.asarray(got_j).tolist() == [2, 1, 0]
+    # the lockstep round advance is the min over the same per-slot rows:
+    # one cold slot stalls every neighbor — exactly what the serving
+    # batcher's per-slot consume avoids (tests/test_spec_serving.py)
+    assert int(per_slot.min()) == 0

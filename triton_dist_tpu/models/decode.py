@@ -739,10 +739,12 @@ class Request:
     uid: Any = None
     rng: Any = None
 
-    def sample(self, logits, rng) -> int:
-        """Pick the next token from a [vocab] f32 logit row."""
-        if self.temperature <= 0.0:
-            return int(logits.argmax())
+    def dist(self, logits) -> np.ndarray:
+        """The sampling distribution over the vocab for a [vocab] f32
+        logit row (float64 probs) — the exact computation :meth:`sample`
+        draws from, factored out so speculative rejection sampling
+        (serving/speculative.py) accepts against the SAME distribution
+        plain serving samples from. Requires ``temperature > 0``."""
         z = logits.astype(np.float64) / self.temperature
         if self.top_k is not None:
             k = min(self.top_k, len(z))   # validated >= 1 at submit()
@@ -753,6 +755,13 @@ class Request:
         z -= z.max()
         probs = np.exp(z)
         probs /= probs.sum()
+        return probs
+
+    def sample(self, logits, rng) -> int:
+        """Pick the next token from a [vocab] f32 logit row."""
+        if self.temperature <= 0.0:
+            return int(logits.argmax())
+        probs = self.dist(logits)
         return int(rng.choice(len(probs), p=probs))
 
 
@@ -1359,10 +1368,14 @@ class ContinuousBatcher:
         self._admit()
         if self.idle:
             return
+        self._chunk_pass()
+        self._decode_round()
+
+    def _chunk_pass(self) -> None:
         # chunked-prefill scheduling (ISSUE 18): each parked slot gets ONE
         # bounded ranged chunk per step, interleaved with the decode step
-        # below — decode rows never mix across the batch dim, so the
-        # chunk passes leave every neighbor's stream byte-identical
+        # that follows — decode rows never mix across the batch dim, so
+        # the chunk passes leave every neighbor's stream byte-identical
         for i in sorted(self._chunk):
             req = self.slot_req[i]
             if req is None:           # struck/poisoned mid-flight
@@ -1375,6 +1388,28 @@ class ContinuousBatcher:
             else:
                 del self._chunk[i]    # final chunk: _ranged_pass admits
             self._ranged_pass(i, req, lo, hi)
+
+    def _publish_step(self, i: int, req: Request) -> None:
+        # publish-on-completion: a prompt page enters the trie only
+        # once its last position's KV is written (a reader admitted
+        # earlier would attend to unwritten pages); generated
+        # positions extend the slot's PRIVATE chain only, so pages
+        # touching them are never published
+        p, pg = int(self.pos[i]), self._px.page
+        if p % pg == 0:
+            g = p // pg - 1
+            if (g == self._px.next_publish(i)
+                    and (g + 1) * pg <= len(req.prompt)):
+                if self._px.publish(
+                    i, g, req.prompt[g * pg:(g + 1) * pg]
+                ):
+                    self._px_dirty = True
+
+    def _decode_round(self) -> None:
+        """The single-token decode half of :meth:`step` (the speculative
+        serving batcher replaces this with a draft+verify round —
+        serving/speculative.py — and falls back here when no slot is in
+        a speculation-eligible state)."""
         if self._px is not None and self._px_dirty:
             self._push_px_table()
         logits, self.cache = self._step(
@@ -1442,20 +1477,7 @@ class ContinuousBatcher:
                     continue
             self.pos[i] += 1
             if self._px is not None:
-                # publish-on-completion: a prompt page enters the trie only
-                # once its last position's KV is written (a reader admitted
-                # earlier would attend to unwritten pages); generated
-                # positions extend the slot's PRIVATE chain only, so pages
-                # touching them are never published
-                p, pg = int(self.pos[i]), self._px.page
-                if p % pg == 0:
-                    g = p // pg - 1
-                    if (g == self._px.next_publish(i)
-                            and (g + 1) * pg <= len(req.prompt)):
-                        if self._px.publish(
-                            i, g, req.prompt[g * pg:(g + 1) * pg]
-                        ):
-                            self._px_dirty = True
+                self._publish_step(i, req)
 
     def run(self, max_steps: int = 100000) -> list[tuple[Any, list]]:
         """Drive until every queued request finishes; returns

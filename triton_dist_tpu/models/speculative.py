@@ -56,6 +56,26 @@ from triton_dist_tpu.models.tp_transformer import TransformerConfig
 from triton_dist_tpu.ops.flash_decode import FlashDecodeConfig
 
 
+def accept_lengths(drafts, preds, k: int, xp=np):
+    """PER-SLOT accepted-draft counts — the speculative acceptance core,
+    shared by the lockstep loop below (which takes the batch ``min``) and
+    the per-slot serving batcher (serving/speculative.py, which does
+    not). ``drafts [b, k]`` are the draft's proposals, ``preds [b, >=k]``
+    the verify pass's greedy predictions (row j = the target's choice
+    after inputs ``tok, d_1..d_j``). Slot i accepts its longest prefix of
+    drafts matching the target's own chain, capped at ``k-1`` — the cap
+    keeps the draft cache rows equal to the accepted inputs without a
+    catch-up forward (module docstring). Returns ``[b]`` counts in
+    ``[0, k-1]``.
+
+    ``xp`` selects the array namespace: ``np`` (host, the serving
+    batcher) or ``jnp`` (inside the lockstep device loop) — one formula,
+    both worlds, so the per-slot/lockstep equivalence is structural
+    (pinned in tests/test_speculative.py)."""
+    match = (preds[:, :k] == drafts).astype(xp.int32)
+    return xp.minimum(xp.cumprod(match, axis=1).sum(axis=1), k - 1)
+
+
 def verify_step(
     cfg: TransformerConfig,
     params: dict,
@@ -252,13 +272,14 @@ def speculative_generate(
             cd, drafts = draft_roll(pd, cd, tok, pos)
             chunk = jnp.concatenate([tok[:, None], drafts], axis=1)
             ct, preds = verify(pt, ct, chunk, pos)
-            # longest verified prefix, lockstep over the batch, capped at
-            # k-1 (the cap keeps the draft cache consistent without a
-            # catch-up forward — see module docstring)
-            match = (preds[:, :k] == drafts).astype(jnp.int32)
-            a = jnp.minimum(
-                jnp.min(jnp.cumprod(match, axis=1).sum(axis=1)), k - 1
-            ).astype(jnp.int32)
+            # longest verified prefix: the shared per-slot acceptance
+            # core (accept_lengths), then lockstep over the batch — the
+            # round advances by the MINIMUM slot's acceptance (min and
+            # the k-1 cap commute, so per-slot-then-min equals the
+            # historical min-then-cap formula bit for bit)
+            a = jnp.min(accept_lengths(drafts, preds, k, xp=jnp)).astype(
+                jnp.int32
+            )
             bonus = jax.lax.dynamic_index_in_dim(
                 preds, a, axis=1, keepdims=False
             )

@@ -75,6 +75,8 @@ ENGINE_SECTIONS = {
     "engine": "always: world/queue/clock facts (disagg: topology facts)",
     "overload": "armed (overload): ladder state, pressure, sheds",
     "prefix_cache": "armed (prefix_cache): PX counters + gauges",
+    "speculative": "armed (speculative): accept rate, live k, rollback "
+                   "and accepted-token totals",
     "span_ms": "armed (obs spans): per-phase p50/p99 breakdown",
     "alerts": "armed (obs alerts): this engine's rule states",
     "handoff": "disagg only: the handoff plane's counter set",

@@ -581,3 +581,38 @@ def overlap_efficiency(t_fused_ms: float, t_compute_ms: float, t_comm_ms: float)
     if serial <= ideal:
         return 1.0
     return max(0.0, min(1.0, (serial - t_fused_ms) / (serial - ideal)))
+
+
+def estimate_spec_decode_gain(
+    k: int,
+    alpha: float,
+    *,
+    verify_cost_factor: float = 0.0625,
+    draft_cost_factor: float = 0.125,
+) -> float:
+    """Expected tokens-per-step-unit gain of a speculative serving round
+    over plain decode (ISSUE 20's break-even surface, Leviathan et al.
+    2023 eq. 1 adapted to the serving cost model).
+
+    A plain decode step emits 1 token per 1.0 step unit. A speculative
+    round emits the accepted-prefix length plus the bonus token —
+    ``E[tokens] = sum_{j=0..k-1} alpha^j`` under per-position acceptance
+    probability ``alpha`` (the j-th draft survives only if all j before
+    it did; the bonus token is the j=0 term) — and costs
+    ``1 + verify_cost_factor*k + draft_cost_factor*k`` units (the
+    :class:`~triton_dist_tpu.serving.speculative.SpecDecodeConfig` cost
+    model the engine charges through ``virtual_step_s``). The gain is
+    their ratio; > 1.0 means speculation wins at this (k, alpha).
+    ``k=0`` (dormant) returns exactly 1.0 — the honesty contract: a
+    disarmed config predicts no win."""
+    if k < 0:
+        raise ValueError(f"k must be >= 0, got {k}")
+    if not 0.0 <= alpha <= 1.0:
+        raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+    if verify_cost_factor < 0 or draft_cost_factor < 0:
+        raise ValueError("cost factors must be >= 0")
+    if k == 0:
+        return 1.0
+    expected = sum(alpha ** j for j in range(k))
+    cost = 1.0 + verify_cost_factor * k + draft_cost_factor * k
+    return expected / cost

@@ -113,6 +113,15 @@ ALERT = "alert"                     # an SLO burn-rate rule fired or
                                     # — informational for is_healthy():
                                     # the alert PREDICTS the flip, the
                                     # degradation it predicts flips
+SPEC_K = "spec_k"                   # serving: the speculative batcher's
+                                    # adaptive-k moved (ISSUE 20) —
+                                    # informational for is_healthy():
+                                    # every emitted token is still
+                                    # verified by the target, k backoff
+                                    # is tuning, not degradation (the
+                                    # SHED_SPEC brownout rung that drops
+                                    # speculation outright records as
+                                    # BROWNOUT like every ladder move)
 
 # the kinds that flip is_healthy(): each one means some work was NOT
 # done on the fast clean path (the flight recorder's burn-rate alerts
@@ -272,6 +281,17 @@ def record_brownout(family: str, frm: str, to: str, *, pressure: float,
     _record(HealthEvent(
         kind=BROWNOUT, family=family,
         reason=f"{frm} -> {to} (pressure={pressure:.3f}, cause={cause})",
+        walltime=time.time(),
+    ))
+
+
+def record_spec_k(family: str, frm: int, to: int, *, alpha: float) -> None:
+    """One adaptive-k move of the speculative serving batcher
+    (serving/speculative.py), with the windowed acceptance rate that
+    triggered it. Informational — SPEC_K never flips is_healthy()."""
+    _record(HealthEvent(
+        kind=SPEC_K, family=family,
+        reason=f"k {frm} -> {to} (alpha={alpha:.3f})",
         walltime=time.time(),
     ))
 

@@ -36,6 +36,14 @@ seeded serve run that composes:
   by-absence attribution → quarantine → the POOL shrinks mid-stream),
   and — when scheduled — a prefill-pool timeout storm that collapses the
   topology to the unified engine with every in-flight request replayed;
+- **speculative serving** (ISSUE 20, ``SoakSpec.speculative``
+  campaigns): burst traffic through the unified engine with SELF-DRAFT
+  speculative decoding armed, composing scheduled corrupt-draft
+  injections (the batcher's sticky ``corrupt_draft_next`` seam — every
+  one must be rejected by the verify pass) with the straggler shrink +
+  prefix-replay arc mid-speculation; judged byte-for-byte against a
+  clean NON-speculative run of the same trace
+  (:func:`check_spec_invariants`);
 - **the N-replica fleet** (ISSUE 16, ``SoakSpec.fleet`` campaigns):
   burst traffic routed by prefix affinity over N disaggregated replicas,
   composing corrupt-KV-chunk injection on the replicas' handoff seams
@@ -169,6 +177,17 @@ class SoakSpec:
     replica_revive_at_step: int = 0
     pool_strag_at_step: int = 0
     prefill_storm_at_step: int = 0
+    # speculative campaign knobs (ISSUE 20): spec_k >= 2 arms self-draft
+    # speculative decoding (draft == target) on the unified engine, so
+    # the greedy token streams are PROVABLY byte-identical to a clean
+    # plain run — the campaign's judged invariant. n_draft_corruptions
+    # schedules sticky corrupt-draft injections (the batcher's chaos
+    # seam flips one drafted token mid-round); every one must be
+    # REJECTED by the verify pass with the stream untouched. The
+    # straggler arc composes: speculation must survive the shrink →
+    # prefix-replay rebuild with its draft state rebuilt cold.
+    spec_k: int = 0
+    n_draft_corruptions: int = 0
 
     @classmethod
     def fleet_recovery_spec(cls, seed: int = 0, **over) -> "SoakSpec":
@@ -231,6 +250,32 @@ class SoakSpec:
         return cls(**kw)
 
     @classmethod
+    def speculative(cls, seed: int = 0, **over) -> "SoakSpec":
+        """The ISSUE 20 soak shape: burst traffic through the unified
+        engine with SELF-DRAFT speculative decoding armed (k=3) ×
+        scheduled corrupt-draft injections × a persistent straggler
+        (mesh shrink + prefix replay mid-speculation). Judged against a
+        clean NON-SPECULATIVE run of the same trace: the finished set
+        and every finished request's token stream must be byte-identical
+        (greedy; the corrupted drafts must each be rejected by the
+        verify pass), and the whole campaign must replay bit-identically
+        from its seed. Overload/deadline pressure is deliberately OFF —
+        shed decisions are timing-dependent and would make the plain
+        reference incomparable; the ladder × speculation composition is
+        pinned in tests/test_spec_serving.py instead."""
+        kw = dict(
+            seed=seed, spec_k=3, n_draft_corruptions=2,
+            n_requests=12, rate_rps=12.0, burst_n=5,
+            s_max=32, max_queue=64,
+            # a narrow window: speculative campaigns take ~k× fewer
+            # steps than plain ones, and a fault drawn past the drain
+            # would deterministically never fire
+            n_timeouts=1, n_corruptions=0, fault_window=12,
+        )
+        kw.update(over)
+        return cls(**kw)
+
+    @classmethod
     def shared_prefix(cls, seed: int = 0, **over) -> "SoakSpec":
         """The ISSUE 12 soak shape: burst traffic over shared prefixes ×
         a straggler × payload corruption × a poisoned shared page."""
@@ -252,8 +297,32 @@ class SoakSpec:
             raise ValueError("corrupt_pe out of range")
         if self.fault_window < (
             self.n_timeouts + self.n_corruptions + self.n_poisons
+            + self.n_draft_corruptions
         ):
             raise ValueError("fault_window too small for the fault count")
+        if self.spec_k == 1:
+            raise ValueError(
+                "spec_k=1 cannot accept a draft under the k-1 cap — use "
+                "0 (off) or >= 2"
+            )
+        if self.n_draft_corruptions and not self.spec_k:
+            raise ValueError(
+                "n_draft_corruptions corrupts a DRAFT token — set spec_k "
+                "too"
+            )
+        if self.spec_k:
+            if self.disagg_prefill_pes or self.fleet_replicas:
+                raise ValueError(
+                    "speculative campaigns run the unified engine — "
+                    "spec_k composes with neither the disagg nor the "
+                    "fleet shapes"
+                )
+            if self.prefix_pool or self.n_corruptions or self.n_poisons:
+                raise ValueError(
+                    "the speculative campaign's seams are draft "
+                    "corruption + the straggler; n_corruptions / "
+                    "n_poisons / prefix_pool are the other shapes' seams"
+                )
         if self.prefix_pool and not self.page_size:
             raise ValueError(
                 "shared-prefix campaigns need page_size (the prefix cache "
@@ -656,6 +725,273 @@ def check_invariants(eng, result: CampaignResult, offered_uids: set) -> list:
             f"controller transitions {len(result.transitions)}"
         )
     return fails
+
+
+def _spec_fault_schedule(spec: SoakSpec) -> dict[int, tuple[str, int]]:
+    """step-call-number -> ("timeout" | "draft", pe) for the speculative
+    campaign, seed-derived like :func:`fault_schedule` (distinct steps,
+    interleaved kinds)."""
+    rng = np.random.default_rng([int(spec.seed), 0x5DEC])
+    n = spec.n_timeouts + spec.n_draft_corruptions
+    steps = sorted(
+        int(s) for s in rng.choice(
+            np.arange(2, 2 + spec.fault_window), size=n, replace=False
+        )
+    )
+    kinds = (
+        [("timeout", spec.straggler_pe)] * spec.n_timeouts
+        + [("draft", -1)] * spec.n_draft_corruptions
+    )
+    rng.shuffle(kinds)
+    return {s: tuple(k) for s, k in zip(steps, kinds)}
+
+
+@contextlib.contextmanager
+def _inject_spec_faults(schedule: dict, world: int):
+    """The speculative chaos seam (ISSUE 20): wrap
+    ``SpeculativeBatcher.step`` (it overrides the base ``step``, so the
+    :func:`_inject_faults` wrap would never fire). Scheduled "timeout"
+    faults raise the usual by-absence straggler records; scheduled
+    "draft" faults arm the batcher's sticky ``corrupt_draft_next`` flag
+    — and RE-ARM it every step until a speculative round actually
+    consumes it, so an idle step, a prompt-feed-only round, or a
+    mid-schedule rebuild (fresh batcher, armed flag lost) cannot
+    silently swallow a corruption the campaign's invariants charge
+    for."""
+    from triton_dist_tpu.serving.speculative import SpeculativeBatcher
+
+    real_step = SpeculativeBatcher.step
+    calls = {"n": 0}
+    pending = {"draft": 0}
+
+    def flaky(self):
+        calls["n"] += 1
+        fault = schedule.get(calls["n"])
+        if fault is not None:
+            kind, pe = fault
+            if kind == "timeout":
+                raise DistTimeoutError(
+                    "batcher_step", _timeout_records(world, pe),
+                    world_size=world,
+                )
+            pending["draft"] += 1   # kind == "draft"
+        if pending["draft"]:
+            self.corrupt_draft_next = True
+        before = self.spec_draft_faults_injected
+        out = real_step(self)
+        if pending["draft"] and self.spec_draft_faults_injected > before:
+            pending["draft"] -= 1
+            # one corruption per round: disarm until the next one is due
+            if not pending["draft"]:
+                self.corrupt_draft_next = False
+        return out
+
+    SpeculativeBatcher.step = flaky
+    try:
+        yield calls
+    finally:
+        SpeculativeBatcher.step = real_step
+
+
+def check_spec_invariants(eng, result: CampaignResult, offered_uids: set,
+                          reference: dict, streams: dict) -> list:
+    """The speculative campaign's green conditions: the standard
+    unified-engine invariants (:func:`check_invariants`) plus the ISSUE
+    20 contract — every scheduled draft corruption fired and was
+    REJECTED by the verify pass (>= 1 rollback apiece), speculative
+    rounds actually ran, and the finished set AND every finished token
+    stream are byte-identical to the clean non-speculative
+    ``reference`` run ({uid: tokens})."""
+    fails = check_invariants(eng, result, offered_uids)
+    spec = result.spec
+    sp = result.snapshot.get("speculative")
+    if sp is None:
+        fails.append(
+            "no speculative section in the engine snapshot — the "
+            "campaign ran disarmed"
+        )
+        return fails
+    if not sp["rounds"]:
+        fails.append(
+            "no speculative round ever ran — the draft+verify path this "
+            "campaign exists to exercise was never entered (retune the "
+            "spec)"
+        )
+    if sp["draft_faults_injected"] != spec.n_draft_corruptions:
+        fails.append(
+            f"draft corruptions fired {sp['draft_faults_injected']} != "
+            f"scheduled {spec.n_draft_corruptions} — the chaos seam "
+            f"never reached a speculative round (retune the spec)"
+        )
+    if sp["draft_faults_injected"] and (
+        sp["rollback_total"] < sp["draft_faults_injected"]
+    ):
+        fails.append(
+            f"rollbacks {sp['rollback_total']} < injected draft faults "
+            f"{sp['draft_faults_injected']} — a corrupted draft token "
+            f"survived the verify pass"
+        )
+    fin = {u for u, k in result.terminals.items() if k == "finished"}
+    if fin != set(reference):
+        fails.append(
+            f"finished set diverged from the plain reference: "
+            f"missing={sorted(set(reference) - fin)} "
+            f"extra={sorted(fin - set(reference))}"
+        )
+    diverged = sorted(
+        u for u in fin & set(reference) if streams.get(u) != reference[u]
+    )
+    if diverged:
+        fails.append(
+            f"token streams diverged from the clean non-speculative run "
+            f"for {diverged} — acceptance/rollback/commit is not "
+            f"stream-preserving"
+        )
+    return fails
+
+
+def _run_speculative_campaign(spec: SoakSpec) -> CampaignResult:
+    """One seeded speculative campaign (dispatched by
+    :func:`run_campaign` when ``spec.spec_k > 0``): the unified engine
+    with self-draft speculation armed, judged byte-for-byte against a
+    clean plain run of the same trace (run first, outside the flight
+    recorder, with its health/obs noise wiped before the judged
+    run)."""
+    import jax
+
+    from triton_dist_tpu import config as tdt_config
+    from triton_dist_tpu import resilience
+    from triton_dist_tpu.serving import (
+        ServingConfig,
+        ServingEngine,
+        SpecDecodeConfig,
+        TrafficSpec,
+        generate_trace,
+    )
+    from triton_dist_tpu.serving.metrics import SLOTargets
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < spec.world:
+        raise RuntimeError(
+            f"soak needs {spec.world} devices (run under "
+            f"--xla_force_host_platform_device_count, as "
+            f"scripts/chaos_soak.py and conftest.py do); have "
+            f"{len(jax.devices())}"
+        )
+    cfgsnap = tdt_config.get_config()
+    saved = (cfgsnap.elastic, cfgsnap.suspect_threshold,
+             cfgsnap.probation_probes)
+    resilience.reset(keep_env=True)
+    tdt_config.update(
+        elastic=True, suspect_threshold=max(1, spec.n_timeouts),
+        probation_probes=1,
+    )
+    try:
+        from triton_dist_tpu.models import init_params
+        from triton_dist_tpu.models.tp_transformer import TransformerConfig
+        from triton_dist_tpu.ops.allgather_gemm import AGGemmConfig
+        from triton_dist_tpu.ops.gemm_reduce_scatter import GemmRSConfig
+        from jax.random import PRNGKey
+
+        cfg = TransformerConfig(
+            vocab=32, hidden=32, ffn=64, n_layers=1, n_q_heads=4,
+            n_kv_heads=4, head_dim=8, batch=spec.batch, seq=8,
+            ag_config=AGGemmConfig(8, 16, 16),
+            rs_config=GemmRSConfig(8, 16, 16),
+        )
+        params = init_params(PRNGKey(1), cfg)
+        mesh = Mesh(np.array(jax.devices()[:spec.world]), ("tp",))
+        traffic = TrafficSpec(
+            rate_rps=spec.rate_rps, n_requests=spec.n_requests,
+            process="burst", burst_every_s=spec.burst_every_s,
+            burst_n=spec.burst_n,
+            prompt_len=("uniform", 2, 4), output_len=("uniform", 4, 8),
+            vocab=cfg.vocab, seed=spec.seed, uid_prefix=f"sp{spec.seed}-",
+            priority_mix=spec.priority_mix, deadline_ms=spec.deadline_ms,
+        )
+
+        def build_engine(sd, clock, tag):
+            # no overload/deadline enforcement: shed decisions are
+            # timing-dependent, and the reference comparison needs both
+            # arms to finish the same request set
+            return ServingEngine(
+                cfg, params, mesh, s_max=spec.s_max, clock=clock,
+                serving=ServingConfig(
+                    max_queue=spec.max_queue,
+                    virtual_step_s=spec.virtual_step_s,
+                    probe_interval_steps=4,
+                    slo=SLOTargets(ttft_ms=1500.0),
+                    speculative=sd,
+                ),
+                obs_tag=tag,
+            )
+
+        ref_clock = _retry.FakeClock()
+        with _retry.clock_scope(ref_clock):
+            ref_eng = build_engine(None, ref_clock, "ref:")
+            ref_done = ref_eng.serve(
+                generate_trace(traffic), max_steps=spec.max_steps
+            )
+        reference = {
+            u: list(r.tokens) for u, r in ref_done.items()
+            if isinstance(r, Finished)
+        }
+        # wipe the reference run's (empty, but structurally possible)
+        # health residue so the judged run's accounting stands alone
+        resilience.reset(keep_env=True)
+
+        trace = generate_trace(traffic)
+        schedule = _spec_fault_schedule(spec)
+        clock = _retry.FakeClock()
+        with _flight_recorder():
+            with _retry.clock_scope(clock):
+                eng = build_engine(
+                    SpecDecodeConfig(
+                        draft_cfg=cfg, draft_params=params, k=spec.spec_k
+                    ),
+                    clock, "",
+                )
+                error = None
+                with _inject_spec_faults(schedule, spec.world) as calls:
+                    try:
+                        done = eng.serve(trace, max_steps=spec.max_steps)
+                    except RuntimeError as exc:
+                        error = f"{type(exc).__name__}: {exc}"
+                        done = dict(eng.results)
+            streams = {
+                u: list(r.tokens) for u, r in done.items()
+                if isinstance(r, Finished)
+            }
+            result = CampaignResult(
+                spec=spec,
+                terminals={u: _terminal_kind(r) for u, r in done.items()},
+                n_steps_hint=calls["n"],
+                rebuilds=eng.rebuilds,
+                transitions=[
+                    dataclasses.asdict(t)
+                    for t in (eng._overload.transitions
+                              if eng._overload else ())
+                ],
+                snapshot=eng.snapshot(),
+                health=resilience.health.snapshot(),
+                fingerprint="",
+                failures=[],
+                error=error,
+            )
+            result.fingerprint = campaign_fingerprint(result)
+            offered = {a.request.uid for a in trace}
+            result.failures = (
+                check_spec_invariants(eng, result, offered, reference,
+                                      streams)
+                + check_blackbox_invariant(result.health)
+            )
+        return result
+    finally:
+        tdt_config.update(
+            elastic=saved[0], suspect_threshold=saved[1],
+            probation_probes=saved[2],
+        )
+        resilience.reset(keep_env=True)
 
 
 @contextlib.contextmanager
@@ -1410,11 +1746,15 @@ def run_campaign(spec: SoakSpec, *, model=None) -> CampaignResult:
     4-PE transformer (the test fixture reuse hook). A spec with
     ``disagg_prefill_pes > 0`` runs the two-pool topology campaign
     (:func:`check_disagg_invariants`); ``fleet_replicas > 0`` runs the
-    N-replica router campaign (:func:`check_fleet_invariants`)."""
+    N-replica router campaign (:func:`check_fleet_invariants`);
+    ``spec_k > 0`` runs the speculative-decoding campaign
+    (:func:`check_spec_invariants`)."""
     if spec.validate().fleet_replicas:
         return _run_fleet_campaign(spec)
     if spec.disagg_prefill_pes:
         return _run_disagg_campaign(spec)
+    if spec.spec_k:
+        return _run_speculative_campaign(spec)
     import jax
 
     from triton_dist_tpu import config as tdt_config

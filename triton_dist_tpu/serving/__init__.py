@@ -12,6 +12,10 @@ Five parts (docs/serving.md "Serving engine" is the full contract):
   serviceable survivor mesh with every in-flight request prefix-replayed
   (prompt + tokens-so-far; no generation lost), and probation
   re-admission grows the world back mid-serving.
+- :mod:`speculative` — speculative decoding as a serving mode (ISSUE
+  20): per-slot draft+verify rounds in the continuous batcher, armed via
+  ``ServingConfig(speculative=SpecDecodeConfig(...))``, adaptive-k, and
+  the overload ladder's negative-cost ``shed_speculation`` rung.
 - :mod:`overload` — the overload controller (ISSUE 11): deadline
   propagation with typed ``Shed`` expiry, interactive/batch priority
   classes with per-class resubmit token buckets, and the pressure-driven
@@ -119,7 +123,12 @@ from triton_dist_tpu.serving.overload import (
     OverloadConfig,
     OverloadController,
     PRIORITIES,
+    SHED_SPEC,
     priority_rank,
+)
+from triton_dist_tpu.serving.speculative import (
+    SpecDecodeConfig,
+    SpeculativeBatcher,
 )
 from triton_dist_tpu.serving.traffic import (
     Arrival,
@@ -150,11 +159,14 @@ __all__ = [
     "PrefixCacheConfig",
     "Rejected",
     "ResurrectConfig",
+    "SHED_SPEC",
     "ServingConfig",
     "ServingEngine",
     "ServingMetrics",
     "SLOTargets",
     "Shed",
+    "SpecDecodeConfig",
+    "SpeculativeBatcher",
     "StreamingHistogram",
     "TrafficSpec",
     "generate_trace",

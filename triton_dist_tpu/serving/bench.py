@@ -203,6 +203,18 @@ def info_lines(rows: list[dict], tag: str = "") -> list[tuple[str, Any, str]]:
             if st is not None and st["count"]:
                 out.append((f"serving_interactive_ttft_p99_ms_{key}",
                             st["p99"], "ms"))
+        if "speculative" in snap:
+            # the speculative A/B's attribution columns (ISSUE 20): the
+            # measured acceptance rate behind the sd_on arm's tokens/s,
+            # the adaptive k it settled on, and the rejected-draft volume
+            sp = snap["speculative"]
+            out.append((f"serving_spec_accept_rate_{key}",
+                        sp["accept_rate"] if sp["accept_rate"] is not None
+                        else 0.0, "fraction"))
+            out.append((f"serving_spec_k_live_{key}",
+                        sp["k_live"], "tokens"))
+            out.append((f"serving_spec_rollback_{key}",
+                        sp["rollback_total"], "tokens"))
         if "fleet" in snap:
             # the fleet A/B's judged columns (ISSUE 16): did affinity
             # routing actually land repeat prefixes on warm replicas,

@@ -127,6 +127,21 @@ class ServingConfig:
                       cost asymmetry under which chunked admission's
                       tail-latency win is measurable. None (default) =
                       prefill charges nothing, as before.
+    speculative:      a :class:`~triton_dist_tpu.serving.speculative.
+                      SpecDecodeConfig` arms speculative decoding as a
+                      serving mode (ISSUE 20): the batcher proposes k
+                      draft tokens per slot per round and verifies them
+                      in ONE batched ranged pass, accepting per-slot.
+                      Greedy streams are byte-identical to plain
+                      serving; seeded-sampled streams are
+                      replay-deterministic. With ``virtual_step_s`` the
+                      step charge scales by the round's cost units
+                      (plain round = 1.0), so FakeClock A/Bs measure the
+                      real step-count win. Composes with the
+                      ``overload`` ladder's ``shed_speculation`` rung
+                      (drop the draft under pressure, counted rebuild,
+                      reverted on descent). None (the default) = the
+                      pre-spec engine, byte for byte.
     """
 
     max_queue: int = 256
@@ -141,8 +156,11 @@ class ServingConfig:
     prefix_cache: PrefixCacheConfig | None = None
     prefill_chunk_tokens: int | None = None
     virtual_prefill_work_s: float | None = None
+    speculative: Any = None
 
     def validate(self) -> "ServingConfig":
+        if self.speculative is not None:
+            self.speculative.validate()
         if self.max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
         if self.prefill_chunk_tokens is not None and self.prefill_chunk_tokens < 1:
@@ -345,6 +363,16 @@ class ServingEngine:
         self._downshift_depth = 0
         self._w8_params = None         # once-quantized serving banks cache
         self._fp8_params = None        # ... and the fp8 twin (ISSUE 19)
+        # speculation shed (ISSUE 20): True while the SHED_SPEC brownout
+        # rung holds — _build serves the PLAIN batcher; reverted on
+        # descent through the same counted-rebuild machinery as the
+        # precision downshifts
+        self._spec_shed = False
+        # speculative counters accumulated across batcher rebuilds (each
+        # rebuild starts fresh tallies, like the px trie) + the
+        # already-mirrored watermark for the _mx delta counters
+        self._spec_totals: dict[str, int] = {}
+        self._spec_mx_seen: dict[str, int] = {}
         # prefix-cache counters accumulated across batcher rebuilds (each
         # rebuild starts a FRESH trie — the pool is the batcher's)
         self._px_totals: dict[str, int] = {}
@@ -468,9 +496,23 @@ class ServingEngine:
             kw["prefix_cache"] = self.serving.prefix_cache
         if self.serving.prefill_chunk_tokens is not None:
             kw["prefill_chunk_tokens"] = self.serving.prefill_chunk_tokens
-        batcher = ContinuousBatcher(
-            self.cfg, self._serving_params(), mesh, s_max=self.s_max, **kw
-        )
+        if self.serving.speculative is not None and not self._spec_shed:
+            # the speculative batcher (ISSUE 20); under the SHED_SPEC
+            # brownout rung the engine builds the PLAIN batcher instead —
+            # shedding speculation IS this dispatch flipping, composed
+            # through the same rebuild+replay the downshifts use
+            from triton_dist_tpu.serving.speculative import SpeculativeBatcher
+
+            batcher = SpeculativeBatcher(
+                self.cfg, self._serving_params(), mesh, s_max=self.s_max,
+                spec_decode=self.serving.speculative, **kw,
+            )
+            batcher.on_k_change = self._on_spec_k_change
+        else:
+            batcher = ContinuousBatcher(
+                self.cfg, self._serving_params(), mesh, s_max=self.s_max,
+                **kw
+            )
         # a fresh batcher's prefill-work counter restarts at 0: resync the
         # engine's charge watermark so rebuilt+replayed admissions charge
         # their own work, not a stale delta
@@ -642,7 +684,16 @@ class ServingEngine:
             raise
         self._failures = 0
         if self.serving.virtual_step_s:
-            self.clock.sleep(self.serving.virtual_step_s)
+            if self.serving.speculative is not None:
+                # speculative step-count accounting (ISSUE 20): a
+                # draft+verify round charges its cost-model units (the
+                # plain round, and the shed/dormant batcher, charge 1.0)
+                self.clock.sleep(
+                    self.serving.virtual_step_s
+                    * getattr(self._batcher, "last_step_units", 1.0)
+                )
+            else:
+                self.clock.sleep(self.serving.virtual_step_s)
         if self.serving.virtual_prefill_work_s:
             # work-proportional prefill charge (ISSUE 18): this step's
             # swept query×key token-pairs through the MXU prefill paths
@@ -760,6 +811,27 @@ class ServingEngine:
                     st.req.uid, st.priority, st.t_enqueue, now,
                     "ladder reached shed_all_batch: queued batch shed",
                 )
+        want_shed = ctrl.wants_spec_shed()
+        if want_shed != self._spec_shed:
+            self._spec_shed = want_shed
+            if (self.serving.speculative is not None
+                    and self.serving.speculative.k >= 2):
+                # the NEGATIVE-cost rung (ISSUE 20): drop/restore the
+                # draft model via the same counted rebuild + prefix
+                # replay as the precision stages below — no in-flight
+                # request loses a token over the mode flip. On a
+                # non-speculative engine the rung is recorded but
+                # rebuilds nothing (armed-untriggered ≡ disarmed).
+                if want_shed:
+                    self.metrics.count("spec_sheds")
+                    self._rebuild(
+                        f"brownout speculation shed ({tr.frm} -> {tr.to})"
+                    )
+                else:
+                    self._rebuild(
+                        f"brownout recovery: speculation restored "
+                        f"({tr.frm} -> {tr.to})"
+                    )
         depth = ctrl.downshift_depth()
         if depth != self._downshift_depth:
             deeper = depth > self._downshift_depth
@@ -840,6 +912,30 @@ class ServingEngine:
             _mx.gauge("serving_tokens_goodput_per_s",
                       round(self.metrics.tokens_goodput / elapsed, 6),
                       engine=self.family)
+            if self.serving.speculative is not None:
+                # the ISSUE 20 mirror: acceptance-rate / live-k gauges,
+                # rollback + accepted-token counters as DELTAS against
+                # the cumulative tallies (counters must only ever go up,
+                # and the tallies survive rebuilds via _fold_spec)
+                cum = self._spec_cum()
+                if cum["tokens_offered"]:
+                    _mx.gauge(
+                        "spec_accept_rate",
+                        round(cum["tokens_accepted"]
+                              / cum["tokens_offered"], 6),
+                        engine=self.family,
+                    )
+                _mx.gauge("spec_k_live",
+                          getattr(self._batcher, "k_live", 0),
+                          engine=self.family)
+                for name, key in (
+                    ("spec_rollback_total", "rollback_total"),
+                    ("spec_tokens_accepted_total", "tokens_accepted"),
+                ):
+                    d = cum[key] - self._spec_mx_seen.get(key, 0)
+                    if d > 0:
+                        _mx.counter(name, d, engine=self.family)
+                        self._spec_mx_seen[key] = cum[key]
         for i, r in enumerate(b.slot_req):
             if r is None:
                 continue
@@ -1078,6 +1174,7 @@ class ServingEngine:
         # accumulate at the engine so a rebuild never zeroes the hit-rate
         struck = old.drain_struck()
         self._fold_px(old.prefix_cache_stats())
+        self._fold_spec(old)
         active, queued = old.export_in_flight()
         target = self._target_mesh()
         self.rebuilds += 1
@@ -1242,6 +1339,57 @@ class ServingEngine:
         for k in PX_COUNTERS:
             self._px_totals[k] = self._px_totals.get(k, 0) + stats.get(k, 0)
 
+    # -- speculative readout (ISSUE 20) ----------------------------------
+
+    _SPEC_COUNTERS = ("rounds", "tokens_offered", "tokens_accepted",
+                      "rollback_total", "bonus_total", "k_transitions",
+                      "draft_faults_injected")
+
+    def _fold_spec(self, old) -> None:
+        """Accumulate a retiring batcher's speculative counters — a
+        rebuild (elastic, downshift, spec shed) starts fresh tallies."""
+        snap = getattr(old, "spec_snapshot", None)
+        if snap is None:
+            return
+        for k, v in snap().items():
+            if k in self._SPEC_COUNTERS:
+                self._spec_totals[k] = self._spec_totals.get(k, 0) + v
+
+    def _spec_cum(self) -> dict:
+        """Cumulative speculative counters: retired batchers + live."""
+        live = getattr(self._batcher, "spec_snapshot", None)
+        live = live() if live is not None else {}
+        return {
+            k: self._spec_totals.get(k, 0) + live.get(k, 0)
+            for k in self._SPEC_COUNTERS
+        }
+
+    def _on_spec_k_change(self, frm: int, to: int, alpha: float) -> None:
+        """The live batcher's adaptive-k callback: health event (the
+        informational SPEC_K kind), engine counter, _mx counter."""
+        self.metrics.count("spec_k_transitions")
+        health.record_spec_k(self.family, frm, to, alpha=alpha)
+        _mx.counter("spec_k_transitions_total", engine=self.family)
+
+    def _spec_section(self) -> dict | None:
+        """The engine snapshot's "speculative" section (None when
+        disarmed, so disarmed snapshots stay byte-identical)."""
+        if self.serving.speculative is None:
+            return None
+        cum = self._spec_cum()
+        offered = cum["tokens_offered"]
+        out = {
+            "k": self.serving.speculative.k,
+            "k_live": getattr(self._batcher, "k_live", 0),
+            "shed": self._spec_shed,
+            "accept_rate": (
+                round(cum["tokens_accepted"] / offered, 6) if offered
+                else None
+            ),
+            **cum,
+        }
+        return out
+
     def _px_snapshot(self) -> dict | None:
         """Prefix-cache counters summed across every batcher this engine
         has run (rebuilds start fresh tries), gauges from the live one."""
@@ -1293,6 +1441,11 @@ class ServingEngine:
             # the ISSUE 12 surface: hit-rate, pages-shared gauge, and
             # prefill-tokens-saved counters the bench A/B reads
             snap["prefix_cache"] = px
+        sp = self._spec_section()
+        if sp is not None:
+            # the ISSUE 20 surface: acceptance rate, live k, rollback
+            # and accepted-token totals the bench info lines read
+            snap["speculative"] = sp
         if _obs.span_enabled():
             # per-phase p50/p99 from the span tracer (ISSUE 9 satellite):
             # the λ-sweep rows carry a step-time BREAKDOWN (queued /

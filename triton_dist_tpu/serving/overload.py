@@ -50,6 +50,14 @@ Three mechanisms, composed:
   starts refusing work. Single-callable configs keep the legacy 4-state
   ladder byte-identically.
 
+  ``shed_speculation=True`` (ISSUE 20) inserts *shed_spec* between
+  brownout1 and brownout2: a speculative engine (``ServingConfig.
+  speculative``) drops its draft+verify round and rebuilds as plain
+  decode — the one degradation that FREES compute rather than spending
+  it, so it outranks every precision downshift. Composed and reverted
+  through the same counted-rebuild replay machinery; disarmed configs
+  keep their ladder byte for byte.
+
   Climbs are immediate (one rung per observed step — overload is an
   emergency); descents require the pressure to fall below the *exit*
   threshold of the current rung AND a minimum dwell, so the ladder cannot
@@ -78,10 +86,15 @@ PRIORITIES = ("interactive", "batch")
 # ladder states, in climbing order. LADDER is the legacy (single-stage
 # downshift) shape; a two-stage ``OverloadConfig.downshift`` inserts
 # BROWNOUT3 between BROWNOUT2 and SHED_ALL_BATCH (ISSUE 19: the fp8
-# rung below w8) — read the effective ladder off
-# ``OverloadConfig.ladder()`` / ``OverloadController._ladder``.
+# rung below w8), and ``shed_speculation=True`` inserts SHED_SPEC
+# between BROWNOUT1 and BROWNOUT2 (ISSUE 20: the NEGATIVE-cost rung —
+# dropping the draft model frees draft+verify compute, so it belongs
+# BEFORE any rung that spends a rebuild degrading precision) — read the
+# effective ladder off ``OverloadConfig.ladder()`` /
+# ``OverloadController._ladder``.
 NORMAL = "normal"
 BROWNOUT1 = "brownout1"
+SHED_SPEC = "shed_spec"
 BROWNOUT2 = "brownout2"
 BROWNOUT3 = "brownout3"
 SHED_ALL_BATCH = "shed_all_batch"
@@ -129,6 +142,16 @@ class OverloadConfig:
                      applies stage 1 composed on top (fp8), and each
                      descent peels one stage back off. None = the
                      transition is still recorded, nothing is rebuilt.
+    shed_speculation: arm the SHED_SPEC rung between brownout1 and
+                     brownout2 (ISSUE 20): a speculative engine drops
+                     its draft+verify round and runs plain decode —
+                     degradation that FREES compute instead of spending
+                     it, so it fires before any precision downshift.
+                     The engine composes/reverts it through the same
+                     counted-rebuild replay machinery as the downshift
+                     stages; armed on a non-speculative engine the rung
+                     still exists (the transition is recorded, nothing
+                     is rebuilt — armed-untriggered ≡ disarmed).
     """
 
     enter_pressure: tuple = (0.55, 0.75, 0.9)
@@ -144,6 +167,7 @@ class OverloadConfig:
     retry_budget: int = 8
     retry_refill_per_s: float = 1.0
     downshift: Any = None
+    shed_speculation: bool = False
 
     def downshift_stages(self) -> tuple:
         """The downshift hook normalized to a tuple of ``cfg -> cfg``
@@ -157,10 +181,17 @@ class OverloadConfig:
 
     def ladder(self) -> tuple:
         """The effective ladder for THIS config: the legacy 4-state shape
-        unless a second downshift stage earns brownout3 its rung."""
+        unless a second downshift stage earns brownout3 its rung and/or
+        ``shed_speculation`` earns shed_spec its rung below brownout2.
+        Disarmed configs keep every legacy ladder byte for byte."""
+        steps = [NORMAL, BROWNOUT1]
+        if self.shed_speculation:
+            steps.append(SHED_SPEC)
+        steps.append(BROWNOUT2)
         if len(self.downshift_stages()) >= 2:
-            return (NORMAL, BROWNOUT1, BROWNOUT2, BROWNOUT3, SHED_ALL_BATCH)
-        return LADDER
+            steps.append(BROWNOUT3)
+        steps.append(SHED_ALL_BATCH)
+        return tuple(steps)
 
     def validate(self) -> "OverloadConfig":
         stages = self.downshift_stages()
@@ -347,8 +378,22 @@ class OverloadController:
         return self.state != NORMAL
 
     def wants_downshift(self) -> bool:
-        """brownout2 and above request the degraded precision step."""
-        return self.rung() >= 2 and self.config.downshift is not None
+        """brownout2 and above request the degraded precision step.
+        (Rung indices are ladder-relative: an armed shed_spec rung
+        shifts brownout2's absolute index up by one.)"""
+        return (
+            self.rung() >= self._ladder.index(BROWNOUT2)
+            and self.config.downshift is not None
+        )
+
+    def wants_spec_shed(self) -> bool:
+        """shed_spec and above request the plain (non-speculative)
+        engine step — the negative-cost rung. Always False when the
+        rung is not armed."""
+        return (
+            self.config.shed_speculation
+            and self.rung() >= self._ladder.index(SHED_SPEC)
+        )
 
     def downshift_depth(self) -> int:
         """How many downshift stages the current rung composes onto the
@@ -356,10 +401,10 @@ class OverloadController:
         stages 0..1 at brownout3, capped at the configured stage count
         (shed_all_batch keeps the deepest composition — shedding batch is
         a worse emergency than the one that degraded precision)."""
-        r = self.rung()
-        if r < 2:
+        r, fp = self.rung(), self._ladder.index(BROWNOUT2)
+        if r < fp:
             return 0
-        return min(r - 1, len(self.config.downshift_stages()))
+        return min(r - fp + 1, len(self.config.downshift_stages()))
 
     def shed_victim(self, queued: list) -> int | None:
         """Pick the overflow-shed victim among ``queued``
